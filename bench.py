@@ -1,0 +1,65 @@
+"""Benchmark: TPC-H Q6/Q1 throughput on the attached TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's vectorized (colexec) engine publishes no
+absolute numbers (BASELINE.md); public roachperf-class hardware runs
+put a Q6-shaped scan+filter+sum around 20-40M rows/s/core, i.e.
+~1.2e8 rows/s on the 3x4-vCPU roachtest config the reference gates on
+(pkg/cmd/roachtest/tests/tpchvec.go). We use 1.25e8 rows/s as the
+colexec baseline for vs_baseline; the north star is >=10x
+(BASELINE.json).
+
+Environment knobs: BENCH_ROWS (default 2^23), BENCH_QUERY (q6|q1|q14).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+BASELINE_ROWS_PER_SEC = 1.25e8  # colexec-equivalent Q6 throughput
+
+
+def main():
+    rows = int(os.environ.get("BENCH_ROWS", 1 << 23))
+    which = os.environ.get("BENCH_QUERY", "q6")
+
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    eng = Engine()
+    t0 = time.time()
+    tables = ("lineitem", "part") if which == "q14" else ("lineitem",)
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows, tables=tables)
+    gen_s = time.time() - t0
+
+    sql = tpch.QUERIES[which]
+    # warmup: compile + device upload
+    t0 = time.time()
+    eng.execute(sql)
+    compile_s = time.time() - t0
+
+    times = []
+    for _ in range(7):
+        t0 = time.time()
+        eng.execute(sql)
+        times.append(time.time() - t0)
+    med = statistics.median(times)
+    rps = rows / med
+
+    out = {
+        "metric": f"tpch_{which}_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/s",
+        "vs_baseline": round(rps / BASELINE_ROWS_PER_SEC, 3),
+    }
+    print(json.dumps(out))
+    print(f"# rows={rows} median_query_s={med:.4f} warmup_s={compile_s:.1f} "
+          f"datagen_s={gen_s:.1f} runs={['%.4f' % t for t in times]}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""cockroach_tpu: a TPU-native distributed SQL database framework.
+
+A from-scratch rebuild of the capabilities of CockroachDB (reference:
+/root/reference, a Go distributed SQL database) designed TPU-first:
+
+- The *device side* (JAX/XLA/Pallas) owns columnar query execution: the
+  analogue of the reference's vectorized engine (``pkg/sql/colexec``,
+  453K lines of generated per-type Go kernels) is a small set of
+  dtype-generic, mask-based JAX kernels compiled by XLA onto the MXU/VPU.
+- The *host side* (Python, C++ where hot) owns what a database host must
+  own: pgwire-ish wire protocol, SQL parsing/planning, the catalog, the
+  MVCC KV store, replication, and job control.
+- The *distribution* layer maps the reference's DistSQL flows
+  (``pkg/sql/distsql_physical_planner.go``) onto ``jax.sharding.Mesh``:
+  range partitions become per-chip shards, and DistSQL's final-stage
+  partial-aggregate shuffle becomes an ICI allreduce
+  (``jax.lax.psum`` inside ``shard_map``).
+
+Layer map (mirrors SURVEY.md §1):
+
+    sql/        parser, AST, semantic analysis, logical planner
+    exec/       logical plan -> compiled JAX program (the "colexec")
+    ops/        device columnar core: ColumnBatch, kernels, agg, join
+    storage/    host columnar MVCC store + memtable/LSM + HLC
+    kv/         transactional KV client (txn coordinator, latches)
+    parallel/   mesh partitioning, shard_map flows, collectives
+    server/     session/connExecutor-analogue + wire protocol
+    models/     flagship query "models" (TPC-H workloads) for bench
+    utils/      settings, metrics, tracing, errors
+"""
+
+__version__ = "0.1.0"
+
+# The engine's physical types require 64-bit lanes (HLC timestamps and
+# scaled-decimal int64 accumulation); JAX disables x64 by default.
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_enable_x64", True)

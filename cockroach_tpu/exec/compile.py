@@ -1,0 +1,408 @@
+"""Compile logical plans into one fused XLA program.
+
+The reference's execution model is a pull-based tree of Operator
+objects, each with a per-batch Next() (colexecop/operator.go:27) —
+pipeline parallelism via goroutines, kernels via 453K lines of
+generated Go. Here the *whole plan* compiles to a single jitted
+function over device-resident columns: scans are MVCC mask kernels,
+filters narrow the selection mask, joins gather through a device hash
+table, and aggregation is a segment reduction. XLA fuses the
+elementwise chain into the reductions, so a Q6-shaped plan becomes
+roughly one fused multiply-mask-reduce over HBM — the TPU answer to
+operator pipelining (no materialization between "operators" at all).
+
+Compilation caching mirrors the reference's plan caching: the engine
+caches the jitted callable keyed by plan fingerprint + input shapes
+(exec/engine.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import agg as aggops
+from ..ops import hashtable
+from ..ops.batch import ColumnBatch
+from ..ops.join import hash_join
+from ..sql import plan as P
+from ..sql.bound import BoundAgg
+from ..sql.types import Family
+from .expr import ExprContext, compile_expr
+
+
+class ExecError(Exception):
+    pass
+
+
+@dataclass
+class ExecParams:
+    """Static execution parameters (session-var controlled)."""
+    hash_group_capacity: int = 1 << 17  # slots for hash-strategy GROUP BY
+    # When set, the plan compiles as one SPMD program per mesh shard:
+    # scans see row-shards, and aggregate partials merge with ICI
+    # collectives over this axis (the DistSQL final-stage merge of
+    # physicalplan/aggregator_funcs.go becomes a psum/pmin/pmax).
+    axis_name: str | None = None
+
+
+class RunContext:
+    """Per-execution inputs to the compiled program."""
+
+    def __init__(self, scans: dict[str, ColumnBatch], read_ts):
+        self.scans = scans
+        self.read_ts = read_ts
+
+
+CompiledNode = Callable[[RunContext], ColumnBatch]
+
+
+def _ctx_of(batch: ColumnBatch, aggs=None) -> ExprContext:
+    cols = {name: (batch.data[i], batch.valid[i])
+            for i, name in enumerate(batch.names)}
+    return ExprContext(cols, batch.n, aggs)
+
+
+def compile_plan(node: P.PlanNode, params: ExecParams,
+                 meta: P.OutputMeta | None = None) -> CompiledNode:
+    if isinstance(node, P.Scan):
+        return _compile_scan(node, params)
+    if isinstance(node, P.Filter):
+        childf = compile_plan(node.child, params)
+        predf = compile_expr(node.pred)
+
+        def run_filter(rc):
+            b = childf(rc)
+            pv = predf(_ctx_of(b))
+            return b.and_sel(jnp.logical_and(pv[0], pv[1]))
+        return run_filter
+    if isinstance(node, P.Project):
+        childf = compile_plan(node.child, params)
+        items = [(name, compile_expr(e)) for name, e in node.items]
+
+        def run_project(rc):
+            b = childf(rc)
+            ctx = _ctx_of(b)
+            cols, valid = {}, {}
+            for name, f in items:
+                d, v = f(ctx)
+                cols[name] = d
+                valid[name] = v
+            return ColumnBatch.from_dict(cols, valid, sel=b.sel)
+        return run_project
+    if isinstance(node, P.HashJoin):
+        leftf = compile_plan(node.left, params)
+        rightf = compile_plan(node.right, params)
+        jn = node
+
+        def run_join(rc):
+            lb = leftf(rc)
+            rb = rightf(rc)
+            return hash_join(lb, rb, jn.left_keys, jn.right_keys,
+                             jn.payload, jn.join_type)
+        return run_join
+    if isinstance(node, P.Aggregate):
+        return _compile_aggregate(node, params)
+    if isinstance(node, P.Sort):
+        return _compile_sort(node, params, meta)
+    if isinstance(node, P.Limit):
+        childf = compile_plan(node.child, params, meta)
+        lim, off = node.limit, node.offset
+
+        def run_limit(rc):
+            b = childf(rc)
+            rank = jnp.cumsum(b.sel.astype(jnp.int32)) - 1
+            keep = b.sel
+            if off:
+                keep = jnp.logical_and(keep, rank >= off)
+            if lim is not None:
+                keep = jnp.logical_and(keep, rank < off + lim)
+            return b.with_sel(keep)
+        return run_limit
+    raise ExecError(f"cannot compile plan node {node!r}")
+
+
+def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
+    alias = node.alias
+    colmap = dict(node.columns)  # batch name -> stored name
+    predf = compile_expr(node.filter) if node.filter is not None else None
+    computedf = [(n, compile_expr(e)) for n, e in node.computed]
+
+    def run_scan(rc: RunContext) -> ColumnBatch:
+        raw = rc.scans[alias]
+        # MVCC visibility: mvcc_ts <= read_ts < mvcc_del, fused with the
+        # scan (storage/columnstore.py docstring; the reference pays a
+        # per-KV decode here, pebble_mvcc_scanner.go:384)
+        ts = raw.col("_mvcc_ts")
+        dl = raw.col("_mvcc_del")
+        live = jnp.logical_and(ts <= rc.read_ts, rc.read_ts < dl)
+        cols, valid = {}, {}
+        for bname, sname in colmap.items():
+            cols[bname] = raw.col(sname)
+            valid[bname] = raw.col_valid(sname)
+        b = ColumnBatch.from_dict(cols, valid,
+                                  sel=jnp.logical_and(raw.sel, live))
+        if predf is not None:
+            pv = predf(_ctx_of(b))
+            b = b.and_sel(jnp.logical_and(pv[0], pv[1]))
+        for cname, cf in computedf:
+            d, v = cf(_ctx_of(b))
+            b = b.with_column(cname, d, v)
+        return b
+    return run_scan
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
+                  axis_name=None):
+    """Compute one aggregate's per-group arrays: (data, valid).
+
+    With axis_name set, partials merge across mesh shards with the
+    collective from AggSpec.merge_ops — the ICI replacement for the
+    reference's final-stage gRPC shuffle (SURVEY.md §A.4)."""
+    grouped = gid is not None
+
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name else x
+
+    def pmin(x):
+        return jax.lax.pmin(x, axis_name) if axis_name else x
+
+    def pmax(x):
+        return jax.lax.pmax(x, axis_name) if axis_name else x
+
+    if a.func == "count_rows":
+        mask = batch.sel
+        if grouped:
+            d = aggops.group_count(gid, mask, num_groups)
+        else:
+            d = aggops.masked_count(mask)[None]
+        d = psum(d)
+        return d, jnp.ones_like(d, dtype=jnp.bool_), None
+    d0, v0 = argf(ctx)
+    mask = jnp.logical_and(batch.sel, v0)
+    if a.func == "count":
+        if grouped:
+            d = aggops.group_count(gid, mask, num_groups)
+        else:
+            d = aggops.masked_count(mask)[None]
+        d = psum(d)
+        return d, jnp.ones_like(d, dtype=jnp.bool_), None
+
+    if grouped:
+        cnt = aggops.group_count(gid, mask, num_groups)
+    else:
+        cnt = aggops.masked_count(mask)[None]
+    cnt = psum(cnt)
+    nonempty = cnt > 0
+
+    if a.func in ("sum", "sum_int"):
+        acc = jnp.float64 if d0.dtype == jnp.float64 else jnp.int64
+        if grouped:
+            d = aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc)
+        else:
+            d = aggops.masked_sum(d0, mask, acc_dtype=acc)[None]
+        d = psum(d)
+        overflow = None
+        if acc == jnp.int64:
+            # int64 keeps decimal sums exact through the SF100 target,
+            # but a large-enough scan wraps silently — run a float64
+            # shadow sum and flag divergence (SURVEY.md §7 "Decimals":
+            # the overflow correctness gate)
+            if grouped:
+                shadow = aggops.group_sum(d0.astype(jnp.float64), gid, mask,
+                                          num_groups)
+            else:
+                shadow = aggops.masked_sum(d0.astype(jnp.float64), mask)[None]
+            shadow = psum(shadow)
+            err = jnp.abs(d.astype(jnp.float64) - shadow)
+            tol = jnp.maximum(jnp.abs(shadow) * 1e-3, 1e12)
+            overflow = jnp.any(err > tol)
+        return d, nonempty, overflow
+    if a.func == "avg":
+        scale = (10.0 ** a.arg.type.scale
+                 if a.arg.type.family == Family.DECIMAL else 1.0)
+        df = d0.astype(jnp.float64) / scale
+        if grouped:
+            s = aggops.group_sum(df, gid, mask, num_groups)
+        else:
+            s = aggops.masked_sum(df, mask)[None]
+        d = psum(s) / jnp.maximum(cnt, 1).astype(jnp.float64)
+        return d, nonempty, None
+    if a.func == "min":
+        if grouped:
+            d = aggops.group_min(d0, gid, mask, num_groups)
+        else:
+            d = aggops.masked_min(d0, mask)[None]
+        return pmin(d), nonempty, None
+    if a.func == "max":
+        if grouped:
+            d = aggops.group_max(d0, gid, mask, num_groups)
+        else:
+            d = aggops.masked_max(d0, mask)[None]
+        return pmax(d), nonempty, None
+    raise ExecError(f"aggregate {a.func} unsupported")
+
+
+def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
+    childf = compile_plan(node.child, params)
+    groupfs = [(name, compile_expr(e)) for name, e in node.group_by]
+    for a in node.aggs:
+        if a.distinct:
+            raise ExecError("DISTINCT aggregates not supported yet")
+    aggfs = [(a, compile_expr(a.arg) if a.arg is not None else None)
+             for a in node.aggs]
+    itemfs = [(name, compile_expr(e)) for name, e in node.items]
+    havingf = compile_expr(node.having) if node.having is not None else None
+    dense = node.max_groups > 0
+    dims = list(node.group_dims)
+    axis = params.axis_name
+    if axis and node.group_by and not dense:
+        # hash-strategy group ids are shard-local; the cross-shard merge
+        # (all_gather + re-group) is future work — engine falls back to
+        # single-device for these plans (exec/engine.py eligibility)
+        raise ExecError("hash-strategy GROUP BY cannot run distributed yet")
+
+    def run_agg(rc: RunContext) -> ColumnBatch:
+        b = childf(rc)
+        ctx = _ctx_of(b)
+        group_cols = {}  # name -> ([G] data, [G] valid)
+
+        if not groupfs:
+            gid, num_groups = None, 1
+        elif dense:
+            # mixed-radix dense code; code dim_i == NULL
+            gid = jnp.zeros((b.n,), dtype=jnp.int32)
+            num_groups = 1
+            gvals = []
+            for (name, gf), dim in zip(groupfs, dims):
+                d, v = gf(ctx)
+                code = jnp.where(v, d.astype(jnp.int32), dim)
+                gid = gid * (dim + 1) + code
+                num_groups *= dim + 1
+                gvals.append((name, dim))
+            # decode per-group key values from the group index itself
+            garange = jnp.arange(num_groups, dtype=jnp.int32)
+            rem = garange
+            strides = []
+            s = 1
+            for dim in reversed(dims):
+                strides.append(s)
+                s *= dim + 1
+            strides.reverse()
+            for ((name, gf), dim, st) in zip(groupfs, dims, strides):
+                code = (garange // st) % (dim + 1)
+                group_cols[name] = (code, code < dim)
+        else:
+            # hash strategy: key cols -> dense ids via the device table
+            keycols = []
+            for name, gf in groupfs:
+                d, v = gf(ctx)
+                kd = d
+                if kd.dtype == jnp.bool_:
+                    kd = kd.astype(jnp.int32)
+                elif jnp.issubdtype(kd.dtype, jnp.floating):
+                    kd = jax.lax.bitcast_convert_type(
+                        kd.astype(jnp.float64), jnp.int64)
+                # NULLs group together: zero data + validity as extra key
+                keycols.append(jnp.where(v, kd, jnp.zeros_like(kd)))
+                keycols.append(v.astype(jnp.int32))
+            cap = params.hash_group_capacity
+            gid, ng, rep = hashtable.group_ids(tuple(keycols), b.sel, cap)
+            num_groups = cap  # static bound; ng is the dynamic count
+            for name, gf in groupfs:
+                d, v = gf(ctx)
+                group_cols[name] = (d[rep], v[rep])
+
+        aggs_out = []
+        overflow = jnp.bool_(False)
+        for a, argf in aggfs:
+            d, v, ovf = _agg_partials(a, argf, b, ctx, gid, num_groups, axis)
+            aggs_out.append((d, v))
+            if ovf is not None:
+                overflow = jnp.logical_or(overflow, ovf)
+
+        # group liveness
+        if not groupfs:
+            live = jnp.ones((1,), dtype=jnp.bool_)
+        elif dense:
+            cnt = aggops.group_count(gid, b.sel, num_groups)
+            if axis:
+                cnt = jax.lax.psum(cnt, axis)
+            live = cnt > 0
+        else:
+            garange = jnp.arange(num_groups, dtype=jnp.int32)
+            live = garange < ng
+
+        out_ctx = ExprContext(group_cols, num_groups, aggs_out)
+        cols, valid = {}, {}
+        for name, f in itemfs:
+            d, v = f(out_ctx)
+            cols[name] = d
+            valid[name] = v
+        if havingf is not None:
+            hv, hm = havingf(out_ctx)
+            live = jnp.logical_and(live, jnp.logical_and(hv, hm))
+        out = ColumnBatch.from_dict(cols, valid, sel=live)
+        # error sentinels ride along as columns for the engine to check
+        out = out.with_column("__sum_overflow",
+                              jnp.broadcast_to(overflow, (num_groups,)))
+        if not groupfs or dense:
+            return out
+        return out.with_column("__ht_overflow",
+                               jnp.broadcast_to(ng < 0, (num_groups,)))
+    return run_agg
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def _compile_sort(node: P.Sort, params: ExecParams,
+                  meta: P.OutputMeta | None) -> CompiledNode:
+    childf = compile_plan(node.child, params, meta)
+    # string sort keys order by dictionary rank, not code
+    rank_tables = {}
+    if meta is not None:
+        for name, desc in node.keys:
+            d = meta.dictionaries.get(name)
+            if d is not None:
+                order = np.argsort(np.asarray(d.values, dtype=object).astype(str),
+                                   kind="stable")
+                rank = np.empty(len(order), dtype=np.int32)
+                rank[order] = np.arange(len(order), dtype=np.int32)
+                rank_tables[name] = rank
+    keys = list(node.keys)
+
+    def run_sort(rc: RunContext) -> ColumnBatch:
+        b = childf(rc)
+        sort_keys = []  # lexsort: LAST key is primary
+        for name, desc in reversed(keys):
+            d = b.col(name)
+            v = b.col_valid(name)
+            if name in rank_tables:
+                lut = jnp.asarray(rank_tables[name])
+                d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+            if desc:
+                d = -d.astype(jnp.float64) if jnp.issubdtype(
+                    d.dtype, jnp.floating) else -d.astype(jnp.int64)
+            # NULLS LAST for asc, NULLS FIRST for desc (PostgreSQL default)
+            nullkey = v if desc else jnp.logical_not(v)
+            sort_keys.append(d)
+            sort_keys.append(nullkey.astype(jnp.int8))
+        # dead rows always last
+        sort_keys.append(jnp.logical_not(b.sel).astype(jnp.int8))
+        perm = jnp.lexsort(tuple(sort_keys))
+        data = tuple(d[perm] for d in b.data)
+        valid = tuple(v[perm] for v in b.valid)
+        return ColumnBatch(data, valid, b.sel[perm], b.names)
+    return run_sort

@@ -1,0 +1,131 @@
+"""Aggregation kernels: masked reductions and grouped segment aggregates.
+
+The reference's hash aggregator (pkg/sql/colexec/hash_aggregator.go:67)
+builds a vectorized hash table of group keys and runs per-function
+kernels (colexecagg) against bucket-selected rows. On TPU the idiomatic
+formulation is *group codes + segment reduction*: map each row to a
+dense group id in [0, num_groups), then aggregate with
+``jax.ops.segment_sum``-style scatters, which XLA lowers to efficient
+sorted/atomic updates. For low-cardinality group-bys (TPC-H Q1: 4
+groups) this is a one-hot matmul-sized op; for general group-bys the
+group id comes from the device hash table in ops/hashtable.py.
+
+Distributed two-stage aggregation follows the reference's
+DistAggregationTable (pkg/sql/physicalplan/aggregator_funcs.go:22-91):
+every aggregate is decomposed into local-stage functions and a
+final-stage merge. Local stages run per-shard inside shard_map; the
+final merge is an ICI collective (psum / pmin / pmax) instead of the
+reference's gRPC shuffle — see parallel/distagg.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel "identity" values for min/max so dead rows never win.
+
+
+def _minident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.iinfo(dtype).max
+
+
+def _maxident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.iinfo(dtype).min
+
+
+# ---------------------------------------------------------------------------
+# ungrouped (scalar) aggregates — return (value, count) partials
+# ---------------------------------------------------------------------------
+
+def masked_sum(data, mask, acc_dtype=None):
+    """SUM over live rows. acc_dtype widens (decimal int64 -> float64 to
+    survive SF100 products; see ops/kernels.py docstring)."""
+    d = data.astype(acc_dtype) if acc_dtype is not None else data
+    return jnp.sum(jnp.where(mask, d, jnp.zeros_like(d)))
+
+
+def masked_count(mask):
+    return jnp.sum(mask.astype(jnp.int64))
+
+
+def masked_min(data, mask):
+    return jnp.min(jnp.where(mask, data, _minident(data.dtype)))
+
+
+def masked_max(data, mask):
+    return jnp.max(jnp.where(mask, data, _maxident(data.dtype)))
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregates over dense group ids
+# ---------------------------------------------------------------------------
+
+def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None):
+    d = data.astype(acc_dtype) if acc_dtype is not None else data
+    d = jnp.where(mask, d, jnp.zeros_like(d))
+    # Dead rows scatter to group 0 with value 0 — harmless.
+    gid = jnp.where(mask, group_ids, 0)
+    return jax.ops.segment_sum(d, gid, num_segments=num_groups)
+
+
+def group_count(group_ids, mask, num_groups: int):
+    return jax.ops.segment_sum(mask.astype(jnp.int64),
+                               jnp.where(mask, group_ids, 0),
+                               num_segments=num_groups)
+
+
+def group_min(data, group_ids, mask, num_groups: int):
+    d = jnp.where(mask, data, _minident(data.dtype))
+    gid = jnp.where(mask, group_ids, 0)
+    return jax.ops.segment_min(d, gid, num_segments=num_groups)
+
+
+def group_max(data, group_ids, mask, num_groups: int):
+    d = jnp.where(mask, data, _maxident(data.dtype))
+    gid = jnp.where(mask, group_ids, 0)
+    return jax.ops.segment_max(d, gid, num_segments=num_groups)
+
+
+# ---------------------------------------------------------------------------
+# aggregate spec machinery (mirrors AggregatorSpec_Func,
+# execinfrapb/processors_sql.proto:798, and the local/final decomposition)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: func in {sum,count,count_rows,min,max,avg,sum_int},
+    over input column `col` (None for count_rows), output name `name`."""
+    func: str
+    col: Optional[str]
+    name: str
+    distinct: bool = False
+
+    @property
+    def local_funcs(self) -> list[str]:
+        # DistAggregationTable analogue: how to split into local partials.
+        if self.func == "avg":
+            return ["sum", "count"]
+        if self.func in ("count", "count_rows"):
+            return ["count"]
+        return [self.func]
+
+    @property
+    def merge_ops(self) -> list[str]:
+        """Collective used to merge partials across shards."""
+        if self.func == "avg":
+            return ["psum", "psum"]
+        if self.func in ("count", "count_rows", "sum", "sum_int"):
+            return ["psum"]
+        if self.func == "min":
+            return ["pmin"]
+        if self.func == "max":
+            return ["pmax"]
+        raise ValueError(self.func)

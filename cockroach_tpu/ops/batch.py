@@ -1,0 +1,173 @@
+"""Device-resident columnar batch: the analogue of ``coldata.Batch``.
+
+The reference's batch (pkg/col/coldata/batch.go:30) is a set of typed
+column vectors plus an optional *selection vector* of live row indices
+(batch.go:53-55): filters produce selection vectors instead of
+compacting. On TPU, gathered index vectors create dynamic shapes, so we
+use the mask formulation (SURVEY.md §7 "Dynamic shapes"): every batch
+carries a boolean ``sel`` mask of live rows, and every column carries a
+boolean validity mask (NULL handling, coldata/nulls.go). All arrays have
+the same static leading dimension ``n`` — XLA sees only static shapes.
+
+A ColumnBatch is a pytree, so it passes through jit/shard_map/scan
+untouched. Column order is the tuple ``names`` (static / hashable).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ColumnBatch:
+    """A fixed-length slab of columns + selection mask.
+
+    data:  tuple of arrays, each shape (n,) (or (n, k) for arena bytes)
+    valid: tuple of bool arrays shape (n,), True = non-NULL
+    sel:   bool array shape (n,), True = row is live
+    names: tuple of column names (aux data, static under jit)
+    """
+
+    data: tuple
+    valid: tuple
+    sel: jnp.ndarray
+    names: tuple
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.valid, self.sel), self.names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        data, valid, sel = children
+        return cls(data=data, valid=valid, sel=sel, names=names)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_dict(cols: Mapping[str, jnp.ndarray],
+                  valid: Mapping[str, jnp.ndarray] | None = None,
+                  sel: jnp.ndarray | None = None) -> "ColumnBatch":
+        names = tuple(cols.keys())
+        data = tuple(jnp.asarray(cols[n]) for n in names)
+        if not data:
+            raise ValueError("ColumnBatch needs at least one column")
+        n = data[0].shape[0]
+        if valid is None:
+            valid = {}
+        vmasks = tuple(
+            jnp.asarray(valid[c], dtype=jnp.bool_) if c in valid
+            else jnp.ones((n,), dtype=jnp.bool_)
+            for c in names)
+        if sel is None:
+            sel = jnp.ones((n,), dtype=jnp.bool_)
+        return ColumnBatch(data=data, valid=vmasks, sel=sel, names=names)
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def n(self) -> int:
+        return self.data[0].shape[0]
+
+    def index(self, name: str) -> int:
+        try:
+            return self.names.index(name)
+        except ValueError:
+            raise KeyError(f"column {name!r} not in batch {self.names}") from None
+
+    def col(self, name: str) -> jnp.ndarray:
+        return self.data[self.index(name)]
+
+    def col_valid(self, name: str) -> jnp.ndarray:
+        return self.valid[self.index(name)]
+
+    def has(self, name: str) -> bool:
+        return name in self.names
+
+    # -- functional updates ------------------------------------------------
+    def with_sel(self, sel: jnp.ndarray) -> "ColumnBatch":
+        return ColumnBatch(self.data, self.valid, sel, self.names)
+
+    def and_sel(self, mask: jnp.ndarray) -> "ColumnBatch":
+        """Apply a filter: narrow the selection (the reference's filter ops
+        produce selection vectors the same way, colexecsel)."""
+        return self.with_sel(jnp.logical_and(self.sel, mask))
+
+    def with_column(self, name: str, data: jnp.ndarray,
+                    valid: jnp.ndarray | None = None) -> "ColumnBatch":
+        """Add or replace a column (projection output)."""
+        if valid is None:
+            valid = jnp.ones((self.n,), dtype=jnp.bool_)
+        if name in self.names:
+            i = self.index(name)
+            datas = list(self.data)
+            valids = list(self.valid)
+            datas[i] = data
+            valids[i] = valid
+            return ColumnBatch(tuple(datas), tuple(valids), self.sel, self.names)
+        return ColumnBatch(self.data + (data,), self.valid + (valid,),
+                           self.sel, self.names + (name,))
+
+    def project(self, names: Iterable[str]) -> "ColumnBatch":
+        names = tuple(names)
+        idx = [self.index(n) for n in names]
+        return ColumnBatch(tuple(self.data[i] for i in idx),
+                           tuple(self.valid[i] for i in idx),
+                           self.sel, names)
+
+    def rename(self, mapping: Mapping[str, str]) -> "ColumnBatch":
+        names = tuple(mapping.get(n, n) for n in self.names)
+        return ColumnBatch(self.data, self.valid, self.sel, names)
+
+    # -- host conversion ---------------------------------------------------
+    def to_host(self) -> dict[str, np.ndarray]:
+        """Compact live rows to host numpy (gateway/result edge only)."""
+        sel = np.asarray(self.sel)
+        out = {}
+        for name, d, v in zip(self.names, self.data, self.valid):
+            dn = np.asarray(d)[sel]
+            vn = np.asarray(v)[sel]
+            out[name] = np.ma.masked_array(dn, mask=~vn)
+        return out
+
+    def __repr__(self) -> str:
+        return f"ColumnBatch(n={self.n}, cols={list(self.names)})"
+
+
+def concat(batches: list[ColumnBatch]) -> ColumnBatch:
+    """Concatenate batches with identical schemas along rows."""
+    first = batches[0]
+    data = tuple(jnp.concatenate([b.data[i] for b in batches])
+                 for i in range(len(first.names)))
+    valid = tuple(jnp.concatenate([b.valid[i] for b in batches])
+                  for i in range(len(first.names)))
+    sel = jnp.concatenate([b.sel for b in batches])
+    return ColumnBatch(data, valid, sel, first.names)
+
+
+def pad_to(batch: ColumnBatch, n: int) -> ColumnBatch:
+    """Pad a batch to a static length with dead rows (sel=False).
+
+    The distribution layer pads every shard to the same static length so
+    one SPMD program covers all shards (ranges are never exactly equal;
+    the reference handles ragged spans with per-node dynamic batching,
+    we handle them with masked padding)."""
+    cur = batch.n
+    if cur == n:
+        return batch
+    if cur > n:
+        raise ValueError(f"batch of {cur} rows cannot pad to {n}")
+    pad = n - cur
+
+    def padarr(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    data = tuple(padarr(d) for d in batch.data)
+    valid = tuple(padarr(v) for v in batch.valid)
+    sel = padarr(batch.sel)
+    return ColumnBatch(data, valid, sel, batch.names)

@@ -1,0 +1,186 @@
+"""Device hash table: vectorized open-addressing build + probe.
+
+The reference's vectorized hash table (pkg/sql/colexec/colexechash/
+hashtable.go:220) computes hashes for a whole batch, gathers chain
+heads, vector-compares keys, and repairs collisions iteratively. The
+TPU formulation below keeps that shape — *batched probing with an
+iterate-until-resolved loop* — but uses open addressing with linear
+probing so all state is flat arrays (no pointer chains):
+
+  - ``claim``: int32[capacity+1]; claim[s] = row id that owns slot s,
+    or N (empty). Slot `capacity` is a trash slot for masked scatters.
+  - build: every live row proposes itself for its hash slot; an
+    ``at[].min`` scatter arbitrates; losers with a different key probe
+    to the next slot; rows that find their own key stop (duplicate).
+    Terminates because every iteration permanently fills at least one
+    slot per colliding chain; capacity >= 2N keeps probe chains short.
+  - keys are tuples of int columns; equality compares all columns via
+    gathers at the owning row (the table stores only row ids, never
+    keys, so multi-column and wide keys cost nothing extra).
+
+Used by: general GROUP BY (dense group ids via cumsum over occupied
+slots), hash join build/probe (ops/join.py), DISTINCT.
+
+All shapes are static; the while_loop is a ``lax.while_loop`` so XLA
+compiles one program regardless of data (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _hash_columns(key_cols: tuple, capacity: int) -> jnp.ndarray:
+    """Fibonacci-style multiplicative hash of one or more int columns,
+    mixed like colexechash's per-column rehashing (hash.go)."""
+    h = jnp.zeros(key_cols[0].shape, dtype=jnp.uint32)
+    for c in key_cols:
+        c64 = c.astype(jnp.int64)
+        lo = (c64 & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = ((c64 >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+        h = (h ^ lo) * jnp.uint32(2654435761)
+        h = (h ^ hi) * jnp.uint32(2246822519)
+        h = h ^ (h >> 15)
+    return (h & jnp.uint32(capacity - 1)).astype(jnp.int32)
+
+
+@dataclass(frozen=True)
+class HashTable:
+    """Built table: claim[s] = owning row id (N = empty)."""
+    claim: jnp.ndarray  # int32[capacity+1]
+    key_cols: tuple     # build-side key columns, for probe comparisons
+    n_build: int
+    capacity: int
+
+
+def _keys_equal(key_cols: tuple, rows_a: jnp.ndarray, rows_b: jnp.ndarray):
+    eq = jnp.ones(rows_a.shape, dtype=jnp.bool_)
+    for c in key_cols:
+        eq = jnp.logical_and(eq, c[rows_a] == c[rows_b])
+    return eq
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def build(key_cols: tuple, mask: jnp.ndarray, capacity: int):
+    """Insert all live rows; returns (claim, slot_of_row, converged).
+
+    slot_of_row[i] = the slot whose owner has row i's key (the owner may
+    be an earlier duplicate). capacity should be a power of two >= 2N;
+    if the distinct-key count exceeds capacity the loop hits its
+    iteration bound and `converged` comes back False (the analogue of
+    the reference's memory-budget spill trigger, colexecdisk — we
+    surface an error instead of spilling for now).
+    """
+    n = key_cols[0].shape[0]
+    assert capacity & (capacity - 1) == 0
+    rowid = jnp.arange(n, dtype=jnp.int32)
+    slot0 = _hash_columns(key_cols, capacity)
+    claim0 = jnp.full((capacity + 1,), n, dtype=jnp.int32)
+
+    def cond(state):
+        _, _, done, it = state
+        return jnp.logical_and(jnp.logical_not(jnp.all(done)),
+                               it < capacity + 2)
+
+    def body(state):
+        claim, slot, done, it = state
+        active = jnp.logical_not(done)
+        empty = claim[slot] == n
+        attempt = jnp.logical_and(active, empty)
+        tgt = jnp.where(attempt, slot, capacity)
+        claim = claim.at[tgt].min(rowid)
+        owner = claim[slot]
+        occupied = owner < n
+        key_eq = _keys_equal(key_cols, jnp.minimum(owner, n - 1), rowid)
+        found = jnp.logical_and(active, jnp.logical_and(occupied, key_eq))
+        done = jnp.logical_or(done, found)
+        # probe on: occupied by a different key
+        advance = jnp.logical_and(active, jnp.logical_and(occupied,
+                                                          jnp.logical_not(key_eq)))
+        slot = jnp.where(advance, (slot + 1) & (capacity - 1), slot)
+        return claim, slot, done, it + 1
+
+    if n == 0:
+        return claim0, slot0, jnp.bool_(True)
+    claim, slot, done, _ = jax.lax.while_loop(
+        cond, body, (claim0, slot0, jnp.logical_not(mask), jnp.int32(0)))
+    return claim, slot, jnp.all(done)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def group_ids(key_cols: tuple, mask: jnp.ndarray, capacity: int):
+    """Dense group ids for GROUP BY: (gid[int32 per row], num_groups[scalar],
+    rep_row[int32 per slot-compacted group bound capacity]).
+
+    gid is dense in [0, num_groups); dead rows get 0. rep_row[g] = a
+    representative row id for group g (to gather group-key output
+    columns), valid for g < num_groups. num_groups is -1 if the table
+    overflowed (more distinct keys than capacity) — callers must check.
+    """
+    n = key_cols[0].shape[0]
+    claim, slot, converged = build(key_cols, mask, capacity)
+    occupied = claim[:capacity] < n
+    dense = jnp.cumsum(occupied.astype(jnp.int32)) - 1  # id per slot
+    gid = jnp.where(mask, dense[slot], 0).astype(jnp.int32)
+    num_groups = jnp.where(converged, jnp.sum(occupied.astype(jnp.int32)),
+                           jnp.int32(-1))
+    # rep_row: scatter owner row into its dense id
+    tgt = jnp.where(occupied, dense, capacity)
+    rep = jnp.full((capacity + 1,), 0, dtype=jnp.int32)
+    rep = rep.at[tgt].set(jnp.minimum(claim[:capacity], n - 1))
+    return gid, num_groups, rep[:capacity]
+
+
+@partial(jax.jit, static_argnames=("capacity", "n_build"))
+def probe(table_claim: jnp.ndarray, build_keys: tuple, probe_keys: tuple,
+          probe_mask: jnp.ndarray, capacity: int, n_build: int):
+    """Probe: for each probe row, find the build slot owning its key.
+
+    Returns (matched[bool], build_row[int32]) — build_row is the row id
+    of the *first* build row with that key (exact for unique build keys,
+    i.e. PK-FK joins; multi-match joins expand via ops/join.py).
+    ``n_build`` is the build side's row count (the empty sentinel).
+    """
+    n = probe_keys[0].shape[0]
+    slot0 = _hash_columns(probe_keys, capacity)
+    empty_val = jnp.int32(n_build)
+
+    def keys_eq(build_rows, probe_rows):
+        eq = jnp.ones(probe_rows.shape, dtype=jnp.bool_)
+        for bc, pc in zip(build_keys, probe_keys):
+            eq = jnp.logical_and(eq, bc[build_rows] == pc[probe_rows])
+        return eq
+
+    rowid = jnp.arange(n, dtype=jnp.int32)
+
+    def cond2(state):
+        _, done, _, _ = state
+        return jnp.logical_not(jnp.all(done))
+
+    def body2(state):
+        slot, done, matched, build_row = state
+        active = jnp.logical_not(done)
+        owner = table_claim[slot]
+        occupied = owner < empty_val
+        safe_owner = jnp.minimum(owner, empty_val - 1)
+        key_eq = keys_eq(safe_owner, rowid)
+        hit = jnp.logical_and(active, jnp.logical_and(occupied, key_eq))
+        miss_empty = jnp.logical_and(active, jnp.logical_not(occupied))
+        matched = jnp.logical_or(matched, hit)
+        build_row = jnp.where(hit, safe_owner, build_row)
+        done = jnp.logical_or(done, jnp.logical_or(hit, miss_empty))
+        advance = jnp.logical_and(active, jnp.logical_and(occupied,
+                                                          jnp.logical_not(key_eq)))
+        slot = jnp.where(advance, (slot + 1) & (capacity - 1), slot)
+        return slot, done, matched, build_row
+
+    init = (slot0, jnp.logical_not(probe_mask),
+            jnp.zeros((n,), dtype=jnp.bool_), jnp.zeros((n,), dtype=jnp.int32))
+    if n == 0:
+        return init[2], init[3]
+    _, _, matched, build_row = jax.lax.while_loop(cond2, body2, init)
+    return matched, build_row

@@ -1,0 +1,116 @@
+"""Distributed query execution over the device mesh.
+
+The TPU answer to DistSQL physical planning (SURVEY.md §2.2, §A.6):
+
+  reference                               this module
+  ---------                               -----------
+  PartitionSpans assigns key spans        table rows shard over the
+  to nodes by leaseholder                 mesh's `shards` axis
+  per-node TableReader + partial agg      the same compiled plan runs
+  processors (SetupFlow gRPC)             as ONE SPMD program/shard_map
+  final-stage merge at the gateway        jax.lax.psum/pmin/pmax over
+  (Outbox/Inbox streams, HashRouter)      ICI inside the program
+  lookup-join data movement               broadcast (replicated) build
+                                          side — dimension tables are
+                                          small; no shuffle needed
+
+Eligibility (round 1): the plan root chain must be
+Limit?/Sort?/Aggregate where the Aggregate is ungrouped or uses the
+dense segment-sum strategy; every HashJoin build subtree is scan-only
+(replicated). Everything else falls back to single-device execution.
+After the collectives, all outputs are replicated, so Sort/Limit/
+HAVING above the Aggregate run identically on every shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+from jax import shard_map
+
+from ..sql import plan as P
+from . import mesh as meshmod
+
+
+@dataclass
+class DistDecision:
+    ok: bool
+    sharded: set  # aliases row-sharded over the mesh
+    replicated: set  # aliases replicated (join build sides)
+    reason: str = ""
+
+
+def analyze(node: P.PlanNode) -> DistDecision:
+    """Decide if the plan can run as one SPMD program (see module doc)."""
+    sharded: set = set()
+    replicated: set = set()
+
+    def scan_only(n) -> bool:
+        if isinstance(n, P.Scan):
+            replicated.add(n.alias)
+            return True
+        if isinstance(n, P.Filter):
+            return scan_only(n.child)
+        return False
+
+    def probe_chain(n) -> bool:
+        """The probe spine: Scan/Filter/Project/HashJoin(with scan-only
+        build)."""
+        if isinstance(n, P.Scan):
+            sharded.add(n.alias)
+            return True
+        if isinstance(n, (P.Filter, P.Project)):
+            return probe_chain(n.child)
+        if isinstance(n, P.HashJoin):
+            if n.join_type not in ("inner", "left", "semi", "anti"):
+                return False
+            return probe_chain(n.left) and scan_only(n.right)
+        return False
+
+    n = node
+    if isinstance(n, P.Limit):
+        n = n.child
+    if isinstance(n, P.Sort):
+        n = n.child
+    if not isinstance(n, P.Aggregate):
+        return DistDecision(False, set(), set(), "root is not an aggregate")
+    if n.group_by and n.max_groups <= 0:
+        return DistDecision(False, set(), set(),
+                            "hash-strategy GROUP BY (shard-local ids)")
+    for a in n.aggs:
+        if a.distinct:
+            return DistDecision(False, set(), set(), "DISTINCT aggregate")
+    if not probe_chain(n.child):
+        return DistDecision(False, set(), set(), "unsupported probe chain")
+    return DistDecision(True, sharded, replicated)
+
+
+def make_distributed_fn(runf, mesh, scan_aliases: dict, decision: DistDecision):
+    """Wrap a compiled plan function in shard_map over `mesh`.
+
+    runf: RunContext -> ColumnBatch (compiled with axis_name set)
+    scan_aliases: alias -> table (the RunContext scans keys)
+    Returns fn(scans, read_ts) -> ColumnBatch with replicated outputs.
+    """
+    from ..exec.compile import RunContext
+
+    shard_leaf = meshmod.shard_spec()
+    repl_leaf = meshmod.replicated_spec()
+
+    def one(alias):
+        return shard_leaf if alias in decision.sharded else repl_leaf
+
+    def fn(scans, read_ts):
+        return runf(RunContext(scans, read_ts))
+
+    # pytree of specs matching (scans dict, read_ts)
+    def spec_for_scans(scans):
+        return {alias: jax.tree.map(lambda _: one(alias), b)
+                for alias, b in scans.items()}
+
+    def wrapped(scans, read_ts):
+        in_specs = (spec_for_scans(scans), repl_leaf)
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=repl_leaf, check_vma=False)(scans, read_ts)
+    return wrapped

@@ -1,0 +1,47 @@
+"""Device mesh management: the cluster topology of the TPU engine.
+
+The reference partitions work by range leaseholder across nodes
+(PartitionSpans, pkg/sql/distsql_physical_planner.go:1096) and moves
+data over gRPC streams. Here the "nodes" of a co-scheduled flow are
+mesh devices: scan spans shard across the `shards` axis, partial
+aggregates merge over ICI collectives inside shard_map
+(parallel/distagg.py), and only host<->host edges fall back to the
+wire (server/, round 2+).
+
+One axis suffices for the DistSQL-style data parallelism; joins use
+broadcast (replicated build side). Multi-axis meshes (e.g. separate
+axes for scan-parallel x partition-parallel shuffles) layer on later.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(devices=None, n: Optional[int] = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.asarray(devs), (SHARD_AXIS,))
+
+
+def shard_spec() -> PartitionSpec:
+    return PartitionSpec(SHARD_AXIS)
+
+
+def replicated_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,221 @@
+"""AST nodes (the analogue of pkg/sql/sem/tree)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .types import SQLType
+
+
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: object  # python int/float/str/bool/None
+    type_hint: Optional[SQLType] = None
+
+    def __repr__(self):
+        return f"Lit({self.value!r})"
+
+
+@dataclass
+class ColumnRef(Expr):
+    name: str
+    table: Optional[str] = None  # qualifier
+
+    def __repr__(self):
+        return f"Col({self.table + '.' if self.table else ''}{self.name})"
+
+
+@dataclass
+class BinOp(Expr):
+    op: str  # + - * / % = != < <= > >= and or || like
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # - not
+    operand: Expr
+
+
+@dataclass
+class Between(Expr):
+    expr: Expr
+    lo: Expr
+    hi: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    expr: Expr
+    items: list[Expr]
+    negated: bool = False
+
+
+@dataclass
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+@dataclass
+class Case(Expr):
+    whens: list[tuple[Expr, Expr]]
+    else_: Optional[Expr] = None
+
+
+@dataclass
+class Cast(Expr):
+    expr: Expr
+    to: SQLType
+
+
+@dataclass
+class FuncCall(Expr):
+    name: str  # lowercased
+    args: list[Expr]
+    star: bool = False  # count(*)
+    distinct: bool = False
+
+
+@dataclass
+class Extract(Expr):
+    part: str  # year/month/day...
+    expr: Expr
+
+
+@dataclass
+class Substring(Expr):
+    expr: Expr
+    start: Expr
+    length: Optional[Expr] = None
+
+
+# ---------------------------------------------------------------------------
+# statements
+# ---------------------------------------------------------------------------
+
+class Statement:
+    pass
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinClause:
+    table: TableRef
+    join_type: str  # inner/left/right/semi/anti/cross
+    on: Optional[Expr] = None
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+    star: bool = False
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem] = field(default_factory=list)
+    table: Optional[TableRef] = None
+    joins: list[JoinClause] = field(default_factory=list)
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type: SQLType
+    nullable: bool = True
+    primary: bool = False
+
+
+@dataclass
+class CreateTable(Statement):
+    name: str
+    columns: list[ColumnDef]
+    primary_key: list[str]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert(Statement):
+    table: str
+    columns: list[str]  # empty = all
+    rows: list[list[Expr]] = field(default_factory=list)
+    select: Optional[Select] = None
+
+
+@dataclass
+class Update(Statement):
+    table: str
+    assignments: list[tuple[str, Expr]] = field(default_factory=list)
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass
+class SetVar(Statement):
+    name: str
+    value: object
+    cluster: bool = False  # SET CLUSTER SETTING
+
+
+@dataclass
+class ShowVar(Statement):
+    name: str
+
+
+@dataclass
+class Explain(Statement):
+    stmt: Statement
+    analyze: bool = False
+
+
+@dataclass
+class BeginTxn(Statement):
+    pass
+
+
+@dataclass
+class CommitTxn(Statement):
+    pass
+
+
+@dataclass
+class RollbackTxn(Statement):
+    pass

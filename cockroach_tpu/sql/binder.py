@@ -1,0 +1,653 @@
+"""Semantic analysis: resolve names, assign types, lower to physical.
+
+The binder (the analogue of optbuilder + sem/eval's type checking,
+pkg/sql/opt/optbuilder/builder.go:184) turns parser AST into the bound
+tree of bound.py. All host-only computation happens here so the
+executor sees pure device-expressible operations:
+
+- decimal literals/arithmetic are lowered to scaled-int64 ops with
+  explicit rescales (scales tracked in SQLType);
+- date/timestamp/interval literals are parsed and constant arithmetic
+  on them is folded (calendar math never reaches the device);
+- predicates over dictionary-encoded string columns become integer
+  code comparisons, or code-set lookups for LIKE/ordered compares
+  (BDictLookup: a precomputed bool table indexed by code — the binder
+  evaluates the predicate against the dictionary once, so a LIKE over
+  600M rows costs one gather on device).
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from . import ast
+from .bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce, BCol,
+                    BConst, BDictLookup, BDictRemap, BExpr, BExtract, BInList,
+                    BIsNull, BoundAgg, BUnary)
+from .types import (BOOL, DATE, FLOAT8, INT8, INTERVAL, STRING, TIMESTAMP,
+                    Family, SQLType, common_numeric_type)
+
+AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+class BindError(Exception):
+    pass
+
+
+@dataclass
+class ColumnBinding:
+    batch_name: str
+    type: SQLType
+    dictionary: Optional[object] = None  # storage.columnstore.Dictionary
+
+
+@dataclass
+class Scope:
+    """In-scope tables: alias -> {col -> ColumnBinding}."""
+    tables: dict[str, dict[str, ColumnBinding]] = field(default_factory=dict)
+
+    def add_table(self, alias: str, cols: dict[str, ColumnBinding]):
+        if alias in self.tables:
+            raise BindError(f"duplicate table alias {alias!r}")
+        self.tables[alias] = cols
+
+    def resolve(self, name: str, qualifier: Optional[str]) -> ColumnBinding:
+        if qualifier is not None:
+            t = self.tables.get(qualifier)
+            if t is None:
+                raise BindError(f"unknown table {qualifier!r}")
+            b = t.get(name)
+            if b is None:
+                raise BindError(f"column {name!r} not in {qualifier!r}")
+            return b
+        hits = [t[name] for t in self.tables.values() if name in t]
+        if not hits:
+            raise BindError(f"unknown column {name!r}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {name!r}")
+        return hits[0]
+
+    def all_columns(self) -> list[ColumnBinding]:
+        out = []
+        for t in self.tables.values():
+            out.extend(t.values())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# literal parsing
+# ---------------------------------------------------------------------------
+
+def parse_date(s: str) -> int:
+    d = datetime.date.fromisoformat(s.strip())
+    return (d - EPOCH).days
+
+
+def parse_timestamp(s: str) -> int:
+    s = s.strip()
+    try:
+        dt = datetime.datetime.fromisoformat(s)
+    except ValueError as e:
+        raise BindError(f"bad timestamp {s!r}") from e
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+    return int((dt - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+
+
+_INTERVAL_RE = re.compile(
+    r"\s*(-?\d+)\s*(year|years|month|months|mon|mons|day|days|hour|hours|"
+    r"minute|minutes|min|mins|second|seconds|sec|secs)\s*", re.I)
+
+
+@dataclass
+class Interval:
+    months: int = 0
+    days: int = 0
+    micros: int = 0
+
+
+def parse_interval(s: str) -> Interval:
+    iv = Interval()
+    pos = 0
+    matched = False
+    for m in _INTERVAL_RE.finditer(s):
+        if m.start() != pos:
+            break
+        pos = m.end()
+        matched = True
+        qty = int(m.group(1))
+        unit = m.group(2).lower()
+        if unit.startswith("year"):
+            iv.months += 12 * qty
+        elif unit.startswith("mon"):
+            iv.months += qty
+        elif unit.startswith("day"):
+            iv.days += qty
+        elif unit.startswith("hour"):
+            iv.micros += qty * 3_600_000_000
+        elif unit.startswith("min"):
+            iv.micros += qty * 60_000_000
+        else:
+            iv.micros += qty * 1_000_000
+    if not matched or pos != len(s.rstrip()):
+        raise BindError(f"bad interval {s!r}")
+    return iv
+
+
+def add_interval_to_date(days: int, iv: Interval, sign: int = 1) -> int:
+    d = EPOCH + datetime.timedelta(days=days)
+    if iv.months:
+        total = d.year * 12 + (d.month - 1) + sign * iv.months
+        y, m = divmod(total, 12)
+        last = [31, 29 if _leap(y) else 28, 31, 30, 31, 30,
+                31, 31, 30, 31, 30, 31][m]
+        d = d.replace(year=y, month=m + 1, day=min(d.day, last))
+    d += datetime.timedelta(days=sign * iv.days)
+    return (d - EPOCH).days
+
+
+def _leap(y: int) -> bool:
+    return y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)
+
+
+# ---------------------------------------------------------------------------
+# binder
+# ---------------------------------------------------------------------------
+
+class Binder:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+        # populated by bind_with_aggs
+        self.aggs: list[BoundAgg] = []
+        self._collect_aggs = False
+
+    # -- main dispatch -------------------------------------------------------
+    def bind(self, e: ast.Expr) -> BExpr:
+        if isinstance(e, ast.Literal):
+            return self.bind_literal(e)
+        if isinstance(e, ast.ColumnRef):
+            b = self.scope.resolve(e.name, e.table)
+            return BCol(b.batch_name, b.type)
+        if isinstance(e, ast.BinOp):
+            return self.bind_binop(e)
+        if isinstance(e, ast.UnaryOp):
+            o = self.bind(e.operand)
+            if e.op == "not":
+                if o.type.family != Family.BOOL:
+                    raise BindError("NOT requires boolean")
+                return BUnary("not", o, BOOL)
+            if isinstance(o, BConst) and o.value is not None:
+                return BConst(-o.value, o.type)
+            return BUnary("-", o, o.type)
+        if isinstance(e, ast.Between):
+            x = self.bind(e.expr)
+            lo = self.coerce(self.bind(e.lo), x.type)
+            hi = self.coerce(self.bind(e.hi), x.type)
+            x, lo, hi = self._align3(x, lo, hi)
+            return BBetween(x, lo, hi, e.negated, BOOL)
+        if isinstance(e, ast.InList):
+            return self.bind_in(e)
+        if isinstance(e, ast.IsNull):
+            return BIsNull(self.bind(e.expr), e.negated, BOOL)
+        if isinstance(e, ast.Case):
+            return self.bind_case(e)
+        if isinstance(e, ast.Cast):
+            return self.bind_cast(self.bind(e.expr), e.to)
+        if isinstance(e, ast.FuncCall):
+            return self.bind_func(e)
+        if isinstance(e, ast.Extract):
+            x = self.bind(e.expr)
+            if x.type.family not in (Family.DATE, Family.TIMESTAMP):
+                raise BindError("EXTRACT needs date/timestamp")
+            return BExtract(e.part.lower(), x, INT8)
+        if isinstance(e, ast.Substring):
+            raise BindError("SUBSTRING on device not supported yet")
+        raise BindError(f"cannot bind {e!r}")
+
+    def bind_literal(self, e: ast.Literal) -> BExpr:
+        v, th = e.value, e.type_hint
+        if v is None:
+            return BConst(None, SQLType.unknown())
+        if th is not None and th.family == Family.DATE:
+            return BConst(parse_date(v), DATE)
+        if th is not None and th.family == Family.TIMESTAMP:
+            return BConst(parse_timestamp(v), TIMESTAMP)
+        if th is not None and th.family == Family.INTERVAL:
+            iv = parse_interval(v)
+            c = BConst(iv, INTERVAL)
+            return c
+        if isinstance(v, bool):
+            return BConst(v, BOOL)
+        if isinstance(v, int):
+            return BConst(v, INT8)
+        if isinstance(v, str) and th is None:
+            # number-looking strings come from decimal literals
+            if re.fullmatch(r"-?\d*\.\d+([eE][-+]?\d+)?|-?\d+[eE][-+]?\d+", v):
+                scale = len(v.split(".")[1].split("e")[0].split("E")[0]) \
+                    if "." in v else 0
+                if "e" in v.lower():
+                    return BConst(float(v), FLOAT8)
+                return BConst(int(round(float(v) * 10 ** scale)),
+                              SQLType.decimal(scale=scale))
+            return BConst(v, STRING)
+        if isinstance(v, float):
+            return BConst(v, FLOAT8)
+        raise BindError(f"cannot type literal {v!r}")
+
+    # -- coercion ------------------------------------------------------------
+    def coerce(self, e: BExpr, target: SQLType) -> BExpr:
+        """Coerce e toward target's family (constants fold)."""
+        t = e.type
+        if t.family == target.family:
+            if t.family == Family.DECIMAL and t.scale != target.scale:
+                return self._rescale_decimal(e, target.scale)
+            return e
+        if t.family == Family.UNKNOWN:
+            e.type = target
+            return e
+        if isinstance(e, BConst):
+            return self._const_to(e, target)
+        if t.family == Family.INT and target.family == Family.DECIMAL:
+            return BBin("*", e, BConst(10 ** target.scale, INT8), target)
+        if t.family == Family.INT and target.family == Family.FLOAT:
+            return BCast(e, FLOAT8)
+        if t.family == Family.DECIMAL and target.family == Family.FLOAT:
+            return BCast(e, FLOAT8)
+        if t.family == Family.STRING and target.family == Family.DATE \
+                and isinstance(e, BConst):
+            return BConst(parse_date(e.value), DATE)
+        raise BindError(f"cannot coerce {t} to {target}")
+
+    def _const_to(self, e: BConst, target: SQLType) -> BConst:
+        v = e.value
+        f = target.family
+        if v is None:
+            return BConst(None, target)
+        if f == Family.DECIMAL:
+            if e.type.family == Family.DECIMAL:
+                return self._rescale_decimal(e, target.scale)
+            return BConst(int(round(float(v) * 10 ** target.scale)), target)
+        if f == Family.FLOAT:
+            if e.type.family == Family.DECIMAL:
+                return BConst(float(v) / 10 ** e.type.scale, FLOAT8)
+            return BConst(float(v), FLOAT8)
+        if f == Family.INT:
+            if e.type.family == Family.DECIMAL:
+                # v is the scaled physical value; cast rounds the logical
+                # value half-away-from-zero (SQL semantics)
+                logical = v / 10 ** e.type.scale
+                return BConst(int(logical + (0.5 if logical >= 0 else -0.5)),
+                              target)
+            return BConst(int(v), target)
+        if f == Family.DATE and isinstance(v, str):
+            return BConst(parse_date(v), DATE)
+        if f == Family.TIMESTAMP and isinstance(v, str):
+            return BConst(parse_timestamp(v), TIMESTAMP)
+        if f == Family.STRING and isinstance(v, str):
+            return BConst(v, STRING)
+        raise BindError(f"cannot convert constant {v!r} to {target}")
+
+    def _rescale_decimal(self, e: BExpr, scale: int) -> BExpr:
+        cur = e.type.scale
+        if cur == scale:
+            return e
+        ty = SQLType.decimal(scale=scale)
+        if isinstance(e, BConst):
+            if e.value is None:
+                return BConst(None, ty)
+            if scale > cur:
+                return BConst(e.value * 10 ** (scale - cur), ty)
+            return BConst(e.value // 10 ** (cur - scale), ty)
+        if scale > cur:
+            return BBin("*", e, BConst(10 ** (scale - cur), INT8), ty)
+        return BBin("//", e, BConst(10 ** (cur - scale), INT8), ty)
+
+    def _align2(self, a: BExpr, b: BExpr) -> tuple[BExpr, BExpr, SQLType]:
+        """Align two operands to a common physical type for +,-,cmp."""
+        ta, tb = a.type, b.type
+        if ta.family == Family.STRING or tb.family == Family.STRING:
+            return a, b, STRING
+        if {ta.family, tb.family} <= {Family.DATE, Family.INT}:
+            return a, b, DATE if Family.DATE in (ta.family, tb.family) else ta
+        target = common_numeric_type(ta, tb)
+        return self.coerce(a, target), self.coerce(b, target), target
+
+    def _align3(self, x, lo, hi):
+        x2, lo2, _ = self._align2(x, lo)
+        x3, hi2, _ = self._align2(x2, hi)
+        # re-align lo in case x changed scale
+        x4, lo3, _ = self._align2(x3, lo2)
+        return x4, lo3, hi2
+
+    # -- operators -----------------------------------------------------------
+    def bind_binop(self, e: ast.BinOp) -> BExpr:
+        op = e.op
+        if op in ("and", "or"):
+            l, r = self.bind(e.left), self.bind(e.right)
+            for s in (l, r):
+                if s.type.family not in (Family.BOOL, Family.UNKNOWN):
+                    raise BindError(f"{op.upper()} requires booleans")
+            return BBin(op, l, r, BOOL)
+        if op == "like":
+            return self.bind_like(e)
+        l, r = self.bind(e.left), self.bind(e.right)
+
+        # interval constant folding: date +/- interval, timestamp +/- interval
+        for a, b, sign_sw in ((l, r, False), (r, l, True)):
+            if b.type.family == Family.INTERVAL:
+                if not isinstance(b, BConst):
+                    raise BindError("non-constant intervals unsupported")
+                if op not in ("+", "-"):
+                    raise BindError(f"bad interval op {op}")
+                sign = -1 if (op == "-" and not sign_sw) else 1
+                if sign_sw and op == "-":
+                    raise BindError("interval - date is invalid")
+                return self._fold_interval(a, b.value, sign)
+
+        if op in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            if op == "<>":
+                op = "!="
+            # string comparisons against dict-encoded columns
+            s = self._bind_string_compare(op, l, r)
+            if s is not None:
+                return s
+            l2, r2, _ = self._align2(l, r)
+            return BBin(op, l2, r2, BOOL)
+        if op in ("+", "-"):
+            if op == "-" and l.type.family == Family.DATE \
+                    and r.type.family == Family.DATE:
+                return BBin("-", l, r, INT8)  # day-count difference
+            if op == "-" and l.type.family == Family.TIMESTAMP \
+                    and r.type.family == Family.TIMESTAMP:
+                return BBin("-", l, r, INTERVAL)  # microseconds
+            l2, r2, t = self._align2(l, r)
+            return BBin(op, l2, r2, t)
+        if op == "*":
+            return self.bind_mul(l, r)
+        if op == "/":
+            l2 = self.coerce(l, FLOAT8) if l.type.family != Family.FLOAT else l
+            r2 = self.coerce(r, FLOAT8) if r.type.family != Family.FLOAT else r
+            return BBin("/", l2, r2, FLOAT8)
+        if op == "%":
+            l2, r2, t = self._align2(l, r)
+            return BBin("%", l2, r2, t)
+        if op == "||":
+            raise BindError("string concat on device not supported yet")
+        raise BindError(f"unknown operator {op}")
+
+    def bind_mul(self, l: BExpr, r: BExpr) -> BExpr:
+        tl, tr = l.type, r.type
+        if Family.FLOAT in (tl.family, tr.family):
+            return BBin("*", self.coerce(l, FLOAT8), self.coerce(r, FLOAT8),
+                        FLOAT8)
+        if tl.family == Family.DECIMAL and tr.family == Family.DECIMAL:
+            # scaled-int multiply: scales add (rescale happens only on
+            # explicit cast or output)
+            ty = SQLType.decimal(scale=tl.scale + tr.scale)
+            return BBin("*", l, r, ty)
+        if tl.family == Family.DECIMAL or tr.family == Family.DECIMAL:
+            dec, other = (l, r) if tl.family == Family.DECIMAL else (r, l)
+            if other.type.family != Family.INT:
+                raise BindError(f"cannot multiply {tl} by {tr}")
+            return BBin("*", dec, other, dec.type)
+        l2, r2, t = self._align2(l, r)
+        return BBin("*", l2, r2, t)
+
+    def _fold_interval(self, d: BExpr, iv: Interval, sign: int) -> BExpr:
+        if d.type.family == Family.DATE:
+            if isinstance(d, BConst):
+                return BConst(add_interval_to_date(d.value, iv, sign), DATE)
+            if iv.months == 0 and iv.micros == 0:
+                return BBin("+", d, BConst(sign * iv.days, INT8), DATE)
+            raise BindError("month intervals on non-constant dates")
+        if d.type.family == Family.TIMESTAMP:
+            if iv.months == 0:
+                delta = sign * (iv.days * 86_400_000_000 + iv.micros)
+                if isinstance(d, BConst):
+                    return BConst(d.value + delta, TIMESTAMP)
+                return BBin("+", d, BConst(delta, INT8), TIMESTAMP)
+            raise BindError("month intervals on timestamps")
+        raise BindError(f"interval arithmetic on {d.type}")
+
+    # -- strings over dictionaries --------------------------------------------
+    def _dict_of(self, e: BExpr):
+        if isinstance(e, BCol) and e.type.family == Family.STRING:
+            for t in self.scope.tables.values():
+                for b in t.values():
+                    if b.batch_name == e.name:
+                        return b.dictionary
+        return None
+
+    def _bind_string_compare(self, op, l, r):
+        if l.type.family != Family.STRING and r.type.family != Family.STRING:
+            return None
+        col, lit, flip = None, None, False
+        if isinstance(r, BConst) and isinstance(r.value, str):
+            col, lit = l, r.value
+        elif isinstance(l, BConst) and isinstance(l.value, str):
+            col, lit, flip = r, l.value, True
+        if col is None:
+            # col-col string compare
+            if isinstance(l, BCol) and isinstance(r, BCol) and op in ("=", "!="):
+                dl, dr = self._dict_of(l), self._dict_of(r)
+                if dl is dr:
+                    return BBin(op, l, r, BOOL)
+                if dl is not None and dr is not None:
+                    # translate r's codes into l's code space (host-side
+                    # table; on device it's one gather — join keys ride this)
+                    table = np.fromiter(
+                        (dl.codes.get(v, -1) for v in dr.values),
+                        dtype=np.int32, count=len(dr.values))
+                    return BBin(op, l, BDictRemap(r, table, l.type), BOOL)
+            raise BindError("unsupported string comparison")
+        d = self._dict_of(col)
+        if d is None:
+            raise BindError("string compare on non-dictionary column")
+        if flip:
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if op == "=":
+            code = d.codes.get(lit)
+            if code is None:
+                return BConst(False, BOOL)  # value absent from data
+            return BBin("=", col, BConst(code, col.type), BOOL)
+        if op == "!=":
+            code = d.codes.get(lit)
+            if code is None:
+                return BConst(True, BOOL)
+            return BBin("!=", col, BConst(code, col.type), BOOL)
+        # ordered compare: evaluate against dictionary -> lookup table
+        vals = np.asarray(d.values, dtype=object)
+        pyop = {"<": np.less, "<=": np.less_equal,
+                ">": np.greater, ">=": np.greater_equal}[op]
+        table = pyop(vals.astype(str), lit)
+        return BDictLookup(col, np.asarray(table, dtype=bool), BOOL)
+
+    def bind_like(self, e: ast.BinOp) -> BExpr:
+        col = self.bind(e.left)
+        pat = self.bind(e.right)
+        if not isinstance(pat, BConst) or not isinstance(pat.value, str):
+            raise BindError("LIKE pattern must be a constant")
+        d = self._dict_of(col)
+        if d is None:
+            raise BindError("LIKE on non-dictionary column")
+        rx = re.compile(
+            "^" + re.escape(pat.value).replace("%", ".*").replace("_", ".")
+            + "$", re.S)
+        table = np.fromiter((rx.match(v) is not None for v in d.values),
+                            dtype=bool, count=len(d.values))
+        return BDictLookup(col, table, BOOL)
+
+    # -- IN / CASE / CAST ------------------------------------------------------
+    def bind_in(self, e: ast.InList) -> BExpr:
+        x = self.bind(e.expr)
+        vals = []
+        if x.type.family == Family.STRING:
+            d = self._dict_of(x)
+            if d is None:
+                raise BindError("IN on non-dictionary string column")
+            for item in e.items:
+                b = self.bind(item)
+                if not isinstance(b, BConst):
+                    raise BindError("IN list must be constants")
+                code = d.codes.get(b.value)
+                if code is not None:
+                    vals.append(code)
+            if not vals:
+                return BConst(e.negated, BOOL)
+            return BInList(x, vals, e.negated, BOOL)
+        # common numeric type across x and all items (so `int_col IN
+        # (1.5)` compares at decimal precision instead of rounding 1.5)
+        bound_items = [self.bind(i) for i in e.items]
+        target = x.type
+        for b in bound_items:
+            target = common_numeric_type(target, b.type) \
+                if x.type.is_numeric else target
+        x2 = self.coerce(x, target) if x.type != target else x
+        for b in bound_items:
+            b2 = self.coerce(b, target)
+            if not isinstance(b2, BConst):
+                raise BindError("IN list must be constants")
+            vals.append(b2.value)
+        return BInList(x2, vals, e.negated, BOOL)
+
+    def bind_case(self, e: ast.Case) -> BExpr:
+        whens = [(self.bind(c), self.bind(v)) for c, v in e.whens]
+        else_ = self.bind(e.else_) if e.else_ is not None else BConst(
+            None, SQLType.unknown())
+        # result type: first non-unknown branch type, all coerced to it
+        rty = None
+        for _, v in whens:
+            if v.type.family != Family.UNKNOWN:
+                rty = v.type
+                break
+        if rty is None:
+            rty = else_.type
+        if rty.family == Family.UNKNOWN:
+            raise BindError("untyped CASE")
+        if rty.family == Family.STRING:
+            # constant string branches get an ad-hoc output dictionary
+            from ..storage.columnstore import Dictionary
+            d = Dictionary()
+
+            def enc(v):
+                if isinstance(v, BConst):
+                    if v.value is None:
+                        return BConst(None, STRING)
+                    if not isinstance(v.value, str):
+                        raise BindError("mixed CASE branch types")
+                    return BConst(d.encode(v.value), STRING)
+                raise BindError(
+                    "CASE over string columns not supported (constants only)")
+            whens = [(c, enc(v)) for c, v in whens]
+            else_ = enc(else_) if not (isinstance(else_, BConst)
+                                       and else_.value is None) else BConst(None, STRING)
+            out = BCase(whens, else_, STRING)
+            out.dictionary = d
+            return out
+        # widen decimals to max scale among branches
+        if rty.family == Family.DECIMAL:
+            smax = max([v.type.scale for _, v in whens
+                        if v.type.family == Family.DECIMAL] +
+                       ([else_.type.scale]
+                        if else_.type.family == Family.DECIMAL else [0]))
+            rty = SQLType.decimal(scale=smax)
+        whens = [(c, self.coerce(v, rty)) for c, v in whens]
+        else_ = self.coerce(else_, rty)
+        return BCase(whens, else_, rty)
+
+    def bind_cast(self, x: BExpr, to: SQLType) -> BExpr:
+        if x.type.family == to.family and x.type == to:
+            return x
+        if isinstance(x, BConst):
+            return self._const_to(x, to)
+        if to.family == Family.FLOAT:
+            return BCast(x, FLOAT8)
+        if to.family == Family.DECIMAL:
+            if x.type.family == Family.DECIMAL:
+                return self._rescale_decimal(x, to.scale)
+            if x.type.family == Family.INT:
+                return BBin("*", x, BConst(10 ** to.scale, INT8), to)
+            if x.type.family == Family.FLOAT:
+                return BCast(x, to)  # executor rounds
+        if to.family == Family.INT:
+            return BCast(x, to)
+        raise BindError(f"unsupported cast {x.type} -> {to}")
+
+    # -- functions & aggregates --------------------------------------------
+    def bind_func(self, e: ast.FuncCall) -> BExpr:
+        name = e.name
+        if name in AGG_FUNCS:
+            if not self._collect_aggs:
+                raise BindError(f"aggregate {name} not allowed here")
+            return self._bind_agg(e)
+        if name == "coalesce":
+            args = [self.bind(a) for a in e.args]
+            rty = next((a.type for a in args
+                        if a.type.family != Family.UNKNOWN), None)
+            if rty is None:
+                raise BindError("untyped COALESCE")
+            args = [self.coerce(a, rty) for a in args]
+            return BCoalesce(args, rty)
+        if name == "abs":
+            x = self.bind(e.args[0])
+            return BUnary("abs", x, x.type)
+        if name in ("floor", "ceil", "round", "sqrt", "ln", "exp"):
+            x = self.coerce(self.bind(e.args[0]), FLOAT8)
+            return BUnary(name, x, FLOAT8)
+        raise BindError(f"unknown function {name}")
+
+    def _bind_agg(self, e: ast.FuncCall) -> BExpr:
+        name = e.name
+        if name == "count" and e.star:
+            spec = BoundAgg("count_rows", None, INT8)
+        else:
+            if len(e.args) != 1:
+                raise BindError(f"{name} takes one argument")
+            arg = self.bind(e.args[0])
+            for a in (arg,):
+                from .bound import walk
+                for nd in walk(a):
+                    if isinstance(nd, BAggRef):
+                        raise BindError("nested aggregates")
+            if name == "count":
+                spec = BoundAgg("count", arg, INT8, e.distinct)
+            elif name == "avg":
+                spec = BoundAgg("avg", arg, FLOAT8, e.distinct)
+            elif name == "sum":
+                if arg.type.family == Family.INT:
+                    spec = BoundAgg("sum_int", arg, INT8, e.distinct)
+                elif arg.type.family == Family.DECIMAL:
+                    spec = BoundAgg("sum", arg, arg.type, e.distinct)
+                else:
+                    spec = BoundAgg("sum", self.coerce(arg, FLOAT8), FLOAT8,
+                                    e.distinct)
+            elif name in ("min", "max"):
+                spec = BoundAgg(name, arg, arg.type, e.distinct)
+            else:
+                raise BindError(name)
+        if spec.distinct and spec.func not in ("count",):
+            raise BindError(f"DISTINCT {name} not supported")
+        # dedup identical aggregates
+        for i, existing in enumerate(self.aggs):
+            if _agg_key(existing) == _agg_key(spec):
+                return BAggRef(i, existing.type)
+        self.aggs.append(spec)
+        return BAggRef(len(self.aggs) - 1, spec.type)
+
+    def bind_with_aggs(self, e: ast.Expr) -> BExpr:
+        self._collect_aggs = True
+        try:
+            return self.bind(e)
+        finally:
+            self._collect_aggs = False
+
+
+def _agg_key(a: BoundAgg):
+    return (a.func, repr(a.arg), a.distinct)

@@ -1,0 +1,170 @@
+"""Typed (bound) expression tree — the output of semantic analysis.
+
+The reference separates AST (sem/tree) from the typed/normalized memo
+expressions the optimizer works on (pkg/sql/opt/memo). Our bound tree
+is the physical lowering: every node carries an SQLType whose physical
+dtype the executor compiles against, decimals are already scaled ints,
+date literals are already day numbers, and string literals against
+dictionary-encoded columns are already dictionary codes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .types import SQLType
+
+
+class BExpr:
+    type: SQLType
+
+
+@dataclass
+class BConst(BExpr):
+    value: object  # physical scalar (int/float/bool) or None for NULL
+    type: SQLType = None
+
+
+@dataclass
+class BCol(BExpr):
+    name: str  # unique batch column name ("alias.col")
+    type: SQLType = None
+
+
+@dataclass
+class BBin(BExpr):
+    op: str
+    left: BExpr
+    right: BExpr
+    type: SQLType = None
+
+
+@dataclass
+class BUnary(BExpr):
+    op: str  # "-" | "not"
+    operand: BExpr
+    type: SQLType = None
+
+
+@dataclass
+class BBetween(BExpr):
+    expr: BExpr
+    lo: BExpr
+    hi: BExpr
+    negated: bool = False
+    type: SQLType = None
+
+
+@dataclass
+class BInList(BExpr):
+    expr: BExpr
+    values: list  # physical constants
+    negated: bool = False
+    type: SQLType = None
+
+
+@dataclass
+class BIsNull(BExpr):
+    expr: BExpr
+    negated: bool = False
+    type: SQLType = None
+
+
+@dataclass
+class BCase(BExpr):
+    whens: list[tuple[BExpr, BExpr]] = field(default_factory=list)
+    else_: Optional[BExpr] = None
+    type: SQLType = None
+
+
+@dataclass
+class BCast(BExpr):
+    expr: BExpr
+    type: SQLType = None
+
+
+@dataclass
+class BCoalesce(BExpr):
+    args: list[BExpr] = field(default_factory=list)
+    type: SQLType = None
+
+
+@dataclass
+class BExtract(BExpr):
+    part: str
+    expr: BExpr
+    type: SQLType = None
+
+
+@dataclass
+class BDictLookup(BExpr):
+    """mask_table[codes] — a predicate over a dictionary-encoded string
+    column, pre-evaluated against the dictionary on the host (binder.py);
+    on device it is a single gather."""
+    expr: BExpr
+    table: object = None  # np.ndarray bool[len(dictionary)]
+    type: SQLType = None
+
+
+@dataclass
+class BDictRemap(BExpr):
+    """remap_table[codes] — translate one string column's dictionary
+    codes into another column's code space (for cross-table string
+    equality, e.g. join keys); absent values map to -1 (never match)."""
+    expr: BExpr
+    table: object = None  # np.ndarray int32[len(src dictionary)]
+    type: SQLType = None
+
+
+@dataclass
+class BAggRef(BExpr):
+    """Placeholder for aggregate i's result in a post-aggregation
+    expression (the reference's execbuilder renders final-stage AVG as
+    SUM/COUNT the same way, physicalplan/aggregator_funcs.go)."""
+    index: int
+    type: SQLType = None
+
+
+@dataclass
+class BoundAgg:
+    """One aggregate instance: func(arg) [distinct]."""
+    func: str  # sum | count | count_rows | min | max | avg | sum_int
+    arg: Optional[BExpr]
+    type: SQLType = None
+    distinct: bool = False
+
+
+def walk(e: BExpr):
+    yield e
+    for child in _children(e):
+        yield from walk(child)
+
+
+def _children(e: BExpr):
+    if isinstance(e, BBin):
+        return [e.left, e.right]
+    if isinstance(e, BUnary):
+        return [e.operand]
+    if isinstance(e, BBetween):
+        return [e.expr, e.lo, e.hi]
+    if isinstance(e, (BInList, BIsNull, BDictLookup, BDictRemap)):
+        return [e.expr]
+    if isinstance(e, BCase):
+        out = []
+        for c, v in e.whens:
+            out += [c, v]
+        if e.else_ is not None:
+            out.append(e.else_)
+        return out
+    if isinstance(e, BCast):
+        return [e.expr]
+    if isinstance(e, BCoalesce):
+        return list(e.args)
+    if isinstance(e, BExtract):
+        return [e.expr]
+    return []
+
+
+def referenced_columns(e: BExpr) -> set[str]:
+    return {n.name for n in walk(e) if isinstance(n, BCol)}

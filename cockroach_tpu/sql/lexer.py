@@ -1,0 +1,148 @@
+"""SQL lexer.
+
+The reference generates its scanner/grammar with goyacc
+(pkg/sql/parser/sql.y, pkg/sql/scanner); a hand-rolled scanner + Pratt
+parser covers our SQL subset without a generator toolchain
+(SURVEY.md §7 step 2).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Tok(enum.Enum):
+    IDENT = "ident"
+    NUMBER = "number"
+    STRING = "string"
+    OP = "op"
+    KEYWORD = "keyword"
+    EOF = "eof"
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "between", "like", "ilike",
+    "is", "null", "true", "false", "case", "when", "then", "else", "end",
+    "cast", "join", "inner", "left", "right", "full", "outer", "cross",
+    "on", "using", "asc", "desc", "distinct", "create", "table", "primary",
+    "key", "insert", "into", "values", "update", "set", "delete", "drop",
+    "interval", "date", "timestamp", "exists", "union", "all", "show",
+    "explain", "begin", "commit", "rollback", "transaction", "index",
+    "analyze", "if", "coalesce", "nulls", "first", "last", "default",
+    "cluster", "setting", "extract", "substring", "backup", "restore",
+    "to", "with",
+}
+
+MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]
+SINGLE_OPS = "+-*/%(),.<>=;"
+
+
+@dataclass
+class Token:
+    kind: Tok
+    text: str
+    pos: int
+
+    def is_kw(self, *kws: str) -> bool:
+        return self.kind == Tok.KEYWORD and self.text in kws
+
+    def __repr__(self):
+        return f"{self.kind.name}:{self.text!r}"
+
+
+class LexError(Exception):
+    pass
+
+
+def lex(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):  # line comment
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i)
+            if j < 0:
+                raise LexError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":  # escaped ''
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            if j >= n:
+                raise LexError(f"unterminated string at {i}")
+            toks.append(Token(Tok.STRING, "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"':  # quoted identifier
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise LexError(f"unterminated identifier at {i}")
+            toks.append(Token(Tok.IDENT, sql[i + 1:j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j > i:
+                    seen_exp = True
+                    j += 1
+                    if j < n and sql[j] in "+-":
+                        j += 1
+                else:
+                    break
+            toks.append(Token(Tok.NUMBER, sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            lw = word.lower()
+            if lw in KEYWORDS:
+                toks.append(Token(Tok.KEYWORD, lw, i))
+            else:
+                toks.append(Token(Tok.IDENT, lw, i))
+            i = j
+            continue
+        matched = False
+        for op in MULTICHAR_OPS:
+            if sql.startswith(op, i):
+                toks.append(Token(Tok.OP, op, i))
+                i += len(op)
+                matched = True
+                break
+        if matched:
+            continue
+        if c in SINGLE_OPS:
+            toks.append(Token(Tok.OP, c, i))
+            i += 1
+            continue
+        raise LexError(f"unexpected character {c!r} at {i}")
+    toks.append(Token(Tok.EOF, "", n))
+    return toks

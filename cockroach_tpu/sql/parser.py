@@ -1,0 +1,585 @@
+"""Pratt-style recursive-descent SQL parser.
+
+Grammar coverage tracks what the execution engine supports (the TPC-H /
+SSB / YCSB benchmark surface plus DDL/DML): SELECT with joins, GROUP
+BY/HAVING, ORDER BY/LIMIT, CASE, CAST, BETWEEN, IN, LIKE, EXTRACT,
+SUBSTRING, date/interval literals; CREATE/DROP TABLE; INSERT/UPDATE/
+DELETE; SET/SHOW; EXPLAIN [ANALYZE]; BEGIN/COMMIT/ROLLBACK.
+
+The reference's grammar is goyacc-generated from a 5MB sql.y
+(pkg/sql/parser/BUILD.bazel:86-99); precedence below mirrors standard
+PostgreSQL precedence.
+"""
+
+from __future__ import annotations
+
+from . import ast
+from .lexer import Tok, Token, lex
+from .types import (BOOL, DATE, FLOAT4, FLOAT8, INT2, INT4, INT8, INTERVAL,
+                    STRING, TIMESTAMP, SQLType)
+
+
+class ParseError(Exception):
+    pass
+
+
+# binding powers for binary operators
+PRECEDENCE = {
+    "or": 10,
+    "and": 20,
+    # NOT handled as prefix with bp 25
+    "=": 40, "!=": 40, "<>": 40, "<": 40, "<=": 40, ">": 40, ">=": 40,
+    "like": 40, "ilike": 40,
+    "||": 45,
+    "+": 50, "-": 50,
+    "*": 60, "/": 60, "%": 60,
+    "::": 80,
+}
+
+TYPE_NAMES = {
+    "int": INT8, "int2": INT2, "int4": INT4, "int8": INT8, "bigint": INT8,
+    "smallint": INT2, "integer": INT4, "bool": BOOL, "boolean": BOOL,
+    "float": FLOAT8, "float4": FLOAT4, "float8": FLOAT8, "real": FLOAT4,
+    "double": FLOAT8, "date": DATE, "timestamp": TIMESTAMP,
+    "timestamptz": TIMESTAMP, "interval": INTERVAL, "string": STRING,
+    "text": STRING, "varchar": STRING, "char": STRING,
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = lex(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != Tok.EOF:
+            self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.peek().is_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()}, got {self.peek()}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == Tok.OP and t.text == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r}, got {self.peek()}")
+
+    def expect_ident(self) -> str:
+        t = self.next()
+        if t.kind not in (Tok.IDENT, Tok.KEYWORD):
+            raise ParseError(f"expected identifier, got {t}")
+        return t.text
+
+    def dotted_name(self) -> str:
+        """a.b.c — setting/variable names."""
+        parts = [self.expect_ident()]
+        while self.accept_op("."):
+            parts.append(self.expect_ident())
+        return ".".join(parts)
+
+    # -- entry -------------------------------------------------------------
+    def parse_statement(self) -> ast.Statement:
+        t = self.peek()
+        if t.is_kw("select"):
+            return self.parse_select()
+        if t.is_kw("create"):
+            return self.parse_create()
+        if t.is_kw("drop"):
+            return self.parse_drop()
+        if t.is_kw("insert"):
+            return self.parse_insert()
+        if t.is_kw("update"):
+            return self.parse_update()
+        if t.is_kw("delete"):
+            return self.parse_delete()
+        if t.is_kw("set"):
+            return self.parse_set()
+        if t.is_kw("show"):
+            self.next()
+            self.accept_kw("cluster")
+            self.accept_kw("setting")
+            return ast.ShowVar(self.dotted_name())
+        if t.is_kw("explain"):
+            self.next()
+            analyze = self.accept_kw("analyze")
+            return ast.Explain(self.parse_statement(), analyze=analyze)
+        if t.is_kw("begin"):
+            self.next()
+            self.accept_kw("transaction")
+            return ast.BeginTxn()
+        if t.is_kw("commit"):
+            self.next()
+            return ast.CommitTxn()
+        if t.is_kw("rollback"):
+            self.next()
+            return ast.RollbackTxn()
+        raise ParseError(f"unexpected {t}")
+
+    def finish(self) -> None:
+        self.accept_op(";")
+        if self.peek().kind != Tok.EOF:
+            raise ParseError(f"trailing tokens at {self.peek()}")
+
+    # -- SELECT ------------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("select")
+        sel = ast.Select()
+        sel.distinct = self.accept_kw("distinct")
+        while True:
+            if self.accept_op("*"):
+                sel.items.append(ast.SelectItem(expr=None, star=True))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.expect_ident()
+                elif self.peek().kind == Tok.IDENT:
+                    alias = self.next().text
+                sel.items.append(ast.SelectItem(expr=e, alias=alias))
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("from"):
+            sel.table = self.parse_table_ref()
+            while True:
+                jt = self.parse_join_type()
+                if jt is None:
+                    break
+                tbl = self.parse_table_ref()
+                on = None
+                if jt != "cross":
+                    self.expect_kw("on")
+                    on = self.parse_expr()
+                sel.joins.append(ast.JoinClause(tbl, jt, on))
+        if self.accept_kw("where"):
+            sel.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            sel.group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                sel.group_by.append(self.parse_expr())
+        if self.accept_kw("having"):
+            sel.having = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                if self.accept_kw("nulls"):  # NULLS FIRST|LAST accepted, default order
+                    if not (self.accept_kw("first") or self.accept_kw("last")):
+                        raise ParseError("expected FIRST or LAST")
+                sel.order_by.append(ast.OrderItem(e, desc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("limit"):
+            sel.limit = int(self.next().text)
+        if self.accept_kw("offset"):
+            sel.offset = int(self.next().text)
+        return sel
+
+    def parse_table_ref(self) -> ast.TableRef:
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek().kind == Tok.IDENT:
+            alias = self.next().text
+        return ast.TableRef(name, alias)
+
+    def parse_join_type(self):
+        t = self.peek()
+        if t.is_kw("join"):
+            self.next()
+            return "inner"
+        if t.is_kw("inner"):
+            self.next()
+            self.expect_kw("join")
+            return "inner"
+        if t.is_kw("left"):
+            self.next()
+            self.accept_kw("outer")
+            self.expect_kw("join")
+            return "left"
+        if t.is_kw("cross"):
+            self.next()
+            self.expect_kw("join")
+            return "cross"
+        if t.is_kw("right") or t.is_kw("full"):
+            raise ParseError(f"{t.text.upper()} JOIN not supported yet")
+        if t.kind == Tok.OP and t.text == ",":
+            nxt = self.peek(1)
+            # comma-join only when followed by a table name (not subquery)
+            if nxt.kind == Tok.IDENT:
+                self.next()
+                return "cross"
+        return None
+
+    # -- expressions -------------------------------------------------------
+    def parse_expr(self, min_bp: int = 0) -> ast.Expr:
+        left = self.parse_prefix()
+        while True:
+            t = self.peek()
+            # postfix-ish constructs
+            if t.is_kw("not") and self.peek(1).is_kw("between", "in", "like", "ilike"):
+                if 35 < min_bp:
+                    break
+                self.next()
+                left = self.parse_not_suffix(left, negated=True)
+                continue
+            if t.is_kw("between", "in"):
+                if 35 < min_bp:
+                    break
+                left = self.parse_not_suffix(left, negated=False)
+                continue
+            if t.is_kw("is"):
+                if 35 < min_bp:
+                    break
+                self.next()
+                neg = self.accept_kw("not")
+                if self.accept_kw("null"):
+                    left = ast.IsNull(left, negated=neg)
+                elif self.accept_kw("true"):
+                    cmp = ast.BinOp("=", left, ast.Literal(True, BOOL))
+                    left = ast.UnaryOp("not", cmp) if neg else cmp
+                elif self.accept_kw("false"):
+                    cmp = ast.BinOp("=", left, ast.Literal(False, BOOL))
+                    left = ast.UnaryOp("not", cmp) if neg else cmp
+                else:
+                    raise ParseError(f"expected NULL/TRUE/FALSE after IS at {self.peek()}")
+                continue
+            op = None
+            if t.kind == Tok.OP and t.text in PRECEDENCE:
+                op = t.text
+            elif t.is_kw("and", "or", "like", "ilike"):
+                op = t.text
+            if op is None:
+                break
+            bp = PRECEDENCE[op]
+            if bp < min_bp:
+                break
+            self.next()
+            if op == "::":
+                left = ast.Cast(left, self.parse_type())
+                continue
+            right = self.parse_expr(bp + 1)
+            left = ast.BinOp(op, left, right)
+        return left
+
+    def parse_not_suffix(self, left: ast.Expr, negated: bool) -> ast.Expr:
+        if self.accept_kw("between"):
+            lo = self.parse_expr(41)
+            self.expect_kw("and")
+            hi = self.parse_expr(41)
+            return ast.Between(left, lo, hi, negated=negated)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.InList(left, items, negated=negated)
+        if self.accept_kw("like") or self.accept_kw("ilike"):
+            right = self.parse_expr(41)
+            e = ast.BinOp("like", left, right)
+            return ast.UnaryOp("not", e) if negated else e
+        raise ParseError(f"unexpected {self.peek()}")
+
+    def parse_prefix(self) -> ast.Expr:
+        t = self.next()
+        if t.kind == Tok.NUMBER:
+            txt = t.text
+            if "." in txt or "e" in txt or "E" in txt:
+                # decimal literal: keep string for scale-aware binding
+                return ast.Literal(txt, None)
+            return ast.Literal(int(txt), None)
+        if t.kind == Tok.STRING:
+            return ast.Literal(t.text, None)
+        if t.is_kw("true"):
+            return ast.Literal(True, BOOL)
+        if t.is_kw("false"):
+            return ast.Literal(False, BOOL)
+        if t.is_kw("null"):
+            return ast.Literal(None, None)
+        if t.is_kw("date"):
+            if self.peek().kind == Tok.STRING:
+                return ast.Literal(self.next().text, DATE)
+            return ast.ColumnRef("date")
+        if t.is_kw("timestamp"):
+            if self.peek().kind == Tok.STRING:
+                return ast.Literal(self.next().text, TIMESTAMP)
+            return ast.ColumnRef("timestamp")
+        if t.is_kw("interval"):
+            if self.peek().kind == Tok.STRING:
+                return ast.Literal(self.next().text, INTERVAL)
+            return ast.ColumnRef("interval")
+        if t.is_kw("not"):
+            return ast.UnaryOp("not", self.parse_expr(25))
+        if t.kind == Tok.OP and t.text == "-":
+            return ast.UnaryOp("-", self.parse_expr(70))
+        if t.kind == Tok.OP and t.text == "+":
+            return self.parse_expr(70)
+        if t.kind == Tok.OP and t.text == "(":
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.is_kw("case"):
+            whens = []
+            operand = None
+            if not self.peek().is_kw("when"):
+                operand = self.parse_expr()
+            while self.accept_kw("when"):
+                cond = self.parse_expr()
+                if operand is not None:
+                    cond = ast.BinOp("=", operand, cond)
+                self.expect_kw("then")
+                val = self.parse_expr()
+                whens.append((cond, val))
+            else_ = None
+            if self.accept_kw("else"):
+                else_ = self.parse_expr()
+            self.expect_kw("end")
+            return ast.Case(whens, else_)
+        if t.is_kw("cast"):
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            ty = self.parse_type()
+            self.expect_op(")")
+            return ast.Cast(e, ty)
+        if t.is_kw("coalesce"):
+            self.expect_op("(")
+            args = [self.parse_expr()]
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("coalesce", args)
+        if t.is_kw("extract"):
+            self.expect_op("(")
+            part = self.expect_ident()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.Extract(part, e)
+        if t.is_kw("substring"):
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("from")
+            start = self.parse_expr()
+            length = None
+            if self.accept_kw("for"):
+                length = self.parse_expr()
+            elif self.accept_op(","):
+                start2 = start
+                length = self.parse_expr()
+                start = start2
+            self.expect_op(")")
+            return ast.Substring(e, start, length)
+        if t.kind in (Tok.IDENT, Tok.KEYWORD):
+            name = t.text
+            # function call?
+            if self.peek().kind == Tok.OP and self.peek().text == "(":
+                self.next()
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return ast.FuncCall(name, [], star=True)
+                distinct = self.accept_kw("distinct")
+                args = []
+                if not self.accept_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                    self.expect_op(")")
+                return ast.FuncCall(name, args, distinct=distinct)
+            # qualified column a.b
+            if self.peek().kind == Tok.OP and self.peek().text == ".":
+                self.next()
+                col = self.expect_ident()
+                return ast.ColumnRef(col, table=name)
+            return ast.ColumnRef(name)
+        raise ParseError(f"unexpected token {t}")
+
+    def parse_type(self) -> SQLType:
+        t = self.next()
+        name = t.text.lower()
+        if name == "double" and self.peek().kind == Tok.IDENT \
+                and self.peek().text == "precision":
+            self.next()
+            return FLOAT8
+        if name in ("decimal", "numeric"):
+            prec, scale = 19, 2
+            if self.accept_op("("):
+                prec = int(self.next().text)
+                if self.accept_op(","):
+                    scale = int(self.next().text)
+                self.expect_op(")")
+            return SQLType.decimal(prec, scale)
+        if name in TYPE_NAMES:
+            ty = TYPE_NAMES[name]
+            if self.accept_op("("):  # varchar(n) etc. — length ignored
+                self.next()
+                self.expect_op(")")
+            return ty
+        raise ParseError(f"unknown type {name!r}")
+
+    # -- DDL/DML -----------------------------------------------------------
+    def parse_create(self) -> ast.Statement:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols: list[ast.ColumnDef] = []
+        pk: list[str] = []
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                pk.append(self.expect_ident())
+                while self.accept_op(","):
+                    pk.append(self.expect_ident())
+                self.expect_op(")")
+            else:
+                cname = self.expect_ident()
+                ctype = self.parse_type()
+                nullable = True
+                primary = False
+                while True:
+                    if self.accept_kw("not"):
+                        self.expect_kw("null")
+                        nullable = False
+                    elif self.accept_kw("null"):
+                        pass
+                    elif self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        primary = True
+                        nullable = False
+                    elif self.accept_kw("default"):
+                        self.parse_expr()  # accepted, ignored for now
+                    else:
+                        break
+                cols.append(ast.ColumnDef(cname, ctype, nullable, primary))
+                if primary:
+                    pk.append(cname)
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.CreateTable(name, cols, pk, if_not_exists)
+
+    def parse_drop(self) -> ast.Statement:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTable(self.expect_ident(), if_exists)
+
+    def parse_insert(self) -> ast.Statement:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.expect_ident()
+        columns: list[str] = []
+        if self.accept_op("("):
+            columns.append(self.expect_ident())
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        if self.peek().is_kw("select"):
+            return ast.Insert(table, columns, select=self.parse_select())
+        self.expect_kw("values")
+        rows: list[list[ast.Expr]] = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.accept_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ast.Insert(table, columns, rows=rows)
+
+    def parse_update(self) -> ast.Statement:
+        self.expect_kw("update")
+        table = self.expect_ident()
+        self.expect_kw("set")
+        assigns: list[tuple[str, ast.Expr]] = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            assigns.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return ast.Update(table, assigns, where)
+
+    def parse_delete(self) -> ast.Statement:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.expect_ident()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return ast.Delete(table, where)
+
+    def parse_set(self) -> ast.Statement:
+        self.expect_kw("set")
+        cluster = False
+        if self.accept_kw("cluster"):
+            self.expect_kw("setting")
+            cluster = True
+        name = self.dotted_name()
+        if not self.accept_op("="):
+            self.expect_kw("to")
+        t = self.next()
+        if t.kind == Tok.NUMBER:
+            val: object = float(t.text) if "." in t.text else int(t.text)
+        elif t.is_kw("true"):
+            val = True
+        elif t.is_kw("false"):
+            val = False
+        else:
+            val = t.text
+        return ast.SetVar(name, val, cluster)
+
+
+def parse(sql: str) -> ast.Statement:
+    p = Parser(sql)
+    stmt = p.parse_statement()
+    p.finish()
+    return stmt
+
+
+def parse_many(sql: str) -> list[ast.Statement]:
+    p = Parser(sql)
+    out = []
+    while p.peek().kind != Tok.EOF:
+        out.append(p.parse_statement())
+        if not p.accept_op(";"):
+            break
+    if p.peek().kind != Tok.EOF:
+        raise ParseError(f"trailing tokens at {p.peek()}")
+    return out

@@ -1,0 +1,119 @@
+"""Logical plan nodes (the analogue of memo relational expressions).
+
+The plan tree the heuristic planner emits and the executor compiles.
+Mirrors the reference's planNode/physicalPlan split loosely: this is
+the single logical form; the distribution layer decides how a Scan's
+spans map onto the device mesh (parallel/partition.py), like
+PartitionSpans (distsql_physical_planner.go:1096) decides node
+placement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .bound import BExpr, BoundAgg
+from .types import SQLType
+
+
+class PlanNode:
+    pass
+
+
+@dataclass
+class Scan(PlanNode):
+    table: str
+    alias: str
+    # batch column name -> stored column name
+    columns: dict[str, str] = field(default_factory=dict)
+    # conjuncts pushed down to the scan (evaluated fused with the read)
+    filter: Optional[BExpr] = None
+    # computed columns added by the planner (e.g. remapped join keys)
+    computed: list[tuple[str, BExpr]] = field(default_factory=list)
+
+
+@dataclass
+class Filter(PlanNode):
+    child: PlanNode
+    pred: BExpr = None
+
+
+@dataclass
+class HashJoin(PlanNode):
+    left: PlanNode           # probe side
+    right: PlanNode          # build side (unique keys)
+    left_keys: list[str] = field(default_factory=list)
+    right_keys: list[str] = field(default_factory=list)
+    payload: list[str] = field(default_factory=list)  # build cols to carry
+    join_type: str = "inner"
+
+
+@dataclass
+class Project(PlanNode):
+    child: PlanNode
+    items: list[tuple[str, BExpr]] = field(default_factory=list)
+
+
+@dataclass
+class Aggregate(PlanNode):
+    child: PlanNode
+    group_by: list[tuple[str, BExpr]] = field(default_factory=list)
+    aggs: list[BoundAgg] = field(default_factory=list)
+    having: Optional[BExpr] = None  # over BAggRef/group columns
+    # output projections over group cols + agg refs
+    items: list[tuple[str, BExpr]] = field(default_factory=list)
+    max_groups: int = 0  # static bound if known (dict-encoded keys), else 0
+    # per-key code-space sizes when max_groups > 0 (dense segment-sum
+    # strategy: gid = mixed-radix code over these dims, +1 slot per dim
+    # for NULL); empty when the hash-table strategy is required
+    group_dims: list[int] = field(default_factory=list)
+
+
+@dataclass
+class Sort(PlanNode):
+    child: PlanNode
+    keys: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+
+
+@dataclass
+class Limit(PlanNode):
+    child: PlanNode
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass
+class OutputMeta:
+    """Result schema: names + types (+ dictionaries for decode)."""
+    names: list[str] = field(default_factory=list)
+    types: list[SQLType] = field(default_factory=list)
+    dictionaries: dict[str, object] = field(default_factory=dict)
+
+
+def plan_tree_repr(node: PlanNode, indent: int = 0) -> str:
+    pad = "  " * indent
+    if isinstance(node, Scan):
+        f = f" filter={node.filter!r}" if node.filter is not None else ""
+        return f"{pad}Scan {node.table} as {node.alias}{f}\n"
+    if isinstance(node, Filter):
+        return (f"{pad}Filter {node.pred!r}\n"
+                + plan_tree_repr(node.child, indent + 1))
+    if isinstance(node, HashJoin):
+        return (f"{pad}HashJoin[{node.join_type}] "
+                f"{node.left_keys}={node.right_keys}\n"
+                + plan_tree_repr(node.left, indent + 1)
+                + plan_tree_repr(node.right, indent + 1))
+    if isinstance(node, Project):
+        return (f"{pad}Project {[n for n, _ in node.items]}\n"
+                + plan_tree_repr(node.child, indent + 1))
+    if isinstance(node, Aggregate):
+        return (f"{pad}Aggregate groups={[n for n, _ in node.group_by]} "
+                f"aggs={[a.func for a in node.aggs]}\n"
+                + plan_tree_repr(node.child, indent + 1))
+    if isinstance(node, Sort):
+        return f"{pad}Sort {node.keys}\n" + plan_tree_repr(node.child, indent + 1)
+    if isinstance(node, Limit):
+        return (f"{pad}Limit {node.limit} offset {node.offset}\n"
+                + plan_tree_repr(node.child, indent + 1))
+    return f"{pad}{node!r}\n"

@@ -1,0 +1,438 @@
+"""Heuristic logical planner: Select AST -> plan tree.
+
+The reference runs a full cost-based optimizer (pkg/sql/opt: memo +
+norm/xform rules); per SURVEY.md §7 step 7 we start heuristic:
+
+- scans for each FROM table, filters split into conjuncts;
+- equality conjuncts between two tables become hash joins (left-deep,
+  in FROM order; the syntactically-later / ON-right table is the build
+  side, so dimension tables join PK-side as in TPC-H/SSB);
+- single-table conjuncts push down into the scan (fused with the MVCC
+  visibility mask on device);
+- aggregates extracted from SELECT/HAVING into an Aggregate node with
+  post-projection expressions (BAggRef), mirroring how the reference's
+  DistAggregationTable renders final AVG as SUM/COUNT;
+- ORDER BY/LIMIT on top.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import ast, plan
+from .binder import Binder, BindError, ColumnBinding, Scope
+from .bound import (BAggRef, BBin, BCol, BDictRemap, BExpr,
+                    referenced_columns, walk)
+from .types import Family, TableSchema
+
+
+class PlanError(Exception):
+    pass
+
+
+@dataclass
+class CatalogView:
+    """What the planner needs from the catalog: schema + dictionaries."""
+    schemas: dict[str, TableSchema]
+    dictionaries: dict[str, dict[str, object]]  # table -> col -> Dictionary
+
+    def schema(self, name: str) -> TableSchema:
+        s = self.schemas.get(name)
+        if s is None:
+            raise PlanError(f"table {name!r} does not exist")
+        return s
+
+
+def split_conjuncts(e: BExpr) -> list[BExpr]:
+    if isinstance(e, BBin) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def and_all(conjuncts: list[BExpr]) -> BExpr:
+    out = conjuncts[0]
+    from .types import BOOL
+    for c in conjuncts[1:]:
+        out = BBin("and", out, c, BOOL)
+    return out
+
+
+class Planner:
+    def __init__(self, catalog: CatalogView):
+        self.catalog = catalog
+
+    def plan_select(self, sel: ast.Select) -> tuple[plan.PlanNode, plan.OutputMeta]:
+        if sel.table is None:
+            raise PlanError("SELECT without FROM not supported")
+
+        # ---- scopes & scans -------------------------------------------------
+        scope = Scope()
+        tables: list[tuple[str, str]] = []  # (alias, table_name)
+        scans: dict[str, plan.Scan] = {}
+        join_specs: list[ast.JoinClause] = list(sel.joins)
+
+        def add_table(tref: ast.TableRef):
+            alias = tref.alias or tref.name
+            schema = self.catalog.schema(tref.name)
+            dicts = self.catalog.dictionaries.get(tref.name, {})
+            cols = {}
+            colmap = {}
+            for c in schema.columns:
+                bname = f"{alias}.{c.name}"
+                cols[c.name] = ColumnBinding(bname, c.type, dicts.get(c.name))
+                colmap[bname] = c.name
+            scope.add_table(alias, cols)
+            tables.append((alias, tref.name))
+            scans[alias] = plan.Scan(tref.name, alias, colmap)
+
+        add_table(sel.table)
+        for j in join_specs:
+            add_table(j.table)
+
+        binder = Binder(scope)
+
+        # ---- gather predicates ---------------------------------------------
+        conjuncts: list[BExpr] = []
+        explicit_joins: list[tuple[str, str, BExpr]] = []  # (alias, type, on)
+        for j in join_specs:
+            alias = j.table.alias or j.table.name
+            if j.on is not None:
+                explicit_joins.append((alias, j.join_type, binder.bind(j.on)))
+            else:
+                explicit_joins.append((alias, j.join_type, None))
+        if sel.where is not None:
+            conjuncts.extend(split_conjuncts(binder.bind(sel.where)))
+
+        alias_of_col: dict[str, str] = {}
+        for alias, _ in tables:
+            for b in scope.tables[alias].values():
+                alias_of_col[b.batch_name] = alias
+
+        def tables_of(e: BExpr) -> set[str]:
+            return {alias_of_col[c] for c in referenced_columns(e)}
+
+        # ---- assemble join tree --------------------------------------------
+        # Left-deep: first table is the running probe side; each joined
+        # table is a build side with equality keys from ON + WHERE.
+        joined = {tables[0][0]}
+        node: plan.PlanNode = scans[tables[0][0]]
+        remaining_conjuncts = list(conjuncts)
+
+        jk_counter = [0]
+
+        def _key_side(e: BExpr):
+            """(alias, batch column name or None-if-computed, expr)."""
+            if isinstance(e, BCol):
+                return alias_of_col[e.name], e.name, None
+            if isinstance(e, BDictRemap) and isinstance(e.expr, BCol):
+                return alias_of_col[e.expr.name], None, e
+            return None, None, None
+
+        def _key_name(alias: str, name, expr) -> str:
+            if name is not None:
+                return name
+            # computed join key (e.g. dictionary-code remap): evaluate it
+            # in the owning scan
+            kname = f"__jk{jk_counter[0]}"
+            jk_counter[0] += 1
+            scans[alias].computed.append((kname, expr))
+            return kname
+
+        def extract_equi_keys(pool: list[BExpr], left_tables: set[str],
+                              right: str):
+            lk, rk, used = [], [], []
+            for c in pool:
+                if not (isinstance(c, BBin) and c.op == "="):
+                    continue
+                ta, na, ea = _key_side(c.left)
+                tb, nb, eb = _key_side(c.right)
+                if ta is None or tb is None:
+                    continue
+                if ta in left_tables and tb == right:
+                    lk.append(_key_name(ta, na, ea))
+                    rk.append(_key_name(tb, nb, eb))
+                    used.append(c)
+                elif tb in left_tables and ta == right:
+                    lk.append(_key_name(tb, nb, eb))
+                    rk.append(_key_name(ta, na, ea))
+                    used.append(c)
+            return lk, rk, used
+
+        ordered = []  # (alias, join_type, on_conjuncts)
+        for alias, jt, on in explicit_joins:
+            ordered.append((alias, jt, split_conjuncts(on) if on is not None else []))
+
+        for alias, jt, on_conj in ordered:
+            # LEFT JOIN must not consume WHERE conjuncts as join keys —
+            # ON and WHERE have different outer-join semantics
+            pool = on_conj + (remaining_conjuncts if jt != "left" else [])
+            lk, rk, used = extract_equi_keys(pool, joined, alias)
+            if lk and jt == "cross":
+                # comma-join with equality predicates in WHERE -> hash join
+                jt = "inner"
+            if not lk:
+                raise PlanError(
+                    f"no equality join condition for {alias} "
+                    "(cartesian products unsupported)")
+            for u in used:
+                if u in remaining_conjuncts:
+                    remaining_conjuncts.remove(u)
+            residual = [c for c in on_conj if c not in used]
+            build = scans[alias]
+            build_local = []
+            if jt == "left":
+                # residual ON conjuncts on the build side filter which
+                # rows can MATCH (NULL-extension still happens) — push
+                # into the build scan; cross-side residuals would need
+                # per-pair evaluation inside the join
+                both_sided = [c for c in residual if tables_of(c) != {alias}]
+                if both_sided:
+                    raise PlanError(
+                        "LEFT JOIN ON conditions across both sides "
+                        "(beyond equality keys) not supported yet")
+                build_local = residual
+                residual = []
+            # build-side single-table WHERE conjuncts push into the build
+            # scan (for LEFT joins, WHERE stays above the join: filtering
+            # the build scan would wrongly null-extend filtered matches)
+            if jt != "left":
+                wl = [c for c in remaining_conjuncts
+                      if tables_of(c) == {alias}]
+                for c in wl:
+                    remaining_conjuncts.remove(c)
+                build_local += wl
+            if build_local:
+                build.filter = and_all(
+                    ([build.filter] if build.filter is not None else [])
+                    + build_local)
+            payload = [b.batch_name for b in scope.tables[alias].values()]
+            node = plan.HashJoin(node, build, lk, rk, payload, jt)
+            joined.add(alias)
+            # residual ON conjuncts of inner joins are plain filters
+            remaining_conjuncts.extend(residual)
+
+        # remaining single-table conjuncts on the probe root push into scan
+        root_alias = tables[0][0]
+        root_local = [c for c in remaining_conjuncts
+                      if tables_of(c) <= {root_alias}]
+        for c in root_local:
+            remaining_conjuncts.remove(c)
+        if root_local:
+            scans[root_alias].filter = and_all(
+                ([scans[root_alias].filter] if scans[root_alias].filter
+                 is not None else []) + root_local)
+        if remaining_conjuncts:
+            node = plan.Filter(node, and_all(remaining_conjuncts))
+
+        # ---- SELECT items & aggregation ------------------------------------
+        has_group = bool(sel.group_by)
+        # expand stars; disambiguate duplicate output names (the batch is
+        # name-keyed, so two items named "sum" would silently collapse)
+        items: list[tuple[str, ast.Expr]] = []
+        seen_names: dict[str, int] = {}
+
+        def uniq(name: str) -> str:
+            k = seen_names.get(name, 0)
+            seen_names[name] = k + 1
+            return name if k == 0 else f"{name}_{k}"
+
+        for it in sel.items:
+            if it.star:
+                for alias, _ in tables:
+                    for colname, b in scope.tables[alias].items():
+                        items.append((uniq(colname),
+                                      ast.ColumnRef(colname, alias)))
+            else:
+                name = it.alias or _default_name(it.expr)
+                items.append((uniq(name), it.expr))
+
+        group_exprs: list[tuple[str, BExpr]] = []
+        if has_group:
+            for i, g in enumerate(sel.group_by):
+                # allow GROUP BY <position> and GROUP BY <alias>
+                if isinstance(g, ast.Literal) and isinstance(g.value, int):
+                    name, expr = items[g.value - 1]
+                    bexpr = binder.bind(expr)
+                else:
+                    bexpr = binder.bind(g)
+                    name = _default_name(g)
+                group_exprs.append((f"g{i}:{name}", bexpr))
+
+        bound_items: list[tuple[str, BExpr]] = []
+        any_agg = False
+        for name, expr in items:
+            b = binder.bind_with_aggs(expr)
+            bound_items.append((name, b))
+            if any(isinstance(n, BAggRef) for n in walk(b)):
+                any_agg = True
+
+        having_b = None
+        if sel.having is not None:
+            having_b = binder.bind_with_aggs(sel.having)
+
+        meta = plan.OutputMeta()
+
+        if has_group or binder.aggs:
+            # rewrite grouped output exprs: replace group-expr occurrences
+            # with group column refs
+            rewritten = []
+            for name, b in bound_items:
+                rewritten.append((name, _replace_group_refs(b, group_exprs)))
+            if having_b is not None:
+                having_b = _replace_group_refs(having_b, group_exprs)
+            for name, b in rewritten:
+                _check_agg_valid(b, group_exprs)
+            max_groups, dims = self._static_group_bound(group_exprs, scope)
+            node = plan.Aggregate(node, group_exprs, binder.aggs,
+                                  having_b, rewritten, max_groups, dims)
+            out_names = [n for n, _ in rewritten]
+            out_types = [b.type for _, b in rewritten]
+        elif sel.distinct:
+            node = plan.Project(node, bound_items)
+            group_exprs = [(n, BCol(n, b.type)) for n, b in bound_items]
+            dmax, ddims = self._static_group_bound(group_exprs, scope)
+            node = plan.Aggregate(node, group_exprs, [], None,
+                                  [(n, BCol(g, b.type))
+                                   for (n, b), (g, _) in
+                                   zip(bound_items, group_exprs)],
+                                  dmax, ddims)
+            out_names = [n for n, _ in bound_items]
+            out_types = [b.type for _, b in bound_items]
+        else:
+            node = plan.Project(node, bound_items)
+            out_names = [n for n, _ in bound_items]
+            out_types = [b.type for _, b in bound_items]
+
+        # ---- ORDER BY / LIMIT ----------------------------------------------
+        if sel.order_by:
+            keys = []
+            grouped = has_group or bool(binder.aggs)
+            for i, ob in enumerate(sel.order_by):
+                if isinstance(ob.expr, ast.Literal) and isinstance(ob.expr.value, int):
+                    keys.append((out_names[ob.expr.value - 1], ob.desc))
+                elif isinstance(ob.expr, ast.ColumnRef) \
+                        and ob.expr.name in out_names:
+                    keys.append((ob.expr.name, ob.desc))
+                elif not grouped and not sel.distinct \
+                        and isinstance(node, plan.Project):
+                    # hidden sort column (ordering by a non-output expr)
+                    b = binder.bind(ob.expr)
+                    hname = f"__ord{i}"
+                    node.items.append((hname, b))
+                    keys.append((hname, ob.desc))
+                else:
+                    raise PlanError("ORDER BY must reference output columns")
+            node = plan.Sort(node, keys)
+        if sel.limit is not None or sel.offset is not None:
+            node = plan.Limit(node, sel.limit, sel.offset or 0)
+
+        meta.names = out_names
+        meta.types = out_types
+        # attach dictionaries for string outputs
+        for name, ty in zip(out_names, out_types):
+            if ty.family == Family.STRING:
+                d = self._find_dict_for_output(name, bound_items, group_exprs,
+                                               scope, node)
+                if d is not None:
+                    meta.dictionaries[name] = d
+        return node, meta
+
+    def _static_group_bound(self, group_exprs, scope: Scope):
+        """If every group key is a dict-encoded column or bool, the group
+        count is bounded by the product of dictionary sizes — the planner
+        can then use dense codes + segment_sum with a static size (TPC-H
+        Q1: 4). Returns (bound, dims); bound 0 when unbounded. Each dim
+        gets one extra NULL slot at compile time."""
+        bound = 1
+        dims = []
+        for _, e in group_exprs:
+            if isinstance(e, BCol) and e.type.family == Family.STRING:
+                d = self._dict_by_batch_name(e.name, scope)
+                if d is None:
+                    return 0, []
+                dims.append(max(len(d), 1))
+            elif isinstance(e, BCol) and e.type.family == Family.BOOL:
+                dims.append(2)
+            else:
+                return 0, []
+            bound *= dims[-1] + 1
+            if bound > 1 << 16:
+                return 0, []
+        return bound, dims
+
+    def _dict_by_batch_name(self, name, scope: Scope):
+        for t in scope.tables.values():
+            for b in t.values():
+                if b.batch_name == name:
+                    return b.dictionary
+        return None
+
+    def _find_dict_for_output(self, name, bound_items, group_exprs, scope, node):
+        for n, b in bound_items:
+            if n != name:
+                continue
+            d = getattr(b, "dictionary", None)  # ad-hoc (CASE constants)
+            if d is not None:
+                return d
+            if isinstance(b, BCol):
+                d = self._dict_by_batch_name(b.name, scope)
+                if d is not None:
+                    return d
+                # grouped output referencing a group column
+                for gn, ge in group_exprs:
+                    if b.name == gn and isinstance(ge, BCol):
+                        return self._dict_by_batch_name(ge.name, scope)
+        return None
+
+
+def _default_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.ColumnRef):
+        return e.name
+    if isinstance(e, ast.FuncCall):
+        return e.name
+    return "column"
+
+
+def _replace_group_refs(e: BExpr, group_exprs) -> BExpr:
+    """Replace occurrences of a group expression with a ref to the group
+    output column (so post-agg projection sees [G]-shaped arrays)."""
+    for gname, gexpr in group_exprs:
+        if repr(e) == repr(gexpr):
+            return BCol(gname, gexpr.type)
+    # recurse
+    import copy
+    e2 = copy.copy(e)
+    from .bound import (BBetween, BCase, BCast, BCoalesce, BDictLookup,
+                        BExtract, BInList, BIsNull, BUnary)
+    if isinstance(e2, BBin):
+        e2.left = _replace_group_refs(e2.left, group_exprs)
+        e2.right = _replace_group_refs(e2.right, group_exprs)
+    elif isinstance(e2, BUnary):
+        e2.operand = _replace_group_refs(e2.operand, group_exprs)
+    elif isinstance(e2, BBetween):
+        e2.expr = _replace_group_refs(e2.expr, group_exprs)
+        e2.lo = _replace_group_refs(e2.lo, group_exprs)
+        e2.hi = _replace_group_refs(e2.hi, group_exprs)
+    elif isinstance(e2, (BInList, BIsNull, BCast, BDictLookup, BDictRemap)):
+        e2.expr = _replace_group_refs(e2.expr, group_exprs)
+    elif isinstance(e2, BExtract):
+        e2.expr = _replace_group_refs(e2.expr, group_exprs)
+    elif isinstance(e2, BCase):
+        e2.whens = [(_replace_group_refs(c, group_exprs),
+                     _replace_group_refs(v, group_exprs))
+                    for c, v in e2.whens]
+        if e2.else_ is not None:
+            e2.else_ = _replace_group_refs(e2.else_, group_exprs)
+    elif isinstance(e2, BCoalesce):
+        e2.args = [_replace_group_refs(a, group_exprs) for a in e2.args]
+    return e2
+
+
+def _check_agg_valid(e: BExpr, group_exprs) -> None:
+    """Every column in a grouped output must be a group col or inside an
+    aggregate (the binder already folded aggregates into BAggRef)."""
+    gnames = {n for n, _ in group_exprs}
+    for n in walk(e):
+        if isinstance(n, BCol) and n.name not in gnames:
+            raise PlanError(
+                f"column {n.name!r} must appear in GROUP BY or an aggregate")

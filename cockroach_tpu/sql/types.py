@@ -1,0 +1,211 @@
+"""SQL type system, mapped to TPU-friendly physical representations.
+
+The reference models SQL types in ``pkg/sql/types`` (oid-compatible
+``types.T``) and stores columnar data in per-type Go slices
+(``pkg/col/coldata/native_types.go``). TPUs have no decimal or string
+units, so every SQL type here is lowered to a fixed-width numeric
+*physical* representation that XLA can tile onto the VPU/MXU:
+
+  BOOL       -> bool_
+  INT2/4/8   -> int32 / int64
+  FLOAT8     -> float64 (float32 on request)
+  DECIMAL    -> scaled int64 fixed-point (value * 10**scale); the
+                reference stores apd.Decimal structs per element and
+                monomorphizes decimal kernels (coldata/native_types.go:33);
+                we instead pick a scale at ingest and do integer math.
+  DATE       -> int32 days since unix epoch
+  TIMESTAMP  -> int64 microseconds since unix epoch
+  STRING     -> int32 dictionary code (dictionary lives host-side) for
+                low-cardinality columns; general strings use a flat
+                (offsets:int32, data:uint8) arena like coldata.Bytes
+                (pkg/col/coldata/bytes.go).
+  INTERVAL   -> int64 microseconds
+
+NULLs are carried as a separate validity bitmap per column (True=valid),
+matching coldata's Nulls (pkg/col/coldata/nulls.go) and Arrow.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class Family(enum.Enum):
+    BOOL = "bool"
+    INT = "int"
+    FLOAT = "float"
+    DECIMAL = "decimal"
+    DATE = "date"
+    TIMESTAMP = "timestamp"
+    INTERVAL = "interval"
+    STRING = "string"
+    BYTES = "bytes"
+    UNKNOWN = "unknown"  # NULL literal before type inference
+
+
+@dataclass(frozen=True)
+class SQLType:
+    family: Family
+    width: int = 64  # bits for INT/FLOAT
+    precision: int = 0  # DECIMAL precision
+    scale: int = 0  # DECIMAL scale (digits after point)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def bool_() -> "SQLType":
+        return SQLType(Family.BOOL)
+
+    @staticmethod
+    def int_(width: int = 64) -> "SQLType":
+        return SQLType(Family.INT, width=width)
+
+    @staticmethod
+    def float_(width: int = 64) -> "SQLType":
+        return SQLType(Family.FLOAT, width=width)
+
+    @staticmethod
+    def decimal(precision: int = 19, scale: int = 2) -> "SQLType":
+        return SQLType(Family.DECIMAL, precision=precision, scale=scale)
+
+    @staticmethod
+    def date() -> "SQLType":
+        return SQLType(Family.DATE, width=32)
+
+    @staticmethod
+    def timestamp() -> "SQLType":
+        return SQLType(Family.TIMESTAMP)
+
+    @staticmethod
+    def interval() -> "SQLType":
+        return SQLType(Family.INTERVAL)
+
+    @staticmethod
+    def string() -> "SQLType":
+        return SQLType(Family.STRING, width=32)
+
+    @staticmethod
+    def bytes_() -> "SQLType":
+        return SQLType(Family.BYTES)
+
+    @staticmethod
+    def unknown() -> "SQLType":
+        return SQLType(Family.UNKNOWN)
+
+    # -- physical lowering -------------------------------------------------
+    @property
+    def np_dtype(self) -> np.dtype:
+        f = self.family
+        if f == Family.BOOL:
+            return np.dtype(np.bool_)
+        if f == Family.INT:
+            return np.dtype(np.int32) if self.width <= 32 else np.dtype(np.int64)
+        if f == Family.FLOAT:
+            return np.dtype(np.float32) if self.width <= 32 else np.dtype(np.float64)
+        if f == Family.DECIMAL:
+            return np.dtype(np.int64)
+        if f == Family.DATE:
+            return np.dtype(np.int32)
+        if f in (Family.TIMESTAMP, Family.INTERVAL):
+            return np.dtype(np.int64)
+        if f == Family.STRING:
+            return np.dtype(np.int32)  # dictionary code
+        if f == Family.BYTES:
+            return np.dtype(np.uint8)  # arena bytes
+        if f == Family.UNKNOWN:
+            return np.dtype(np.int32)
+        raise TypeError(f"no physical dtype for {self}")
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.family in (Family.INT, Family.FLOAT, Family.DECIMAL)
+
+    @property
+    def is_orderable(self) -> bool:
+        return self.family != Family.BYTES
+
+    def __str__(self) -> str:
+        f = self.family
+        if f == Family.INT:
+            return f"INT{self.width // 8}"
+        if f == Family.FLOAT:
+            return "FLOAT4" if self.width <= 32 else "FLOAT8"
+        if f == Family.DECIMAL:
+            return f"DECIMAL({self.precision},{self.scale})"
+        return f.name
+
+
+# Canonical instances
+BOOL = SQLType.bool_()
+INT2 = SQLType.int_(16)
+INT4 = SQLType.int_(32)
+INT8 = SQLType.int_(64)
+FLOAT4 = SQLType.float_(32)
+FLOAT8 = SQLType.float_(64)
+DATE = SQLType.date()
+TIMESTAMP = SQLType.timestamp()
+INTERVAL = SQLType.interval()
+STRING = SQLType.string()
+BYTES = SQLType.bytes_()
+UNKNOWN = SQLType.unknown()
+
+
+def common_numeric_type(a: SQLType, b: SQLType) -> SQLType:
+    """Binary-op result-type resolution (a tiny version of the reference's
+    cast matrix in pkg/sql/sem/cast)."""
+    if a.family == Family.UNKNOWN:
+        return b
+    if b.family == Family.UNKNOWN:
+        return a
+    fams = {a.family, b.family}
+    if Family.FLOAT in fams:
+        return FLOAT8
+    if Family.DECIMAL in fams:
+        scale = max(a.scale if a.family == Family.DECIMAL else 0,
+                    b.scale if b.family == Family.DECIMAL else 0)
+        return SQLType.decimal(scale=scale)
+    if fams == {Family.INT}:
+        return SQLType.int_(max(a.width, b.width))
+    if Family.DATE in fams and Family.INT in fams:
+        return DATE  # date +/- int days
+    if Family.TIMESTAMP in fams and Family.INTERVAL in fams:
+        return TIMESTAMP
+    if len(fams) == 1:
+        return a
+    raise TypeError(f"incompatible types {a} and {b}")
+
+
+@dataclass
+class ColumnSchema:
+    name: str
+    type: SQLType
+    nullable: bool = True
+    # For STRING columns: dictionary values (host-side); code i -> dictionary[i].
+    dictionary: Optional[list] = None
+
+
+@dataclass
+class TableSchema:
+    name: str
+    columns: list[ColumnSchema] = field(default_factory=list)
+    primary_key: list[str] = field(default_factory=list)
+    table_id: int = 0
+
+    def column(self, name: str) -> ColumnSchema:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"column {name!r} not in table {self.name!r}")
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"column {name!r} not in table {self.name!r}")
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]

@@ -1,0 +1,304 @@
+"""Host columnar MVCC store — the table-data plane feeding the TPU.
+
+Design rationale (SURVEY.md §7 step 3 + "Host↔HBM feed rate"): the
+reference stores SQL rows as KV pairs and pays a per-row decode
+(cFetcher, pkg/sql/colfetcher/cfetcher.go) on every scan; its own
+direct-columnar-scan work (pkg/storage/col_mvcc.go:37-64) moves that
+decode server-side to skip a network hop. We go one step further and
+keep the *primary* analytic representation columnar: each table is a
+list of immutable column chunks (numpy arrays + validity), with MVCC
+visibility as two int64 timestamp columns per chunk:
+
+    _mvcc_ts   — commit timestamp of the row version (Timestamp.to_int)
+    _mvcc_del  — deletion timestamp (MAX if live)
+
+A scan AS OF timestamp T selects ``_mvcc_ts <= T < _mvcc_del`` — a pure
+mask kernel that runs on device beside the WHERE clause, so MVCC
+visibility filtering costs one compare+and per row (SURVEY.md §7
+"MVCC visibility filtering on device": resolved in favor of on-device).
+
+Updates/deletes write tombstones (set _mvcc_del) and appended new
+versions; chunks are sealed at `chunk_rows` and never mutated except
+for the deletion column, mirroring LSM immutability. String columns
+are dictionary-encoded at ingest (codes on device, dictionary on
+host). Point reads and the write path go through the row-oriented KV
+layer (storage/memtable.py, kv/); this module is the scan plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..sql.types import ColumnSchema, Family, TableSchema
+from .hlc import MAX_TIMESTAMP, Timestamp
+
+MAX_TS_INT = MAX_TIMESTAMP.to_int()
+
+
+class Dictionary:
+    """Growable string dictionary: value <-> int32 code."""
+
+    def __init__(self):
+        self.values: list[str] = []
+        self.codes: dict[str, int] = {}
+
+    def encode(self, v: str) -> int:
+        c = self.codes.get(v)
+        if c is None:
+            c = len(self.values)
+            self.values.append(v)
+            self.codes[v] = c
+        return c
+
+    def encode_array(self, vals) -> np.ndarray:
+        arr = np.asarray(vals)
+        if arr.shape[0] > 4096:
+            # bulk path: unique once, then one gather (600M-row ingest
+            # must not loop per value)
+            uniq, inv = np.unique(arr.astype(str), return_inverse=True)
+            lut = np.fromiter((self.encode(u) for u in uniq),
+                              dtype=np.int32, count=len(uniq))
+            return lut[inv].astype(np.int32)
+        return np.fromiter((self.encode(v) for v in arr),
+                           dtype=np.int32, count=len(arr))
+
+    def decode_array(self, codes: np.ndarray) -> np.ndarray:
+        arr = np.asarray(self.values, dtype=object)
+        return arr[codes]
+
+    def __len__(self):
+        return len(self.values)
+
+
+@dataclass
+class Chunk:
+    """Immutable columnar slab (the storage analogue of an SSTable)."""
+    data: dict[str, np.ndarray]
+    valid: dict[str, np.ndarray]
+    mvcc_ts: np.ndarray   # int64 creation timestamps
+    mvcc_del: np.ndarray  # int64 deletion timestamps (MAX_TS_INT = live)
+    n: int
+
+    def live_mask(self, ts: int) -> np.ndarray:
+        return (self.mvcc_ts <= ts) & (ts < self.mvcc_del)
+
+
+@dataclass
+class TableData:
+    schema: TableSchema
+    dictionaries: dict[str, Dictionary] = field(default_factory=dict)
+    chunks: list[Chunk] = field(default_factory=list)
+    open_rows: dict[str, list] = field(default_factory=dict)  # building chunk
+    open_ts: list = field(default_factory=list)
+    chunk_rows: int = 1 << 20
+    # generation bumps on every mutation; device caches key on it
+    generation: int = 0
+
+    @property
+    def row_count(self) -> int:
+        return sum(c.n for c in self.chunks) + len(self.open_ts)
+
+
+class ColumnStore:
+    """All tables of one store (one node's data plane)."""
+
+    def __init__(self, chunk_rows: int = 1 << 20):
+        self._lock = threading.RLock()
+        self.tables: dict[str, TableData] = {}
+        self.chunk_rows = chunk_rows
+
+    # -- DDL ---------------------------------------------------------------
+    def create_table(self, schema: TableSchema) -> TableData:
+        with self._lock:
+            if schema.name in self.tables:
+                raise ValueError(f"table {schema.name!r} exists")
+            td = TableData(schema=schema, chunk_rows=self.chunk_rows)
+            for col in schema.columns:
+                if col.type.family == Family.STRING:
+                    td.dictionaries[col.name] = Dictionary()
+                td.open_rows[col.name] = []
+            self.tables[schema.name] = td
+            return td
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            del self.tables[name]
+
+    def table(self, name: str) -> TableData:
+        td = self.tables.get(name)
+        if td is None:
+            raise KeyError(f"table {name!r} does not exist")
+        return td
+
+    # -- ingest ------------------------------------------------------------
+    def insert_columns(self, name: str, cols: dict[str, np.ndarray],
+                       ts: Timestamp,
+                       valid: Optional[dict[str, np.ndarray]] = None) -> int:
+        """Bulk columnar ingest (IMPORT path; one sealed chunk per call,
+        the analogue of AddSSTable ingestion in pkg/sql/importer)."""
+        td = self.table(name)
+        valid = valid or {}
+        n = len(next(iter(cols.values())))
+        data: dict[str, np.ndarray] = {}
+        vmap: dict[str, np.ndarray] = {}
+        with self._lock:
+            for col in td.schema.columns:
+                cn = col.name
+                if cn not in cols:
+                    if not col.nullable:
+                        raise ValueError(f"missing non-null column {cn}")
+                    data[cn] = np.zeros(n, dtype=col.type.np_dtype)
+                    vmap[cn] = np.zeros(n, dtype=bool)
+                    continue
+                raw = cols[cn]
+                if col.type.family == Family.STRING and raw.dtype.kind in ("U", "O", "S"):
+                    arr = td.dictionaries[cn].encode_array(raw)
+                elif col.type.family == Family.DECIMAL and raw.dtype.kind == "f":
+                    arr = np.round(raw * (10 ** col.type.scale)).astype(np.int64)
+                else:
+                    arr = np.asarray(raw, dtype=col.type.np_dtype)
+                data[cn] = arr
+                vmap[cn] = (np.asarray(valid[cn], dtype=bool) if cn in valid
+                            else np.ones(n, dtype=bool))
+            tsi = ts.to_int()
+            chunk = Chunk(data=data, valid=vmap,
+                          mvcc_ts=np.full(n, tsi, dtype=np.int64),
+                          mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64),
+                          n=n)
+            td.chunks.append(chunk)
+            td.generation += 1
+        return n
+
+    def insert_rows(self, name: str, rows: list[dict], ts: Timestamp) -> int:
+        """Row-at-a-time insert (INSERT VALUES path): buffers into the
+        open chunk, sealing at chunk_rows."""
+        td = self.table(name)
+        with self._lock:
+            tsi = ts.to_int()
+            for row in rows:
+                for col in td.schema.columns:
+                    td.open_rows[col.name].append(row.get(col.name))
+                td.open_ts.append(tsi)
+            td.generation += 1
+            if len(td.open_ts) >= td.chunk_rows:
+                self._seal_locked(td)
+        return len(rows)
+
+    def _seal_locked(self, td: TableData) -> None:
+        if not td.open_ts:
+            return
+        n = len(td.open_ts)
+        data, vmap = {}, {}
+        for col in td.schema.columns:
+            vals = td.open_rows[col.name]
+            v = np.array([x is not None for x in vals], dtype=bool)
+            if col.type.family == Family.STRING:
+                d = td.dictionaries[col.name]
+                arr = np.fromiter(
+                    (d.encode(x) if x is not None else 0 for x in vals),
+                    dtype=np.int32, count=n)
+            elif col.type.family == Family.DECIMAL:
+                # ints are already-scaled physical values (binder output);
+                # floats are logical and get scaled here (bulk loaders)
+                scale = 10 ** col.type.scale
+                arr = np.fromiter(
+                    (0 if x is None else
+                     x if isinstance(x, (int, np.integer)) else
+                     int(round(float(x) * scale))
+                     for x in vals),
+                    dtype=np.int64, count=n)
+            else:
+                arr = np.array([x if x is not None else 0 for x in vals],
+                               dtype=col.type.np_dtype)
+            data[col.name] = arr
+            vmap[col.name] = v
+            td.open_rows[col.name] = []
+        td.chunks.append(Chunk(
+            data=data, valid=vmap,
+            mvcc_ts=np.asarray(td.open_ts, dtype=np.int64),
+            mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n))
+        td.open_ts = []
+
+    def seal(self, name: str) -> None:
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            td.generation += 1
+
+    # -- mutation (tombstones + new versions) -------------------------------
+    def delete_where(self, name: str, pred, ts: Timestamp) -> int:
+        """Tombstone rows matching pred(chunk)->bool mask, visible as of
+        ts (MVCC: set deletion timestamp; old readers still see them)."""
+        td = self.table(name)
+        tsi = ts.to_int()
+        deleted = 0
+        with self._lock:
+            self._seal_locked(td)
+            for chunk in td.chunks:
+                mask = chunk.live_mask(tsi) & pred(chunk)
+                chunk.mvcc_del[mask] = tsi
+                deleted += int(mask.sum())
+            td.generation += 1
+        return deleted
+
+    def update_where(self, name: str, pred, assign, ts: Timestamp) -> int:
+        """MVCC update = tombstone old version + append new version.
+        assign(chunk, mask) -> (data_cols, valid_cols) for the new
+        versions of the masked rows."""
+        td = self.table(name)
+        tsi = ts.to_int()
+        updated = 0
+        with self._lock:
+            self._seal_locked(td)
+            new_rows = []
+            for chunk in td.chunks:
+                mask = chunk.live_mask(tsi) & pred(chunk)
+                cnt = int(mask.sum())
+                if cnt == 0:
+                    continue
+                chunk.mvcc_del[mask] = tsi
+                new_rows.append(assign(chunk, mask))
+                updated += cnt
+            for data, vmap in new_rows:
+                n = len(next(iter(data.values())))
+                td.chunks.append(Chunk(
+                    data={k: np.asarray(v) for k, v in data.items()},
+                    valid={k: np.asarray(v, dtype=bool)
+                           for k, v in vmap.items()},
+                    mvcc_ts=np.full(n, tsi, dtype=np.int64),
+                    mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n))
+            td.generation += 1
+        return updated
+
+    # -- GC ------------------------------------------------------------------
+    def gc(self, name: str, threshold: Timestamp) -> int:
+        """Drop row versions deleted before `threshold` (the analogue of
+        the MVCC GC queue, kvserver/mvcc_gc_queue.go)."""
+        td = self.table(name)
+        ti = threshold.to_int()
+        removed = 0
+        with self._lock:
+            new_chunks = []
+            for chunk in td.chunks:
+                keep = chunk.mvcc_del > ti
+                drop = int((~keep).sum())
+                if drop == 0:
+                    new_chunks.append(chunk)
+                    continue
+                removed += drop
+                if keep.any():
+                    new_chunks.append(Chunk(
+                        data={k: v[keep] for k, v in chunk.data.items()},
+                        valid={k: v[keep] for k, v in chunk.valid.items()},
+                        mvcc_ts=chunk.mvcc_ts[keep],
+                        mvcc_del=chunk.mvcc_del[keep],
+                        n=int(keep.sum())))
+            td.chunks = new_chunks
+            td.generation += 1
+        return removed
+
+

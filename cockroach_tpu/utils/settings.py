@@ -1,0 +1,137 @@
+"""Settings: the three config planes of the reference (SURVEY.md §5).
+
+1. Cluster settings (pkg/settings: typed, dynamic, `SET CLUSTER
+   SETTING`) -> ``Settings`` registry with typed registration and
+   update callbacks (gossip propagation arrives with the cluster
+   fabric).
+2. Session vars (pkg/sql/sessiondatapb, vars.go; the north-star gate
+   `SET vectorize=...` lives there) -> ``SessionVars``.
+3. Node config (CLI flags / base.Config) -> ``NodeConfig``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class SettingError(Exception):
+    pass
+
+
+@dataclass
+class _Setting:
+    name: str
+    default: object
+    kind: type
+    description: str = ""
+    validate: Optional[Callable[[object], None]] = None
+
+
+class Settings:
+    """Typed cluster-setting registry (cf. settings.RegisterBoolSetting,
+    pkg/settings/bool.go:107)."""
+
+    def __init__(self):
+        self._defs: dict[str, _Setting] = {}
+        self._values: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._watchers: list[Callable[[str, object], None]] = []
+        _register_builtins(self)
+
+    def register(self, name: str, default, kind: type, description: str = "",
+                 validate=None):
+        self._defs[name] = _Setting(name, default, kind, description, validate)
+
+    def set(self, name: str, value) -> None:
+        d = self._defs.get(name)
+        if d is None:
+            raise SettingError(f"unknown cluster setting {name!r}")
+        if d.kind is bool and isinstance(value, str):
+            value = value.lower() in ("true", "on", "1", "yes")
+        try:
+            value = d.kind(value)
+        except (TypeError, ValueError) as e:
+            raise SettingError(f"bad value for {name}: {value!r}") from e
+        if d.validate is not None:
+            d.validate(value)
+        with self._lock:
+            self._values[name] = value
+            watchers = list(self._watchers)
+        for w in watchers:
+            w(name, value)
+
+    def get(self, name: str):
+        d = self._defs.get(name)
+        if d is None:
+            raise SettingError(f"unknown cluster setting {name!r}")
+        with self._lock:
+            return self._values.get(name, d.default)
+
+    def on_change(self, fn: Callable[[str, object], None]):
+        self._watchers.append(fn)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {n: d.default for n, d in self._defs.items()}
+            out.update(self._values)
+            return out
+
+    def apply_snapshot(self, snap: dict) -> None:
+        """Adopt a gossiped snapshot from another node."""
+        for k, v in snap.items():
+            if k in self._defs:
+                with self._lock:
+                    self._values[k] = v
+
+
+def _pow2(v):
+    if v & (v - 1) != 0:
+        raise SettingError("must be a power of two")
+
+
+def _register_builtins(s: Settings):
+    s.register("version", "25.3-tpu.1", str, "cluster version gate")
+    s.register("sql.tpu.direct_columnar_scans.enabled", True, bool,
+               "serve scans straight from the columnar MVCC store "
+               "(cf. V23_1_KVDirectColumnarScans)")
+    s.register("sql.distsql.mesh_partitioning.enabled", True, bool,
+               "partition scan spans over the device mesh")
+    s.register("kv.range.max_bytes", 512 << 20, int,
+               "range split threshold (cf. 512MB default)")
+    s.register("kv.gc.ttl_seconds", 14400, int, "MVCC GC TTL")
+    s.register("sql.exec.hash_group_capacity", 1 << 17, int,
+               "device hash-table slots for GROUP BY", _pow2)
+
+
+@dataclass
+class SessionVars:
+    """Session variables with reference-compatible names where sensible."""
+    values: dict = field(default_factory=lambda: {
+        "vectorize": "on",           # on | off  (off = host row engine)
+        "distsql": "auto",           # auto | on | off | always
+        "direct_columnar_scans_enabled": True,
+        "hash_group_capacity": 1 << 17,
+        "application_name": "",
+        "database": "defaultdb",
+        "extra_float_digits": 0,
+        "statement_timeout": 0,
+    })
+
+    def set(self, name: str, value) -> None:
+        self.values[name] = value
+
+    def get(self, name: str, default=None):
+        return self.values.get(name, default)
+
+
+@dataclass
+class NodeConfig:
+    """Per-node boot config (cf. base.Config + CLI flags)."""
+    node_id: int = 1
+    addr: str = "127.0.0.1:26257"
+    http_addr: str = "127.0.0.1:8080"
+    store_dir: str = ""
+    join: list[str] = field(default_factory=list)
+    max_offset_ns: int = 500_000_000

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's `fakedist` logic-test configs
+(pkg/sql/logictest/logictestbase/logictestbase.go:270-460), which
+simulate multi-node distribution in one process via a fake span
+resolver — here, XLA's host-platform device-count flag gives us 8
+virtual devices so every sharding/collective path compiles and runs
+without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

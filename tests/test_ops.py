@@ -1,0 +1,210 @@
+"""Unit tests for the device columnar core (ops/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cockroach_tpu.ops import agg, hashtable, kernels
+from cockroach_tpu.ops.batch import ColumnBatch, concat, pad_to
+from cockroach_tpu.ops.join import hash_join
+
+
+def mk(vals, valid=None):
+    v = jnp.asarray(vals)
+    m = jnp.ones(v.shape, jnp.bool_) if valid is None else jnp.asarray(valid)
+    return (v, m)
+
+
+class TestKernels:
+    def test_arith_null_propagation(self):
+        a = mk([1, 2, 3], [True, False, True])
+        b = mk([10, 20, 30])
+        v, m = kernels.add(a, b)
+        assert v[0] == 11 and v[2] == 33
+        assert list(np.asarray(m)) == [True, False, True]
+
+    def test_div_by_zero_is_null(self):
+        v, m = kernels.div(mk([10.0, 4.0]), mk([2.0, 0.0]))
+        assert v[0] == 5.0
+        assert not bool(m[1])
+
+    def test_kleene_and(self):
+        # (TRUE, NULL, FALSE) x (TRUE, NULL, FALSE) truth table
+        t, n, f = (True, True), (False, False), (False, True)  # (val, valid)
+        vals = [t, n, f]
+        expect = {
+            (0, 0): (True, True), (0, 1): (None, False), (0, 2): (False, True),
+            (1, 0): (None, False), (1, 1): (None, False), (1, 2): (False, True),
+            (2, 0): (False, True), (2, 1): (False, True), (2, 2): (False, True),
+        }
+        for (i, j), (ev, em) in expect.items():
+            a = mk([vals[i][0]], [vals[i][1]])
+            b = mk([vals[j][0]], [vals[j][1]])
+            v, m = kernels.and_(a, b)
+            assert bool(m[0]) == em, (i, j)
+            if em:
+                assert bool(v[0]) == ev, (i, j)
+
+    def test_kleene_or(self):
+        # NULL OR TRUE = TRUE; NULL OR FALSE = NULL
+        v, m = kernels.or_(mk([False], [False]), mk([True]))
+        assert bool(m[0]) and bool(v[0])
+        v, m = kernels.or_(mk([False], [False]), mk([False]))
+        assert not bool(m[0])
+
+    def test_case_when(self):
+        c1 = mk([True, False, False])
+        c2 = mk([False, True, False])
+        out_v, out_m = kernels.case_when(
+            [(c1, mk([1, 1, 1])), (c2, mk([2, 2, 2]))], mk([9, 9, 9]))
+        assert list(np.asarray(out_v)) == [1, 2, 9]
+
+    def test_between_in(self):
+        v, m = kernels.between(mk([1, 5, 9]), mk([2, 2, 2]), mk([6, 6, 6]))
+        assert list(np.asarray(v)) == [False, True, False]
+        v, m = kernels.in_list(mk([1, 5, 9]), [5, 9])
+        assert list(np.asarray(v)) == [False, True, True]
+
+
+class TestBatch:
+    def test_roundtrip_and_filter(self):
+        b = ColumnBatch.from_dict({"a": jnp.arange(5), "b": jnp.arange(5) * 10})
+        b2 = b.and_sel(b.col("a") >= 2)
+        host = b2.to_host()
+        assert list(host["a"]) == [2, 3, 4]
+        assert list(host["b"]) == [20, 30, 40]
+
+    def test_with_column_replace(self):
+        b = ColumnBatch.from_dict({"a": jnp.arange(3)})
+        b = b.with_column("c", b.col("a") + 100)
+        b = b.with_column("c", b.col("c") + 1)
+        assert list(b.to_host()["c"]) == [101, 102, 103]
+
+    def test_pad_and_concat(self):
+        b = ColumnBatch.from_dict({"a": jnp.arange(3)})
+        p = pad_to(b, 8)
+        assert p.n == 8
+        assert int(p.sel.sum()) == 3
+        c = concat([b, b])
+        assert c.n == 6
+
+    def test_null_masking_to_host(self):
+        b = ColumnBatch.from_dict(
+            {"a": jnp.array([1, 2, 3])},
+            valid={"a": jnp.array([True, False, True])})
+        out = b.to_host()["a"]
+        assert bool(out.mask[1]) and not bool(out.mask[0])
+
+
+class TestAgg:
+    def test_masked_reductions(self):
+        d = jnp.array([1.0, 2.0, 3.0, 4.0])
+        m = jnp.array([True, False, True, True])
+        assert float(agg.masked_sum(d, m)) == 8.0
+        assert int(agg.masked_count(m)) == 3
+        assert float(agg.masked_min(d, m)) == 1.0
+        assert float(agg.masked_max(d, m)) == 4.0
+
+    def test_group_aggs(self):
+        d = jnp.array([1, 2, 3, 4, 5], dtype=jnp.int64)
+        g = jnp.array([0, 1, 0, 1, 2], dtype=jnp.int32)
+        m = jnp.array([True, True, True, True, False])
+        s = agg.group_sum(d, g, m, 4)
+        assert list(np.asarray(s))[:3] == [4, 6, 0]
+        c = agg.group_count(g, m, 4)
+        assert list(np.asarray(c))[:3] == [2, 2, 0]
+        mx = agg.group_max(d, g, m, 4)
+        assert int(mx[1]) == 4
+
+    def test_avg_decomposition(self):
+        spec = agg.AggSpec("avg", "x", "avg_x")
+        assert spec.local_funcs == ["sum", "count"]
+        assert spec.merge_ops == ["psum", "psum"]
+
+
+class TestHashTable:
+    def test_group_ids_dense(self):
+        keys = (jnp.array([7, 7, 3, 9, 3, 7], dtype=jnp.int64),)
+        mask = jnp.ones(6, jnp.bool_)
+        gid, ng, rep = hashtable.group_ids(keys, mask, 16)
+        gid = np.asarray(gid)
+        assert int(ng) == 3
+        # same key -> same gid, different key -> different gid
+        assert gid[0] == gid[1] == gid[5]
+        assert gid[2] == gid[4]
+        assert len({gid[0], gid[2], gid[3]}) == 3
+        # rep rows map back to the right keys
+        k = np.asarray(keys[0])
+        assert {int(k[r]) for r in np.asarray(rep)[:3]} == {7, 3, 9}
+
+    def test_group_ids_multicol_and_mask(self):
+        k1 = jnp.array([1, 1, 1, 2], dtype=jnp.int64)
+        k2 = jnp.array([5, 6, 5, 5], dtype=jnp.int64)
+        mask = jnp.array([True, True, True, False])
+        gid, ng, _ = hashtable.group_ids((k1, k2), mask, 16)
+        assert int(ng) == 2
+        assert int(gid[0]) == int(gid[2])
+        assert int(gid[0]) != int(gid[1])
+
+    def test_probe(self):
+        bkeys = (jnp.array([10, 20, 30], dtype=jnp.int64),)
+        claim, _, conv = hashtable.build(bkeys, jnp.ones(3, jnp.bool_), 16)
+        assert bool(conv)
+        pkeys = (jnp.array([20, 99, 10, 30], dtype=jnp.int64),)
+        matched, row = hashtable.probe(claim, bkeys, pkeys,
+                                       jnp.ones(4, jnp.bool_), 16, 3)
+        assert list(np.asarray(matched)) == [True, False, True, True]
+        assert list(np.asarray(row)[[0, 2, 3]]) == [1, 0, 2]
+
+    def test_many_collisions(self):
+        # All keys congruent mod capacity -> long probe chains
+        keys = (jnp.arange(0, 640, 64, dtype=jnp.int64) * 0 +
+                jnp.arange(10, dtype=jnp.int64) * 1024,)
+        gid, ng, _ = hashtable.group_ids(keys, jnp.ones(10, jnp.bool_), 32)
+        assert int(ng) == 10
+        assert len(set(np.asarray(gid).tolist())) == 10
+
+
+class TestJoin:
+    def _sides(self):
+        probe = ColumnBatch.from_dict({
+            "pk": jnp.array([1, 2, 3, 4, 2], dtype=jnp.int64),
+            "val": jnp.array([10, 20, 30, 40, 21], dtype=jnp.int64)})
+        build = ColumnBatch.from_dict({
+            "bk": jnp.array([2, 4, 8], dtype=jnp.int64),
+            "name": jnp.array([200, 400, 800], dtype=jnp.int64)})
+        return probe, build
+
+    def test_inner(self):
+        probe, build = self._sides()
+        out = hash_join(probe, build, ["pk"], ["bk"], ["name"], "inner")
+        h = out.to_host()
+        assert list(h["pk"]) == [2, 4, 2]
+        assert list(h["name"]) == [200, 400, 200]
+
+    def test_left(self):
+        probe, build = self._sides()
+        out = hash_join(probe, build, ["pk"], ["bk"], ["name"], "left")
+        h = out.to_host()
+        assert len(h["pk"]) == 5
+        assert list(h["name"].mask) == [True, False, True, False, False]
+
+    def test_semi_anti(self):
+        probe, build = self._sides()
+        semi = hash_join(probe, build, ["pk"], ["bk"], [], "semi").to_host()
+        assert list(semi["pk"]) == [2, 4, 2]
+        anti = hash_join(probe, build, ["pk"], ["bk"], [], "anti").to_host()
+        assert list(anti["pk"]) == [1, 3]
+
+    def test_null_keys_never_match(self):
+        probe = ColumnBatch.from_dict(
+            {"pk": jnp.array([2, 2], dtype=jnp.int64)},
+            valid={"pk": jnp.array([True, False])})
+        build = ColumnBatch.from_dict({"bk": jnp.array([2], dtype=jnp.int64),
+                                       "x": jnp.array([7], dtype=jnp.int64)})
+        out = hash_join(probe, build, ["pk"], ["bk"], ["x"], "inner")
+        assert len(out.to_host()["pk"]) == 1
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

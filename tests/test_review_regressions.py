@@ -1,0 +1,81 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import datetime
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE a (id INT, d DATE)")
+    e.execute("INSERT INTO a VALUES (1, '2024-01-10'), (2, '2024-02-10'), "
+              "(3, '2024-03-10')")
+    e.execute("CREATE TABLE b (id INT, x INT)")
+    e.execute("INSERT INTO b VALUES (1, 5), (2, -1)")
+    return e
+
+
+def test_left_join_on_condition_preserves_outer_rows(eng):
+    # b.x > 0 in ON restricts matches, not output rows
+    r = eng.execute("SELECT a.id, b.x FROM a LEFT JOIN b "
+                    "ON a.id = b.id AND b.x > 0 ORDER BY a.id")
+    assert r.column("id") == [1, 2, 3]
+    assert r.column("x") == [5, None, None]
+
+
+def test_left_join_where_on_build_filters_after_join(eng):
+    # WHERE on the build side applies after NULL-extension
+    r = eng.execute("SELECT a.id FROM a LEFT JOIN b ON a.id = b.id "
+                    "WHERE b.x IS NULL ORDER BY a.id")
+    assert r.column("id") == [3]
+
+
+def test_duplicate_output_names_do_not_collapse(eng):
+    r = eng.execute("SELECT sum(id) , sum(id + 10) FROM a")
+    assert r.names == ["sum", "sum_1"]
+    assert r.rows == [(6, 36)]
+
+
+def test_date_minus_date_is_days(eng):
+    r = eng.execute("SELECT id FROM a WHERE d - date '2024-01-01' > 35 "
+                    "ORDER BY id")
+    assert r.column("id") == [2, 3]
+
+
+def test_decimal_literal_in_int_list_does_not_round(eng):
+    r = eng.execute("SELECT id FROM a WHERE id IN (1.5, 3)")
+    assert r.column("id") == [3]
+
+
+def test_extract_of_group_column(eng):
+    r = eng.execute("SELECT EXTRACT(month FROM d) AS m, count(*) AS n "
+                    "FROM a GROUP BY d ORDER BY m")
+    assert r.column("m") == [1, 2, 3]
+
+
+def test_hash_capacity_retry_takes_effect(eng):
+    e2 = Engine()
+    e2.execute("CREATE TABLE big (k INT)")
+    e2.execute("INSERT INTO big VALUES "
+               + ",".join(f"({i})" for i in range(300)))
+    s = e2.session()
+    s.vars.set("hash_group_capacity", 256)
+    with pytest.raises(EngineError):
+        e2.execute("SELECT k, count(*) AS n FROM big GROUP BY k", s)
+    s.vars.set("hash_group_capacity", 4096)
+    r = e2.execute("SELECT k, count(*) AS n FROM big GROUP BY k", s)
+    assert len(r.rows) == 300
+
+
+def test_insert_select_cache_distinguishes_queries(eng):
+    eng.execute("CREATE TABLE sink1 (v INT)")
+    eng.execute("CREATE TABLE sink2 (v INT)")
+    eng.execute("INSERT INTO sink1 SELECT id FROM a")
+    eng.execute("INSERT INTO sink2 SELECT id + 100 FROM a")
+    r1 = eng.execute("SELECT v FROM sink1 ORDER BY v")
+    r2 = eng.execute("SELECT v FROM sink2 ORDER BY v")
+    assert r1.column("v") == [1, 2, 3]
+    assert r2.column("v") == [101, 102, 103]
