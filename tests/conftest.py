@@ -19,4 +19,8 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms to
+# "axon,cpu" at interpreter start, overriding the env var; re-pin it
+# through jax.config so tests always see the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
