@@ -73,6 +73,49 @@ class Session:
     in_txn: bool = False
 
 
+@dataclass
+class Prepared:
+    """A planned+compiled SELECT bound to device-resident tables.
+
+    ``dispatch()`` is asynchronous (returns the device-side output
+    batch immediately, XLA-style); ``run()`` dispatches and
+    materializes. The read timestamp is taken per execution and the
+    bound device tables are re-resolved if any scanned table's
+    generation moved (DML re-uploads), so a prepared statement sees
+    current data under the session's isolation rules, like a pgwire
+    portal re-executed after Bind."""
+
+    engine: "Engine"
+    session: "Session"
+    stmt: "ast.Select"
+    sql_text: str
+    jfn: object
+    scans: dict
+    meta: object
+    gens: tuple  # ((table, generation), ...) captured at prepare time
+
+    def _refresh(self) -> "Prepared":
+        cur = tuple((t, self.engine.store.table(t).generation)
+                    for t, _ in self.gens)
+        if cur == self.gens:
+            return self
+        return self.engine._prepare_select(self.stmt, self.session,
+                                           self.sql_text)
+
+    def dispatch(self, read_ts: Optional[Timestamp] = None) -> ColumnBatch:
+        p = self._refresh()
+        if p is not self:
+            self.jfn, self.scans, self.meta, self.gens = \
+                p.jfn, p.scans, p.meta, p.gens
+        ts = read_ts or self.engine._read_ts(self.session)
+        # np scalar: a jnp.int64() upload would cost a blocking
+        # host->device round trip before the query even dispatches.
+        return self.jfn(self.scans, np.int64(ts.to_int()))
+
+    def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
+        return self.engine._materialize(self.dispatch(read_ts), self.meta)
+
+
 class Engine:
     def __init__(self, store: ColumnStore | None = None,
                  clock: Clock | None = None,
@@ -158,15 +201,12 @@ class Engine:
         planner = Planner(self.catalog_view())
         return planner.plan_select(stmt)
 
-    def _exec_select(self, sel: ast.Select, session: Session,
-                     sql_text: str) -> Result:
-        if sel.table is None:
-            return self._exec_table_free(sel)
+    def _prepare_select(self, sel: ast.Select, session: Session,
+                        sql_text: str) -> "Prepared":
         for td in self.store.tables.values():
             if td.open_ts:
                 self.store.seal(td.schema.name)
         node, meta = self._plan(sel, session)
-        read_ts = self._read_ts(session)
 
         scan_aliases = _collect_scans(node)
         decision = self._dist_decision(node, session)
@@ -201,9 +241,27 @@ class Engine:
             self._exec_cache[key] = (jfn, meta)
         else:
             jfn, meta = cached
+        gens = tuple((t, g) for t, g, _ in sorted(gens))
+        return Prepared(self, session, sel, sql_text, jfn, scans, meta, gens)
 
-        out = jfn(scans, jnp.int64(read_ts.to_int()))
-        return self._materialize(out, meta)
+    def prepare(self, sql: str, session: Session | None = None) -> "Prepared":
+        """Prepare a SELECT for repeated execution (the pgwire
+        prepared-statement/portal path, pkg/sql/pgwire/conn.go Describe/
+        Bind/Execute). ``Prepared.dispatch()`` launches the compiled
+        program without blocking on the result, so a stream of
+        executions pipelines on-device instead of paying a full
+        host<->device round trip per query."""
+        session = session or self.session()
+        stmt = parser.parse(sql)
+        if not isinstance(stmt, ast.Select) or stmt.table is None:
+            raise EngineError("can only prepare table-reading SELECTs")
+        return self._prepare_select(stmt, session, sql_text=sql)
+
+    def _exec_select(self, sel: ast.Select, session: Session,
+                     sql_text: str) -> Result:
+        if sel.table is None:
+            return self._exec_table_free(sel)
+        return self._prepare_select(sel, session, sql_text).run()
 
     def _dist_decision(self, node, session: Session):
         """Choose distributed (SPMD over the mesh) vs single-device —

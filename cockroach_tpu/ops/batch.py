@@ -125,10 +125,15 @@ class ColumnBatch:
 
     # -- host conversion ---------------------------------------------------
     def to_host(self) -> dict[str, np.ndarray]:
-        """Compact live rows to host numpy (gateway/result edge only)."""
-        sel = np.asarray(self.sel)
+        """Compact live rows to host numpy (gateway/result edge only).
+
+        One bundled device_get for the whole pytree: per-array fetches
+        each pay a full host<->device round trip, which dominates query
+        latency on remote-attached TPUs."""
+        data, valid, sel = jax.device_get((self.data, self.valid, self.sel))
+        sel = np.asarray(sel)
         out = {}
-        for name, d, v in zip(self.names, self.data, self.valid):
+        for name, d, v in zip(self.names, data, valid):
             dn = np.asarray(d)[sel]
             vn = np.asarray(v)[sel]
             out[name] = np.ma.masked_array(dn, mask=~vn)

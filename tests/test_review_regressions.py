@@ -79,3 +79,20 @@ def test_insert_select_cache_distinguishes_queries(eng):
     r2 = eng.execute("SELECT v FROM sink2 ORDER BY v")
     assert r1.column("v") == [1, 2, 3]
     assert r2.column("v") == [101, 102, 103]
+
+
+class TestPreparedRefresh:
+    def test_prepared_sees_dml(self):
+        """A Prepared statement must not serve stale device tables
+        after DML bumps the table generation (review finding r1)."""
+        from cockroach_tpu.exec.engine import Engine
+
+        e = Engine()
+        e.execute("CREATE TABLE pr (a INT, m DECIMAL(10,2))")
+        e.execute("INSERT INTO pr VALUES (1, 1.00), (2, 2.00)")
+        p = e.prepare("SELECT sum(m) AS s FROM pr")
+        assert p.run().rows == [(3.0,)]
+        e.execute("DELETE FROM pr WHERE a = 2")
+        assert p.run().rows == [(1.0,)]
+        e.execute("INSERT INTO pr VALUES (3, 4.00)")
+        assert p.run().rows == [(5.0,)]
