@@ -1,0 +1,128 @@
+"""Datadriven test harness (the cockroachdb/datadriven analogue).
+
+Test files are sequences of directives:
+
+    <command> [arg=val ...]
+    [input lines...]
+    ----
+    expected output
+
+Blocks are separated by blank lines. `run_datadriven(path, handler)`
+calls handler(TestData) per directive and diffs the returned string
+against the expectation. REWRITE=1 in the environment rewrites the
+file with actual outputs instead of failing (datadriven's -rewrite
+flag) — the workflow the reference uses to maintain its thousands of
+golden files (pkg/storage/mvcc_history_test.go, opt's testdata).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TestData:
+    cmd: str
+    args: dict = field(default_factory=dict)
+    input: str = ""
+    expected: str = ""
+    pos: str = ""
+
+    def arg(self, name, default=None):
+        return self.args.get(name, default)
+
+    def has(self, name):
+        return name in self.args
+
+
+_ARG_RE = re.compile(r"([A-Za-z_][\w.-]*)(?:=(\S+))?")
+
+
+def _parse_file(path: str) -> list[TestData]:
+    blocks = []
+    with open(path) as f:
+        lines = f.read().split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if not line.strip() or line.lstrip().startswith("#"):
+            i += 1
+            continue
+        pos = f"{path}:{i + 1}"
+        header = line.split("#")[0].strip() if "#" in line else line.strip()
+        parts = header.split(None, 1)
+        cmd = parts[0]
+        args = {}
+        if len(parts) > 1:
+            for m in _ARG_RE.finditer(parts[1]):
+                args[m.group(1)] = m.group(2) if m.group(2) is not None else True
+        i += 1
+        input_lines = []
+        while i < len(lines) and lines[i].strip() != "----":
+            input_lines.append(lines[i])
+            i += 1
+        if i >= len(lines):
+            raise ValueError(f"{pos}: directive without ---- separator")
+        i += 1  # skip ----
+        out_lines = []
+        while i < len(lines) and lines[i].strip() != "":
+            out_lines.append(lines[i])
+            i += 1
+        blocks.append(TestData(cmd=cmd, args=args,
+                               input="\n".join(input_lines).strip(),
+                               expected="\n".join(out_lines), pos=pos))
+    return blocks
+
+
+def run_datadriven(path: str, handler) -> None:
+    rewrite = os.environ.get("REWRITE") == "1"
+    blocks = _parse_file(path)
+    actuals = []
+    failures = []
+    for td in blocks:
+        try:
+            actual = handler(td) or "ok"
+        except Exception as e:  # handlers signal errors as output
+            actual = f"error: ({type(e).__name__}) {e}"
+        actual = actual.rstrip("\n")
+        actuals.append(actual)
+        if not rewrite and actual != td.expected:
+            failures.append(
+                f"\n{td.pos}: {td.cmd}\nexpected:\n{td.expected}\n"
+                f"actual:\n{actual}")
+    if rewrite:
+        _rewrite_file(path, blocks, actuals)
+        return
+    if failures:
+        raise AssertionError("".join(failures))
+
+
+def _rewrite_file(path: str, blocks: list[TestData],
+                  actuals: list[str]) -> None:
+    out = []
+    with open(path) as f:
+        orig_lines = f.read().split("\n")
+    # reconstruct: keep leading comments/blank runs between blocks
+    li = 0
+    for td, actual in zip(blocks, actuals):
+        hdr_idx = int(td.pos.rsplit(":", 1)[1]) - 1
+        while li < hdr_idx:
+            out.append(orig_lines[li])
+            li += 1
+        out.append(orig_lines[li])  # header
+        li += 1
+        while orig_lines[li].strip() != "----":
+            out.append(orig_lines[li])
+            li += 1
+        out.append("----")
+        li += 1
+        while li < len(orig_lines) and orig_lines[li].strip() != "":
+            li += 1  # skip old expected
+        out.extend(actual.split("\n"))
+    while li < len(orig_lines):
+        out.append(orig_lines[li])
+        li += 1
+    with open(path, "w") as f:
+        f.write("\n".join(out).rstrip("\n") + "\n")

@@ -1,0 +1,230 @@
+"""Unit tests for the storage engine stack: key encodings, memtable,
+SST persistence, LSM merge/compaction, MVCC edge cases.
+
+The encoding ordering property mirrors the reference's
+encoding round-trip tests (pkg/util/encoding); randomized op
+application cross-checked against a model dict mirrors
+pkg/storage/metamorphic.
+"""
+
+import random
+import tempfile
+
+from cockroach_tpu.storage.keys import (EngineKey, decode_bytes, decode_int,
+                                        encode_bytes, encode_float,
+                                        encode_int, next_key, prefix_end,
+                                        table_key)
+from cockroach_tpu.storage.lsm import LSM
+from cockroach_tpu.storage.mvcc import (MVCC, TxnMeta, TxnStatus,
+                                        WriteIntentError, WriteTooOldError,
+                                        ts)
+from cockroach_tpu.storage.sst import SST
+
+
+class TestEncodings:
+    def test_int_order_roundtrip(self):
+        rng = random.Random(0)
+        vals = sorted([rng.randrange(-(1 << 62), 1 << 62)
+                       for _ in range(200)] +
+                      [0, 1, -1, (1 << 63) - 1, -(1 << 63)])
+        encs = []
+        for v in vals:
+            buf = bytearray()
+            encode_int(buf, v)
+            got, off = decode_int(bytes(buf), 0)
+            assert got == v and off == 8
+            encs.append(bytes(buf))
+        assert encs == sorted(encs)
+
+    def test_float_order(self):
+        vals = sorted([-1e300, -2.5, -0.0, 0.0, 1e-9, 3.14, 7e200])
+        encs = []
+        for v in vals:
+            buf = bytearray()
+            encode_float(buf, v)
+            encs.append(bytes(buf))
+        assert encs == sorted(encs)
+
+    def test_bytes_escape_order(self):
+        vals = sorted([b"", b"\x00", b"\x00\x00", b"\x00\x01", b"a",
+                       b"a\x00", b"a\x00b", b"ab", b"b"])
+        encs = []
+        for v in vals:
+            buf = bytearray()
+            encode_bytes(buf, v)
+            got, _ = decode_bytes(bytes(buf), 0)
+            assert got == v
+            encs.append(bytes(buf))
+        assert encs == sorted(encs)
+        # prefix freedom: "a" < "a\x00b" < "ab" must hold encoded
+        assert encs == sorted(encs)
+
+    def test_table_key_order(self):
+        k1 = table_key(5, (1, "apple"))
+        k2 = table_key(5, (1, "banana"))
+        k3 = table_key(5, (2, "apple"))
+        k4 = table_key(6, (0, ""))
+        assert k1 < k2 < k3 < k4
+
+    def test_engine_key_order(self):
+        a_meta = EngineKey.meta(b"a")
+        a_30 = EngineKey.versioned(b"a", ts(30))
+        a_10 = EngineKey.versioned(b"a", ts(10))
+        b_meta = EngineKey.meta(b"b")
+        order = [a_meta, a_30, a_10, b_meta]
+        assert sorted(order) == order
+        encs = [k.encode() for k in order]
+        assert sorted(encs) == encs
+        for k in order:
+            assert EngineKey.decode(k.encode()) == k
+
+    def test_prefix_end(self):
+        assert prefix_end(b"ab") == b"ac"
+        assert prefix_end(b"a\xff") == b"b"
+        assert next_key(b"a") == b"a\x00"
+
+
+class TestLSM:
+    def test_flush_compact_get(self):
+        eng = LSM(memtable_size=1 << 30)
+        keys = [EngineKey.versioned(f"k{i:04d}".encode(), ts(1))
+                for i in range(500)]
+        for i, k in enumerate(keys):
+            eng.put(k, f"v{i}".encode())
+        eng.flush()
+        for i, k in enumerate(keys[:100]):
+            eng.put(k, f"v{i}'".encode())  # shadow in newer run
+        eng.flush()
+        eng.delete(keys[0])
+        eng.flush()
+        eng.compact()
+        assert eng.get(keys[0]) is None
+        assert eng.get(keys[1]) == b"v1'"
+        assert eng.get(keys[200]) == b"v200"
+        got = list(eng.scan(EngineKey.meta(b"")))
+        assert len(got) == 499  # tombstoned key dropped by compaction
+
+    def test_persistence_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            eng = LSM(dir=d, memtable_size=1 << 30)
+            for i in range(100):
+                eng.put(EngineKey.versioned(f"p{i:03d}".encode(), ts(5)),
+                        f"val{i}".encode())
+            eng.flush()
+            eng.put(EngineKey.versioned(b"unflushed", ts(6)), b"wal-only")
+            eng.close()
+            eng2 = LSM(dir=d)
+            assert eng2.stats["wal_replayed"] == 1
+            assert eng2.get(EngineKey.versioned(b"p050", ts(5))) == b"val50"
+            assert eng2.get(EngineKey.versioned(b"unflushed", ts(6))) \
+                == b"wal-only"
+
+    def test_sst_binary_format(self):
+        with tempfile.TemporaryDirectory() as d:
+            entries = [(EngineKey.versioned(f"s{i}".encode(), ts(i + 1)),
+                        (f"v{i}".encode() if i % 3 else None))
+                       for i in range(50)]
+            entries.sort()
+            sst = SST(entries)
+            path = d + "/x.sst"
+            sst.write(path)
+            back = SST.load(path)
+            assert list(back.entries()) == entries
+
+    def test_randomized_vs_model(self):
+        """Random puts/deletes/flushes vs a model dict (metamorphic)."""
+        rng = random.Random(42)
+        eng = LSM(memtable_size=1 << 30)
+        model: dict = {}
+        for step in range(2000):
+            op = rng.random()
+            k = EngineKey.versioned(
+                f"r{rng.randrange(100):03d}".encode(), ts(rng.randrange(50) + 1))
+            if op < 0.6:
+                v = f"v{step}".encode()
+                eng.put(k, v)
+                model[k] = v
+            elif op < 0.8:
+                eng.delete(k)
+                model.pop(k, None)
+            elif op < 0.95:
+                eng.flush()
+            else:
+                eng.compact()
+        got = {k: v for k, v in eng.scan(EngineKey.meta(b""))}
+        assert got == model
+
+
+class TestMVCCEdges:
+    def test_own_intent_replace(self):
+        m = MVCC()
+        txn = TxnMeta(write_ts=ts(10), read_ts=ts(10))
+        m.put(b"k", ts(10), b"v1", txn=txn)
+        txn.seq += 1
+        m.put(b"k", ts(10), b"v2", txn=txn)
+        assert m.get(b"k", ts(10), txn=txn).value == b"v2"
+        m.resolve_intent(b"k", txn, TxnStatus.COMMITTED)
+        vers = list(m.iter_versions(b"k"))
+        assert len(vers) == 1 and vers[0].value == b"v2"
+
+    def test_write_too_old_nontxn(self):
+        m = MVCC()
+        m.put(b"k", ts(20), b"new")
+        try:
+            m.put(b"k", ts(10), b"old")
+            assert False
+        except WriteTooOldError as e:
+            assert e.actual_ts > ts(20)
+
+    def test_intent_blocks_writer(self):
+        m = MVCC()
+        txn = TxnMeta(write_ts=ts(10), read_ts=ts(10))
+        m.put(b"k", ts(10), b"v", txn=txn)
+        try:
+            m.put(b"k", ts(20), b"other")
+            assert False
+        except WriteIntentError as e:
+            assert e.txn_meta.id == txn.id
+
+    def test_scan_max_keys(self):
+        m = MVCC()
+        for i in range(10):
+            m.put(f"k{i}".encode(), ts(5), b"x")
+        got = m.scan(b"k", b"l", ts(10), max_keys=3)
+        assert [mv.key for mv in got] == [b"k0", b"k1", b"k2"]
+
+    def test_gc_skips_intent_shadowed(self):
+        """GC must not collect beneath an unresolved intent (review)."""
+        m = MVCC()
+        m.put(b"k", ts(5), b"old")
+        txn = TxnMeta(write_ts=ts(8), read_ts=ts(8))
+        m.put(b"k", ts(8), b"prov", txn=txn)
+        assert m.gc(b"", b"\xff", ts(20)) == 0
+        m.resolve_intent(b"k", txn, TxnStatus.ABORTED)
+        assert m.get(b"k", ts(30)).value == b"old"
+
+    def test_restarted_txn_skips_old_epoch_intent(self):
+        """A restarted txn (new epoch) must not read its pre-restart
+        provisional writes (review)."""
+        m = MVCC()
+        m.put(b"k", ts(5), b"committed")
+        txn = TxnMeta(write_ts=ts(10), read_ts=ts(10))
+        m.put(b"k", ts(10), b"pre-restart", txn=txn)
+        txn.epoch += 1
+        txn.seq = 0
+        got = m.get(b"k", ts(10), txn=txn)
+        assert got.value == b"committed"
+        got = m.scan(b"k", b"l", ts(10), txn=txn)
+        assert got[0].value == b"committed"
+
+    def test_inconsistent_scan_reports_intents(self):
+        m = MVCC()
+        m.put(b"a", ts(5), b"va")
+        txn = TxnMeta(write_ts=ts(8), read_ts=ts(8))
+        m.put(b"b", ts(8), b"prov", txn=txn)
+        skipped = []
+        vals = m.scan(b"a", b"z", ts(10), inconsistent=True,
+                      intents_out=skipped)
+        assert [v.key for v in vals] == [b"a"]
+        assert len(skipped) == 1 and skipped[0][0] == b"b"
+        assert skipped[0][1].id == txn.id
