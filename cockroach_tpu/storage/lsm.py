@@ -30,7 +30,8 @@ from .memtable import Memtable
 from .sst import SST
 
 _WAL_HDR = struct.Struct("<IBII")  # crc, op, klen, vlen
-_OP_PUT, _OP_DEL = 0, 1
+_OP_PUT, _OP_DEL, _OP_BATCH = 0, 1, 2
+_BATCH_ENT = struct.Struct("<BII")  # op, klen, vlen
 
 
 class LSM:
@@ -66,11 +67,18 @@ class LSM:
             self._maybe_flush()
 
     def write_batch(self, ops: list[tuple[EngineKey, Optional[bytes]]]) -> None:
-        """Atomic-ish batch apply (pebble.Batch.Commit analogue: one
-        WAL sync for the whole batch)."""
+        """Atomic batch apply (pebble.Batch.Commit): the whole batch is
+        one framed WAL record, so crash replay applies all of it or
+        none (intent meta + provisional value must not tear apart)."""
         with self._lock:
+            payload = bytearray()
             for k, v in ops:
-                self._log(_OP_PUT if v is not None else _OP_DEL, k, v or b"")
+                ek = k.encode()
+                val = v if v is not None else b""
+                op = _OP_PUT if v is not None else _OP_DEL
+                payload += _BATCH_ENT.pack(op, len(ek), len(val)) + ek + val
+            self._log(_OP_BATCH, None, bytes(payload))
+            for k, v in ops:
                 self.mem.put(k, v)
             self._maybe_flush()
 
@@ -173,10 +181,10 @@ class LSM:
         open(self._wal_path, "wb").close()
         self._wal = open(self._wal_path, "ab")
 
-    def _log(self, op: int, key: EngineKey, value: bytes) -> None:
+    def _log(self, op: int, key: Optional[EngineKey], value: bytes) -> None:
         if self.dir is None or self._wal is None:
             return
-        ek = key.encode()
+        ek = key.encode() if key is not None else b""
         payload = ek + value
         crc = zlib.crc32(bytes([op]) + payload)
         self._wal.write(_WAL_HDR.pack(crc, op, len(ek), len(value)) + payload)
@@ -218,9 +226,28 @@ class LSM:
                 off += klen + vlen
                 if zlib.crc32(bytes([op]) + ek + val) != crc:
                     break  # corrupt tail
-                key = EngineKey.decode(ek)
-                self.mem.put(key, val if op == _OP_PUT else None)
+                if op == _OP_BATCH:
+                    for k, v in self._decode_batch(val):
+                        self.mem.put(k, v)
+                else:
+                    key = EngineKey.decode(ek)
+                    self.mem.put(key, val if op == _OP_PUT else None)
                 self.stats["wal_replayed"] += 1
+
+    @staticmethod
+    def _decode_batch(payload: bytes
+                      ) -> list[tuple[EngineKey, Optional[bytes]]]:
+        ops = []
+        off = 0
+        while off + _BATCH_ENT.size <= len(payload):
+            op, klen, vlen = _BATCH_ENT.unpack_from(payload, off)
+            off += _BATCH_ENT.size
+            ek = payload[off: off + klen]
+            val = payload[off + klen: off + klen + vlen]
+            off += klen + vlen
+            ops.append((EngineKey.decode(ek),
+                        val if op == _OP_PUT else None))
+        return ops
 
 
 def _merge(sources: list) -> Iterator[tuple[EngineKey, Optional[bytes]]]:

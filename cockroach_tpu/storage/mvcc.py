@@ -342,6 +342,35 @@ class MVCC:
                         n += 1
         return n
 
+    def has_writes_between(self, start: bytes, end: bytes,
+                           t0: Timestamp, t1: Timestamp,
+                           exclude_txn: Optional[str] = None) -> bool:
+        """Any committed version in [start,end) with t0 < ts <= t1?
+        The span-refresh validity check (kvcoord span refresher):
+        provisional values (under a meta record) don't count, nor do
+        versions written by `exclude_txn` itself."""
+        cur_meta: Optional[TxnMeta] = None
+        cur_key: Optional[bytes] = None
+        for ek, raw in self.engine.scan(EngineKey.meta(start),
+                                        EngineKey.meta(end),
+                                        include_tombstones=True):
+            if raw is None:
+                continue
+            if ek.key != cur_key:
+                cur_key = ek.key
+                cur_meta = None
+            if ek.is_meta:
+                cur_meta = TxnMeta.from_json(raw)
+                continue
+            if not (t0 < ek.ts <= t1):
+                continue
+            if cur_meta is not None and ek.ts == cur_meta.write_ts:
+                if exclude_txn is not None and cur_meta.id == exclude_txn:
+                    continue  # our own intent
+                return True  # foreign intent in the window: refresh fails
+            return True
+        return False
+
     # -- GC ------------------------------------------------------------------
     def gc(self, start: bytes, end: bytes, threshold: Timestamp) -> int:
         """MVCC GC: drop versions shadowed as of `threshold` and

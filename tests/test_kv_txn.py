@@ -1,0 +1,286 @@
+"""Transaction layer tests: latches, tscache, pushes, refresh, and a
+kvnemesis-style randomized concurrency check.
+
+The final class mirrors pkg/kv/kvnemesis: random concurrent
+transactions (bank transfers) applied from many threads, then a
+serializability validation — committed txns replayed in commit-ts
+order against a model must reproduce every read each txn actually
+observed, and invariants (total balance) must hold at every timestamp.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from cockroach_tpu.kv.concurrency import (Span, SpanLatchManager,
+                                          TimestampCache, TxnAbortedError,
+                                          TxnRetryError)
+from cockroach_tpu.kv.txn import DB, KVStore, Txn
+from cockroach_tpu.storage.hlc import Timestamp
+from cockroach_tpu.storage.mvcc import TxnStatus, ts
+
+
+class TestLatches:
+    def test_read_read_no_conflict(self):
+        m = SpanLatchManager()
+        g1 = m.acquire([(Span(b"a"), False)])
+        g2 = m.acquire([(Span(b"a"), False)], timeout=0.5)
+        m.release(g1)
+        m.release(g2)
+
+    def test_write_blocks_read(self):
+        m = SpanLatchManager()
+        g1 = m.acquire([(Span(b"a"), True)])
+        got = []
+
+        def reader():
+            g = m.acquire([(Span(b"a"), False)], timeout=5)
+            got.append(g)
+            m.release(g)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        assert not got  # blocked
+        m.release(g1)
+        t.join(timeout=5)
+        assert got
+
+    def test_disjoint_writes_no_conflict(self):
+        m = SpanLatchManager()
+        g1 = m.acquire([(Span(b"a", b"c"), True)])
+        g2 = m.acquire([(Span(b"d", b"f"), True)], timeout=0.5)
+        m.release(g1)
+        m.release(g2)
+
+    def test_timeout(self):
+        m = SpanLatchManager()
+        m.acquire([(Span(b"a"), True)])
+        with pytest.raises(TimeoutError):
+            m.acquire([(Span(b"a"), True)], timeout=0.1)
+
+
+class TestTimestampCache:
+    def test_point_and_span(self):
+        c = TimestampCache()
+        c.add(Span(b"a"), ts(10))
+        c.add(Span(b"c", b"f"), ts(20))
+        assert c.get_max(Span(b"a")) == ts(10)
+        assert c.get_max(Span(b"b")) == c.low_water
+        assert c.get_max(Span(b"d")) == ts(20)
+        assert c.get_max(Span(b"a", b"z")) == ts(20)
+
+    def test_rotation_folds_low_water(self):
+        c = TimestampCache()
+        for i in range(5000):
+            c.add(Span(b"k%05d" % i), ts(i + 1))
+        assert c.get_max(Span(b"zzz")) >= ts(1)
+
+
+class TestTxnBasics:
+    def test_read_your_writes_and_commit(self):
+        db = DB()
+        t = Txn(db.store)
+        t.put(b"k", b"v1")
+        assert t.get(b"k") == b"v1"
+        t.commit()
+        assert db.get(b"k") == b"v1"
+
+    def test_rollback_discards(self):
+        db = DB()
+        t = Txn(db.store)
+        t.put(b"k", b"v1")
+        t.rollback()
+        assert db.get(b"k") is None
+
+    def test_uncommitted_invisible(self):
+        db = DB()
+        t = Txn(db.store)
+        t.put(b"k", b"v1")
+        t2 = Txn(db.store)
+        # t2 read pushes t1 (still pending, not expired) -> retry error,
+        # or sees nothing if below; at same ts it must not see v1
+        try:
+            assert t2.get(b"k") is None
+        except TxnRetryError:
+            pass
+        t.rollback()
+        t2.rollback()
+
+    def test_write_write_conflict_via_push(self):
+        db = DB()
+        t1 = Txn(db.store)
+        t1.put(b"k", b"t1")
+        # expire t1's heartbeat so t2's push aborts it
+        db.store.txns.get(t1.meta.id).last_heartbeat -= 100
+        t2 = Txn(db.store)
+        t2.put(b"k", b"t2")
+        t2.commit()
+        with pytest.raises(TxnAbortedError):
+            t1.commit()
+        assert db.get(b"k") == b"t2"
+
+    def test_tscache_bumps_writer(self):
+        db = DB()
+        db.put(b"k", b"v0")
+        t1 = Txn(db.store)
+        t2 = Txn(db.store)  # later ts
+        assert t2.get(b"k") == b"v0"
+        t2.commit()
+        t1.put(b"k", b"v1")  # must land above t2's read
+        commit_ts = t1.commit()
+        assert commit_ts > t2.meta.read_ts
+
+    def test_refresh_success_and_failure(self):
+        db = DB()
+        db.put(b"a", b"a0")
+        db.put(b"b", b"b0")
+        # success: reads untouched while write ts gets bumped
+        t = Txn(db.store)
+        assert t.get(b"a") == b"a0"
+        t3 = Txn(db.store)
+        assert t3.get(b"k2") is None
+        t3.commit()
+        t.put(b"k2", b"x")  # bumped above t3's read by tscache
+        t.commit()  # refresh of read span {a} succeeds
+        # failure: read span overwritten behind our read ts
+        t = Txn(db.store)
+        assert t.get(b"b") == b"b0"
+        db.put(b"b", b"b1")  # independent committed write
+        t4 = Txn(db.store)
+        assert t4.get(b"k3") is None
+        t4.commit()
+        t.put(b"k3", b"y")
+        with pytest.raises(TxnRetryError):
+            t.commit()
+
+    def test_db_txn_retry_loop(self):
+        db = DB()
+        db.put(b"b", b"b0")
+        calls = []
+
+        def fn(t: Txn):
+            calls.append(1)
+            v = t.get(b"b")
+            if len(calls) == 1:
+                # sabotage: overwrite b behind the txn's back, then
+                # force a write-ts bump so commit needs a refresh
+                db.put(b"b", b"b1")
+                t5 = Txn(db.store)
+                t5.get(b"sab")
+                t5.commit()
+                t.put(b"sab", b"s")
+            else:
+                t.put(b"sab", b"s")
+            return v
+
+        v = db.txn(fn)
+        assert len(calls) >= 2  # retried at least once
+        assert v == b"b1"  # retry observed the newer value
+
+
+ACCOUNTS = 8
+INITIAL = 100
+
+
+class TestKVNemesis:
+    """Randomized concurrent bank: serializability validation."""
+
+    def test_concurrent_transfers_serializable(self):
+        db = DB()
+        for i in range(ACCOUNTS):
+            db.put(b"acct%d" % i, str(INITIAL).encode())
+
+        committed = []  # (commit_ts, [(frm, to, amt, observed_sums)])
+        lock = threading.Lock()
+        stop = threading.Event()
+        errors = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                frm, to = rng.sample(range(ACCOUNTS), 2)
+                amt = rng.randrange(1, 20)
+                try:
+                    t = Txn(db.store)
+                    bf = int(t.get(b"acct%d" % frm))
+                    bt = int(t.get(b"acct%d" % to))
+                    if bf < amt:
+                        t.rollback()
+                        continue
+                    t.put(b"acct%d" % frm, str(bf - amt).encode())
+                    t.put(b"acct%d" % to, str(bt + amt).encode())
+                    cts = t.commit()
+                    with lock:
+                        committed.append((cts, frm, to, amt, bf, bt))
+                except (TxnRetryError, TxnAbortedError):
+                    try:
+                        t.rollback()
+                    except Exception:
+                        pass
+                except Exception as e:  # unexpected
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        assert committed, "no txns committed"
+
+        # invariant: total conserved
+        final = sum(int(db.get(b"acct%d" % i)) for i in range(ACCOUNTS))
+        assert final == ACCOUNTS * INITIAL
+
+        # serializability: replay in commit-ts order; each txn's
+        # observed pre-balances must match the model state
+        committed.sort(key=lambda e: e[0])
+        model = {i: INITIAL for i in range(ACCOUNTS)}
+        for cts, frm, to, amt, bf, bt in committed:
+            assert model[frm] == bf, \
+                f"txn@{cts} read acct{frm}={bf}, model={model[frm]}"
+            assert model[to] == bt, \
+                f"txn@{cts} read acct{to}={bt}, model={model[to]}"
+            model[frm] -= amt
+            model[to] += amt
+        for i in range(ACCOUNTS):
+            assert model[i] == int(db.get(b"acct%d" % i))
+
+
+class TestReviewRegressions:
+    def test_registry_evicts_finished(self):
+        db = DB()
+        for i in range(20):
+            db.put(b"k%d" % i, b"v")
+        assert len(db.store.txns._records) == 0
+
+    def test_error_in_txn_fn_rolls_back(self):
+        db = DB()
+        with pytest.raises(ZeroDivisionError):
+            db.txn(lambda t: (t.put(b"zz", b"v"), 1 / 0))
+        assert len(db.store.txns._records) == 0
+        t0 = time.monotonic()
+        db.put(b"zz", b"clean")  # must not stall on a zombie intent
+        assert time.monotonic() - t0 < 0.5
+        assert db.get(b"zz") == b"clean"
+
+    def test_own_read_does_not_push_write(self):
+        db = DB()
+        db.put(b"k", b"v0")
+
+        def rmw(t):
+            t.get(b"k")
+            t.put(b"k", b"v1")
+            return (t.meta.write_ts, t.meta.read_ts)
+
+        wts, rts = db.txn(rmw)
+        assert wts == rts  # no self-push, no refresh needed

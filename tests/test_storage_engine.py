@@ -228,3 +228,36 @@ class TestMVCCEdges:
         assert [v.key for v in vals] == [b"a"]
         assert len(skipped) == 1 and skipped[0][0] == b"b"
         assert skipped[0][1].id == txn.id
+
+    def test_write_batch_atomic_in_wal(self):
+        """A batch is one framed WAL record: replay applies all of it
+        (review: intent meta + provisional value must not tear)."""
+        with tempfile.TemporaryDirectory() as d:
+            eng = LSM(dir=d)
+            eng.write_batch([
+                (EngineKey.meta(b"k"), b"meta"),
+                (EngineKey.versioned(b"k", ts(5)), b"prov"),
+            ])
+            eng.close()
+            eng2 = LSM(dir=d)
+            assert eng2.stats["wal_replayed"] == 1  # one batch record
+            assert eng2.get(EngineKey.meta(b"k")) == b"meta"
+            assert eng2.get(EngineKey.versioned(b"k", ts(5))) == b"prov"
+            # torn batch: truncate mid-record -> nothing applied
+            eng2.close()
+            with open(d + "/WAL", "rb") as f:
+                raw = f.read()
+            eng3 = LSM(dir=d)
+            base = eng3.stats["wal_replayed"]
+            eng3.write_batch([
+                (EngineKey.meta(b"t"), b"m2"),
+                (EngineKey.versioned(b"t", ts(6)), b"p2"),
+            ])
+            eng3.close()
+            with open(d + "/WAL", "rb") as f:
+                full = f.read()
+            with open(d + "/WAL", "wb") as f:
+                f.write(full[:len(raw) + 8])  # tear the new record
+            eng4 = LSM(dir=d)
+            assert eng4.get(EngineKey.meta(b"t")) is None
+            assert eng4.get(EngineKey.versioned(b"t", ts(6))) is None
