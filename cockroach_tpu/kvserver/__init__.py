@@ -1,0 +1,11 @@
+"""KV server layer: Raft replication, stores/replicas, leases, liveness.
+
+TPU-native rebuild of the reference's ``pkg/kv/kvserver`` (Store/Replica,
+etcd-raft integration ``replica_raft.go``, epoch leases
+``replica_range_lease.go``, liveness ``liveness/liveness.go``). The
+replication plane is host-side control logic — it is deliberately kept
+off-device; only scan/aggregate payload work goes to the TPU.
+"""
+
+from cockroach_tpu.kvserver.raft import RaftNode, Ready, Message  # noqa: F401
+from cockroach_tpu.kvserver.transport import LocalTransport  # noqa: F401

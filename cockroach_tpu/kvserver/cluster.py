@@ -1,0 +1,218 @@
+"""In-process multi-node cluster: N stores over a local transport.
+
+The analogue of ``testcluster.StartTestCluster``
+(``pkg/testutils/testcluster/testcluster.go:58,233``): N real stores
+with real raft replication and liveness in one process, driven by a
+deterministic pump instead of goroutines. This is both the integration
+-test harness and the substrate the distributed SQL layer schedules
+flows onto.
+
+Request routing here is deliberately minimal (try replicas until the
+leaseholder answers); the full DistSender with range cache lives in
+``cockroach_tpu/kv/distsender.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from cockroach_tpu.kvserver.liveness import NodeLiveness
+from cockroach_tpu.kvserver.store import (Lease, RangeDescriptor, Replica,
+                                          Store, _enc_ts)
+from cockroach_tpu.kvserver.transport import LocalTransport
+from cockroach_tpu.storage.hlc import Clock
+
+
+class NotLeaseholderError(Exception):
+    def __init__(self, range_id: int, hint: Optional[int]):
+        super().__init__(f"r{range_id}: not leaseholder (try n{hint})")
+        self.range_id = range_id
+        self.leaseholder_hint = hint
+
+
+class Cluster:
+    def __init__(self, n_nodes: int = 3, seed: int = 0,
+                 liveness_ttl: int = 30):
+        self.transport = LocalTransport()
+        self.liveness = NodeLiveness(ttl_ticks=liveness_ttl)
+        self.clock = Clock()
+        self.stores: dict[int, Store] = {}
+        self.descriptors: dict[int, RangeDescriptor] = {}
+        self.down: set[int] = set()
+        self._next_range_id = 1
+        for node_id in range(1, n_nodes + 1):
+            self.stores[node_id] = Store(node_id, self.transport,
+                                         clock=self.clock,
+                                         liveness=self.liveness, seed=seed)
+            self.liveness.heartbeat(node_id)
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+    def create_range(self, start_key: bytes, end_key: bytes,
+                     replicas: Optional[list[int]] = None
+                     ) -> RangeDescriptor:
+        replicas = replicas or sorted(self.stores)[:3]
+        desc = RangeDescriptor(self._next_range_id, start_key, end_key,
+                               list(replicas))
+        self._next_range_id += 1
+        self.descriptors[desc.range_id] = desc
+        for nid in replicas:
+            self.stores[nid].create_replica(desc)
+        return desc
+
+    def range_for_key(self, key: bytes) -> Optional[RangeDescriptor]:
+        for d in self.descriptors.values():
+            if d.contains(key):
+                return d
+        return None
+
+    # ------------------------------------------------------------------
+    # pump (the scheduler: ticks, ready handling, message delivery)
+    # ------------------------------------------------------------------
+    def _can_heartbeat(self, nid: int) -> bool:
+        """Liveness records live in a replicated system range; a node
+        that cannot reach a quorum of the cluster cannot write its
+        heartbeat (so partitioned nodes lapse, like the reference)."""
+        n = len(self.stores)
+        reachable = 1 + sum(
+            1 for p in self.stores
+            if p != nid and p not in self.down
+            and not self.transport._blocked(nid, p))
+        return reachable > n // 2
+
+    def pump(self, iterations: int = 1) -> None:
+        for _ in range(iterations):
+            self.liveness.tick()
+            for nid, store in self.stores.items():
+                if nid in self.down:
+                    continue
+                if self._can_heartbeat(nid):
+                    self.liveness.heartbeat(nid)
+                store.tick()
+                store.handle_ready_all()
+            self.transport.deliver_all()
+            for nid, store in self.stores.items():
+                if nid not in self.down:
+                    store.handle_ready_all()
+
+    def pump_until(self, cond, max_iter: int = 500) -> bool:
+        for _ in range(max_iter):
+            if cond():
+                return True
+            self.pump()
+        return cond()
+
+    # -- fault injection -------------------------------------------
+    def stop_node(self, node_id: int) -> None:
+        self.down.add(node_id)
+        self.transport.stop_node(node_id)
+
+    def restart_node(self, node_id: int) -> None:
+        self.down.discard(node_id)
+        self.transport.restart_node(node_id)
+        self.liveness.heartbeat(node_id)
+
+    # ------------------------------------------------------------------
+    # leases
+    # ------------------------------------------------------------------
+    def acquire_lease(self, range_id: int, node_id: int,
+                      max_iter: int = 500) -> bool:
+        """Have node_id's replica acquire the epoch lease for the range
+        (request_lease path of replica_range_lease.go): fence a dead
+        prior holder by epoch increment, then replicate a lease record."""
+        rep = self.stores[node_id].replicas.get(range_id)
+        if rep is None:
+            return False
+        self.pump_until(lambda: rep.raft.is_leader() or
+                        rep.raft.leader_id is not None, max_iter)
+        if not rep.raft.is_leader():
+            return False
+        cur = rep.lease
+        if cur.holder and cur.holder != node_id and \
+                self.liveness.epoch_of(cur.holder) == cur.epoch and \
+                self.liveness.is_live(cur.holder):
+            return False         # current holder is alive and unfenced
+        if cur.holder and cur.holder != node_id and \
+                self.liveness.epoch_of(cur.holder) == cur.epoch:
+            if not self.liveness.increment_epoch(cur.holder):
+                return False
+        done = {"ok": False}
+
+        def cb(_):
+            done["ok"] = True
+
+        rep.propose({"kind": "lease", "holder": node_id,
+                     "epoch": self.liveness.epoch_of(node_id)}, cb)
+        self.pump_until(lambda: done["ok"], max_iter)
+        return done["ok"] and rep.holds_lease()
+
+    def leaseholder(self, range_id: int) -> Optional[int]:
+        for nid, store in self.stores.items():
+            if nid in self.down:
+                continue
+            rep = store.replicas.get(range_id)
+            if rep is not None and rep.holds_lease():
+                return nid
+        return None
+
+    def ensure_lease(self, range_id: int) -> Optional[int]:
+        lh = self.leaseholder(range_id)
+        if lh is not None:
+            return lh
+        desc = self.descriptors[range_id]
+        # prefer the raft leader; it can acquire immediately
+        for nid in desc.replicas:
+            if nid in self.down:
+                continue
+            rep = self.stores[nid].replicas.get(range_id)
+            if rep and rep.raft.is_leader() and \
+                    self.acquire_lease(range_id, nid):
+                return nid
+        for nid in desc.replicas:
+            if nid not in self.down and self.acquire_lease(range_id, nid):
+                return nid
+        return None
+
+    # ------------------------------------------------------------------
+    # KV client API (simple router; DistSender supersedes this)
+    # ------------------------------------------------------------------
+    def _leaseholder_replica(self, key: bytes) -> Replica:
+        desc = self.range_for_key(key)
+        if desc is None:
+            raise KeyError(f"no range for key {key!r}")
+        lh = self.ensure_lease(desc.range_id)
+        if lh is None:
+            raise RuntimeError(f"r{desc.range_id}: no leaseholder "
+                               "(quorum lost?)")
+        return self.stores[lh].replicas[desc.range_id]
+
+    def put(self, key: bytes, value: bytes, max_iter: int = 500) -> None:
+        rep = self._leaseholder_replica(key)
+        done = {"ok": False}
+
+        def cb(_):
+            done["ok"] = True
+
+        cmd = {"kind": "batch", "ops": [{
+            "op": "put", "key": key.decode("latin1"),
+            "value": value.decode("latin1"),
+            "ts": _enc_ts(self.clock.now()),
+        }]}
+        if not rep.propose(cmd, cb):
+            raise RuntimeError("proposal rejected (not leader)")
+        if not self.pump_until(lambda: done["ok"], max_iter):
+            raise RuntimeError("proposal did not commit (quorum lost?)")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        rep = self._leaseholder_replica(key)
+        return rep.read({"op": "get", "key": key.decode("latin1"),
+                         "ts": _enc_ts(self.clock.now())})
+
+    def scan(self, start: bytes, end: bytes, limit: int = 0):
+        rep = self._leaseholder_replica(start)
+        return rep.read({"op": "scan", "start": start.decode("latin1"),
+                         "end": end.decode("latin1"),
+                         "ts": _enc_ts(self.clock.now()),
+                         "limit": limit})

@@ -1,0 +1,250 @@
+"""Store/Replica: ranges replicated by Raft, applied to the MVCC engine.
+
+Rebuild of the reference's core kvserver objects:
+- ``Store`` (``pkg/kv/kvserver/store.go``): per-node container of
+  replicas, routes incoming requests/raft traffic by range, pumps the
+  raft scheduler (``scheduler.go:181`` worker pool → here a
+  deterministic ``pump()``).
+- ``Replica`` (``replica.go``, ``replica_send.go:113``): one member of
+  one range's consensus group. Write path mirrors
+  ``executeWriteBatch`` → ``evalAndPropose`` (``replica_raft.go:105``):
+  commands are proposed to raft and applied to the local MVCC engine
+  once committed; reads are served by the leaseholder without
+  consensus (``replica_read.go:43``).
+- Epoch leases (``replica_range_lease.go``): the lease record is itself
+  replicated state; validity is tied to node-liveness epochs so a dead
+  leaseholder is fenced by incrementing its epoch.
+
+Commands are JSON-encoded MVCC batches — evaluation is deterministic,
+so applying the same log yields identical engines on every replica.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cockroach_tpu.kvserver.raft import RaftNode, Snapshot
+from cockroach_tpu.storage.hlc import Clock, Timestamp
+from cockroach_tpu.storage.keys import EngineKey
+from cockroach_tpu.storage.mvcc import MVCC, TxnMeta
+
+
+@dataclass
+class RangeDescriptor:
+    """Which nodes replicate [start_key, end_key) (roachpb.RangeDescriptor)."""
+
+    range_id: int
+    start_key: bytes
+    end_key: bytes
+    replicas: list[int]          # node ids
+    generation: int = 0
+
+    def contains(self, key: bytes) -> bool:
+        return self.start_key <= key < self.end_key
+
+
+@dataclass
+class Lease:
+    holder: int                  # node id; 0 = none
+    epoch: int = 0               # liveness epoch of the holder
+    sequence: int = 0
+
+
+def _enc_ts(t: Timestamp) -> list:
+    return [t.wall, t.logical]
+
+
+def _dec_ts(v: list) -> Timestamp:
+    return Timestamp(v[0], v[1])
+
+
+class Replica:
+    def __init__(self, store: "Store", desc: RangeDescriptor):
+        self.store = store
+        self.desc = desc
+        self.raft = RaftNode(store.node_id, list(desc.replicas),
+                             rng=store.rng_for(desc.range_id))
+        self.mvcc = MVCC()
+        self.lease = Lease(holder=0)
+        self.applied_index = 0
+        self._waiters: dict[int, Callable] = {}
+        self.raft_log_size = 0
+
+    # ------------------------------------------------------------------
+    # read / write entry points (leaseholder-gated)
+    # ------------------------------------------------------------------
+    def holds_lease(self) -> bool:
+        if self.lease.holder != self.store.node_id:
+            return False
+        lv = self.store.liveness
+        if lv is None:
+            return self.raft.is_leader()
+        return lv.epoch_of(self.store.node_id) == self.lease.epoch and \
+            lv.is_live(self.store.node_id)
+
+    def read(self, op: dict) -> object:
+        """Serve a read at this replica (caller checked the lease)."""
+        read_ts = _dec_ts(op["ts"])
+        if op["op"] == "get":
+            mv = self.mvcc.get(op["key"].encode(), read_ts)
+            return None if mv is None else mv.value
+        if op["op"] == "scan":
+            return [(mv.key, mv.value) for mv in self.mvcc.scan(
+                op["start"].encode(), op["end"].encode(), read_ts,
+                max_keys=op.get("limit", 0))]
+        raise ValueError(f"unknown read op {op['op']}")
+
+    def propose(self, cmd: dict, done: Optional[Callable] = None) -> bool:
+        """Propose a write command; ``done(result)`` fires on apply."""
+        data = json.dumps(cmd).encode()
+        idx = self.raft.propose(data)
+        if idx is None:
+            return False
+        if done is not None:
+            self._waiters[idx] = done
+        return True
+
+    # ------------------------------------------------------------------
+    # raft plumbing
+    # ------------------------------------------------------------------
+    def step(self, msg) -> None:
+        self.raft.step(msg)
+
+    def tick(self) -> None:
+        self.raft.tick()
+
+    def handle_ready(self) -> None:
+        rd = self.raft.ready()
+        if not rd.any():
+            return
+        if rd.snapshot is not None:
+            self._apply_snapshot(rd.snapshot)
+        for e in rd.entries:
+            self.raft_log_size += len(e.data)
+        for m in rd.messages:
+            self.store.transport.send(self.store.node_id, m.to,
+                                      (self.desc.range_id, m))
+        for e in rd.committed_entries:
+            self._apply(e.index, e.data)
+        # size-triggered raft log truncation (raft_log_queue analogue)
+        if self.raft_log_size > self.store.raft_log_max and \
+                self.raft.is_leader():
+            self.raft.compact(self.applied_index, self._snapshot_state())
+            self.raft_log_size = 0
+
+    # ------------------------------------------------------------------
+    # state machine
+    # ------------------------------------------------------------------
+    def _apply(self, index: int, data: bytes) -> None:
+        self.applied_index = index
+        result = None
+        if data:
+            cmd = json.loads(data.decode())
+            result = self._eval(cmd)
+        done = self._waiters.pop(index, None)
+        if done is not None:
+            done(result)
+
+    def _eval(self, cmd: dict) -> object:
+        kind = cmd.get("kind")
+        if kind == "batch":
+            out = []
+            for op in cmd["ops"]:
+                out.append(self._eval_op(op))
+            return out
+        if kind == "lease":
+            self.lease = Lease(cmd["holder"], cmd["epoch"],
+                               self.lease.sequence + 1)
+            return self.lease
+        raise ValueError(f"unknown command kind {kind}")
+
+    def _eval_op(self, op: dict) -> object:
+        o = op["op"]
+        wts = _dec_ts(op["ts"]) if "ts" in op else None
+        txn = TxnMeta.from_json(op["txn"].encode()) if op.get("txn") else None
+        if o == "put":
+            self.mvcc.put(op["key"].encode(), wts,
+                          op["value"].encode(), txn=txn)
+            return True
+        if o == "delete":
+            self.mvcc.delete(op["key"].encode(), wts, txn=txn)
+            return True
+        if o == "resolve":
+            self.mvcc.resolve_intent(op["key"].encode(), txn,
+                                     commit=op["commit"])
+            return True
+        raise ValueError(f"unknown write op {o}")
+
+    # ------------------------------------------------------------------
+    # snapshots (InstallSnapshot / store_snapshot.go analogue)
+    # ------------------------------------------------------------------
+    def _snapshot_state(self) -> bytes:
+        items = [(k.encode().decode("latin1"), v.decode("latin1"))
+                 for k, v in self.mvcc.engine.scan(EngineKey(b"", -1))]
+        return json.dumps({
+            "kv": items,
+            "lease": [self.lease.holder, self.lease.epoch,
+                      self.lease.sequence],
+        }).encode()
+
+    def _apply_snapshot(self, snap: Snapshot) -> None:
+        if not snap.data:
+            return
+        state = json.loads(snap.data.decode())
+        self.mvcc = MVCC()
+        for k, v in state["kv"]:
+            self.mvcc.engine.put(EngineKey.decode(k.encode("latin1")),
+                                 v.encode("latin1"))
+        h, e, s = state["lease"]
+        self.lease = Lease(h, e, s)
+        self.applied_index = snap.index
+
+
+class Store:
+    """All replicas on one node (pkg/kv/kvserver/store.go)."""
+
+    def __init__(self, node_id: int, transport, clock: Optional[Clock] = None,
+                 liveness=None, raft_log_max: int = 1 << 20, seed: int = 0):
+        self.node_id = node_id
+        self.transport = transport
+        self.clock = clock or Clock()
+        self.liveness = liveness
+        self.raft_log_max = raft_log_max
+        self.replicas: dict[int, Replica] = {}
+        self._seed = seed
+        transport.register(node_id, self._handle_raft_message)
+
+    def rng_for(self, range_id: int):
+        import random
+        return random.Random((self._seed << 16) ^ (self.node_id << 8)
+                             ^ range_id)
+
+    def create_replica(self, desc: RangeDescriptor) -> Replica:
+        r = Replica(self, desc)
+        self.replicas[desc.range_id] = r
+        return r
+
+    def remove_replica(self, range_id: int) -> None:
+        self.replicas.pop(range_id, None)
+
+    def replica_for_key(self, key: bytes) -> Optional[Replica]:
+        for r in self.replicas.values():
+            if r.desc.contains(key):
+                return r
+        return None
+
+    def _handle_raft_message(self, frm: int, payload) -> None:
+        range_id, msg = payload
+        r = self.replicas.get(range_id)
+        if r is not None:
+            r.step(msg)
+
+    def tick(self) -> None:
+        for r in list(self.replicas.values()):
+            r.tick()
+
+    def handle_ready_all(self) -> None:
+        for r in list(self.replicas.values()):
+            r.handle_ready()
