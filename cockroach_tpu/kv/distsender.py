@@ -1,0 +1,197 @@
+"""DistSender: range-addressed batch routing with retries.
+
+Rebuild of ``pkg/kv/kvclient/kvcoord/dist_sender.go:299,795``:
+- splits a batch of point/span ops by range boundaries
+  (``divideAndSendBatchToRanges`` ``:1210``),
+- routes each piece to the cached leaseholder, trying other replicas
+  on failure,
+- refreshes stale cache entries from the meta authority (here the
+  cluster's descriptor map — the analogue of the meta ranges) on
+  NotLeaseholder / RangeKeyMismatch, and retries with backoff.
+
+The transport is an in-process call into the target store (the gRPC
+``Internal.Batch`` boundary of the reference).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cockroach_tpu.kv.rangecache import RangeCache
+from cockroach_tpu.kvserver.cluster import Cluster, NotLeaseholderError
+from cockroach_tpu.kvserver.store import RangeBoundsError, _enc_ts
+from cockroach_tpu.storage.hlc import Timestamp
+
+
+class RangeKeyMismatchError(Exception):
+    pass
+
+
+@dataclass
+class BatchRequest:
+    """A list of op dicts: {op: get|scan|put|delete, key|start/end, ...}."""
+
+    ops: list[dict] = field(default_factory=list)
+
+    def get(self, key: bytes) -> "BatchRequest":
+        self.ops.append({"op": "get", "key": key})
+        return self
+
+    def scan(self, start: bytes, end: bytes,
+             limit: int = 0) -> "BatchRequest":
+        self.ops.append({"op": "scan", "start": start, "end": end,
+                         "limit": limit})
+        return self
+
+    def put(self, key: bytes, value: bytes) -> "BatchRequest":
+        self.ops.append({"op": "put", "key": key, "value": value})
+        return self
+
+    def delete(self, key: bytes) -> "BatchRequest":
+        self.ops.append({"op": "delete", "key": key})
+        return self
+
+
+class DistSender:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.cache = RangeCache()
+        self.retries = 0
+        self.rpcs = 0
+
+    # ------------------------------------------------------------------
+    # meta lookup (the meta-range scan of the reference)
+    # ------------------------------------------------------------------
+    def _meta_lookup(self, key: bytes):
+        desc = self.cluster.range_for_key(key)
+        if desc is None:
+            raise KeyError(f"no range containing {key!r}")
+        self.cache.insert(desc)
+        return self.cache.lookup(key)
+
+    def _entry_for(self, key: bytes):
+        e = self.cache.lookup(key)
+        if e is None:
+            e = self._meta_lookup(key)
+        return e
+
+    # ------------------------------------------------------------------
+    # sending
+    # ------------------------------------------------------------------
+    def send(self, batch: BatchRequest,
+             ts: Optional[Timestamp] = None) -> list:
+        """Execute the batch; per-op results positionally.
+
+        Results: get→value|None, scan→[(k,v)], put/delete→True.
+        """
+        ts = ts or self.cluster.clock.now()
+        results: list = [None] * len(batch.ops)
+        for i, op in enumerate(batch.ops):
+            if op["op"] == "scan":
+                results[i] = self._send_scan(op, ts)
+            else:
+                results[i] = self._send_point(op, ts)
+        return results
+
+    def _send_point(self, op: dict, ts: Timestamp, attempts: int = 8):
+        key = op["key"]
+        for _ in range(attempts):
+            entry = self._entry_for(key)
+            desc = entry.desc
+            try:
+                return self._rpc(desc, entry, op, ts, key)
+            except (RangeKeyMismatchError, RangeBoundsError, KeyError):
+                self.retries += 1
+                self.cache.evict(key)
+            except NotLeaseholderError as e:
+                self.retries += 1
+                if e.hint:
+                    self.cache.update_leaseholder(key, e.hint)
+                else:
+                    self.cache.evict(key)
+                self.cluster.pump(2)
+        raise RuntimeError(f"batch op to {key!r} exhausted retries")
+
+    def _send_scan(self, op: dict, ts: Timestamp) -> list:
+        """Iterate range-by-range across split boundaries
+        (divideAndSendBatchToRanges)."""
+        out = []
+        cur, end = op["start"], op["end"]
+        limit = op.get("limit", 0)
+        while cur < end:
+            entry = self._entry_for(cur)
+            desc = entry.desc
+            piece = dict(op)
+            piece["start"] = cur
+            piece["end"] = min(end, desc.end_key)
+            remaining = 0
+            if limit:
+                remaining = limit - len(out)
+                if remaining <= 0:
+                    break
+                piece["limit"] = remaining
+            try:
+                out.extend(self._rpc(desc, entry, piece, ts, cur))
+            except (RangeKeyMismatchError, RangeBoundsError, KeyError,
+                    NotLeaseholderError):
+                self.retries += 1
+                self.cache.evict(cur)
+                self.cluster.pump(2)
+                continue
+            cur = desc.end_key
+        return out
+
+    def _rpc(self, desc, entry, op: dict, ts: Timestamp, key: bytes):
+        """One Internal.Batch 'RPC' against a replica of desc."""
+        self.rpcs += 1
+        order = [entry.leaseholder] if entry.leaseholder else []
+        order += [n for n in desc.replicas if n not in order]
+        last_err: Exception = NotLeaseholderError()
+        for nid in order:
+            if nid in self.cluster.down:
+                continue
+            store = self.cluster.stores.get(nid)
+            rep = store.replicas.get(desc.range_id) if store else None
+            if rep is None:
+                last_err = RangeKeyMismatchError()
+                continue
+            # range bounds may have moved (split/merge) since caching
+            if not rep.desc.contains(key):
+                self.cache.insert(copy.deepcopy(rep.desc))
+                last_err = RangeKeyMismatchError()
+                continue
+            if not rep.holds_lease():
+                lh = self.cluster.ensure_lease(desc.range_id)
+                if lh is not None and lh != nid:
+                    last_err = NotLeaseholderError(hint=lh)
+                    continue
+                if lh is None:
+                    last_err = NotLeaseholderError()
+                    continue
+                rep = self.cluster.stores[lh].replicas[desc.range_id]
+            entry.leaseholder = rep.store.node_id
+            return self._execute(rep, op, ts)
+        raise last_err
+
+    def _execute(self, rep, op: dict, ts: Timestamp):
+        o = dict(op)
+        kind = o.pop("op")
+        if kind in ("get", "scan"):
+            req = {"op": kind, "ts": _enc_ts(ts)}
+            if kind == "get":
+                req["key"] = op["key"].decode("latin1")
+            else:
+                req["start"] = op["start"].decode("latin1")
+                req["end"] = op["end"].decode("latin1")
+                req["limit"] = op.get("limit", 0)
+            return rep.read(req)
+        # writes go through raft
+        wire = {"op": kind, "key": op["key"].decode("latin1"),
+                "ts": _enc_ts(ts)}
+        if kind == "put":
+            wire["value"] = op["value"].decode("latin1")
+        self.cluster.propose_and_wait(rep, {"kind": "batch",
+                                            "ops": [wire]})
+        return True

@@ -18,17 +18,20 @@ import json
 from typing import Optional
 
 from cockroach_tpu.kvserver.liveness import NodeLiveness
-from cockroach_tpu.kvserver.store import (Lease, RangeDescriptor, Replica,
-                                          Store, _enc_ts)
+from cockroach_tpu.kvserver.store import (EngineKey, Lease, RangeDescriptor,
+                                          Replica, Store, _enc_ts)
 from cockroach_tpu.kvserver.transport import LocalTransport
 from cockroach_tpu.storage.hlc import Clock
 
 
 class NotLeaseholderError(Exception):
-    def __init__(self, range_id: int, hint: Optional[int]):
+    """Request hit a non-leaseholder replica; retry at ``hint``."""
+
+    def __init__(self, range_id: Optional[int] = None,
+                 hint: Optional[int] = None):
         super().__init__(f"r{range_id}: not leaseholder (try n{hint})")
         self.range_id = range_id
-        self.leaseholder_hint = hint
+        self.hint = hint
 
 
 class Cluster:
@@ -115,6 +118,142 @@ class Cluster:
         self.liveness.heartbeat(node_id)
 
     # ------------------------------------------------------------------
+    # range lifecycle (split/merge queues + replicate queue/allocator)
+    # ------------------------------------------------------------------
+    def propose_and_wait(self, rep, cmd: dict, max_iter: int = 500):
+        """Propose on ``rep`` (forwarding to the leader as needed) and
+        pump until the command applies locally; retries around
+        elections. Raises if the command never commits."""
+        out = {}
+
+        def cb(result):
+            out["result"] = result
+            out["ok"] = True
+
+        for _ in range(5):
+            if rep.propose(cmd, cb):
+                if self.pump_until(lambda: "ok" in out, max_iter):
+                    return out["result"]
+            else:
+                self.pump(5)
+        rep._waiters.pop(cmd.get("_id", ""), None)   # don't leak the cb
+        raise RuntimeError("proposal did not commit (quorum lost?)")
+
+    def _propose_admin(self, range_id: int, cmd: dict,
+                       max_iter: int = 500):
+        lh = self.ensure_lease(range_id)
+        if lh is None:
+            raise RuntimeError(f"r{range_id}: no leaseholder")
+        rep = self.stores[lh].replicas[range_id]
+        return self.propose_and_wait(rep, cmd, max_iter)
+
+    def split_range(self, key: bytes) -> RangeDescriptor:
+        """AdminSplit: replicate a split trigger through the LHS group."""
+        lhs = self.range_for_key(key)
+        if lhs is None:
+            raise KeyError(f"no range for {key!r}")
+        if lhs.start_key == key:
+            return lhs
+        new_id = self._next_range_id
+        self._next_range_id += 1
+        rhs = self._propose_admin(lhs.range_id, {
+            "kind": "split", "key": key.decode("latin1"),
+            "new_range_id": new_id,
+        })
+        self.descriptors[new_id] = RangeDescriptor(
+            new_id, key, lhs.end_key, list(lhs.replicas))
+        lhs.end_key = key
+        return self.descriptors[new_id]
+
+    def merge_ranges(self, lhs_range_id: int) -> RangeDescriptor:
+        """AdminMerge: absorb the right-hand neighbour into the LHS."""
+        lhs = self.descriptors[lhs_range_id]
+        rhs = next((d for d in self.descriptors.values()
+                    if d.start_key == lhs.end_key), None)
+        if rhs is None:
+            raise KeyError("no right-hand neighbour")
+        if sorted(rhs.replicas) != sorted(lhs.replicas):
+            raise RuntimeError("merge requires colocated replica sets")
+        # subsume: freeze the RHS by reading its full state from the
+        # (caught-up) leaseholder and carrying it in the merge trigger
+        rhs_lh = self.ensure_lease(rhs.range_id)
+        if rhs_lh is None:
+            raise RuntimeError(f"r{rhs.range_id}: no leaseholder")
+        rhs_rep = self.stores[rhs_lh].replicas[rhs.range_id]
+        rhs_state = [(ek.encode().decode("latin1"),
+                      None if v is None else v.decode("latin1"))
+                     for ek, v in rhs_rep.mvcc.engine.scan(
+                         EngineKey(b"", -1), include_tombstones=True)]
+        self._propose_admin(lhs_range_id, {
+            "kind": "merge", "rhs_range_id": rhs.range_id,
+            "rhs_end_key": rhs.end_key.decode("latin1"),
+            "rhs_state": rhs_state,
+        })
+        lhs.end_key = rhs.end_key
+        del self.descriptors[rhs.range_id]
+        return lhs
+
+    def change_replicas(self, range_id: int,
+                        add: Optional[int] = None,
+                        remove: Optional[int] = None) -> None:
+        """One replica at a time (the simple-majority membership-change
+        restriction; the reference uses joint consensus to lift it)."""
+        desc = self.descriptors[range_id]
+        new = [n for n in desc.replicas if n != remove]
+        if add is not None and add not in new:
+            new.append(add)
+        if add is not None:
+            # materialize the learner replica before the config commits
+            # so it can receive raft traffic (snapshot-before-voter)
+            self.stores[add].create_replica(
+                RangeDescriptor(range_id, desc.start_key, desc.end_key,
+                                list(new), desc.generation + 1))
+        self._propose_admin(range_id, {
+            "kind": "change_replicas", "replicas": new,
+        })
+        desc.replicas = new
+        desc.generation += 1
+        if remove is not None and remove in self.stores:
+            # replicaGC-queue analogue: the removed node stops getting
+            # raft traffic before it can apply its own removal, so the
+            # orchestrator (meta authority) collects the husk
+            self.stores[remove].remove_replica(range_id)
+
+    def replicate_queue_scan(self, target: int = 3) -> list[str]:
+        """The replicate queue + allocator ComputeAction analogue:
+        up-replicate under-replicated ranges and replace replicas on
+        dead nodes (allocatorimpl/allocator.go:560)."""
+        actions = []
+        live = [n for n in self.stores if n not in self.down
+                and self.liveness.is_live(n)]
+        load = {n: 0 for n in live}
+        for d in self.descriptors.values():
+            for n in d.replicas:
+                if n in load:
+                    load[n] += 1
+        for d in list(self.descriptors.values()):
+            dead = [n for n in d.replicas if n not in live]
+            live_members = [n for n in d.replicas if n in live]
+            # replace-dead first (only while quorum of the old config
+            # still stands), then up-replicate
+            candidates = sorted((n for n in live if n not in d.replicas),
+                                key=lambda n: load[n])
+            if dead and len(live_members) > len(d.replicas) // 2 \
+                    and candidates:
+                add = candidates[0]
+                self.change_replicas(d.range_id, add=add,
+                                     remove=dead[0])
+                load[add] += 1
+                actions.append(f"r{d.range_id}: replace n{dead[0]} "
+                               f"with n{add}")
+            elif len(d.replicas) < min(target, len(live)) and candidates:
+                add = candidates[0]
+                self.change_replicas(d.range_id, add=add)
+                load[add] += 1
+                actions.append(f"r{d.range_id}: add n{add}")
+        return actions
+
+    # ------------------------------------------------------------------
     # leases
     # ------------------------------------------------------------------
     def acquire_lease(self, range_id: int, node_id: int,
@@ -138,15 +277,13 @@ class Cluster:
                 self.liveness.epoch_of(cur.holder) == cur.epoch:
             if not self.liveness.increment_epoch(cur.holder):
                 return False
-        done = {"ok": False}
-
-        def cb(_):
-            done["ok"] = True
-
-        rep.propose({"kind": "lease", "holder": node_id,
-                     "epoch": self.liveness.epoch_of(node_id)}, cb)
-        self.pump_until(lambda: done["ok"], max_iter)
-        return done["ok"] and rep.holds_lease()
+        try:
+            self.propose_and_wait(rep, {
+                "kind": "lease", "holder": node_id,
+                "epoch": self.liveness.epoch_of(node_id)}, max_iter)
+        except RuntimeError:
+            return False
+        return rep.holds_lease()
 
     def leaseholder(self, range_id: int) -> Optional[int]:
         for nid, store in self.stores.items():
@@ -190,20 +327,12 @@ class Cluster:
 
     def put(self, key: bytes, value: bytes, max_iter: int = 500) -> None:
         rep = self._leaseholder_replica(key)
-        done = {"ok": False}
-
-        def cb(_):
-            done["ok"] = True
-
         cmd = {"kind": "batch", "ops": [{
             "op": "put", "key": key.decode("latin1"),
             "value": value.decode("latin1"),
             "ts": _enc_ts(self.clock.now()),
         }]}
-        if not rep.propose(cmd, cb):
-            raise RuntimeError("proposal rejected (not leader)")
-        if not self.pump_until(lambda: done["ok"], max_iter):
-            raise RuntimeError("proposal did not commit (quorum lost?)")
+        self.propose_and_wait(rep, cmd, max_iter)
 
     def get(self, key: bytes) -> Optional[bytes]:
         rep = self._leaseholder_replica(key)
@@ -211,8 +340,23 @@ class Cluster:
                          "ts": _enc_ts(self.clock.now())})
 
     def scan(self, start: bytes, end: bytes, limit: int = 0):
-        rep = self._leaseholder_replica(start)
-        return rep.read({"op": "scan", "start": start.decode("latin1"),
-                         "end": end.decode("latin1"),
-                         "ts": _enc_ts(self.clock.now()),
-                         "limit": limit})
+        """Range-by-range scan across split boundaries (the simple
+        client; DistSender adds caching and parallelism)."""
+        out: list = []
+        ts = _enc_ts(self.clock.now())
+        cur = start
+        while cur < end:
+            desc = self.range_for_key(cur)
+            if desc is None:
+                break
+            rep = self._leaseholder_replica(cur)
+            piece_end = min(end, rep.desc.end_key)
+            remaining = limit - len(out) if limit else 0
+            if limit and remaining <= 0:
+                break
+            out.extend(rep.read({
+                "op": "scan", "start": cur.decode("latin1"),
+                "end": piece_end.decode("latin1"), "ts": ts,
+                "limit": remaining}))
+            cur = rep.desc.end_key
+        return out

@@ -269,6 +269,22 @@ class RaftNode:
     def is_leader(self) -> bool:
         return self.state == LEADER
 
+    def update_membership(self, peers: list[int]) -> None:
+        """Apply a membership change (the reference uses joint consensus
+        via etcd ConfChange; we apply the simple single-step form — the
+        embedder must change one replica at a time)."""
+        self.peers = [p for p in peers if p != self.id]
+        self.quorum = (len(peers) // 2) + 1
+        if self.state == LEADER:
+            last = self.log.last_index()
+            for p in self.peers:
+                self.next_index.setdefault(p, last + 1)
+                self.match_index.setdefault(p, 0)
+            for gone in [p for p in self.next_index if p not in self.peers]:
+                self.next_index.pop(gone, None)
+                self.match_index.pop(gone, None)
+            self._maybe_commit()
+
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------

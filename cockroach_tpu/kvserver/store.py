@@ -21,7 +21,9 @@ so applying the same log yields identical engines on every replica.
 
 from __future__ import annotations
 
+import copy
 import json
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -52,6 +54,16 @@ class Lease:
     sequence: int = 0
 
 
+class RangeBoundsError(Exception):
+    """Request span is outside the replica's bounds (RangeKeyMismatch)."""
+
+    def __init__(self, desc: RangeDescriptor, key: bytes):
+        super().__init__(
+            f"key {key!r} outside r{desc.range_id} "
+            f"[{desc.start_key!r},{desc.end_key!r})")
+        self.desc = desc
+
+
 def _enc_ts(t: Timestamp) -> list:
     return [t.wall, t.logical]
 
@@ -69,7 +81,11 @@ class Replica:
         self.mvcc = MVCC()
         self.lease = Lease(holder=0)
         self.applied_index = 0
-        self._waiters: dict[int, Callable] = {}
+        self._waiters: dict[str, Callable] = {}
+        # bounded dedup window for retried forwarded proposals
+        self._applied_ids: set[str] = set()
+        self._applied_order: deque[str] = deque()
+        self._next_cmd = 0
         self.raft_log_size = 0
 
     # ------------------------------------------------------------------
@@ -85,26 +101,49 @@ class Replica:
             lv.is_live(self.store.node_id)
 
     def read(self, op: dict) -> object:
-        """Serve a read at this replica (caller checked the lease)."""
+        """Serve a read at this replica (caller checked the lease).
+
+        Spans are validated against the replica's bounds, like the
+        server-side CheckRequest validation in the reference: a scan
+        must not silently return a partial answer after a split."""
         read_ts = _dec_ts(op["ts"])
         if op["op"] == "get":
-            mv = self.mvcc.get(op["key"].encode(), read_ts)
+            key = op["key"].encode("latin1")
+            if not self.desc.contains(key):
+                raise RangeBoundsError(self.desc, key)
+            mv = self.mvcc.get(key, read_ts)
             return None if mv is None else mv.value
         if op["op"] == "scan":
+            start = op["start"].encode("latin1")
+            end = op["end"].encode("latin1")
+            if not self.desc.contains(start) or end > self.desc.end_key:
+                raise RangeBoundsError(self.desc, start)
             return [(mv.key, mv.value) for mv in self.mvcc.scan(
-                op["start"].encode(), op["end"].encode(), read_ts,
-                max_keys=op.get("limit", 0))]
+                start, end, read_ts, max_keys=op.get("limit", 0))]
         raise ValueError(f"unknown read op {op['op']}")
 
     def propose(self, cmd: dict, done: Optional[Callable] = None) -> bool:
-        """Propose a write command; ``done(result)`` fires on apply."""
-        data = json.dumps(cmd).encode()
-        idx = self.raft.propose(data)
-        if idx is None:
-            return False
+        """Propose a write command; ``done(result)`` fires when the
+        command applies on THIS replica. Non-leader replicas forward to
+        the known leader (etcd raft's MsgProp forwarding) — commands
+        are tracked by id, not log index, so completion is observed
+        locally regardless of who appended the entry."""
+        if "_id" not in cmd:
+            self._next_cmd += 1
+            cmd["_id"] = f"{self.store.node_id}.{self._next_cmd}"
         if done is not None:
-            self._waiters[idx] = done
-        return True
+            self._waiters[cmd["_id"]] = done
+        if self.raft.is_leader():
+            self.raft.propose(json.dumps(cmd).encode())
+            return True
+        leader = self.raft.leader_id
+        if leader is not None and leader != self.store.node_id:
+            self.store.transport.send(
+                self.store.node_id, leader,
+                (self.desc.range_id, ("prop", cmd)))
+            return True
+        self._waiters.pop(cmd["_id"], None)
+        return False
 
     # ------------------------------------------------------------------
     # raft plumbing
@@ -125,7 +164,7 @@ class Replica:
             self.raft_log_size += len(e.data)
         for m in rd.messages:
             self.store.transport.send(self.store.node_id, m.to,
-                                      (self.desc.range_id, m))
+                                      (self.desc.range_id, ("msg", m)))
         for e in rd.committed_entries:
             self._apply(e.index, e.data)
         # size-triggered raft log truncation (raft_log_queue analogue)
@@ -139,11 +178,19 @@ class Replica:
     # ------------------------------------------------------------------
     def _apply(self, index: int, data: bytes) -> None:
         self.applied_index = index
-        result = None
-        if data:
-            cmd = json.loads(data.decode())
-            result = self._eval(cmd)
-        done = self._waiters.pop(index, None)
+        if not data:
+            return
+        cmd = json.loads(data.decode())
+        cmd_id = cmd.get("_id", "")
+        if cmd_id and cmd_id in self._applied_ids:
+            return      # retried forward landed twice: apply once
+        if cmd_id:
+            self._applied_ids.add(cmd_id)
+            self._applied_order.append(cmd_id)
+            while len(self._applied_order) > 10000:
+                self._applied_ids.discard(self._applied_order.popleft())
+        result = self._eval(cmd)
+        done = self._waiters.pop(cmd_id, None)
         if done is not None:
             done(result)
 
@@ -158,21 +205,81 @@ class Replica:
             self.lease = Lease(cmd["holder"], cmd["epoch"],
                                self.lease.sequence + 1)
             return self.lease
+        if kind == "split":
+            return self._apply_split(cmd)
+        if kind == "merge":
+            return self._apply_merge(cmd)
+        if kind == "change_replicas":
+            return self._apply_change_replicas(cmd)
         raise ValueError(f"unknown command kind {kind}")
+
+    # -- range lifecycle triggers (applied below raft, so they run
+    # deterministically on every replica: splitTrigger/mergeTrigger of
+    # batcheval/cmd_end_transaction.go, simplified) -------------------
+    def _apply_split(self, cmd: dict) -> RangeDescriptor:
+        split_key = cmd["key"].encode("latin1")
+        rhs = RangeDescriptor(cmd["new_range_id"], split_key,
+                              self.desc.end_key, list(self.desc.replicas),
+                              generation=self.desc.generation + 1)
+        self.desc.end_key = split_key
+        self.desc.generation += 1
+        rhs_rep = self.store.create_replica(rhs)
+        # move user data at keys >= split_key into the RHS engine;
+        # local move — no snapshot needed, exactly like splitTrigger
+        moved = []
+        for ek, v in list(self.mvcc.engine.scan(EngineKey(split_key, -1),
+                                                include_tombstones=True)):
+            if ek.key >= split_key:
+                moved.append((ek, v))
+        for ek, v in moved:
+            if v is not None:
+                rhs_rep.mvcc.engine.put(ek, v)
+            else:
+                rhs_rep.mvcc.engine.delete(ek)
+            self.mvcc.engine.delete(ek)
+        rhs_rep.lease = Lease(self.lease.holder, self.lease.epoch,
+                              sequence=1)
+        return rhs
+
+    def _apply_merge(self, cmd: dict) -> RangeDescriptor:
+        # the merge trigger carries the subsumed RHS state in the
+        # command (the orchestrator read it from the RHS leaseholder at
+        # freeze time), so application is deterministic even on stores
+        # whose local RHS replica lags or is absent
+        for k, v in cmd["rhs_state"]:
+            ek = EngineKey.decode(k.encode("latin1"))
+            if v is not None:
+                self.mvcc.engine.put(ek, v.encode("latin1"))
+            else:
+                self.mvcc.engine.delete(ek)
+        self.desc.end_key = cmd["rhs_end_key"].encode("latin1")
+        self.desc.generation += 1
+        self.store.remove_replica(cmd["rhs_range_id"])
+        return self.desc
+
+    def _apply_change_replicas(self, cmd: dict) -> RangeDescriptor:
+        new_replicas = list(cmd["replicas"])
+        self.desc.replicas = new_replicas
+        self.desc.generation += 1
+        if self.store.node_id not in new_replicas:
+            self.store.remove_replica(self.desc.range_id)
+        else:
+            self.raft.update_membership(new_replicas)
+        return self.desc
 
     def _eval_op(self, op: dict) -> object:
         o = op["op"]
         wts = _dec_ts(op["ts"]) if "ts" in op else None
         txn = TxnMeta.from_json(op["txn"].encode()) if op.get("txn") else None
         if o == "put":
-            self.mvcc.put(op["key"].encode(), wts,
-                          op["value"].encode(), txn=txn)
+            self.mvcc.put(op["key"].encode("latin1"), wts,
+                          op["value"].encode("latin1"), txn=txn)
             return True
         if o == "delete":
-            self.mvcc.delete(op["key"].encode(), wts, txn=txn)
+            self.mvcc.delete(op["key"].encode("latin1"), wts, txn=txn)
             return True
         if o == "resolve":
-            self.mvcc.resolve_intent(op["key"].encode(), txn,
+            self.mvcc.resolve_intent(op["key"].encode("latin1"), txn,
                                      commit=op["commit"])
             return True
         raise ValueError(f"unknown write op {o}")
@@ -187,6 +294,13 @@ class Replica:
             "kv": items,
             "lease": [self.lease.holder, self.lease.epoch,
                       self.lease.sequence],
+            # descriptor travels with the snapshot: a follower restored
+            # past compacted split/change_replicas triggers must still
+            # learn its bounds and membership
+            "desc": [self.desc.range_id,
+                     self.desc.start_key.decode("latin1"),
+                     self.desc.end_key.decode("latin1"),
+                     list(self.desc.replicas), self.desc.generation],
         }).encode()
 
     def _apply_snapshot(self, snap: Snapshot) -> None:
@@ -199,6 +313,13 @@ class Replica:
                                  v.encode("latin1"))
         h, e, s = state["lease"]
         self.lease = Lease(h, e, s)
+        if "desc" in state:
+            rid, sk, ek2, reps, gen = state["desc"]
+            if gen > self.desc.generation:
+                self.desc = RangeDescriptor(rid, sk.encode("latin1"),
+                                            ek2.encode("latin1"),
+                                            list(reps), gen)
+                self.raft.update_membership(list(reps))
         self.applied_index = snap.index
 
 
@@ -222,7 +343,9 @@ class Store:
                              ^ range_id)
 
     def create_replica(self, desc: RangeDescriptor) -> Replica:
-        r = Replica(self, desc)
+        # every replica owns its descriptor copy: range-lifecycle
+        # triggers mutate it independently below raft on each store
+        r = Replica(self, copy.deepcopy(desc))
         self.replicas[desc.range_id] = r
         return r
 
@@ -236,10 +359,17 @@ class Store:
         return None
 
     def _handle_raft_message(self, frm: int, payload) -> None:
-        range_id, msg = payload
+        range_id, (kind, body) = payload
         r = self.replicas.get(range_id)
-        if r is not None:
-            r.step(msg)
+        if r is None:
+            return
+        if kind == "msg":
+            r.step(body)
+        elif kind == "prop":
+            # forwarded proposal: append if we are (still) the leader;
+            # otherwise drop — the proposer's retry loop re-sends
+            if r.raft.is_leader():
+                r.raft.propose(json.dumps(body).encode())
 
     def tick(self) -> None:
         for r in list(self.replicas.values()):
