@@ -1,0 +1,14 @@
+"""Distributed SQL flow infrastructure (host/DCN tier).
+
+The two-tier communication design of SURVEY.md §2.9: co-scheduled
+flows run as ONE SPMD program over the device mesh with ICI collectives
+(``cockroach_tpu/parallel/distagg.py``); flows that cross hosts use
+this package — serialized flow specs set up per-node processors
+(``SetupFlow``, pkg/sql/distsql/server.go:625), and columnar batches
+stream back over the wire (``FlowStream`` + Outbox/Inbox,
+pkg/sql/colflow/colrpc) in an Arrow-IPC-style framing (colserde).
+"""
+
+from cockroach_tpu.distsql.flow import (FlowRegistry, FlowSpec,  # noqa: F401
+                                        Inbox, Outbox)
+from cockroach_tpu.distsql.node import DistSQLNode, Gateway  # noqa: F401

@@ -1,0 +1,127 @@
+"""Flow specs, stream rendezvous, and batch streaming.
+
+Rebuilds the flow runtime of the reference:
+- ``FlowSpec`` — the serialized unit of work shipped to each node
+  (``execinfrapb.FlowSpec`` carried by SetupFlowRequest,
+  execinfrapb/api.proto:149). Our processor core is (sql, stage): the
+  node re-plans the statement deterministically and applies the stage
+  transform, instead of shipping an operator-tree proto.
+- ``FlowRegistry`` — rendezvous of inbound streams keyed by
+  (flow_id, stream_id) (flowinfra/flow_registry.go): the gateway's
+  consumer and the remote producer find each other here regardless of
+  arrival order.
+- ``Outbox``/``Inbox`` — streaming producer/consumer of serialized
+  columnar chunks (colflow/colrpc/outbox.go:150, inbox.go:326).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from cockroach_tpu.distsql import serde
+
+
+@dataclass
+class FlowSpec:
+    flow_id: str
+    gateway: int                 # node id consuming the results
+    stage: str                   # "rows" | "partial_agg"
+    sql: str
+    stream_id: int               # output stream on the gateway
+    chunk_rows: int = 65536
+    read_ts: Optional[int] = None
+
+    def to_wire(self) -> dict:
+        return {"flow_id": self.flow_id, "gateway": self.gateway,
+                "stage": self.stage, "sql": self.sql,
+                "stream_id": self.stream_id,
+                "chunk_rows": self.chunk_rows, "read_ts": self.read_ts}
+
+    @staticmethod
+    def from_wire(d: dict) -> "FlowSpec":
+        return FlowSpec(**d)
+
+
+class Inbox:
+    """Blocking consumer of one inbound stream; chunks accumulate until
+    EOF. ``error`` carries a remote execution failure to the gateway
+    (the reference propagates these as flow-level metadata)."""
+
+    def __init__(self):
+        self.chunks: deque[bytes] = deque()
+        self.eof = False
+        self.error: Optional[str] = None
+
+    def push(self, chunk: Optional[bytes], eof: bool,
+             error: Optional[str] = None) -> None:
+        if chunk is not None:
+            self.chunks.append(chunk)
+        if error is not None:
+            self.error = error
+            self.eof = True
+        elif eof:
+            self.eof = True
+
+    def drain_arrays(self) -> list[tuple[int, dict, dict]]:
+        out = []
+        while self.chunks:
+            out.append(serde.bytes_to_arrays(self.chunks.popleft()))
+        return out
+
+
+class FlowRegistry:
+    """(flow_id, stream_id) -> Inbox rendezvous (flow_registry.go)."""
+
+    def __init__(self):
+        self._inboxes: dict[tuple[str, int], Inbox] = {}
+
+    def inbox(self, flow_id: str, stream_id: int) -> Inbox:
+        key = (flow_id, stream_id)
+        if key not in self._inboxes:
+            self._inboxes[key] = Inbox()
+        return self._inboxes[key]
+
+    def release(self, flow_id: str) -> None:
+        for key in [k for k in self._inboxes if k[0] == flow_id]:
+            del self._inboxes[key]
+
+
+class Outbox:
+    """Chunks a host batch and pushes frames to the gateway's inbox via
+    the transport (FlowStream)."""
+
+    def __init__(self, transport, frm: int, to: int, flow_id: str,
+                 stream_id: int):
+        self.transport = transport
+        self.frm = frm
+        self.to = to
+        self.flow_id = flow_id
+        self.stream_id = stream_id
+
+    def _send(self, chunk: Optional[bytes], eof: bool,
+              error: Optional[str] = None) -> None:
+        self.transport.send(self.frm, self.to,
+                            ("flow_stream", self.flow_id, self.stream_id,
+                             chunk, eof, error))
+
+    def send_arrays(self, n: int, cols: dict[str, np.ndarray],
+                    valid: dict[str, np.ndarray],
+                    chunk_rows: int) -> None:
+        if n == 0:
+            self._send(serde.encode_columns(0, {k: v[:0] for k, v in
+                                                cols.items()},
+                                            {k: v[:0] for k, v in
+                                             valid.items()}), False)
+        for lo in range(0, n, chunk_rows):
+            hi = min(n, lo + chunk_rows)
+            self._send(serde.encode_columns(
+                hi - lo,
+                {k: v[lo:hi] for k, v in cols.items()},
+                {k: v[lo:hi] for k, v in valid.items()}), False)
+
+    def close(self, error: Optional[str] = None) -> None:
+        self._send(None, True, error)

@@ -1,0 +1,260 @@
+"""Per-node DistSQL server + the gateway flow runner.
+
+``DistSQLNode`` is the remote side: it handles SetupFlow by planning
+the statement locally (specs carry SQL + stage role; re-planning is
+deterministic because every node shares the catalog), applying the
+stage transform from ``physical.py``, executing the local plan over
+its own shard through the normal XLA pipeline, and streaming the
+result chunks to the gateway (``pkg/sql/distsql/server.go:625``
+SetupFlow; ``colrpc/outbox.go`` push side).
+
+``Gateway`` is the DistSQLPlanner/runner: it assigns the flow to every
+node holding a shard of the scanned table (the PartitionSpans analogue
+— ownership here is shard-residency, the way leaseholders partition
+spans in ``distsql_physical_planner.go:1096``), collects inbound
+streams in the FlowRegistry, unions them into the ``__union`` pseudo
+table, and runs the final stage through the same compiler.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import jax.numpy as jnp
+import numpy as np
+
+from cockroach_tpu.distsql import serde
+from cockroach_tpu.distsql.flow import FlowRegistry, FlowSpec, Outbox
+from cockroach_tpu.distsql.physical import UNION, split
+from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
+from cockroach_tpu.ops.batch import ColumnBatch
+from cockroach_tpu.sql import parser
+from cockroach_tpu.sql.planner import Planner
+
+
+class FlowError(Exception):
+    pass
+
+
+class DistSQLNode:
+    def __init__(self, node_id: int, engine, transport):
+        self.node_id = node_id
+        self.engine = engine
+        self.transport = transport
+        self.registry = FlowRegistry()
+        transport.register(node_id, self._handle)
+        self.flows_run = 0
+
+    # -- rpc handlers ----------------------------------------------
+    def _handle(self, frm: int, payload) -> None:
+        kind = payload[0]
+        if kind == "setup_flow":
+            self._setup_flow(FlowSpec.from_wire(payload[1]))
+        elif kind == "flow_stream":
+            _, flow_id, stream_id, chunk, eof, error = payload
+            self.registry.inbox(flow_id, stream_id).push(chunk, eof, error)
+
+    # -- local stage execution -------------------------------------
+    def _setup_flow(self, spec: FlowSpec) -> None:
+        outbox = Outbox(self.transport, self.node_id, spec.gateway,
+                        spec.flow_id, spec.stream_id)
+        try:
+            self.flows_run += 1
+            batch, stage = self._run_local(spec)
+            host = {n: np.asarray(d)
+                    for n, d in zip(batch.names, batch.data)}
+            sel = np.asarray(batch.sel)
+            for flag in ("__sum_overflow", "__ht_overflow"):
+                if flag in host and bool(np.any(host[flag][sel])):
+                    raise FlowError(f"local stage error: {flag}")
+            # compact by sel once on the pulled host arrays (no wire
+            # roundtrip needed for that)
+            skip = ("__sum_overflow", "__ht_overflow")
+            cols = {c: host[c][sel] for c in batch.names
+                    if not c.startswith(skip)}
+            valid = {c: np.asarray(batch.col_valid(c))[sel]
+                     for c in cols}
+            n = int(sel.sum())
+            # dictionary codes are node-local: ship strings instead
+            for name, src in stage.string_cols.items():
+                d = self._dictionary_for(stage.local, src)
+                codes = np.asarray(cols[name])
+                if d is None or len(d) == 0:
+                    vals = np.zeros(len(codes), dtype="S1")
+                else:
+                    safe = np.clip(codes, 0, len(d) - 1)
+                    vals = d.decode_array(safe).astype("S")
+                cols[name] = np.where(valid[name], vals, b"")
+            outbox.send_arrays(n, cols, valid, spec.chunk_rows)
+            outbox.close()
+        except Exception as e:          # noqa: BLE001 — ships to gateway
+            outbox.close(error=f"{type(e).__name__}: {e}")
+
+    def _run_local(self, spec: FlowSpec):
+        eng = self.engine
+        node, meta = Planner(eng.catalog_view()).plan_select(
+            parser.parse(spec.sql))
+        stage = split(node)
+        runf = compile_plan(stage.local, ExecParams())
+        scans = {alias: eng._device_table(tbl)
+                 for alias, tbl in _collect_scans(stage.local).items()}
+        read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
+                            else eng.clock.now().to_int())
+        return runf(RunContext(scans, read_ts)), stage
+
+    def _dictionary_for(self, local_plan, bcol_name: str):
+        """Resolve a batch column 'alias.col' to its table dictionary."""
+        from cockroach_tpu.sql import plan as P
+        alias = bcol_name.split(".", 1)[0]
+
+        def rec(n):
+            if isinstance(n, P.Scan):
+                if n.alias == alias and bcol_name in n.columns:
+                    stored = n.columns[bcol_name]
+                    td = self.engine.store.table(n.table)
+                    return td.dictionaries.get(stored)
+                return None
+            if isinstance(n, P.HashJoin):
+                return rec(n.left) or rec(n.right)
+            if hasattr(n, "child"):
+                return rec(n.child)
+            return None
+        return rec(local_plan)
+
+
+def _collect_scans(node) -> dict[str, str]:
+    from cockroach_tpu.sql import plan as P
+    out: dict[str, str] = {}
+
+    def rec(n):
+        if isinstance(n, P.Scan):
+            if n.table != UNION:
+                out[n.alias] = n.table
+        elif isinstance(n, P.HashJoin):
+            rec(n.left)
+            rec(n.right)
+        elif hasattr(n, "child"):
+            rec(n.child)
+    rec(node)
+    return out
+
+
+class Gateway:
+    """Plans and runs one distributed statement (PlanAndRunAll,
+    ``pkg/sql/distsql_running.go:1519``). The gateway owns a
+    DistSQLNode — it may itself hold a shard — and fans SetupFlow out
+    to every data node."""
+
+    def __init__(self, own: DistSQLNode, data_nodes: list[int],
+                 replicated_tables: set | None = None):
+        self.own = own
+        self.nodes = data_nodes
+        # tables fully present on every data node (dimension tables);
+        # join build sides must come from these — a sharded⋈sharded
+        # join would silently lose cross-node matches
+        self.replicated_tables = replicated_tables or set()
+
+    def _check_join_placement(self, plan_node) -> None:
+        from cockroach_tpu.distsql.physical import DistUnsupported
+        from cockroach_tpu.sql import plan as P
+
+        def rec(n, build_side):
+            if isinstance(n, P.Scan):
+                if build_side and n.table not in self.replicated_tables:
+                    raise DistUnsupported(
+                        f"join build side {n.table!r} is not replicated "
+                        "on all data nodes (shuffle joins not "
+                        "supported yet)")
+            elif isinstance(n, P.HashJoin):
+                rec(n.left, build_side)
+                rec(n.right, True)
+            elif hasattr(n, "child"):
+                rec(n.child, build_side)
+        rec(plan_node, False)
+
+    def run(self, sql: str, chunk_rows: int = 65536):
+        eng = self.own.engine
+        transport = self.own.transport
+        node, meta = Planner(eng.catalog_view()).plan_select(
+            parser.parse(sql))
+        self._check_join_placement(node)
+        stage = split(node)
+        flow_id = uuid.uuid4().hex[:12]
+        read_ts = int(eng.clock.now().to_int())
+
+        # SetupFlow to each participant; stream i <- node i
+        registry = self.own.registry
+        inboxes = []
+        for i, nid in enumerate(self.nodes):
+            spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
+                            stream_id=i, chunk_rows=chunk_rows,
+                            read_ts=read_ts)
+            inboxes.append(registry.inbox(flow_id, i))
+            transport.send(self.own.node_id, nid,
+                           ("setup_flow", spec.to_wire()))
+        # drive the in-process "network" until all streams finish
+        for _ in range(10000):
+            if all(ib.eof for ib in inboxes):
+                break
+            if transport.deliver_all() == 0 and \
+                    transport.pending() == 0:
+                break
+        try:
+            errs = [ib.error for ib in inboxes if ib.error]
+            if errs:
+                raise FlowError("; ".join(errs))
+            if not all(ib.eof for ib in inboxes):
+                raise FlowError("flow streams stalled")
+            union, merged_dicts = self._union_batch(
+                [c for ib in inboxes for c in ib.drain_arrays()],
+                stage.union_columns, stage.string_cols)
+        finally:
+            registry.release(flow_id)
+
+        # output dictionaries come from the merged wire strings, not the
+        # gateway's (possibly empty) local shard
+        for out_name, union_col in stage.dict_outputs.items():
+            if union_col in merged_dicts:
+                meta.dictionaries[out_name] = merged_dicts[union_col]
+        runf = compile_plan(stage.final, ExecParams(), meta)
+        out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+        return eng._materialize(out, meta)
+
+    def _union_batch(self, chunks, columns, string_cols):
+        from cockroach_tpu.storage.columnstore import Dictionary
+        cols: dict[str, list] = {c: [] for c in columns}
+        valid: dict[str, list] = {c: [] for c in columns}
+        total = 0
+        for n, ccols, cvalid in chunks:
+            if n == 0:
+                continue
+            total += n
+            for c in columns:
+                cols[c].append(ccols[c])
+                valid[c].append(cvalid[c])
+        merged: dict[str, Dictionary] = {}
+        if total == 0:
+            data = {c: np.zeros(1, dtype=np.int64) for c in columns}
+            vmask = {c: np.zeros(1, dtype=bool) for c in columns}
+            sel = np.zeros(1, dtype=bool)
+            for c in string_cols:
+                merged[c] = Dictionary()
+        else:
+            data = {c: np.concatenate(cols[c]) for c in columns}
+            vmask = {c: np.concatenate(valid[c]) for c in columns}
+            sel = np.ones(total, dtype=bool)
+            # re-encode wire strings against one merged dictionary
+            for c in string_cols:
+                d = Dictionary()
+                data[c] = d.encode_array(data[c].astype(str))
+                merged[c] = d
+        n = len(sel)
+        # MVCC columns for the pseudo-table scan: always visible
+        data["_mvcc_ts"] = np.zeros(n, dtype=np.int64)
+        data["_mvcc_del"] = np.full(n, np.iinfo(np.int64).max,
+                                    dtype=np.int64)
+        batch = ColumnBatch.from_dict(
+            {k: jnp.asarray(v) for k, v in data.items()},
+            {k: jnp.asarray(v) for k, v in vmask.items()},
+            sel=jnp.asarray(sel))
+        return batch, merged

@@ -1,0 +1,286 @@
+"""Physical planning: split a logical plan into local + final stages.
+
+The DistAggregationTable model of the reference
+(``pkg/sql/physicalplan/aggregator_funcs.go:22-91``): each aggregate
+function maps to LocalStage functions computed per node and FinalStage
+functions merging the partials at the gateway — SUM→SUM/SUM,
+COUNT→COUNT/SUM_INT, AVG→[SUM,COUNT] + a division render. Plans whose
+root aggregation cannot be split ship filtered rows instead ("rows"
+stage) and aggregate entirely at the gateway.
+
+The final stage is a normal logical plan whose leaf scans the union of
+inbound partial batches (pseudo-table ``__union``), so it compiles
+through the same XLA pipeline as any query.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from cockroach_tpu.sql import plan as P
+from cockroach_tpu.sql.bound import (BAggRef, BBin, BCast, BCol, BExpr,
+                                     BoundAgg, walk)
+from cockroach_tpu.sql.types import FLOAT8, Family
+
+UNION = "__union"
+
+
+@dataclass
+class StagePlan:
+    stage: str                    # "rows" | "partial_agg"
+    local: P.PlanNode             # runs on every data node
+    final: P.PlanNode             # runs at the gateway over __union
+    union_columns: list[str] = field(default_factory=list)
+    # union columns that are dictionary codes: name -> source BCol name.
+    # Codes are node-local (each shard built its dictionary from its own
+    # data), so these cross the wire as strings and the gateway
+    # re-encodes them against a merged dictionary — the Arrow
+    # dictionary-replacement model colserde sidesteps by shipping
+    # dictionaries per batch.
+    string_cols: dict = field(default_factory=dict)
+    # final output name -> union string column whose merged dictionary
+    # decodes it (fixes up OutputMeta.dictionaries at the gateway)
+    dict_outputs: dict = field(default_factory=dict)
+
+
+def _peel(node: P.PlanNode):
+    """Strip Limit/Sort wrappers off the root; they rerun above the
+    final stage (all inputs gathered at the gateway by then)."""
+    wrappers = []
+    while isinstance(node, (P.Limit, P.Sort)):
+        wrappers.append(node)
+        node = node.child
+    return wrappers, node
+
+
+def _rewrap(wrappers, node):
+    for w in reversed(wrappers):
+        if isinstance(w, P.Limit):
+            node = P.Limit(node, w.limit, w.offset)
+        else:
+            node = P.Sort(node, list(w.keys))
+    return node
+
+
+def _coltypes(node: P.PlanNode) -> dict:
+    """name -> SQLType for every column reference in the tree."""
+    out = {}
+
+    def scan_expr(e):
+        if e is None:
+            return
+        for sub in walk(e):
+            if isinstance(sub, BCol) and sub.type is not None:
+                out.setdefault(sub.name, sub.type)
+
+    def rec(n):
+        if isinstance(n, P.Scan):
+            scan_expr(n.filter)
+            for _, e in n.computed:
+                scan_expr(e)
+        elif isinstance(n, P.Filter):
+            scan_expr(n.pred)
+            rec(n.child)
+        elif isinstance(n, P.Project):
+            for _, e in n.items:
+                scan_expr(e)
+            rec(n.child)
+        elif isinstance(n, P.HashJoin):
+            rec(n.left)
+            rec(n.right)
+        elif isinstance(n, P.Aggregate):
+            for _, e in n.group_by:
+                scan_expr(e)
+            for a in n.aggs:
+                scan_expr(a.arg)
+            scan_expr(n.having)
+            for _, e in n.items:
+                scan_expr(e)
+            rec(n.child)
+        elif isinstance(n, (P.Sort, P.Limit)):
+            rec(n.child)
+    rec(node)
+    return out
+
+
+def _subst_aggrefs(e: BExpr, mapping: dict[int, BExpr]) -> BExpr:
+    import copy
+    if e is None:
+        return None
+    if isinstance(e, BAggRef):
+        return mapping[e.index]
+    e = copy.copy(e)
+    if isinstance(e, BBin):
+        e.left = _subst_aggrefs(e.left, mapping)
+        e.right = _subst_aggrefs(e.right, mapping)
+    elif hasattr(e, "expr"):
+        e.expr = _subst_aggrefs(e.expr, mapping)
+    elif hasattr(e, "operand"):
+        e.operand = _subst_aggrefs(e.operand, mapping)
+    elif hasattr(e, "args"):
+        e.args = [_subst_aggrefs(a, mapping) for a in e.args]
+    elif hasattr(e, "whens"):
+        e.whens = [(_subst_aggrefs(c, mapping), _subst_aggrefs(v, mapping))
+                   for c, v in e.whens]
+        if e.else_ is not None:
+            e.else_ = _subst_aggrefs(e.else_, mapping)
+    return e
+
+
+SPLITTABLE = {"sum", "sum_int", "count", "count_rows", "min", "max", "avg"}
+
+
+def split(node: P.PlanNode) -> StagePlan:
+    wrappers, core = _peel(node)
+    if isinstance(core, P.Aggregate) and \
+            all(a.func in SPLITTABLE and not a.distinct
+                for a in core.aggs):
+        return _split_aggregate(wrappers, core)
+    return _rows_stage(wrappers, core)
+
+
+def _string_union_cols(pairs) -> dict:
+    """(union_name, expr) pairs -> {union_name: source_bcol_name} for
+    dictionary-coded columns. Non-BCol string exprs can't be resolved
+    to a source dictionary — not distributable yet."""
+    out = {}
+    for n, e in pairs:
+        ty = getattr(e, "type", None)
+        if ty is not None and ty.family == Family.STRING:
+            if not isinstance(e, BCol):
+                raise DistUnsupported(
+                    f"string output {n!r} is not a plain column")
+            out[n] = e.name
+    return out
+
+
+class DistUnsupported(Exception):
+    pass
+
+
+def _rows_stage(wrappers, core) -> StagePlan:
+    """Ship (filtered/projected) rows; whole core repeats at gateway
+    over the union when it is an Aggregate, else rows pass through."""
+    if isinstance(core, P.Aggregate):
+        types = _coltypes(core)
+        needed = set()
+        for _, e in core.group_by:
+            needed |= {c.name for c in walk(e) if isinstance(c, BCol)}
+        for a in core.aggs:
+            if a.arg is not None:
+                needed |= {c.name for c in walk(a.arg)
+                           if isinstance(c, BCol)}
+        cols = sorted(needed)
+        items = [(n, BCol(n, types.get(n))) for n in cols]
+        local = P.Project(core.child, items=items)
+        strings = _string_union_cols(items)
+        final_child = P.Scan(UNION, UNION, columns={n: n for n in cols})
+        final = P.Aggregate(final_child, list(core.group_by),
+                            list(core.aggs), core.having,
+                            list(core.items),
+                            0 if strings else core.max_groups,
+                            [] if strings else list(core.group_dims))
+        # output -> group name -> source column (two hops)
+        group_src = {gn: ge.name for gn, ge in core.group_by
+                     if isinstance(ge, BCol) and ge.name in strings}
+        dict_outputs = {n: group_src[e.name] for n, e in core.items
+                        if isinstance(e, BCol) and e.name in group_src}
+        return StagePlan("rows", local, _rewrap(wrappers, final), cols,
+                         strings, dict_outputs)
+    # pure row pipeline (no aggregate): union the outputs, rerun
+    # sort/limit at the gateway
+    out_names = _output_names(core)
+    items = _output_items(core)
+    strings = _string_union_cols(items) if items is not None else {}
+    final = P.Scan(UNION, UNION, columns={n: n for n in out_names})
+    return StagePlan("rows", core, _rewrap(wrappers, final), out_names,
+                     strings, {n: n for n in strings})
+
+
+def _output_items(core: P.PlanNode):
+    if isinstance(core, P.Project):
+        return list(core.items)
+    if isinstance(core, P.Aggregate):
+        return list(core.items)
+    if isinstance(core, P.Filter):
+        return _output_items(core.child)
+    return None
+
+
+def _output_names(core: P.PlanNode) -> list[str]:
+    if isinstance(core, P.Project):
+        return [n for n, _ in core.items]
+    if isinstance(core, P.Aggregate):
+        return [n for n, _ in core.items]
+    if isinstance(core, P.Scan):
+        return list(core.columns.keys())
+    if isinstance(core, (P.Filter,)):
+        return _output_names(core.child)
+    if isinstance(core, P.HashJoin):
+        return _output_names(core.left) + list(core.payload)
+    raise ValueError(f"cannot determine output columns of {core!r}")
+
+
+def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
+    local_aggs: list[BoundAgg] = []
+    final_aggs: list[BoundAgg] = []
+    # orig agg index -> expression over final agg refs
+    final_ref: dict[int, BExpr] = {}
+
+    def partial_name(j: int) -> str:
+        return f"__p{j}"
+
+    for i, a in enumerate(core.aggs):
+        if a.func == "avg":
+            # AVG -> [SUM(float), COUNT] locally; SUM/SUM + divide at
+            # the final stage (aggregator_funcs.go AVG entry). BCast
+            # DECIMAL->FLOAT descales scaled-int decimals itself.
+            arg_f: BExpr = BCast(a.arg, FLOAT8)
+            js, jc = len(local_aggs), len(local_aggs) + 1
+            local_aggs.append(BoundAgg("sum", arg_f, FLOAT8))
+            local_aggs.append(BoundAgg("count", a.arg, a.type))
+            fs, fc = len(final_aggs), len(final_aggs) + 1
+            final_aggs.append(BoundAgg(
+                "sum", BCol(partial_name(js), FLOAT8), FLOAT8))
+            final_aggs.append(BoundAgg(
+                "sum_int", BCol(partial_name(jc), a.type), a.type))
+            final_ref[i] = BBin("/", BAggRef(fs, FLOAT8),
+                                BCast(BAggRef(fc, a.type), FLOAT8),
+                                FLOAT8)
+            continue
+        j = len(local_aggs)
+        local_aggs.append(a)
+        f = len(final_aggs)
+        merge_func = {"sum": "sum", "sum_int": "sum_int",
+                      "count": "sum_int", "count_rows": "sum_int",
+                      "min": "min", "max": "max"}[a.func]
+        final_aggs.append(BoundAgg(merge_func,
+                                   BCol(partial_name(j), a.type), a.type))
+        final_ref[i] = BAggRef(f, a.type)
+
+    gnames = [n for n, _ in core.group_by]
+    local_items = [(n, BCol(n, e.type)) for n, e in core.group_by]
+    local_items += [(partial_name(j), BAggRef(j, la.type))
+                    for j, la in enumerate(local_aggs)]
+    local = P.Aggregate(core.child, list(core.group_by), local_aggs,
+                        None, local_items, core.max_groups,
+                        list(core.group_dims))
+    strings = _string_union_cols(list(core.group_by))
+
+    union_cols = gnames + [partial_name(j)
+                           for j in range(len(local_aggs))]
+    final_child = P.Scan(UNION, UNION,
+                         columns={n: n for n in union_cols})
+    final_group = [(n, BCol(n, e.type)) for n, e in core.group_by]
+    final_items = [(n, _subst_aggrefs(e, final_ref))
+                   for n, e in core.items]
+    final_having = _subst_aggrefs(core.having, final_ref)
+    # merged dictionaries are only known at union time, so dict-coded
+    # group keys re-group via the hash strategy at the gateway
+    final = P.Aggregate(final_child, final_group, final_aggs,
+                        final_having, final_items,
+                        0 if strings else core.max_groups,
+                        [] if strings else list(core.group_dims))
+    dict_outputs = {n: e.name for n, e in final_items
+                    if isinstance(e, BCol) and e.name in strings}
+    return StagePlan("partial_agg", local, _rewrap(wrappers, final),
+                     union_cols, strings, dict_outputs)

@@ -68,7 +68,9 @@ class DistSender:
         desc = self.cluster.range_for_key(key)
         if desc is None:
             raise KeyError(f"no range containing {key!r}")
-        self.cache.insert(desc)
+        # snapshot, never alias: the authority mutates its descriptors
+        # in place on split/merge and the cache must go stale honestly
+        self.cache.insert(copy.deepcopy(desc))
         return self.cache.lookup(key)
 
     def _entry_for(self, key: bytes):
@@ -120,7 +122,11 @@ class DistSender:
         out = []
         cur, end = op["start"], op["end"]
         limit = op.get("limit", 0)
+        failures = 0
         while cur < end:
+            if failures > 8:
+                raise RuntimeError(f"scan piece at {cur!r} exhausted "
+                                   "retries (range unavailable?)")
             entry = self._entry_for(cur)
             desc = entry.desc
             piece = dict(op)
@@ -137,9 +143,11 @@ class DistSender:
             except (RangeKeyMismatchError, RangeBoundsError, KeyError,
                     NotLeaseholderError):
                 self.retries += 1
+                failures += 1
                 self.cache.evict(cur)
                 self.cluster.pump(2)
                 continue
+            failures = 0
             cur = desc.end_key
         return out
 

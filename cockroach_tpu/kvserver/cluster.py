@@ -116,6 +116,15 @@ class Cluster:
         self.down.discard(node_id)
         self.transport.restart_node(node_id)
         self.liveness.heartbeat(node_id)
+        # reconcile replicas from meta: a node restored by snapshot may
+        # have missed below-raft split triggers whose log entries were
+        # compacted, so ranges it should serve have no local replica
+        # (the reference learns these from meta + incoming raft traffic)
+        store = self.stores[node_id]
+        for desc in self.descriptors.values():
+            if node_id in desc.replicas and \
+                    desc.range_id not in store.replicas:
+                store.create_replica(desc)
 
     # ------------------------------------------------------------------
     # range lifecycle (split/merge queues + replicate queue/allocator)
@@ -180,6 +189,16 @@ class Cluster:
         if rhs_lh is None:
             raise RuntimeError(f"r{rhs.range_id}: no leaseholder")
         rhs_rep = self.stores[rhs_lh].replicas[rhs.range_id]
+        # drain in-flight RHS proposals before snapshotting: an acked
+        # write must not vanish into a pre-write rhs_state (Subsume
+        # blocks new traffic in the reference; here the orchestrator is
+        # single-threaded, so draining is sufficient)
+        drained = self.pump_until(
+            lambda: rhs_rep.applied_index >= rhs_rep.raft.commit
+            and not rhs_rep._waiters, 200)
+        if not drained:
+            raise RuntimeError(
+                f"r{rhs.range_id}: cannot subsume, in-flight proposals")
         rhs_state = [(ek.encode().decode("latin1"),
                       None if v is None else v.decode("latin1"))
                      for ek, v in rhs_rep.mvcc.engine.scan(
@@ -240,9 +259,12 @@ class Cluster:
                                 key=lambda n: load[n])
             if dead and len(live_members) > len(d.replicas) // 2 \
                     and candidates:
+                # one replica at a time (change_replicas' safety
+                # condition): add the replacement first, then remove
+                # the dead member in a second config change
                 add = candidates[0]
-                self.change_replicas(d.range_id, add=add,
-                                     remove=dead[0])
+                self.change_replicas(d.range_id, add=add)
+                self.change_replicas(d.range_id, remove=dead[0])
                 load[add] += 1
                 actions.append(f"r{d.range_id}: replace n{dead[0]} "
                                f"with n{add}")

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import copy
 import json
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -85,7 +86,6 @@ class Replica:
         # bounded dedup window for retried forwarded proposals
         self._applied_ids: set[str] = set()
         self._applied_order: deque[str] = deque()
-        self._next_cmd = 0
         self.raft_log_size = 0
 
     # ------------------------------------------------------------------
@@ -129,8 +129,10 @@ class Replica:
         are tracked by id, not log index, so completion is observed
         locally regardless of who appended the entry."""
         if "_id" not in cmd:
-            self._next_cmd += 1
-            cmd["_id"] = f"{self.store.node_id}.{self._next_cmd}"
+            # globally unique across replica re-creations: a plain
+            # counter would reuse ids after remove+re-add and trip the
+            # dedup window on surviving replicas
+            cmd["_id"] = f"{self.store.node_id}.{uuid.uuid4().hex[:16]}"
         if done is not None:
             self._waiters[cmd["_id"]] = done
         if self.raft.is_leader():
