@@ -80,8 +80,24 @@ class DistSQLNode:
                 d = self._dictionary_for(stage.local, src)
                 codes = np.asarray(cols[name])
                 if d is None or len(d) == 0:
+                    if valid[name].any():
+                        # valid rows but no dictionary to decode them
+                        # with — same bug class as an out-of-range code
+                        raise FlowError(
+                            f"{name}: valid rows but missing/empty "
+                            "dictionary")
                     vals = np.zeros(len(codes), dtype="S1")
                 else:
+                    # an out-of-range code on a VALID row is a planner or
+                    # dictionary bug; clamping would silently decode it
+                    # to the wrong string — fail the flow instead (the
+                    # error ships to the gateway via the outbox)
+                    bad = valid[name] & ((codes < 0) | (codes >= len(d)))
+                    if bad.any():
+                        raise FlowError(
+                            f"{name}: dictionary code out of range "
+                            f"(code {int(codes[bad][0])}, dict size "
+                            f"{len(d)})")
                     safe = np.clip(codes, 0, len(d) - 1)
                     vals = d.decode_array(safe).astype("S")
                 cols[name] = np.where(valid[name], vals, b"")

@@ -24,6 +24,14 @@ from cockroach_tpu.kvserver.transport import LocalTransport
 from cockroach_tpu.storage.hlc import Clock
 
 
+class AmbiguousResultError(RuntimeError):
+    """The proposal's fate is unknown: it reached raft (locally or via a
+    forward) but the waiter timed out before observing the apply. It may
+    still commit; a caller that blindly retries with a NEW command id
+    could double-apply semantically (the reference returns
+    AmbiguousResultError from this window, kvpb/errors.go)."""
+
+
 class NotLeaseholderError(Exception):
     """Request hit a non-leaseholder replica; retry at ``hint``."""
 
@@ -139,13 +147,25 @@ class Cluster:
             out["result"] = result
             out["ok"] = True
 
+        reached_raft = False
         for _ in range(5):
             if rep.propose(cmd, cb):
+                reached_raft = True
                 if self.pump_until(lambda: "ok" in out, max_iter):
                     return out["result"]
             else:
                 self.pump(5)
         rep._waiters.pop(cmd.get("_id", ""), None)   # don't leak the cb
+        # the dedup window is the commit record: if the id landed there,
+        # the command applied but the callback raced our timeout
+        if cmd.get("_id", "") in rep._applied_ids:
+            raise AmbiguousResultError(
+                "proposal applied but result was not observed")
+        if reached_raft:
+            # a forwarded/appended attempt can still commit after we
+            # stop waiting — this is NOT a definite failure
+            raise AmbiguousResultError(
+                "proposal handed to raft but not observed to commit")
         raise RuntimeError("proposal did not commit (quorum lost?)")
 
     def _propose_admin(self, range_id: int, cmd: dict,
@@ -221,6 +241,32 @@ class Cluster:
         new = [n for n in desc.replicas if n != remove]
         if add is not None and add not in new:
             new.append(add)
+        if remove is not None and not new:
+            raise RuntimeError(f"r{range_id}: cannot remove last replica")
+        if remove is not None and self.leaseholder(range_id) == remove:
+            # Removing a live leaseholder would wedge the range: the
+            # survivors' lease record keeps naming a node that stays
+            # live and unfenced, so no one can ever re-acquire. Transfer
+            # the lease to a surviving replica first (the reference
+            # transfers or rejects, replica_command.go).
+            target = next((n for n in new if n not in self.down
+                           and self.liveness.is_live(n)), None)
+            if target is None:
+                raise RuntimeError(
+                    f"r{range_id}: cannot remove leaseholder n{remove}: "
+                    "no live survivor to transfer the lease to")
+            lh_rep = self.stores[remove].replicas[range_id]
+            self.propose_and_wait(lh_rep, {
+                "kind": "lease", "holder": target,
+                "epoch": self.liveness.epoch_of(target)})
+            # the transfer applied on the proposer; wait for the TARGET
+            # to apply it too, or the lease exists only on the node we
+            # are about to remove
+            if not self.pump_until(
+                    lambda: self.leaseholder(range_id) == target, 200):
+                raise RuntimeError(
+                    f"r{range_id}: lease transfer to n{target} did not "
+                    "apply")
         if add is not None:
             # materialize the learner replica before the config commits
             # so it can receive raft traffic (snapshot-before-voter)
@@ -291,12 +337,18 @@ class Cluster:
         if not rep.raft.is_leader():
             return False
         cur = rep.lease
-        if cur.holder and cur.holder != node_id and \
+        # a holder no longer in the range's replica set is implicitly
+        # fenced — it can never serve the range again (defense in depth
+        # alongside the transfer-before-remove in change_replicas)
+        holder_is_member = cur.holder in rep.desc.replicas
+        if cur.holder and cur.holder != node_id and holder_is_member and \
                 self.liveness.epoch_of(cur.holder) == cur.epoch and \
                 self.liveness.is_live(cur.holder):
             return False         # current holder is alive and unfenced
-        if cur.holder and cur.holder != node_id and \
+        if cur.holder and cur.holder != node_id and holder_is_member and \
                 self.liveness.epoch_of(cur.holder) == cur.epoch:
+            # fencing a non-member is unnecessary (it cannot serve) and
+            # would invalidate the live node's leases on OTHER ranges
             if not self.liveness.increment_epoch(cur.holder):
                 return False
         try:

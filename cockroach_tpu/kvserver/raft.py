@@ -389,8 +389,13 @@ class RaftNode:
                 self.log.append([e])
         match = m.log_index + len(m.entries)
         if m.commit > self.commit:
-            self.commit = min(m.commit, match if m.entries
-                              else self.log.last_index())
+            # Clamp to the verified prefix (prev + appended entries), not
+            # our own last_index: on a heartbeat, entries past m.log_index
+            # are not proven to match the leader's log, and committing
+            # them could apply a divergent old-term suffix if messages
+            # are reordered/duplicated (etcd raft sends
+            # commit=min(commit, match) for the same reason).
+            self.commit = max(self.commit, min(m.commit, match))
             self._hs_dirty = True
         self._msgs.append(Message(MsgType.APPEND_RESP, self.id, m.frm,
                                   self.term, success=True,

@@ -31,6 +31,15 @@ class LocalTransport:
         self.dropped = 0
 
     def register(self, node_id: int, handler: Callable) -> None:
+        if node_id in self._handlers and \
+                self._handlers[node_id] is not handler:
+            # A Store and a DistSQL node sharing one transport would
+            # silently clobber each other's delivery; demand distinct
+            # transports (or explicit re-registration of the same
+            # handler, which restart paths legitimately do).
+            raise ValueError(
+                f"transport: node {node_id} already registered with a "
+                "different handler")
         self._handlers[node_id] = handler
         self._queues.setdefault(node_id, deque())
 

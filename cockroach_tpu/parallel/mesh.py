@@ -27,6 +27,14 @@ SHARD_AXIS = "shards"
 def make_mesh(devices=None, n: Optional[int] = None) -> Mesh:
     devs = list(devices if devices is not None else jax.devices())
     if n is not None:
+        if len(devs) < n:
+            # Truncating silently would "test" an n-way sharding on one
+            # device; demand the caller pin the platform first (e.g.
+            # --xla_force_host_platform_device_count, tests/conftest.py).
+            raise RuntimeError(
+                f"make_mesh(n={n}): only {len(devs)} JAX devices available "
+                f"on platform {devs[0].platform if devs else '?'}; refusing "
+                "to silently truncate the mesh")
         devs = devs[:n]
     return Mesh(np.asarray(devs), (SHARD_AXIS,))
 

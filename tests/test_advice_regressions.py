@@ -1,0 +1,105 @@
+"""Regression tests for advisor findings (round 1 ADVICE.md)."""
+
+import pytest
+
+from cockroach_tpu.kvserver.cluster import AmbiguousResultError, Cluster
+from cockroach_tpu.kvserver.raft import Entry, Message, MsgType, RaftNode
+from cockroach_tpu.kvserver.transport import LocalTransport
+
+
+def test_remove_live_leaseholder_does_not_wedge_range():
+    """ADVICE medium: removing the live leaseholder used to leave the
+    survivors' lease record naming a live, unfenced node forever, so no
+    replica could ever re-acquire. change_replicas must transfer the
+    lease to a survivor first."""
+    c = Cluster(n_nodes=4)
+    c.create_range(b"a", b"z", replicas=[1, 2, 3])
+    c.put(b"k1", b"v1")
+    lh = c.leaseholder(1)
+    assert lh is not None
+    c.change_replicas(1, add=4, remove=lh)
+    c.pump(10)
+    # the range must still be fully usable: reads, writes, a leaseholder
+    assert c.get(b"k1") == b"v1"
+    c.put(b"k2", b"v2")
+    assert c.get(b"k2") == b"v2"
+    new_lh = c.leaseholder(1)
+    assert new_lh is not None and new_lh != lh
+    assert lh not in c.descriptors[1].replicas
+
+
+def test_acquire_lease_treats_removed_holder_as_fenced():
+    """Defense in depth: even if a lease record names a node that is no
+    longer a member of the range, survivors can re-acquire."""
+    c = Cluster(n_nodes=4)
+    c.create_range(b"a", b"z", replicas=[1, 2, 3])
+    lh = c.ensure_lease(1)
+    # force a stale lease record naming a non-member (bypassing the
+    # transfer in change_replicas, as if the transfer were lost)
+    survivors = [n for n in (1, 2, 3) if n != lh]
+    for nid in survivors + [lh]:
+        rep = c.stores[nid].replicas.get(1)
+        if rep is not None:
+            rep.desc.replicas = [n for n in rep.desc.replicas if n != lh]
+            rep.raft.update_membership(rep.desc.replicas)
+    c.descriptors[1].replicas = [n for n in c.descriptors[1].replicas
+                                 if n != lh]
+    c.stores[lh].remove_replica(1)
+    # old holder stays live and unfenced — but is no longer a member
+    assert c.liveness.is_live(lh)
+    # survivors must elect a leader now that the old one is gone
+    assert c.pump_until(lambda: any(
+        c.stores[n].replicas[1].raft.is_leader() for n in survivors), 300)
+    got = c.ensure_lease(1)
+    assert got in survivors
+
+
+def test_heartbeat_does_not_commit_unverified_suffix():
+    """ADVICE low: a heartbeat (empty APPEND) must not advance commit
+    past the verified prefix — the follower's own divergent old-term
+    suffix is not proven to match the leader's log."""
+    import random
+
+    n = RaftNode(2, [1, 2, 3], rng=random.Random(0))
+    # follower holds a stale term-1 suffix at indexes 1..3
+    n.log.append([Entry(1, 1, b"a"), Entry(1, 2, b"stale"),
+                  Entry(1, 3, b"stale")])
+    # new term-2 leader heartbeats with prev=(1,term 1) and commit=3;
+    # only index 1 is verified by the prev check
+    n.step(Message(MsgType.APPEND, frm=1, to=2, term=2,
+                   log_index=1, log_term=1, entries=[], commit=3))
+    assert n.commit == 1, n.commit
+
+
+def test_quorum_loss_surfaces_ambiguous_result():
+    """ADVICE low: a proposal handed to raft that times out is
+    ambiguous (it may still commit), not definitely failed."""
+    c = Cluster(n_nodes=3)
+    c.create_range(b"a", b"z", replicas=[1, 2, 3])
+    c.put(b"k", b"v")                      # establishes a leader/lease
+    lh = c.leaseholder(1)
+    rep = c.stores[lh].replicas[1]
+    for nid in (1, 2, 3):
+        if nid != lh:
+            c.stop_node(nid)
+    with pytest.raises(AmbiguousResultError):
+        c.propose_and_wait(rep, {"kind": "batch", "ops": [{
+            "op": "put", "key": "k2", "value": "v2",
+            "ts": [c.clock.now().wall, 0]}]}, max_iter=10)
+
+
+def test_transport_rejects_conflicting_registration():
+    """ADVICE low: silent handler overwrite would let a Store and a
+    DistSQL node clobber each other's delivery."""
+    t = LocalTransport()
+
+    def h1(frm, msg):
+        pass
+
+    def h2(frm, msg):
+        pass
+
+    t.register(1, h1)
+    t.register(1, h1)            # same handler: fine (restart paths)
+    with pytest.raises(ValueError):
+        t.register(1, h2)
