@@ -23,6 +23,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kv.concurrency import (Span, TxnAbortedError, TxnRetryError)
+from ..kv.txn import DB as KVDB
+from ..kv.txn import KVStore, Txn
 from ..ops.batch import ColumnBatch
 from ..parallel import mesh as meshmod
 from ..parallel.distagg import analyze as dist_analyze
@@ -33,8 +36,9 @@ from ..sql import plan as P
 from ..sql.binder import Binder, ColumnBinding, Scope
 from ..sql.bound import BConst
 from ..sql.planner import CatalogView, Planner
+from ..sql.rowenc import ROWID
 from ..sql.types import ColumnSchema, Family, TableSchema
-from ..storage.columnstore import MAX_TS_INT, ColumnStore
+from ..storage.columnstore import MAX_TS_INT, Chunk, ColumnStore
 from ..storage.hlc import Clock, Timestamp
 from ..utils.settings import SessionVars, Settings
 from .compile import ExecParams, RunContext, compile_plan
@@ -67,10 +71,27 @@ class Result:
 @dataclass
 class Session:
     """Session state (the connExecutor's session data,
-    sessiondatapb/session_data.go)."""
+    sessiondatapb/session_data.go). An open explicit transaction holds
+    a real kv.Txn: DML writes intents through it and buffers its
+    scan-plane effects; COMMIT publishes them at the commit timestamp,
+    ROLLBACK discards them (the reference's connExecutor txn state
+    machine, conn_executor.go:1835)."""
     vars: SessionVars = field(default_factory=SessionVars)
-    txn_read_ts: Optional[Timestamp] = None  # pinned by BEGIN
-    in_txn: bool = False
+    txn: Optional[Txn] = None
+    # ordered (table, op) effects: ("put", key, row) | ("del", key)
+    effects: list = field(default_factory=list)
+    # a failed statement aborts the whole txn (postgres semantics:
+    # "current transaction is aborted" until ROLLBACK) — this keeps
+    # statements atomic without kv-level savepoints
+    txn_aborted: bool = False
+
+    @property
+    def in_txn(self) -> bool:
+        return self.txn is not None
+
+    @property
+    def txn_read_ts(self) -> Optional[Timestamp]:
+        return self.txn.meta.read_ts if self.txn is not None else None
 
 
 @dataclass
@@ -123,6 +144,10 @@ class Engine:
                  mesh=None):
         self.store = store or ColumnStore()
         self.clock = clock or Clock()
+        # the transactional row plane: DML writes intents here via
+        # kv.Txn (latches, tscache, pushes — kv/txn.py) and publishes
+        # committed effects into the columnstore scan plane
+        self.kv = KVDB(KVStore(clock=self.clock))
         self.settings = settings or Settings()
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
@@ -141,6 +166,11 @@ class Engine:
 
     def execute_stmt(self, stmt: ast.Statement, session: Session,
                      sql_text: str = "") -> Result:
+        if session.txn_aborted and not isinstance(
+                stmt, (ast.CommitTxn, ast.RollbackTxn)):
+            raise EngineError(
+                "current transaction is aborted, commands ignored "
+                "until end of transaction block")
         if isinstance(stmt, ast.Select):
             return self._exec_select(stmt, session, sql_text)
         if isinstance(stmt, ast.CreateTable):
@@ -171,16 +201,38 @@ class Engine:
                                 P.plan_tree_repr(node).rstrip().split("\n")],
                           tag="EXPLAIN")
         if isinstance(stmt, ast.BeginTxn):
-            session.in_txn = True
-            session.txn_read_ts = self.clock.now()
+            if session.txn is not None:
+                raise EngineError("transaction already open")
+            session.txn = Txn(self.kv.store)
+            session.effects = []
+            session.txn_aborted = False
             return Result(tag="BEGIN")
         if isinstance(stmt, ast.CommitTxn):
-            session.in_txn = False
-            session.txn_read_ts = None
+            t = session.txn
+            if t is None:
+                return Result(tag="COMMIT")
+            effects = session.effects
+            aborted = session.txn_aborted
+            session.txn, session.effects = None, []
+            session.txn_aborted = False
+            if aborted:
+                # COMMIT of an aborted txn is a rollback (pg semantics)
+                t.rollback()
+                return Result(tag="ROLLBACK")
+            try:
+                commit_ts = t.commit()
+            except (TxnRetryError, TxnAbortedError) as e:
+                t.rollback()
+                # the pg "restart transaction" error class (40001):
+                # client must retry the whole txn
+                raise EngineError(f"restart transaction: {e}") from e
+            self._publish(effects, commit_ts)
             return Result(tag="COMMIT")
         if isinstance(stmt, ast.RollbackTxn):
-            session.in_txn = False
-            session.txn_read_ts = None
+            if session.txn is not None:
+                session.txn.rollback()
+            session.txn, session.effects = None, []
+            session.txn_aborted = False
             return Result(tag="ROLLBACK")
         raise EngineError(f"unsupported statement {type(stmt).__name__}")
 
@@ -209,22 +261,46 @@ class Engine:
         node, meta = self._plan(sel, session)
 
         scan_aliases = _collect_scans(node)
-        decision = self._dist_decision(node, session)
+        # read-your-own-writes: tables this txn has written get an
+        # overlay snapshot (committed + buffered effects), not the
+        # shared device cache; overlay scans stay single-device
+        overlay = set()
+        if session.txn is not None and session.effects:
+            touched = {tb for tb, _ in session.effects}
+            overlay = touched & set(scan_aliases.values())
+        decision = None if overlay else self._dist_decision(node, session)
+        read_ts = self._read_ts(session)
 
         scans = {}
         gens = []
+        shapes = []
         for alias, tname in scan_aliases.items():
-            if decision is not None:
+            self._register_table_read(session.txn, tname, read_ts)
+            if tname in overlay:
+                b = self._overlay_batch(tname, session.effects, read_ts)
+                gens.append((tname, -1))
+            elif decision is not None:
                 sharded = alias in decision.sharded
                 b = self._device_table(tname, "sharded" if sharded
                                        else "replicated")
+                gens.append((tname, self.store.table(tname).generation))
             else:
                 b = self._device_table(tname)
+                gens.append((tname, self.store.table(tname).generation))
             scans[alias] = b
-            gens.append((tname, self.store.table(tname).generation, b.n))
+            dictlens = tuple(
+                sorted((cn, len(d)) for cn, d in
+                       self.store.table(tname).dictionaries.items()))
+            shapes.append((tname, b.n, dictlens))
 
         cap = int(session.vars.get("hash_group_capacity", 1 << 17))
-        key = (sql_text, tuple(sorted(gens)), decision is not None, cap)
+        # keyed by shape (padded row-count bucket) + dictionary sizes,
+        # NOT data generation: the compiled XLA program depends only on
+        # shapes and on literal dictionary codes (append-only, so any
+        # growth shows up in dictlens) — the plan-cache fingerprint idea
+        # of the reference (sql/plan_opt.go), adapted to XLA's
+        # shape-specialized compilation model
+        key = (sql_text, tuple(sorted(shapes)), decision is not None, cap)
         cached = self._exec_cache.get(key)
         if cached is None:
             params = ExecParams(
@@ -241,7 +317,7 @@ class Engine:
             self._exec_cache[key] = (jfn, meta)
         else:
             jfn, meta = cached
-        gens = tuple((t, g) for t, g, _ in sorted(gens))
+        gens = tuple(sorted(gens))
         return Prepared(self, session, sel, sql_text, jfn, scans, meta, gens)
 
     def prepare(self, sql: str, session: Session | None = None) -> "Prepared":
@@ -311,7 +387,17 @@ class Engine:
             del self._device_tables[k]
         if td.open_ts:
             self.store.seal(name)
-        chunks = td.chunks
+        b = self._batch_from_chunks(td, td.chunks)
+        if placement == "sharded":
+            b = jax.device_put(b, meshmod.row_sharding(self.mesh))
+        elif placement == "replicated":
+            b = jax.device_put(b, meshmod.replicated(self.mesh))
+        self._device_tables[key] = b
+        return b
+
+    def _batch_from_chunks(self, td, chunks: list) -> ColumnBatch:
+        """Concatenate chunks, pad to a power-of-two row bucket, and
+        upload as a device-resident ColumnBatch with MVCC columns."""
         cols: dict[str, np.ndarray] = {}
         valid: dict[str, np.ndarray] = {}
         n = sum(c.n for c in chunks)
@@ -335,15 +421,17 @@ class Engine:
         cols["_mvcc_del"] = _pad(mdl, padded, fill=np.int64(0))
         valid["_mvcc_ts"] = np.ones(padded, bool)
         valid["_mvcc_del"] = np.ones(padded, bool)
-        b = ColumnBatch.from_dict(
+        return ColumnBatch.from_dict(
             {k: jnp.asarray(v) for k, v in cols.items()},
             {k: jnp.asarray(v) for k, v in valid.items()})
-        if placement == "sharded":
-            b = jax.device_put(b, meshmod.row_sharding(self.mesh))
-        elif placement == "replicated":
-            b = jax.device_put(b, meshmod.replicated(self.mesh))
-        self._device_tables[key] = b
-        return b
+
+    def _overlay_batch(self, name: str, effects: list,
+                       read_ts: Timestamp) -> ColumnBatch:
+        """Uncached device snapshot of committed chunks + this txn's
+        buffered effects (read-your-own-writes)."""
+        td = self.store.table(name)
+        chunks = self._overlay_chunks(name, effects, read_ts)
+        return self._batch_from_chunks(td, chunks)
 
     # -- result materialization ---------------------------------------------
     def _materialize(self, out: ColumnBatch, meta: P.OutputMeta) -> Result:
@@ -378,7 +466,7 @@ class Engine:
             columns=[ColumnSchema(d.name, d.type, d.nullable)
                      for d in c.columns],
             primary_key=list(c.primary_key),
-            table_id=len(self.store.tables) + 100)
+            table_id=self.store.alloc_table_id())
         self.store.create_table(schema)
         return Result(tag="CREATE TABLE")
 
@@ -392,11 +480,151 @@ class Engine:
             del self._device_tables[k]
         return Result(tag="DROP TABLE")
 
-    # -- DML -----------------------------------------------------------------
+    # -- DML (through the transactional KV plane) ----------------------------
+    # Every DML statement writes row intents through kv.Txn (latches,
+    # tscache floors, pushes, read refresh — the TxnCoordSender stack)
+    # and records scan-plane effects that are published into the
+    # columnstore only at the commit timestamp. Mirrors the reference's
+    # write path: sql/row writers -> kv.Txn -> intents, resolved at
+    # commit (pkg/kv/db.go:896, pkg/sql/row/writer.go).
+
+    def _dml(self, session: Session, fn) -> Result:
+        """Run fn(txn, effects)->Result in the session's open txn, or
+        in a fresh auto-commit txn with the kv retry loop."""
+        if session.txn is not None:
+            # a failed statement aborts the whole explicit txn: its
+            # partial intents are resolved away and nothing publishes.
+            # This is how statement atomicity holds without kv-level
+            # savepoints (pg's "aborted until end of txn block").
+            try:
+                return fn(session.txn, session.effects)
+            except (TxnRetryError, TxnAbortedError) as e:
+                session.txn_aborted = True
+                session.txn.rollback()
+                raise EngineError(f"restart transaction: {e}") from e
+            except BaseException:
+                session.txn_aborted = True
+                session.txn.rollback()
+                raise
+        last: Exception | None = None
+        for _ in range(KVDB.MAX_ATTEMPTS):
+            t = Txn(self.kv.store)
+            effects: list = []
+            try:
+                res = fn(t, effects)
+                commit_ts = t.commit()
+                self._publish(effects, commit_ts)
+                return res
+            except (TxnRetryError, TxnAbortedError) as e:
+                t.rollback()
+                last = e
+            except BaseException:
+                t.rollback()
+                raise
+        raise EngineError(f"DML exhausted retries: {last}")
+
+    def _publish(self, effects: list, ts: Timestamp) -> None:
+        if not effects:
+            return
+        by_table: dict[str, list] = {}
+        order: list[str] = []
+        for table, op in effects:
+            if table not in by_table:
+                by_table[table] = []
+                order.append(table)
+            by_table[table].append(op)
+        for table in order:
+            self.store.apply_committed(table, by_table[table], ts)
+            self._evict(table)
+
+    def _register_table_read(self, txn: Optional[Txn], table: str,
+                             read_ts: Timestamp) -> None:
+        """Record a scan-plane read in the KV concurrency plane: the
+        table span goes into the txn's refresh set and the timestamp
+        cache, so conflicting writers get pushed above our read — the
+        contract of Replica.Send read path + span refresher."""
+        codec = self.store.table(table).codec
+        start, end = codec.span()
+        span = Span(start, end)
+        self.kv.store.tscache.add(span, read_ts,
+                                  txn.meta.id if txn else None)
+        if txn is not None:
+            txn.read_spans.append(span)
+
+    def _txn_key_state(self, effects: list, table: str) -> dict:
+        """Net per-key state of buffered effects for one table:
+        key -> row dict (pending put) or None (pending delete)."""
+        state: dict[bytes, object] = {}
+        for tb, op in effects:
+            if tb != table:
+                continue
+            if op[0] == "put":
+                state[op[1]] = op[2]
+            else:
+                state[op[1]] = None
+        return state
+
+    def _overlay_chunks(self, table: str, effects: list,
+                        read_ts: Timestamp) -> list[Chunk]:
+        """Committed chunks with this txn's buffered effects applied:
+        pending deletes/overwrites tombstone the committed version
+        (copy-on-write of the deletion column), pending puts appear as
+        a delta chunk visible at the txn's read timestamp. This is the
+        read-your-own-writes overlay; the reference gets the same from
+        MVCC intents being visible to their own txn."""
+        td = self.store.table(table)
+        state = self._txn_key_state(effects, table)
+        if not state:
+            self.store.seal(table)
+            return list(td.chunks)
+        idx = self.store.ensure_pk_index(table)
+        rts = read_ts.to_int()
+        shadow: dict[int, np.ndarray] = {}   # chunk idx -> COW mvcc_del
+        for key in state:
+            pos = idx.get(key)
+            if pos is None:
+                continue
+            ci, ri = pos
+            if ci not in shadow:
+                shadow[ci] = td.chunks[ci].mvcc_del.copy()
+            shadow[ci][ri] = rts   # hidden from this txn's reads
+        chunks = []
+        for ci, c in enumerate(td.chunks):
+            if ci in shadow:
+                c = Chunk(data=c.data, valid=c.valid, mvcc_ts=c.mvcc_ts,
+                          mvcc_del=shadow[ci], n=c.n, rowid=c.rowid)
+            chunks.append(c)
+        pending_rows = [r for r in state.values() if r is not None]
+        if pending_rows:
+            chunks.append(self._delta_chunk(td, pending_rows, rts))
+        return chunks
+
+    def _delta_chunk(self, td, rows: list[dict], ts_int: int) -> Chunk:
+        n = len(rows)
+        data, vmap = {}, {}
+        for col in td.schema.columns:
+            vals = [r.get(col.name) for r in rows]
+            v = np.array([x is not None for x in vals], dtype=bool)
+            if col.type.family == Family.STRING:
+                d = td.dictionaries[col.name]
+                arr = np.fromiter(
+                    (d.encode(x) if x is not None else 0 for x in vals),
+                    dtype=np.int32, count=n)
+            else:
+                arr = np.array([x if x is not None else 0 for x in vals],
+                               dtype=col.type.np_dtype)
+            data[col.name] = arr
+            vmap[col.name] = v
+        return Chunk(
+            data=data, valid=vmap,
+            mvcc_ts=np.full(n, ts_int, dtype=np.int64),
+            mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
+            rowid=np.asarray([int(r.get(ROWID, 0)) for r in rows],
+                             dtype=np.int64))
+
     def _exec_insert(self, ins: ast.Insert, session: Session) -> Result:
         td = self.store.table(ins.table)
         schema = td.schema
-        ts = self.clock.now()
         if ins.select is not None:
             # cache key must identify the inner select (repr is stable
             # and content-based for the AST dataclasses)
@@ -405,29 +633,63 @@ class Engine:
             cols = ins.columns or schema.column_names
             rows = [dict(zip(cols, r)) for r in src.rows]
             rows = [self._encode_row(schema, r) for r in rows]
-            n = self.store.insert_rows(ins.table, rows, ts)
-            return Result(row_count=n, tag="INSERT")
-        cols = ins.columns or schema.column_names
-        binder = Binder(Scope())
-        rows = []
-        for row_exprs in ins.rows:
-            if len(row_exprs) != len(cols):
-                raise EngineError("INSERT value count mismatch")
-            row = {}
-            for cname, e in zip(cols, row_exprs):
-                col = schema.column(cname)
-                b = binder.bind(e)
-                if not isinstance(b, BConst):
-                    raise EngineError("INSERT values must be constants")
-                if b.value is None:
-                    if not col.nullable:
-                        raise EngineError(f"null in non-null column {cname}")
-                    row[cname] = None
-                else:
-                    row[cname] = binder._const_to(b, col.type).value
-            rows.append(row)
-        n = self.store.insert_rows(ins.table, rows, ts)
-        return Result(row_count=n, tag="INSERT")
+        else:
+            cols = ins.columns or schema.column_names
+            binder = Binder(Scope())
+            rows = []
+            for row_exprs in ins.rows:
+                if len(row_exprs) != len(cols):
+                    raise EngineError("INSERT value count mismatch")
+                row = {}
+                for cname, e in zip(cols, row_exprs):
+                    col = schema.column(cname)
+                    b = binder.bind(e)
+                    if not isinstance(b, BConst):
+                        raise EngineError("INSERT values must be constants")
+                    if b.value is None:
+                        if not col.nullable:
+                            raise EngineError(
+                                f"null in non-null column {cname}")
+                        row[cname] = None
+                    else:
+                        row[cname] = binder._const_to(b, col.type).value
+                rows.append(row)
+        for row in rows:
+            for col in schema.columns:
+                if not col.nullable and row.get(col.name) is None:
+                    raise EngineError(f"null in non-null column {col.name}")
+        codec = td.codec
+
+        def fn(t: Txn, effects: list) -> Result:
+            pending = self._txn_key_state(effects, ins.table)
+            idx = self.store.ensure_pk_index(ins.table)
+            new_rows = []
+            for row in rows:
+                r = dict(row)
+                if codec.synthetic_pk:
+                    r[ROWID] = self.store.alloc_rowids(ins.table, 1)[0]
+                key = codec.key(r)
+                if not codec.synthetic_pk:
+                    # duplicate-key check = CPut semantics: a KV read
+                    # (sees concurrent intents, registers the span)
+                    # plus the scan-plane live index (covers
+                    # bulk-ingested rows with no KV pair)
+                    in_txn = pending.get(key, "absent")
+                    committed = (t.get(key) is not None or key in idx)
+                    if in_txn not in (None, "absent") or \
+                            (committed and in_txn == "absent"):
+                        pk = codec.pk_values(r)
+                        raise EngineError(
+                            f"duplicate key value {pk!r} violates "
+                            f"primary key of {ins.table!r}")
+                t.put(key, codec.encode_value(r))
+                pending[key] = r
+                new_rows.append((key, r))
+            for key, r in new_rows:
+                effects.append((ins.table, ("put", key, r)))
+            return Result(row_count=len(rows), tag="INSERT")
+
+        return self._dml(session, fn)
 
     def _encode_row(self, schema: TableSchema, row: dict) -> dict:
         out = {}
@@ -474,10 +736,26 @@ class Engine:
 
     def _exec_delete(self, d: ast.Delete, session: Session) -> Result:
         scope, _ = self._dml_scope(d.table)
-        ts = self.clock.now()
-        n = self.store.delete_where(d.table, self._chunk_pred(d.table, d.where, scope), ts)
-        self._evict(d.table)
-        return Result(row_count=n, tag="DELETE")
+        td = self.store.table(d.table)
+        codec = td.codec
+        predf = self._chunk_pred(d.table, d.where, scope)
+
+        def fn(t: Txn, effects: list) -> Result:
+            read_ts = t.meta.read_ts
+            self._register_table_read(t, d.table, read_ts)
+            rts = read_ts.to_int()
+            n = 0
+            for chunk in self._overlay_chunks(d.table, effects, read_ts):
+                mask = chunk.live_mask(rts) & predf(chunk)
+                for ri in np.nonzero(mask)[0]:
+                    row = self.store.extract_row(td, chunk, int(ri))
+                    key = codec.key(row)
+                    t.delete(key)
+                    effects.append((d.table, ("del", key)))
+                    n += 1
+            return Result(row_count=n, tag="DELETE")
+
+        return self._dml(session, fn)
 
     def _exec_update(self, u: ast.Update, session: Session) -> Result:
         scope, schema = self._dml_scope(u.table)
@@ -525,11 +803,57 @@ class Engine:
                     valid[cn] = chunk.valid[cn][idx]
             return data, valid
 
-        ts = self.clock.now()
-        n = self.store.update_where(
-            u.table, self._chunk_pred(u.table, u.where, scope), assign, ts)
-        self._evict(u.table)
-        return Result(row_count=n, tag="UPDATE")
+        codec = td.codec
+        predf = self._chunk_pred(u.table, u.where, scope)
+
+        def fn(t: Txn, effects: list) -> Result:
+            read_ts = t.meta.read_ts
+            self._register_table_read(t, u.table, read_ts)
+            rts = read_ts.to_int()
+            idx = self.store.ensure_pk_index(u.table)
+            n = 0
+            todo = []
+            for chunk in self._overlay_chunks(u.table, effects, read_ts):
+                mask = chunk.live_mask(rts) & predf(chunk)
+                if not mask.any():
+                    continue
+                data, valid = assign(chunk, mask)
+                for j, ri in enumerate(np.nonzero(mask)[0]):
+                    old = self.store.extract_row(td, chunk, int(ri))
+                    new = dict(old)
+                    for c in schema.columns:
+                        cn = c.name
+                        if not valid[cn][j]:
+                            new[cn] = None
+                        elif c.type.family == Family.STRING:
+                            new[cn] = td.dictionaries[cn].values[
+                                int(data[cn][j])]
+                        else:
+                            new[cn] = data[cn][j].item()
+                    todo.append((old, new))
+            pending = self._txn_key_state(effects, u.table)
+            for old, new in todo:
+                okey = codec.key(old)
+                nkey = codec.key(new)
+                if nkey != okey:
+                    # pk change: delete old kv, insert new (dup-checked)
+                    in_txn = pending.get(nkey, "absent")
+                    committed = (t.get(nkey) is not None or nkey in idx)
+                    if in_txn not in (None, "absent") or \
+                            (committed and in_txn == "absent"):
+                        raise EngineError(
+                            f"duplicate key {codec.pk_values(new)!r} on "
+                            f"UPDATE of {u.table!r}")
+                    t.delete(okey)
+                    effects.append((u.table, ("del", okey)))
+                    pending[okey] = None
+                t.put(nkey, codec.encode_value(new))
+                effects.append((u.table, ("put", nkey, new)))
+                pending[nkey] = new
+                n += 1
+            return Result(row_count=n, tag="UPDATE")
+
+        return self._dml(session, fn)
 
     def _evict(self, name: str):
         for k in [k for k in self._device_tables if k[0] == name]:

@@ -1,0 +1,148 @@
+"""Row <-> KV encoding: SQL rows mapped onto the transactional KV plane.
+
+The analogue of the reference's ``pkg/sql/rowenc`` (index key encoding,
+``EncodeIndexKey``) and the value side of ``pkg/sql/row`` writers. Every
+table row has exactly one KV pair on primary index 1:
+
+    key   = /Table/<id>/1/<pk cols...>      (order-preserving, keys.py)
+    value = null-bitmap + packed non-pk column values
+
+Tables with no declared PRIMARY KEY get a hidden ``rowid`` key column
+(the reference synthesizes a ``rowid INT DEFAULT unique_rowid()``
+column the same way, pkg/sql/catalog/tabledesc). Rowids are allocated
+by the storage layer (storage/columnstore.py) and threaded through
+here as ``row["__rowid__"]``.
+
+Values are "storage-logical": STRING columns travel as UTF-8 strings
+(dictionary codes are store-local and must not leak into the
+replicated KV plane); DECIMAL/DATE/TIMESTAMP are their physical int
+forms (scaled int, epoch days, epoch micros) exactly as the column
+store holds them.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..storage import keys
+from .types import Family, TableSchema
+
+ROWID = "__rowid__"
+
+
+class RowCodec:
+    """Encode/decode rows of one table schema to KV pairs."""
+
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self.table_id = schema.table_id
+        self.pk_cols = list(schema.primary_key)
+        self.synthetic_pk = not self.pk_cols
+        # value columns: everything not in the pk (pk is recoverable
+        # from the key; the reference likewise omits key cols from the
+        # value, rowenc/valueside)
+        self.value_cols = [c for c in schema.columns
+                           if c.name not in self.pk_cols]
+
+    # -- spans -------------------------------------------------------------
+    def span(self) -> tuple[bytes, bytes]:
+        p = keys.table_prefix(self.table_id)
+        return p, keys.prefix_end(p)
+
+    # -- keys --------------------------------------------------------------
+    def pk_values(self, row: dict) -> tuple:
+        if self.synthetic_pk:
+            return (int(row[ROWID]),)
+        return tuple(row[c] for c in self.pk_cols)
+
+    def key(self, row: dict) -> bytes:
+        return keys.table_key(self.table_id, self.pk_values(row))
+
+    def key_from_pk(self, pk_vals: tuple) -> bytes:
+        return keys.table_key(self.table_id, pk_vals)
+
+    # -- values ------------------------------------------------------------
+    def encode_value(self, row: dict) -> bytes:
+        cols = self.value_cols
+        nulls = 0
+        buf = bytearray()
+        for i, c in enumerate(cols):
+            v = row.get(c.name)
+            if v is None:
+                nulls |= 1 << i
+                continue
+            f = c.type.family
+            if f == Family.BOOL:
+                buf += struct.pack(">B", 1 if v else 0)
+            elif f == Family.FLOAT:
+                buf += struct.pack(">d", float(v))
+            elif f in (Family.STRING, Family.BYTES):
+                raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                buf += struct.pack(">I", len(raw)) + raw
+            else:  # INT / DECIMAL / DATE / TIMESTAMP / INTERVAL: int64
+                buf += struct.pack(">q", int(v))
+        nb = (len(cols) + 7) // 8
+        return nulls.to_bytes(nb, "little") + bytes(buf)
+
+    def decode_value(self, b: bytes) -> dict:
+        cols = self.value_cols
+        nb = (len(cols) + 7) // 8
+        nulls = int.from_bytes(b[:nb], "little")
+        off = nb
+        row: dict = {}
+        for i, c in enumerate(cols):
+            if nulls & (1 << i):
+                row[c.name] = None
+                continue
+            f = c.type.family
+            if f == Family.BOOL:
+                row[c.name] = bool(b[off])
+                off += 1
+            elif f == Family.FLOAT:
+                (row[c.name],) = struct.unpack_from(">d", b, off)
+                off += 8
+            elif f in (Family.STRING, Family.BYTES):
+                (ln,) = struct.unpack_from(">I", b, off)
+                off += 4
+                raw = b[off:off + ln]
+                off += ln
+                row[c.name] = raw.decode("utf-8") if f == Family.STRING \
+                    else raw
+            else:
+                (row[c.name],) = struct.unpack_from(">q", b, off)
+                off += 8
+        return row
+
+    def decode_key(self, key: bytes) -> tuple:
+        """Recover pk values from an encoded table key."""
+        prefix = keys.table_prefix(self.table_id)
+        if not key.startswith(prefix):
+            raise ValueError(f"key {key!r} not in table {self.table_id}")
+        off = len(prefix)
+        out = []
+        cols = ([None] if self.synthetic_pk
+                else [self.schema.column(c) for c in self.pk_cols])
+        for c in cols:
+            fam = Family.INT if c is None else c.type.family
+            if fam in (Family.STRING, Family.BYTES):
+                v, off = keys.decode_bytes(key, off)
+                out.append(v.decode("utf-8") if fam == Family.STRING else v)
+            elif fam == Family.FLOAT:
+                v, off = keys.decode_float(key, off)
+                out.append(v)
+            else:
+                v, off = keys.decode_int(key, off)
+                out.append(v)
+        return tuple(out)
+
+    def decode_row(self, key: bytes, value: bytes) -> dict:
+        """Full row from a KV pair (pk cols from the key, rest from the
+        value) — the cFetcher decode contract, colfetcher/cfetcher.go:668."""
+        row = self.decode_value(value)
+        pk = self.decode_key(key)
+        if self.synthetic_pk:
+            row[ROWID] = pk[0]
+        else:
+            for name, v in zip(self.pk_cols, pk):
+                row[name] = v
+        return row

@@ -82,6 +82,10 @@ class Chunk:
     mvcc_ts: np.ndarray   # int64 creation timestamps
     mvcc_del: np.ndarray  # int64 deletion timestamps (MAX_TS_INT = live)
     n: int
+    # hidden per-row id: stable identity for rows of tables with no
+    # declared primary key (the reference synthesizes a rowid column
+    # the same way, pkg/sql/catalog/tabledesc)
+    rowid: Optional[np.ndarray] = None
 
     def live_mask(self, ts: int) -> np.ndarray:
         return (self.mvcc_ts <= ts) & (ts < self.mvcc_del)
@@ -97,10 +101,22 @@ class TableData:
     chunk_rows: int = 1 << 20
     # generation bumps on every mutation; device caches key on it
     generation: int = 0
+    open_rowids: list = field(default_factory=list)
+    next_rowid: int = 1
+    # pk-key bytes -> (chunk_index, row_index) of the LIVE version.
+    # Built lazily on first transactional DML; None = not built.
+    pk_index: Optional[dict] = None
 
     @property
     def row_count(self) -> int:
         return sum(c.n for c in self.chunks) + len(self.open_ts)
+
+    @property
+    def codec(self):
+        from ..sql.rowenc import RowCodec
+        if not hasattr(self, "_codec") or self._codec is None:
+            self._codec = RowCodec(self.schema)
+        return self._codec
 
 
 class ColumnStore:
@@ -110,12 +126,24 @@ class ColumnStore:
         self._lock = threading.RLock()
         self.tables: dict[str, TableData] = {}
         self.chunk_rows = chunk_rows
+        # monotonic: a dropped table's id is never reused, so its
+        # orphaned KV rows can never alias a new table's keyspace
+        # (the reference keeps descriptor ids monotonic the same way)
+        self._next_table_id = 100
+
+    def alloc_table_id(self) -> int:
+        with self._lock:
+            tid = self._next_table_id
+            self._next_table_id += 1
+            return tid
 
     # -- DDL ---------------------------------------------------------------
     def create_table(self, schema: TableSchema) -> TableData:
         with self._lock:
             if schema.name in self.tables:
                 raise ValueError(f"table {schema.name!r} exists")
+            self._next_table_id = max(self._next_table_id,
+                                      schema.table_id + 1)
             td = TableData(schema=schema, chunk_rows=self.chunk_rows)
             for col in schema.columns:
                 if col.type.family == Family.STRING:
@@ -165,11 +193,15 @@ class ColumnStore:
                 vmap[cn] = (np.asarray(valid[cn], dtype=bool) if cn in valid
                             else np.ones(n, dtype=bool))
             tsi = ts.to_int()
+            rid0 = td.next_rowid
+            td.next_rowid += n
             chunk = Chunk(data=data, valid=vmap,
                           mvcc_ts=np.full(n, tsi, dtype=np.int64),
                           mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64),
-                          n=n)
+                          n=n,
+                          rowid=np.arange(rid0, rid0 + n, dtype=np.int64))
             td.chunks.append(chunk)
+            td.pk_index = None  # rebuilt lazily if DML touches this table
             td.generation += 1
         return n
 
@@ -177,12 +209,19 @@ class ColumnStore:
         """Row-at-a-time insert (INSERT VALUES path): buffers into the
         open chunk, sealing at chunk_rows."""
         td = self.table(name)
+        from ..sql.rowenc import ROWID
         with self._lock:
             tsi = ts.to_int()
             for row in rows:
                 for col in td.schema.columns:
                     td.open_rows[col.name].append(row.get(col.name))
                 td.open_ts.append(tsi)
+                rid = row.get(ROWID)
+                if rid is None:
+                    rid = td.next_rowid
+                    td.next_rowid += 1
+                td.open_rowids.append(int(rid))
+            td.pk_index = None
             td.generation += 1
             if len(td.open_ts) >= td.chunk_rows:
                 self._seal_locked(td)
@@ -217,11 +256,18 @@ class ColumnStore:
             data[col.name] = arr
             vmap[col.name] = v
             td.open_rows[col.name] = []
+        if len(td.open_rowids) != n:
+            # rows buffered before the rowid plumbing existed, or by a
+            # caller that bypassed insert_rows: allocate fresh ids
+            td.open_rowids = list(range(td.next_rowid, td.next_rowid + n))
+            td.next_rowid += n
         td.chunks.append(Chunk(
             data=data, valid=vmap,
             mvcc_ts=np.asarray(td.open_ts, dtype=np.int64),
-            mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n))
+            mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
+            rowid=np.asarray(td.open_rowids, dtype=np.int64)))
         td.open_ts = []
+        td.open_rowids = []
 
     def seal(self, name: str) -> None:
         td = self.table(name)
@@ -242,6 +288,7 @@ class ColumnStore:
                 mask = chunk.live_mask(tsi) & pred(chunk)
                 chunk.mvcc_del[mask] = tsi
                 deleted += int(mask.sum())
+            td.pk_index = None
             td.generation += 1
         return deleted
 
@@ -265,14 +312,129 @@ class ColumnStore:
                 updated += cnt
             for data, vmap in new_rows:
                 n = len(next(iter(data.values())))
+                rid0 = td.next_rowid
+                td.next_rowid += n
                 td.chunks.append(Chunk(
                     data={k: np.asarray(v) for k, v in data.items()},
                     valid={k: np.asarray(v, dtype=bool)
                            for k, v in vmap.items()},
                     mvcc_ts=np.full(n, tsi, dtype=np.int64),
-                    mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n))
+                    mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
+                    rowid=np.arange(rid0, rid0 + n, dtype=np.int64)))
+            td.pk_index = None
             td.generation += 1
         return updated
+
+    # -- transactional publish (the scan plane as a materialization of
+    # the committed KV row plane; engine DML writes intents through
+    # kv.Txn and publishes here at the commit timestamp) ---------------------
+    def alloc_rowids(self, name: str, n: int) -> list[int]:
+        td = self.table(name)
+        with self._lock:
+            r0 = td.next_rowid
+            td.next_rowid += n
+            return list(range(r0, r0 + n))
+
+    def extract_row(self, td: TableData, chunk: Chunk, ri: int) -> dict:
+        """One row in storage-logical form (strings decoded, numerics
+        physical) — the inverse of the seal path's encode."""
+        from ..sql.rowenc import ROWID
+        row: dict = {}
+        for col in td.schema.columns:
+            cn = col.name
+            if not chunk.valid[cn][ri]:
+                row[cn] = None
+            elif col.type.family == Family.STRING:
+                row[cn] = td.dictionaries[cn].values[int(chunk.data[cn][ri])]
+            else:
+                row[cn] = chunk.data[cn][ri].item()
+        if chunk.rowid is not None:
+            row[ROWID] = int(chunk.rowid[ri])
+        return row
+
+    def ensure_pk_index(self, name: str) -> dict:
+        """Build (lazily) the pk-key -> (chunk, row) locator for LIVE
+        rows. The DML path needs it to tombstone superseded versions;
+        bulk-ingested tables only pay for it if they are ever DML'd."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            if td.pk_index is not None:
+                return td.pk_index
+            codec = td.codec
+            idx: dict[bytes, tuple[int, int]] = {}
+            from ..sql.rowenc import ROWID
+            for ci, chunk in enumerate(td.chunks):
+                live = chunk.mvcc_del == MAX_TS_INT
+                for ri in np.nonzero(live)[0]:
+                    if codec.synthetic_pk:
+                        key = codec.key_from_pk((int(chunk.rowid[ri]),))
+                    else:
+                        pk = []
+                        for cn in codec.pk_cols:
+                            col = td.schema.column(cn)
+                            v = chunk.data[cn][ri]
+                            if col.type.family == Family.STRING:
+                                pk.append(td.dictionaries[cn]
+                                          .values[int(v)])
+                            else:
+                                pk.append(v.item())
+                        key = codec.key_from_pk(tuple(pk))
+                    idx[key] = (ci, int(ri))
+            td.pk_index = idx
+            return idx
+
+    def apply_committed(self, name: str, ops: list, ts: Timestamp) -> None:
+        """Publish one committed txn's effects on this table.
+
+        ops: ordered list of ("put", key_bytes, row_dict) and
+        ("del", key_bytes). A put supersedes (tombstones) the prior
+        live version of the same key; rows carry storage-logical
+        values (see extract_row). Mirrors how the reference's scan
+        plane only ever sees resolved, committed versions (intents are
+        filtered by pebbleMVCCScanner before SQL decodes them)."""
+        td = self.table(name)
+        from ..sql.rowenc import ROWID
+        with self._lock:
+            idx = self.ensure_pk_index(name)
+            tsi = ts.to_int()
+            new_rows: list[tuple[bytes, dict]] = []
+            new_keys: dict[bytes, int] = {}  # key -> position in new_rows
+            for op in ops:
+                kind, key = op[0], op[1]
+                pos = idx.pop(key, None)
+                if pos is not None:
+                    ci, ri = pos
+                    td.chunks[ci].mvcc_del[ri] = tsi
+                npos = new_keys.pop(key, None)
+                if npos is not None:
+                    new_rows[npos] = (key, None)  # superseded in-txn
+                if kind == "put":
+                    row = dict(op[2])
+                    if td.codec.synthetic_pk and ROWID not in row:
+                        row[ROWID] = td.next_rowid
+                        td.next_rowid += 1
+                    new_keys[key] = len(new_rows)
+                    new_rows.append((key, row))
+            live = [(k, r) for k, r in new_rows if r is not None]
+            if live:
+                base_ci = len(td.chunks)
+                rows = [r for _, r in live]
+                for row in rows:
+                    for col in td.schema.columns:
+                        td.open_rows[col.name].append(row.get(col.name))
+                    td.open_ts.append(tsi)
+                    td.open_rowids.append(int(row.get(ROWID, 0)) or
+                                          self._next_rowid_locked(td))
+                self._seal_locked(td)
+                for i, (k, _) in enumerate(live):
+                    idx[k] = (base_ci, i)
+            td.generation += 1
+
+    def _next_rowid_locked(self, td: TableData) -> int:
+        r = td.next_rowid
+        td.next_rowid += 1
+        return r
 
     # -- GC ------------------------------------------------------------------
     def gc(self, name: str, threshold: Timestamp) -> int:
@@ -296,8 +458,11 @@ class ColumnStore:
                         valid={k: v[keep] for k, v in chunk.valid.items()},
                         mvcc_ts=chunk.mvcc_ts[keep],
                         mvcc_del=chunk.mvcc_del[keep],
-                        n=int(keep.sum())))
+                        n=int(keep.sum()),
+                        rowid=(chunk.rowid[keep]
+                               if chunk.rowid is not None else None)))
             td.chunks = new_chunks
+            td.pk_index = None
             td.generation += 1
         return removed
 

@@ -1,0 +1,287 @@
+"""Transactional SQL: DML through the kv.Txn plane.
+
+The round-1 verdict's core finding: BEGIN/COMMIT/ROLLBACK were
+cosmetic (a ROLLBACK after INSERT left the row committed). These tests
+pin the unified semantics: DML writes intents through kv.Txn and only
+a COMMIT publishes effects to the TPU scan plane.
+
+Reference behaviors mirrored: pkg/kv/db.go:896 (DB.Txn retry loop),
+pkg/sql/conn_executor.go txn state machine, MVCC intent visibility
+(own-txn reads see intents; other txns push).
+"""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+def make_engine():
+    eng = Engine()
+    eng.execute("CREATE TABLE kv (k INT8 NOT NULL, v INT8, s STRING)")
+    return eng
+
+
+def count(eng, session=None, where=""):
+    r = eng.execute(f"SELECT count(*) AS c FROM kv {where}", session)
+    return r.rows[0][0]
+
+
+class TestRollback:
+    def test_insert_rollback_leaves_no_row(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 10)", s)
+        eng.execute("ROLLBACK", s)
+        assert count(eng) == 0
+        # and a fresh session sees nothing either
+        assert count(eng, eng.session()) == 0
+
+    def test_update_rollback_restores(self):
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 10)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("UPDATE kv SET v = 99 WHERE k = 1", s)
+        eng.execute("ROLLBACK", s)
+        r = eng.execute("SELECT v FROM kv WHERE k = 1")
+        assert r.rows == [(10,)]
+
+    def test_delete_rollback_restores(self):
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 10), (2, 20)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("DELETE FROM kv WHERE k = 1", s)
+        assert count(eng, s) == 1  # txn sees its own delete
+        eng.execute("ROLLBACK", s)
+        assert count(eng) == 2
+
+
+class TestCommit:
+    def test_commit_publishes(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 10)", s)
+        # invisible to other sessions before commit
+        assert count(eng, eng.session()) == 0
+        eng.execute("COMMIT", s)
+        assert count(eng, eng.session()) == 1
+
+    def test_multi_statement_txn(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 1)", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (2, 2)", s)
+        eng.execute("UPDATE kv SET v = v + 100 WHERE k = 1", s)
+        eng.execute("DELETE FROM kv WHERE k = 2", s)
+        eng.execute("COMMIT", s)
+        r = eng.execute("SELECT k, v FROM kv")
+        assert r.rows == [(1, 101)]
+
+    def test_autocommit_dml_visible(self):
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v, s) VALUES (1, 10, 'a')")
+        eng.execute("UPDATE kv SET s = 'b' WHERE k = 1")
+        r = eng.execute("SELECT s FROM kv WHERE k = 1")
+        assert r.rows == [("b",)]
+
+
+class TestReadYourWrites:
+    def test_select_sees_own_insert(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (7, 70)", s)
+        r = eng.execute("SELECT v FROM kv WHERE k = 7", s)
+        assert r.rows == [(70,)]
+        eng.execute("ROLLBACK", s)
+
+    def test_update_own_insert_in_txn(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (7, 70)", s)
+        eng.execute("UPDATE kv SET v = 71 WHERE k = 7", s)
+        r = eng.execute("SELECT v FROM kv WHERE k = 7", s)
+        assert r.rows == [(71,)]
+        eng.execute("COMMIT", s)
+        assert eng.execute("SELECT v FROM kv WHERE k = 7").rows == [(71,)]
+
+    def test_delete_own_insert_in_txn(self):
+        eng = make_engine()
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO kv (k, v) VALUES (7, 70)", s)
+        eng.execute("DELETE FROM kv WHERE k = 7", s)
+        assert count(eng, s) == 0
+        eng.execute("COMMIT", s)
+        assert count(eng) == 0
+
+
+class TestIsolation:
+    def test_snapshot_read_in_txn(self):
+        """A txn's reads stay at its read timestamp: concurrent
+        committed inserts are invisible (MVCC snapshot)."""
+        eng = make_engine()
+        s1 = eng.session()
+        eng.execute("BEGIN", s1)
+        assert count(eng, s1) == 0
+        eng.execute("INSERT INTO kv (k, v) VALUES (9, 9)", eng.session())
+        assert count(eng, s1) == 0       # still the snapshot
+        eng.execute("ROLLBACK", s1)
+        assert count(eng) == 1
+
+    def test_write_write_conflict(self):
+        """Two txns updating the same row: the second committer fails
+        (or the first gets aborted by a push) — no lost update."""
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 0)")
+        s1, s2 = eng.session(), eng.session()
+        eng.execute("BEGIN", s1)
+        eng.execute("UPDATE kv SET v = 1 WHERE k = 1", s1)
+        eng.execute("BEGIN", s2)
+        outcomes = []
+        try:
+            eng.execute("UPDATE kv SET v = 2 WHERE k = 1", s2)
+            outcomes.append("s2-wrote")
+        except EngineError:
+            outcomes.append("s2-blocked")
+        # one of the two txns must fail to commit with both writes
+        done = []
+        for s in (s1, s2):
+            try:
+                eng.execute("COMMIT", s)
+                done.append(True)
+            except EngineError:
+                done.append(False)
+        final = eng.execute("SELECT v FROM kv WHERE k = 1").rows[0][0]
+        assert final in (0, 1, 2)
+        # no lost update: if both committed, the second saw the first
+        if all(done):
+            assert final == 2
+
+    def test_txn_restart_error_surfaces(self):
+        """A conflicting commit raises the 40001-class restart error
+        instead of silently dropping writes."""
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 0)")
+        s1 = eng.session()
+        eng.execute("BEGIN", s1)
+        assert count(eng, s1) == 1  # registers the read span
+        # concurrent committed write invalidates s1's read snapshot if
+        # s1's commit ts must advance past it
+        eng.execute("UPDATE kv SET v = 5 WHERE k = 1")
+        eng.execute("UPDATE kv SET v = 7 WHERE k = 1", s1)
+        try:
+            eng.execute("COMMIT", s1)
+            committed = True
+        except EngineError as e:
+            committed = False
+            assert "restart" in str(e)
+        final = eng.execute("SELECT v FROM kv WHERE k = 1").rows[0][0]
+        assert final == (7 if committed else 5)
+
+
+class TestBulkInteraction:
+    def test_dml_on_bulk_ingested_table(self):
+        """Transactional DML over rows that entered via bulk columnar
+        ingest (the AddSSTable path) — the pk locator is built lazily."""
+        import numpy as np
+
+        from cockroach_tpu.storage.hlc import Timestamp
+        eng = make_engine()
+        eng.store.insert_columns(
+            "kv",
+            {"k": np.arange(10, dtype=np.int64),
+             "v": np.arange(10, dtype=np.int64) * 10,
+             "s": np.asarray(["x"] * 10)},
+            eng.clock.now())
+        assert count(eng) == 10
+        eng.execute("UPDATE kv SET v = -1 WHERE k >= 8")
+        eng.execute("DELETE FROM kv WHERE k < 2")
+        assert count(eng) == 8
+        r = eng.execute("SELECT count(*) AS c FROM kv WHERE v = -1")
+        assert r.rows[0][0] == 2
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("DELETE FROM kv WHERE v = -1", s)
+        eng.execute("ROLLBACK", s)
+        assert count(eng) == 8
+
+
+class TestMVCCTimeTravel:
+    def test_old_reader_sees_old_version(self):
+        eng = make_engine()
+        eng.execute("INSERT INTO kv (k, v) VALUES (1, 10)")
+        ts_before = eng.clock.now()
+        eng.execute("UPDATE kv SET v = 20 WHERE k = 1")
+        # a prepared read pinned at the old timestamp sees v=10
+        p = eng.prepare("SELECT v FROM kv")
+        r_old = p.run(read_ts=ts_before)
+        assert r_old.rows == [(10,)]
+        r_new = p.run()
+        assert r_new.rows == [(20,)]
+
+
+class TestStatementAtomicity:
+    """Code-review round-2 findings: a failed statement must not leave
+    partial writes behind (pg semantics: the whole txn aborts)."""
+
+    def test_failed_stmt_aborts_txn(self):
+        eng = Engine()
+        eng.execute(
+            "CREATE TABLE u (k INT8 NOT NULL PRIMARY KEY, v INT8)")
+        eng.execute("INSERT INTO u (k, v) VALUES (1, 1), (2, 2), (12, 12)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        with pytest.raises(EngineError, match="duplicate"):
+            # k=2 -> 12 collides; k=1 -> 11 would have succeeded
+            eng.execute("UPDATE u SET k = k + 10 WHERE k <= 2", s)
+        # txn is aborted: further statements rejected until ROLLBACK
+        with pytest.raises(EngineError, match="aborted"):
+            eng.execute("SELECT k FROM u", s)
+        eng.execute("ROLLBACK", s)
+        r = eng.execute("SELECT k, v FROM u ORDER BY k")
+        assert r.rows == [(1, 1), (2, 2), (12, 12)]
+
+    def test_commit_of_aborted_txn_is_rollback(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE u (k INT8 NOT NULL PRIMARY KEY)")
+        eng.execute("INSERT INTO u (k) VALUES (1)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO u (k) VALUES (5)", s)
+        with pytest.raises(EngineError, match="duplicate"):
+            eng.execute("INSERT INTO u (k) VALUES (1)", s)
+        r = eng.execute("COMMIT", s)
+        assert r.tag == "ROLLBACK"
+        # the k=5 insert must not have survived, and no phantom
+        # KV intent blocks re-inserting it
+        assert eng.execute("SELECT count(*) AS c FROM u").rows[0][0] == 1
+        eng.execute("INSERT INTO u (k) VALUES (5)")
+        assert eng.execute("SELECT count(*) AS c FROM u").rows[0][0] == 2
+
+    def test_failed_autocommit_insert_atomic(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE u (k INT8 NOT NULL PRIMARY KEY)")
+        eng.execute("INSERT INTO u (k) VALUES (1)")
+        with pytest.raises(EngineError, match="duplicate"):
+            eng.execute("INSERT INTO u (k) VALUES (3), (1)")
+        assert eng.execute("SELECT count(*) AS c FROM u").rows[0][0] == 1
+        eng.execute("INSERT INTO u (k) VALUES (3)")  # no phantom intent
+
+
+class TestDropRecreate:
+    def test_dropped_table_id_not_reused(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t1 (k INT8 NOT NULL PRIMARY KEY)")
+        eng.execute("INSERT INTO t1 (k) VALUES (1)")
+        eng.execute("DROP TABLE t1")
+        eng.execute("CREATE TABLE t1 (k INT8 NOT NULL PRIMARY KEY)")
+        assert eng.execute("SELECT count(*) AS c FROM t1").rows[0][0] == 0
+        # no phantom duplicate from the dropped table's orphaned rows
+        eng.execute("INSERT INTO t1 (k) VALUES (1)")
+        assert eng.execute("SELECT count(*) AS c FROM t1").rows[0][0] == 1
