@@ -1,0 +1,287 @@
+"""Command-line interface: start / sql / demo / version.
+
+The analogue of the reference's cobra CLI (pkg/cli/start.go:395 runStart;
+pkg/cli/clisqlshell for the interactive shell; pkg/cli/demo.go). Run as
+``python -m cockroach_tpu <command>``.
+
+The embedded ``PgClient`` is a from-scratch minimal pgwire v3 frontend
+(startup, simple query, text results) so the shell has no dependency on
+psycopg; tests drive the server through it too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import sys
+
+from . import __version__
+
+DEFAULT_PORT = 26257
+
+
+class PgError(Exception):
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(fields.get("M", "unknown error"))
+
+    @property
+    def sqlstate(self) -> str:
+        return self.fields.get("C", "XX000")
+
+
+class PgClient:
+    """Minimal pgwire v3 frontend for the simple query protocol."""
+
+    def __init__(self, host: str, port: int, user: str = "root",
+                 database: str = "defaultdb", timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.params: dict[str, str] = {}
+        self.txn_status = b"I"
+        params = (f"user\x00{user}\x00database\x00{database}\x00\x00"
+                  .encode())
+        body = struct.pack("!I", 196608) + params
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self._wait_ready()
+
+    # -- framing -------------------------------------------------------------
+    def _exactly(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            b = self.sock.recv(n)
+            if not b:
+                raise ConnectionError("server disconnected")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def _msg(self) -> tuple[bytes, bytes]:
+        typ = self._exactly(1)
+        (ln,) = struct.unpack("!I", self._exactly(4))
+        return typ, self._exactly(ln - 4)
+
+    @staticmethod
+    def _err_fields(body: bytes) -> dict:
+        fields = {}
+        off = 0
+        while off < len(body) and body[off:off + 1] != b"\x00":
+            code = body[off:off + 1].decode()
+            end = body.index(b"\x00", off + 1)
+            fields[code] = body[off + 1:end].decode()
+            off = end + 1
+        return fields
+
+    def _wait_ready(self):
+        err = None
+        while True:
+            typ, body = self._msg()
+            if typ == b"Z":
+                self.txn_status = body
+                if err:
+                    raise PgError(err)
+                return
+            if typ == b"E":
+                err = self._err_fields(body)
+            elif typ == b"S":
+                k, v = body.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            # R (auth), K (key data), N (notice): nothing to do
+
+    # -- queries -------------------------------------------------------------
+    def query(self, sql: str):
+        """Run one simple-protocol Query; returns (names, rows, tags)."""
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4)
+                          + payload)
+        names: list[str] = []
+        rows: list[tuple] = []
+        tags: list[str] = []
+        err = None
+        while True:
+            typ, body = self._msg()
+            if typ == b"T":
+                (n,) = struct.unpack_from("!H", body, 0)
+                off = 2
+                names = []
+                for _ in range(n):
+                    end = body.index(b"\x00", off)
+                    names.append(body[off:end].decode())
+                    off = end + 1 + 18
+            elif typ == b"D":
+                (n,) = struct.unpack_from("!H", body, 0)
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", body, off)
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif typ == b"C":
+                tags.append(body.rstrip(b"\x00").decode())
+            elif typ == b"I":
+                tags.append("")
+            elif typ == b"E":
+                err = self._err_fields(body)
+            elif typ == b"Z":
+                self.txn_status = body
+                if err:
+                    raise PgError(err)
+                return names, rows, tags
+
+    def close(self):
+        try:
+            self.sock.sendall(b"X" + struct.pack("!I", 4))
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- commands ----------------------------------------------------------------
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    if ":" in addr:
+        host, port = addr.rsplit(":", 1)
+        return host or "127.0.0.1", int(port)
+    return addr, DEFAULT_PORT
+
+
+def cmd_start(args) -> int:
+    from .server import Node, NodeConfig
+
+    host, port = _parse_addr(args.listen_addr)
+    node = Node(NodeConfig(listen_host=host, listen_port=port))
+    node.start()
+    h, p = node.sql_addr
+    print(f"cockroach-tpu node starting\n"
+          f"version:     {__version__}\n"
+          f"sql:         postgresql://root@{h}:{p}/defaultdb\n"
+          f"status:      serving", flush=True)
+    try:
+        import threading
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        print("\ninterrupt: shutting down", flush=True)
+    node.stop()
+    return 0
+
+
+def _shell(client: PgClient) -> int:
+    print(f"# cockroach-tpu sql shell (v{__version__}); "
+          f"\\q to quit", flush=True)
+    buf = ""
+    while True:
+        try:
+            prompt = "> " if not buf else "... "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        if line.strip() in ("\\q", "exit", "quit"):
+            break
+        buf += line + "\n"
+        if not buf.strip() or not buf.rstrip().endswith(";"):
+            continue
+        sql, buf = buf, ""
+        try:
+            names, rows, tags = client.query(sql)
+        except PgError as e:
+            print(f"ERROR: {e} (SQLSTATE {e.sqlstate})", flush=True)
+            continue
+        except ConnectionError:
+            print("connection lost", flush=True)
+            return 1
+        _print_result(names, rows, tags)
+    client.close()
+    return 0
+
+
+def _print_result(names, rows, tags):
+    if names:
+        widths = [max(len(n), *(len(str(r[i])) if r[i] is not None else 4
+                                for r in rows)) if rows else len(n)
+                  for i, n in enumerate(names)]
+        print("  ".join(n.ljust(w) for n, w in zip(names, widths)))
+        print("  ".join("-" * w for w in widths))
+        for r in rows:
+            print("  ".join(
+                ("NULL" if v is None else str(v)).ljust(w)
+                for v, w in zip(r, widths)))
+    for t in tags:
+        print(t, flush=True)
+
+
+def cmd_sql(args) -> int:
+    host, port = _parse_addr(args.url)
+    try:
+        client = PgClient(host, port)
+    except OSError as e:
+        print(f"cannot connect to {host}:{port}: {e}", file=sys.stderr)
+        return 1
+    if args.execute:
+        rc = 0
+        for sql in args.execute:
+            try:
+                names, rows, tags = client.query(sql)
+                _print_result(names, rows, tags)
+            except PgError as e:
+                print(f"ERROR: {e} (SQLSTATE {e.sqlstate})",
+                      file=sys.stderr)
+                rc = 1
+        client.close()
+        return rc
+    return _shell(client)
+
+
+def cmd_demo(args) -> int:
+    from .server import Node, NodeConfig
+
+    print(f"# loading TPC-H sf={args.sf} demo data ...", flush=True)
+    node = Node(NodeConfig(load_tpch_sf=args.sf)).start()
+    h, p = node.sql_addr
+    print(f"# demo node at postgresql://root@{h}:{p}/defaultdb", flush=True)
+    client = PgClient(h, p)
+    rc = _shell(client)
+    node.stop()
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cockroach-tpu",
+        description="TPU-native distributed SQL engine")
+    sub = ap.add_subparsers(dest="command")
+
+    p_start = sub.add_parser("start", help="start a node")
+    p_start.add_argument("--listen-addr", default=f"127.0.0.1:{DEFAULT_PORT}")
+    p_start.set_defaults(fn=cmd_start)
+
+    p_sql = sub.add_parser("sql", help="open a SQL shell")
+    p_sql.add_argument("--url", default=f"127.0.0.1:{DEFAULT_PORT}",
+                       help="host:port of a running node")
+    p_sql.add_argument("-e", "--execute", action="append",
+                       help="run statement(s) and exit")
+    p_sql.set_defaults(fn=cmd_sql)
+
+    p_demo = sub.add_parser("demo", help="in-memory node + shell with "
+                                         "TPC-H data")
+    p_demo.add_argument("--sf", type=float, default=0.01)
+    p_demo.set_defaults(fn=cmd_demo)
+
+    p_ver = sub.add_parser("version", help="print version")
+    p_ver.set_defaults(fn=lambda a: (print(f"cockroach-tpu v{__version__} "
+                                           f"(jax/XLA, pgwire v3)"), 0)[1])
+
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.print_help()
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

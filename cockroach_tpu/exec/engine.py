@@ -16,6 +16,7 @@ growth, not every ingest.
 from __future__ import annotations
 
 import datetime
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -154,6 +155,12 @@ class Engine:
         self.mesh = mesh
         self._device_tables: dict[tuple, ColumnBatch] = {}
         self._exec_cache: dict[tuple, tuple] = {}
+        # statement execution is serialized per engine: pgwire serves
+        # each connection on its own thread, and the plan/device caches
+        # plus columnstore publish are not safe under concurrent
+        # mutation (the reference runs a connExecutor per conn against
+        # thread-safe subsystems; finer-grained locking is later work)
+        self._stmt_lock = threading.RLock()
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:
@@ -161,7 +168,14 @@ class Engine:
 
     def execute(self, sql: str, session: Session | None = None) -> Result:
         session = session or self.session()
-        stmt = parser.parse(sql)
+        try:
+            stmt = parser.parse(sql)
+        except Exception:
+            # a syntax error inside an explicit txn block aborts it,
+            # same as any other statement failure (pg semantics)
+            if session.txn is not None:
+                session.txn_aborted = True
+            raise
         return self.execute_stmt(stmt, session, sql_text=sql)
 
     def execute_stmt(self, stmt: ast.Statement, session: Session,
@@ -171,6 +185,20 @@ class Engine:
             raise EngineError(
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block")
+        try:
+            with self._stmt_lock:
+                return self._dispatch_stmt(stmt, session, sql_text)
+        except Exception:
+            # any error inside an explicit txn block aborts it until
+            # ROLLBACK (postgres semantics; the connExecutor state
+            # machine's stateAborted) — not just DML failures
+            if session.txn is not None and not isinstance(
+                    stmt, ast.BeginTxn):
+                session.txn_aborted = True
+            raise
+
+    def _dispatch_stmt(self, stmt: ast.Statement, session: Session,
+                       sql_text: str = "") -> Result:
         if isinstance(stmt, ast.Select):
             return self._exec_select(stmt, session, sql_text)
         if isinstance(stmt, ast.CreateTable):
@@ -521,7 +549,10 @@ class Engine:
             except BaseException:
                 t.rollback()
                 raise
-        raise EngineError(f"DML exhausted retries: {last}")
+        # still the retryable serialization class (pgwire maps the
+        # "restart transaction" phrasing to SQLSTATE 40001)
+        raise EngineError(f"restart transaction: DML exhausted "
+                          f"retries: {last}")
 
     def _publish(self, effects: list, ts: Timestamp) -> None:
         if not effects:
@@ -580,14 +611,34 @@ class Engine:
         idx = self.store.ensure_pk_index(table)
         rts = read_ts.to_int()
         shadow: dict[int, np.ndarray] = {}   # chunk idx -> COW mvcc_del
+
+        def _tombstone(ci: int, ri: int):
+            if ci not in shadow:
+                shadow[ci] = td.chunks[ci].mvcc_del.copy()
+            shadow[ci][ri] = rts   # hidden from this txn's reads
         for key in state:
             pos = idx.get(key)
             if pos is None:
                 continue
             ci, ri = pos
-            if ci not in shadow:
-                shadow[ci] = td.chunks[ci].mvcc_del.copy()
-            shadow[ci][ri] = rts   # hidden from this txn's reads
+            if td.chunks[ci].mvcc_ts[ri] > rts:
+                # live version is newer than our snapshot (a concurrent
+                # txn superseded the key after our read_ts): it is
+                # already invisible at rts; the version we must hide is
+                # found by the superseded-after-rts sweep below
+                continue
+            _tombstone(ci, ri)
+        # Versions visible at rts but superseded/deleted after it are
+        # NOT in the live pk index, yet they are exactly what a pending
+        # write must shadow (otherwise the old version + our delta row
+        # would both surface). They satisfy rts < mvcc_del < MAX — a
+        # small candidate set (recent MVCC garbage) we key-match.
+        for ci, c in enumerate(td.chunks):
+            cand = np.nonzero((c.mvcc_ts <= rts) & (rts < c.mvcc_del)
+                              & (c.mvcc_del != MAX_TS_INT))[0]
+            for ri in cand:
+                if self.store.row_key(td, c, int(ri)) in state:
+                    _tombstone(ci, int(ri))
         chunks = []
         for ci, c in enumerate(td.chunks):
             if ci in shadow:

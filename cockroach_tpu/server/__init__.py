@@ -1,0 +1,6 @@
+"""Node server + pgwire SQL API (reference: pkg/server, pkg/sql/pgwire)."""
+
+from .node import Node, NodeConfig
+from .pgwire import PgServer
+
+__all__ = ["Node", "NodeConfig", "PgServer"]

@@ -1,0 +1,70 @@
+"""Node lifecycle: assemble subsystems and serve clients.
+
+The analogue of the reference's server package (pkg/server/server.go:203
+``NewServer`` wires rpc/gossip/kv/sql together; ``PreStart``
+server.go:1213 boots them in dependency order; ``AcceptClients``
+server.go:1915 opens the pgwire listener). Here a Node owns the
+columnstore scan plane, the HLC clock, the transactional KV plane
+(inside Engine), cluster settings, and the pgwire server; ``start()``
+brings them up and returns once the SQL listener is bound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import __version__
+from ..exec.engine import Engine
+from ..storage.columnstore import ColumnStore
+from ..storage.hlc import Clock
+from ..utils.settings import Settings
+from .pgwire import PgServer
+
+
+@dataclass
+class NodeConfig:
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0          # 0 = ephemeral (tests); CLI default 26257
+    mesh: object = None           # optional device mesh for DistSQL
+    load_tpch_sf: float | None = None  # demo mode: preload TPC-H tables
+
+
+class Node:
+    def __init__(self, config: NodeConfig | None = None):
+        self.config = config or NodeConfig()
+        self.clock = Clock()
+        self.store = ColumnStore()
+        self.settings = Settings()
+        self.engine = Engine(store=self.store, clock=self.clock,
+                             settings=self.settings,
+                             mesh=self.config.mesh)
+        self.pg: PgServer | None = None
+        self._started = False
+
+    @property
+    def sql_addr(self) -> tuple[str, int]:
+        assert self.pg is not None, "node not started"
+        return self.pg.addr
+
+    def start(self) -> "Node":
+        if self._started:
+            return self
+        if self.config.load_tpch_sf is not None:
+            from ..models import tpch
+            tpch.load(self.engine, sf=self.config.load_tpch_sf)
+        self.pg = PgServer(self.engine, self.config.listen_host,
+                           self.config.listen_port,
+                           version=__version__).start()
+        self._started = True
+        return self
+
+    def stop(self):
+        if self.pg is not None:
+            self.pg.stop()
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,452 @@
+"""PostgreSQL wire protocol v3 — the SQL API surface.
+
+The analogue of the reference's pgwire server (pkg/sql/pgwire/server.go:685
+``ServeConn``; per-connection loop pkg/sql/pgwire/conn.go:280 ``serveImpl``).
+Scope: startup handshake (plus SSLRequest denial), trust auth, the simple
+query protocol (Query -> RowDescription/DataRow/CommandComplete), a minimal
+extended protocol (Parse/Bind/Describe/Execute/Close/Sync) sufficient for
+driver-style clients that never use parameters, and error reporting with
+SQLSTATE codes. Each connection owns an engine Session, so transaction
+state (idle / open / aborted) is per-connection exactly like the
+reference's connExecutor, and is reported in ReadyForQuery.
+
+No TLS, SCRAM, COPY, or portals-with-suspension: those are listed in
+SURVEY §2.1 as later-round work. The framing below is from the public
+PostgreSQL protocol documentation, not from the reference tree.
+"""
+
+from __future__ import annotations
+
+import datetime
+import socket
+import socketserver
+import struct
+import threading
+
+from ..exec.engine import Engine, EngineError, Result, Session
+
+PROTO_V3 = 196608          # 3.0
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+GSSENC_REQUEST = 80877104
+
+# type OIDs (public pg catalog numbers)
+OID_BOOL = 16
+OID_INT8 = 20
+OID_FLOAT8 = 701
+OID_TEXT = 25
+OID_DATE = 1082
+OID_TIMESTAMP = 1114
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def _sqlstate(exc: Exception) -> str:
+    msg = str(exc)
+    if "restart transaction" in msg:
+        return "40001"  # serialization_failure
+    if "transaction is aborted" in msg:
+        return "25P02"  # in_failed_sql_transaction
+    if isinstance(exc, EngineError):
+        return "42601" if "parse" in msg.lower() else "XX000"
+    return "XX000"
+
+
+def _infer_oid(rows, col: int) -> int:
+    """Type OID from the first non-null value in column ``col``."""
+    for row in rows:
+        v = row[col]
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return OID_BOOL
+        if isinstance(v, int):
+            return OID_INT8
+        if isinstance(v, float):
+            return OID_FLOAT8
+        if isinstance(v, datetime.datetime):
+            return OID_TIMESTAMP
+        if isinstance(v, datetime.date):
+            return OID_DATE
+        return OID_TEXT
+    return OID_TEXT
+
+
+def _encode_text(v) -> bytes | None:
+    """Text-format result encoding (format code 0)."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+def split_statements(buf: str) -> list[str]:
+    """Split a simple-Query string on top-level semicolons.
+
+    Respects single-quoted literals (with '' escapes) and double-quoted
+    identifiers; pgwire's simple query protocol allows multiple
+    statements per message.
+    """
+    out, cur, i, n = [], [], 0, len(buf)
+    quote = None
+    while i < n:
+        c = buf[i]
+        if quote:
+            cur.append(c)
+            if c == quote:
+                if quote == "'" and i + 1 < n and buf[i + 1] == "'":
+                    cur.append(buf[i + 1])
+                    i += 1
+                else:
+                    quote = None
+        elif c in ("'", '"'):
+            quote = c
+            cur.append(c)
+        elif c == ";":
+            s = "".join(cur).strip()
+            if s:
+                out.append(s)
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    s = "".join(cur).strip()
+    if s:
+        out.append(s)
+    return out
+
+
+class _Writer:
+    """Typed pgwire backend-message writer over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+
+    def msg(self, typ: bytes, payload: bytes = b""):
+        self._buf += typ + struct.pack("!I", len(payload) + 4) + payload
+
+    def flush(self):
+        if self._buf:
+            self._sock.sendall(bytes(self._buf))
+            self._buf.clear()
+
+    # -- concrete messages ---------------------------------------------------
+    def auth_ok(self):
+        self.msg(b"R", struct.pack("!I", 0))
+
+    def parameter_status(self, key: str, val: str):
+        self.msg(b"S", key.encode() + b"\x00" + val.encode() + b"\x00")
+
+    def backend_key_data(self, pid: int, secret: int):
+        self.msg(b"K", struct.pack("!II", pid & 0xFFFFFFFF, secret))
+
+    def ready_for_query(self, status: bytes):
+        self.msg(b"Z", status)
+        self.flush()
+
+    def row_description(self, names, oids):
+        p = bytearray(struct.pack("!H", len(names)))
+        for name, oid in zip(names, oids):
+            p += name.encode() + b"\x00"
+            p += struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+        self.msg(b"T", bytes(p))
+
+    def data_row(self, encoded: list[bytes | None]):
+        p = bytearray(struct.pack("!H", len(encoded)))
+        for e in encoded:
+            if e is None:
+                p += struct.pack("!i", -1)
+            else:
+                p += struct.pack("!I", len(e)) + e
+        self.msg(b"D", bytes(p))
+
+    def command_complete(self, tag: str):
+        self.msg(b"C", tag.encode() + b"\x00")
+
+    def empty_query(self):
+        self.msg(b"I")
+
+    def no_data(self):
+        self.msg(b"n")
+
+    def parse_complete(self):
+        self.msg(b"1")
+
+    def bind_complete(self):
+        self.msg(b"2")
+
+    def close_complete(self):
+        self.msg(b"3")
+
+    def parameter_description(self, oids):
+        self.msg(b"t", struct.pack("!H", len(oids)) +
+                 b"".join(struct.pack("!I", o) for o in oids))
+
+    def error(self, message: str, code: str = "XX000",
+              severity: str = "ERROR"):
+        p = (b"S" + severity.encode() + b"\x00" +
+             b"V" + severity.encode() + b"\x00" +
+             b"C" + code.encode() + b"\x00" +
+             b"M" + message.encode() + b"\x00" + b"\x00")
+        self.msg(b"E", p)
+
+
+class _Reader:
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def _exactly(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            b = self._sock.recv(n)
+            if not b:
+                raise ConnectionError("client disconnected")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def startup(self) -> tuple[int, dict]:
+        (length,) = struct.unpack("!I", self._exactly(4))
+        if length < 8 or length > 1 << 20:
+            raise ProtocolError(f"bad startup length {length}")
+        body = self._exactly(length - 4)
+        (code,) = struct.unpack("!I", body[:4])
+        params = {}
+        if code == PROTO_V3:
+            parts = body[4:].split(b"\x00")
+            for k, v in zip(parts[::2], parts[1::2]):
+                if k:
+                    params[k.decode()] = v.decode()
+        return code, params
+
+    def message(self) -> tuple[bytes, bytes]:
+        typ = self._exactly(1)
+        (length,) = struct.unpack("!I", self._exactly(4))
+        if length < 4 or length > 1 << 28:
+            raise ProtocolError(f"bad message length {length}")
+        return typ, self._exactly(length - 4)
+
+
+def _cstr(b: bytes, off: int) -> tuple[str, int]:
+    end = b.index(b"\x00", off)
+    return b[off:end].decode(), end + 1
+
+
+class _Conn:
+    """One client connection: the serveImpl loop (conn.go:280)."""
+
+    def __init__(self, sock: socket.socket, engine: Engine, conn_id: int,
+                 version: str):
+        self.sock = sock
+        self.engine = engine
+        self.conn_id = conn_id
+        self.version = version
+        self.r = _Reader(sock)
+        self.w = _Writer(sock)
+        self.session: Session = engine.session()
+        # extended-protocol state: prepared statements + bound portals
+        self.stmts: dict[str, str] = {}
+        self.portals: dict[str, str] = {}
+        self._errored = False  # skip-until-Sync after extended-proto error
+
+    # -- helpers -------------------------------------------------------------
+    def _txn_status(self) -> bytes:
+        if self.session.txn_aborted:
+            return b"E"
+        return b"T" if self.session.in_txn else b"I"
+
+    def _complete_tag(self, res: Result) -> str:
+        if res.tag == "INSERT":
+            return f"INSERT 0 {res.row_count}"
+        if res.tag in ("UPDATE", "DELETE"):
+            return f"{res.tag} {res.row_count}"
+        if res.names:  # any row-returning statement
+            return f"{res.tag} {len(res.rows)}"
+        return res.tag
+
+    def _send_result(self, res: Result, describe: bool = True):
+        if res.names:
+            oids = [_infer_oid(res.rows, i) for i in range(len(res.names))]
+            if describe:
+                self.w.row_description(res.names, oids)
+            for row in res.rows:
+                self.w.data_row([_encode_text(v) for v in row])
+        self.w.command_complete(self._complete_tag(res))
+
+    def _execute(self, sql: str) -> Result:
+        return self.engine.execute(sql, self.session)
+
+    # -- protocol phases -----------------------------------------------------
+    def handshake(self) -> bool:
+        while True:
+            code, params = self.r.startup()
+            if code in (SSL_REQUEST, GSSENC_REQUEST):
+                self.sock.sendall(b"N")  # not supported; retry cleartext
+                continue
+            if code == CANCEL_REQUEST:
+                return False
+            if code != PROTO_V3:
+                self.w.error(f"unsupported protocol {code >> 16}."
+                             f"{code & 0xFFFF}", code="0A000",
+                             severity="FATAL")
+                self.w.flush()
+                return False
+            break
+        self.w.auth_ok()
+        self.w.parameter_status("server_version", "13.0 cockroach-tpu "
+                                + self.version)
+        self.w.parameter_status("client_encoding", "UTF8")
+        self.w.parameter_status("DateStyle", "ISO")
+        self.w.parameter_status("integer_datetimes", "on")
+        self.w.backend_key_data(self.conn_id, 0)
+        self.w.ready_for_query(self._txn_status())
+        return True
+
+    def serve(self):
+        if not self.handshake():
+            return
+        while True:
+            typ, body = self.r.message()
+            if typ == b"X":          # Terminate
+                return
+            if typ == b"Q":
+                self._simple_query(body)
+            elif typ in (b"P", b"B", b"D", b"E", b"C", b"H", b"S"):
+                self._extended(typ, body)
+            elif typ == b"F":        # function call: unsupported
+                self.w.error("function call protocol unsupported",
+                             code="0A000")
+                self.w.ready_for_query(self._txn_status())
+            else:
+                self.w.error(f"unknown frontend message {typ!r}",
+                             code="08P01")
+                self.w.ready_for_query(self._txn_status())
+
+    def _simple_query(self, body: bytes):
+        sql, _ = _cstr(body, 0)
+        stmts = split_statements(sql)
+        if not stmts:
+            self.w.empty_query()
+            self.w.ready_for_query(self._txn_status())
+            return
+        for s in stmts:
+            try:
+                res = self._execute(s)
+            except Exception as e:  # engine errors end the message batch
+                self.w.error(str(e), code=_sqlstate(e))
+                break
+            self._send_result(res)
+        self.w.ready_for_query(self._txn_status())
+
+    def _extended(self, typ: bytes, body: bytes):
+        # after an error, discard everything until Sync
+        if self._errored and typ != b"S":
+            return
+        try:
+            if typ == b"P":           # Parse
+                name, off = _cstr(body, 0)
+                sql, off = _cstr(body, off)
+                (nparams,) = struct.unpack_from("!H", body, off)
+                if nparams:
+                    raise EngineError(
+                        "bind parameters are not supported yet")
+                self.stmts[name] = sql
+                self.w.parse_complete()
+            elif typ == b"B":         # Bind
+                portal, off = _cstr(body, 0)
+                stmt, off = _cstr(body, off)
+                if stmt not in self.stmts:
+                    raise EngineError(f"unknown prepared statement "
+                                      f"{stmt!r}")
+                self.portals[portal] = self.stmts[stmt]
+                self.w.bind_complete()
+            elif typ == b"D":         # Describe
+                kind, sql_name = body[:1], _cstr(body, 1)[0]
+                src = self.portals if kind == b"P" else self.stmts
+                if sql_name not in src:
+                    raise EngineError(f"unknown {kind!r} {sql_name!r}")
+                if kind == b"S":
+                    self.w.parameter_description([])
+                # row shape is only known post-execution here; NoData
+                # keeps drivers on the simple path (they re-describe
+                # from the result's RowDescription we emit on Execute)
+                self.w.no_data()
+            elif typ == b"E":         # Execute
+                portal, _ = _cstr(body, 0)
+                if portal not in self.portals:
+                    raise EngineError(f"unknown portal {portal!r}")
+                res = self._execute(self.portals[portal])
+                self._send_result(res)
+            elif typ == b"C":         # Close
+                kind, name = body[:1], _cstr(body, 1)[0]
+                (self.portals if kind == b"P" else self.stmts).pop(
+                    name, None)
+                self.w.close_complete()
+            elif typ == b"H":         # Flush
+                self.w.flush()
+            elif typ == b"S":         # Sync
+                self._errored = False
+                self.w.ready_for_query(self._txn_status())
+        except Exception as e:
+            self._errored = True
+            self.w.error(str(e), code=_sqlstate(e))
+            self.w.flush()
+
+
+class PgServer:
+    """TCP listener dispatching pgwire connections onto threads.
+
+    The reference accepts on a listener in (*Server).AcceptClients
+    (pkg/server/server.go:1915) and serves each conn on a goroutine via
+    pgwire.Server.ServeConn; threads are the Python analogue.
+    """
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1",
+                 port: int = 0, version: str = "0.2.0"):
+        self.engine = engine
+        self.version = version
+        self._next_id = [0]
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._next_id[0] += 1
+                conn = _Conn(self.request, outer.engine,
+                             outer._next_id[0], outer.version)
+                try:
+                    conn.serve()
+                except (ConnectionError, ProtocolError, OSError):
+                    pass
+                finally:
+                    if conn.session.txn is not None:
+                        conn.session.txn.rollback()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            name="pgwire-accept", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

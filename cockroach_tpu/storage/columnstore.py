@@ -352,6 +352,22 @@ class ColumnStore:
             row[ROWID] = int(chunk.rowid[ri])
         return row
 
+    def row_key(self, td: TableData, chunk: Chunk, ri: int) -> bytes:
+        """The KV key bytes for one stored row version (pk columns
+        decoded and run through the table's order-preserving codec)."""
+        codec = td.codec
+        if codec.synthetic_pk:
+            return codec.key_from_pk((int(chunk.rowid[ri]),))
+        pk = []
+        for cn in codec.pk_cols:
+            col = td.schema.column(cn)
+            v = chunk.data[cn][ri]
+            if col.type.family == Family.STRING:
+                pk.append(td.dictionaries[cn].values[int(v)])
+            else:
+                pk.append(v.item())
+        return codec.key_from_pk(tuple(pk))
+
     def ensure_pk_index(self, name: str) -> dict:
         """Build (lazily) the pk-key -> (chunk, row) locator for LIVE
         rows. The DML path needs it to tombstone superseded versions;
@@ -361,26 +377,11 @@ class ColumnStore:
             self._seal_locked(td)
             if td.pk_index is not None:
                 return td.pk_index
-            codec = td.codec
             idx: dict[bytes, tuple[int, int]] = {}
-            from ..sql.rowenc import ROWID
             for ci, chunk in enumerate(td.chunks):
                 live = chunk.mvcc_del == MAX_TS_INT
                 for ri in np.nonzero(live)[0]:
-                    if codec.synthetic_pk:
-                        key = codec.key_from_pk((int(chunk.rowid[ri]),))
-                    else:
-                        pk = []
-                        for cn in codec.pk_cols:
-                            col = td.schema.column(cn)
-                            v = chunk.data[cn][ri]
-                            if col.type.family == Family.STRING:
-                                pk.append(td.dictionaries[cn]
-                                          .values[int(v)])
-                            else:
-                                pk.append(v.item())
-                        key = codec.key_from_pk(tuple(pk))
-                    idx[key] = (ci, int(ri))
+                    idx[self.row_key(td, chunk, int(ri))] = (ci, int(ri))
             td.pk_index = idx
             return idx
 

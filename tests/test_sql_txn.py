@@ -285,3 +285,37 @@ class TestDropRecreate:
         # no phantom duplicate from the dropped table's orphaned rows
         eng.execute("INSERT INTO t1 (k) VALUES (1)")
         assert eng.execute("SELECT count(*) AS c FROM t1").rows[0][0] == 1
+
+
+class TestOverlaySnapshotCorrectness:
+    def test_overlay_shadows_version_visible_at_read_ts(self):
+        """A pending write must shadow the version visible at the txn's
+        read timestamp even when a concurrent commit already superseded
+        the key (the live pk index then points at a version that is
+        invisible at rts; the old version must not surface beside the
+        txn's delta row). Reference: MVCC intents replace the committed
+        version for their own txn's reads regardless of later writes."""
+        eng = Engine()
+        eng.execute("CREATE TABLE ov (k INT8 NOT NULL PRIMARY KEY, v INT8)")
+        eng.execute("INSERT INTO ov (k, v) VALUES (1, 10)")
+        rts = eng.clock.now()            # txn snapshot
+        eng.execute("UPDATE ov SET v = 20 WHERE k = 1")  # concurrent commit
+        td = eng.store.table("ov")
+        key = td.codec.key_from_pk((1,))
+        effects = [("ov", ("put", key, {"k": 1, "v": 30}))]
+        chunks = eng._overlay_chunks("ov", effects, rts)
+        ri = rts.to_int()
+        visible = sum(int(c.live_mask(ri).sum()) for c in chunks)
+        assert visible == 1  # only the txn's own pending row
+
+    def test_syntax_error_aborts_open_txn(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE se (k INT8 NOT NULL PRIMARY KEY)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        with pytest.raises(Exception):
+            eng.execute("SELCT 1", s)    # syntax error
+        with pytest.raises(EngineError, match="aborted"):
+            eng.execute("INSERT INTO se (k) VALUES (1)", s)
+        eng.execute("ROLLBACK", s)
+        assert eng.execute("SELECT count(*) AS c FROM se").rows[0][0] == 0
