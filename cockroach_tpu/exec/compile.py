@@ -114,14 +114,7 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
         lim, off = node.limit, node.offset
 
         def run_limit(rc):
-            b = childf(rc)
-            rank = jnp.cumsum(b.sel.astype(jnp.int32)) - 1
-            keep = b.sel
-            if off:
-                keep = jnp.logical_and(keep, rank >= off)
-            if lim is not None:
-                keep = jnp.logical_and(keep, rank < off + lim)
-            return b.with_sel(keep)
+            return limit_batch(childf(rc), lim, off)
         return run_limit
     raise ExecError(f"cannot compile plan node {node!r}")
 
@@ -365,13 +358,11 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
 # sort
 # ---------------------------------------------------------------------------
 
-def _compile_sort(node: P.Sort, params: ExecParams,
-                  meta: P.OutputMeta | None) -> CompiledNode:
-    childf = compile_plan(node.child, params, meta)
-    # string sort keys order by dictionary rank, not code
+def _sort_rank_tables(keys, meta: P.OutputMeta | None) -> dict:
+    """String sort keys order by dictionary rank, not code."""
     rank_tables = {}
     if meta is not None:
-        for name, desc in node.keys:
+        for name, desc in keys:
             d = meta.dictionaries.get(name)
             if d is not None:
                 order = np.argsort(np.asarray(d.values, dtype=object).astype(str),
@@ -379,30 +370,272 @@ def _compile_sort(node: P.Sort, params: ExecParams,
                 rank = np.empty(len(order), dtype=np.int32)
                 rank[order] = np.arange(len(order), dtype=np.int32)
                 rank_tables[name] = rank
+    return rank_tables
+
+
+def sort_batch(b: ColumnBatch, keys, rank_tables: dict) -> ColumnBatch:
+    sort_keys = []  # lexsort: LAST key is primary
+    for name, desc in reversed(keys):
+        d = b.col(name)
+        v = b.col_valid(name)
+        if name in rank_tables:
+            lut = jnp.asarray(rank_tables[name])
+            d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        if desc:
+            d = -d.astype(jnp.float64) if jnp.issubdtype(
+                d.dtype, jnp.floating) else -d.astype(jnp.int64)
+        # NULLS LAST for asc, NULLS FIRST for desc (PostgreSQL default)
+        nullkey = v if desc else jnp.logical_not(v)
+        sort_keys.append(d)
+        sort_keys.append(nullkey.astype(jnp.int8))
+    # dead rows always last
+    sort_keys.append(jnp.logical_not(b.sel).astype(jnp.int8))
+    perm = jnp.lexsort(tuple(sort_keys))
+    data = tuple(d[perm] for d in b.data)
+    valid = tuple(v[perm] for v in b.valid)
+    return ColumnBatch(data, valid, b.sel[perm], b.names)
+
+
+def limit_batch(b: ColumnBatch, limit, offset) -> ColumnBatch:
+    rank = jnp.cumsum(b.sel.astype(jnp.int32)) - 1
+    keep = b.sel
+    if offset:
+        keep = jnp.logical_and(keep, rank >= offset)
+    if limit is not None:
+        keep = jnp.logical_and(keep, rank < offset + limit)
+    return b.with_sel(keep)
+
+
+def _compile_sort(node: P.Sort, params: ExecParams,
+                  meta: P.OutputMeta | None) -> CompiledNode:
+    childf = compile_plan(node.child, params, meta)
+    rank_tables = _sort_rank_tables(node.keys, meta)
     keys = list(node.keys)
 
     def run_sort(rc: RunContext) -> ColumnBatch:
-        b = childf(rc)
-        sort_keys = []  # lexsort: LAST key is primary
-        for name, desc in reversed(keys):
-            d = b.col(name)
-            v = b.col_valid(name)
-            if name in rank_tables:
-                lut = jnp.asarray(rank_tables[name])
-                d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
-            if d.dtype == jnp.bool_:
-                d = d.astype(jnp.int32)
-            if desc:
-                d = -d.astype(jnp.float64) if jnp.issubdtype(
-                    d.dtype, jnp.floating) else -d.astype(jnp.int64)
-            # NULLS LAST for asc, NULLS FIRST for desc (PostgreSQL default)
-            nullkey = v if desc else jnp.logical_not(v)
-            sort_keys.append(d)
-            sort_keys.append(nullkey.astype(jnp.int8))
-        # dead rows always last
-        sort_keys.append(jnp.logical_not(b.sel).astype(jnp.int8))
-        perm = jnp.lexsort(tuple(sort_keys))
-        data = tuple(d[perm] for d in b.data)
-        valid = tuple(v[perm] for v in b.valid)
-        return ColumnBatch(data, valid, b.sel[perm], b.names)
+        return sort_batch(childf(rc), keys, rank_tables)
     return run_sort
+
+
+# ---------------------------------------------------------------------------
+# streaming aggregation (beyond-HBM scans)
+# ---------------------------------------------------------------------------
+# The reference pages scans with byte-limited KV batches
+# (pkg/sql/row/kv_batch_fetcher.go:191) and spills operators to disk;
+# the HBM analogue streams the fact table host->device in fixed-shape
+# pages and keeps only per-group partial-aggregate STATE device-resident
+# between pages. The per-page partial / cross-page combine / finalize
+# split is exactly the DistAggregationTable local/final-stage algebra
+# (pkg/sql/physicalplan/aggregator_funcs.go) with "page" standing in
+# for "node": SUM -> add, MIN -> min, AVG -> (sum, count) + divide.
+
+_COMBINE_OPS = {
+    "add": lambda a, b: a + b,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def _is_float_agg_arg(a: BoundAgg) -> bool:
+    return a.arg is not None and a.arg.type.family == Family.FLOAT
+
+
+def _agg_state_ops(a: BoundAgg) -> tuple:
+    """Static combine-op layout of one aggregate's partial state."""
+    if a.func in ("count_rows", "count"):
+        return ("add",)
+    if a.func in ("sum", "sum_int"):
+        # int64-accumulated sums carry a float64 shadow for the
+        # overflow gate (see _agg_partials)
+        return ("add", "add") if _is_float_agg_arg(a) else ("add", "add", "add")
+    if a.func == "avg":
+        return ("add", "add")
+    if a.func == "min":
+        return ("min", "add")
+    if a.func == "max":
+        return ("max", "add")
+    raise ExecError(f"aggregate {a.func} cannot stream")
+
+
+def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups) -> tuple:
+    """One page's partial-state arrays for one aggregate (layout must
+    match _agg_state_ops)."""
+    grouped = gid is not None
+    if a.func == "count_rows":
+        mask = batch.sel
+        d = (aggops.group_count(gid, mask, num_groups) if grouped
+             else aggops.masked_count(mask)[None])
+        return (d,)
+    d0, v0 = argf(ctx)
+    mask = jnp.logical_and(batch.sel, v0)
+    cnt = (aggops.group_count(gid, mask, num_groups) if grouped
+           else aggops.masked_count(mask)[None])
+    if a.func == "count":
+        return (cnt,)
+    if a.func in ("sum", "sum_int"):
+        acc = jnp.float64 if _is_float_agg_arg(a) else jnp.int64
+        d = (aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc)
+             if grouped else aggops.masked_sum(d0, mask, acc_dtype=acc)[None])
+        if acc == jnp.int64:
+            sh = (aggops.group_sum(d0.astype(jnp.float64), gid, mask,
+                                   num_groups) if grouped
+                  else aggops.masked_sum(d0.astype(jnp.float64), mask)[None])
+            return (d, cnt, sh)
+        return (d, cnt)
+    if a.func == "avg":
+        scale = (10.0 ** a.arg.type.scale
+                 if a.arg.type.family == Family.DECIMAL else 1.0)
+        df = d0.astype(jnp.float64) / scale
+        s = (aggops.group_sum(df, gid, mask, num_groups) if grouped
+             else aggops.masked_sum(df, mask)[None])
+        return (s, cnt)
+    if a.func == "min":
+        m = (aggops.group_min(d0, gid, mask, num_groups) if grouped
+             else aggops.masked_min(d0, mask)[None])
+        return (m, cnt)
+    if a.func == "max":
+        m = (aggops.group_max(d0, gid, mask, num_groups) if grouped
+             else aggops.masked_max(d0, mask)[None])
+        return (m, cnt)
+    raise ExecError(f"aggregate {a.func} cannot stream")
+
+
+def _agg_finalize(a: BoundAgg, arrs: tuple):
+    """Combined state -> (data, valid, overflow|None)."""
+    if a.func in ("count_rows", "count"):
+        d = arrs[0]
+        return d, jnp.ones_like(d, dtype=jnp.bool_), None
+    if a.func in ("sum", "sum_int"):
+        if _is_float_agg_arg(a):
+            d, cnt = arrs
+            return d, cnt > 0, None
+        d, cnt, sh = arrs
+        err = jnp.abs(d.astype(jnp.float64) - sh)
+        tol = jnp.maximum(jnp.abs(sh) * 1e-3, 1e12)
+        return d, cnt > 0, jnp.any(err > tol)
+    if a.func == "avg":
+        s, cnt = arrs
+        return s / jnp.maximum(cnt, 1).astype(jnp.float64), cnt > 0, None
+    if a.func in ("min", "max"):
+        m, cnt = arrs
+        return m, cnt > 0, None
+    raise ExecError(f"aggregate {a.func} cannot stream")
+
+
+@dataclass
+class StreamingPlan:
+    """A plan compiled for paged execution over one streamed scan."""
+    page_fn: Callable      # RunContext -> flat state tuple
+    combine: Callable      # (state, state) -> state
+    final_fn: Callable     # state -> ColumnBatch
+
+
+def compile_streaming(node: P.PlanNode, params: ExecParams,
+                      meta: P.OutputMeta | None = None) -> StreamingPlan:
+    """Compile Limit?/Sort?/Aggregate(dense|ungrouped) for paging.
+
+    The child subtree (scan/filter/project/joins-with-resident-builds)
+    compiles unchanged and runs once per page; only the aggregate is
+    split into page-partials + combine + finalize.
+    """
+    limit_node = sort_node = None
+    n = node
+    if isinstance(n, P.Limit):
+        limit_node, n = n, n.child
+    if isinstance(n, P.Sort):
+        sort_node, n = n, n.child
+    if not isinstance(n, P.Aggregate):
+        raise ExecError("streaming requires an aggregate-rooted plan")
+    agg = n
+    dense = agg.max_groups > 0
+    if agg.group_by and not dense:
+        raise ExecError("hash-strategy GROUP BY cannot stream yet")
+    for a in agg.aggs:
+        if a.distinct:
+            raise ExecError("DISTINCT aggregates cannot stream")
+    childf = compile_plan(agg.child, params)
+    groupfs = [(name, compile_expr(e)) for name, e in agg.group_by]
+    aggfs = [(a, compile_expr(a.arg) if a.arg is not None else None)
+             for a in agg.aggs]
+    itemfs = [(name, compile_expr(e)) for name, e in agg.items]
+    havingf = compile_expr(agg.having) if agg.having is not None else None
+    dims = list(agg.group_dims)
+    num_groups = 1
+    for dim in dims:
+        num_groups *= dim + 1
+    ops_layout = [_agg_state_ops(a) for a, _ in aggfs]
+    flat_ops = tuple(op for ops in ops_layout for op in ops) + ("add",)
+
+    def page_fn(rc: RunContext) -> tuple:
+        b = childf(rc)
+        ctx = _ctx_of(b)
+        if not groupfs:
+            gid = None
+        else:
+            gid = jnp.zeros((b.n,), dtype=jnp.int32)
+            for (name, gf), dim in zip(groupfs, dims):
+                d, v = gf(ctx)
+                code = jnp.where(v, d.astype(jnp.int32), dim)
+                gid = gid * (dim + 1) + code
+        state = []
+        for a, argf in aggfs:
+            state.extend(_agg_page_state(a, argf, b, ctx, gid, num_groups))
+        # group liveness counter rides last
+        live_cnt = (aggops.group_count(gid, b.sel, num_groups) if groupfs
+                    else aggops.masked_count(b.sel)[None])
+        state.append(live_cnt)
+        return tuple(state)
+
+    def combine(sa: tuple, sb: tuple) -> tuple:
+        return tuple(_COMBINE_OPS[op](x, y)
+                     for op, x, y in zip(flat_ops, sa, sb))
+
+    rank_tables = (_sort_rank_tables(sort_node.keys, meta)
+                   if sort_node is not None else {})
+
+    def final_fn(state: tuple) -> ColumnBatch:
+        group_cols = {}
+        if groupfs:
+            garange = jnp.arange(num_groups, dtype=jnp.int32)
+            strides = []
+            s = 1
+            for dim in reversed(dims):
+                strides.append(s)
+                s *= dim + 1
+            strides.reverse()
+            for ((name, _), dim, st) in zip(groupfs, dims, strides):
+                code = (garange // st) % (dim + 1)
+                group_cols[name] = (code, code < dim)
+        i = 0
+        aggs_out = []
+        overflow = jnp.bool_(False)
+        for (a, _), ops in zip(aggfs, ops_layout):
+            d, v, ovf = _agg_finalize(a, state[i:i + len(ops)])
+            i += len(ops)
+            aggs_out.append((d, v))
+            if ovf is not None:
+                overflow = jnp.logical_or(overflow, ovf)
+        live_cnt = state[i]
+        live = (live_cnt > 0 if groupfs
+                else jnp.ones((1,), dtype=jnp.bool_))
+        out_ctx = ExprContext(group_cols, num_groups, aggs_out)
+        cols, valid = {}, {}
+        for name, f in itemfs:
+            d, v = f(out_ctx)
+            cols[name] = d
+            valid[name] = v
+        if havingf is not None:
+            hv, hm = havingf(out_ctx)
+            live = jnp.logical_and(live, jnp.logical_and(hv, hm))
+        out = ColumnBatch.from_dict(cols, valid, sel=live)
+        out = out.with_column("__sum_overflow",
+                              jnp.broadcast_to(overflow, (num_groups,)))
+        if sort_node is not None:
+            out = sort_batch(out, list(sort_node.keys), rank_tables)
+        if limit_node is not None:
+            out = limit_batch(out, limit_node.limit, limit_node.offset)
+        return out
+
+    return StreamingPlan(page_fn, combine, final_fn)

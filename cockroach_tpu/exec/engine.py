@@ -42,7 +42,8 @@ from ..sql.types import ColumnSchema, Family, TableSchema
 from ..storage.columnstore import MAX_TS_INT, Chunk, ColumnStore
 from ..storage.hlc import Clock, Timestamp
 from ..utils.settings import SessionVars, Settings
-from .compile import ExecParams, RunContext, compile_plan
+from .compile import (ExecParams, RunContext, compile_plan,
+                      compile_streaming)
 from .expr import ExprContext, compile_expr
 
 EPOCH_DATE = datetime.date(1970, 1, 1)
@@ -115,6 +116,9 @@ class Prepared:
     scans: dict
     meta: object
     gens: tuple  # ((table, generation), ...) captured at prepare time
+    # beyond-HBM paging: (alias, page_rows) of the streamed fact table
+    stream: Optional[tuple] = None
+    stream_cols: Optional[frozenset] = None
 
     def _refresh(self) -> "Prepared":
         cur = tuple((t, self.engine.store.table(t).generation)
@@ -129,10 +133,27 @@ class Prepared:
         if p is not self:
             self.jfn, self.scans, self.meta, self.gens = \
                 p.jfn, p.scans, p.meta, p.gens
+            self.stream, self.stream_cols = p.stream, p.stream_cols
         ts = read_ts or self.engine._read_ts(self.session)
         # np scalar: a jnp.int64() upload would cost a blocking
         # host->device round trip before the query even dispatches.
-        return self.jfn(self.scans, np.int64(ts.to_int()))
+        tsv = np.int64(ts.to_int())
+        if self.stream is None:
+            return self.jfn(self.scans, tsv)
+        # paged execution: every page's upload+compute dispatches
+        # asynchronously, so page i+1's host-side assembly overlaps
+        # page i's device work (the double-buffering of the
+        # reference's byte-limited KV paging, kv_batch_fetcher.go:191)
+        _alias, tname, page_rows = self.stream
+        fns: _StreamFns = self.jfn
+        state = None
+        scans = dict(self.scans)
+        for page in self.engine._iter_pages(tname, self.stream_cols,
+                                            page_rows):
+            scans[_alias] = page
+            s = fns.page(scans, tsv)
+            state = s if state is None else fns.combine(state, s)
+        return fns.final(state)
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
         return self.engine._materialize(self.dispatch(read_ts), self.meta)
@@ -289,6 +310,7 @@ class Engine:
         node, meta = self._plan(sel, session)
 
         scan_aliases = _collect_scans(node)
+        scan_cols = _collect_scan_columns(node)
         # read-your-own-writes: tables this txn has written get an
         # overlay snapshot (committed + buffered effects), not the
         # shared device cache; overlay scans stay single-device
@@ -297,6 +319,9 @@ class Engine:
             touched = {tb for tb, _ in session.effects}
             overlay = touched & set(scan_aliases.values())
         decision = None if overlay else self._dist_decision(node, session)
+        stream = (None if (overlay or decision is not None)
+                  else self._stream_decision(node, scan_aliases, scan_cols,
+                                             session))
         read_ts = self._read_ts(session)
 
         scans = {}
@@ -304,16 +329,28 @@ class Engine:
         shapes = []
         for alias, tname in scan_aliases.items():
             self._register_table_read(session.txn, tname, read_ts)
+            cols = scan_cols.get(alias)
+            if stream is not None and alias == stream[0]:
+                # the streamed fact table never uploads whole; its
+                # shape contribution is the (static) page size — but
+                # dictionary sizes still fingerprint the compiled plan
+                # (group codes are baked into the XLA program)
+                gens.append((tname, self.store.table(tname).generation))
+                dictlens = tuple(
+                    sorted((cn, len(d)) for cn, d in
+                           self.store.table(tname).dictionaries.items()))
+                shapes.append((tname, stream[2], dictlens))
+                continue
             if tname in overlay:
                 b = self._overlay_batch(tname, session.effects, read_ts)
                 gens.append((tname, -1))
             elif decision is not None:
                 sharded = alias in decision.sharded
                 b = self._device_table(tname, "sharded" if sharded
-                                       else "replicated")
+                                       else "replicated", cols)
                 gens.append((tname, self.store.table(tname).generation))
             else:
-                b = self._device_table(tname)
+                b = self._device_table(tname, cols=cols)
                 gens.append((tname, self.store.table(tname).generation))
             scans[alias] = b
             dictlens = tuple(
@@ -328,17 +365,28 @@ class Engine:
         # growth shows up in dictlens) — the plan-cache fingerprint idea
         # of the reference (sql/plan_opt.go), adapted to XLA's
         # shape-specialized compilation model
-        key = (sql_text, tuple(sorted(shapes)), decision is not None, cap)
+        key = (sql_text, tuple(sorted(shapes)), decision is not None,
+               stream, cap)
         cached = self._exec_cache.get(key)
         if cached is None:
             params = ExecParams(
                 hash_group_capacity=cap,
                 axis_name=SHARD_AXIS if decision is not None else None)
-            runf = compile_plan(node, params, meta)
-            if decision is not None:
+            if stream is not None:
+                splan = compile_streaming(node, params, meta)
+
+                def page_fn(scans_in, ts_in, _f=splan.page_fn):
+                    return _f(RunContext(scans_in, ts_in))
+                jfn = _StreamFns(jax.jit(page_fn),
+                                 jax.jit(splan.combine),
+                                 jax.jit(splan.final_fn))
+            elif decision is not None:
+                runf = compile_plan(node, params, meta)
                 jfn = jax.jit(make_distributed_fn(
                     runf, self.mesh, scan_aliases, decision))
             else:
+                runf = compile_plan(node, params, meta)
+
                 def fn(scans_in, ts_in):
                     return runf(RunContext(scans_in, ts_in))
                 jfn = jax.jit(fn)
@@ -346,7 +394,10 @@ class Engine:
         else:
             jfn, meta = cached
         gens = tuple(sorted(gens))
-        return Prepared(self, session, sel, sql_text, jfn, scans, meta, gens)
+        return Prepared(self, session, sel, sql_text, jfn, scans, meta,
+                        gens, stream=stream,
+                        stream_cols=(scan_cols.get(stream[0])
+                                     if stream else None))
 
     def prepare(self, sql: str, session: Session | None = None) -> "Prepared":
         """Prepare a SELECT for repeated execution (the pgwire
@@ -402,43 +453,138 @@ class Engine:
             types.append(b.type)
         return Result(names=names, rows=[tuple(row)])
 
+    # -- beyond-HBM streaming ------------------------------------------------
+    def _stream_decision(self, node, scan_aliases: dict, scan_cols: dict,
+                         session: Session):
+        """Page the fact table through HBM when its pruned upload would
+        not fit the device budget. Eligibility mirrors the mesh
+        distribution analysis (the plan must reduce to mergeable
+        aggregate partials); only the probe-spine scan streams.
+        Returns (alias, table, page_rows) or None."""
+        if session.vars.get("streaming", "auto") == "off":
+            return None
+        budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
+        if budget <= 0:
+            return None
+        d = dist_analyze(node)
+        if not d.ok or len(d.sharded) != 1:
+            return None
+        alias = next(iter(d.sharded))
+        tname = scan_aliases[alias]
+        td = self.store.table(tname)
+        if td.row_count == 0:
+            return None
+        if self._table_device_bytes(td, scan_cols.get(alias)) <= budget:
+            return None
+        # Build-side tables still upload whole: streaming the probe is
+        # strictly better than not, and an over-budget build fails
+        # upstream with a clean quota error rather than silently here.
+        page_rows = max(1024,
+                        int(session.vars.get("streaming_page_rows",
+                                             1 << 21)))
+        return (alias, tname, page_rows)
+
+    def _table_device_bytes(self, td, cols) -> int:
+        """Device bytes a pruned upload of this table would take."""
+        n = td.row_count
+        padded = max(_next_pow2(max(n, 1)), 1024)
+        total = 16 * padded  # the two MVCC int64 columns
+        for col in td.schema.columns:
+            if cols is not None and col.name not in cols:
+                continue
+            total += (np.dtype(col.type.np_dtype).itemsize + 1) * padded
+        return total
+
+    def _iter_pages(self, tname: str, cols, page_rows: int):
+        """Yield fixed-shape device pages of a table's chunks. Each
+        page is padded to page_rows with never-visible rows so one XLA
+        program serves every page."""
+        td = self.store.table(tname)
+        if td.open_ts:
+            self.store.seal(tname)
+        chunks = list(td.chunks)
+        total = sum(c.n for c in chunks)
+        names = [c.name for c in td.schema.columns
+                 if cols is None or c.name in cols]
+        start = 0
+        while start < total:
+            end = min(start + page_rows, total)
+            data = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.data[cn],
+                                      start, end)
+                    for cn in names}
+            valid = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.valid[cn],
+                                       start, end)
+                     for cn in names}
+            mts = _slice_chunks(chunks, lambda c: c.mvcc_ts, start, end)
+            mdl = _slice_chunks(chunks, lambda c: c.mvcc_del, start, end)
+            page = {cn: _pad(a, page_rows) for cn, a in data.items()}
+            page["_mvcc_ts"] = _pad(mts, page_rows, fill=np.int64(2**62))
+            page["_mvcc_del"] = _pad(mdl, page_rows, fill=np.int64(0))
+            vmap = {cn: _pad(v, page_rows) for cn, v in valid.items()
+                    if not v.all()}
+            yield ColumnBatch.from_dict(
+                {k: jnp.asarray(v) for k, v in page.items()},
+                {k: jnp.asarray(v) for k, v in vmap.items()})
+            start = end
+
     # -- device table cache --------------------------------------------------
-    def _device_table(self, name: str, placement: str = "single") -> ColumnBatch:
+    def _device_table(self, name: str, placement: str = "single",
+                      cols: frozenset | None = None) -> ColumnBatch:
         td = self.store.table(name)
-        key = (name, td.generation, placement)
-        hit = self._device_tables.get(key)
-        if hit is not None:
-            return hit
+        # a cached upload with a SUPERSET of the needed columns serves
+        # this scan directly (scans read columns by name); this keeps
+        # one resident copy per table instead of one per column set
+        for k, v in self._device_tables.items():
+            if (k[0] == name and k[1] == td.generation
+                    and k[2] == placement
+                    and (k[3] is None
+                         or (cols is not None and cols <= k[3]))):
+                return v
         # evict stale generations of this table
         for k in [k for k in self._device_tables if k[0] == name
                   and k[1] != td.generation]:
             del self._device_tables[k]
         if td.open_ts:
             self.store.seal(name)
-        b = self._batch_from_chunks(td, td.chunks)
+        b = self._batch_from_chunks(td, td.chunks, cols)
         if placement == "sharded":
             b = jax.device_put(b, meshmod.row_sharding(self.mesh))
         elif placement == "replicated":
             b = jax.device_put(b, meshmod.replicated(self.mesh))
-        self._device_tables[key] = b
+        # drop now-redundant strict-subset uploads of the same table
+        for k in [k for k in self._device_tables
+                  if k[0] == name and k[1] == td.generation
+                  and k[2] == placement and k[3] is not None
+                  and (cols is None or k[3] < cols)]:
+            del self._device_tables[k]
+        self._device_tables[(name, td.generation, placement, cols)] = b
         return b
 
-    def _batch_from_chunks(self, td, chunks: list) -> ColumnBatch:
+    def _batch_from_chunks(self, td, chunks: list,
+                           prune: frozenset | None = None) -> ColumnBatch:
         """Concatenate chunks, pad to a power-of-two row bucket, and
-        upload as a device-resident ColumnBatch with MVCC columns."""
+        upload as a device-resident ColumnBatch with MVCC columns.
+        With ``prune`` set, only those stored columns upload (the scan
+        projection; HBM is the scarce resource the reference's
+        needed-columns fetch logic protects, cfetcher.go:668)."""
         cols: dict[str, np.ndarray] = {}
         valid: dict[str, np.ndarray] = {}
         n = sum(c.n for c in chunks)
         padded = max(_next_pow2(max(n, 1)), 1024)
         for col in td.schema.columns:
             cn = col.name
+            if prune is not None and cn not in prune:
+                continue
             parts = [c.data[cn] for c in chunks]
             arr = (np.concatenate(parts) if parts
                    else np.zeros(0, dtype=col.type.np_dtype))
             vparts = [c.valid[cn] for c in chunks]
             va = np.concatenate(vparts) if vparts else np.zeros(0, bool)
             cols[cn] = _pad(arr, padded)
-            valid[cn] = _pad(va, padded)
+            if not va.all():
+                # all-valid masks regenerate on device (ones) for free
+                # instead of paying PCIe for a constant
+                valid[cn] = _pad(va, padded)
         ts_parts = [c.mvcc_ts for c in chunks]
         del_parts = [c.mvcc_del for c in chunks]
         mts = np.concatenate(ts_parts) if ts_parts else np.zeros(0, np.int64)
@@ -447,8 +593,6 @@ class Engine:
         # padding rows are never visible: created at +inf
         cols["_mvcc_ts"] = _pad(mts, padded, fill=np.int64(2**62))
         cols["_mvcc_del"] = _pad(mdl, padded, fill=np.int64(0))
-        valid["_mvcc_ts"] = np.ones(padded, bool)
-        valid["_mvcc_del"] = np.ones(padded, bool)
         return ColumnBatch.from_dict(
             {k: jnp.asarray(v) for k, v in cols.items()},
             {k: jnp.asarray(v) for k, v in valid.items()})
@@ -914,6 +1058,45 @@ class Engine:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+@dataclass
+class _StreamFns:
+    """The three jitted pieces of a paged plan (compile_streaming)."""
+    page: object
+    combine: object
+    final: object
+
+
+def _collect_scan_columns(node: P.PlanNode) -> dict[str, frozenset]:
+    """alias -> stored columns the plan's scans actually read (the
+    pruned upload set; cf. the reference's neededColumns in
+    colfetcher/cfetcher.go)."""
+    out: dict[str, set] = {}
+    if isinstance(node, P.Scan):
+        out.setdefault(node.alias, set()).update(node.columns.values())
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            for a, s in _collect_scan_columns(c).items():
+                out.setdefault(a, set()).update(s)
+    return {a: frozenset(s) for a, s in out.items()}
+
+
+def _slice_chunks(chunks: list, getter, start: int, end: int) -> np.ndarray:
+    """Materialize rows [start, end) of a chunked column as one array."""
+    parts = []
+    off = 0
+    for c in chunks:
+        lo, hi = max(start - off, 0), min(end - off, c.n)
+        if lo < hi:
+            parts.append(getter(c)[lo:hi])
+        off += c.n
+        if off >= end:
+            break
+    if len(parts) == 1:
+        return parts[0]
+    return np.concatenate(parts) if parts else np.zeros(0)
+
 
 def _collect_scans(node: P.PlanNode) -> dict[str, str]:
     out = {}

@@ -117,3 +117,71 @@ def plan_tree_repr(node: PlanNode, indent: int = 0) -> str:
         return (f"{pad}Limit {node.limit} offset {node.offset}\n"
                 + plan_tree_repr(node.child, indent + 1))
     return f"{pad}{node!r}\n"
+
+
+def prune_scan_columns(root: PlanNode) -> PlanNode:
+    """Projection pruning: shrink every Scan's column map to the batch
+    columns the rest of the plan actually references. The engine
+    uploads only these to HBM (the reference fetches only needed
+    columns per index, colfetcher/cfetcher.go:668; here the win is
+    device memory and PCIe, not just decode time).
+
+    Conservative by name: a scan column survives if its batch name
+    ("alias.col") appears in ANY expression/key list anywhere in the
+    tree, so renames above Projects can never starve a real use.
+    """
+    from .bound import referenced_columns
+
+    needed: set[str] = set()
+
+    def collect(n: PlanNode):
+        if isinstance(n, Scan):
+            if n.filter is not None:
+                needed.update(referenced_columns(n.filter))
+            for _, e in n.computed:
+                needed.update(referenced_columns(e))
+        elif isinstance(n, Filter):
+            needed.update(referenced_columns(n.pred))
+        elif isinstance(n, HashJoin):
+            needed.update(n.left_keys)
+            needed.update(n.right_keys)
+            needed.update(n.payload)
+        elif isinstance(n, Project):
+            for _, e in n.items:
+                needed.update(referenced_columns(e))
+        elif isinstance(n, Aggregate):
+            for _, e in n.group_by:
+                needed.update(referenced_columns(e))
+            for a in n.aggs:
+                if a.arg is not None:
+                    needed.update(referenced_columns(a.arg))
+            if n.having is not None:
+                needed.update(referenced_columns(n.having))
+            for _, e in n.items:
+                needed.update(referenced_columns(e))
+        elif isinstance(n, Sort):
+            needed.update(name for name, _ in n.keys)
+        for attr in ("child", "left", "right"):
+            c = getattr(n, attr, None)
+            if c is not None:
+                collect(c)
+
+    collect(root)
+
+    def prune(n: PlanNode):
+        if isinstance(n, Scan):
+            kept = {bn: sn for bn, sn in n.columns.items()
+                    if bn in needed}
+            if not kept and n.columns:
+                # count(*)-style plans touch no columns, but a batch
+                # needs one to carry its shape
+                bn = next(iter(n.columns))
+                kept = {bn: n.columns[bn]}
+            n.columns = kept
+        for attr in ("child", "left", "right"):
+            c = getattr(n, attr, None)
+            if c is not None:
+                prune(c)
+
+    prune(root)
+    return root

@@ -335,6 +335,7 @@ class Planner:
                                                scope, node)
                 if d is not None:
                     meta.dictionaries[name] = d
+        plan.prune_scan_columns(node)
         return node, meta
 
     def _static_group_bound(self, group_exprs, scope: Scope):

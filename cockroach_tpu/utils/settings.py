@@ -103,6 +103,10 @@ def _register_builtins(s: Settings):
     s.register("kv.gc.ttl_seconds", 14400, int, "MVCC GC TTL")
     s.register("sql.exec.hash_group_capacity", 1 << 17, int,
                "device hash-table slots for GROUP BY", _pow2)
+    s.register("sql.exec.hbm_budget_bytes", 12 << 30, int,
+               "device-memory budget for resident table uploads; "
+               "aggregate scans over bigger tables stream in pages "
+               "(the HBM analogue of --max-sql-memory / workmem)")
 
 
 @dataclass
@@ -111,6 +115,8 @@ class SessionVars:
     values: dict = field(default_factory=lambda: {
         "vectorize": "on",           # on | off  (off = host row engine)
         "distsql": "auto",           # auto | on | off | always
+        "streaming": "auto",         # auto | off (beyond-HBM paging)
+        "streaming_page_rows": 1 << 21,
         "direct_columnar_scans_enabled": True,
         "hash_group_capacity": 1 << 17,
         "application_name": "",
