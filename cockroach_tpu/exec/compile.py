@@ -51,11 +51,21 @@ class ExecParams:
 
 
 class RunContext:
-    """Per-execution inputs to the compiled program."""
+    """Per-execution inputs to the compiled program.
 
-    def __init__(self, scans: dict[str, ColumnBatch], read_ts):
+    nparts/pid (dynamic scalars) drive the hash-partitioned spill
+    recursion: a hash-strategy GROUP BY keeps only rows with
+    salted_hash(keys) & (nparts-1) == pid, so the engine can rerun ONE
+    compiled program per partition when the group table overflows (the
+    reference's hash_based_partitioner, re-reading from HBM instead of
+    disk). nparts=1/pid=0 (or None) means unpartitioned."""
+
+    def __init__(self, scans: dict[str, ColumnBatch], read_ts,
+                 nparts=None, pid=None):
         self.scans = scans
         self.read_ts = read_ts
+        self.nparts = nparts
+        self.pid = pid
 
 
 CompiledNode = Callable[[RunContext], ColumnBatch]
@@ -152,6 +162,28 @@ def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
 # ---------------------------------------------------------------------------
 # aggregation
 # ---------------------------------------------------------------------------
+
+def _agg_output(group_cols, aggs_out, live, itemfs, havingf,
+                num_groups: int, sum_ovf, ht_ovf=None) -> ColumnBatch:
+    """Shared tail of every aggregation strategy: evaluate the output
+    items over (group cols, agg results), apply HAVING, and attach the
+    error-sentinel columns the engine checks at materialize time."""
+    out_ctx = ExprContext(group_cols, num_groups, aggs_out)
+    cols, valid = {}, {}
+    for name, f in itemfs:
+        d, v = f(out_ctx)
+        cols[name] = d
+        valid[name] = v
+    if havingf is not None:
+        hv, hm = havingf(out_ctx)
+        live = jnp.logical_and(live, jnp.logical_and(hv, hm))
+    out = ColumnBatch.from_dict(cols, valid, sel=live)
+    out = out.with_column("__sum_overflow",
+                          jnp.broadcast_to(sum_ovf, (num_groups,)))
+    if ht_ovf is not None:
+        out = out.with_column("__ht_overflow",
+                              jnp.broadcast_to(ht_ovf, (num_groups,)))
+    return out
 
 def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
                   axis_name=None):
@@ -258,10 +290,11 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
     dims = list(node.group_dims)
     axis = params.axis_name
     if axis and node.group_by and not dense:
-        # hash-strategy group ids are shard-local; the cross-shard merge
-        # (all_gather + re-group) is future work — engine falls back to
-        # single-device for these plans (exec/engine.py eligibility)
-        raise ExecError("hash-strategy GROUP BY cannot run distributed yet")
+        # hash-strategy group ids are shard-local; merge via
+        # all_gather of per-slot partial state + re-group (the ICI
+        # form of the HashRouter shuffle, colflow/routers.go:425)
+        return _compile_hash_dist_aggregate(node, params, childf, groupfs,
+                                            aggfs, itemfs, havingf)
 
     def run_agg(rc: RunContext) -> ColumnBatch:
         b = childf(rc)
@@ -298,15 +331,15 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
             keycols = []
             for name, gf in groupfs:
                 d, v = gf(ctx)
-                kd = d
-                if kd.dtype == jnp.bool_:
-                    kd = kd.astype(jnp.int32)
-                elif jnp.issubdtype(kd.dtype, jnp.floating):
-                    kd = jax.lax.bitcast_convert_type(
-                        kd.astype(jnp.float64), jnp.int64)
+                kd, kv = _key_encode(d, v)
                 # NULLs group together: zero data + validity as extra key
-                keycols.append(jnp.where(v, kd, jnp.zeros_like(kd)))
-                keycols.append(v.astype(jnp.int32))
+                keycols.append(kd)
+                keycols.append(kv)
+            if rc.nparts is not None:
+                # hash-partitioned spill recursion: keep only this
+                # partition's rows (no-op when nparts == 1)
+                b = b.and_sel(hashtable.partition_mask(
+                    tuple(keycols), rc.nparts, rc.pid))
             cap = params.hash_group_capacity
             gid, ng, rep = hashtable.group_ids(tuple(keycols), b.sel, cap)
             num_groups = cap  # static bound; ng is the dynamic count
@@ -334,23 +367,10 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
             garange = jnp.arange(num_groups, dtype=jnp.int32)
             live = garange < ng
 
-        out_ctx = ExprContext(group_cols, num_groups, aggs_out)
-        cols, valid = {}, {}
-        for name, f in itemfs:
-            d, v = f(out_ctx)
-            cols[name] = d
-            valid[name] = v
-        if havingf is not None:
-            hv, hm = havingf(out_ctx)
-            live = jnp.logical_and(live, jnp.logical_and(hv, hm))
-        out = ColumnBatch.from_dict(cols, valid, sel=live)
-        # error sentinels ride along as columns for the engine to check
-        out = out.with_column("__sum_overflow",
-                              jnp.broadcast_to(overflow, (num_groups,)))
-        if not groupfs or dense:
-            return out
-        return out.with_column("__ht_overflow",
-                               jnp.broadcast_to(ng < 0, (num_groups,)))
+        return _agg_output(group_cols, aggs_out, live, itemfs, havingf,
+                           num_groups, overflow,
+                           ht_ovf=(None if (not groupfs or dense)
+                                   else ng < 0))
     return run_agg
 
 
@@ -532,6 +552,22 @@ class StreamingPlan:
     final_fn: Callable     # state -> ColumnBatch
 
 
+def can_stream(node: P.PlanNode) -> bool:
+    """Mirror of compile_streaming's eligibility — the engine's
+    streaming decision must never pick a plan this module will refuse
+    to compile (hash-strategy GROUP BY and DISTINCT can't page yet)."""
+    n = node
+    if isinstance(n, P.Limit):
+        n = n.child
+    if isinstance(n, P.Sort):
+        n = n.child
+    if not isinstance(n, P.Aggregate):
+        return False
+    if n.group_by and n.max_groups <= 0:
+        return False
+    return not any(a.distinct for a in n.aggs)
+
+
 def compile_streaming(node: P.PlanNode, params: ExecParams,
                       meta: P.OutputMeta | None = None) -> StreamingPlan:
     """Compile Limit?/Sort?/Aggregate(dense|ungrouped) for paging.
@@ -620,18 +656,8 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
         live_cnt = state[i]
         live = (live_cnt > 0 if groupfs
                 else jnp.ones((1,), dtype=jnp.bool_))
-        out_ctx = ExprContext(group_cols, num_groups, aggs_out)
-        cols, valid = {}, {}
-        for name, f in itemfs:
-            d, v = f(out_ctx)
-            cols[name] = d
-            valid[name] = v
-        if havingf is not None:
-            hv, hm = havingf(out_ctx)
-            live = jnp.logical_and(live, jnp.logical_and(hv, hm))
-        out = ColumnBatch.from_dict(cols, valid, sel=live)
-        out = out.with_column("__sum_overflow",
-                              jnp.broadcast_to(overflow, (num_groups,)))
+        out = _agg_output(group_cols, aggs_out, live, itemfs, havingf,
+                          num_groups, overflow)
         if sort_node is not None:
             out = sort_batch(out, list(sort_node.keys), rank_tables)
         if limit_node is not None:
@@ -639,3 +665,101 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
         return out
 
     return StreamingPlan(page_fn, combine, final_fn)
+
+
+# ---------------------------------------------------------------------------
+# distributed hash-strategy GROUP BY
+# ---------------------------------------------------------------------------
+
+def _key_encode(d, v):
+    """Encode one group-key column as (masked int payload, validity) —
+    the two int columns the device hash table keys on."""
+    kd = d
+    if kd.dtype == jnp.bool_:
+        kd = kd.astype(jnp.int32)
+    elif jnp.issubdtype(kd.dtype, jnp.floating):
+        kd = jax.lax.bitcast_convert_type(kd.astype(jnp.float64), jnp.int64)
+    return jnp.where(v, kd, jnp.zeros_like(kd)), v.astype(jnp.int32)
+
+
+def _compile_hash_dist_aggregate(node: P.Aggregate, params: ExecParams,
+                                 childf, groupfs, aggfs, itemfs,
+                                 havingf) -> CompiledNode:
+    """SPMD hash GROUP BY over the mesh.
+
+    Per shard: local hash grouping into <= capacity dense slots, with
+    page-state partials per slot (the same local-stage algebra the
+    streaming path uses). Then one ``all_gather`` ships every shard's
+    (group keys, partial state) slots over ICI, each shard re-groups
+    the S*capacity gathered slots with the same device hash table, and
+    segment-merges the partials (add/min/max per op). Replaces the
+    reference's HashRouter gRPC shuffle + final-stage aggregation
+    (colflow/routers.go:425, physicalplan/aggregator_funcs.go) with
+    two collectives' worth of ICI traffic; outputs are replicated.
+    """
+    axis = params.axis_name
+    cap = params.hash_group_capacity
+    ops_layout = [_agg_state_ops(a) for a, _ in aggfs]
+    flat_ops = [op for ops in ops_layout for op in ops]
+
+    def run(rc: RunContext) -> ColumnBatch:
+        b = childf(rc)
+        ctx = _ctx_of(b)
+        keycols = []
+        gdata = []  # (name, data, valid) of each group-key expression
+        for name, gf in groupfs:
+            d, v = gf(ctx)
+            kd, kv = _key_encode(d, v)
+            keycols.append(kd)
+            keycols.append(kv)
+            gdata.append((name, d, v))
+        if rc.nparts is not None:
+            b = b.and_sel(hashtable.partition_mask(
+                tuple(keycols), rc.nparts, rc.pid))
+        gid, ng, rep = hashtable.group_ids(tuple(keycols), b.sel, cap)
+        slot_live = jnp.arange(cap, dtype=jnp.int32) < ng
+
+        flat_state = []
+        for a, argf in aggfs:
+            flat_state.extend(_agg_page_state(a, argf, b, ctx, gid, cap))
+
+        def gather(x):
+            return jax.lax.all_gather(x, axis, tiled=True)
+
+        g_keys = tuple(gather(kc[rep]) for kc in keycols)
+        g_live = gather(slot_live)
+        g_state = [gather(s) for s in flat_state]
+        g_cols = [(name, gather(d[rep]), gather(v[rep]))
+                  for name, d, v in gdata]
+
+        # re-group the gathered slots; identical inputs on every shard
+        # make this deterministic-replicated
+        gid2, ng2, rep2 = hashtable.group_ids(g_keys, g_live, cap)
+        merged = []
+        for gs, op in zip(g_state, flat_ops):
+            if op == "add":
+                merged.append(aggops.group_sum(gs, gid2, g_live, cap,
+                                               acc_dtype=gs.dtype))
+            elif op == "min":
+                merged.append(aggops.group_min(gs, gid2, g_live, cap))
+            else:
+                merged.append(aggops.group_max(gs, gid2, g_live, cap))
+
+        aggs_out = []
+        sum_ovf = jnp.bool_(False)
+        i = 0
+        for (a, _), ops in zip(aggfs, ops_layout):
+            d, v, ovf = _agg_finalize(a, tuple(merged[i:i + len(ops)]))
+            i += len(ops)
+            aggs_out.append((d, v))
+            if ovf is not None:
+                sum_ovf = jnp.logical_or(sum_ovf, ovf)
+
+        group_cols = {name: (gd[rep2], gv[rep2]) for name, gd, gv in g_cols}
+        live = jnp.arange(cap, dtype=jnp.int32) < jnp.maximum(ng2, 0)
+        # overflow if any shard's local table or the merged table spilled
+        any_local = jax.lax.psum((ng < 0).astype(jnp.int32), axis) > 0
+        return _agg_output(group_cols, aggs_out, live, itemfs, havingf,
+                           cap, sum_ovf,
+                           ht_ovf=jnp.logical_or(any_local, ng2 < 0))
+    return run

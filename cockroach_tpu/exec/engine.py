@@ -41,8 +41,10 @@ from ..sql.rowenc import ROWID
 from ..sql.types import ColumnSchema, Family, TableSchema
 from ..storage.columnstore import MAX_TS_INT, Chunk, ColumnStore
 from ..storage.hlc import Clock, Timestamp
+from ..utils.metric import MetricRegistry
+from ..utils.mon import BytesMonitor, MemoryQuotaError
 from ..utils.settings import SessionVars, Settings
-from .compile import (ExecParams, RunContext, compile_plan,
+from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
 from .expr import ExprContext, compile_expr
 
@@ -52,6 +54,12 @@ EPOCH_DT = datetime.datetime(1970, 1, 1)
 
 class EngineError(Exception):
     pass
+
+
+class HashCapacityExceeded(EngineError):
+    """GROUP BY distinct-key count exceeded the device hash table.
+    Prepared.run catches this and falls back to hash-partitioned
+    re-execution (the spill path)."""
 
 
 @dataclass
@@ -128,7 +136,8 @@ class Prepared:
         return self.engine._prepare_select(self.stmt, self.session,
                                            self.sql_text)
 
-    def dispatch(self, read_ts: Optional[Timestamp] = None) -> ColumnBatch:
+    def dispatch(self, read_ts: Optional[Timestamp] = None,
+                 nparts: int = 1, pid: int = 0) -> ColumnBatch:
         p = self._refresh()
         if p is not self:
             self.jfn, self.scans, self.meta, self.gens = \
@@ -139,7 +148,8 @@ class Prepared:
         # host->device round trip before the query even dispatches.
         tsv = np.int64(ts.to_int())
         if self.stream is None:
-            return self.jfn(self.scans, tsv)
+            return self.jfn(self.scans, tsv, np.int32(nparts),
+                            np.int32(pid))
         # paged execution: every page's upload+compute dispatches
         # asynchronously, so page i+1's host-side assembly overlaps
         # page i's device work (the double-buffering of the
@@ -156,7 +166,13 @@ class Prepared:
         return fns.final(state)
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
-        return self.engine._materialize(self.dispatch(read_ts), self.meta)
+        try:
+            return self.engine._materialize(self.dispatch(read_ts),
+                                            self.meta)
+        except HashCapacityExceeded:
+            # partition-and-recurse (the reference's disk spiller,
+            # colexecdisk/disk_spiller.go:75, over HBM re-reads)
+            return self.engine._run_partitioned(self, read_ts)
 
 
 class Engine:
@@ -182,6 +198,17 @@ class Engine:
         # mutation (the reference runs a connExecutor per conn against
         # thread-safe subsystems; finer-grained locking is later work)
         self._stmt_lock = threading.RLock()
+        self.metrics = MetricRegistry()
+        # device-memory accounting: resident table uploads reserve
+        # against the HBM budget BEFORE device_put, so an over-budget
+        # upload fails with a quota error naming the knob instead of
+        # an XLA OOM (pkg/util/mon/bytes_usage.go:173 analogue)
+        self.hbm = BytesMonitor(
+            "hbm", lambda: int(self.settings.get(
+                "sql.exec.hbm_budget_bytes")),
+            on_change=lambda used: self.metrics.gauge(
+                "sql.mem.device.current",
+                "bytes of HBM reserved by resident tables").set(used))
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:
@@ -206,13 +233,25 @@ class Engine:
             raise EngineError(
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block")
+        import time as _time
+        t0 = _time.monotonic()
         try:
             with self._stmt_lock:
-                return self._dispatch_stmt(stmt, session, sql_text)
+                res = self._dispatch_stmt(stmt, session, sql_text)
+            self.metrics.counter(
+                f"sql.{type(stmt).__name__.lower()}.count",
+                "statements executed, by type").inc()
+            self.metrics.histogram(
+                "sql.exec.latency",
+                "statement execution latency (s)").observe(
+                    _time.monotonic() - t0)
+            return res
         except Exception:
             # any error inside an explicit txn block aborts it until
             # ROLLBACK (postgres semantics; the connExecutor state
             # machine's stateAborted) — not just DML failures
+            self.metrics.counter("sql.failure.count",
+                                 "statements that errored").inc()
             if session.txn is not None and not isinstance(
                     stmt, ast.BeginTxn):
                 session.txn_aborted = True
@@ -387,8 +426,8 @@ class Engine:
             else:
                 runf = compile_plan(node, params, meta)
 
-                def fn(scans_in, ts_in):
-                    return runf(RunContext(scans_in, ts_in))
+                def fn(scans_in, ts_in, nparts, pid):
+                    return runf(RunContext(scans_in, ts_in, nparts, pid))
                 jfn = jax.jit(fn)
             self._exec_cache[key] = (jfn, meta)
         else:
@@ -453,6 +492,93 @@ class Engine:
             types.append(b.type)
         return Result(names=names, rows=[tuple(row)])
 
+    # -- hash-partitioned spill ---------------------------------------------
+    MAX_SPILL_PARTITIONS = 256
+
+    def _run_partitioned(self, prep: "Prepared",
+                         read_ts: Optional[Timestamp]) -> Result:
+        """Partition-and-recurse fallback for hash GROUP BY overflow.
+
+        The compiled program already takes (nparts, pid) scalars and
+        keeps only rows whose salted key-hash lands in partition pid
+        (ops/hashtable.py partition_mask), so spilling is: rerun the
+        SAME program once per partition, concatenate the per-partition
+        group rows on the host, then apply any Sort/Limit there
+        (device sort/limit would have been per-partition). Doubling
+        the partition count until every partition fits mirrors the
+        reference's recursive hash_based_partitioner; re-reads hit the
+        resident HBM table instead of disk.
+        """
+        node, meta = self._plan(prep.stmt, prep.session)
+        limit_node = sort_node = None
+        if isinstance(node, P.Limit):
+            limit_node, node = node, node.child
+        if isinstance(node, P.Sort):
+            sort_node, node = node, node.child
+        if not isinstance(node, P.Aggregate) or node.max_groups > 0:
+            raise HashCapacityExceeded(
+                "GROUP BY overflow in a non-spillable plan shape; "
+                "SET hash_group_capacity to a larger power of two")
+
+        # compile the STRIPPED plan (no device Sort/Limit — a per-
+        # partition limit would truncate wrongly); reuse prep's device
+        # scans, which already match the distribution decision
+        cap = int(prep.session.vars.get("hash_group_capacity", 1 << 17))
+        decision = self._dist_decision(node, prep.session)
+        shapes = tuple(sorted((a, b.n) for a, b in prep.scans.items()))
+        dictlens = tuple(
+            sorted((t, tuple(sorted((cn, len(d)) for cn, d in
+                                    self.store.table(t).dictionaries
+                                    .items())))
+                   for t, _ in prep.gens))
+        key = ("spill", prep.sql_text, shapes, dictlens, cap,
+               decision is not None)
+        cached = self._exec_cache.get(key)
+        if cached is None:
+            params = ExecParams(
+                hash_group_capacity=cap,
+                axis_name=SHARD_AXIS if decision is not None else None)
+            runf = compile_plan(node, params, meta)
+            if decision is not None:
+                jfn = jax.jit(make_distributed_fn(
+                    runf, self.mesh, _collect_scans(node), decision))
+            else:
+                def fn(scans_in, ts_in, np_, pid_):
+                    return runf(RunContext(scans_in, ts_in, np_, pid_))
+                jfn = jax.jit(fn)
+            self._exec_cache[key] = (jfn, meta)
+        else:
+            jfn, meta = cached
+
+        ts = read_ts or self._read_ts(prep.session)
+        tsv = np.int64(ts.to_int())
+        nparts = 2
+        while nparts <= self.MAX_SPILL_PARTITIONS:
+            try:
+                all_rows: list[tuple] = []
+                for pid in range(nparts):
+                    out = jfn(prep.scans, tsv, np.int32(nparts),
+                              np.int32(pid))
+                    part = self._materialize(out, meta)
+                    all_rows.extend(part.rows)
+                break
+            except HashCapacityExceeded:
+                nparts *= 2
+        else:
+            raise HashCapacityExceeded(
+                f"GROUP BY did not fit hash_group_capacity even at "
+                f"{self.MAX_SPILL_PARTITIONS} spill partitions")
+
+        rows = all_rows
+        if sort_node is not None:
+            rows = _host_sort(rows, meta, sort_node.keys)
+        if limit_node is not None:
+            off = limit_node.offset or 0
+            end = (off + limit_node.limit
+                   if limit_node.limit is not None else None)
+            rows = rows[off:end]
+        return Result(names=list(meta.names), rows=rows)
+
     # -- beyond-HBM streaming ------------------------------------------------
     def _stream_decision(self, node, scan_aliases: dict, scan_cols: dict,
                          session: Session):
@@ -466,6 +592,10 @@ class Engine:
         budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
         if budget <= 0:
             return None
+        if not can_stream(node):
+            # dist_analyze accepts more shapes (e.g. hash GROUP BY)
+            # than paging can compile; never pick those
+            return None
         d = dist_analyze(node)
         if not d.ok or len(d.sharded) != 1:
             return None
@@ -474,7 +604,16 @@ class Engine:
         td = self.store.table(tname)
         if td.row_count == 0:
             return None
-        if self._table_device_bytes(td, scan_cols.get(alias)) <= budget:
+        # working set = pruned upload + aggregation temporaries. XLA's
+        # segment reductions materialize ~2 n-length temps per
+        # aggregate concurrently (measured: TPC-H Q1 at 2^27 rows
+        # compiles to ~12GB of HLO temps), so a table that "fits" can
+        # still OOM at compile time without this term.
+        n_aggs = _count_aggs(node)
+        padded = max(_next_pow2(max(td.row_count, 1)), 1024)
+        temp_bytes = 16 * n_aggs * padded
+        if (self._table_device_bytes(td, scan_cols.get(alias))
+                + temp_bytes <= budget):
             return None
         # Build-side tables still upload whole: streaming the probe is
         # strictly better than not, and an over-budget build fails
@@ -528,6 +667,17 @@ class Engine:
             start = end
 
     # -- device table cache --------------------------------------------------
+    def _evict_device(self, key) -> None:
+        self._device_tables.pop(key, None)
+        self.hbm.release(key)
+
+    def drop_device_cache(self) -> None:
+        """Evict every resident table upload AND release its memory
+        reservation (a raw _device_tables.clear() would leak the
+        monitor's accounting)."""
+        for k in list(self._device_tables):
+            self._evict_device(k)
+
     def _device_table(self, name: str, placement: str = "single",
                       cols: frozenset | None = None) -> ColumnBatch:
         td = self.store.table(name)
@@ -543,21 +693,33 @@ class Engine:
         # evict stale generations of this table
         for k in [k for k in self._device_tables if k[0] == name
                   and k[1] != td.generation]:
-            del self._device_tables[k]
+            self._evict_device(k)
         if td.open_ts:
             self.store.seal(name)
-        b = self._batch_from_chunks(td, td.chunks, cols)
-        if placement == "sharded":
-            b = jax.device_put(b, meshmod.row_sharding(self.mesh))
-        elif placement == "replicated":
-            b = jax.device_put(b, meshmod.replicated(self.mesh))
+        key = (name, td.generation, placement, cols)
+        # account BEFORE upload; replication costs a copy per device
+        nbytes = self._table_device_bytes(td, cols)
+        if placement == "replicated" and self.mesh is not None:
+            nbytes *= self.mesh.size
+        self.hbm.reserve(key, nbytes)
+        try:
+            b = self._batch_from_chunks(td, td.chunks, cols)
+            if placement == "sharded":
+                b = jax.device_put(b, meshmod.row_sharding(self.mesh))
+            elif placement == "replicated":
+                b = jax.device_put(b, meshmod.replicated(self.mesh))
+        except BaseException:
+            self.hbm.release(key)
+            raise
         # drop now-redundant strict-subset uploads of the same table
         for k in [k for k in self._device_tables
                   if k[0] == name and k[1] == td.generation
                   and k[2] == placement and k[3] is not None
                   and (cols is None or k[3] < cols)]:
-            del self._device_tables[k]
-        self._device_tables[(name, td.generation, placement, cols)] = b
+            self._evict_device(k)
+        self._device_tables[key] = b
+        self.metrics.counter("sql.device.table_uploads",
+                             "resident table uploads to HBM").inc()
         return b
 
     def _batch_from_chunks(self, td, chunks: list,
@@ -609,7 +771,7 @@ class Engine:
     def _materialize(self, out: ColumnBatch, meta: P.OutputMeta) -> Result:
         if out.has("__ht_overflow"):
             if bool(np.asarray(out.col("__ht_overflow"))[0]):
-                raise EngineError(
+                raise HashCapacityExceeded(
                     "GROUP BY cardinality exceeded hash_group_capacity; "
                     "SET hash_group_capacity to a larger power of two")
         if out.has("__sum_overflow"):
@@ -649,7 +811,7 @@ class Engine:
             raise EngineError(f"table {d.name!r} does not exist")
         self.store.drop_table(d.name)
         for k in [k for k in self._device_tables if k[0] == d.name]:
-            del self._device_tables[k]
+            self._evict_device(k)
         return Result(tag="DROP TABLE")
 
     # -- DML (through the transactional KV plane) ----------------------------
@@ -1052,7 +1214,7 @@ class Engine:
 
     def _evict(self, name: str):
         for k in [k for k in self._device_tables if k[0] == name]:
-            del self._device_tables[k]
+            self._evict_device(k)
 
 
 # ---------------------------------------------------------------------------
@@ -1065,6 +1227,37 @@ class _StreamFns:
     page: object
     combine: object
     final: object
+
+
+def _host_sort(rows: list, meta: P.OutputMeta, keys) -> list:
+    """Host-side ORDER BY over decoded result rows (spill path only).
+    Matches device semantics: ascending puts NULLs last, descending
+    puts NULLs first; strings compare lexicographically."""
+    out = list(rows)
+    for name, desc in reversed(list(keys)):
+        try:
+            i = meta.names.index(name)
+        except ValueError:
+            raise EngineError(
+                f"cannot host-sort spilled result by {name!r}") from None
+        out = sorted(out,
+                     key=lambda r, i=i: (r[i] is None,
+                                         0 if r[i] is None else r[i]),
+                     reverse=desc)
+    return out
+
+
+def _count_aggs(node: P.PlanNode) -> int:
+    """Aggregate-function count of the plan's root aggregate (for the
+    streaming working-set estimate)."""
+    n = node
+    if isinstance(n, P.Limit):
+        n = n.child
+    if isinstance(n, P.Sort):
+        n = n.child
+    if isinstance(n, P.Aggregate):
+        return max(len(n.aggs), 1)
+    return 1
 
 
 def _collect_scan_columns(node: P.PlanNode) -> dict[str, frozenset]:

@@ -48,6 +48,18 @@ def _hash_columns(key_cols: tuple, capacity: int) -> jnp.ndarray:
     return (h & jnp.uint32(capacity - 1)).astype(jnp.int32)
 
 
+def partition_mask(key_cols: tuple, nparts, pid) -> jnp.ndarray:
+    """Row mask for hash-partitioned spill recursion: True where
+    salted_hash(keys) & (nparts-1) == pid. The salt column decorrelates
+    the partition hash from the group-table hash so one partition's
+    groups spread over all table slots (cf. the reference's
+    hash_based_partitioner using a different hash per recursion level).
+    nparts must be a power of two; nparts==1 keeps every row."""
+    salt = jnp.full(key_cols[0].shape, 0x85EBCA6B, dtype=jnp.int32)
+    h = _hash_columns(tuple(key_cols) + (salt,), 1 << 16)
+    return (h & (jnp.int32(nparts) - 1)) == jnp.int32(pid)
+
+
 @dataclass(frozen=True)
 class HashTable:
     """Built table: claim[s] = owning row id (N = empty)."""

@@ -14,12 +14,14 @@ The TPU answer to DistSQL physical planning (SURVEY.md §2.2, §A.6):
                                           side — dimension tables are
                                           small; no shuffle needed
 
-Eligibility (round 1): the plan root chain must be
-Limit?/Sort?/Aggregate where the Aggregate is ungrouped or uses the
-dense segment-sum strategy; every HashJoin build subtree is scan-only
-(replicated). Everything else falls back to single-device execution.
-After the collectives, all outputs are replicated, so Sort/Limit/
-HAVING above the Aggregate run identically on every shard.
+Eligibility: the plan root chain must be Limit?/Sort?/Aggregate —
+ungrouped, dense segment-sum strategy, or hash strategy (round 2:
+shard-local hash groups merge via all_gather + re-group, see
+exec/compile.py _compile_hash_dist_aggregate) — with every HashJoin
+build subtree scan-only (replicated). DISTINCT aggregates fall back
+to single-device execution. After the collectives, all outputs are
+replicated, so Sort/Limit/HAVING above the Aggregate run identically
+on every shard.
 """
 
 from __future__ import annotations
@@ -75,9 +77,6 @@ def analyze(node: P.PlanNode) -> DistDecision:
         n = n.child
     if not isinstance(n, P.Aggregate):
         return DistDecision(False, set(), set(), "root is not an aggregate")
-    if n.group_by and n.max_groups <= 0:
-        return DistDecision(False, set(), set(),
-                            "hash-strategy GROUP BY (shard-local ids)")
     for a in n.aggs:
         if a.distinct:
             return DistDecision(False, set(), set(), "DISTINCT aggregate")
@@ -101,16 +100,17 @@ def make_distributed_fn(runf, mesh, scan_aliases: dict, decision: DistDecision):
     def one(alias):
         return shard_leaf if alias in decision.sharded else repl_leaf
 
-    def fn(scans, read_ts):
-        return runf(RunContext(scans, read_ts))
+    def fn(scans, read_ts, nparts, pid):
+        return runf(RunContext(scans, read_ts, nparts, pid))
 
-    # pytree of specs matching (scans dict, read_ts)
+    # pytree of specs matching (scans dict, read_ts, nparts, pid)
     def spec_for_scans(scans):
         return {alias: jax.tree.map(lambda _: one(alias), b)
                 for alias, b in scans.items()}
 
-    def wrapped(scans, read_ts):
-        in_specs = (spec_for_scans(scans), repl_leaf)
+    def wrapped(scans, read_ts, nparts, pid):
+        in_specs = (spec_for_scans(scans), repl_leaf, repl_leaf, repl_leaf)
         return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=repl_leaf, check_vma=False)(scans, read_ts)
+                         out_specs=repl_leaf,
+                         check_vma=False)(scans, read_ts, nparts, pid)
     return wrapped

@@ -1,0 +1,101 @@
+"""Distributed hash-strategy GROUP BY over the virtual 8-device mesh.
+
+Round-1 fell back to a single device for any GROUP BY without a
+static dense bound (high-cardinality int keys, big dictionaries). Now
+shard-local hash groups merge across the mesh via all_gather +
+re-group (exec/compile.py _compile_hash_dist_aggregate) — the ICI
+form of the reference's HashRouter shuffle + final aggregation stage
+(colflow/routers.go:425, physicalplan/aggregator_funcs.go). Oracle:
+the same query with distsql=off.
+"""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.parallel.distagg import analyze
+from cockroach_tpu.sql import parser
+from cockroach_tpu.sql.planner import Planner
+
+
+def _mk_engine(n_rows: int, n_keys: int) -> Engine:
+    eng = Engine()
+    assert eng.mesh is not None and eng.mesh.size == 8, \
+        "tests need the 8-device CPU mesh from conftest"
+    eng.execute("CREATE TABLE hg (k INT8 NOT NULL, v INT8, f FLOAT)")
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, n_keys, size=n_rows).astype(np.int64)
+    v = rng.integers(-1000, 1000, size=n_rows).astype(np.int64)
+    f = rng.random(n_rows)
+    eng.store.insert_columns(
+        "hg", {"k": k, "v": v, "f": f}, eng.clock.now())
+    return eng
+
+
+def _run_both(eng, q, cap=None):
+    s_dist = eng.session()
+    s_local = eng.session()
+    s_local.vars.set("distsql", "off")
+    if cap is not None:
+        s_dist.vars.set("hash_group_capacity", cap)
+        s_local.vars.set("hash_group_capacity", cap)
+    dist = eng.execute(q, s_dist)
+    local = eng.execute(q, s_local)
+    return dist.rows, local.rows
+
+
+class TestDistributedHashGroupBy:
+    def test_analyzer_accepts_hash_groupby(self):
+        eng = _mk_engine(1024, 100)
+        node, _ = Planner(eng.catalog_view()).plan_select(
+            parser.parse("SELECT k, sum(v) AS s FROM hg GROUP BY k"))
+        d = analyze(node)
+        assert d.ok, d.reason
+
+    def test_sum_count_by_int_key(self):
+        eng = _mk_engine(20_000, 3_000)
+        q = ("SELECT k, sum(v) AS s, count(*) AS c FROM hg "
+             "GROUP BY k ORDER BY k")
+        dist, local = _run_both(eng, q)
+        assert len(dist) == len(local) > 2500
+        assert dist == local
+
+    def test_avg_min_max_merge(self):
+        eng = _mk_engine(20_000, 500)
+        q = ("SELECT k, avg(f) AS a, min(v) AS mn, max(v) AS mx "
+             "FROM hg GROUP BY k ORDER BY k")
+        dist, local = _run_both(eng, q)
+        assert len(dist) == len(local)
+        for rd, rl in zip(dist, local):
+            assert rd[0] == rl[0]
+            assert abs(rd[1] - rl[1]) < 1e-9
+            assert rd[2] == rl[2] and rd[3] == rl[3]
+
+    def test_100k_groups_distribute(self):
+        """The VERDICT's done-bar: a 100K-group aggregation runs
+        distributed on the mesh and matches the single-device oracle."""
+        eng = _mk_engine(300_000, 100_000)
+        q = "SELECT k, sum(v) AS s FROM hg GROUP BY k"
+        # confirm the distributed path is actually taken
+        node, _ = Planner(eng.catalog_view()).plan_select(parser.parse(q))
+        assert analyze(node).ok
+        dist, local = _run_both(eng, q)
+        assert len(dist) == len(local) > 90_000
+        assert sorted(dist) == sorted(local)
+
+    def test_having_and_sort_above_hash_dist(self):
+        eng = _mk_engine(10_000, 200)
+        q = ("SELECT k, count(*) AS c FROM hg GROUP BY k "
+             "HAVING count(*) > 40 ORDER BY c DESC, k LIMIT 10")
+        dist, local = _run_both(eng, q)
+        assert dist == local
+
+    def test_capacity_overflow_spills(self):
+        # more distinct keys than table slots: the spill path kicks in
+        # (hash-partitioned re-execution) on BOTH the distributed and
+        # the single-device plan, and results still match
+        eng = _mk_engine(5_000, 2_000)
+        dist, local = _run_both(
+            eng, "SELECT k, sum(v) AS s FROM hg GROUP BY k", cap=1024)
+        assert len(dist) == len(local) > 1_500  # > cap: both spilled
+        assert sorted(dist) == sorted(local)

@@ -71,13 +71,15 @@ class TestDistributionDecision:
                         "ORDER BY l_orderkey LIMIT 3")
         assert len(r.rows) == 3
 
-    def test_analyze_rejects_hash_groupby(self, eng):
+    def test_analyze_accepts_hash_groupby(self, eng):
+        # round 2: hash-strategy GROUP BY distributes via all_gather +
+        # re-group (tests/test_dist_hash_groupby.py covers correctness)
         from cockroach_tpu.sql import parser
         from cockroach_tpu.sql.planner import Planner
         node, _ = Planner(eng.catalog_view()).plan_select(parser.parse(
             "SELECT l_orderkey, count(*) FROM lineitem GROUP BY l_orderkey"))
         d = distagg.analyze(node)
-        assert not d.ok
+        assert d.ok
 
     def test_analyze_accepts_q14_shape(self, eng):
         from cockroach_tpu.sql import parser

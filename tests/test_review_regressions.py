@@ -62,9 +62,11 @@ def test_hash_capacity_retry_takes_effect(eng):
     e2.execute("INSERT INTO big VALUES "
                + ",".join(f"({i})" for i in range(300)))
     s = e2.session()
+    # round 2: overflow no longer errors — the spill path partitions
+    # and the query still answers correctly at any capacity
     s.vars.set("hash_group_capacity", 256)
-    with pytest.raises(EngineError):
-        e2.execute("SELECT k, count(*) AS n FROM big GROUP BY k", s)
+    r = e2.execute("SELECT k, count(*) AS n FROM big GROUP BY k", s)
+    assert len(r.rows) == 300
     s.vars.set("hash_group_capacity", 4096)
     r = e2.execute("SELECT k, count(*) AS n FROM big GROUP BY k", s)
     assert len(r.rows) == 300

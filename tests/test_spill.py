@@ -1,0 +1,76 @@
+"""Hash GROUP BY spill: partition-and-recurse on capacity overflow.
+
+The reference swaps an in-memory operator for a disk-spilling external
+one on OOM (colexecdisk/disk_spiller.go:75, hash_based_partitioner).
+Here the compiled program takes (nparts, pid) scalars and masks rows
+to one hash partition, so the engine reruns the SAME XLA program per
+partition against the resident HBM table, doubling partitions until
+each fits; Sort/Limit are applied on the host over the concatenated
+group rows. The VERDICT bar: hash_group_capacity=64 with 10K distinct
+groups must pass.
+"""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, HashCapacityExceeded
+
+
+def _mk(n_rows: int, n_keys: int, distsql="off") -> tuple:
+    eng = Engine()
+    eng.execute("CREATE TABLE sp (k INT8 NOT NULL, v INT8, s STRING)")
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, n_keys, size=n_rows).astype(np.int64)
+    v = rng.integers(-100, 100, size=n_rows).astype(np.int64)
+    s = np.array(["aa", "bb", "cc"], dtype=object)[k % 3]
+    eng.store.insert_columns("sp", {"k": k, "v": v, "s": s},
+                             eng.clock.now())
+    sess = eng.session()
+    sess.vars.set("distsql", distsql)
+    return eng, sess, k, v
+
+
+class TestSpill:
+    def test_10k_groups_at_capacity_64(self):
+        """The VERDICT done-bar."""
+        eng, s, k, v = _mk(40_000, 10_000)
+        s.vars.set("hash_group_capacity", 64)
+        r = eng.execute("SELECT k, sum(v) AS sv, count(*) AS c "
+                        "FROM sp GROUP BY k", s)
+        distinct = np.unique(k)
+        assert len(r.rows) == len(distinct) > 9_500
+        # spot-check against numpy
+        got = {row[0]: (row[1], row[2]) for row in r.rows}
+        for key in (int(distinct[0]), int(distinct[77]),
+                    int(distinct[-1])):
+            m = k == key
+            assert got[key] == (int(v[m].sum()), int(m.sum()))
+
+    def test_spill_respects_order_by_and_limit(self):
+        eng, s, k, v = _mk(20_000, 3_000)
+        s.vars.set("hash_group_capacity", 256)
+        q = ("SELECT k, count(*) AS c FROM sp GROUP BY k "
+             "ORDER BY c DESC, k LIMIT 7")
+        spilled = eng.execute(q, s).rows
+        s.vars.set("hash_group_capacity", 1 << 14)  # fits: no spill
+        direct = eng.execute(q, s).rows
+        assert spilled == direct
+
+    def test_spill_with_string_keys_and_having(self):
+        eng, s, k, v = _mk(10_000, 2_000)
+        s.vars.set("hash_group_capacity", 128)
+        q = ("SELECT k, s, min(v) AS mn, max(v) AS mx, avg(v) AS a "
+             "FROM sp GROUP BY k, s HAVING count(*) > 2 ORDER BY k, s")
+        spilled = eng.execute(q, s).rows
+        s.vars.set("hash_group_capacity", 1 << 14)
+        direct = eng.execute(q, s).rows
+        assert len(spilled) == len(direct)
+        for rs, rd in zip(spilled, direct):
+            assert rs[:4] == rd[:4]
+            assert abs(rs[4] - rd[4]) < 1e-9
+
+    def test_unspillable_beyond_max_partitions(self):
+        eng, s, k, v = _mk(60_000, 40_000)
+        s.vars.set("hash_group_capacity", 64)  # 64*256 < 40_000
+        with pytest.raises(HashCapacityExceeded, match="spill partitions"):
+            eng.execute("SELECT k, sum(v) AS sv FROM sp GROUP BY k", s)

@@ -126,13 +126,16 @@ def test_streamed_respects_mvcc_deletes():
 
 
 def test_streaming_off_session_var(engines):
+    # with streaming disabled, an over-budget table is a clean quota
+    # error at prepare time (memory monitor), not a silent upload
     big, small, s2 = engines
     s = small.session()
     s.vars.set("distsql", "off")
     s.vars.set("streaming", "off")
     from cockroach_tpu.sql import parser
-    p = small._prepare_select(parser.parse(tpch.Q6), s, tpch.Q6)
-    assert p.stream is None
+    from cockroach_tpu.utils.mon import MemoryQuotaError
+    with pytest.raises(MemoryQuotaError, match="budget"):
+        small._prepare_select(parser.parse(tpch.Q6), s, tpch.Q6)
 
 
 def test_column_pruning_uploads_only_needed():
