@@ -1,0 +1,11 @@
+"""Jobs: durable registry + checkpoint/resume (reference: pkg/jobs)."""
+
+from .import_job import IMPORT_JOB, ImportResumer, synthetic_chunk
+from .registry import (CANCELED, FAILED, PENDING, RUNNING, SUCCEEDED,
+                       JobCanceled, JobContext, JobRecord, JobsError,
+                       Registry)
+
+__all__ = ["Registry", "JobRecord", "JobContext", "JobsError",
+           "JobCanceled", "ImportResumer", "IMPORT_JOB",
+           "synthetic_chunk", "PENDING", "RUNNING", "SUCCEEDED",
+           "FAILED", "CANCELED"]

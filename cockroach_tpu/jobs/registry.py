@@ -1,0 +1,274 @@
+"""Durable jobs: registry, leasing, checkpointed resume.
+
+The analogue of the reference's jobs system (pkg/jobs/registry.go:1317
+``Resumer{Resume,OnFailOrCancel}``; adoption/leasing registry.go:1508;
+progress persistence progress.go). Job records are JSON rows in the
+transactional KV plane under /System/jobs/<id>, so claims are
+serializable txns and progress checkpoints survive the death of the
+node running the job: a new registry (same store, new session) adopts
+any job whose lease lapsed and resumes it from its last checkpoint.
+
+Single-process scope for now: adoption is driven by explicit
+``adopt_and_run_all()`` / ``run_job()`` calls (a Node wires these to a
+background loop); multi-node adoption arrives with the cluster fabric.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..kv.txn import DB as KVDB
+
+JOBS_PREFIX = b"/System/jobs/"
+
+PENDING = "pending"
+RUNNING = "running"
+SUCCEEDED = "succeeded"
+FAILED = "failed"
+CANCELED = "canceled"
+CANCEL_REQUESTED = "cancel-requested"
+
+
+class JobsError(Exception):
+    pass
+
+
+class JobCanceled(JobsError):
+    """Raised inside a Resumer by ctx.check_cancel()."""
+
+
+class LeaseLostError(JobsError):
+    """The job's lease moved to another session: a pre-empted runner
+    must stop instead of clobbering the adopter's progress."""
+
+
+@dataclass
+class JobRecord:
+    id: int
+    type: str
+    payload: dict
+    status: str = PENDING
+    progress: dict = field(default_factory=dict)
+    lease_owner: str = ""
+    lease_expires: float = 0.0   # unix seconds; 0 = unleased
+    error: str = ""
+    fraction_completed: float = 0.0
+
+    def encode(self) -> bytes:
+        return json.dumps(self.__dict__, sort_keys=True).encode()
+
+    @staticmethod
+    def decode(raw: bytes) -> "JobRecord":
+        return JobRecord(**json.loads(raw.decode()))
+
+
+def _job_key(job_id: int) -> bytes:
+    return JOBS_PREFIX + f"{job_id:016d}".encode()
+
+
+class JobContext:
+    """What a Resumer sees while running (the jobs.Job handle)."""
+
+    def __init__(self, registry: "Registry", record: JobRecord):
+        self._registry = registry
+        self.job_id = record.id
+        self.payload = dict(record.payload)
+        self._progress = dict(record.progress)
+
+    def progress(self) -> dict:
+        return dict(self._progress)
+
+    def checkpoint(self, progress: dict,
+                   fraction: Optional[float] = None) -> None:
+        """Persist progress NOW (cf. backupccl's checkpoint loop,
+        backup_job.go:230-266 — ours is synchronous per call). Raises
+        LeaseLostError if another session adopted the job meanwhile —
+        the slow runner must abandon, not overwrite the adopter."""
+        self._registry._update(self.job_id, progress=dict(progress),
+                               fraction=fraction,
+                               expect_owner=self._registry.session_id)
+        self._progress = dict(progress)
+
+    def check_cancel(self) -> None:
+        rec = self._registry.job(self.job_id)
+        if rec.status == CANCEL_REQUESTED:
+            raise JobCanceled(f"job {self.job_id} canceled")
+
+
+class Registry:
+    """Create, claim, run, and observe jobs against one KV store."""
+
+    def __init__(self, db: KVDB, session_id: str = "node-1",
+                 lease_seconds: float = 10.0,
+                 now: Callable[[], float] = time.time):
+        self.db = db
+        self.session_id = session_id
+        self.lease_seconds = lease_seconds
+        self.now = now
+        self._resumers: dict[str, Callable[[], object]] = {}
+        self._next_id_hint = 1
+
+    # -- registration --------------------------------------------------------
+    def register(self, job_type: str, factory: Callable[[], object]) -> None:
+        """factory() -> object with resume(ctx) and (optionally)
+        on_fail_or_cancel(ctx) — the Resumer interface
+        (jobs/registry.go:1317,1336)."""
+        self._resumers[job_type] = factory
+
+    # -- creation ------------------------------------------------------------
+    def create(self, job_type: str, payload: dict) -> int:
+        if job_type not in self._resumers:
+            raise JobsError(f"no resumer registered for {job_type!r}")
+
+        def txn(t):
+            # allocate the next id under the txn (scan the tail)
+            jid = self._next_id_hint
+            while t.get(_job_key(jid)) is not None:
+                jid += 1
+            rec = JobRecord(id=jid, type=job_type, payload=payload)
+            t.put(_job_key(jid), rec.encode())
+            return jid
+        jid = self.db.txn(txn)
+        self._next_id_hint = jid + 1
+        return jid
+
+    # -- observation ---------------------------------------------------------
+    def job(self, job_id: int) -> JobRecord:
+        raw = self.db.get(_job_key(job_id))
+        if raw is None:
+            raise JobsError(f"job {job_id} does not exist")
+        return JobRecord.decode(raw)
+
+    def jobs(self) -> list[JobRecord]:
+        out = []
+        for _k, v in self.db.scan(JOBS_PREFIX, JOBS_PREFIX + b"\xff"):
+            out.append(JobRecord.decode(v))
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def _update(self, job_id: int, expect_owner: Optional[str] = None,
+                **changes) -> JobRecord:
+        def txn(t):
+            raw = t.get(_job_key(job_id))
+            if raw is None:
+                raise JobsError(f"job {job_id} vanished")
+            rec = JobRecord.decode(raw)
+            if expect_owner is not None and rec.lease_owner != expect_owner:
+                raise LeaseLostError(
+                    f"job {job_id} lease now held by "
+                    f"{rec.lease_owner!r}, not {expect_owner!r}")
+            if "progress" in changes:
+                rec.progress = changes["progress"]
+            if changes.get("fraction") is not None:
+                rec.fraction_completed = float(changes["fraction"])
+            for f in ("status", "lease_owner", "lease_expires", "error"):
+                if f in changes:
+                    setattr(rec, f, changes[f])
+            t.put(_job_key(job_id), rec.encode())
+            return rec
+        return self.db.txn(txn)
+
+    def _try_claim(self, job_id: int) -> Optional[JobRecord]:
+        """Serializable claim: pending, or running with a lapsed lease
+        (the dead-node adoption path, registry.go:1508)."""
+        now = self.now()
+
+        def txn(t):
+            raw = t.get(_job_key(job_id))
+            if raw is None:
+                return None
+            rec = JobRecord.decode(raw)
+            adoptable = (
+                rec.status == PENDING
+                or (rec.status == RUNNING
+                    and (rec.lease_owner == self.session_id
+                         or rec.lease_expires <= now))
+                or rec.status == CANCEL_REQUESTED)
+            if not adoptable:
+                return None
+            if rec.status != CANCEL_REQUESTED:
+                rec.status = RUNNING
+            rec.lease_owner = self.session_id
+            rec.lease_expires = now + self.lease_seconds
+            t.put(_job_key(job_id), rec.encode())
+            return rec
+        return self.db.txn(txn)
+
+    def run_job(self, job_id: int) -> JobRecord:
+        """Claim and run one job to a terminal state (synchronously)."""
+        rec = self._try_claim(job_id)
+        if rec is None:
+            return self.job(job_id)
+        factory = self._resumers.get(rec.type)
+        if factory is None:
+            return self._update(job_id, status=FAILED,
+                                error=f"no resumer for {rec.type!r}")
+        resumer = factory()
+        ctx = JobContext(self, rec)
+        if rec.status == CANCEL_REQUESTED:
+            if hasattr(resumer, "on_fail_or_cancel"):
+                resumer.on_fail_or_cancel(ctx)
+            return self._update(job_id, status=CANCELED,
+                                lease_owner="", lease_expires=0.0)
+        try:
+            resumer.resume(ctx)
+        except LeaseLostError:
+            # another session adopted the job out from under this one
+            # (lease lapsed mid-chunk): abandon without touching the
+            # record — the adopter owns it now
+            return self.job(job_id)
+        except JobCanceled:
+            if hasattr(resumer, "on_fail_or_cancel"):
+                resumer.on_fail_or_cancel(ctx)
+            return self._update(job_id, status=CANCELED,
+                                expect_owner=self.session_id,
+                                lease_owner="", lease_expires=0.0)
+        except _CrashForTesting:
+            # simulated node death: leave RUNNING with the lease intact
+            # — only lease expiry lets another registry adopt it
+            raise
+        except Exception as e:  # Resumer failure -> terminal FAILED
+            if hasattr(resumer, "on_fail_or_cancel"):
+                try:
+                    resumer.on_fail_or_cancel(ctx)
+                except Exception:
+                    pass
+            try:
+                return self._update(job_id, status=FAILED, error=str(e),
+                                    expect_owner=self.session_id,
+                                    lease_owner="", lease_expires=0.0)
+            except LeaseLostError:
+                return self.job(job_id)
+        try:
+            return self._update(job_id, status=SUCCEEDED,
+                                fraction=1.0,
+                                expect_owner=self.session_id,
+                                lease_owner="", lease_expires=0.0)
+        except LeaseLostError:
+            return self.job(job_id)
+
+    def adopt_and_run_all(self) -> list[JobRecord]:
+        """Run every adoptable job once (the adoption loop's body)."""
+        out = []
+        for rec in self.jobs():
+            if rec.status in (PENDING, CANCEL_REQUESTED) or (
+                    rec.status == RUNNING
+                    and rec.lease_expires <= self.now()):
+                out.append(self.run_job(rec.id))
+        return out
+
+    def cancel(self, job_id: int) -> JobRecord:
+        rec = self.job(job_id)
+        if rec.status in (SUCCEEDED, FAILED, CANCELED):
+            return rec
+        if rec.status == PENDING:
+            return self._update(job_id, status=CANCELED)
+        return self._update(job_id, status=CANCEL_REQUESTED)
+
+
+class _CrashForTesting(BaseException):
+    """TestingKnobs-style fault injection: simulates the process dying
+    mid-job (lease stays, progress stays at the last checkpoint)."""

@@ -1,0 +1,181 @@
+"""Jobs registry + resumable IMPORT tests.
+
+Mirrors the reference's jobs tests (pkg/jobs/jobs_test.go) and the
+backup checkpoint/resume exemplar: the kill-and-resume test is the
+VERDICT's done-bar — a crash mid-ingest must complete the import
+EXACTLY once after adoption by a fresh registry.
+"""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.jobs import (CANCELED, FAILED, IMPORT_JOB, PENDING,
+                                RUNNING, SUCCEEDED, ImportResumer,
+                                JobsError, Registry)
+from cockroach_tpu.jobs.registry import _CrashForTesting
+
+COLUMNS = {"a": "int", "b": "float", "s": 16}
+
+
+def _mk_engine():
+    eng = Engine()
+    eng.execute("CREATE TABLE imp (a INT8 NOT NULL, b FLOAT NOT NULL, "
+                "s STRING NOT NULL)")
+    eng.store.set_dictionary("imp", "s", [f"v{i}" for i in range(16)])
+    return eng
+
+
+def _payload(total=10_000, chunk=1_000):
+    return {"table": "imp", "total_rows": total, "chunk_rows": chunk,
+            "seed": 42, "columns": COLUMNS}
+
+
+def _registry(eng, session="node-1", crash_after=None, lease=10.0):
+    reg = Registry(eng.kv, session_id=session, lease_seconds=lease)
+    reg.register(IMPORT_JOB,
+                 lambda: ImportResumer(eng, crash_after_chunk=crash_after))
+    return reg
+
+
+class TestRegistry:
+    def test_create_and_run_to_completion(self):
+        eng = _mk_engine()
+        reg = _registry(eng)
+        jid = reg.create(IMPORT_JOB, _payload())
+        assert reg.job(jid).status == PENDING
+        rec = reg.run_job(jid)
+        assert rec.status == SUCCEEDED
+        assert rec.fraction_completed == 1.0
+        r = eng.execute("SELECT count(*) AS c FROM imp")
+        assert r.rows == [(10_000,)]
+
+    def test_unknown_type_rejected(self):
+        eng = _mk_engine()
+        reg = _registry(eng)
+        with pytest.raises(JobsError, match="no resumer"):
+            reg.create("BOGUS", {})
+
+    def test_failed_job_records_error(self):
+        eng = _mk_engine()
+        reg = Registry(eng.kv)
+
+        class Boom:
+            def resume(self, ctx):
+                raise ValueError("exploded")
+        reg.register("BOOM", Boom)
+        jid = reg.create("BOOM", {})
+        rec = reg.run_job(jid)
+        assert rec.status == FAILED
+        assert "exploded" in rec.error
+
+    def test_cancel_pending_and_running(self):
+        eng = _mk_engine()
+        reg = _registry(eng)
+        jid = reg.create(IMPORT_JOB, _payload())
+        assert reg.cancel(jid).status == CANCELED
+        # canceling a terminal job is a no-op
+        assert reg.cancel(jid).status == CANCELED
+
+    def test_jobs_listing(self):
+        eng = _mk_engine()
+        reg = _registry(eng)
+        ids = [reg.create(IMPORT_JOB, _payload(total=100, chunk=50))
+               for _ in range(3)]
+        assert [j.id for j in reg.jobs()] == ids
+
+
+class TestKillAndResume:
+    def test_crash_mid_import_resumes_exactly_once(self):
+        """The VERDICT done-bar."""
+        eng = _mk_engine()
+        reg1 = _registry(eng, session="node-1", crash_after=3, lease=0.0)
+        jid = reg1.create(IMPORT_JOB, _payload(total=10_000, chunk=1_000))
+        with pytest.raises(_CrashForTesting):
+            reg1.run_job(jid)
+        rec = reg1.job(jid)
+        assert rec.status == RUNNING  # died holding the lease
+        # 4 chunks landed (crash fired after chunk index 3's ingest),
+        # but the checkpoint only recorded 3 — the dangerous window
+        assert rec.progress["chunks_done"] == 3
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(4_000,)]
+
+        # a different registry session adopts after lease expiry and
+        # completes the job WITHOUT re-ingesting chunk 3
+        reg2 = _registry(eng, session="node-2", lease=10.0)
+        rec2 = reg2.run_job(jid)
+        assert rec2.status == SUCCEEDED
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(10_000,)]
+        # deterministic generator => values correct, not just counts:
+        # chunk 3 (the crash chunk) appears exactly once
+        from cockroach_tpu.jobs import synthetic_chunk
+        c3 = synthetic_chunk(42, 3, 1_000, COLUMNS)
+        want = int(c3["a"].sum())
+        got = eng.execute(
+            "SELECT sum(a) AS s FROM imp").rows[0][0]
+        full = sum(int(synthetic_chunk(42, i, 1_000, COLUMNS)["a"].sum())
+                   for i in range(10))
+        assert got == full  # includes chunk 3 exactly once
+        assert want > 0
+
+    def test_live_lease_blocks_adoption(self):
+        eng = _mk_engine()
+        reg1 = _registry(eng, session="node-1", crash_after=2, lease=3600)
+        jid = reg1.create(IMPORT_JOB, _payload(total=5_000, chunk=1_000))
+        with pytest.raises(_CrashForTesting):
+            reg1.run_job(jid)
+        # lease still live: another session must NOT adopt
+        reg2 = _registry(eng, session="node-2")
+        rec = reg2.run_job(jid)
+        assert rec.status == RUNNING
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(3_000,)]
+
+    def test_adopt_and_run_all_picks_up_pending(self):
+        eng = _mk_engine()
+        reg = _registry(eng)
+        ids = [reg.create(IMPORT_JOB, _payload(total=2_000, chunk=500))
+               for _ in range(2)]
+        done = reg.adopt_and_run_all()
+        assert {r.id for r in done} == set(ids)
+        assert all(r.status == SUCCEEDED for r in done)
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(4_000,)]
+
+
+class TestReviewRegressions:
+    def test_partial_final_chunk_not_double_ingested(self):
+        """total_rows not a multiple of chunk_rows: a crash after the
+        final PARTIAL chunk must not re-ingest it on resume."""
+        eng = _mk_engine()
+        # chunks: 30, 30, 30, 10 — crash fires after the last one
+        reg1 = _registry(eng, session="node-1", crash_after=3, lease=0.0)
+        jid = reg1.create(IMPORT_JOB, _payload(total=100, chunk=30))
+        with pytest.raises(_CrashForTesting):
+            reg1.run_job(jid)
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(100,)]
+        reg2 = _registry(eng, session="node-2")
+        rec = reg2.run_job(jid)
+        assert rec.status == SUCCEEDED
+        assert eng.execute("SELECT count(*) AS c FROM imp").rows \
+            == [(100,)]
+
+    def test_preempted_runner_cannot_clobber_adopter(self):
+        """A slow original runner whose lease lapsed must abandon when
+        its next checkpoint discovers the adopter's lease."""
+        from cockroach_tpu.jobs.registry import (JobContext,
+                                                 LeaseLostError)
+        eng = _mk_engine()
+        reg1 = _registry(eng, session="node-1", lease=0.0)
+        jid = reg1.create(IMPORT_JOB, _payload(total=1_000, chunk=500))
+        rec = reg1._try_claim(jid)
+        ctx = JobContext(reg1, rec)
+        # adopter claims (lease already lapsed with lease_seconds=0)
+        reg2 = _registry(eng, session="node-2", lease=3600)
+        assert reg2._try_claim(jid) is not None
+        with pytest.raises(LeaseLostError):
+            ctx.checkpoint({"baseline_rows": 0, "chunks_done": 1})
+        # the adopter's record is untouched
+        assert reg2.job(jid).lease_owner == "node-2"
