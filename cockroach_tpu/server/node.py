@@ -25,6 +25,7 @@ from .pgwire import PgServer
 class NodeConfig:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0          # 0 = ephemeral (tests); CLI default 26257
+    http_port: int | None = 0     # status/metrics; None disables
     mesh: object = None           # optional device mesh for DistSQL
     load_tpch_sf: float | None = None  # demo mode: preload TPC-H tables
 
@@ -38,13 +39,65 @@ class Node:
         self.engine = Engine(store=self.store, clock=self.clock,
                              settings=self.settings,
                              mesh=self.config.mesh)
+        from ..jobs import IMPORT_JOB, ImportResumer, Registry
+        self.jobs = Registry(self.engine.kv)
+        self.jobs.register(IMPORT_JOB, lambda: ImportResumer(self.engine))
         self.pg: PgServer | None = None
+        self._http = None
         self._started = False
 
     @property
     def sql_addr(self) -> tuple[str, int]:
         assert self.pg is not None, "node not started"
         return self.pg.addr
+
+    @property
+    def http_addr(self) -> tuple[str, int]:
+        assert self._http is not None, "status server not started"
+        return self._http.server_address[:2]
+
+    def _start_status_server(self):
+        """Status/metrics HTTP endpoint (pkg/server/status: /healthz,
+        /_status/vars Prometheus text)."""
+        import http.server
+        import json
+        import threading
+
+        node = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path in ("/metrics", "/_status/vars"):
+                    body = node.engine.metrics.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body = json.dumps({
+                        "status": "ok",
+                        "version": __version__,
+                        "tables": len(node.store.tables),
+                        "hbm_used_bytes": node.engine.hbm.used,
+                    }).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Srv(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._http = Srv((self.config.listen_host,
+                          self.config.http_port), H)
+        threading.Thread(target=self._http.serve_forever,
+                         name="status-http", daemon=True).start()
 
     def start(self) -> "Node":
         if self._started:
@@ -55,12 +108,18 @@ class Node:
         self.pg = PgServer(self.engine, self.config.listen_host,
                            self.config.listen_port,
                            version=__version__).start()
+        if self.config.http_port is not None:
+            self._start_status_server()
         self._started = True
         return self
 
     def stop(self):
         if self.pg is not None:
             self.pg.stop()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
         self._started = False
 
     def __enter__(self):

@@ -44,11 +44,15 @@ class ProtocolError(Exception):
 
 
 def _sqlstate(exc: Exception) -> str:
+    from ..utils.mon import MemoryQuotaError
+
     msg = str(exc)
     if "restart transaction" in msg:
         return "40001"  # serialization_failure
     if "transaction is aborted" in msg:
         return "25P02"  # in_failed_sql_transaction
+    if isinstance(exc, MemoryQuotaError):
+        return "53200"  # out_of_memory
     if isinstance(exc, EngineError):
         return "42601" if "parse" in msg.lower() else "XX000"
     return "XX000"

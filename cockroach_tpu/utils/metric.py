@@ -1,0 +1,139 @@
+"""Metrics: counters, gauges, histograms + Prometheus text export.
+
+The analogue of the reference's metric registry (pkg/util/metric/
+registry.go:31) and its Prometheus exporter (prometheus_exporter.go).
+Every subsystem registers named metrics here; the Node's status
+endpoint serves the text exposition format.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, delta: int = 1) -> None:
+        with self._lock:
+            self._v += delta
+
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._v += delta
+
+    def dec(self, delta: float = 1.0) -> None:
+        self.inc(-delta)
+
+    def value(self) -> float:
+        return self._v
+
+
+class Histogram:
+    """Log-bucketed latency/size histogram (the reference uses HDR-ish
+    histograms; log2 buckets keep it dependency-free)."""
+
+    def __init__(self, name: str, help_: str = "", num_buckets: int = 40):
+        self.name = name
+        self.help = help_
+        self._buckets = [0] * num_buckets
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        b = 0 if v <= 0 else min(len(self._buckets) - 1,
+                                 max(0, int(math.log2(v * 1e6) + 1)))
+        with self._lock:
+            self._buckets[b] += 1
+            self._sum += v
+            self._count += 1
+
+    def value(self) -> dict:
+        return {"count": self._count, "sum": self._sum}
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            target = q * self._count
+            acc = 0
+            for i, c in enumerate(self._buckets):
+                acc += c
+                if acc >= target:
+                    return (2.0 ** (i - 1)) / 1e6
+            return (2.0 ** (len(self._buckets) - 1)) / 1e6
+
+
+class MetricRegistry:
+    """Named metric registry (pkg/util/metric/registry.go:31)."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_add(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_add(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        return self._get_or_add(name, lambda: Histogram(name, help_))
+
+    def _get_or_add(self, name: str, mk):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = mk()
+                self._metrics[name] = m
+            return m
+
+    def get(self, name: str) -> Optional[object]:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        return {name: m.value() for name, m in sorted(self._metrics.items())}
+
+    def to_prometheus(self) -> str:
+        """Text exposition format (prometheus_exporter.go)."""
+        out = []
+        for name, m in sorted(self._metrics.items()):
+            pname = name.replace(".", "_").replace("-", "_")
+            if m.help:
+                out.append(f"# HELP {pname} {m.help}")
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {pname} counter")
+                out.append(f"{pname} {m.value()}")
+            elif isinstance(m, Gauge):
+                out.append(f"# TYPE {pname} gauge")
+                out.append(f"{pname} {m.value()}")
+            elif isinstance(m, Histogram):
+                v = m.value()
+                out.append(f"# TYPE {pname} summary")
+                out.append(f'{pname}{{quantile="0.5"}} {m.quantile(0.5)}')
+                out.append(f'{pname}{{quantile="0.99"}} {m.quantile(0.99)}')
+                out.append(f"{pname}_sum {v['sum']}")
+                out.append(f"{pname}_count {v['count']}")
+        return "\n".join(out) + "\n"

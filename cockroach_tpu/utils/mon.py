@@ -1,0 +1,70 @@
+"""Memory accounting: budgeted byte reservations for device memory.
+
+The analogue of the reference's mon.BytesMonitor hierarchies
+(pkg/util/mon/bytes_usage.go:173) backing --max-sql-memory; here the
+scarce pool is device HBM. The engine reserves an upload's bytes
+BEFORE materializing it on device, so an over-budget query fails with
+a clean quota error instead of an opaque XLA allocator OOM (and the
+error names the knob to turn).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class MemoryQuotaError(Exception):
+    pass
+
+
+class BytesMonitor:
+    """One budgeted pool with named accounts (child accounts are flat —
+    the reference's monitor tree collapses to (pool, account) here)."""
+
+    def __init__(self, name: str, limit: Callable[[], int] | int,
+                 on_change: Callable[[int], None] | None = None):
+        self.name = name
+        self._limit = limit if callable(limit) else (lambda: limit)
+        self._used = 0
+        self._accounts: dict[object, int] = {}
+        self._lock = threading.Lock()
+        self._on_change = on_change
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def limit(self) -> int:
+        return int(self._limit())
+
+    def reserve(self, account, nbytes: int) -> None:
+        """Grow `account` by nbytes; raises MemoryQuotaError if the
+        pool would exceed its limit (no partial reservation)."""
+        with self._lock:
+            limit = self.limit
+            if limit > 0 and self._used + nbytes > limit:
+                raise MemoryQuotaError(
+                    f"{self.name}: reserving {nbytes} bytes for "
+                    f"{account!r} exceeds budget ({self._used} of "
+                    f"{limit} in use); drop cached tables or raise "
+                    f"the budget setting")
+            self._used += nbytes
+            self._accounts[account] = self._accounts.get(account, 0) + nbytes
+            used = self._used
+        if self._on_change:
+            self._on_change(used)
+
+    def release(self, account) -> int:
+        """Release everything held by `account`."""
+        with self._lock:
+            n = self._accounts.pop(account, 0)
+            self._used -= n
+            used = self._used
+        if self._on_change:
+            self._on_change(used)
+        return n
+
+    def account_bytes(self, account) -> int:
+        return self._accounts.get(account, 0)

@@ -1,0 +1,124 @@
+"""Metrics registry + HBM accounting + status endpoint tests.
+
+Reference analogues: pkg/util/metric/registry.go:31 (registry +
+Prometheus export), pkg/util/mon/bytes_usage.go:173 (byte budgets),
+pkg/server/status (/healthz, /_status/vars).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.utils.metric import MetricRegistry
+from cockroach_tpu.utils.mon import BytesMonitor, MemoryQuotaError
+
+
+class TestMetricRegistry:
+    def test_counter_gauge_histogram(self):
+        m = MetricRegistry()
+        c = m.counter("a.b", "help a")
+        c.inc()
+        c.inc(4)
+        g = m.gauge("g.x")
+        g.set(2.5)
+        g.inc()
+        h = m.histogram("lat")
+        for v in (0.001, 0.002, 0.1):
+            h.observe(v)
+        snap = m.snapshot()
+        assert snap["a.b"] == 5
+        assert snap["g.x"] == 3.5
+        assert snap["lat"]["count"] == 3
+        assert 0.0005 < h.quantile(0.5) < 0.01
+
+    def test_same_name_returns_same_metric(self):
+        m = MetricRegistry()
+        assert m.counter("x") is m.counter("x")
+
+    def test_prometheus_export(self):
+        m = MetricRegistry()
+        m.counter("sql.query.count", "queries").inc(7)
+        m.gauge("hbm.used").set(123)
+        text = m.to_prometheus()
+        assert "# TYPE sql_query_count counter" in text
+        assert "sql_query_count 7" in text
+        assert "hbm_used 123" in text
+
+
+class TestBytesMonitor:
+    def test_reserve_release(self):
+        mon = BytesMonitor("m", 1000)
+        mon.reserve("a", 600)
+        with pytest.raises(MemoryQuotaError, match="budget"):
+            mon.reserve("b", 600)
+        mon.reserve("b", 300)
+        assert mon.used == 900
+        assert mon.release("a") == 600
+        assert mon.used == 300
+        mon.reserve("c", 600)  # fits now
+
+    def test_engine_wires_queries_to_metrics(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE mt (a INT8)")
+        eng.execute("INSERT INTO mt VALUES (1), (2)")
+        eng.execute("SELECT count(*) AS c FROM mt")
+        snap = eng.metrics.snapshot()
+        assert snap["sql.select.count"] >= 1
+        assert snap["sql.insert.count"] >= 1
+        assert snap["sql.exec.latency"]["count"] >= 3
+        # resident upload accounted
+        assert snap["sql.mem.device.current"] > 0
+        assert eng.hbm.used > 0
+
+    def test_over_budget_upload_is_clean_quota_error(self):
+        """A non-streamable plan over a too-big table fails with a
+        quota error naming the knob, not an XLA OOM."""
+        eng = Engine()
+        eng.execute("CREATE TABLE big (a INT8 NOT NULL PRIMARY KEY)")
+        eng.execute("INSERT INTO big VALUES " +
+                    ", ".join(f"({i})" for i in range(5000)))
+        eng.settings.set("sql.exec.hbm_budget_bytes", 1 << 10)
+        s = eng.session()
+        s.vars.set("distsql", "off")
+        # ORDER BY root is not aggregate-streamable -> resident upload
+        with pytest.raises(MemoryQuotaError, match="budget"):
+            eng.execute("SELECT a FROM big ORDER BY a LIMIT 5", s)
+
+    def test_drop_table_releases_hbm(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE rel (a INT8)")
+        eng.execute("INSERT INTO rel VALUES (1)")
+        eng.execute("SELECT a FROM rel")
+        assert eng.hbm.used > 0
+        eng.execute("DROP TABLE rel")
+        assert eng.hbm.used == 0
+
+
+class TestStatusEndpoint:
+    def test_healthz_and_metrics(self):
+        from cockroach_tpu.server import Node, NodeConfig
+
+        with Node(NodeConfig()) as n:
+            from cockroach_tpu.cli import PgClient
+            c = PgClient(*n.sql_addr)
+            c.query("SELECT 1 + 1")
+            c.close()
+            h, p = n.http_addr
+            with urllib.request.urlopen(
+                    f"http://{h}:{p}/healthz", timeout=5) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            with urllib.request.urlopen(
+                    f"http://{h}:{p}/metrics", timeout=5) as r:
+                text = r.read().decode()
+            assert "sql_select_count" in text
+
+    def test_404(self):
+        from cockroach_tpu.server import Node, NodeConfig
+
+        with Node(NodeConfig()) as n:
+            h, p = n.http_addr
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://{h}:{p}/nope", timeout=5)
