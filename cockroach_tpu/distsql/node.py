@@ -110,6 +110,12 @@ class DistSQLNode:
         eng = self.engine
         node, meta = Planner(eng.catalog_view()).plan_select(
             parser.parse(spec.sql))
+        # duplicate-keyed join builds must error, not silently drop
+        # matches — same guard as the gateway's _prepare_select
+        from cockroach_tpu.storage.hlc import Timestamp as _TS
+        rts = (_TS.from_int(spec.read_ts) if spec.read_ts is not None
+               else eng.clock.now())
+        eng._check_join_builds(node, rts)
         stage = split(node)
         runf = compile_plan(stage.local, ExecParams())
         scans = {alias: eng._device_table(tbl)

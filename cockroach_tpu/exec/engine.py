@@ -283,11 +283,19 @@ class Engine:
                 v = self.settings.get(stmt.name)
             return Result(names=[stmt.name], rows=[(v,)], tag="SHOW")
         if isinstance(stmt, ast.Explain):
+            from ..sql.stats import estimate
             node, _ = self._plan(stmt.stmt, session)
+            costs = estimate(node, self.catalog_view().stats)
+            tree = P.plan_tree_repr(node, costs=costs)
             return Result(names=["plan"],
                           rows=[(line,) for line in
-                                P.plan_tree_repr(node).rstrip().split("\n")],
+                                tree.rstrip().split("\n")],
                           tag="EXPLAIN")
+        if isinstance(stmt, ast.Analyze):
+            self.store.analyze(stmt.table)
+            self.metrics.counter("sql.stats.analyze",
+                                 "ANALYZE statements run").inc()
+            return Result(tag="ANALYZE")
         if isinstance(stmt, ast.BeginTxn):
             if session.txn is not None:
                 raise EngineError("transaction already open")
@@ -326,10 +334,25 @@ class Engine:
 
     # -- catalog -------------------------------------------------------------
     def catalog_view(self) -> CatalogView:
+        from ..sql.stats import TableStats
         schemas = {n: td.schema for n, td in self.store.tables.items()}
         dicts = {n: dict(td.dictionaries)
                  for n, td in self.store.tables.items()}
-        return CatalogView(schemas, dicts)
+        stats = {}
+        for n, td in self.store.tables.items():
+            if td.stats is not None:
+                # stale ANALYZE output (mutations since) still informs
+                # estimates but no longer counts as authoritative
+                st = TableStats(
+                    row_count=td.row_count,
+                    distinct=dict(td.stats.distinct),
+                    null_frac=dict(td.stats.null_frac),
+                    analyzed=td.stats_generation == td.generation)
+            else:
+                st = TableStats(row_count=td.row_count)
+            stats[n] = st
+        return CatalogView(schemas, dicts, stats,
+                           key_distinct_fn=self.store.key_distinct)
 
     def _read_ts(self, session: Session) -> Timestamp:
         return session.txn_read_ts or self.clock.now()
@@ -362,6 +385,9 @@ class Engine:
                   else self._stream_decision(node, scan_aliases, scan_cols,
                                              session))
         read_ts = self._read_ts(session)
+        # the join-build uniqueness guard is snapshot-aware: it must
+        # judge the rows visible at THIS query's read timestamp
+        self._check_join_builds(node, read_ts)
 
         scans = {}
         gens = []
@@ -456,6 +482,59 @@ class Engine:
         if sel.table is None:
             return self._exec_table_free(sel)
         return self._prepare_select(sel, session, sql_text).run()
+
+    def _check_join_builds(self, node, read_ts: Timestamp) -> None:
+        """The device hash join gathers ONE build row per probe key
+        (ops/join.py: exact for unique build keys). Verify build-side
+        key uniqueness on the host over the rows VISIBLE at the query's
+        read timestamp before running — a duplicate-keyed build must be
+        a clean error, never a silently-dropped match. The reference's
+        hash join handles duplicates by row expansion (colexecjoin/
+        hashjoiner.go:870); that emission strategy is future work."""
+
+        def walk(n):
+            if isinstance(n, P.HashJoin):
+                if n.join_type in ("inner", "left"):
+                    self._check_one_build(n, read_ts)
+                walk(n.left)
+                walk(n.right)
+                return
+            for attr in ("child",):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    walk(c)
+
+        walk(node)
+
+    def _check_one_build(self, join, read_ts: Timestamp) -> None:
+        from ..sql.stats import _underlying_col
+        b = join.right
+        if not isinstance(b, P.Scan):
+            return
+        stored = []
+        computed = dict(b.computed)
+        for rk in join.right_keys:
+            sname = b.columns.get(rk)
+            if sname is None:
+                # computed key: a dictionary-code remap of a column is
+                # injective, so check the underlying column instead
+                inner = _underlying_col(computed.get(rk))
+                if inner is not None:
+                    sname = b.columns.get(inner.name)
+            if sname is None:
+                return  # cannot map back to storage; accept
+            stored.append(sname)
+        if not self.store.keys_unique_for_read(b.table, tuple(stored),
+                                               read_ts.to_int()):
+            # NB: checked at TABLE granularity — a build whose pushed
+            # filter would make the keys unique (latest-version-style
+            # predicates) is conservatively rejected too; filtered
+            # uniqueness needs host predicate evaluation (future work)
+            raise EngineError(
+                f"hash join build side {b.table!r} has duplicate join "
+                f"keys {stored}; make the uniquely-keyed table the "
+                "build side (duplicate-key build emission is not "
+                "supported yet)")
 
     def _dist_decision(self, node, session: Session):
         """Choose distributed (SPMD over the mesh) vs single-device —

@@ -207,6 +207,12 @@ class Explain(Statement):
 
 
 @dataclass
+class Analyze(Statement):
+    """ANALYZE <table> — collect table statistics (pkg/sql/stats)."""
+    table: str
+
+
+@dataclass
 class BeginTxn(Statement):
     pass
 

@@ -121,6 +121,9 @@ class Parser:
             self.next()
             analyze = self.accept_kw("analyze")
             return ast.Explain(self.parse_statement(), analyze=analyze)
+        if t.is_kw("analyze"):
+            self.next()
+            return ast.Analyze(self.expect_ident())
         if t.is_kw("begin"):
             self.next()
             self.accept_kw("transaction")
@@ -229,8 +232,9 @@ class Parser:
             raise ParseError(f"{t.text.upper()} JOIN not supported yet")
         if t.kind == Tok.OP and t.text == ",":
             nxt = self.peek(1)
-            # comma-join only when followed by a table name (not subquery)
-            if nxt.kind == Tok.IDENT:
+            # comma-join only when followed by a table name (not a
+            # subquery); keyword-named tables ("date" in SSB) allowed
+            if nxt.kind in (Tok.IDENT, Tok.KEYWORD):
                 self.next()
                 return "cross"
         return None

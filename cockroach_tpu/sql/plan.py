@@ -91,31 +91,44 @@ class OutputMeta:
     dictionaries: dict[str, object] = field(default_factory=dict)
 
 
-def plan_tree_repr(node: PlanNode, indent: int = 0) -> str:
+def plan_tree_repr(node: PlanNode, indent: int = 0,
+                   costs: dict | None = None) -> str:
+    """Render the plan tree; with ``costs`` (sql/stats.estimate output,
+    id(node) -> (est_rows, est_cost)) each line gets the optimizer's
+    cardinality/cost annotations, like EXPLAIN's estimated-row counts
+    in the reference."""
     pad = "  " * indent
+
+    def ann() -> str:
+        if costs is None or id(node) not in costs:
+            return ""
+        rows, cost = costs[id(node)]
+        return f"  (rows≈{rows:.0f} cost≈{cost:.0f})"
+
+    def child(n, extra_indent: int = 1) -> str:
+        return plan_tree_repr(n, indent + extra_indent, costs)
+
     if isinstance(node, Scan):
         f = f" filter={node.filter!r}" if node.filter is not None else ""
-        return f"{pad}Scan {node.table} as {node.alias}{f}\n"
+        return f"{pad}Scan {node.table} as {node.alias}{f}{ann()}\n"
     if isinstance(node, Filter):
-        return (f"{pad}Filter {node.pred!r}\n"
-                + plan_tree_repr(node.child, indent + 1))
+        return f"{pad}Filter {node.pred!r}{ann()}\n" + child(node.child)
     if isinstance(node, HashJoin):
         return (f"{pad}HashJoin[{node.join_type}] "
-                f"{node.left_keys}={node.right_keys}\n"
-                + plan_tree_repr(node.left, indent + 1)
-                + plan_tree_repr(node.right, indent + 1))
+                f"{node.left_keys}={node.right_keys}{ann()}\n"
+                + child(node.left) + child(node.right))
     if isinstance(node, Project):
-        return (f"{pad}Project {[n for n, _ in node.items]}\n"
-                + plan_tree_repr(node.child, indent + 1))
+        return (f"{pad}Project {[n for n, _ in node.items]}{ann()}\n"
+                + child(node.child))
     if isinstance(node, Aggregate):
         return (f"{pad}Aggregate groups={[n for n, _ in node.group_by]} "
-                f"aggs={[a.func for a in node.aggs]}\n"
-                + plan_tree_repr(node.child, indent + 1))
+                f"aggs={[a.func for a in node.aggs]}{ann()}\n"
+                + child(node.child))
     if isinstance(node, Sort):
-        return f"{pad}Sort {node.keys}\n" + plan_tree_repr(node.child, indent + 1)
+        return f"{pad}Sort {node.keys}{ann()}\n" + child(node.child)
     if isinstance(node, Limit):
-        return (f"{pad}Limit {node.limit} offset {node.offset}\n"
-                + plan_tree_repr(node.child, indent + 1))
+        return (f"{pad}Limit {node.limit} offset {node.offset}{ann()}\n"
+                + child(node.child))
     return f"{pad}{node!r}\n"
 
 

@@ -30,17 +30,29 @@ class PlanError(Exception):
     pass
 
 
-@dataclass
 class CatalogView:
-    """What the planner needs from the catalog: schema + dictionaries."""
-    schemas: dict[str, TableSchema]
-    dictionaries: dict[str, dict[str, object]]  # table -> col -> Dictionary
+    """What the planner needs from the catalog: schema + dictionaries
+    + table statistics (exact row counts; ANALYZE-computed distincts
+    when available — sql/stats.py). ``key_distinct_fn(table, cols) ->
+    (distinct, nonnull_rows)`` is the engine's exact uniqueness probe
+    (cached per generation); None when no store is attached."""
+
+    def __init__(self, schemas, dictionaries, stats=None,
+                 key_distinct_fn=None):
+        self.schemas = schemas
+        self.dictionaries = dictionaries
+        self.stats = stats or {}
+        self.key_distinct_fn = key_distinct_fn
 
     def schema(self, name: str) -> TableSchema:
         s = self.schemas.get(name)
         if s is None:
             raise PlanError(f"table {name!r} does not exist")
         return s
+
+    def row_count(self, name: str) -> float:
+        st = self.stats.get(name)
+        return float(st.row_count) if st is not None else 1000.0
 
 
 def split_conjuncts(e: BExpr) -> list[BExpr]:
@@ -60,6 +72,44 @@ def and_all(conjuncts: list[BExpr]) -> BExpr:
 class Planner:
     def __init__(self, catalog: CatalogView):
         self.catalog = catalog
+
+    def _keys_unique(self, cand_alias: str, cand_table: str, pool,
+                     other_side: set, _key_side, scans) -> bool:
+        """Would ``cand_alias`` have unique join keys as a build side?
+        Collect its side of the equality conjuncts against
+        ``other_side`` and run the catalog's exact distinct probe.
+        Conservative: unknown/computed keys or no probe -> False."""
+        fn = self.catalog.key_distinct_fn
+        if fn is None:
+            return False
+        stored = []
+        colmap = scans[cand_alias].columns
+        for c in pool:
+            if not (isinstance(c, BBin) and c.op == "="):
+                continue
+            ta, na, ea = _key_side(c.left)
+            tb, nb, eb = _key_side(c.right)
+            cand_name = None
+            if ta == cand_alias and tb in other_side:
+                cand_name, cand_expr = na, ea
+            elif tb == cand_alias and ta in other_side:
+                cand_name, cand_expr = nb, eb
+            else:
+                continue
+            if cand_name is None:
+                # dictionary-remapped key: the remap is injective, so
+                # the underlying column's distinctness carries over
+                from .stats import _underlying_col
+                inner = _underlying_col(cand_expr)
+                cand_name = getattr(inner, "name", None)
+            sname = colmap.get(cand_name) if cand_name else None
+            if sname is None:
+                return False
+            stored.append(sname)
+        if not stored:
+            return False
+        distinct, nonnull = fn(cand_table, tuple(stored))
+        return distinct == nonnull
 
     def plan_select(self, sel: ast.Select) -> tuple[plan.PlanNode, plan.OutputMeta]:
         if sel.table is None:
@@ -116,6 +166,7 @@ class Planner:
         # table is a build side with equality keys from ON + WHERE.
         joined = {tables[0][0]}
         node: plan.PlanNode = scans[tables[0][0]]
+        probe_root = tables[0][0]  # updated if the build-side swap fires
         remaining_conjuncts = list(conjuncts)
 
         jk_counter = [0]
@@ -161,6 +212,73 @@ class Planner:
         ordered = []  # (alias, join_type, on_conjuncts)
         for alias, jt, on in explicit_joins:
             ordered.append((alias, jt, split_conjuncts(on) if on is not None else []))
+
+        def _has_equi_keys(pool, left_tables: set, right: str) -> bool:
+            """Dry-run of extract_equi_keys (no computed-key naming)."""
+            for c in pool:
+                if not (isinstance(c, BBin) and c.op == "="):
+                    continue
+                ta, _, _ = _key_side(c.left)
+                tb, _, _ = _key_side(c.right)
+                if ta is None or tb is None:
+                    continue
+                if ((ta in left_tables and tb == right)
+                        or (tb in left_tables and ta == right)):
+                    return True
+            return False
+
+        alias_table = dict(tables)
+
+        def _rc(alias: str) -> float:
+            return self.catalog.row_count(alias_table[alias])
+
+        # Stats-driven join ordering (VERDICT #10; the memo/xform
+        # search of opt/xform/optimizer.go:239 is later-round work):
+        # when every join is INNER/cross, greedily build against the
+        # smallest joinable table next — smaller build sides mean
+        # smaller device hash tables and fewer gathered columns.
+        if ordered and all(jt in ("inner", "cross")
+                           for _, jt, _ in ordered):
+            remaining = list(ordered)
+            reordered = []
+            sim_joined = set(joined)
+            pool_all = list(conjuncts)
+            ok = True
+            while remaining:
+                joinable = [
+                    e for e in remaining
+                    if _has_equi_keys(e[2] + pool_all, sim_joined, e[0])]
+                if not joinable:
+                    ok = False  # fall back to syntax order
+                    break
+                pick = min(joinable, key=lambda e: _rc(e[0]))
+                reordered.append(pick)
+                remaining.remove(pick)
+                sim_joined.add(pick[0])
+            if ok:
+                ordered = reordered
+            # Build-side selection for the FIRST join: hash joins want
+            # the SMALL side as the build, but a build's keys must be
+            # unique (ops/join.py) — so only swap when the smaller
+            # side's keys are verified unique via the store's exact
+            # probe. If the syntax probe (root) is the smaller side,
+            # swap roles.
+            if ordered:
+                first_alias, first_jt, first_on = ordered[0]
+                root = tables[0][0]
+                # a zero row count means "no local data here" (e.g. a
+                # DistSQL gateway whose rows live on data nodes), not
+                # "empty table" — no signal, keep syntax order
+                if (first_jt in ("inner", "cross")
+                        and 0 < _rc(root) < _rc(first_alias)
+                        and self._keys_unique(
+                            root, alias_table[root],
+                            first_on + conjuncts, {first_alias},
+                            _key_side, scans)):
+                    node = scans[first_alias]
+                    joined = {first_alias}
+                    ordered[0] = (root, first_jt, first_on)
+                    probe_root = first_alias
 
         for alias, jt, on_conj in ordered:
             # LEFT JOIN must not consume WHERE conjuncts as join keys —
@@ -212,7 +330,7 @@ class Planner:
             remaining_conjuncts.extend(residual)
 
         # remaining single-table conjuncts on the probe root push into scan
-        root_alias = tables[0][0]
+        root_alias = probe_root
         root_local = [c for c in remaining_conjuncts
                       if tables_of(c) <= {root_alias}]
         for c in root_local:

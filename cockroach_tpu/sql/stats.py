@@ -1,0 +1,165 @@
+"""Table statistics + a simple cost model for the planner.
+
+The analogue of pkg/sql/stats (table statistics + histograms feeding
+the optimizer's costing, opt/memo/statistics_builder.go). ANALYZE
+<table> computes exact per-column distinct counts and null fractions
+over the live rows (our tables are host-resident columns, so "exact"
+is one np.unique per column — the reference samples because its data
+lives behind the KV API). Row counts are always exact and free.
+
+The cost model is deliberately small: cardinality estimates drive two
+real decisions — hash-join build-side selection and the EXPLAIN cost
+column — matching the round-2 goal (VERDICT #10), not the reference's
+full memo/xform search (opt/xform/optimizer.go:239, later rounds).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import plan as P
+
+# default selectivities when no stats apply (the reference's
+# unknownFilterSelectivity-style constants, statistics_builder.go)
+SEL_EQ = 0.1
+SEL_RANGE = 1.0 / 3.0
+SEL_OTHER = 0.5
+
+
+@dataclass
+class TableStats:
+    row_count: int = 0
+    distinct: dict = field(default_factory=dict)   # col -> n distinct
+    null_frac: dict = field(default_factory=dict)  # col -> fraction
+    analyzed: bool = False
+
+
+def analyze_columns(td) -> TableStats:
+    """Exact stats over a table's live rows (ANALYZE)."""
+    from ..storage.columnstore import MAX_TS_INT
+
+    st = TableStats(analyzed=True)
+    total = 0
+    parts: dict[str, list] = {c.name: [] for c in td.schema.columns}
+    nulls: dict[str, int] = {c.name: 0 for c in td.schema.columns}
+    for chunk in td.chunks:
+        live = chunk.mvcc_del == MAX_TS_INT
+        total += int(live.sum())
+        for col in td.schema.columns:
+            cn = col.name
+            v = chunk.valid[cn][live]
+            d = chunk.data[cn][live]
+            nulls[cn] += int((~v).sum())
+            parts[cn].append(d[v])
+    st.row_count = total
+    for cn, ps in parts.items():
+        arr = np.concatenate(ps) if ps else np.zeros(0)
+        st.distinct[cn] = int(len(np.unique(arr))) if arr.size else 0
+        st.null_frac[cn] = nulls[cn] / total if total else 0.0
+    return st
+
+
+def _underlying_col(e):
+    """Peel wrappers (dict remaps, casts) down to a column reference."""
+    from .bound import BCol
+    seen = 0
+    while e is not None and not isinstance(e, BCol) and seen < 8:
+        e = getattr(e, "expr", None)
+        seen += 1
+    return e if isinstance(e, BCol) else None
+
+
+def _col_distinct(name: str, stats: TableStats | None):
+    if stats is None:
+        return None
+    # bound columns are alias-qualified ("lineitem.l_returnflag");
+    # stats key on stored names
+    return (stats.distinct.get(name)
+            or stats.distinct.get(name.split(".")[-1]))
+
+
+def _pred_selectivity(e, stats: TableStats | None) -> float:
+    """Selectivity of one bound predicate expression."""
+    from .bound import BBin
+
+    if isinstance(e, BBin):
+        if e.op == "and":
+            return (_pred_selectivity(e.left, stats)
+                    * _pred_selectivity(e.right, stats))
+        if e.op == "or":
+            a = _pred_selectivity(e.left, stats)
+            b = _pred_selectivity(e.right, stats)
+            return min(1.0, a + b)
+        if e.op == "=":
+            col = _underlying_col(e.left) or _underlying_col(e.right)
+            nd = _col_distinct(col.name, stats) if col is not None else None
+            if nd:
+                return 1.0 / nd
+            return SEL_EQ
+        if e.op in ("<", "<=", ">", ">="):
+            return SEL_RANGE
+    return SEL_OTHER
+
+
+def scan_rows(node: P.Scan, stats_map: dict) -> float:
+    st = stats_map.get(node.table)
+    rows = float(st.row_count) if st else 1000.0
+    if node.filter is not None:
+        rows *= _pred_selectivity(node.filter, st)
+    return max(rows, 1.0)
+
+
+def estimate(node: P.PlanNode, stats_map: dict) -> dict:
+    """Bottom-up (est_rows, est_cost) per plan node, keyed by id().
+
+    Costs are abstract row-touch units: scan = rows, filter = input
+    rows, hash join = probe + build (build pays a table-build
+    surcharge), aggregate = input + groups, sort = n log n.
+    """
+    out: dict[int, tuple[float, float]] = {}
+
+    def walk(n) -> tuple[float, float]:
+        if isinstance(n, P.Scan):
+            st = stats_map.get(n.table)
+            raw = float(st.row_count) if st else 1000.0
+            rows = scan_rows(n, stats_map)
+            r = (rows, raw)
+        elif isinstance(n, P.Filter):
+            crows, ccost = walk(n.child)
+            st = None
+            rows = crows * _pred_selectivity(n.pred, st)
+            r = (max(rows, 1.0), ccost + crows)
+        elif isinstance(n, P.HashJoin):
+            prows, pcost = walk(n.left)
+            brows, bcost = walk(n.right)
+            # PK-FK: each probe row matches <= 1 build row
+            rows = prows if n.join_type in ("inner", "left",
+                                            "semi") else prows * 0.5
+            r = (max(rows, 1.0), pcost + bcost + prows + 2.0 * brows)
+        elif isinstance(n, P.Aggregate):
+            crows, ccost = walk(n.child)
+            groups = (min(float(n.max_groups), crows) if n.max_groups
+                      else min(crows, 1 << 17) * 0.1)
+            r = (max(groups if n.group_by else 1.0, 1.0),
+                 ccost + crows + groups)
+        elif isinstance(n, P.Project):
+            crows, ccost = walk(n.child)
+            r = (crows, ccost + crows)
+        elif isinstance(n, P.Sort):
+            crows, ccost = walk(n.child)
+            r = (crows, ccost + crows * max(np.log2(max(crows, 2.0)), 1.0))
+        elif isinstance(n, P.Limit):
+            crows, ccost = walk(n.child)
+            rows = crows
+            if n.limit is not None:
+                rows = min(crows, float(n.limit))
+            r = (rows, ccost + crows)
+        else:
+            r = (1.0, 1.0)
+        out[id(n)] = r
+        return r
+
+    walk(node)
+    return out

@@ -106,6 +106,14 @@ class TableData:
     # pk-key bytes -> (chunk_index, row_index) of the LIVE version.
     # Built lazily on first transactional DML; None = not built.
     pk_index: Optional[dict] = None
+    # ANALYZE output (sql/stats.py TableStats) + the generation it was
+    # computed at; stale stats still inform the planner (estimates),
+    # exact row_count always comes from row_count
+    stats: Optional[object] = None
+    stats_generation: int = -1
+    # cached multi-column distinct counts for join-uniqueness checks:
+    # (cols tuple) -> (generation, distinct, live_rows)
+    key_distinct_cache: dict = field(default_factory=dict)
 
     @property
     def row_count(self) -> int:
@@ -163,11 +171,23 @@ class ColumnStore:
         return td
 
     # -- ingest ------------------------------------------------------------
+    def set_dictionary(self, name: str, col: str, values) -> None:
+        """Pre-seed a string column's dictionary so bulk ingest can pass
+        already-encoded int32 codes (the big-data path: encoding 600M
+        object strings through np.unique would dominate ingest)."""
+        d = self.table(name).dictionaries[col]
+        for v in values:
+            d.encode(v)
+
     def insert_columns(self, name: str, cols: dict[str, np.ndarray],
                        ts: Timestamp,
                        valid: Optional[dict[str, np.ndarray]] = None) -> int:
         """Bulk columnar ingest (IMPORT path; one sealed chunk per call,
-        the analogue of AddSSTable ingestion in pkg/sql/importer)."""
+        the analogue of AddSSTable ingestion in pkg/sql/importer).
+
+        String columns accept either string arrays (dictionary-encoded
+        here) or int32 code arrays into a dictionary pre-seeded via
+        set_dictionary."""
         td = self.table(name)
         valid = valid or {}
         n = len(next(iter(cols.values())))
@@ -185,6 +205,14 @@ class ColumnStore:
                 raw = cols[cn]
                 if col.type.family == Family.STRING and raw.dtype.kind in ("U", "O", "S"):
                     arr = td.dictionaries[cn].encode_array(raw)
+                elif (col.type.family == Family.STRING
+                      and raw.dtype.kind in ("i", "u")):
+                    arr = np.asarray(raw, dtype=np.int32)
+                    if arr.size and (int(arr.max()) >= len(td.dictionaries[cn])
+                                     or int(arr.min()) < 0):
+                        raise ValueError(
+                            f"encoded codes for {cn} out of dictionary "
+                            f"range (seed it with set_dictionary first)")
                 elif col.type.family == Family.DECIMAL and raw.dtype.kind == "f":
                     arr = np.round(raw * (10 ** col.type.scale)).astype(np.int64)
                 else:
@@ -436,6 +464,84 @@ class ColumnStore:
         r = td.next_rowid
         td.next_rowid += 1
         return r
+
+    # -- statistics ----------------------------------------------------------
+    def analyze(self, name: str):
+        """ANALYZE: exact per-column stats over live rows (sql/stats)."""
+        from ..sql.stats import analyze_columns
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            td.stats = analyze_columns(td)
+            td.stats_generation = td.generation
+            return td.stats
+
+    def _distinct_under(self, td: TableData, cols: tuple,
+                        row_mask_fn) -> tuple[int, int]:
+        """(distinct combined-key count, non-NULL-key row count) over
+        rows selected by row_mask_fn(chunk) -> bool mask."""
+        parts = []
+        nonnull_rows = 0
+        for chunk in td.chunks:
+            sel = row_mask_fn(chunk)
+            arrs = [chunk.data[c][sel] for c in cols]
+            vals = [chunk.valid[c][sel] for c in cols]
+            # NULL keys never join; exclude them from uniqueness
+            ok = np.ones(int(sel.sum()), dtype=bool)
+            for v in vals:
+                ok &= v
+            nonnull_rows += int(ok.sum())
+            parts.append(np.stack([a[ok] for a in arrs], axis=1)
+                         if arrs else np.zeros((0, 0)))
+        if parts and sum(p.shape[0] for p in parts):
+            allk = np.concatenate(parts, axis=0)
+            distinct = int(len(np.unique(allk, axis=0)))
+        else:
+            distinct = 0
+        return distinct, nonnull_rows
+
+    def key_distinct(self, name: str, cols: tuple) -> tuple[int, int]:
+        """(distinct combined-key count, non-NULL-key live row count)
+        over CURRENTLY-live rows — the planner's build-side swap
+        heuristic. Cached per table generation. For the correctness
+        guard use keys_unique_for_read (snapshot-aware)."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            hit = td.key_distinct_cache.get(cols)
+            if hit is not None and hit[0] == td.generation:
+                return hit[1], hit[2]
+            distinct, nonnull = self._distinct_under(
+                td, cols, lambda c: c.mvcc_del == MAX_TS_INT)
+            td.key_distinct_cache[cols] = (td.generation, distinct,
+                                           nonnull)
+            return distinct, nonnull
+
+    def keys_unique_for_read(self, name: str, cols: tuple,
+                             read_ts_int: int) -> bool:
+        """Snapshot-aware uniqueness: are the keys unique among the
+        rows VISIBLE at read_ts (the rows a scan at that timestamp
+        joins)? Two tiers: if keys are unique across ALL versions
+        (cacheable per generation — every snapshot is a subset, so any
+        snapshot is unique too), accept without looking at the
+        timestamp; otherwise compute at the exact snapshot (tables
+        with updated rows pay this per distinct read_ts)."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            allkey = ("__allversions__",) + cols
+            hit = td.key_distinct_cache.get(allkey)
+            if hit is None or hit[0] != td.generation:
+                d, n = self._distinct_under(
+                    td, cols, lambda c: np.ones(c.n, dtype=bool))
+                td.key_distinct_cache[allkey] = (td.generation, d, n)
+            else:
+                _, d, n = hit
+            if d == n:
+                return True
+            d, n = self._distinct_under(
+                td, cols, lambda c: c.live_mask(read_ts_int))
+            return d == n
 
     # -- GC ------------------------------------------------------------------
     def gc(self, name: str, threshold: Timestamp) -> int:
