@@ -1,0 +1,111 @@
+"""SQL-on-ranges integration: table rows on raft ranges feeding SQL.
+
+The VERDICT round-1 done-bar for unifying the two stacks, part (b):
+a multi-node test where table rows live on raft-replicated ranges,
+DistSQL-style partitioning assigns spans by range leaseholder, and a
+node kill does not lose committed rows. Reference path:
+cfetcher.go:668 -> kv_batch_fetcher.go:107 -> DistSender -> ranges;
+placement via PartitionSpans (distsql_physical_planner.go:1096).
+"""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.kv.rowfetch import RangeTable
+from cockroach_tpu.kvserver.cluster import Cluster
+from cockroach_tpu.sql import parser
+from cockroach_tpu.sql.types import TableSchema
+from cockroach_tpu.storage import keys
+
+
+def _schema() -> TableSchema:
+    eng = Engine()
+    eng.execute("CREATE TABLE acct (id INT8 NOT NULL PRIMARY KEY, "
+                "bal INT8 NOT NULL, region STRING)")
+    return eng.store.table("acct").schema
+
+
+ROWS = [{"id": i, "bal": 100 + i, "region": "eu" if i % 2 else "us"}
+        for i in range(120)]
+
+
+@pytest.fixture()
+def cluster_table():
+    cluster = Cluster(n_nodes=4)
+    schema = _schema()
+    rt = RangeTable(cluster, schema)
+    lo, hi = rt.codec.span()
+    cluster.create_range(lo, hi, replicas=[1, 2, 3])
+    cluster.pump_until(lambda: cluster.ensure_lease(1) is not None)
+    rt.insert_rows(ROWS)
+    return cluster, rt
+
+
+class TestSQLOnRanges:
+    def test_rows_roundtrip_through_ranges(self, cluster_table):
+        cluster, rt = cluster_table
+        rows = rt.fetch_rows()
+        assert len(rows) == 120
+        assert {r["id"] for r in rows} == set(range(120))
+        assert rows[7]["bal"] == 107 and rows[7]["region"] == "eu"
+
+    def test_materialize_and_query(self, cluster_table):
+        cluster, rt = cluster_table
+        eng = Engine()
+        n = rt.materialize_into(eng)
+        assert n == 120
+        r = eng.execute("SELECT region, sum(bal) AS s, count(*) AS c "
+                        "FROM acct GROUP BY region ORDER BY region")
+        want_eu = sum(100 + i for i in range(120) if i % 2)
+        want_us = sum(100 + i for i in range(120) if not i % 2)
+        assert r.rows == [("eu", want_eu, 60), ("us", want_us, 60)]
+
+    def test_partition_spans_by_leaseholder(self, cluster_table):
+        """Split the table's span and move a lease: partitioning must
+        follow the leaseholders, and per-partition fetches must
+        exactly tile the table."""
+        cluster, rt = cluster_table
+        mid = rt.codec.key_from_pk((60,))
+        cluster.split_range(mid)
+        # move the second range's lease to node 2
+        d2 = cluster.range_for_key(mid)
+        cluster.acquire_lease(d2.range_id, 2)
+        parts = rt.partition_spans()
+        assert sum(len(v) for v in parts.values()) >= 2
+        # each node materializes ONLY its leaseholder partition; the
+        # union of all partitions is the full table, disjointly
+        seen = []
+        for nid, spans in parts.items():
+            eng = Engine()
+            rt.materialize_into(eng, spans=spans)
+            seen.extend(eng.execute("SELECT id FROM acct").column("id"))
+        assert sorted(seen) == list(range(120))
+
+    def test_node_kill_preserves_committed_rows(self, cluster_table):
+        """The headline: kill the leaseholder; a survivor acquires the
+        lease and every committed row is still served."""
+        cluster, rt = cluster_table
+        d = cluster.range_for_key(rt.codec.span()[0])
+        holder = cluster.leaseholder(d.range_id)
+        assert holder is not None
+        cluster.stop_node(holder)
+        # wait out the dead holder's liveness epoch; the next read
+        # re-acquires the lease on a survivor via ensure_lease
+        cluster.pump(cluster.liveness.ttl + 2)
+        eng = Engine()
+        n = rt.materialize_into(eng)
+        assert n == 120
+        r = eng.execute("SELECT count(*) AS c, sum(bal) AS s FROM acct")
+        assert r.rows == [(120, sum(100 + i for i in range(120)))]
+
+    def test_write_after_failover_visible(self, cluster_table):
+        cluster, rt = cluster_table
+        d = cluster.range_for_key(rt.codec.span()[0])
+        holder = cluster.leaseholder(d.range_id)
+        cluster.stop_node(holder)
+        cluster.pump(cluster.liveness.ttl + 2)
+        rt.insert_rows([{"id": 1000, "bal": 1, "region": "ap"}])
+        eng = Engine()
+        assert rt.materialize_into(eng) == 121
+        assert eng.execute(
+            "SELECT bal FROM acct WHERE id = 1000").rows == [(1,)]
