@@ -19,17 +19,23 @@ A from-scratch rebuild of the capabilities of CockroachDB (reference:
 Layer map (mirrors SURVEY.md §1):
 
     sql/        parser, AST, semantic analysis, logical planner
-    exec/       logical plan -> compiled JAX program (the "colexec")
+    exec/       logical plan -> compiled JAX program (the "colexec"):
+                streaming beyond-HBM scans, hash-partitioned spill
     ops/        device columnar core: ColumnBatch, kernels, agg, join
     storage/    host columnar MVCC store + memtable/LSM + HLC
-    kv/         transactional KV client (txn coordinator, latches)
+    kv/         transactional KV client (txn coordinator, latches,
+                DistSender + range cache)
+    kvserver/   ranges: raft, leases, liveness, splits/merges, queues
     parallel/   mesh partitioning, shard_map flows, collectives
-    server/     session/connExecutor-analogue + wire protocol
+    distsql/    cross-node flow runtime (specs, registry, outbox/inbox)
+    server/     node lifecycle + pgwire v3 wire protocol
+    jobs/       durable job registry, checkpoint/resume, IMPORT
     models/     flagship query "models" (TPC-H workloads) for bench
-    utils/      settings, metrics, tracing, errors
+    utils/      settings
+    cli.py      cockroach-tpu start / sql / demo
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # The engine's physical types require 64-bit lanes (HLC timestamps and
 # scaled-decimal int64 accumulation); JAX disables x64 by default.

@@ -250,6 +250,21 @@ def cmd_demo(args) -> int:
     return rc
 
 
+def cmd_workload(args) -> int:
+    import json
+
+    from .exec.engine import Engine
+    from .workload import WORKLOADS
+
+    eng = Engine()
+    cls = WORKLOADS[args.name]
+    wl = cls(eng.kv if args.name == "kv" else eng)
+    wl.setup()
+    out = wl.run(steps=args.steps)
+    print(json.dumps(out, default=str))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="cockroach-tpu",
@@ -271,6 +286,12 @@ def main(argv=None) -> int:
                                          "TPC-H data")
     p_demo.add_argument("--sf", type=float, default=0.01)
     p_demo.set_defaults(fn=cmd_demo)
+
+    p_wl = sub.add_parser("workload", help="run a load generator "
+                                           "(bank|kv|ycsb|ssb)")
+    p_wl.add_argument("name", choices=["bank", "kv", "ycsb", "ssb"])
+    p_wl.add_argument("--steps", type=int, default=100)
+    p_wl.set_defaults(fn=cmd_workload)
 
     p_ver = sub.add_parser("version", help="print version")
     p_ver.set_defaults(fn=lambda a: (print(f"cockroach-tpu v{__version__} "

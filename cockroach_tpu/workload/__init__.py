@@ -1,0 +1,22 @@
+"""Workload generators (reference: pkg/workload — tpcc, tpch, ycsb,
+kv, bank, movr...). Each workload is a library object with ``setup``
+(schema + initial data) and ``run`` (a step loop reporting ops/s),
+runnable via ``cockroach-tpu workload run <name>`` or in tests.
+
+TPC-H lives in models/tpch.py (it doubles as the bench's flagship
+"model"); this package holds the OLTP/operational generators and SSB.
+"""
+
+from .bank import Bank
+from .kvload import KVLoad
+from .ssb import SSB
+from .ycsb import YCSB
+
+WORKLOADS = {
+    "bank": Bank,
+    "kv": KVLoad,
+    "ycsb": YCSB,
+    "ssb": SSB,
+}
+
+__all__ = ["Bank", "KVLoad", "YCSB", "SSB", "WORKLOADS"]

@@ -1,0 +1,278 @@
+"""SSB — the Star Schema Benchmark (O'Neil et al), named in
+BASELINE.md's bench ladder. A lineorder fact table joined against
+date/part/supplier/customer dimensions; flights Q1 (restrictive scan),
+Q2 (brand rollup), Q3 (customer/supplier geography), Q4 (profit).
+
+Mirrors the reference's workload-generator shape
+(pkg/workload/tpch/tpch.go style): seeded numpy columns with the
+spec's value domains, DDL, query texts, and numpy oracles for
+correctness gating.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LINEORDER_PER_SF = 6_000_000
+
+DDL = {
+    "date": """
+CREATE TABLE date (
+    d_datekey   INT8 NOT NULL PRIMARY KEY,
+    d_year      INT8 NOT NULL,
+    d_yearmonth STRING NOT NULL,
+    d_weeknum   INT8 NOT NULL
+)""",
+    "supplier": """
+CREATE TABLE supplier (
+    s_suppkey INT8 NOT NULL PRIMARY KEY,
+    s_city    STRING NOT NULL,
+    s_nation  STRING NOT NULL,
+    s_region  STRING NOT NULL
+)""",
+    "part_ssb": """
+CREATE TABLE part_ssb (
+    p_partkey  INT8 NOT NULL PRIMARY KEY,
+    p_mfgr     STRING NOT NULL,
+    p_category STRING NOT NULL,
+    p_brand1   STRING NOT NULL
+)""",
+    "customer": """
+CREATE TABLE customer (
+    c_custkey INT8 NOT NULL PRIMARY KEY,
+    c_city    STRING NOT NULL,
+    c_nation  STRING NOT NULL,
+    c_region  STRING NOT NULL
+)""",
+    "lineorder": """
+CREATE TABLE lineorder (
+    lo_orderkey      INT8 NOT NULL,
+    lo_custkey       INT8 NOT NULL,
+    lo_partkey       INT8 NOT NULL,
+    lo_suppkey       INT8 NOT NULL,
+    lo_orderdate     INT8 NOT NULL,
+    lo_quantity      INT8 NOT NULL,
+    lo_extendedprice INT8 NOT NULL,
+    lo_discount      INT8 NOT NULL,
+    lo_revenue       INT8 NOT NULL,
+    lo_supplycost    INT8 NOT NULL
+)""",
+}
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = {r: [f"{r[:3]}_NATION{i}" for i in range(5)] for r in REGIONS}
+MFGRS = [f"MFGR#{i}" for i in range(1, 6)]
+
+
+def _dates():
+    """The 7-year date dim 1992-1998 (one row per day, datekey
+    yyyymmdd)."""
+    import datetime
+    days = []
+    d = datetime.date(1992, 1, 1)
+    while d <= datetime.date(1998, 12, 31):
+        days.append(d)
+        d += datetime.timedelta(days=1)
+    return days
+
+
+def gen_dims(sf: float, seed: int = 1) -> dict:
+    rng = np.random.default_rng(seed)
+    days = _dates()
+    date = {
+        "d_datekey": np.array([d.year * 10000 + d.month * 100 + d.day
+                               for d in days], dtype=np.int64),
+        "d_year": np.array([d.year for d in days], dtype=np.int64),
+        "d_yearmonth": np.array([f"{d.year}{d.month:02d}" for d in days],
+                                dtype=object),
+        "d_weeknum": np.array([d.isocalendar()[1] for d in days],
+                              dtype=np.int64),
+    }
+    ns = max(int(2_000 * max(sf, 0.01)), 20)
+    s_region = rng.choice(REGIONS, size=ns)
+    supplier = {
+        "s_suppkey": np.arange(1, ns + 1, dtype=np.int64),
+        "s_city": np.array([f"{r[:4]}CITY{rng.integers(0, 10)}"
+                            for r in s_region], dtype=object),
+        "s_nation": np.array([rng.choice(NATIONS[r]) for r in s_region],
+                             dtype=object),
+        "s_region": s_region.astype(object),
+    }
+    npart = max(int(200_000 * max(sf, 0.001)), 200)
+    mfgr = rng.choice(MFGRS, size=npart)
+    cat = np.array([f"{m}{rng.integers(1, 6)}" for m in mfgr], dtype=object)
+    part = {
+        "p_partkey": np.arange(1, npart + 1, dtype=np.int64),
+        "p_mfgr": mfgr.astype(object),
+        "p_category": cat,
+        "p_brand1": np.array([f"{c}{rng.integers(1, 41)}" for c in cat],
+                             dtype=object),
+    }
+    nc = max(int(30_000 * max(sf, 0.001)), 30)
+    c_region = rng.choice(REGIONS, size=nc)
+    customer = {
+        "c_custkey": np.arange(1, nc + 1, dtype=np.int64),
+        "c_city": np.array([f"{r[:4]}CITY{rng.integers(0, 10)}"
+                            for r in c_region], dtype=object),
+        "c_nation": np.array([rng.choice(NATIONS[r]) for r in c_region],
+                             dtype=object),
+        "c_region": c_region.astype(object),
+    }
+    return {"date": date, "supplier": supplier, "part_ssb": part,
+            "customer": customer}
+
+
+def gen_lineorder(sf: float, dims: dict, seed: int = 0,
+                  rows: int | None = None) -> dict:
+    n = rows if rows is not None else int(LINEORDER_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    datekeys = dims["date"]["d_datekey"]
+    quantity = rng.integers(1, 51, size=n).astype(np.int64)
+    eprice = rng.integers(90_000, 10_000_000, size=n).astype(np.int64)
+    discount = rng.integers(0, 11, size=n).astype(np.int64)
+    revenue = eprice * (100 - discount) // 100
+    return {
+        "lo_orderkey": np.arange(1, n + 1, dtype=np.int64),
+        "lo_custkey": rng.integers(
+            1, len(dims["customer"]["c_custkey"]) + 1, size=n
+        ).astype(np.int64),
+        "lo_partkey": rng.integers(
+            1, len(dims["part_ssb"]["p_partkey"]) + 1, size=n
+        ).astype(np.int64),
+        "lo_suppkey": rng.integers(
+            1, len(dims["supplier"]["s_suppkey"]) + 1, size=n
+        ).astype(np.int64),
+        "lo_orderdate": rng.choice(datekeys, size=n).astype(np.int64),
+        "lo_quantity": quantity,
+        "lo_extendedprice": eprice,
+        "lo_discount": discount,
+        "lo_revenue": revenue,
+        "lo_supplycost": (eprice * 6 // 10),
+    }
+
+
+def load(engine, sf: float = 0.01, seed: int = 0,
+         rows: int | None = None) -> dict:
+    dims = gen_dims(sf, seed=seed + 1)
+    lo = gen_lineorder(sf, dims, seed=seed, rows=rows)
+    ts = engine.clock.now()
+    for name, ddl in DDL.items():
+        engine.execute(ddl)
+        engine.store.insert_columns(
+            name, dims[name] if name != "lineorder" else lo, ts)
+    return {"dims": dims, "lineorder": lo}
+
+
+# -- queries (texts follow the SSB spec) -------------------------------------
+
+Q1_1 = """
+SELECT sum(lo_extendedprice * lo_discount) AS revenue
+FROM lineorder, date
+WHERE lo_orderdate = d_datekey AND d_year = 1993
+  AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25
+"""
+
+Q1_2 = """
+SELECT sum(lo_extendedprice * lo_discount) AS revenue
+FROM lineorder, date
+WHERE lo_orderdate = d_datekey AND d_yearmonth = '199401'
+  AND lo_discount BETWEEN 4 AND 6
+  AND lo_quantity >= 26 AND lo_quantity <= 35
+"""
+
+Q2_1 = """
+SELECT d_year, p_brand1, sum(lo_revenue) AS revenue
+FROM lineorder, date, part_ssb, supplier
+WHERE lo_orderdate = d_datekey AND lo_partkey = p_partkey
+  AND lo_suppkey = s_suppkey
+  AND p_category = 'MFGR#12' AND s_region = 'AMERICA'
+GROUP BY d_year, p_brand1
+ORDER BY d_year, p_brand1
+"""
+
+Q3_1 = """
+SELECT c_nation, s_nation, d_year, sum(lo_revenue) AS revenue
+FROM lineorder, customer, supplier, date
+WHERE lo_custkey = c_custkey AND lo_suppkey = s_suppkey
+  AND lo_orderdate = d_datekey
+  AND c_region = 'ASIA' AND s_region = 'ASIA'
+  AND d_year >= 1992 AND d_year <= 1997
+GROUP BY c_nation, s_nation, d_year
+ORDER BY d_year, revenue DESC
+"""
+
+Q4_1 = """
+SELECT d_year, c_nation,
+       sum(lo_revenue - lo_supplycost) AS profit
+FROM lineorder, customer, supplier, date
+WHERE lo_custkey = c_custkey AND lo_suppkey = s_suppkey
+  AND lo_orderdate = d_datekey
+  AND c_region = 'AMERICA' AND s_region = 'AMERICA'
+GROUP BY d_year, c_nation
+ORDER BY d_year, c_nation
+"""
+
+QUERIES = {"q1.1": Q1_1, "q1.2": Q1_2, "q2.1": Q2_1, "q3.1": Q3_1,
+           "q4.1": Q4_1}
+
+
+# -- numpy oracles -----------------------------------------------------------
+
+def _dim_lookup(dims, table, key_col, val_col):
+    keys = dims[table][key_col]
+    vals = dims[table][val_col]
+    return dict(zip(keys.tolist(), vals.tolist()))
+
+
+def ref_q1_1(lo: dict, dims: dict) -> int:
+    year = _dim_lookup(dims, "date", "d_datekey", "d_year")
+    yr = np.array([year[k] for k in lo["lo_orderdate"].tolist()])
+    m = ((yr == 1993) & (lo["lo_discount"] >= 1) & (lo["lo_discount"] <= 3)
+         & (lo["lo_quantity"] < 25))
+    return int((lo["lo_extendedprice"][m] * lo["lo_discount"][m]).sum())
+
+
+def ref_q2_1(lo: dict, dims: dict) -> list[tuple]:
+    year = _dim_lookup(dims, "date", "d_datekey", "d_year")
+    cat = _dim_lookup(dims, "part_ssb", "p_partkey", "p_category")
+    brand = _dim_lookup(dims, "part_ssb", "p_partkey", "p_brand1")
+    sreg = _dim_lookup(dims, "supplier", "s_suppkey", "s_region")
+    out: dict[tuple, int] = {}
+    od, pk, sk = (lo["lo_orderdate"].tolist(), lo["lo_partkey"].tolist(),
+                  lo["lo_suppkey"].tolist())
+    rev = lo["lo_revenue"].tolist()
+    for i in range(len(od)):
+        if cat[pk[i]] != "MFGR#12" or sreg[sk[i]] != "AMERICA":
+            continue
+        key = (year[od[i]], brand[pk[i]])
+        out[key] = out.get(key, 0) + rev[i]
+    return sorted((y, b, r) for (y, b), r in out.items())
+
+
+class SSB:
+    """Workload-registry wrapper: load + run the query flights."""
+
+    name = "ssb"
+
+    def __init__(self, engine, sf: float = 0.01, seed: int = 0,
+                 rows: int | None = None):
+        self.engine = engine
+        self.sf = sf
+        self.seed = seed
+        self.rows = rows
+        self.data = None
+
+    def setup(self) -> None:
+        self.data = load(self.engine, self.sf, seed=self.seed,
+                         rows=self.rows)
+
+    def run(self, steps: int = 1) -> dict:
+        import time
+        out = {}
+        for name, sql in QUERIES.items():
+            t0 = time.monotonic()
+            for _ in range(steps):
+                r = self.engine.execute(sql)
+            out[name] = {"rows": len(r.rows),
+                         "seconds": (time.monotonic() - t0) / steps}
+        return out

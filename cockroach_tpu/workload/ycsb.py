@@ -1,0 +1,109 @@
+"""YCSB core workloads A-F against the SQL engine.
+
+Mirrors pkg/workload/ycsb/ycsb.go:118: a usertable of (key, fields),
+zipfian-or-uniform key selection, per-workload operation mixes:
+
+  A: 50% read / 50% update        D: 95% read / 5% insert (latest)
+  B: 95% read / 5% update         E: 95% scan / 5% insert
+  C: 100% read                    F: 50% read / 50% read-modify-write
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MIXES = {
+    "A": {"read": 0.5, "update": 0.5},
+    "B": {"read": 0.95, "update": 0.05},
+    "C": {"read": 1.0},
+    "D": {"read": 0.95, "insert": 0.05},
+    "E": {"scan": 0.95, "insert": 0.05},
+    "F": {"read": 0.5, "rmw": 0.5},
+}
+
+
+class _Zipf:
+    """Bounded zipfian sampler (the YCSB ScrambledZipfian without the
+    scramble; theta 0.99 like the spec)."""
+
+    def __init__(self, n: int, rng, theta: float = 0.99):
+        self.rng = rng
+        ranks = np.arange(1, n + 1, dtype=np.float64)
+        w = 1.0 / np.power(ranks, theta)
+        self.p = w / w.sum()
+        self.n = n
+
+    def sample(self) -> int:
+        return int(self.rng.choice(self.n, p=self.p))
+
+
+class YCSB:
+    name = "ycsb"
+
+    def __init__(self, engine, workload: str = "A", records: int = 1000,
+                 seed: int = 0, distribution: str = "zipfian",
+                 scan_limit: int = 10):
+        if workload not in MIXES:
+            raise ValueError(f"unknown YCSB workload {workload!r}")
+        self.engine = engine
+        self.mix = MIXES[workload]
+        self.workload = workload
+        self.records = records
+        self.rng = np.random.default_rng(seed)
+        self.zipf = (_Zipf(records, self.rng)
+                     if distribution == "zipfian" else None)
+        self.scan_limit = scan_limit
+        self.next_key = records
+        self.ops = {op: 0 for op in
+                    ("read", "update", "insert", "scan", "rmw")}
+
+    def setup(self) -> None:
+        e = self.engine
+        e.execute("CREATE TABLE usertable (ycsb_key INT8 NOT NULL "
+                  "PRIMARY KEY, field0 INT8, field1 INT8)")
+        vals = ", ".join(f"({i}, {i * 7 % 1000}, {i * 13 % 1000})"
+                         for i in range(self.records))
+        e.execute(f"INSERT INTO usertable VALUES {vals}")
+
+    def _key(self) -> int:
+        if self.zipf is not None:
+            return self.zipf.sample()
+        return int(self.rng.integers(0, self.records))
+
+    def step(self) -> str:
+        ops, probs = zip(*self.mix.items())
+        op = self.rng.choice(ops, p=probs)
+        e = self.engine
+        k = self._key()
+        if op == "read":
+            e.execute(f"SELECT field0, field1 FROM usertable "
+                      f"WHERE ycsb_key = {k}")
+        elif op == "update":
+            e.execute(f"UPDATE usertable SET field0 = "
+                      f"{int(self.rng.integers(0, 1000))} "
+                      f"WHERE ycsb_key = {k}")
+        elif op == "insert":
+            e.execute(f"INSERT INTO usertable VALUES ({self.next_key}, "
+                      f"0, 0)")
+            self.next_key += 1
+        elif op == "scan":
+            e.execute(f"SELECT ycsb_key, field0 FROM usertable "
+                      f"WHERE ycsb_key >= {k} ORDER BY ycsb_key "
+                      f"LIMIT {self.scan_limit}")
+        elif op == "rmw":
+            r = e.execute(f"SELECT field0 FROM usertable "
+                          f"WHERE ycsb_key = {k}")
+            v = (r.rows[0][0] or 0) + 1 if r.rows else 0
+            e.execute(f"UPDATE usertable SET field0 = {v} "
+                      f"WHERE ycsb_key = {k}")
+        self.ops[op] += 1
+        return op
+
+    def run(self, steps: int = 100) -> dict:
+        import time
+        t0 = time.monotonic()
+        for _ in range(steps):
+            self.step()
+        dt = time.monotonic() - t0
+        return {"ops": dict(self.ops), "seconds": dt,
+                "ops_per_sec": steps / dt if dt > 0 else 0.0}
