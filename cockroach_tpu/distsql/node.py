@@ -18,6 +18,7 @@ table, and runs the final stage through the same compiler.
 
 from __future__ import annotations
 
+import time as _time
 import uuid
 
 import jax.numpy as jnp
@@ -167,14 +168,23 @@ class Gateway:
     DistSQLNode — it may itself hold a shard — and fans SetupFlow out
     to every data node."""
 
+    # Idle deadline for socket flows. A remote stage is silent while it
+    # compiles + executes (the handler responds only when the stage
+    # finishes), and a first-run XLA compile of a while_loop-heavy plan
+    # takes tens of seconds — so the default must comfortably exceed
+    # worst-case compile, not round-trip, time.
+    FLOW_TIMEOUT = 300.0
+
     def __init__(self, own: DistSQLNode, data_nodes: list[int],
-                 replicated_tables: set | None = None):
+                 replicated_tables: set | None = None,
+                 flow_timeout: float = FLOW_TIMEOUT):
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
         # join build sides must come from these — a sharded⋈sharded
         # join would silently lose cross-node matches
         self.replicated_tables = replicated_tables or set()
+        self.flow_timeout = flow_timeout
 
     def _check_join_placement(self, plan_node) -> None:
         from cockroach_tpu.distsql.physical import DistUnsupported
@@ -214,13 +224,27 @@ class Gateway:
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
-        # drive the in-process "network" until all streams finish
-        for _ in range(10000):
+        # drive the network until all streams finish. In-process
+        # transports are synchronous: an empty queue means stalled.
+        # Socket transports (rpc.SocketTransport, is_async=True)
+        # deliver whenever peers respond — poll until a deadline.
+        is_async = getattr(transport, "is_async", False)
+        # IDLE timeout: the clock resets whenever anything arrives, so
+        # a long multi-chunk stream never starves a later chunk of
+        # budget — only true silence for flow_timeout fails the flow
+        deadline = _time.monotonic() + self.flow_timeout
+        for _ in range(100_000_000):
             if all(ib.eof for ib in inboxes):
                 break
             if transport.deliver_all() == 0 and \
                     transport.pending() == 0:
-                break
+                if not is_async:
+                    break
+                if _time.monotonic() > deadline:
+                    break
+                _time.sleep(0.001)
+            else:
+                deadline = _time.monotonic() + self.flow_timeout
         try:
             errs = [ib.error for ib in inboxes if ib.error]
             if errs:

@@ -1,0 +1,7 @@
+"""Cluster fabric: socket RPC transport + gossip (reference: pkg/rpc,
+pkg/gossip)."""
+
+from .context import SocketTransport, encode_msg, decode_msg
+from .gossip import Gossip
+
+__all__ = ["SocketTransport", "Gossip", "encode_msg", "decode_msg"]

@@ -28,6 +28,12 @@ class NodeConfig:
     http_port: int | None = 0     # status/metrics; None disables
     mesh: object = None           # optional device mesh for DistSQL
     load_tpch_sf: float | None = None  # demo mode: preload TPC-H tables
+    # cluster fabric: this node's id + RPC port, and peer addresses to
+    # join ({node_id: (host, port)}); None disables the fabric
+    node_id: int = 1
+    rpc_port: int | None = None
+    join: dict | None = None
+    gossip_interval: float = 0.2
 
 
 class Node:
@@ -40,10 +46,14 @@ class Node:
                              settings=self.settings,
                              mesh=self.config.mesh)
         from ..jobs import IMPORT_JOB, ImportResumer, Registry
-        self.jobs = Registry(self.engine.kv)
+        self.jobs = Registry(self.engine.kv,
+                             session_id=f"node-{self.config.node_id}")
         self.jobs.register(IMPORT_JOB, lambda: ImportResumer(self.engine))
         self.pg: PgServer | None = None
         self._http = None
+        self.rpc = None
+        self.gossip = None
+        self._gossip_stop = None
         self._started = False
 
     @property
@@ -99,6 +109,61 @@ class Node:
         threading.Thread(target=self._http.serve_forever,
                          name="status-http", daemon=True).start()
 
+    def _start_fabric(self):
+        """RPC listener + gossip loop (pkg/rpc, pkg/gossip): cluster
+        settings set on any node converge on all of them."""
+        import threading
+
+        from ..rpc import Gossip, SocketTransport
+        from ..rpc.gossip import wire_settings
+
+        cfg = self.config
+        self.rpc = SocketTransport(cfg.node_id, cfg.listen_host,
+                                   cfg.rpc_port)
+        peers = [cfg.node_id]
+        for nid, addr in (cfg.join or {}).items():
+            self.rpc.connect(nid, tuple(addr))
+            peers.append(nid)
+        self.gossip = Gossip(cfg.node_id, self.rpc, peers=peers)
+        # extensible fabric dispatch: gossip consumes its own payloads
+        # (handle() returns False otherwise); other subsystems add
+        # themselves under a message "kind" without clobbering gossip
+        self.rpc_handlers: dict[str, object] = {}
+
+        def dispatch(frm, msg):
+            if self.gossip.handle(frm, msg):
+                return
+            kind = msg.get("kind") if isinstance(msg, dict) else None
+            h = self.rpc_handlers.get(kind)
+            if h is not None:
+                h(frm, msg)
+
+        self.rpc.register(cfg.node_id, dispatch)
+        wire_settings(self.gossip, self.settings)
+        self.gossip.add_info(f"node:{cfg.node_id}:sql_addr",
+                             list(self.sql_addr))
+        self._gossip_stop = threading.Event()
+        rpc, gossip, stop = self.rpc, self.gossip, self._gossip_stop
+
+        def loop():
+            # locals, not self.*: stop() nulls the attributes while
+            # this thread may still be mid-tick
+            while not stop.is_set():
+                gossip.tick()
+                rpc.deliver_all()
+                stop.wait(cfg.gossip_interval)
+
+        self._gossip_thread = threading.Thread(target=loop,
+                                               name="gossip", daemon=True)
+        self._gossip_thread.start()
+
+    def connect_peer(self, node_id: int, rpc_addr) -> None:
+        """Late join: learn a peer after startup."""
+        assert self.rpc is not None
+        self.rpc.connect(node_id, tuple(rpc_addr))
+        if node_id not in self.gossip.peers:
+            self.gossip.peers.append(node_id)
+
     def start(self) -> "Node":
         if self._started:
             return self
@@ -110,10 +175,18 @@ class Node:
                            version=__version__).start()
         if self.config.http_port is not None:
             self._start_status_server()
+        if self.config.rpc_port is not None:
+            self._start_fabric()
         self._started = True
         return self
 
     def stop(self):
+        if self._gossip_stop is not None:
+            self._gossip_stop.set()
+            self._gossip_thread.join(timeout=5)
+        if self.rpc is not None:
+            self.rpc.close()
+            self.rpc = None
         if self.pg is not None:
             self.pg.stop()
         if self._http is not None:
