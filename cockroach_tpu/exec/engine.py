@@ -424,6 +424,7 @@ class Engine:
             shapes.append((tname, b.n, dictlens))
 
         cap = int(session.vars.get("hash_group_capacity", 1 << 17))
+        pallas = session.vars.get("pallas_groupagg", "off") == "on"
         # keyed by shape (padded row-count bucket) + dictionary sizes,
         # NOT data generation: the compiled XLA program depends only on
         # shapes and on literal dictionary codes (append-only, so any
@@ -431,12 +432,14 @@ class Engine:
         # of the reference (sql/plan_opt.go), adapted to XLA's
         # shape-specialized compilation model
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
-               stream, cap)
+               stream, cap, pallas)
         cached = self._exec_cache.get(key)
         if cached is None:
             params = ExecParams(
                 hash_group_capacity=cap,
-                axis_name=SHARD_AXIS if decision is not None else None)
+                axis_name=SHARD_AXIS if decision is not None else None,
+                pallas_groupagg=pallas,
+                pallas_interpret=jax.default_backend() != "tpu")
             if stream is not None:
                 splan = compile_streaming(node, params, meta)
 

@@ -119,6 +119,9 @@ class SessionVars:
         "streaming_page_rows": 1 << 21,
         "direct_columnar_scans_enabled": True,
         "hash_group_capacity": 1 << 17,
+        # opt-in one-pass Pallas kernel for dense float GROUP BY
+        # (f32 accumulation: approximate vs the XLA path's f64)
+        "pallas_groupagg": "off",    # on | off
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,
