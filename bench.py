@@ -1,14 +1,24 @@
-"""Benchmark: TPC-H Q6/Q1 throughput on the attached TPU chip.
+"""Benchmark: TPC-H Q6/Q1/Q14 throughput on the attached TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line:
+  {"metric": "tpch_q6_rows_per_sec", "value": N, "unit": "rows/s",
+   "vs_baseline": N, ...}
+plus per-query fields (q1_rows_per_sec, q14_rows_per_sec) and the
+measured host-CPU number (cpu_q6_rows_per_sec / vs_cpu) when the CPU
+baseline pass ran.
 
-Baseline: the reference's vectorized (colexec) engine publishes no
-absolute numbers (BASELINE.md); public roachperf-class hardware runs
-put a Q6-shaped scan+filter+sum around 20-40M rows/s/core, i.e.
-~1.2e8 rows/s on the 3x4-vCPU roachtest config the reference gates on
-(pkg/cmd/roachtest/tests/tpchvec.go). We use 1.25e8 rows/s as the
-colexec baseline for vs_baseline; the north star is >=10x
-(BASELINE.json).
+Baselines — two, with provenance:
+- ASSUMED colexec baseline (vs_baseline): the reference publishes no
+  absolute numbers (BASELINE.md); public roachperf-class runs put a
+  Q6-shaped scan+filter+sum around 20-40M rows/s/core, i.e. ~1.25e8
+  rows/s on the 3x4-vCPU roachtest config the reference gates on
+  (pkg/cmd/roachtest/tests/tpchvec.go). Kept constant across rounds so
+  vs_baseline stays comparable.
+- MEASURED host-CPU baseline (vs_cpu): this same engine's Q6 plan
+  compiled with XLA-CPU on this host (all cores), measured in a
+  subprocess each bench run. This is a *generous* stand-in for colexec
+  (XLA vectorizes + multithreads); beating it by 5-10x on one chip is
+  the honest accomplishment.
 
 Methodology: steady-state engine throughput. The query is prepared
 once (Engine.prepare — the pgwire portal path), then PIPELINE
@@ -19,52 +29,38 @@ single host<->device sync costs ~50-70ms, which would otherwise
 dominate and measure the tunnel, not the engine. Single-shot blocking
 latency is reported on stderr alongside.
 
-Environment knobs: BENCH_ROWS (default 2^23), BENCH_QUERY (q6|q1|q14),
-BENCH_PIPELINE (default 16), BENCH_REPEATS (default 5).
+Environment knobs: BENCH_ROWS (default 2^25 on TPU so the default run
+finishes in minutes on a tunnel-attached chip; 2^22 on CPU —
+BENCH_ROWS=$((1<<27)) reproduces the headline run in BENCHMARKS.md),
+BENCH_QUERY (q6|q1|q14|all; default all), BENCH_PIPELINE (default 16),
+BENCH_REPEATS (default 5), BENCH_CPU=0 to skip the CPU-baseline
+subprocess, BENCH_CPU_ROWS (default 2^22).
 """
 
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 
-BASELINE_ROWS_PER_SEC = 1.25e8  # colexec-equivalent Q6 throughput
+BASELINE_ROWS_PER_SEC = 1.25e8  # assumed colexec-equivalent Q6 throughput
 
 
-def main():
-    rows = int(os.environ.get("BENCH_ROWS", 1 << 23))
-    which = os.environ.get("BENCH_QUERY", "q6")
-    pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
-    repeats = int(os.environ.get("BENCH_REPEATS", 5))
-
+def bench_query(eng, sql, rows, pipeline, repeats):
     import jax
 
-    from cockroach_tpu.exec.engine import Engine
-    from cockroach_tpu.models import tpch
-
-    eng = Engine()
     t0 = time.time()
-    tables = ("lineitem", "part") if which == "q14" else ("lineitem",)
-    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows, tables=tables)
-    gen_s = time.time() - t0
-
-    sql = tpch.QUERIES[which]
-    # warmup: compile + device upload
-    t0 = time.time()
-    eng.execute(sql)
-    compile_s = time.time() - t0
+    eng.execute(sql)  # warmup: compile + device upload
+    warm_s = time.time() - t0
 
     prep = eng.prepare(sql)
-
-    # single-shot blocking latency (includes one full device sync)
     lat = []
     for _ in range(3):
         t0 = time.time()
         prep.run()
         lat.append(time.time() - t0)
 
-    # steady-state: dispatch PIPELINE executions, sync once
     rates = []
     for _ in range(repeats):
         t0 = time.time()
@@ -72,20 +68,160 @@ def main():
         jax.block_until_ready(outs)
         dt = time.time() - t0
         rates.append(rows * pipeline / dt)
-    rps = statistics.median(rates)
+    return statistics.median(rates), statistics.median(lat), warm_s, rates
 
-    out = {
-        "metric": f"tpch_{which}_rows_per_sec",
-        "value": round(rps),
-        "unit": "rows/s",
-        "vs_baseline": round(rps / BASELINE_ROWS_PER_SEC, 3),
-    }
-    print(json.dumps(out))
-    print(f"# rows={rows} pipeline={pipeline} "
-          f"median_latency_s={statistics.median(lat):.4f} "
-          f"warmup_s={compile_s:.1f} datagen_s={gen_s:.1f} "
-          f"rates_Mrps={['%.0f' % (r / 1e6) for r in rates]}",
+
+def run(rows_by_query, pipeline, repeats, tag=""):
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    results = {}
+    rows_used = {}
+    # group queries sharing a row count onto one engine/dataset
+    by_rows: dict[int, list] = {}
+    for which, rows in rows_by_query.items():
+        by_rows.setdefault(rows, []).append(which)
+    for rows, queries in by_rows.items():
+        eng = Engine()
+        t0 = time.time()
+        tables = ("lineitem", "part") if "q14" in queries else ("lineitem",)
+        tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+                  tables=tables, encoded=True)
+        gen_s = time.time() - t0
+        for which in queries:
+            # one resident pruned column set per query: drop the
+            # previous query's upload so peak HBM is one working set
+            eng.drop_device_cache()
+            rps, lat, warm_s, rates = bench_query(
+                eng, tpch.QUERIES[which], rows, pipeline, repeats)
+            results[which] = rps
+            rows_used[which] = rows
+            print(f"# {tag}{which}: rows={rows} pipeline={pipeline} "
+                  f"rows_per_sec={rps:.3e} median_latency_s={lat:.4f} "
+                  f"warmup_s={warm_s:.1f} "
+                  f"rates_Mrps={['%.0f' % (r / 1e6) for r in rates]}",
+                  file=sys.stderr)
+        print(f"# {tag}datagen_s={gen_s:.1f} rows={rows}", file=sys.stderr)
+        del eng
+    return results, rows_used
+
+
+def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
+              mode: str = "tpu_child"):
+    """One query/measurement in its own subprocess: a fresh backend
+    per query, so a wedged tunnel/compile (observed: the relay
+    sometimes hangs a compile indefinitely) costs ONE attempt, not
+    the whole bench. Killing the stuck process clears the wedge, so
+    one retry usually lands. mode="cpu" runs the same plan under
+    XLA-CPU (sequenced BEFORE the TPU section — both are host-CPU
+    hungry, so overlapping them would bias the ratio)."""
+    env = dict(os.environ, BENCH_MODE=mode, BENCH_ROWS=str(rows),
+               BENCH_QUERY=query, BENCH_CPU="0")
+    if mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_REPEATS"] = "3"
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # bypass the TPU relay
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"# {query}: attempt {attempt + 1} timed out after "
+                  f"{timeout}s", file=sys.stderr)
+            continue
+        sys.stderr.write(out.stderr)
+        if out.returncode != 0:
+            print(f"# {query}: child failed rc={out.returncode}",
+                  file=sys.stderr)
+            continue
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                return json.loads(line)
+    print(f"# {query}: all {attempts} attempts failed, skipping",
           file=sys.stderr)
+    return None
+
+
+def main():
+    mode = os.environ.get("BENCH_MODE", "tpu")
+    # Default sized to finish in a few minutes on the tunnel-attached
+    # chip (upload dominates warmup). BENCH_ROWS=$((1<<27)) reproduces
+    # the headline beyond-2^27 run recorded in BENCHMARKS.md.
+    default_rows = 1 << 22 if mode == "cpu" else 1 << 25
+    rows = int(os.environ.get("BENCH_ROWS", default_rows))
+    qenv = os.environ.get("BENCH_QUERY", "all")
+    queries = (["q6", "q1", "q14"] if qenv == "all"
+               else [q.strip() for q in qenv.split(",")])
+    pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+
+    # q1/q14's 8-aggregate working set (~12GB of XLA temps at 2^27)
+    # runs at a resident-friendly row count; q6 takes the full size
+    cap_multi = 1 << 25 if mode.startswith("tpu") else rows
+    rows_by_query = {q: (rows if q == "q6" else min(rows, cap_multi))
+                     for q in queries}
+
+    if mode in ("cpu", "tpu_child"):
+        # leaf mode: measure in-process and emit one JSON line
+        tag = "cpu " if mode == "cpu" else ""
+        results, rows_used = run(rows_by_query, pipeline, repeats, tag=tag)
+        primary = queries[0]
+        print(json.dumps({
+            "metric": f"tpch_{primary}_rows_per_sec",
+            "value": round(results[primary]),
+            "unit": "rows/s",
+            "rows": rows_used[primary],
+            **{f"{w}_rows_per_sec": round(r) for w, r in results.items()},
+        }))
+        return
+
+    cpu = None
+    cpu_query = None
+    if os.environ.get("BENCH_CPU", "1") != "0":
+        # measured BEFORE the TPU section so the parent's host work
+        # cannot depress the CPU number (which would overstate vs_cpu)
+        cpu_query = ([q for q in queries if q == "q6"] or queries[:1])[0]
+        cpu = run_child(int(os.environ.get("BENCH_CPU_ROWS", 1 << 22)),
+                        cpu_query, timeout=600, attempts=1, mode="cpu")
+
+    # healthy children finish well inside this; a wedged compile eats
+    # one timeout then retries in a fresh process
+    child_timeout = int(os.environ.get(
+        "BENCH_CHILD_TIMEOUT", max(600, rows >> 17)))
+    results = {}
+    rows_used = {}
+    for q in queries:  # q6 first: the primary metric lands early
+        r = run_child(rows_by_query[q], q, child_timeout)
+        if r is not None:
+            results[q] = r["value"]
+            rows_used[q] = r["rows"]
+    if not results:
+        print(json.dumps({"metric": "tpch_q6_rows_per_sec", "value": 0,
+                          "unit": "rows/s", "vs_baseline": 0,
+                          "error": "all bench children failed"}))
+        return
+    primary = "q6" if "q6" in results else next(iter(results))
+    out = {
+        "metric": f"tpch_{primary}_rows_per_sec",
+        "value": round(results[primary]),
+        "unit": "rows/s",
+        "vs_baseline": round(results[primary] / BASELINE_ROWS_PER_SEC, 3),
+        "rows": rows_used[primary],
+        "baseline_provenance": ("assumed 1.25e8 rows/s colexec Q6 on "
+                                "3x4vCPU (no published numbers; see "
+                                "bench.py docstring)"),
+    }
+    for which, rps in results.items():
+        out[f"{which}_rows_per_sec"] = round(rps)
+        out[f"{which}_rows"] = rows_used[which]
+
+    if cpu is not None:
+        out[f"cpu_{cpu_query}_rows_per_sec"] = cpu["value"]
+        out["cpu_rows"] = cpu.get("rows")
+        if cpu["value"] and cpu_query == primary:
+            out["vs_cpu"] = round(results[primary] / cpu["value"], 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

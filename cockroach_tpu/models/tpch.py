@@ -75,9 +75,28 @@ NAMES = ["goldenrod lavender", "blush thistle", "spring green",
          "antique misty", "navy powder"]
 
 
-def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None) -> dict:
+RF_VALUES = ["R", "A", "N"]
+LS_VALUES = ["O", "F"]
+
+# string-column dictionaries for the encoded fast path (codes index
+# into these, in this order)
+LINEITEM_DICTS = {
+    "l_returnflag": RF_VALUES,
+    "l_linestatus": LS_VALUES,
+    "l_shipinstruct": SHIPINSTRUCT,
+    "l_shipmode": SHIPMODES,
+}
+
+
+def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None,
+                 encoded: bool = False) -> dict:
     """Generate lineitem columns as numpy arrays (decimals as floats —
-    the columnar store scales them at ingest)."""
+    the columnar store scales them at ingest).
+
+    encoded=True returns int32 dictionary codes for the string columns
+    (see LINEITEM_DICTS) instead of object arrays — the only path that
+    scales to SF100-class row counts (object arrays + np.unique over
+    600M strings would dominate ingest)."""
     n = rows if rows is not None else int(LINEITEM_PER_SF * sf)
     rng = np.random.default_rng(seed)
     nparts = max(int(PART_PER_SF * max(sf, 0.01)), 1000)
@@ -103,8 +122,21 @@ def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None) -> dict:
     # N/O, R/F)
     cutoff = _days("1995-06-17")
     received = receiptdate <= cutoff
-    rf = np.where(received, np.where(rng.random(n) < 0.5, "R", "A"), "N")
-    ls = np.where(shipdate > cutoff, "O", "F")
+    # both paths draw the rf coin at the same rng stream position so
+    # encoded and object datasets agree row-for-row on rf/ls
+    coin = rng.random(n) < 0.5
+    if encoded:
+        # codes into LINEITEM_DICTS (R=0, A=1, N=2; O=0, F=1)
+        rf = np.where(received, np.where(coin, 0, 1), 2).astype(np.int32)
+        ls = np.where(shipdate > cutoff, 0, 1).astype(np.int32)
+        si = rng.integers(0, len(SHIPINSTRUCT), size=n).astype(np.int32)
+        sm = rng.integers(0, len(SHIPMODES), size=n).astype(np.int32)
+    else:
+        rf = np.where(received,
+                      np.where(coin, "R", "A"), "N").astype(object)
+        ls = np.where(shipdate > cutoff, "O", "F").astype(object)
+        si = rng.choice(SHIPINSTRUCT, size=n).astype(object)
+        sm = rng.choice(SHIPMODES, size=n).astype(object)
     return {
         "l_orderkey": orderkey,
         "l_partkey": partkey,
@@ -114,13 +146,13 @@ def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None) -> dict:
         "l_extendedprice": extendedprice,
         "l_discount": discount,
         "l_tax": tax,
-        "l_returnflag": rf.astype(object),
-        "l_linestatus": ls.astype(object),
+        "l_returnflag": rf,
+        "l_linestatus": ls,
         "l_shipdate": shipdate,
         "l_commitdate": commitdate,
         "l_receiptdate": receiptdate,
-        "l_shipinstruct": rng.choice(SHIPINSTRUCT, size=n).astype(object),
-        "l_shipmode": rng.choice(SHIPMODES, size=n).astype(object),
+        "l_shipinstruct": si,
+        "l_shipmode": sm,
     }
 
 
@@ -149,17 +181,23 @@ def gen_part(sf: float, seed: int = 1, rows: int | None = None) -> dict:
 
 
 def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
-         rows: int | None = None) -> None:
+         rows: int | None = None, encoded: bool = False) -> None:
     """Create + bulk-ingest TPC-H tables into an Engine.
 
     ``rows`` caps the *lineitem* row count only (CI-speed slices);
     dimension tables always get their full SF-proportional size so the
-    key spaces stay consistent with gen_lineitem's foreign keys."""
+    key spaces stay consistent with gen_lineitem's foreign keys.
+    ``encoded`` uses the pre-encoded string fast path (same numeric
+    data and returnflag/linestatus values as the object path for a
+    given seed, so the numpy oracles still agree)."""
     ts = engine.clock.now()
     for t in tables:
         engine.execute(DDL[t])
         if t == "lineitem":
-            cols = gen_lineitem(sf, seed=seed, rows=rows)
+            if encoded:
+                for cn, vals in LINEITEM_DICTS.items():
+                    engine.store.set_dictionary(t, cn, vals)
+            cols = gen_lineitem(sf, seed=seed, rows=rows, encoded=encoded)
         else:
             cols = gen_part(sf)
         engine.store.insert_columns(t, cols, ts)

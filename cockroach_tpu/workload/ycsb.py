@@ -50,12 +50,16 @@ class YCSB:
         self.workload = workload
         self.records = records
         self.rng = np.random.default_rng(seed)
+        self.distribution = distribution
         self.zipf = (_Zipf(records, self.rng)
                      if distribution == "zipfian" else None)
         self.scan_limit = scan_limit
         self.next_key = records
         self.ops = {op: 0 for op in
                     ("read", "update", "insert", "scan", "rmw")}
+        # hoisted: the mix is fixed, don't rebuild per step
+        self._op_names, self._op_probs = zip(*self.mix.items())
+        self._op_probs = np.asarray(self._op_probs)
 
     def setup(self) -> None:
         e = self.engine
@@ -66,13 +70,19 @@ class YCSB:
         e.execute(f"INSERT INTO usertable VALUES {vals}")
 
     def _key(self) -> int:
+        if self.workload == "D":
+            # "latest" distribution: reads skew toward recently
+            # inserted keys (ycsb.go's latestGenerator) — zipfian over
+            # the DISTANCE from the newest key, over the live keyspace
+            off = (self.zipf.sample() if self.zipf is not None
+                   else int(self.rng.integers(0, self.records)))
+            return max(0, self.next_key - 1 - (off % self.next_key))
         if self.zipf is not None:
             return self.zipf.sample()
         return int(self.rng.integers(0, self.records))
 
     def step(self) -> str:
-        ops, probs = zip(*self.mix.items())
-        op = self.rng.choice(ops, p=probs)
+        op = self.rng.choice(self._op_names, p=self._op_probs)
         e = self.engine
         k = self._key()
         if op == "read":

@@ -34,7 +34,7 @@ class TestBank:
 
 
 class TestYCSB:
-    @pytest.mark.parametrize("wl", ["A", "B", "C", "E", "F"])
+    @pytest.mark.parametrize("wl", ["A", "B", "C", "D", "E", "F"])
     def test_mix_runs_and_counts(self, wl):
         eng = Engine()
         y = YCSB(eng, workload=wl, records=50, seed=3)
