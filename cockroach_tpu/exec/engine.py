@@ -69,6 +69,7 @@ class Result:
     rows: list[tuple] = field(default_factory=list)
     row_count: int = 0  # for DML
     tag: str = "SELECT"
+    types: list = field(default_factory=list)  # SQLTypes (SELECT only)
 
     def column(self, name: str) -> list:
         i = self.names.index(name)
@@ -361,8 +362,141 @@ class Engine:
     def _plan(self, stmt, session):
         if not isinstance(stmt, ast.Select):
             raise EngineError("can only EXPLAIN SELECT")
-        planner = Planner(self.catalog_view())
+        read_ts = self._read_ts(session)
+        planner = Planner(
+            self.catalog_view(),
+            subquery_eval=lambda sel, lim: self._eval_subquery(
+                sel, session, lim),
+            now_micros=read_ts.wall // 1000)
         return planner.plan_select(stmt)
+
+    # -- subqueries / CTEs ---------------------------------------------------
+    def _eval_subquery(self, sel: ast.Select, session: Session,
+                       limit_one: bool = False):
+        """Execute an expression subquery before the main statement
+        (the reference's planTop.subqueryPlans, sql/subquery.go) and
+        hand (rows, types) back to the binder for constant inlining."""
+        import copy
+        if limit_one and sel.limit is None:
+            sel = copy.copy(sel)
+            sel.limit = 1  # EXISTS needs one row, not the result set
+        res = self._exec_select(sel, session, f"(subquery {sel!r})")
+        return res.rows, res.types
+
+    @staticmethod
+    def _has_derived(sel: ast.Select) -> bool:
+        refs = ([sel.table] if sel.table is not None else []) + \
+            [j.table for j in sel.joins]
+        return any(r.subquery is not None for r in refs)
+
+    def _exec_with_temps(self, sel: ast.Select, session: Session,
+                         sql_text: str) -> Result:
+        """WITH ctes / FROM (SELECT...): materialize each into a temp
+        columnstore table, rewrite references, run the main query, drop
+        the temps. The reference plans CTEs as once-materialized
+        buffers (sql/opt: WithExpr / spool); here the natural TPU form
+        is a temp scan-plane table the main program reads like any
+        other."""
+        import copy
+        sel = copy.copy(sel)
+        temps: list[str] = []
+        mapping: dict[str, str] = {}
+        try:
+            for name, cols, sub in sel.ctes:
+                sub = _rewrite_table_names(sub, mapping)
+                res = self._exec_select(sub, session, f"(cte {sub!r})")
+                tname = f"__cte{self._temp_seq()}_{name}"
+                self._materialize_temp(tname, res, cols)
+                mapping[name] = tname
+                temps.append(tname)
+            sel.ctes = []
+            refs = ([("table", sel.table)] if sel.table is not None
+                    else []) + [("join", j) for j in sel.joins]
+            for kind, obj in refs:
+                ref = obj if kind == "table" else obj.table
+                if ref.subquery is None:
+                    continue
+                sub = _rewrite_table_names(ref.subquery, mapping)
+                res = self._exec_select(sub, session,
+                                        f"(derived {sub!r})")
+                tname = f"__cte{self._temp_seq()}_{ref.alias}"
+                self._materialize_temp(tname, res, None)
+                temps.append(tname)
+                newref = ast.TableRef(tname, ref.alias)
+                if kind == "table":
+                    sel.table = newref
+                else:
+                    obj.table = newref
+            sel = _rewrite_table_names(sel, mapping)
+            return self._exec_select(sel, session, sql_text)
+        finally:
+            for t in temps:
+                if t in self.store.tables:
+                    self.store.drop_table(t)
+                    for k in [k for k in self._device_tables
+                              if k[0] == t]:
+                        self._evict_device(k)
+
+    _temp_counter = [0]
+
+    def _temp_seq(self) -> int:
+        self._temp_counter[0] += 1
+        return self._temp_counter[0]
+
+    def _materialize_temp(self, tname: str, res: Result,
+                          rename: list | None) -> None:
+        """Create a columnstore table from a decoded Result."""
+        names = list(res.names)
+        if rename is not None:
+            if len(rename) != len(names):
+                raise EngineError(
+                    "CTE column list length does not match query")
+            names = list(rename)
+        if len(set(names)) != len(names):
+            raise EngineError(f"duplicate column names in {tname}")
+        types = res.types
+        if not types:
+            raise EngineError("subquery produced no column types")
+        schema = TableSchema(
+            name=tname,
+            columns=[ColumnSchema(n, t, True)
+                     for n, t in zip(names, types)],
+            primary_key=[],
+            table_id=self.store.alloc_table_id())
+        self.store.create_table(schema)
+        if not res.rows:
+            return
+        n = len(res.rows)
+        cols: dict[str, np.ndarray] = {}
+        valid: dict[str, np.ndarray] = {}
+        for i, (cname, ty) in enumerate(zip(names, types)):
+            vals = [r[i] for r in res.rows]
+            v = np.array([x is not None for x in vals], dtype=bool)
+            f = ty.family
+            if f == Family.STRING:
+                arr = np.array([x if x is not None else "" for x in vals],
+                               dtype=object)
+            elif f == Family.DATE:
+                arr = np.array(
+                    [(x - EPOCH_DATE).days if isinstance(x, datetime.date)
+                     else (x or 0) for x in vals], dtype=np.int64)
+            elif f == Family.TIMESTAMP:
+                arr = np.array(
+                    [int((x - EPOCH_DT).total_seconds() * 1e6)
+                     if isinstance(x, datetime.datetime) else (x or 0)
+                     for x in vals], dtype=np.int64)
+            else:
+                # DECIMAL floats are rescaled by insert_columns
+                arr = np.array([x if x is not None else 0 for x in vals],
+                               dtype=ty.np_dtype
+                               if f != Family.DECIMAL else np.float64)
+            cols[cname] = arr
+            valid[cname] = v
+        # temps ingest at wall=1 so they are visible at ANY read
+        # timestamp — including a txn's pinned one from before the
+        # materialization happened
+        self.store.insert_columns(tname, cols, Timestamp(1, 0),
+                                  valid=valid)
 
     def _prepare_select(self, sel: ast.Select, session: Session,
                         sql_text: str) -> "Prepared":
@@ -431,8 +565,13 @@ class Engine:
         # growth shows up in dictlens) — the plan-cache fingerprint idea
         # of the reference (sql/plan_opt.go), adapted to XLA's
         # shape-specialized compilation model
+        # plan fingerprint: subquery results are inlined into the plan
+        # as constants, so two preparations of the SAME sql_text can
+        # compile DIFFERENT programs when underlying data moved —
+        # sql_text alone would hand back a stale compiled constant
+        plan_fp = hash(repr(node))
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
-               stream, cap, pallas)
+               stream, cap, pallas, plan_fp)
         cached = self._exec_cache.get(key)
         if cached is None:
             params = ExecParams(
@@ -482,8 +621,10 @@ class Engine:
 
     def _exec_select(self, sel: ast.Select, session: Session,
                      sql_text: str) -> Result:
+        if sel.ctes or self._has_derived(sel):
+            return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
-            return self._exec_table_free(sel)
+            return self._exec_table_free(sel, session)
         return self._prepare_select(sel, session, sql_text).run()
 
     def _check_join_builds(self, node, read_ts: Timestamp) -> None:
@@ -554,9 +695,16 @@ class Engine:
         d = dist_analyze(node)
         return d if d.ok else None
 
-    def _exec_table_free(self, sel: ast.Select) -> Result:
+    def _exec_table_free(self, sel: ast.Select,
+                         session: Session | None = None) -> Result:
         """SELECT <exprs> with no FROM."""
-        binder = Binder(Scope())
+        session = session or self.session()
+        read_ts = self._read_ts(session)
+        binder = Binder(
+            Scope(),
+            subquery_eval=lambda s, lim: self._eval_subquery(
+                s, session, lim),
+            now_micros=read_ts.wall // 1000)
         names, exprs = [], []
         for it in sel.items:
             if it.star:
@@ -568,11 +716,24 @@ class Engine:
         row = []
         types = []
         for b in exprs:
+            if isinstance(b, BConst):
+                # constants (incl. folded string builtins) skip the
+                # device: strings have no resident dictionary here
+                v = b.value
+                if b.type.family == Family.DECIMAL and v is not None:
+                    v = v / 10 ** b.type.scale
+                elif b.type.family == Family.DATE and v is not None:
+                    v = EPOCH_DATE + datetime.timedelta(days=int(v))
+                elif b.type.family == Family.TIMESTAMP and v is not None:
+                    v = EPOCH_DT + datetime.timedelta(microseconds=int(v))
+                row.append(v)
+                types.append(b.type)
+                continue
             d, v = compile_expr(b)(ctx)
             row.append(_decode_scalar(np.asarray(d)[0], bool(np.asarray(v)[0]),
                                       b.type, None))
             types.append(b.type)
-        return Result(names=names, rows=[tuple(row)])
+        return Result(names=names, rows=[tuple(row)], types=types)
 
     # -- hash-partitioned spill ---------------------------------------------
     MAX_SPILL_PARTITIONS = 256
@@ -614,7 +775,7 @@ class Engine:
                                     .items())))
                    for t, _ in prep.gens))
         key = ("spill", prep.sql_text, shapes, dictlens, cap,
-               decision is not None)
+               decision is not None, hash(repr(node)))
         cached = self._exec_cache.get(key)
         if cached is None:
             params = ExecParams(
@@ -862,7 +1023,7 @@ class Engine:
                     "decimal SUM overflowed int64 accumulation; "
                     "CAST the argument to FLOAT to trade exactness for range")
         host = out.to_host()
-        res = Result(names=list(meta.names))
+        res = Result(names=list(meta.names), types=list(meta.types))
         cols = []
         for name, ty in zip(meta.names, meta.types):
             arr = host[name]
@@ -1158,10 +1319,16 @@ class Engine:
         scope.add_table(table, cols)
         return scope, td.schema
 
-    def _chunk_pred(self, table: str, where, scope: Scope):
+    def _chunk_pred(self, table: str, where, scope: Scope,
+                    session: Session | None = None):
         if where is None:
             return lambda chunk: np.ones(chunk.n, dtype=bool)
-        binder = Binder(scope)
+        session = session or self.session()
+        binder = Binder(
+            scope,
+            subquery_eval=lambda s, lim: self._eval_subquery(
+                s, session, lim),
+            now_micros=self._read_ts(session).wall // 1000)
         pred = binder.bind(where)
         predf = compile_expr(pred)
 
@@ -1177,7 +1344,7 @@ class Engine:
         scope, _ = self._dml_scope(d.table)
         td = self.store.table(d.table)
         codec = td.codec
-        predf = self._chunk_pred(d.table, d.where, scope)
+        predf = self._chunk_pred(d.table, d.where, scope, session)
 
         def fn(t: Txn, effects: list) -> Result:
             read_ts = t.meta.read_ts
@@ -1243,7 +1410,7 @@ class Engine:
             return data, valid
 
         codec = td.codec
-        predf = self._chunk_pred(u.table, u.where, scope)
+        predf = self._chunk_pred(u.table, u.where, scope, session)
 
         def fn(t: Txn, effects: list) -> Result:
             read_ts = t.meta.read_ts
@@ -1397,6 +1564,72 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     out = np.full(n, fill, dtype=a.dtype)
     out[: a.shape[0]] = a
     return out
+
+
+def _rewrite_table_names(sel: ast.Select, mapping: dict) -> ast.Select:
+    """Deep-copy a Select with CTE names replaced by their materialized
+    temp-table names — in FROM/JOIN refs and inside expression
+    subqueries (which execute while the temps are still live)."""
+    import copy
+    if not mapping:
+        return sel
+    sel = copy.deepcopy(sel)
+
+    def fix_ref(ref: ast.TableRef):
+        if ref is None or ref.subquery is not None:
+            if ref is not None and ref.subquery is not None:
+                fix_select(ref.subquery)
+            return
+        if ref.name in mapping:
+            ref.alias = ref.alias or ref.name
+            ref.name = mapping[ref.name]
+
+    def fix_expr(e):
+        if e is None:
+            return
+        if isinstance(e, (ast.Subquery, ast.Exists)):
+            fix_select(e.select)
+            return
+        if isinstance(e, ast.InSubquery):
+            fix_expr(e.expr)
+            fix_select(e.select)
+            return
+        for attr in ("left", "right", "operand", "expr", "lo", "hi",
+                     "start", "length", "else_"):
+            fix_expr(getattr(e, attr, None))
+        for a in getattr(e, "args", None) or []:
+            fix_expr(a)
+        for a in getattr(e, "items", None) or []:
+            fix_expr(a)
+        for c, v in getattr(e, "whens", None) or []:
+            fix_expr(c)
+            fix_expr(v)
+
+    def fix_select(s: ast.Select):
+        # a CTE of the same name in an inner scope shadows the outer
+        shadowed = {name for name, _, _ in s.ctes}
+        inner = {k: v for k, v in mapping.items() if k not in shadowed}
+        if s is not sel and inner != mapping:
+            rewritten = _rewrite_table_names(s, inner)
+            s.__dict__.update(rewritten.__dict__)
+            return
+        fix_ref(s.table)
+        for j in s.joins:
+            fix_ref(j.table)
+            fix_expr(j.on)
+        fix_expr(s.where)
+        fix_expr(s.having)
+        for it in s.items:
+            fix_expr(it.expr)
+        for g in s.group_by:
+            fix_expr(g)
+        for ob in s.order_by:
+            fix_expr(ob.expr)
+        for _, _, sub in s.ctes:
+            fix_select(sub)
+
+    fix_select(sel)
+    return sel
 
 
 def _decode_scalar(v, valid: bool, ty, dictionary):

@@ -21,8 +21,8 @@ import numpy as np
 
 from ..ops import kernels as K
 from ..sql.bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce,
-                         BCol, BConst, BDictLookup, BDictRemap, BExpr,
-                         BExtract, BInList, BIsNull, BUnary)
+                         BCol, BConst, BDictGather, BDictLookup, BDictRemap,
+                         BExpr, BExtract, BFunc, BInList, BIsNull, BUnary)
 from ..sql.types import Family, SQLType
 
 
@@ -203,6 +203,20 @@ def compile_expr(e: BExpr) -> CompiledExpr:
             return K.extract_part(part, d, fam), v
         return f_extract
 
+    if isinstance(e, BFunc):
+        return _compile_func(e)
+
+    if isinstance(e, BDictGather):
+        xf = compile_expr(e.expr)
+        tbl = np.asarray(e.table)
+
+        def f_gather(ctx):
+            d, v = xf(ctx)
+            lut = jnp.asarray(tbl)
+            codes = jnp.clip(d, 0, tbl.shape[0] - 1)
+            return lut[codes], v
+        return f_gather
+
     if isinstance(e, BDictLookup):
         xf = compile_expr(e.expr)
         tbl = np.asarray(e.table, dtype=bool)
@@ -226,3 +240,105 @@ def compile_expr(e: BExpr) -> CompiledExpr:
         return f_remap
 
     raise NotImplementedError(f"cannot compile {e!r}")
+
+
+# 1-arg elementwise builtin kernels (sql/builtins.py registry); all
+# fuse into the surrounding scan program
+_UNARY_KERNELS = {
+    "sqrt": jnp.sqrt, "ln": jnp.log, "exp": jnp.exp,
+    "log10": jnp.log10, "log2": jnp.log2, "cbrt": jnp.cbrt,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "cot": lambda x: 1.0 / jnp.tan(x),
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "floor": jnp.floor, "ceil": jnp.ceil, "ceiling": jnp.ceil,
+    "trunc": jnp.trunc, "sign": jnp.sign,
+}
+
+_BINARY_KERNELS = {
+    "pow": jnp.power, "power": jnp.power, "atan2": jnp.arctan2,
+}
+
+
+def _compile_func(e: BFunc) -> CompiledExpr:
+    name = e.name
+    fs = [compile_expr(a) for a in e.args]
+    if name in _UNARY_KERNELS:
+        fn = _UNARY_KERNELS[name]
+
+        def f1(ctx):
+            d, v = fs[0](ctx)
+            return fn(d), v
+        return f1
+    if name in _BINARY_KERNELS:
+        fn = _BINARY_KERNELS[name]
+
+        def f2(ctx):
+            (a, va), (b, vb) = fs[0](ctx), fs[1](ctx)
+            return fn(a, b), jnp.logical_and(va, vb)
+        return f2
+    if name in ("round_n", "trunc_n"):
+        ndigits = e.args[1].value
+        scale = 10.0 ** ndigits
+        op = jnp.round if name == "round_n" else jnp.trunc
+
+        def f_round(ctx):
+            d, v = fs[0](ctx)
+            return op(d * scale) / scale, v
+        return f_round
+    if name == "mod":
+        def f_mod(ctx):
+            return K.mod(fs[0](ctx), fs[1](ctx))
+        return f_mod
+    if name == "div":
+        def f_div(ctx):
+            (a, va), (b, vb) = fs[0](ctx), fs[1](ctx)
+            ok = b != 0
+            q = jnp.trunc(a / jnp.where(ok, b, 1.0))
+            return q, jnp.logical_and(jnp.logical_and(va, vb), ok)
+        return f_div
+    if name in ("greatest", "least"):
+        pick = jnp.maximum if name == "greatest" else jnp.minimum
+
+        def f_gl(ctx):
+            # SQL GREATEST/LEAST ignore NULL arguments
+            d, v = fs[0](ctx)
+            for f in fs[1:]:
+                d2, v2 = f(ctx)
+                both = jnp.logical_and(v, v2)
+                d = jnp.where(both, pick(d, d2), jnp.where(v, d, d2))
+                v = jnp.logical_or(v, v2)
+            return d, v
+        return f_gl
+    if name == "nullif":
+        def f_nullif(ctx):
+            (a, va), (b, vb) = fs[0](ctx), fs[1](ctx)
+            eq = jnp.logical_and(a == b, jnp.logical_and(va, vb))
+            return a, jnp.logical_and(va, jnp.logical_not(eq))
+        return f_nullif
+    if name == "isnan":
+        def f_isnan(ctx):
+            d, v = fs[0](ctx)
+            return jnp.isnan(d), v
+        return f_isnan
+    if name == "width_bucket":
+        n = e.args[3].value
+
+        def f_wb(ctx):
+            (x, vx), (lo, vl), (hi, vh) = (f(ctx) for f in fs[:3])
+            frac = (x - lo) / (hi - lo)
+            b = jnp.floor(frac * n).astype(jnp.int64) + 1
+            b = jnp.where(x < lo, 0, jnp.where(x >= hi, n + 1, b))
+            return b, jnp.logical_and(vx, jnp.logical_and(vl, vh))
+        return f_wb
+    if name in ("date_trunc_date", "date_trunc_ts"):
+        part = e.args[0].value
+        kern = (K.date_trunc_days if name == "date_trunc_date"
+                else K.date_trunc_micros)
+
+        def f_trunc(ctx):
+            d, v = fs[1](ctx)
+            return kern(part, d), v
+        return f_trunc
+    raise NotImplementedError(f"no kernel for builtin {name}")

@@ -91,6 +91,28 @@ class Extract(Expr):
 
 
 @dataclass
+class Subquery(Expr):
+    """Scalar subquery: (SELECT one column, at most one row). Executed
+    before the main statement and inlined as a constant (the
+    reference's planTop subquery execution, sql/subquery.go)."""
+    select: "Select" = None
+
+
+@dataclass
+class Exists(Expr):
+    """EXISTS (SELECT ...) — true iff the subquery returns any row."""
+    select: "Select" = None
+
+
+@dataclass
+class InSubquery(Expr):
+    """x IN (SELECT ...) — membership against a one-column subquery."""
+    expr: Expr = None
+    select: "Select" = None
+    negated: bool = False
+
+
+@dataclass
 class Substring(Expr):
     expr: Expr
     start: Expr
@@ -109,6 +131,9 @@ class Statement:
 class TableRef:
     name: str
     alias: Optional[str] = None
+    # derived table: FROM (SELECT ...) alias — materialized before
+    # planning like a single-use CTE; name is synthesized
+    subquery: Optional["Select"] = None
 
 
 @dataclass
@@ -143,6 +168,9 @@ class Select(Statement):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    # WITH name [(col,...)] AS (SELECT ...) — non-recursive CTEs,
+    # materialized in order before the main query
+    ctes: list[tuple] = field(default_factory=list)  # (name, cols|None, Select)
 
 
 @dataclass

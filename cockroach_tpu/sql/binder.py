@@ -162,11 +162,19 @@ def _leap(y: int) -> bool:
 # ---------------------------------------------------------------------------
 
 class Binder:
-    def __init__(self, scope: Scope):
+    def __init__(self, scope: Scope, subquery_eval=None,
+                 now_micros: Optional[int] = None):
         self.scope = scope
         # populated by bind_with_aggs
         self.aggs: list[BoundAgg] = []
         self._collect_aggs = False
+        # subquery_eval(ast.Select) -> (rows, types): executes a
+        # subquery before the main statement (the reference plans and
+        # runs planTop.subqueryPlans first, sql/subquery.go); None when
+        # the caller cannot execute (pure-binder contexts)
+        self.subquery_eval = subquery_eval
+        # statement timestamp in unix micros for now()/current_date
+        self.now_micros = now_micros
 
     # -- main dispatch -------------------------------------------------------
     def bind(self, e: ast.Expr) -> BExpr:
@@ -208,8 +216,96 @@ class Binder:
                 raise BindError("EXTRACT needs date/timestamp")
             return BExtract(e.part.lower(), x, INT8)
         if isinstance(e, ast.Substring):
-            raise BindError("SUBSTRING on device not supported yet")
+            from . import builtins as bi
+            args = [self.bind(e.expr), self.bind(e.start)]
+            if e.length is not None:
+                args.append(self.bind(e.length))
+            for a in args[1:]:
+                if not isinstance(a, BConst):
+                    raise BindError("SUBSTRING bounds must be constants")
+            try:
+                out = bi.bind_builtin(self, "substr", args, None)
+            except bi.BuiltinError as err:
+                raise BindError(str(err)) from err
+            if out is None:
+                raise BindError("SUBSTRING binding failed")
+            return out
+        if isinstance(e, ast.Subquery):
+            rows, types = self._run_subquery(e.select)
+            if len(types) != 1:
+                raise BindError("scalar subquery must return one column")
+            if len(rows) > 1:
+                raise BindError(
+                    "more than one row returned by a subquery used as "
+                    "an expression")
+            val = rows[0][0] if rows else None
+            return self._subquery_const(val, types[0])
+        if isinstance(e, ast.Exists):
+            rows, _ = self._run_subquery(e.select, limit_one=True)
+            return BConst(bool(rows), BOOL)
+        if isinstance(e, ast.InSubquery):
+            rows, types = self._run_subquery(e.select)
+            if len(types) != 1:
+                raise BindError("IN subquery must return one column")
+            items = [self._subquery_const(r[0], types[0]) for r in rows
+                     if r[0] is not None]
+            return self._bind_in_consts(self.bind(e.expr), items,
+                                        e.negated)
         raise BindError(f"cannot bind {e!r}")
+
+    # -- subqueries ---------------------------------------------------------
+    def _run_subquery(self, sel: ast.Select, limit_one: bool = False):
+        if self.subquery_eval is None:
+            raise BindError("subqueries not supported in this context")
+        try:
+            return self.subquery_eval(sel, limit_one)
+        except BindError as e:
+            # outer-column references fail name resolution in the
+            # subquery's own scope: report it as what it is
+            raise BindError(
+                f"correlated subqueries not supported ({e})") from e
+
+    def _subquery_const(self, val, ty: SQLType) -> BConst:
+        """Re-encode a decoded subquery result value to physical form."""
+        if val is None:
+            return BConst(None, SQLType.unknown())
+        f = ty.family
+        if f == Family.DECIMAL:
+            return BConst(int(round(float(val) * 10 ** ty.scale)), ty)
+        if f == Family.DATE:
+            return BConst((val - EPOCH).days
+                          if isinstance(val, datetime.date) else int(val), ty)
+        if f == Family.TIMESTAMP:
+            if isinstance(val, datetime.datetime):
+                us = int((val - datetime.datetime(1970, 1, 1))
+                         .total_seconds() * 1e6)
+                return BConst(us, ty)
+            return BConst(int(val), ty)
+        return BConst(val, ty)
+
+    def _bind_in_consts(self, x: BExpr, items: list[BConst],
+                        negated: bool) -> BExpr:
+        """IN over pre-bound constant items (subquery results)."""
+        if x.type.family == Family.STRING:
+            d = self._dict_of(x)
+            if d is None:
+                raise BindError("IN on non-dictionary string column")
+            vals = [d.codes[c.value] for c in items
+                    if c.value in d.codes]
+            if not vals:
+                return BConst(negated, BOOL)
+            return BInList(x, vals, negated, BOOL)
+        vals = []
+        target = x.type
+        for c in items:
+            if x.type.is_numeric:
+                target = common_numeric_type(target, c.type)
+        x2 = self.coerce(x, target) if x.type != target else x
+        for c in items:
+            vals.append(self.coerce(c, target).value)
+        if not vals:
+            return BConst(negated, BOOL)
+        return BInList(x2, vals, negated, BOOL)
 
     def bind_literal(self, e: ast.Literal) -> BExpr:
         v, th = e.value, e.type_hint
@@ -290,6 +386,9 @@ class Binder:
             return BConst(parse_date(v), DATE)
         if f == Family.TIMESTAMP and isinstance(v, str):
             return BConst(parse_timestamp(v), TIMESTAMP)
+        if f in (Family.DATE, Family.TIMESTAMP) \
+                and e.type.family == f and isinstance(v, int):
+            return BConst(v, target)  # already physical (days / micros)
         if f == Family.STRING and isinstance(v, str):
             return BConst(v, STRING)
         raise BindError(f"cannot convert constant {v!r} to {target}")
@@ -379,7 +478,12 @@ class Binder:
             l2, r2, t = self._align2(l, r)
             return BBin("%", l2, r2, t)
         if op == "||":
-            raise BindError("string concat on device not supported yet")
+            from . import builtins as bi
+            try:
+                out = bi.bind_builtin(self, "concat", [l, r], e)
+            except bi.BuiltinError as err:
+                raise BindError(str(err)) from err
+            return out
         raise BindError(f"unknown operator {op}")
 
     def bind_mul(self, l: BExpr, r: BExpr) -> BExpr:
@@ -418,6 +522,11 @@ class Binder:
 
     # -- strings over dictionaries --------------------------------------------
     def _dict_of(self, e: BExpr):
+        # nodes that carry their own output dictionary (string builtins,
+        # CASE over constants) chain transforms: upper(trim(col)) works
+        d = getattr(e, "dictionary", None)
+        if d is not None:
+            return d
         if isinstance(e, BCol) and e.type.family == Family.STRING:
             for t in self.scope.tables.values():
                 for b in t.values():
@@ -598,9 +707,17 @@ class Binder:
         if name == "abs":
             x = self.bind(e.args[0])
             return BUnary("abs", x, x.type)
-        if name in ("floor", "ceil", "round", "sqrt", "ln", "exp"):
+        if name == "round" and len(e.args) == 1:
             x = self.coerce(self.bind(e.args[0]), FLOAT8)
             return BUnary(name, x, FLOAT8)
+        from . import builtins as bi
+        args = [self.bind(a) for a in e.args]
+        try:
+            out = bi.bind_builtin(self, name, args, e)
+        except bi.BuiltinError as err:
+            raise BindError(str(err)) from err
+        if out is not None:
+            return out
         raise BindError(f"unknown function {name}")
 
     def _bind_agg(self, e: ast.FuncCall) -> BExpr:

@@ -118,6 +118,33 @@ class BDictRemap(BExpr):
 
 
 @dataclass
+class BFunc(BExpr):
+    """N-ary elementwise builtin on device (pow, atan2, greatest, ...).
+    The kernel table lives in exec/expr.py; the binder (sql/builtins.py)
+    has already coerced arguments to the kernel's expected families."""
+    name: str
+    args: list[BExpr] = field(default_factory=list)
+    type: SQLType = None
+
+
+@dataclass
+class BDictGather(BExpr):
+    """value_table[codes] — a scalar function of a dictionary-encoded
+    string column, pre-evaluated against the dictionary on the host
+    (sql/builtins.py); on device it is one typed gather. Generalizes
+    BDictLookup (bool tables) to arbitrary result types: length() is an
+    int64 table, upper() is a code table into a NEW output dictionary
+    (carried in .dictionary)."""
+    expr: BExpr
+    table: object = None  # np.ndarray[len(dictionary)] of type's dtype
+    type: SQLType = None
+    # output Dictionary for string results. repr=False: two binds of
+    # the same expression build distinct Dictionary objects, and the
+    # planner matches group exprs structurally by repr
+    dictionary: object = field(default=None, repr=False)
+
+
+@dataclass
 class BAggRef(BExpr):
     """Placeholder for aggregate i's result in a post-aggregation
     expression (the reference's execbuilder renders final-stage AVG as
@@ -148,8 +175,11 @@ def _children(e: BExpr):
         return [e.operand]
     if isinstance(e, BBetween):
         return [e.expr, e.lo, e.hi]
-    if isinstance(e, (BInList, BIsNull, BDictLookup, BDictRemap)):
+    if isinstance(e, (BInList, BIsNull, BDictLookup, BDictRemap,
+                      BDictGather)):
         return [e.expr]
+    if isinstance(e, BFunc):
+        return list(e.args)
     if isinstance(e, BCase):
         out = []
         for c, v in e.whens:

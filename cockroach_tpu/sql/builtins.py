@@ -1,0 +1,328 @@
+"""Builtin scalar function library.
+
+The analogue of pkg/sql/sem/builtins (~600 functions in the reference).
+Functions split by execution strategy, each chosen for the TPU:
+
+- **Elementwise numeric/date** (sin, pow, date_trunc, ...): bind to a
+  BFunc/BUnary node whose kernel is a jnp elementwise op —- XLA fuses
+  it into the surrounding scan, so a builtin costs nothing extra.
+- **String functions over dictionary-encoded columns** (upper, length,
+  substr, ...): evaluated ONCE against the column's dictionary on the
+  host at bind time, producing a value table; on device the function is
+  a single gather (BDictGather). upper() over 600M rows costs O(|dict|)
+  host work + one gather — the dictionary-encoding dividend.
+- **Constant folding**: any builtin over constants folds at bind time
+  (the reference's normalization rules, opt/norm).
+
+Registered entries are consulted by Binder.bind_func (binder.py).
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+
+import numpy as np
+
+from .bound import BConst, BDictGather, BExpr, BFunc, BUnary
+from .types import (BOOL, DATE, FLOAT8, INT8, STRING, TIMESTAMP, Family,
+                    SQLType)
+
+
+class BuiltinError(Exception):
+    pass
+
+
+# 1-arg float elementwise builtins: name -> python fn (for constant
+# folding); the device kernel table lives in exec/expr.py:_FUNC_KERNELS
+FLOAT_UNARY = {
+    "sqrt": math.sqrt, "ln": math.log, "exp": math.exp,
+    "log10": math.log10, "log2": math.log2,
+    "cbrt": lambda x: math.copysign(abs(x) ** (1 / 3), x),
+    "sin": math.sin, "cos": math.cos, "tan": math.tan,
+    "cot": lambda x: 1.0 / math.tan(x),
+    "asin": math.asin, "acos": math.acos, "atan": math.atan,
+    "sinh": math.sinh, "cosh": math.cosh, "tanh": math.tanh,
+    "degrees": math.degrees, "radians": math.radians,
+    "floor": math.floor, "ceil": math.ceil, "ceiling": math.ceil,
+}
+
+# 2-arg float elementwise
+FLOAT_BINARY = {
+    "pow": math.pow, "power": math.pow, "atan2": math.atan2,
+}
+
+
+def _fold(name, args, pyfn, ty):
+    """Constant-fold when every argument is a constant."""
+    if all(isinstance(a, BConst) for a in args):
+        vals = [a.value for a in args]
+        if any(v is None for v in vals):
+            return BConst(None, ty)
+        try:
+            return BConst(pyfn(*vals), ty)
+        except (ValueError, OverflowError, ZeroDivisionError):
+            return BConst(None, ty)
+    return None
+
+
+def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
+    """Resolve a builtin call; returns None if unknown (caller errors).
+    ``binder`` provides coerce() and dictionary resolution; ``e`` is the
+    original ast.FuncCall (for string-literal args)."""
+    if name in FLOAT_UNARY:
+        if len(args) != 1:
+            raise BuiltinError(f"{name} takes one argument")
+        x = binder.coerce(args[0], FLOAT8)
+        return _fold(name, [x], FLOAT_UNARY[name], FLOAT8) \
+            or BFunc(name, [x], FLOAT8)
+    if name in FLOAT_BINARY:
+        if len(args) != 2:
+            raise BuiltinError(f"{name} takes two arguments")
+        xs = [binder.coerce(a, FLOAT8) for a in args]
+        return _fold(name, xs, FLOAT_BINARY[name], FLOAT8) \
+            or BFunc(name, xs, FLOAT8)
+    if name in ("round", "trunc") and len(args) == 2:
+        x = binder.coerce(args[0], FLOAT8)
+        nd = args[1]
+        if not isinstance(nd, BConst):
+            raise BuiltinError(f"{name} digit count must be constant")
+        return BFunc(name + "_n", [x, BConst(int(nd.value), INT8)], FLOAT8)
+    if name == "trunc" and len(args) == 1:
+        x = binder.coerce(args[0], FLOAT8)
+        return _fold(name, [x], math.trunc, FLOAT8) \
+            or BFunc("trunc", [x], FLOAT8)
+    if name == "sign":
+        x = binder.coerce(args[0], FLOAT8)
+        return _fold(name, [x], lambda v: float(np.sign(v)), FLOAT8) \
+            or BFunc("sign", [x], FLOAT8)
+    if name == "mod":
+        if len(args) != 2:
+            raise BuiltinError("mod takes two arguments")
+        from .binder import Binder  # for _align2 typing only
+        l, r, ty = binder._align2(args[0], args[1])
+        return BFunc("mod", [l, r], ty)
+    if name == "div":
+        xs = [binder.coerce(a, FLOAT8) for a in args]
+        return BFunc("div", xs, FLOAT8)
+    if name in ("greatest", "least"):
+        if not args:
+            raise BuiltinError(f"{name} needs arguments")
+        ty = args[0].type
+        for a in args[1:]:
+            _, _, ty = binder._align2(BConst(None, ty), a)
+        xs = [binder.coerce(a, ty) for a in args]
+        return BFunc(name, xs, ty)
+    if name == "nullif":
+        if len(args) != 2:
+            raise BuiltinError("nullif takes two arguments")
+        l, r, _ = binder._align2(args[0], args[1])
+        return BFunc("nullif", [l, r], l.type)
+    if name == "pi":
+        return BConst(math.pi, FLOAT8)
+    if name == "isnan":
+        x = binder.coerce(args[0], FLOAT8)
+        return BFunc("isnan", [x], BOOL)
+    if name == "width_bucket":
+        if len(args) != 4:
+            raise BuiltinError("width_bucket(x, lo, hi, n)")
+        xs = [binder.coerce(a, FLOAT8) for a in args[:3]]
+        n = args[3]
+        if not isinstance(n, BConst):
+            raise BuiltinError("width_bucket count must be constant")
+        return BFunc("width_bucket", xs + [BConst(int(n.value), INT8)], INT8)
+
+    # ---- date/time --------------------------------------------------------
+    if name in ("now", "current_timestamp", "transaction_timestamp",
+                "statement_timestamp", "clock_timestamp"):
+        us = binder.now_micros
+        if us is None:
+            raise BuiltinError(f"{name}() needs a statement timestamp")
+        return BConst(int(us), TIMESTAMP)
+    if name == "current_date":
+        us = binder.now_micros
+        if us is None:
+            raise BuiltinError("current_date needs a statement timestamp")
+        return BConst(int(us // 86_400_000_000), DATE)
+    if name == "date_trunc":
+        if len(args) != 2 or not isinstance(args[0], BConst):
+            raise BuiltinError("date_trunc('part', expr)")
+        part = str(args[0].value).lower()
+        x = args[1]
+        if x.type.family not in (Family.DATE, Family.TIMESTAMP):
+            raise BuiltinError("date_trunc needs date/timestamp")
+        if part not in ("year", "quarter", "month", "week", "day",
+                        "hour", "minute", "second"):
+            raise BuiltinError(f"bad date_trunc field {part!r}")
+        if x.type.family == Family.DATE and part in (
+                "hour", "minute", "second", "day"):
+            return x  # trunc below day granularity is identity on DATE
+        kind = "ts" if x.type.family == Family.TIMESTAMP else "date"
+        return BFunc(f"date_trunc_{kind}",
+                     [BConst(part, STRING), x], x.type)
+    if name in ("extract", "date_part"):
+        # EXTRACT has dedicated syntax, but date_part('year', x) arrives
+        # here as a plain call
+        if len(args) != 2 or not isinstance(args[0], BConst):
+            raise BuiltinError("date_part('part', expr)")
+        from .bound import BExtract
+        return BExtract(str(args[0].value).lower(), args[1], INT8)
+    if name == "make_date":
+        xs = [binder.coerce(a, INT8) for a in args]
+        if all(isinstance(a, BConst) for a in xs):
+            y, m, d = (int(a.value) for a in xs)
+            return BConst(
+                (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days,
+                DATE)
+        raise BuiltinError("make_date requires constants")
+    if name == "age":
+        if len(args) == 2:
+            l, r = args
+            if l.type.family == r.type.family == Family.TIMESTAMP:
+                from .bound import BBin
+                from .types import INTERVAL
+                return BBin("-", l, r, INTERVAL)
+        raise BuiltinError("age(timestamp, timestamp)")
+
+    # ---- strings over dictionaries ---------------------------------------
+    out = _bind_string_builtin(binder, name, args)
+    if out is not None:
+        return out
+    return None
+
+
+# string -> string builtins: name -> fn(str, *const_args) -> str
+_STR_TO_STR = {
+    "upper": lambda s: s.upper(),
+    "lower": lambda s: s.lower(),
+    "initcap": lambda s: s.title(),
+    "reverse": lambda s: s[::-1],
+    "btrim": lambda s, chars=None: s.strip(chars),
+    "trim": lambda s, chars=None: s.strip(chars),
+    "ltrim": lambda s, chars=None: s.lstrip(chars),
+    "rtrim": lambda s, chars=None: s.rstrip(chars),
+    "replace": lambda s, a, b: s.replace(a, b),
+    "translate": lambda s, frm, to: s.translate(
+        str.maketrans(frm[:len(to)], to[:len(frm)], frm[len(to):])),
+    "left": lambda s, n: s[:n] if n >= 0 else s[:len(s) + n],
+    "right": lambda s, n: (s[-n:] if n > 0 else s[-n - len(s):]
+                           if n < 0 else ""),
+    "repeat": lambda s, n: s * max(n, 0),
+    "lpad": lambda s, n, fill=" ": _pad(s, n, fill, left=True),
+    "rpad": lambda s, n, fill=" ": _pad(s, n, fill, left=False),
+    "substr": lambda s, start, length=None: _substr(s, start, length),
+    "substring": lambda s, start, length=None: _substr(s, start, length),
+    "concat": None,  # variadic, handled specially
+    "md5": None,     # needs hashlib, handled specially
+}
+
+# string -> scalar builtins: name -> (fn, SQLType)
+_STR_TO_VAL = {
+    "length": (len, INT8),
+    "char_length": (len, INT8),
+    "character_length": (len, INT8),
+    "octet_length": (lambda s: len(s.encode()), INT8),
+    "ascii": (lambda s: ord(s[0]) if s else 0, INT8),
+    "strpos": (lambda s, sub: s.find(sub) + 1, INT8),
+    "position": (lambda s, sub: s.find(sub) + 1, INT8),
+    "starts_with": (lambda s, p: s.startswith(p), BOOL),
+    "ends_with": (lambda s, p: s.endswith(p), BOOL),
+}
+
+
+def _pad(s, n, fill, left):
+    if n <= len(s):
+        return s[:n]
+    pad = (fill * n)[: n - len(s)]
+    return pad + s if left else s + pad
+
+
+def _substr(s, start, length=None):
+    # SQL substring: 1-based; nonpositive start eats into length
+    i = start - 1
+    if length is None:
+        return s[max(i, 0):]
+    end = i + length
+    return s[max(i, 0):max(end, 0)]
+
+
+def _bind_string_builtin(binder, name: str, args: list) -> BExpr | None:
+    import hashlib
+    if name == "md5":
+        fn = lambda s: hashlib.md5(s.encode()).hexdigest()  # noqa: E731
+        return _dict_transform(binder, name, args[0], fn)
+    if name == "concat":
+        # variadic; exactly one dictionary column allowed, rest constants
+        col_i = None
+        parts = []
+        for i, a in enumerate(args):
+            if isinstance(a, BConst):
+                parts.append("" if a.value is None else str(a.value))
+            elif a.type.family == Family.STRING and col_i is None:
+                col_i = i
+                parts.append(None)
+            else:
+                raise BuiltinError(
+                    "concat supports one string column + constants")
+        if col_i is None:
+            return BConst("".join(parts), STRING)
+        pre = "".join(p for p in parts[:col_i] if p is not None)
+        post = "".join(p for p in parts[col_i + 1:] if p is not None)
+        return _dict_transform(binder, name, args[col_i],
+                               lambda s: pre + s + post)
+    if name in _STR_TO_STR:
+        if not args:
+            raise BuiltinError(f"{name} needs arguments")
+        x, consts = args[0], args[1:]
+        cvals = []
+        for c in consts:
+            if not isinstance(c, BConst):
+                raise BuiltinError(
+                    f"{name}: non-leading arguments must be constants")
+            cvals.append(c.value)
+        fn = _STR_TO_STR[name]
+        return _dict_transform(binder, name, x,
+                               lambda s: fn(s, *cvals))
+    if name in _STR_TO_VAL:
+        fn, ty = _STR_TO_VAL[name]
+        x, consts = args[0], args[1:]
+        cvals = []
+        for c in consts:
+            if not isinstance(c, BConst):
+                raise BuiltinError(
+                    f"{name}: non-leading arguments must be constants")
+            cvals.append(c.value)
+        if isinstance(x, BConst):
+            if x.value is None:
+                return BConst(None, ty)
+            return BConst(fn(str(x.value), *cvals), ty)
+        d = binder._dict_of(x)
+        if d is None:
+            raise BuiltinError(f"{name} on non-dictionary column")
+        vals = [fn(v, *cvals) for v in d.values]
+        table = np.asarray(vals,
+                           dtype=bool if ty is BOOL else np.int64)
+        return BDictGather(x, table, ty)
+    return None
+
+
+def _dict_transform(binder, name, x, fn) -> BExpr:
+    """string->string builtin: build an output dictionary by mapping the
+    input dictionary through fn; the device op is a code remap gather."""
+    from ..storage.columnstore import Dictionary
+    if isinstance(x, BConst):
+        if x.value is None:
+            return BConst(None, STRING)
+        return BConst(fn(str(x.value)), STRING)
+    if x.type.family != Family.STRING:
+        raise BuiltinError(f"{name} needs a string argument")
+    d = binder._dict_of(x)
+    if d is None:
+        raise BuiltinError(f"{name} on non-dictionary column")
+    out = Dictionary()
+    codes = np.fromiter((out.encode(fn(v)) for v in d.values),
+                        dtype=np.int64, count=len(d.values))
+    g = BDictGather(x, codes, STRING)
+    g.dictionary = out
+    return g

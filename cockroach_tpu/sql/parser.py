@@ -100,6 +100,8 @@ class Parser:
         t = self.peek()
         if t.is_kw("select"):
             return self.parse_select()
+        if t.is_kw("with"):
+            return self.parse_with()
         if t.is_kw("create"):
             return self.parse_create()
         if t.is_kw("drop"):
@@ -142,6 +144,33 @@ class Parser:
             raise ParseError(f"trailing tokens at {self.peek()}")
 
     # -- SELECT ------------------------------------------------------------
+    def parse_with(self) -> ast.Select:
+        """WITH name [(cols)] AS (select) [, ...] SELECT ... — the CTEs
+        attach to the main Select (non-recursive; RECURSIVE rejected)."""
+        self.expect_kw("with")
+        if self.accept_kw("recursive"):
+            raise ParseError("WITH RECURSIVE not supported")
+        ctes = []
+        while True:
+            name = self.expect_ident()
+            cols = None
+            if self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.parse_with() if self.peek().is_kw("with") \
+                else self.parse_select()
+            self.expect_op(")")
+            ctes.append((name, cols, sub))
+            if not self.accept_op(","):
+                break
+        sel = self.parse_select()
+        sel.ctes = ctes + sel.ctes
+        return sel
+
     def parse_select(self) -> ast.Select:
         self.expect_kw("select")
         sel = ast.Select()
@@ -202,6 +231,15 @@ class Parser:
         return sel
 
     def parse_table_ref(self) -> ast.TableRef:
+        if self.peek().kind == Tok.OP and self.peek().text == "(":
+            # derived table: FROM (SELECT ...) [AS] alias
+            self.next()
+            sub = self.parse_with() if self.peek().is_kw("with") \
+                else self.parse_select()
+            self.expect_op(")")
+            self.accept_kw("as")
+            alias = self.expect_ident()
+            return ast.TableRef(alias, alias, subquery=sub)
         name = self.expect_ident()
         alias = None
         if self.accept_kw("as"):
@@ -298,6 +336,11 @@ class Parser:
             return ast.Between(left, lo, hi, negated=negated)
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.peek().is_kw("select", "with"):
+                sub = self.parse_with() if self.peek().is_kw("with") \
+                    else self.parse_select()
+                self.expect_op(")")
+                return ast.InSubquery(left, sub, negated=negated)
             items = [self.parse_expr()]
             while self.accept_op(","):
                 items.append(self.parse_expr())
@@ -344,9 +387,20 @@ class Parser:
         if t.kind == Tok.OP and t.text == "+":
             return self.parse_expr(70)
         if t.kind == Tok.OP and t.text == "(":
+            if self.peek().is_kw("select", "with"):
+                sub = self.parse_with() if self.peek().is_kw("with") \
+                    else self.parse_select()
+                self.expect_op(")")
+                return ast.Subquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if t.is_kw("exists"):
+            self.expect_op("(")
+            sub = self.parse_with() if self.peek().is_kw("with") \
+                else self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub)
         if t.is_kw("case"):
             whens = []
             operand = None
@@ -401,6 +455,10 @@ class Parser:
             return ast.Substring(e, start, length)
         if t.kind in (Tok.IDENT, Tok.KEYWORD):
             name = t.text
+            # parenless special-syntax functions (SQL standard)
+            if name in ("current_date", "current_timestamp") and not (
+                    self.peek().kind == Tok.OP and self.peek().text == "("):
+                return ast.FuncCall(name, [])
             # function call?
             if self.peek().kind == Tok.OP and self.peek().text == "(":
                 self.next()

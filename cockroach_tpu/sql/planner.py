@@ -70,8 +70,13 @@ def and_all(conjuncts: list[BExpr]) -> BExpr:
 
 
 class Planner:
-    def __init__(self, catalog: CatalogView):
+    def __init__(self, catalog: CatalogView, subquery_eval=None,
+                 now_micros=None):
         self.catalog = catalog
+        # engine-supplied hooks: subquery execution + statement
+        # timestamp for now()/current_date (binder.py)
+        self.subquery_eval = subquery_eval
+        self.now_micros = now_micros
 
     def _keys_unique(self, cand_alias: str, cand_table: str, pool,
                      other_side: set, _key_side, scans) -> bool:
@@ -139,7 +144,8 @@ class Planner:
         for j in join_specs:
             add_table(j.table)
 
-        binder = Binder(scope)
+        binder = Binder(scope, subquery_eval=self.subquery_eval,
+                        now_micros=self.now_micros)
 
         # ---- gather predicates ---------------------------------------------
         conjuncts: list[BExpr] = []
@@ -366,11 +372,21 @@ class Planner:
 
         group_exprs: list[tuple[str, BExpr]] = []
         if has_group:
+            item_by_name = {n: e for n, e in items}
             for i, g in enumerate(sel.group_by):
                 # allow GROUP BY <position> and GROUP BY <alias>
                 if isinstance(g, ast.Literal) and isinstance(g.value, int):
                     name, expr = items[g.value - 1]
                     bexpr = binder.bind(expr)
+                elif isinstance(g, ast.ColumnRef) and g.table is None:
+                    try:
+                        bexpr = binder.bind(g)  # real columns win
+                        name = _default_name(g)
+                    except BindError:
+                        if g.name not in item_by_name:
+                            raise
+                        bexpr = binder.bind(item_by_name[g.name])
+                        name = g.name
                 else:
                     bexpr = binder.bind(g)
                     name = _default_name(g)
@@ -499,7 +515,12 @@ class Planner:
                     return d
                 # grouped output referencing a group column
                 for gn, ge in group_exprs:
-                    if b.name == gn and isinstance(ge, BCol):
+                    if b.name != gn:
+                        continue
+                    gd = getattr(ge, "dictionary", None)
+                    if gd is not None:
+                        return gd  # string-builtin transform output
+                    if isinstance(ge, BCol):
                         return self._dict_by_batch_name(ge.name, scope)
         return None
 
