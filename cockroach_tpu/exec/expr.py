@@ -22,7 +22,8 @@ import numpy as np
 from ..ops import kernels as K
 from ..sql.bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce,
                          BCol, BConst, BDictGather, BDictLookup, BDictRemap,
-                         BExpr, BExtract, BFunc, BInList, BIsNull, BUnary)
+                         BExpr, BExtract, BFunc, BInList, BIsNull, BUnary,
+                         BWinRef)
 from ..sql.types import Family, SQLType
 
 
@@ -73,6 +74,13 @@ def compile_expr(e: BExpr) -> CompiledExpr:
         def f_agg(ctx):
             return ctx.aggs[i]
         return f_agg
+
+    if isinstance(e, BWinRef):
+        wname = f"__win{e.index}"
+
+        def f_win(ctx):
+            return ctx.col(wname)
+        return f_win
 
     if isinstance(e, BBin):
         lf, rf = compile_expr(e.left), compile_expr(e.right)

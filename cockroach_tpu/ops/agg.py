@@ -94,6 +94,26 @@ def group_max(data, group_ids, mask, num_groups: int):
     return jax.ops.segment_max(d, gid, num_segments=num_groups)
 
 
+def distinct_first_mask(data, mask, group_ids, num_groups: int):
+    """True at the FIRST masked occurrence of each (group, value) pair.
+
+    DISTINCT aggregates become ordinary aggregates with this extra
+    mask: sort rows by (group, value), flag group/value changes,
+    scatter the flags back — one lexsort, no per-group work (the
+    reference dedups inside its hash aggregator per-bucket instead,
+    colexec/distinct.eg.go)."""
+    n = data.shape[0]
+    sentinel = jnp.int64(num_groups)
+    g = jnp.where(mask, group_ids.astype(jnp.int64), sentinel)
+    order = jnp.lexsort((data, g))
+    gs, ds = g[order], data[order]
+    first = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_),
+        jnp.logical_or(gs[1:] != gs[:-1], ds[1:] != ds[:-1])])
+    first = jnp.logical_and(first, gs < sentinel)
+    return jnp.zeros((n,), jnp.bool_).at[order].set(first)
+
+
 # ---------------------------------------------------------------------------
 # aggregate spec machinery (mirrors AggregatorSpec_Func,
 # execinfrapb/processors_sql.proto:798, and the local/final decomposition)

@@ -91,6 +91,16 @@ class Extract(Expr):
 
 
 @dataclass
+class WindowCall(Expr):
+    """f(args) OVER (PARTITION BY ... ORDER BY ...)."""
+    func: str
+    args: list[Expr] = field(default_factory=list)
+    star: bool = False
+    partition_by: list[Expr] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+
+
+@dataclass
 class Subquery(Expr):
     """Scalar subquery: (SELECT one column, at most one row). Executed
     before the main statement and inlined as a constant (the

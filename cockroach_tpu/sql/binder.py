@@ -28,7 +28,7 @@ import numpy as np
 from . import ast
 from .bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce, BCol,
                     BConst, BDictLookup, BDictRemap, BExpr, BExtract, BInList,
-                    BIsNull, BoundAgg, BUnary)
+                    BIsNull, BoundAgg, BoundWindow, BUnary, BWinRef)
 from .types import (BOOL, DATE, FLOAT8, INT8, INTERVAL, STRING, TIMESTAMP,
                     Family, SQLType, common_numeric_type)
 
@@ -175,6 +175,9 @@ class Binder:
         self.subquery_eval = subquery_eval
         # statement timestamp in unix micros for now()/current_date
         self.now_micros = now_micros
+        # window function instances (bind_with_windows)
+        self.windows: list[BoundWindow] = []
+        self._collect_windows = False
 
     # -- main dispatch -------------------------------------------------------
     def bind(self, e: ast.Expr) -> BExpr:
@@ -210,6 +213,8 @@ class Binder:
             return self.bind_cast(self.bind(e.expr), e.to)
         if isinstance(e, ast.FuncCall):
             return self.bind_func(e)
+        if isinstance(e, ast.WindowCall):
+            return self.bind_window(e)
         if isinstance(e, ast.Extract):
             x = self.bind(e.expr)
             if x.type.family not in (Family.DATE, Family.TIMESTAMP):
@@ -749,8 +754,8 @@ class Binder:
                 spec = BoundAgg(name, arg, arg.type, e.distinct)
             else:
                 raise BindError(name)
-        if spec.distinct and spec.func not in ("count",):
-            raise BindError(f"DISTINCT {name} not supported")
+        if spec.distinct and spec.func in ("min", "max"):
+            spec.distinct = False  # DISTINCT is a no-op for min/max
         # dedup identical aggregates
         for i, existing in enumerate(self.aggs):
             if _agg_key(existing) == _agg_key(spec):
@@ -764,6 +769,75 @@ class Binder:
             return self.bind(e)
         finally:
             self._collect_aggs = False
+
+    # -- window functions ---------------------------------------------------
+    WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead",
+                    "first_value", "last_value", "ntile"}
+
+    def bind_window(self, e: ast.WindowCall) -> BExpr:
+        if not self._collect_windows:
+            raise BindError("window functions not allowed here")
+        name = e.func
+        parts = [self.bind(p) for p in e.partition_by]
+        orders = [(self.bind(o.expr), o.desc) for o in e.order_by]
+        offset = 1
+        arg = None
+        if name in ("row_number", "rank", "dense_rank"):
+            if e.args:
+                raise BindError(f"{name}() takes no arguments")
+            if not orders:
+                raise BindError(f"{name}() requires ORDER BY")
+            ty = INT8
+        elif name in ("lag", "lead"):
+            if not 1 <= len(e.args) <= 2:
+                raise BindError(f"{name}(expr[, offset])")
+            if not orders:
+                raise BindError(f"{name}() requires ORDER BY")
+            arg = self.bind(e.args[0])
+            if len(e.args) == 2:
+                off = self.bind(e.args[1])
+                if not isinstance(off, BConst):
+                    raise BindError(f"{name} offset must be constant")
+                offset = int(off.value)
+            ty = arg.type
+        elif name in ("first_value", "last_value"):
+            if len(e.args) != 1:
+                raise BindError(f"{name}(expr)")
+            arg = self.bind(e.args[0])
+            ty = arg.type
+        elif name == "count" and e.star:
+            ty = INT8
+            name = "count_rows"
+        elif name in AGG_FUNCS:
+            if len(e.args) != 1:
+                raise BindError(f"{name} takes one argument")
+            arg = self.bind(e.args[0])
+            if name == "count":
+                ty = INT8
+            elif name == "avg":
+                ty = FLOAT8
+            elif name == "sum":
+                if arg.type.family == Family.INT:
+                    name, ty = "sum_int", INT8
+                elif arg.type.family == Family.DECIMAL:
+                    ty = arg.type
+                else:
+                    arg = self.coerce(arg, FLOAT8)
+                    ty = FLOAT8
+            else:  # min/max
+                ty = arg.type
+        else:
+            raise BindError(f"unknown window function {name}")
+        spec = BoundWindow(name, arg, parts, orders, offset, ty)
+        self.windows.append(spec)
+        return BWinRef(len(self.windows) - 1, ty)
+
+    def bind_with_windows(self, e: ast.Expr) -> BExpr:
+        self._collect_windows = True
+        try:
+            return self.bind(e)
+        finally:
+            self._collect_windows = False
 
 
 def _agg_key(a: BoundAgg):

@@ -154,6 +154,27 @@ class BAggRef(BExpr):
 
 
 @dataclass
+class BWinRef(BExpr):
+    """Placeholder for window function i's result column (the Window
+    plan node materializes it as batch column __win{i})."""
+    index: int
+    type: SQLType = None
+
+
+@dataclass
+class BoundWindow:
+    """One window function instance: func(arg) OVER (partition, order).
+    Offset carries the lag/lead distance."""
+    func: str  # row_number|rank|dense_rank|lag|lead|first_value|
+    #            last_value|sum|sum_int|count|count_rows|min|max|avg
+    arg: Optional[BExpr]
+    partition_by: list[BExpr] = field(default_factory=list)
+    order_by: list[tuple[BExpr, bool]] = field(default_factory=list)
+    offset: int = 1  # lag/lead distance
+    type: SQLType = None
+
+
+@dataclass
 class BoundAgg:
     """One aggregate instance: func(arg) [distinct]."""
     func: str  # sum | count | count_rows | min | max | avg | sum_int

@@ -32,7 +32,8 @@ KEYWORDS = {
     "explain", "begin", "commit", "rollback", "transaction", "index",
     "analyze", "if", "coalesce", "nulls", "first", "last", "default",
     "cluster", "setting", "extract", "substring", "backup", "restore",
-    "to", "with",
+    "to", "with", "over", "partition", "recursive", "rows", "range",
+    "groups",
 }
 
 MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]

@@ -464,7 +464,10 @@ class Parser:
                 self.next()
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return ast.FuncCall(name, [], star=True)
+                    fc = ast.FuncCall(name, [], star=True)
+                    if self.peek().is_kw("over"):
+                        return self.parse_over(fc)
+                    return fc
                 distinct = self.accept_kw("distinct")
                 args = []
                 if not self.accept_op(")"):
@@ -472,7 +475,10 @@ class Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                     self.expect_op(")")
-                return ast.FuncCall(name, args, distinct=distinct)
+                fc = ast.FuncCall(name, args, distinct=distinct)
+                if self.peek().is_kw("over"):
+                    return self.parse_over(fc)
+                return fc
             # qualified column a.b
             if self.peek().kind == Tok.OP and self.peek().text == ".":
                 self.next()
@@ -480,6 +486,36 @@ class Parser:
                 return ast.ColumnRef(col, table=name)
             return ast.ColumnRef(name)
         raise ParseError(f"unexpected token {t}")
+
+    def parse_over(self, fc: ast.FuncCall) -> ast.WindowCall:
+        """OVER ( [PARTITION BY e,...] [ORDER BY e [ASC|DESC],...] )."""
+        self.expect_kw("over")
+        self.expect_op("(")
+        parts: list[ast.Expr] = []
+        orders: list[ast.OrderItem] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            parts.append(self.parse_expr())
+            while self.accept_op(","):
+                parts.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                orders.append(ast.OrderItem(e, desc))
+                if not self.accept_op(","):
+                    break
+        if self.peek().is_kw("rows", "range", "groups"):
+            raise ParseError("explicit window frames not supported")
+        self.expect_op(")")
+        if fc.distinct:
+            raise ParseError("DISTINCT in window functions not supported")
+        return ast.WindowCall(fc.name, fc.args, fc.star, parts, orders)
 
     def parse_type(self) -> SQLType:
         t = self.next()

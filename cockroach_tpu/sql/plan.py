@@ -71,6 +71,15 @@ class Aggregate(PlanNode):
 
 
 @dataclass
+class Window(PlanNode):
+    """Materialize window function results as __win{i} columns on the
+    child batch (colexecwindow analogue; one lexsort + scans per spec,
+    ops/window.py)."""
+    child: PlanNode
+    windows: list = field(default_factory=list)  # BoundWindow
+
+
+@dataclass
 class Sort(PlanNode):
     child: PlanNode
     keys: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
@@ -124,6 +133,9 @@ def plan_tree_repr(node: PlanNode, indent: int = 0,
         return (f"{pad}Aggregate groups={[n for n, _ in node.group_by]} "
                 f"aggs={[a.func for a in node.aggs]}{ann()}\n"
                 + child(node.child))
+    if isinstance(node, Window):
+        return (f"{pad}Window {[w.func for w in node.windows]}{ann()}\n"
+                + child(node.child))
     if isinstance(node, Sort):
         return f"{pad}Sort {node.keys}{ann()}\n" + child(node.child)
     if isinstance(node, Limit):
@@ -172,6 +184,14 @@ def prune_scan_columns(root: PlanNode) -> PlanNode:
                 needed.update(referenced_columns(n.having))
             for _, e in n.items:
                 needed.update(referenced_columns(e))
+        elif isinstance(n, Window):
+            for w in n.windows:
+                if w.arg is not None:
+                    needed.update(referenced_columns(w.arg))
+                for p in w.partition_by:
+                    needed.update(referenced_columns(p))
+                for o, _ in w.order_by:
+                    needed.update(referenced_columns(o))
         elif isinstance(n, Sort):
             needed.update(name for name, _ in n.keys)
         for attr in ("child", "left", "right"):

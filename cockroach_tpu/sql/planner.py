@@ -394,11 +394,19 @@ class Planner:
 
         bound_items: list[tuple[str, BExpr]] = []
         any_agg = False
-        for name, expr in items:
-            b = binder.bind_with_aggs(expr)
-            bound_items.append((name, b))
-            if any(isinstance(n, BAggRef) for n in walk(b)):
-                any_agg = True
+        binder._collect_windows = not has_group  # windows over raw rows
+        try:
+            for name, expr in items:
+                b = binder.bind_with_aggs(expr)
+                bound_items.append((name, b))
+                if any(isinstance(n, BAggRef) for n in walk(b)):
+                    any_agg = True
+        finally:
+            binder._collect_windows = False
+        if binder.windows and (has_group or binder.aggs):
+            raise PlanError(
+                "window functions over grouped queries not supported yet "
+                "(wrap the GROUP BY in a subquery)")
 
         having_b = None
         if sel.having is not None:
@@ -433,6 +441,8 @@ class Planner:
             out_names = [n for n, _ in bound_items]
             out_types = [b.type for _, b in bound_items]
         else:
+            if binder.windows:
+                node = plan.Window(node, binder.windows)
             node = plan.Project(node, bound_items)
             out_names = [n for n, _ in bound_items]
             out_types = [b.type for _, b in bound_items]
