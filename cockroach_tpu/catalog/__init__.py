@@ -1,0 +1,18 @@
+"""Catalog: versioned table descriptors in KV + descriptor leases.
+
+The analogue of pkg/sql/catalog: descriptors are the system of record
+for schema (descpb.TableDescriptor), stored transactionally in the KV
+plane under /desc/<id> with a /nsp/<name> namespace index, versioned
+on every schema change; the lease manager (catalog/lease/lease.go:672)
+hands planners cached descriptor versions under expiring leases and
+enforces the two-version invariant: a new version cannot be published
+for use until every lease on version-2 is released or expired.
+"""
+
+from .catalog import Catalog, CatalogError, DESC_PREFIX, NSP_PREFIX
+from .descriptor import TableDescriptor, ColumnDescriptor
+from .lease import LeaseManager, LeasedDescriptor
+
+__all__ = ["Catalog", "CatalogError", "TableDescriptor",
+           "ColumnDescriptor", "LeaseManager", "LeasedDescriptor",
+           "DESC_PREFIX", "NSP_PREFIX"]

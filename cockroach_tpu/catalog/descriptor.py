@@ -1,0 +1,103 @@
+"""Table descriptors: the serialized schema record.
+
+The analogue of descpb.TableDescriptor (pkg/sql/catalog/descpb): a
+versioned, state-carrying schema object. Columns carry a state so a
+schema change can add a column in DELETE_AND_WRITE_ONLY before it
+becomes PUBLIC (the two-step of the reference's schema changer);
+readers only see PUBLIC columns.
+
+Serialization is JSON (the reference uses protobuf; the wire format is
+an implementation detail — what matters is that descriptors round-trip
+through the KV plane byte-exactly and carry their version).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ..sql.types import ColumnSchema, Family, SQLType, TableSchema
+
+PUBLIC = "public"
+WRITE_ONLY = "write_only"    # writes include it, reads don't see it
+DROPPED = "dropped"
+
+
+@dataclass
+class ColumnDescriptor:
+    name: str
+    type: SQLType
+    nullable: bool = True
+    state: str = PUBLIC
+    default: object = None  # constant backfill value
+
+
+@dataclass
+class TableDescriptor:
+    id: int
+    name: str
+    version: int = 1
+    columns: list[ColumnDescriptor] = field(default_factory=list)
+    primary_key: list[str] = field(default_factory=list)
+    state: str = PUBLIC  # table-level: public | dropped
+
+    # -- schema views -------------------------------------------------------
+    def public_schema(self) -> TableSchema:
+        """What readers/planners see: PUBLIC columns only."""
+        return TableSchema(
+            name=self.name,
+            columns=[ColumnSchema(c.name, c.type, c.nullable)
+                     for c in self.columns if c.state == PUBLIC],
+            primary_key=list(self.primary_key),
+            table_id=self.id)
+
+    def column(self, name: str) -> ColumnDescriptor:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    # -- serde --------------------------------------------------------------
+    def encode(self) -> bytes:
+        return json.dumps({
+            "id": self.id,
+            "name": self.name,
+            "version": self.version,
+            "state": self.state,
+            "primary_key": self.primary_key,
+            "columns": [{
+                "name": c.name,
+                "type": _enc_type(c.type),
+                "nullable": c.nullable,
+                "state": c.state,
+                "default": c.default,
+            } for c in self.columns],
+        }).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "TableDescriptor":
+        o = json.loads(raw.decode())
+        return cls(
+            id=o["id"], name=o["name"], version=o["version"],
+            state=o["state"], primary_key=list(o["primary_key"]),
+            columns=[ColumnDescriptor(
+                c["name"], _dec_type(c["type"]), c["nullable"],
+                c["state"], c.get("default")) for c in o["columns"]])
+
+    @classmethod
+    def from_schema(cls, schema: TableSchema) -> "TableDescriptor":
+        return cls(
+            id=schema.table_id, name=schema.name,
+            columns=[ColumnDescriptor(c.name, c.type, c.nullable)
+                     for c in schema.columns],
+            primary_key=list(schema.primary_key))
+
+
+def _enc_type(t: SQLType) -> dict:
+    return {"family": t.family.value, "width": t.width,
+            "precision": t.precision, "scale": t.scale}
+
+
+def _dec_type(o: dict) -> SQLType:
+    return SQLType(Family(o["family"]), width=o["width"],
+                   precision=o["precision"], scale=o["scale"])

@@ -188,6 +188,13 @@ class Engine:
         # committed effects into the columnstore scan plane
         self.kv = KVDB(KVStore(clock=self.clock))
         self.settings = settings or Settings()
+        # catalog: versioned descriptors in KV + leases (pkg/sql/catalog);
+        # the columnstore's TableData.schema is the runtime cache of the
+        # PUBLIC schema, kept in sync by the DDL/schema-change paths
+        from ..catalog import Catalog, LeaseManager
+        self.catalog = Catalog(self.kv)
+        self.leases = LeaseManager(self.catalog, holder=f"sql-{id(self)}",
+                                   now_ns=lambda: self.clock.now().wall)
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
@@ -266,6 +273,8 @@ class Engine:
             return self._exec_create(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._exec_drop(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._exec_alter(stmt, session)
         if isinstance(stmt, ast.Insert):
             return self._exec_insert(stmt, session)
         if isinstance(stmt, ast.Update):
@@ -278,6 +287,14 @@ class Engine:
             else:
                 session.vars.set(stmt.name, stmt.value)
             return Result(tag="SET")
+        if isinstance(stmt, ast.ShowTables):
+            descs = sorted(self.catalog.list_tables(),
+                           key=lambda d: d.name)
+            return Result(
+                names=["table_name", "version"],
+                rows=[(d.name, d.version) for d in descs
+                      if not d.name.startswith("__")],
+                tag="SHOW TABLES")
         if isinstance(stmt, ast.ShowVar):
             v = session.vars.get(stmt.name, None)
             if v is None:
@@ -336,7 +353,21 @@ class Engine:
     # -- catalog -------------------------------------------------------------
     def catalog_view(self) -> CatalogView:
         from ..sql.stats import TableStats
-        schemas = {n: td.schema for n, td in self.store.tables.items()}
+        # planners see the PUBLIC schema: columns mid-add (WRITE_ONLY
+        # descriptor state, schemachange.py) are physically present but
+        # hidden until published
+        schemas = {}
+        for n, td in self.store.tables.items():
+            if any(c.hidden for c in td.schema.columns):
+                s = TableSchema(
+                    name=td.schema.name,
+                    columns=[c for c in td.schema.columns
+                             if not c.hidden],
+                    primary_key=list(td.schema.primary_key),
+                    table_id=td.schema.table_id)
+                schemas[n] = s
+            else:
+                schemas[n] = td.schema
         dicts = {n: dict(td.dictionaries)
                  for n, td in self.store.tables.items()}
         stats = {}
@@ -1034,6 +1065,7 @@ class Engine:
 
     # -- DDL -----------------------------------------------------------------
     def _exec_create(self, c: ast.CreateTable) -> Result:
+        from ..catalog import CatalogError, TableDescriptor
         if c.name in self.store.tables:
             if c.if_not_exists:
                 return Result(tag="CREATE TABLE")
@@ -1042,20 +1074,125 @@ class Engine:
             name=c.name,
             columns=[ColumnSchema(d.name, d.type, d.nullable)
                      for d in c.columns],
-            primary_key=list(c.primary_key),
-            table_id=self.store.alloc_table_id())
+            primary_key=list(c.primary_key))
+        # the descriptor (catalog, system of record) is written first,
+        # transactionally — two racing CREATEs conflict on the
+        # namespace key; the columnstore table is the scan-plane
+        # materialization keyed by the allocated descriptor id
+        try:
+            desc = self.catalog.create_table(
+                TableDescriptor.from_schema(schema))
+        except CatalogError as e:
+            if c.if_not_exists:
+                return Result(tag="CREATE TABLE")
+            raise EngineError(str(e)) from e
+        schema.table_id = desc.id
         self.store.create_table(schema)
         return Result(tag="CREATE TABLE")
 
     def _exec_drop(self, d: ast.DropTable) -> Result:
+        from ..catalog import CatalogError
         if d.name not in self.store.tables:
             if d.if_exists:
                 return Result(tag="DROP TABLE")
             raise EngineError(f"table {d.name!r} does not exist")
+        try:
+            self.catalog.drop_table(d.name)
+        except CatalogError:
+            pass  # store-only table (pre-catalog tests); still drop it
         self.store.drop_table(d.name)
         for k in [k for k in self._device_tables if k[0] == d.name]:
             self._evict_device(k)
         return Result(tag="DROP TABLE")
+
+    # -- schema changes -------------------------------------------------------
+    @property
+    def jobs(self):
+        """Lazily-built jobs registry for engine-initiated work
+        (schema changes); Nodes build their own adopting registry."""
+        if getattr(self, "_jobs", None) is None:
+            from ..jobs import Registry
+            from ..jobs.schemachange import (SCHEMA_CHANGE_JOB,
+                                             SchemaChangeResumer)
+            self._jobs = Registry(self.kv,
+                                  session_id=f"engine-{id(self)}")
+            self._jobs.register(SCHEMA_CHANGE_JOB,
+                                lambda: SchemaChangeResumer(self))
+        return self._jobs
+
+    def _exec_alter(self, a: ast.AlterTable, session: Session) -> Result:
+        """Online schema change: the descriptor moves through
+        WRITE_ONLY -> (backfill job) -> PUBLIC with a lease drain at
+        each version bump (catalog/lease.py), like the reference's
+        schema changer (pkg/sql/schemachanger via pkg/jobs)."""
+        from ..catalog import CatalogError
+        from ..catalog.descriptor import WRITE_ONLY, ColumnDescriptor
+        from ..jobs.schemachange import SCHEMA_CHANGE_JOB
+        if a.table not in self.store.tables:
+            raise EngineError(f"table {a.table!r} does not exist")
+        desc = self.catalog.get_by_name(a.table)
+        if desc is None:
+            raise EngineError(
+                f"table {a.table!r} has no descriptor (pre-catalog)")
+        if a.drop is not None:
+            colname = a.drop
+            if not any(c.name == colname for c in desc.columns):
+                raise EngineError(f"column {colname!r} does not exist")
+            if colname in desc.primary_key:
+                raise EngineError(
+                    f"cannot drop primary key column {colname!r}")
+            # step 1: hide from readers, publish, drain leases
+            desc.column(colname).state = WRITE_ONLY
+            self.store.hide_column(a.table, colname)
+            desc = self.leases.publish(desc)
+            # step 2: physically remove, publish the final version
+            desc.columns = [c for c in desc.columns
+                            if c.name != colname]
+            self.store.drop_column(a.table, colname)
+            self.leases.publish(desc)
+            for k in [k for k in self._device_tables
+                      if k[0] == a.table]:
+                self._evict_device(k)
+            return Result(tag="ALTER TABLE")
+
+        # ADD COLUMN
+        cdef = a.add
+        if any(c.name == cdef.name for c in desc.columns):
+            raise EngineError(f"column {cdef.name!r} already exists")
+        default_phys = None
+        if a.default is not None:
+            binder = Binder(Scope())
+            b = binder.bind(a.default)
+            if not isinstance(b, BConst):
+                raise EngineError("DEFAULT must be a constant")
+            if b.value is not None:
+                default_phys = binder.coerce(b, cdef.type).value
+        if not cdef.nullable and default_phys is None \
+                and self.store.table(a.table).row_count > 0:
+            raise EngineError(
+                "adding NOT NULL column to non-empty table requires "
+                "DEFAULT")
+        # step 1: WRITE_ONLY descriptor + hidden physical column —
+        # writes carry it, readers don't see it yet
+        desc.columns.append(ColumnDescriptor(
+            cdef.name, cdef.type, cdef.nullable, WRITE_ONLY,
+            default_phys))
+        desc = self.leases.publish(desc)
+        self.store.add_column(
+            a.table, ColumnSchema(cdef.name, cdef.type, cdef.nullable),
+            default=default_phys, hidden=True)
+        # step 2+3: chunk-checkpointed backfill + PUBLIC publish run as
+        # a durable job (resumable after a crash)
+        job_id = self.jobs.create(SCHEMA_CHANGE_JOB,
+                                  {"table": a.table,
+                                   "column": cdef.name})
+        rec = self.jobs.run_job(job_id)
+        if rec.status != "succeeded":
+            raise EngineError(
+                f"schema change failed: {rec.error or rec.status}")
+        for k in [k for k in self._device_tables if k[0] == a.table]:
+            self._evict_device(k)
+        return Result(tag="ALTER TABLE")
 
     # -- DML (through the transactional KV plane) ----------------------------
     # Every DML statement writes row intents through kv.Txn (latches,

@@ -4,8 +4,10 @@ from .import_job import IMPORT_JOB, ImportResumer, synthetic_chunk
 from .registry import (CANCELED, FAILED, PENDING, RUNNING, SUCCEEDED,
                        JobCanceled, JobContext, JobRecord, JobsError,
                        Registry)
+from .schemachange import SCHEMA_CHANGE_JOB, SchemaChangeResumer
 
 __all__ = ["Registry", "JobRecord", "JobContext", "JobsError",
            "JobCanceled", "ImportResumer", "IMPORT_JOB",
            "synthetic_chunk", "PENDING", "RUNNING", "SUCCEEDED",
-           "FAILED", "CANCELED"]
+           "FAILED", "CANCELED", "SCHEMA_CHANGE_JOB",
+           "SchemaChangeResumer"]

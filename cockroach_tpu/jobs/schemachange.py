@@ -1,0 +1,81 @@
+"""Schema-change job: online ADD COLUMN backfill.
+
+The analogue of the reference's schema changer running as a job
+(pkg/sql/schemachanger executed through pkg/jobs; legacy backfill in
+pkg/sql/backfill): the column is added to the descriptor in WRITE_ONLY
+state and to the scan plane hidden, then this job backfills sealed
+chunks one at a time (each chunk a checkpoint), and finally publishes
+the descriptor version with the column PUBLIC and unhides it. Every
+step is idempotent, so a crashed job resumes from its checkpoint and
+a re-run of a finished step is a no-op.
+"""
+
+from __future__ import annotations
+
+from .registry import JobContext
+
+SCHEMA_CHANGE_JOB = "schema-change"
+
+
+class SchemaChangeResumer:
+    """payload: {table, column}; progress: {chunks_done}."""
+
+    def __init__(self, engine, crash_after_chunk=None):
+        self.engine = engine
+        self.crash_after_chunk = crash_after_chunk
+
+    def resume(self, ctx: JobContext) -> None:
+        from ..catalog import CatalogError
+        from ..catalog.descriptor import PUBLIC
+        p = ctx.payload
+        table, column = p["table"], p["column"]
+        store = self.engine.store
+        catalog = self.engine.catalog
+
+        desc = catalog.get_by_name(table)
+        if desc is None:
+            raise CatalogError(f"table {table!r} vanished mid-change")
+        col = desc.column(column)
+        if col.state != PUBLIC:
+            # backfill loop: chunks can grow while we run (concurrent
+            # inserts), so iterate until none are missing the column
+            done = int(ctx.progress().get("chunks_done", 0))
+            while True:
+                ctx.check_cancel()
+                missing = store.unfilled_chunks(table, column)
+                if not missing:
+                    break
+                for ci in missing:
+                    ctx.check_cancel()
+                    store.backfill_column_chunk(table, column, ci)
+                    done += 1
+                    if (self.crash_after_chunk is not None
+                            and done >= self.crash_after_chunk):
+                        from .registry import _CrashForTesting
+                        raise _CrashForTesting()
+                    ctx.checkpoint({"chunks_done": done})
+            # publish: descriptor version+1 with the column PUBLIC,
+            # wait for old leases (two-version invariant), then unhide
+            # in the scan plane
+            col.state = PUBLIC
+            self.engine.leases.publish(desc)
+        store.publish_column(table, column)
+        ctx.checkpoint({"chunks_done": ctx.progress().get(
+            "chunks_done", 0), "published": True}, fraction=1.0)
+
+    def on_fail_or_cancel(self, ctx: JobContext) -> None:
+        """Roll back: drop the half-added hidden column."""
+        p = ctx.payload
+        try:
+            td = self.engine.store.table(p["table"])
+            if any(c.name == p["column"] and c.hidden
+                   for c in td.schema.columns):
+                self.engine.store.drop_column(p["table"], p["column"])
+            desc = self.engine.catalog.get_by_name(p["table"])
+            if desc is not None and any(c.name == p["column"]
+                                        for c in desc.columns):
+                desc.columns = [c for c in desc.columns
+                                if c.name != p["column"]]
+                self.engine.catalog.write_new_version(desc)
+        except KeyError:
+            pass

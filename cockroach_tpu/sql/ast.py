@@ -206,6 +206,16 @@ class DropTable(Statement):
 
 
 @dataclass
+class AlterTable(Statement):
+    """ALTER TABLE <t> ADD COLUMN <def> [DEFAULT lit] | DROP COLUMN <c>.
+    Executed as an online schema change (jobs/schemachange.py)."""
+    table: str
+    add: Optional[ColumnDef] = None
+    default: Optional[Expr] = None
+    drop: Optional[str] = None
+
+
+@dataclass
 class Insert(Statement):
     table: str
     columns: list[str]  # empty = all
@@ -236,6 +246,11 @@ class SetVar(Statement):
 @dataclass
 class ShowVar(Statement):
     name: str
+
+
+@dataclass
+class ShowTables(Statement):
+    pass
 
 
 @dataclass

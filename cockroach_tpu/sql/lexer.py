@@ -33,7 +33,7 @@ KEYWORDS = {
     "analyze", "if", "coalesce", "nulls", "first", "last", "default",
     "cluster", "setting", "extract", "substring", "backup", "restore",
     "to", "with", "over", "partition", "recursive", "rows", "range",
-    "groups",
+    "groups", "alter", "add", "column",
 }
 
 MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]

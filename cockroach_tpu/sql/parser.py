@@ -106,6 +106,8 @@ class Parser:
             return self.parse_create()
         if t.is_kw("drop"):
             return self.parse_drop()
+        if t.is_kw("alter"):
+            return self.parse_alter()
         if t.is_kw("insert"):
             return self.parse_insert()
         if t.is_kw("update"):
@@ -116,6 +118,10 @@ class Parser:
             return self.parse_set()
         if t.is_kw("show"):
             self.next()
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "tables":
+                self.next()
+                return ast.ShowTables()
             self.accept_kw("cluster")
             self.accept_kw("setting")
             return ast.ShowVar(self.dotted_name())
@@ -587,6 +593,34 @@ class Parser:
                 break
         self.expect_op(")")
         return ast.CreateTable(name, cols, pk, if_not_exists)
+
+    def parse_alter(self) -> ast.Statement:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.expect_ident()
+        if self.accept_kw("add"):
+            self.accept_kw("column")
+            cname = self.expect_ident()
+            ctype = self.parse_type()
+            default = None
+            nullable = True
+            while True:
+                if self.accept_kw("default"):
+                    default = self.parse_expr()
+                elif self.accept_kw("not"):
+                    self.expect_kw("null")
+                    nullable = False
+                elif self.accept_kw("null"):
+                    pass
+                else:
+                    break
+            return ast.AlterTable(
+                table, add=ast.ColumnDef(cname, ctype, nullable),
+                default=default)
+        if self.accept_kw("drop"):
+            self.accept_kw("column")
+            return ast.AlterTable(table, drop=self.expect_ident())
+        raise ParseError("expected ADD or DROP after ALTER TABLE")
 
     def parse_drop(self) -> ast.Statement:
         self.expect_kw("drop")

@@ -185,6 +185,10 @@ class ColumnSchema:
     nullable: bool = True
     # For STRING columns: dictionary values (host-side); code i -> dictionary[i].
     dictionary: Optional[list] = None
+    # Schema-change visibility: a column being added (catalog state
+    # WRITE_ONLY) exists physically — DML writes it — but planners and
+    # SELECT * must not see it until the descriptor goes PUBLIC.
+    hidden: bool = False
 
 
 @dataclass

@@ -194,14 +194,23 @@ class ColumnStore:
         data: dict[str, np.ndarray] = {}
         vmap: dict[str, np.ndarray] = {}
         with self._lock:
+            defaults = getattr(td, "column_defaults", {})
             for col in td.schema.columns:
                 cn = col.name
                 if cn not in cols:
-                    if not col.nullable:
+                    dv = defaults.get(cn)
+                    if dv is not None:
+                        cols = dict(cols)
+                        cols[cn] = np.full(
+                            n, dv, dtype=object
+                            if col.type.family == Family.STRING
+                            else None)
+                    elif not col.nullable:
                         raise ValueError(f"missing non-null column {cn}")
-                    data[cn] = np.zeros(n, dtype=col.type.np_dtype)
-                    vmap[cn] = np.zeros(n, dtype=bool)
-                    continue
+                    else:
+                        data[cn] = np.zeros(n, dtype=col.type.np_dtype)
+                        vmap[cn] = np.zeros(n, dtype=bool)
+                        continue
                 raw = cols[cn]
                 if col.type.family == Family.STRING and raw.dtype.kind in ("U", "O", "S"):
                     arr = td.dictionaries[cn].encode_array(raw)
@@ -240,9 +249,11 @@ class ColumnStore:
         from ..sql.rowenc import ROWID
         with self._lock:
             tsi = ts.to_int()
+            defaults = getattr(td, "column_defaults", {})
             for row in rows:
                 for col in td.schema.columns:
-                    td.open_rows[col.name].append(row.get(col.name))
+                    td.open_rows[col.name].append(
+                        row.get(col.name, defaults.get(col.name)))
                 td.open_ts.append(tsi)
                 rid = row.get(ROWID)
                 if rid is None:
@@ -356,6 +367,103 @@ class ColumnStore:
     # -- transactional publish (the scan plane as a materialization of
     # the committed KV row plane; engine DML writes intents through
     # kv.Txn and publishes here at the commit timestamp) ---------------------
+    # -- schema changes (ALTER TABLE; pkg/sql/backfill analogue) -----------
+    def add_column(self, name: str, col, default=None,
+                   hidden: bool = True) -> None:
+        """Add a column to the live schema (hidden until published).
+        Existing sealed chunks are backfilled separately, chunk by
+        chunk (backfill_column_chunk) by the schema-change job; the
+        open chunk and all future writes carry it immediately."""
+        td = self.table(name)
+        with self._lock:
+            if any(c.name == col.name for c in td.schema.columns):
+                raise ValueError(f"column {col.name!r} already exists")
+            col.hidden = hidden
+            td.schema.columns.append(col)
+            if col.type.family == Family.STRING:
+                td.dictionaries.setdefault(col.name, Dictionary())
+            td.column_defaults = getattr(td, "column_defaults", {})
+            if default is not None:
+                td.column_defaults[col.name] = default
+            td.open_rows[col.name] = [default] * len(td.open_ts)
+            td._codec = None
+            td.pk_index = None
+            td.generation += 1
+
+    def backfill_column_chunk(self, name: str, colname: str,
+                              chunk_index: int) -> bool:
+        """Fill one sealed chunk with the column's default (idempotent;
+        returns False when the chunk already has it). The unit of
+        schema-change checkpointing, like the reference's per-span
+        backfill progress (pkg/sql/backfill)."""
+        td = self.table(name)
+        with self._lock:
+            if chunk_index >= len(td.chunks):
+                return False
+            chunk = td.chunks[chunk_index]
+            if colname in chunk.data:
+                return False
+            col = td.schema.column(colname)
+            default = getattr(td, "column_defaults", {}).get(colname)
+            n = chunk.n
+            if default is None:
+                chunk.data[colname] = np.zeros(n, dtype=(
+                    np.int32 if col.type.family == Family.STRING
+                    else col.type.np_dtype))
+                chunk.valid[colname] = np.zeros(n, dtype=bool)
+            elif col.type.family == Family.STRING:
+                code = td.dictionaries[colname].encode(default)
+                chunk.data[colname] = np.full(n, code, dtype=np.int32)
+                chunk.valid[colname] = np.ones(n, dtype=bool)
+            else:
+                v = default
+                if col.type.family == Family.DECIMAL \
+                        and not isinstance(v, (int, np.integer)):
+                    v = int(round(float(v) * 10 ** col.type.scale))
+                chunk.data[colname] = np.full(n, v,
+                                              dtype=col.type.np_dtype)
+                chunk.valid[colname] = np.ones(n, dtype=bool)
+            td.generation += 1
+            return True
+
+    def unfilled_chunks(self, name: str, colname: str) -> list[int]:
+        td = self.table(name)
+        with self._lock:
+            return [i for i, c in enumerate(td.chunks)
+                    if colname not in c.data]
+
+    def publish_column(self, name: str, colname: str) -> None:
+        """Make an added column visible to readers (descriptor went
+        PUBLIC)."""
+        td = self.table(name)
+        with self._lock:
+            td.schema.column(colname).hidden = False
+            td.generation += 1
+
+    def hide_column(self, name: str, colname: str) -> None:
+        td = self.table(name)
+        with self._lock:
+            td.schema.column(colname).hidden = True
+            td.generation += 1
+
+    def drop_column(self, name: str, colname: str) -> None:
+        td = self.table(name)
+        with self._lock:
+            idx = td.schema.column_index(colname)
+            if td.schema.columns[idx].name in td.schema.primary_key:
+                raise ValueError(
+                    f"cannot drop primary key column {colname!r}")
+            del td.schema.columns[idx]
+            td.dictionaries.pop(colname, None)
+            td.open_rows.pop(colname, None)
+            getattr(td, "column_defaults", {}).pop(colname, None)
+            for c in td.chunks:
+                c.data.pop(colname, None)
+                c.valid.pop(colname, None)
+            td._codec = None
+            td.pk_index = None
+            td.generation += 1
+
     def alloc_rowids(self, name: str, n: int) -> list[int]:
         td = self.table(name)
         with self._lock:
@@ -449,9 +557,11 @@ class ColumnStore:
             if live:
                 base_ci = len(td.chunks)
                 rows = [r for _, r in live]
+                defaults = getattr(td, "column_defaults", {})
                 for row in rows:
                     for col in td.schema.columns:
-                        td.open_rows[col.name].append(row.get(col.name))
+                        td.open_rows[col.name].append(
+                            row.get(col.name, defaults.get(col.name)))
                     td.open_ts.append(tsi)
                     td.open_rowids.append(int(row.get(ROWID, 0)) or
                                           self._next_rowid_locked(td))
