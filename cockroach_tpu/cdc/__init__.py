@@ -1,0 +1,7 @@
+"""CDC: changefeeds over SQL tables (reference: pkg/ccl/changefeedccl)."""
+
+from .changefeed import (CHANGEFEED_JOB, ChangefeedResumer, CollectorSink,
+                         FileSink, TableFeed, open_sink)
+
+__all__ = ["CHANGEFEED_JOB", "ChangefeedResumer", "TableFeed",
+           "CollectorSink", "FileSink", "open_sink"]

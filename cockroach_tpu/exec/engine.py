@@ -195,6 +195,9 @@ class Engine:
         self.catalog = Catalog(self.kv)
         self.leases = LeaseManager(self.catalog, holder=f"sql-{id(self)}",
                                    now_ns=lambda: self.clock.now().wall)
+        # changefeed event taps (cdc/changefeed.py TableFeed)
+        self.cdc_feeds: list = []
+        self._cdc_threads: dict[int, threading.Thread] = {}
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
@@ -287,6 +290,26 @@ class Engine:
             else:
                 session.vars.set(stmt.name, stmt.value)
             return Result(tag="SET")
+        if isinstance(stmt, ast.CreateChangefeed):
+            jid = self.create_changefeed(stmt.table, stmt.sink)
+            return Result(names=["job_id"], rows=[(jid,)],
+                          tag="CREATE CHANGEFEED")
+        if isinstance(stmt, ast.ShowJobs):
+            recs = sorted(self.jobs.jobs(), key=lambda r: r.id)
+            return Result(
+                names=["job_id", "job_type", "status",
+                       "fraction_completed"],
+                rows=[(r.id, r.type, r.status,
+                       round(r.fraction_completed, 3)) for r in recs],
+                tag="SHOW JOBS")
+        if isinstance(stmt, ast.CancelJob):
+            # async cancel (the statement lock is held here and the
+            # changefeed thread may be waiting on it — joining would
+            # self-deadlock); the job observes the request at its next
+            # check_cancel and exits
+            self.jobs.cancel(stmt.job_id)
+            self._cdc_threads.pop(stmt.job_id, None)
+            return Result(tag="CANCEL JOB")
         if isinstance(stmt, ast.ShowTables):
             descs = sorted(self.catalog.list_tables(),
                            key=lambda d: d.name)
@@ -1111,6 +1134,7 @@ class Engine:
         """Lazily-built jobs registry for engine-initiated work
         (schema changes); Nodes build their own adopting registry."""
         if getattr(self, "_jobs", None) is None:
+            from ..cdc import CHANGEFEED_JOB, ChangefeedResumer
             from ..jobs import Registry
             from ..jobs.schemachange import (SCHEMA_CHANGE_JOB,
                                              SchemaChangeResumer)
@@ -1118,7 +1142,34 @@ class Engine:
                                   session_id=f"engine-{id(self)}")
             self._jobs.register(SCHEMA_CHANGE_JOB,
                                 lambda: SchemaChangeResumer(self))
+            self._jobs.register(CHANGEFEED_JOB,
+                                lambda: ChangefeedResumer(self))
         return self._jobs
+
+    def create_changefeed(self, table: str, sink: str,
+                          cursor: int = 0,
+                          resolved_every_s: float = 0.05) -> int:
+        """Start a changefeed job tailing `table` into `sink`
+        (mem://name or file://path); returns the job id. Runs on a
+        background thread until canceled (jobs.cancel(id))."""
+        from ..cdc import CHANGEFEED_JOB
+        if table not in self.store.tables:
+            raise EngineError(f"table {table!r} does not exist")
+        job_id = self.jobs.create(CHANGEFEED_JOB, {
+            "table": table, "sink": sink, "cursor": cursor,
+            "resolved_every_s": resolved_every_s})
+        th = threading.Thread(target=self._run_changefeed,
+                              args=(job_id,), daemon=True)
+        self._cdc_threads[job_id] = th
+        th.start()
+        return job_id
+
+    def _run_changefeed(self, job_id: int) -> None:
+        from ..jobs import JobsError
+        try:
+            self.jobs.run_job(job_id)
+        except (JobsError, Exception):
+            pass  # terminal state is in the job record
 
     def _exec_alter(self, a: ast.AlterTable, session: Session) -> Result:
         """Online schema change: the descriptor moves through
@@ -1253,6 +1304,9 @@ class Engine:
         for table in order:
             self.store.apply_committed(table, by_table[table], ts)
             self._evict(table)
+            for feed in self.cdc_feeds:
+                if feed.table == table:
+                    feed.on_publish(by_table[table], ts)
 
     def _register_table_read(self, txn: Optional[Txn], table: str,
                              read_ts: Timestamp) -> None:

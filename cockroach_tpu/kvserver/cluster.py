@@ -108,6 +108,26 @@ class Cluster:
                 if nid not in self.down:
                     store.handle_ready_all()
 
+    def tick_closed_ts(self) -> None:
+        """One side-transport round: every live leaseholder closes up
+        to now - target and ships it to followers (then pump delivers)."""
+        for nid, store in self.stores.items():
+            if nid not in self.down:
+                store.broadcast_closed_ts()
+        self.transport.deliver_all()
+
+    def follower_get(self, key: bytes, node_id: int,
+                     ts=None) -> Optional[bytes]:
+        """Read from a specific (possibly follower) replica at ts —
+        succeeds only below that replica's closed timestamp."""
+        desc = self.range_for_key(key)
+        if desc is None:
+            raise KeyError(f"no range for key {key!r}")
+        rep = self.stores[node_id].replicas[desc.range_id]
+        return rep.follower_read({
+            "op": "get", "key": key.decode("latin1"),
+            "ts": _enc_ts(ts or self.clock.now())})
+
     def pump_until(self, cond, max_iter: int = 500) -> bool:
         for _ in range(max_iter):
             if cond():

@@ -73,6 +73,10 @@ def _dec_ts(v: list) -> Timestamp:
     return Timestamp(v[0], v[1])
 
 
+class FollowerReadError(Exception):
+    """The follower's closed timestamp has not reached the read ts."""
+
+
 class Replica:
     def __init__(self, store: "Store", desc: RangeDescriptor):
         self.store = store
@@ -87,6 +91,20 @@ class Replica:
         self._applied_ids: set[str] = set()
         self._applied_order: deque[str] = deque()
         self.raft_log_size = 0
+        # closed timestamps (pkg/kv/kvserver/closedts): the leaseholder
+        # promises no new writes at or below closed_ts. It rides raft
+        # commands (so followers learn it at apply time, consistent by
+        # construction) and, for idle ranges, the side transport —
+        # (ts, applied-index) pairs usable only once this replica has
+        # applied that far (the LAI condition of sidetransport).
+        self.closed_ts = Timestamp(0, 0)
+        self._side_closed: Optional[tuple] = None  # (Timestamp, lai)
+        # min write ts of proposals not yet applied here: the closed ts
+        # must stay below every in-flight write (the reference's
+        # propBuf closed-timestamp tracker, replica_proposal_buf.go)
+        self._inflight_wts: dict[str, Timestamp] = {}
+        from .rangefeed import Processor as RangefeedProcessor
+        self.rangefeed = RangefeedProcessor(self)
 
     # ------------------------------------------------------------------
     # read / write entry points (leaseholder-gated)
@@ -122,6 +140,49 @@ class Replica:
                 start, end, read_ts, max_keys=op.get("limit", 0))]
         raise ValueError(f"unknown read op {op['op']}")
 
+    # -- closed timestamps / follower reads -----------------------------
+    def effective_closed_ts(self) -> Timestamp:
+        """What this replica knows to be closed: raft-carried closed_ts
+        plus any side-transport update whose lease-applied-index this
+        replica has caught up to."""
+        out = self.closed_ts
+        if self._side_closed is not None:
+            ts, lai = self._side_closed
+            if self.applied_index >= lai and out < ts:
+                out = ts
+        return out
+
+    def follower_read(self, op: dict) -> object:
+        """Serve a read from THIS replica without the lease, valid only
+        at or below the closed timestamp (follower reads,
+        kvserver/replica_follower_read.go)."""
+        read_ts = _dec_ts(op["ts"])
+        closed = self.effective_closed_ts()
+        if not (read_ts < closed or read_ts == closed):
+            raise FollowerReadError(
+                f"r{self.desc.range_id}: read ts {read_ts} above closed "
+                f"ts {closed}")
+        return self.read(op)
+
+    def handle_side_closed(self, body: dict) -> None:
+        ts = _dec_ts(body["ts"])
+        lai = int(body["lai"])
+        if self._side_closed is None or self._side_closed[0] < ts:
+            self._side_closed = (ts, lai)
+            eff = self.effective_closed_ts()
+            if eff > Timestamp(0, 0):
+                self.rangefeed.on_closed(eff)
+
+    def _closed_target(self) -> Timestamp:
+        wall = self.store.clock.now().wall - self.store.closedts_target_ns
+        target = Timestamp(max(wall, 0), 0)
+        for wts in self._inflight_wts.values():
+            below = (Timestamp(wts.wall, wts.logical - 1)
+                     if wts.logical > 0 else Timestamp(wts.wall - 1, 0))
+            if below < target:
+                target = below
+        return target
+
     def propose(self, cmd: dict, done: Optional[Callable] = None) -> bool:
         """Propose a write command; ``done(result)`` fires when the
         command applies on THIS replica. Non-leader replicas forward to
@@ -133,6 +194,32 @@ class Replica:
             # counter would reuse ids after remove+re-add and trip the
             # dedup window on surviving replicas
             cmd["_id"] = f"{self.store.node_id}.{uuid.uuid4().hex[:16]}"
+        if cmd.get("kind") == "batch" and self.holds_lease():
+            # closed-timestamp discipline at the leaseholder: forward
+            # any write below the closed ts (the promise to followers
+            # is that history at or below it is immutable), and carry a
+            # new closed ts on the command so followers advance at
+            # apply time (closedts "raft transport")
+            closed = self.closed_ts
+            min_wts = None
+            for op in cmd["ops"]:
+                if "ts" not in op:
+                    continue
+                wts = _dec_ts(op["ts"])
+                if not closed < wts:
+                    wts = Timestamp(closed.wall, closed.logical + 1)
+                    op["ts"] = _enc_ts(wts)
+                if min_wts is None or wts < min_wts:
+                    min_wts = wts
+            if min_wts is not None:
+                self._inflight_wts[cmd["_id"]] = min_wts
+            target = self._closed_target()
+            if min_wts is not None and not target < min_wts:
+                target = Timestamp(min_wts.wall, min_wts.logical - 1) \
+                    if min_wts.logical > 0 else Timestamp(
+                        min_wts.wall - 1, 0)
+            if self.closed_ts < target:
+                cmd["closed"] = _enc_ts(target)
         if done is not None:
             self._waiters[cmd["_id"]] = done
         if self.raft.is_leader():
@@ -184,6 +271,7 @@ class Replica:
             return
         cmd = json.loads(data.decode())
         cmd_id = cmd.get("_id", "")
+        self._inflight_wts.pop(cmd_id, None)
         if cmd_id and cmd_id in self._applied_ids:
             return      # retried forward landed twice: apply once
         if cmd_id:
@@ -202,6 +290,13 @@ class Replica:
             out = []
             for op in cmd["ops"]:
                 out.append(self._eval_op(op))
+            if "closed" in cmd:
+                # applied on every replica in log order: a follower's
+                # closed_ts never runs ahead of its applied state
+                ts = _dec_ts(cmd["closed"])
+                if self.closed_ts < ts:
+                    self.closed_ts = ts
+                    self.rangefeed.on_closed(ts)
             return out
         if kind == "lease":
             self.lease = Lease(cmd["holder"], cmd["epoch"],
@@ -270,19 +365,43 @@ class Replica:
         return self.desc
 
     def _eval_op(self, op: dict) -> object:
+        from ..storage.mvcc import TxnStatus
         o = op["op"]
         wts = _dec_ts(op["ts"]) if "ts" in op else None
         txn = TxnMeta.from_json(op["txn"].encode()) if op.get("txn") else None
         if o == "put":
-            self.mvcc.put(op["key"].encode("latin1"), wts,
-                          op["value"].encode("latin1"), txn=txn)
+            key = op["key"].encode("latin1")
+            self.mvcc.put(key, wts, op["value"].encode("latin1"), txn=txn)
+            if txn is None:
+                # committed immediately; intent writes emit at resolve
+                self.rangefeed.on_value(
+                    key, op["value"].encode("latin1"), wts)
             return True
         if o == "delete":
-            self.mvcc.delete(op["key"].encode("latin1"), wts, txn=txn)
+            key = op["key"].encode("latin1")
+            self.mvcc.delete(key, wts, txn=txn)
+            if txn is None:
+                self.rangefeed.on_value(key, None, wts)
             return True
         if o == "resolve":
-            self.mvcc.resolve_intent(op["key"].encode("latin1"), txn,
-                                     commit=op["commit"])
+            key = op["key"].encode("latin1")
+            commit = bool(op["commit"])
+            commit_ts = _dec_ts(op["commit_ts"]) \
+                if op.get("commit_ts") else None
+            # capture the provisional value BEFORE the meta is removed
+            # so a commit can emit it on the rangefeed
+            val = None
+            if commit:
+                mv = self.mvcc._newest_version(key, txn.write_ts)
+                if mv is not None and mv.ts == txn.write_ts:
+                    val = mv.value
+            done = self.mvcc.resolve_intent(
+                key, txn,
+                TxnStatus.COMMITTED if commit else TxnStatus.ABORTED,
+                commit_ts=commit_ts)
+            if done and commit:
+                self.rangefeed.on_value(key, val,
+                                        commit_ts or txn.write_ts)
             return True
         raise ValueError(f"unknown write op {o}")
 
@@ -329,12 +448,16 @@ class Store:
     """All replicas on one node (pkg/kv/kvserver/store.go)."""
 
     def __init__(self, node_id: int, transport, clock: Optional[Clock] = None,
-                 liveness=None, raft_log_max: int = 1 << 20, seed: int = 0):
+                 liveness=None, raft_log_max: int = 1 << 20, seed: int = 0,
+                 closedts_target_ns: int = int(3e9)):
         self.node_id = node_id
         self.transport = transport
         self.clock = clock or Clock()
         self.liveness = liveness
         self.raft_log_max = raft_log_max
+        # how far behind now the leaseholder closes (the reference's
+        # kv.closed_timestamp.target_duration, default 3s)
+        self.closedts_target_ns = closedts_target_ns
         self.replicas: dict[int, Replica] = {}
         self._seed = seed
         transport.register(node_id, self._handle_raft_message)
@@ -372,6 +495,8 @@ class Store:
             # otherwise drop — the proposer's retry loop re-sends
             if r.raft.is_leader():
                 r.raft.propose(json.dumps(body).encode())
+        elif kind == "closedts":
+            r.handle_side_closed(body)
 
     def tick(self) -> None:
         for r in list(self.replicas.values()):
@@ -380,3 +505,23 @@ class Store:
     def handle_ready_all(self) -> None:
         for r in list(self.replicas.values()):
             r.handle_ready()
+
+    def broadcast_closed_ts(self) -> None:
+        """Side transport for idle ranges (closedts/sidetransport
+        sender.go:38): each leaseholder advances its closed ts toward
+        now - target and ships (ts, applied index) to followers — no
+        raft traffic needed on quiescent ranges."""
+        for r in list(self.replicas.values()):
+            if not r.holds_lease():
+                continue
+            target = r._closed_target()
+            if r.closed_ts < target:
+                r.closed_ts = target
+                r.rangefeed.on_closed(target)
+            body = {"ts": _enc_ts(r.closed_ts),
+                    "lai": r.applied_index}
+            for nid in r.desc.replicas:
+                if nid != self.node_id:
+                    self.transport.send(
+                        self.node_id, nid,
+                        (r.desc.range_id, ("closedts", body)))

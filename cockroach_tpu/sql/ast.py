@@ -254,6 +254,23 @@ class ShowTables(Statement):
 
 
 @dataclass
+class CreateChangefeed(Statement):
+    """CREATE CHANGEFEED FOR <table> INTO '<sink-uri>'."""
+    table: str
+    sink: str
+
+
+@dataclass
+class ShowJobs(Statement):
+    pass
+
+
+@dataclass
+class CancelJob(Statement):
+    job_id: int
+
+
+@dataclass
 class Explain(Statement):
     stmt: Statement
     analyze: bool = False

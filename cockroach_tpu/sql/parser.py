@@ -122,6 +122,10 @@ class Parser:
                     and self.peek().text == "tables":
                 self.next()
                 return ast.ShowTables()
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "jobs":
+                self.next()
+                return ast.ShowJobs()
             self.accept_kw("cluster")
             self.accept_kw("setting")
             return ast.ShowVar(self.dotted_name())
@@ -132,6 +136,16 @@ class Parser:
         if t.is_kw("analyze"):
             self.next()
             return ast.Analyze(self.expect_ident())
+        if t.kind in (Tok.IDENT, Tok.KEYWORD) and t.text == "cancel":
+            self.next()
+            if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                    and self.peek().text == "job"):
+                raise ParseError("expected JOB after CANCEL")
+            self.next()
+            n = self.next()
+            if n.kind != Tok.NUMBER:
+                raise ParseError("expected job id")
+            return ast.CancelJob(int(n.text))
         if t.is_kw("begin"):
             self.next()
             self.accept_kw("transaction")
@@ -549,6 +563,19 @@ class Parser:
     # -- DDL/DML -----------------------------------------------------------
     def parse_create(self) -> ast.Statement:
         self.expect_kw("create")
+        if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == "changefeed":
+            self.next()
+            self.expect_kw("for")
+            table = self.expect_ident()
+            if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                    and self.peek().text == "into"):
+                raise ParseError("expected INTO '<sink>'")
+            self.next()
+            t = self.next()
+            if t.kind != Tok.STRING:
+                raise ParseError("sink must be a string literal")
+            return ast.CreateChangefeed(table, t.text)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):

@@ -406,6 +406,47 @@ class MVCC:
         return removed
 
     # -- introspection -------------------------------------------------------
+    def oldest_intent_ts(self, start: bytes,
+                         end: bytes) -> Optional[Timestamp]:
+        """Lowest write_ts among live intents in [start, end) — the
+        resolved-timestamp clamp for rangefeeds (the reference tracks
+        this incrementally in rangefeed's unresolvedIntentQueue)."""
+        oldest: Optional[Timestamp] = None
+        for ek, raw in self.engine.scan(EngineKey.meta(start),
+                                        EngineKey.meta(end)):
+            if ek.is_meta and raw is not None:
+                m = TxnMeta.from_json(raw)
+                if oldest is None or m.write_ts < oldest:
+                    oldest = m.write_ts
+        return oldest
+
+    def committed_versions_after(self, start: bytes, end: bytes,
+                                 after_ts: Timestamp) -> list[MVCCValue]:
+        """Every committed version with ts > after_ts in [start, end),
+        tombstones included, ordered by (ts, key) — the rangefeed
+        catch-up scan (rangefeed/catchup_scan.go)."""
+        out: list[MVCCValue] = []
+        cur_meta: Optional[TxnMeta] = None
+        cur_key: Optional[bytes] = None
+        for ek, raw in self.engine.scan(EngineKey.meta(start),
+                                        EngineKey.meta(end),
+                                        include_tombstones=True):
+            if ek.key != cur_key:
+                cur_key = ek.key
+                cur_meta = None
+            if ek.is_meta:
+                if raw is not None:
+                    cur_meta = TxnMeta.from_json(raw)
+                continue
+            if raw is None:
+                continue
+            if cur_meta is not None and ek.ts == cur_meta.write_ts:
+                continue  # provisional (uncommitted intent) version
+            if after_ts < ek.ts:
+                out.append(MVCCValue(ek.key, ek.ts, _dec_value(raw)))
+        out.sort(key=lambda mv: (mv.ts.wall, mv.ts.logical, mv.key))
+        return out
+
     def iter_versions(self, key: bytes) -> Iterator[MVCCValue]:
         for ek, raw in self.engine.scan(EngineKey(key, 0),
                                         EngineKey(next_key(key), -1),

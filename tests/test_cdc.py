@@ -1,0 +1,202 @@
+"""Rangefeed (KV plane) + changefeed (SQL plane) — the CDC stack.
+
+References: pkg/kv/kvserver/rangefeed (processor, catch-up scan,
+resolved timestamps), pkg/ccl/changefeedccl (encoder/sink/resolved,
+cursor resume)."""
+
+import time
+
+import pytest
+
+from cockroach_tpu.cdc import CHANGEFEED_JOB, ChangefeedResumer, open_sink
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.kvserver.cluster import Cluster
+from cockroach_tpu.storage.hlc import Timestamp
+
+
+def make_cluster():
+    c = Cluster(n_nodes=3)
+    for s in c.stores.values():
+        s.closedts_target_ns = 0
+    c.create_range(b"a", b"z")
+    c.pump_until(lambda: c.leaseholder(1) is not None)
+    return c
+
+
+class TestRangefeed:
+    def test_live_events_and_checkpoints(self):
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(3)
+        lh = c.leaseholder(1)
+        rep = c.stores[lh].replicas[1]
+        reg = rep.rangefeed.register(b"a", b"z", c.clock.now())
+        c.put(b"k2", b"v2")
+        c.pump(3)
+        c.tick_closed_ts()
+        evs = reg.drain()
+        vals = [(e.key, e.value) for e in evs if e.kind == "value"]
+        cps = [e.ts for e in evs if e.kind == "checkpoint"]
+        assert (b"k2", b"v2") in vals
+        assert (b"k1", b"v1") not in vals  # before registration ts
+        assert cps and max(cps) >= max(
+            e.ts for e in evs if e.kind == "value")
+
+    def test_catch_up_scan(self):
+        c = make_cluster()
+        t0 = c.clock.now()
+        c.put(b"k1", b"v1")
+        c.put(b"k1", b"v1b")
+        c.put(b"k2", b"v2")
+        c.pump(3)
+        lh = c.leaseholder(1)
+        rep = c.stores[lh].replicas[1]
+        reg = rep.rangefeed.register(b"a", b"z", t0)
+        vals = [(e.key, e.value) for e in reg.drain()
+                if e.kind == "value"]
+        assert vals == [(b"k1", b"v1"), (b"k1", b"v1b"), (b"k2", b"v2")]
+
+    def test_follower_replica_feeds_from_log(self):
+        """Events are emitted at APPLY time, so a registration on a
+        follower sees committed writes too (the reference serves
+        rangefeeds from followers for exactly this reason)."""
+        c = make_cluster()
+        c.put(b"k0", b"seed")
+        c.pump(3)
+        lh = c.leaseholder(1)
+        follower = next(n for n in c.stores if n != lh)
+        rep = c.stores[follower].replicas[1]
+        reg = rep.rangefeed.register(b"a", b"z", c.clock.now())
+        c.put(b"k3", b"v3")
+        c.pump(5)
+        vals = [(e.key, e.value) for e in reg.drain()
+                if e.kind == "value"]
+        assert (b"k3", b"v3") in vals
+
+    def test_resolved_clamped_by_intent(self):
+        """An unresolved intent holds the resolved ts below its write
+        ts (rangefeed's unresolvedIntentQueue contract)."""
+        import json
+
+        from cockroach_tpu.kvserver.store import _enc_ts
+        from cockroach_tpu.storage.mvcc import TxnMeta
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(3)
+        lh = c.leaseholder(1)
+        rep = c.stores[lh].replicas[1]
+        reg = rep.rangefeed.register(b"a", b"z", Timestamp(0, 0))
+        intent_ts = c.clock.now()
+        txn = TxnMeta(id="t1", key=b"k5", write_ts=intent_ts,
+                      read_ts=intent_ts)
+        cmd = {"kind": "batch", "ops": [{
+            "op": "put", "key": "k5", "value": "prov",
+            "ts": _enc_ts(intent_ts),
+            "txn": txn.to_json().decode()}]}
+        c.propose_and_wait(rep, cmd)
+        c.pump(3)
+        c.tick_closed_ts()
+        evs = reg.drain()
+        cps = [e.ts for e in evs if e.kind == "checkpoint"]
+        assert cps, "no checkpoint emitted"
+        assert max(cps) < intent_ts
+        # no value event for the provisional write
+        assert not any(e.key == b"k5" for e in evs if e.kind == "value")
+
+
+class TestChangefeed:
+    def wait(self, cond, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def test_end_to_end(self, tmp_path):
+        e = Engine()
+        e.execute("CREATE TABLE acc (id INT PRIMARY KEY, bal INT)")
+        e.execute("INSERT INTO acc VALUES (1, 100)")
+        jid = e.execute(
+            "CREATE CHANGEFEED FOR acc INTO 'mem://e2e'").rows[0][0]
+        sink = open_sink("mem://e2e")
+        assert self.wait(lambda: len(sink.rows) >= 1)
+        e.execute("UPDATE acc SET bal = 150 WHERE id = 1")
+        e.execute("DELETE FROM acc WHERE id = 1")
+        assert self.wait(lambda: len(sink.rows) >= 3)
+        afters = [r["after"] for r in sink.rows]
+        assert {"id": 1, "bal": 100} in afters
+        assert {"id": 1, "bal": 150} in afters
+        assert afters[-1] is None  # the delete
+        # resolved timestamps are monotone and eventually pass the
+        # last event
+        assert self.wait(lambda: sink.resolved and
+                         sink.resolved[-1] >= sink.rows[-1]["updated"])
+        assert sink.resolved == sorted(sink.resolved)
+        e.execute(f"CANCEL JOB {jid}")
+        assert self.wait(lambda: e.jobs.job(jid).status == "canceled")
+
+    def test_txn_commit_visibility(self):
+        """Events appear only at COMMIT, with the commit timestamp; a
+        rolled-back txn emits nothing."""
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT PRIMARY KEY)")
+        jid = e.execute(
+            "CREATE CHANGEFEED FOR t INTO 'mem://txn'").rows[0][0]
+        sink = open_sink("mem://txn")
+        s = e.session()
+        e.execute("BEGIN", session=s)
+        e.execute("INSERT INTO t VALUES (1)", session=s)
+        time.sleep(0.1)
+        assert sink.rows == []  # not committed yet
+        e.execute("COMMIT", session=s)
+        assert self.wait(lambda: len(sink.rows) == 1)
+        s2 = e.session()
+        e.execute("BEGIN", session=s2)
+        e.execute("INSERT INTO t VALUES (2)", session=s2)
+        e.execute("ROLLBACK", session=s2)
+        time.sleep(0.15)
+        assert len(sink.rows) == 1  # rollback emitted nothing
+        e.execute(f"CANCEL JOB {jid}")
+
+    def test_cursor_resume_redelivers(self):
+        """A changefeed restarted from its checkpoint re-emits history
+        after the cursor — the at-least-once resume contract."""
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT PRIMARY KEY, v INT)")
+        e.execute("INSERT INTO t VALUES (1, 10)")
+        cut = e.clock.now().to_int()
+        e.execute("INSERT INTO t VALUES (2, 20)")
+        e.store.seal("t")
+        sink = open_sink("mem://resume")
+        jid = e.jobs.create(CHANGEFEED_JOB, {
+            "table": "t", "sink": "mem://resume", "cursor": cut,
+            "resolved_every_s": 0.02})
+        import threading
+        th = threading.Thread(target=lambda: e.jobs.run_job(jid),
+                              daemon=True)
+        th.start()
+        assert self.wait(lambda: len(sink.rows) >= 1)
+        # only the row after the cursor arrives
+        assert [r["after"]["a"] for r in sink.rows] == [2]
+        e.jobs.cancel(jid)
+        th.join(timeout=5)
+
+    def test_file_sink(self, tmp_path):
+        import json
+        path = tmp_path / "feed.ndjson"
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT PRIMARY KEY)")
+        jid = e.execute(
+            f"CREATE CHANGEFEED FOR t INTO 'file://{path}'").rows[0][0]
+        e.execute("INSERT INTO t VALUES (7)")
+        assert self.wait(lambda: path.exists() and any(
+            '"after"' in ln for ln in
+            path.read_text().splitlines() if ln))
+        e.execute(f"CANCEL JOB {jid}")
+        assert self.wait(lambda: e.jobs.job(jid).status == "canceled")
+        lines = [json.loads(x) for x in
+                 path.read_text().splitlines() if x]
+        assert any(o.get("after", {}) and o["after"]["a"] == 7
+                   for o in lines if o.get("after"))
+        assert any("resolved" in o for o in lines)

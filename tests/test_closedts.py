@@ -1,0 +1,125 @@
+"""Closed timestamps + follower reads.
+
+The analogue of pkg/kv/kvserver/closedts tests: leaseholders close
+history behind a target duration (riding raft commands, plus a side
+transport for idle ranges); followers serve reads at or below their
+closed timestamp; writes can never land at or below a closed ts."""
+
+import pytest
+
+from cockroach_tpu.kvserver.cluster import Cluster
+from cockroach_tpu.kvserver.store import FollowerReadError
+from cockroach_tpu.storage.hlc import Timestamp
+
+
+def make_cluster(target_ns=0):
+    c = Cluster(n_nodes=3)
+    for s in c.stores.values():
+        s.closedts_target_ns = target_ns
+    c.create_range(b"a", b"z")
+    c.pump_until(lambda: c.leaseholder(1) is not None)
+    return c
+
+
+class TestClosedTimestamps:
+    def test_raft_carried_closed_ts_reaches_followers(self):
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        lh = c.leaseholder(1)
+        lead = c.stores[lh].replicas[1]
+        assert lead.closed_ts > Timestamp(0, 0)
+        for nid, s in c.stores.items():
+            if nid == lh:
+                continue
+            # followers learned the closed ts via the applied command
+            assert s.replicas[1].closed_ts == lead.closed_ts
+
+    def test_follower_read_below_closed(self):
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        read_ts = c.clock.now()
+        c.put(b"k2", b"v2")  # carries a closed ts past read_ts
+        c.pump(5)
+        lh = c.leaseholder(1)
+        follower = next(n for n in c.stores if n != lh)
+        assert c.follower_get(b"k1", follower, ts=read_ts) == b"v1"
+
+    def test_follower_read_above_closed_rejected(self):
+        c = make_cluster(target_ns=int(3600e9))  # closes far behind
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        lh = c.leaseholder(1)
+        follower = next(n for n in c.stores if n != lh)
+        with pytest.raises(FollowerReadError):
+            c.follower_get(b"k1", follower, ts=c.clock.now())
+
+    def test_side_transport_closes_idle_range(self):
+        """No writes at all: the side transport alone must advance
+        followers' closed timestamps (sidetransport/sender.go:38)."""
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        read_ts = c.clock.now()
+        # no further writes; idle range
+        c.tick_closed_ts()
+        c.pump(3)
+        lh = c.leaseholder(1)
+        follower = next(n for n in c.stores if n != lh)
+        assert c.follower_get(b"k1", follower, ts=read_ts) == b"v1"
+
+    def test_write_below_closed_is_forwarded(self):
+        """A write handed to the leaseholder with a stale timestamp
+        must not mutate closed history: it gets forwarded above the
+        closed ts."""
+        from cockroach_tpu.kvserver.store import _enc_ts
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        c.tick_closed_ts()  # close history PAST v1's write ts
+        c.pump(3)
+        lh = c.leaseholder(1)
+        lead = c.stores[lh].replicas[1]
+        closed = lead.closed_ts
+        stale = Timestamp(closed.wall, closed.logical)  # at the fence
+        cmd = {"kind": "batch", "ops": [{
+            "op": "put", "key": "k1", "value": "evil",
+            "ts": _enc_ts(stale)}]}
+        c.propose_and_wait(lead, cmd)
+        c.pump(5)
+        # the closed-history read still sees v1
+        assert c.follower_get(
+            b"k1", next(n for n in c.stores if n != lh),
+            ts=closed) == b"v1"
+        # and the forwarded write IS visible above the closed ts
+        assert c.get(b"k1") == b"evil"
+
+    def test_follower_read_waits_for_applied_index(self):
+        """A side-transport closed ts is unusable until the follower
+        has applied up to the attached index (the LAI condition)."""
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        lh = c.leaseholder(1)
+        follower = next(n for n in c.stores if n != lh)
+        rep = c.stores[follower].replicas[1]
+        ts = c.clock.now()
+        # fabricate a side update claiming an index far ahead
+        rep.handle_side_closed({
+            "ts": [ts.wall, ts.logical], "lai": rep.applied_index + 100})
+        with pytest.raises(FollowerReadError):
+            c.follower_get(b"k1", follower, ts=ts)
+
+    def test_quorum_loss_still_serves_follower_reads(self):
+        """The payoff: with the leaseholder dead, closed history is
+        still readable from survivors."""
+        c = make_cluster()
+        c.put(b"k1", b"v1")
+        c.pump(5)
+        read_ts = c.clock.now()
+        c.tick_closed_ts()
+        c.pump(3)
+        lh = c.leaseholder(1)
+        c.stop_node(lh)
+        follower = next(n for n in c.stores if n != lh)
+        assert c.follower_get(b"k1", follower, ts=read_ts) == b"v1"
