@@ -290,6 +290,26 @@ class Engine:
             else:
                 session.vars.set(stmt.name, stmt.value)
             return Result(tag="SET")
+        if isinstance(stmt, ast.Backup):
+            from ..jobs.backup import BACKUP_JOB
+            for t in stmt.tables:
+                if t not in self.store.tables:
+                    raise EngineError(f"table {t!r} does not exist")
+            jid = self.jobs.create(BACKUP_JOB, {
+                "tables": stmt.tables, "dest": stmt.dest})
+            rec = self.jobs.run_job(jid)
+            if rec.status != "succeeded":
+                raise EngineError(f"BACKUP failed: {rec.error}")
+            return Result(names=["job_id"], rows=[(jid,)], tag="BACKUP")
+        if isinstance(stmt, ast.Restore):
+            from ..jobs.backup import RESTORE_JOB
+            jid = self.jobs.create(RESTORE_JOB, {
+                "tables": stmt.tables, "src": stmt.src})
+            rec = self.jobs.run_job(jid)
+            if rec.status != "succeeded":
+                raise EngineError(f"RESTORE failed: {rec.error}")
+            return Result(names=["job_id"], rows=[(jid,)],
+                          tag="RESTORE")
         if isinstance(stmt, ast.CreateChangefeed):
             jid = self.create_changefeed(stmt.table, stmt.sink)
             return Result(names=["job_id"], rows=[(jid,)],
@@ -1144,6 +1164,12 @@ class Engine:
                                 lambda: SchemaChangeResumer(self))
             self._jobs.register(CHANGEFEED_JOB,
                                 lambda: ChangefeedResumer(self))
+            from ..jobs.backup import (BACKUP_JOB, RESTORE_JOB,
+                                       BackupResumer, RestoreResumer)
+            self._jobs.register(BACKUP_JOB,
+                                lambda: BackupResumer(self))
+            self._jobs.register(RESTORE_JOB,
+                                lambda: RestoreResumer(self))
         return self._jobs
 
     def create_changefeed(self, table: str, sink: str,

@@ -271,6 +271,21 @@ class CancelJob(Statement):
 
 
 @dataclass
+class Backup(Statement):
+    """BACKUP TABLE a, b INTO '<dir>' (incremental when the directory
+    already holds a backup)."""
+    tables: list[str]
+    dest: str
+
+
+@dataclass
+class Restore(Statement):
+    """RESTORE TABLE a, b FROM '<dir>' (empty tables = all)."""
+    tables: list[str]
+    src: str
+
+
+@dataclass
 class Explain(Statement):
     stmt: Statement
     analyze: bool = False

@@ -146,6 +146,29 @@ class Parser:
             if n.kind != Tok.NUMBER:
                 raise ParseError("expected job id")
             return ast.CancelJob(int(n.text))
+        if t.is_kw("backup"):
+            self.next()
+            self.expect_kw("table")
+            tables = [self.expect_ident()]
+            while self.accept_op(","):
+                tables.append(self.expect_ident())
+            self.expect_kw("into")
+            s = self.next()
+            if s.kind != Tok.STRING:
+                raise ParseError("expected destination string")
+            return ast.Backup(tables, s.text)
+        if t.is_kw("restore"):
+            self.next()
+            tables = []
+            if self.accept_kw("table"):
+                tables.append(self.expect_ident())
+                while self.accept_op(","):
+                    tables.append(self.expect_ident())
+            self.expect_kw("from")
+            s = self.next()
+            if s.kind != Tok.STRING:
+                raise ParseError("expected source string")
+            return ast.Restore(tables, s.text)
         if t.is_kw("begin"):
             self.next()
             self.accept_kw("transaction")

@@ -1,0 +1,152 @@
+"""BACKUP / RESTORE jobs: full + incremental + crash resume.
+
+The analogue of pkg/ccl/backupccl tests: the manifest/layer window
+algebra (new rows, updates, deletions since the previous backup), the
+per-table checkpointing loop (backup_job.go:230-266), and adoption
+after a crash."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+from cockroach_tpu.jobs import Registry
+from cockroach_tpu.jobs.backup import (BACKUP_JOB, BackupResumer,
+                                       RestoreResumer)
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE acc (id INT PRIMARY KEY, name STRING, "
+              "bal DECIMAL(10,2))")
+    e.execute("INSERT INTO acc VALUES (1,'a',10.50),(2,'b',20.25),"
+              "(3,'c',30.00)")
+    return e
+
+
+def table_rows(e, t="acc"):
+    return e.execute(f"SELECT id, name, bal FROM {t} ORDER BY id").rows
+
+
+class TestFullBackup:
+    def test_roundtrip(self, eng, tmp_path):
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        e2 = Engine()
+        e2.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+        assert table_rows(e2) == table_rows(eng)
+        # descriptor restored into the new catalog
+        assert e2.catalog.get_by_name("acc") is not None
+
+    def test_restore_all_tables_by_default(self, eng, tmp_path):
+        eng.execute("CREATE TABLE t2 (x INT)")
+        eng.execute("INSERT INTO t2 VALUES (7)")
+        eng.execute(f"BACKUP TABLE acc, t2 INTO '{tmp_path}'")
+        e2 = Engine()
+        e2.execute(f"RESTORE FROM '{tmp_path}'")
+        assert table_rows(e2) == table_rows(eng)
+        assert e2.execute("SELECT x FROM t2").rows == [(7,)]
+
+    def test_restore_into_existing_table_fails(self, eng, tmp_path):
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        with pytest.raises(EngineError, match="already exists"):
+            eng.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+
+    def test_restore_missing_backup_fails(self, eng, tmp_path):
+        with pytest.raises(EngineError, match="no backup"):
+            eng.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+
+    def test_post_restore_inserts_work(self, eng, tmp_path):
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        e2 = Engine()
+        e2.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+        e2.execute("INSERT INTO acc VALUES (9,'z',1.00)")
+        assert e2.execute("SELECT count(*) FROM acc").rows == [(4,)]
+
+
+class TestIncrementalBackup:
+    def test_update_delete_insert_window(self, eng, tmp_path):
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        eng.execute("UPDATE acc SET bal = 99.99 WHERE id = 2")
+        eng.execute("DELETE FROM acc WHERE id = 3")
+        eng.execute("INSERT INTO acc VALUES (4,'d',40.00)")
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        e2 = Engine()
+        e2.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+        assert table_rows(e2) == table_rows(eng) == \
+            [(1, "a", 10.5), (2, "b", 99.99), (4, "d", 40.0)]
+
+    def test_three_layers(self, eng, tmp_path):
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        eng.execute("DELETE FROM acc WHERE id = 1")
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        eng.execute("INSERT INTO acc VALUES (1,'a2',11.00)")
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        e2 = Engine()
+        e2.execute(f"RESTORE TABLE acc FROM '{tmp_path}'")
+        assert table_rows(e2) == table_rows(eng)
+
+    def test_incremental_layer_is_small(self, eng, tmp_path):
+        import numpy as np
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        eng.execute("INSERT INTO acc VALUES (4,'d',40.00)")
+        eng.execute(f"BACKUP TABLE acc INTO '{tmp_path}'")
+        with np.load(tmp_path / "l1_acc.npz",
+                     allow_pickle=True) as z:
+            assert int(z["__n"][0]) == 1  # only the new row
+
+
+class TestCrashResume:
+    def test_backup_resumes_after_crash(self, eng, tmp_path):
+        """Crash after the first table's export; a fresh registry
+        finishes the remaining table without redoing the first."""
+        import time
+
+        from cockroach_tpu.jobs.registry import _CrashForTesting
+        eng.execute("CREATE TABLE t2 (x INT)")
+        eng.execute("INSERT INTO t2 VALUES (7)")
+        crashy = Registry(eng.kv, session_id="crashy",
+                          lease_seconds=0.05)
+        crashy.register(BACKUP_JOB,
+                        lambda: BackupResumer(eng,
+                                              crash_after_table=0))
+        jid = crashy.create(BACKUP_JOB, {
+            "tables": ["acc", "t2"], "dest": str(tmp_path)})
+        with pytest.raises(_CrashForTesting):
+            crashy.run_job(jid)
+        # no manifest yet: a torn backup is invisible
+        import os
+        assert "BACKUP_MANIFEST.json" not in os.listdir(tmp_path)
+        time.sleep(0.1)
+        fresh = Registry(eng.kv, session_id="fresh")
+        fresh.register(BACKUP_JOB, lambda: BackupResumer(eng))
+        done = fresh.adopt_and_run_all()
+        assert any(r.id == jid and r.status == "succeeded"
+                   for r in done)
+        e2 = Engine()
+        e2.execute(f"RESTORE FROM '{tmp_path}'")
+        assert table_rows(e2) == table_rows(eng)
+        assert e2.execute("SELECT x FROM t2").rows == [(7,)]
+
+    def test_snapshot_ts_fixed_across_resume(self, eng, tmp_path):
+        """Writes between crash and resume must NOT leak into the
+        backup: the end_ts checkpoint pins the snapshot."""
+        import time
+
+        from cockroach_tpu.jobs.registry import _CrashForTesting
+        eng.execute("CREATE TABLE t2 (x INT)")
+        crashy = Registry(eng.kv, session_id="crashy",
+                          lease_seconds=0.05)
+        crashy.register(BACKUP_JOB,
+                        lambda: BackupResumer(eng,
+                                              crash_after_table=0))
+        jid = crashy.create(BACKUP_JOB, {
+            "tables": ["acc", "t2"], "dest": str(tmp_path)})
+        with pytest.raises(_CrashForTesting):
+            crashy.run_job(jid)
+        eng.execute("INSERT INTO t2 VALUES (999)")  # after snapshot ts
+        time.sleep(0.1)
+        fresh = Registry(eng.kv, session_id="fresh")
+        fresh.register(BACKUP_JOB, lambda: BackupResumer(eng))
+        fresh.adopt_and_run_all()
+        e2 = Engine()
+        e2.execute(f"RESTORE FROM '{tmp_path}'")
+        assert e2.execute("SELECT count(*) FROM t2").rows == [(0,)]
