@@ -1,0 +1,120 @@
+"""Native (C++) components: build-on-first-import, ctypes ABI.
+
+The reference carries its native axis in c-deps/ built by Bazel; here
+the single native hotspot so far is bulk key encoding (keyenc.cpp).
+The shared library compiles lazily with g++ (cached next to the
+source, keyed on mtime) and loads via ctypes — pybind11 isn't in the
+image, and the ABI is 4 flat functions. Everything degrades to the
+pure-Python codec if a toolchain is missing, so the package never
+hard-depends on a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "keyenc.cpp")
+_SO = os.path.join(_HERE, "_keyenc.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return True
+        tmp = _SO + ".tmp"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib():
+    """The loaded keyenc library, or None (callers fall back)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.keyenc_batch_int.argtypes = [
+            u8p, ctypes.c_int64, i64p, ctypes.c_int64, u8p, i64p]
+        lib.keyenc_batch_int.restype = None
+        lib.keyenc_batch_bytes.argtypes = [
+            u8p, ctypes.c_int64, u8p, i64p, ctypes.c_int64, u8p, i64p]
+        lib.keyenc_batch_bytes.restype = ctypes.c_int64
+        lib.keyenc_int64.argtypes = [ctypes.c_int64, u8p]
+        lib.keyenc_float64.argtypes = [ctypes.c_double, u8p]
+        lib.keyenc_bytes.argtypes = [u8p, ctypes.c_int64, u8p]
+        lib.keyenc_bytes.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def batch_encode_int_keys(prefix: bytes, vals) -> list[bytes]:
+    """n keys of prefix+int64 via the native encoder; None if no lib."""
+    import numpy as np
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    n = len(vals)
+    stride = len(prefix) + 8
+    out = np.empty(n * stride, dtype=np.uint8)
+    offs = np.empty(n + 1, dtype=np.int64)
+    pbuf = (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
+    lib.keyenc_batch_int(
+        ctypes.cast(pbuf, ctypes.POINTER(ctypes.c_uint8)),
+        len(prefix),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    raw = out.tobytes()
+    return [raw[i * stride:(i + 1) * stride] for i in range(n)]
+
+
+def batch_encode_str_keys(prefix: bytes, strs: list[str]) -> list[bytes]:
+    """n keys of prefix+escaped-utf8; None if no lib."""
+    import numpy as np
+    lib = get_lib()
+    if lib is None:
+        return None
+    blobs = [s.encode("utf-8") for s in strs]
+    n = len(blobs)
+    data = b"".join(blobs)
+    doffs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=doffs[1:])
+    cap = n * len(prefix) + 2 * len(data) + 2 * n
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    offs = np.empty(n + 1, dtype=np.int64)
+    pbuf = (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
+    dbuf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
+        data or b"\x00")
+    lib.keyenc_batch_bytes(
+        ctypes.cast(pbuf, ctypes.POINTER(ctypes.c_uint8)),
+        len(prefix),
+        ctypes.cast(dbuf, ctypes.POINTER(ctypes.c_uint8)),
+        doffs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    raw = out.tobytes()
+    return [raw[offs[i]:offs[i + 1]] for i in range(n)]

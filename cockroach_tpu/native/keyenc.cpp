@@ -1,0 +1,103 @@
+// Native order-preserving key encoder (hot host-side path).
+//
+// The analogue of the reference's native encoding axis: where
+// CockroachDB leans on Go codegen + Pebble's C-shaped comparator for
+// key work, the TPU rebuild keeps compute on-device and pushes the
+// row-plane's hottest HOST loop — bulk primary-key encoding (pk-index
+// builds, DML key derivation, backup exports) — into C++. The byte
+// format matches storage/keys.py exactly (8-byte big-endian
+// sign-offset ints; 0x00-escaped, 0x00 0x01-terminated bytes;
+// IEEE754 bit-flip floats); tests/test_native_keyenc.py pins the two
+// implementations together.
+//
+// Build: cockroach_tpu/native/__init__.py compiles this with g++ at
+// first import (ctypes ABI, no pybind11 in the image) and falls back
+// to the Python codec if a toolchain is unavailable.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// scalar encodings
+// ---------------------------------------------------------------------------
+
+static inline void put_u64_be(uint8_t *dst, uint64_t u) {
+  for (int i = 7; i >= 0; --i) {
+    dst[i] = (uint8_t)(u & 0xff);
+    u >>= 8;
+  }
+}
+
+// int64 -> 8 bytes big-endian with sign offset (keys.py encode_int)
+void keyenc_int64(int64_t v, uint8_t *out) {
+  put_u64_be(out, (uint64_t)v + (1ULL << 63));
+}
+
+// float64 -> 8 bytes with the order-preserving bit flip
+void keyenc_float64(double v, uint8_t *out) {
+  uint64_t u;
+  std::memcpy(&u, &v, 8);
+  if (u & (1ULL << 63))
+    u = ~u;
+  else
+    u |= (1ULL << 63);
+  put_u64_be(out, u);
+}
+
+// escaped+terminated bytes; returns encoded length (<= 2*len + 2)
+int64_t keyenc_bytes(const uint8_t *src, int64_t len, uint8_t *out) {
+  int64_t o = 0;
+  for (int64_t i = 0; i < len; ++i) {
+    if (src[i] == 0x00) {
+      out[o++] = 0x00;
+      out[o++] = 0xff;
+    } else {
+      out[o++] = src[i];
+    }
+  }
+  out[o++] = 0x00;
+  out[o++] = 0x01;
+  return o;
+}
+
+// ---------------------------------------------------------------------------
+// batch key encoders (prefix + one pk column per key)
+// ---------------------------------------------------------------------------
+
+// n keys of (prefix + int64): fixed stride. out must hold
+// n * (prefix_len + 8); out_offsets gets n+1 entries.
+void keyenc_batch_int(const uint8_t *prefix, int64_t prefix_len,
+                      const int64_t *vals, int64_t n, uint8_t *out,
+                      int64_t *out_offsets) {
+  const int64_t stride = prefix_len + 8;
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t *dst = out + i * stride;
+    std::memcpy(dst, prefix, (size_t)prefix_len);
+    keyenc_int64(vals[i], dst + prefix_len);
+    out_offsets[i] = i * stride;
+  }
+  out_offsets[n] = n * stride;
+}
+
+// n keys of (prefix + escaped bytes). Inputs are a concatenated utf-8
+// buffer with n+1 offsets. out must hold n*prefix_len + 2*data_len +
+// 2*n bytes (worst case). Returns total bytes written.
+int64_t keyenc_batch_bytes(const uint8_t *prefix, int64_t prefix_len,
+                           const uint8_t *data,
+                           const int64_t *data_offsets, int64_t n,
+                           uint8_t *out, int64_t *out_offsets) {
+  int64_t o = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    out_offsets[i] = o;
+    std::memcpy(out + o, prefix, (size_t)prefix_len);
+    o += prefix_len;
+    o += keyenc_bytes(data + data_offsets[i],
+                      data_offsets[i + 1] - data_offsets[i], out + o);
+  }
+  out_offsets[n] = o;
+  return o;
+}
+
+}  // extern "C"

@@ -516,10 +516,46 @@ class ColumnStore:
             idx: dict[bytes, tuple[int, int]] = {}
             for ci, chunk in enumerate(td.chunks):
                 live = chunk.mvcc_del == MAX_TS_INT
-                for ri in np.nonzero(live)[0]:
-                    idx[self.row_key(td, chunk, int(ri))] = (ci, int(ri))
+                ris = np.nonzero(live)[0]
+                batch = self._batch_row_keys(td, chunk, ris)
+                if batch is not None:
+                    for ri, key in zip(ris, batch):
+                        idx[key] = (ci, int(ri))
+                else:
+                    for ri in ris:
+                        idx[self.row_key(td, chunk, int(ri))] = \
+                            (ci, int(ri))
             td.pk_index = idx
             return idx
+
+    def _batch_row_keys(self, td: TableData, chunk: Chunk,
+                        ris: np.ndarray):
+        """Bulk pk-key encode via the native codec (native/keyenc.cpp);
+        None = shape not covered (multi-column or float pk) or no
+        toolchain — caller falls back to the Python row_key loop."""
+        from .. import native
+        from . import keys as K
+        codec = td.codec
+        if len(ris) == 0:
+            return []
+        prefix = K.table_prefix(codec.table_id)
+        if codec.synthetic_pk:
+            return native.batch_encode_int_keys(prefix,
+                                                chunk.rowid[ris])
+        if len(codec.pk_cols) != 1:
+            return None
+        cn = codec.pk_cols[0]
+        col = td.schema.column(cn)
+        fam = col.type.family
+        if fam == Family.STRING:
+            vals = td.dictionaries[cn].decode_array(
+                chunk.data[cn][ris])
+            return native.batch_encode_str_keys(prefix, list(vals))
+        if fam in (Family.INT, Family.DATE, Family.TIMESTAMP,
+                   Family.DECIMAL, Family.BOOL, Family.INTERVAL):
+            return native.batch_encode_int_keys(
+                prefix, chunk.data[cn][ris].astype(np.int64))
+        return None
 
     def apply_committed(self, name: str, ops: list, ts: Timestamp) -> None:
         """Publish one committed txn's effects on this table.
