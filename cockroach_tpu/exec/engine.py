@@ -167,9 +167,12 @@ class Prepared:
         return fns.final(state)
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
+        tracer = self.engine.tracer
         try:
-            return self.engine._materialize(self.dispatch(read_ts),
-                                            self.meta)
+            with tracer.span("dispatch"):
+                out = self.dispatch(read_ts)
+            with tracer.span("materialize"):
+                return self.engine._materialize(out, self.meta)
         except HashCapacityExceeded:
             # partition-and-recurse (the reference's disk spiller,
             # colexecdisk/disk_spiller.go:75, over HBM re-reads)
@@ -198,6 +201,12 @@ class Engine:
         # changefeed event taps (cdc/changefeed.py TableFeed)
         self.cdc_feeds: list = []
         self._cdc_threads: dict[int, threading.Thread] = {}
+        # observability: span tracing (util/tracing) + per-statement
+        # fingerprint stats (pkg/sql/sqlstats)
+        from ..utils.sqlstats import StatsRegistry
+        from ..utils.tracing import Tracer
+        self.tracer = Tracer()
+        self.sqlstats = StatsRegistry()
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
@@ -247,15 +256,20 @@ class Engine:
         import time as _time
         t0 = _time.monotonic()
         try:
-            with self._stmt_lock:
-                res = self._dispatch_stmt(stmt, session, sql_text)
+            with self.tracer.span(
+                    f"stmt:{type(stmt).__name__.lower()}"):
+                with self._stmt_lock:
+                    res = self._dispatch_stmt(stmt, session, sql_text)
             self.metrics.counter(
                 f"sql.{type(stmt).__name__.lower()}.count",
                 "statements executed, by type").inc()
+            dt = _time.monotonic() - t0
             self.metrics.histogram(
                 "sql.exec.latency",
-                "statement execution latency (s)").observe(
-                    _time.monotonic() - t0)
+                "statement execution latency (s)").observe(dt)
+            if sql_text:
+                self.sqlstats.record(sql_text, dt,
+                                     max(len(res.rows), res.row_count))
             return res
         except Exception:
             # any error inside an explicit txn block aborts it until
@@ -263,6 +277,10 @@ class Engine:
             # machine's stateAborted) — not just DML failures
             self.metrics.counter("sql.failure.count",
                                  "statements that errored").inc()
+            if sql_text:
+                self.sqlstats.record(sql_text,
+                                     _time.monotonic() - t0, 0,
+                                     failed=True)
             if session.txn is not None and not isinstance(
                     stmt, ast.BeginTxn):
                 session.txn_aborted = True
@@ -345,6 +363,9 @@ class Engine:
             return Result(names=[stmt.name], rows=[(v,)], tag="SHOW")
         if isinstance(stmt, ast.Explain):
             from ..sql.stats import estimate
+            if stmt.analyze:
+                return self._explain_analyze(stmt.stmt, session,
+                                             sql_text)
             node, _ = self._plan(stmt.stmt, session)
             costs = estimate(node, self.catalog_view().stats)
             tree = P.plan_tree_repr(node, costs=costs)
@@ -352,6 +373,16 @@ class Engine:
                           rows=[(line,) for line in
                                 tree.rstrip().split("\n")],
                           tag="EXPLAIN")
+        if isinstance(stmt, ast.ShowStatements):
+            return Result(
+                names=["fingerprint", "count", "mean_latency_ms",
+                       "max_latency_ms", "rows", "failures"],
+                rows=[(s.fingerprint, s.count,
+                       round(s.mean_latency_s * 1e3, 3),
+                       round(s.max_latency_s * 1e3, 3),
+                       s.total_rows, s.failures)
+                      for s in self.sqlstats.all()],
+                tag="SHOW STATEMENTS")
         if isinstance(stmt, ast.Analyze):
             self.store.analyze(stmt.table)
             self.metrics.counter("sql.stats.analyze",
@@ -392,6 +423,37 @@ class Engine:
             session.txn_aborted = False
             return Result(tag="ROLLBACK")
         raise EngineError(f"unsupported statement {type(stmt).__name__}")
+
+    def _explain_analyze(self, sel, session: Session,
+                         sql_text: str) -> Result:
+        """EXPLAIN ANALYZE: run the statement under a trace recording
+        and render the plan with measured phase timings + row counts
+        (the reference's instrumented statement diagnostics,
+        sql/instrumentation.go)."""
+        if not isinstance(sel, ast.Select):
+            raise EngineError("can only EXPLAIN ANALYZE SELECT")
+        import time as _time
+        with self.tracer.capture("explain-analyze") as rec:
+            t0 = _time.monotonic()
+            res = self._exec_select(sel, session, sql_text)
+            total_ms = (_time.monotonic() - t0) * 1e3
+        node, _ = self._plan(sel, session)
+        from ..sql.stats import estimate
+        costs = estimate(node, self.catalog_view().stats)
+        lines = ["planning/execution:"]
+        for name in ("plan", "compile", "upload", "dispatch",
+                     "materialize"):
+            s = rec.find(name)
+            if s is not None:
+                tag_s = "".join(f" {k}={v}" for k, v in s.tags.items())
+                lines.append(f"  {name}: {s.duration_ms:.2f}ms{tag_s}")
+        lines.append(f"  total: {total_ms:.2f}ms, "
+                     f"rows returned: {len(res.rows)}")
+        lines.append("plan:")
+        lines.extend("  " + ln for ln in P.plan_tree_repr(
+            node, costs=costs).rstrip().split("\n"))
+        return Result(names=["info"], rows=[(ln,) for ln in lines],
+                      tag="EXPLAIN ANALYZE")
 
     # -- catalog -------------------------------------------------------------
     def catalog_view(self) -> CatalogView:
@@ -577,7 +639,8 @@ class Engine:
         for td in self.store.tables.values():
             if td.open_ts:
                 self.store.seal(td.schema.name)
-        node, meta = self._plan(sel, session)
+        with self.tracer.span("plan"):
+            node, meta = self._plan(sel, session)
 
         scan_aliases = _collect_scans(node)
         scan_cols = _collect_scan_columns(node)
@@ -647,6 +710,7 @@ class Engine:
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
                stream, cap, pallas, plan_fp)
         cached = self._exec_cache.get(key)
+        self.tracer.tag(plan_cache="hit" if cached else "miss")
         if cached is None:
             params = ExecParams(
                 hash_group_capacity=cap,

@@ -266,6 +266,12 @@ class ShowJobs(Statement):
 
 
 @dataclass
+class ShowStatements(Statement):
+    """SHOW STATEMENTS: per-fingerprint execution stats (sqlstats)."""
+    pass
+
+
+@dataclass
 class CancelJob(Statement):
     job_id: int
 

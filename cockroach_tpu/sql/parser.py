@@ -126,6 +126,10 @@ class Parser:
                     and self.peek().text == "jobs":
                 self.next()
                 return ast.ShowJobs()
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "statements":
+                self.next()
+                return ast.ShowStatements()
             self.accept_kw("cluster")
             self.accept_kw("setting")
             return ast.ShowVar(self.dotted_name())

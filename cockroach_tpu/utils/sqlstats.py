@@ -1,0 +1,73 @@
+"""Per-statement execution statistics (pkg/sql/sqlstats analogue).
+
+Statements aggregate by FINGERPRINT — the query text with literals
+replaced by placeholders, so `SELECT a FROM t WHERE b = 7` and
+`... b = 8` are one statement — tracking counts, latency moments, and
+row counts. Surfaced through SHOW STATEMENTS.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+
+
+_NUM = re.compile(r"\b\d+(\.\d+)?([eE][-+]?\d+)?\b")
+_STR = re.compile(r"'(?:[^']|'')*'")
+_WS = re.compile(r"\s+")
+
+
+def fingerprint(sql: str) -> str:
+    """Normalize literals to '_' (the reference's tree-walking
+    fingerprinter, here regex-shaped: same goal, no reparse)."""
+    s = _STR.sub("'_'", sql)
+    s = _NUM.sub("_", s)
+    return _WS.sub(" ", s).strip()
+
+
+@dataclass
+class StmtStats:
+    fingerprint: str
+    count: int = 0
+    total_latency_s: float = 0.0
+    max_latency_s: float = 0.0
+    total_rows: int = 0
+    failures: int = 0
+
+    @property
+    def mean_latency_s(self) -> float:
+        return self.total_latency_s / self.count if self.count else 0.0
+
+
+class StatsRegistry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._stats: dict[str, StmtStats] = {}
+
+    def record(self, sql: str, latency_s: float, rows: int,
+               failed: bool = False) -> None:
+        fp = fingerprint(sql)
+        with self._mu:
+            st = self._stats.get(fp)
+            if st is None:
+                st = self._stats[fp] = StmtStats(fp)
+            st.count += 1
+            st.total_latency_s += latency_s
+            st.max_latency_s = max(st.max_latency_s, latency_s)
+            st.total_rows += rows
+            if failed:
+                st.failures += 1
+
+    def all(self) -> list[StmtStats]:
+        with self._mu:
+            return sorted(self._stats.values(),
+                          key=lambda s: -s.total_latency_s)
+
+    def get(self, sql: str):
+        with self._mu:
+            return self._stats.get(fingerprint(sql))
+
+    def reset(self) -> None:
+        with self._mu:
+            self._stats.clear()

@@ -1,0 +1,88 @@
+"""Span-based tracing (the analogue of pkg/util/tracing).
+
+A Tracer hands out nested spans with wall-clock durations and tags;
+the active span propagates through a thread-local, so any layer can
+child_span() without plumbing (the reference threads a Context
+instead; a thread-local matches this engine's one-statement-per-thread
+execution model). A capture() scope collects the finished span tree —
+that recording is what EXPLAIN ANALYZE renders, like the reference's
+WithRecording(trace) statement diagnostics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start_ns: int
+    end_ns: int = 0
+    tags: dict = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def tree_lines(self, indent: int = 0) -> list[str]:
+        tag_s = "".join(f" {k}={v}" for k, v in self.tags.items())
+        out = [f"{'  ' * indent}{self.name}: "
+               f"{self.duration_ms:.2f}ms{tag_s}"]
+        for c in self.children:
+            out.extend(c.tree_lines(indent + 1))
+        return out
+
+    def find(self, name: str) -> Optional["Span"]:
+        if self.name == name:
+            return self
+        for c in self.children:
+            hit = c.find(name)
+            if hit is not None:
+                return hit
+        return None
+
+
+class Tracer:
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _cur(self) -> Optional[Span]:
+        return getattr(self._tls, "span", None)
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        """Open a child of the active span (no-op-cheap when nothing
+        is capturing: spans still nest, they just aren't retained)."""
+        parent = self._cur()
+        s = Span(name, time.monotonic_ns(), tags=dict(tags))
+        if parent is not None:
+            parent.children.append(s)
+        self._tls.span = s
+        try:
+            yield s
+        finally:
+            s.end_ns = time.monotonic_ns()
+            self._tls.span = parent
+
+    @contextmanager
+    def capture(self, name: str = "trace"):
+        """Collect a full recording rooted at `name` on this thread."""
+        prev = self._cur()
+        root = Span(name, time.monotonic_ns())
+        self._tls.span = root
+        try:
+            yield root
+        finally:
+            root.end_ns = time.monotonic_ns()
+            self._tls.span = prev
+
+    def tag(self, **tags) -> None:
+        s = self._cur()
+        if s is not None:
+            s.tags.update(tags)

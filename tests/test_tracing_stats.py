@@ -1,0 +1,113 @@
+"""Tracing spans, EXPLAIN ANALYZE, and sqlstats.
+
+References: pkg/util/tracing (span recordings), sql/instrumentation.go
+(EXPLAIN ANALYZE over a trace), pkg/sql/sqlstats (fingerprint
+aggregation)."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.utils.sqlstats import StatsRegistry, fingerprint
+from cockroach_tpu.utils.tracing import Tracer
+
+
+class TestTracer:
+    def test_nested_spans(self):
+        tr = Tracer()
+        with tr.capture("root") as rec:
+            with tr.span("a"):
+                with tr.span("b", rows=3):
+                    pass
+            with tr.span("c"):
+                pass
+        assert [c.name for c in rec.children] == ["a", "c"]
+        assert rec.children[0].children[0].name == "b"
+        assert rec.find("b").tags == {"rows": 3}
+        assert rec.find("b").duration_ms >= 0
+
+    def test_spans_without_capture_are_harmless(self):
+        tr = Tracer()
+        with tr.span("orphan"):
+            tr.tag(x=1)
+
+    def test_capture_isolated_per_thread(self):
+        import threading
+        tr = Tracer()
+        seen = []
+
+        def worker():
+            with tr.capture("w") as rec:
+                with tr.span("inner"):
+                    pass
+            seen.append(rec)
+
+        with tr.capture("main") as rec:
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert rec.find("inner") is None  # other thread's span
+        assert seen[0].find("inner") is not None
+
+
+class TestFingerprint:
+    def test_literals_normalized(self):
+        a = fingerprint("SELECT a FROM t WHERE b = 7 AND s = 'x'")
+        b = fingerprint("SELECT a FROM t WHERE b = 942 AND s = 'zz'")
+        assert a == b
+
+    def test_structure_distinguished(self):
+        assert fingerprint("SELECT a FROM t") != \
+            fingerprint("SELECT b FROM t")
+
+    def test_registry_aggregates(self):
+        r = StatsRegistry()
+        r.record("SELECT 1", 0.5, 1)
+        r.record("SELECT 2", 1.5, 1)
+        r.record("SELECT x", 0.1, 0, failed=True)
+        top = r.all()[0]
+        assert top.count == 2 and top.mean_latency_s == 1.0
+        assert top.max_latency_s == 1.5
+        assert r.all()[1].failures == 1
+
+
+class TestEngineIntegration:
+    @pytest.fixture()
+    def eng(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT, s STRING)")
+        e.execute("INSERT INTO t VALUES (1,'x'),(2,'y'),(3,'x')")
+        return e
+
+    def test_explain_analyze_shape(self, eng):
+        r = eng.execute("EXPLAIN ANALYZE SELECT s, count(*) FROM t "
+                        "GROUP BY s")
+        text = "\n".join(row[0] for row in r.rows)
+        assert "dispatch:" in text and "materialize:" in text
+        assert "rows returned: 2" in text
+        assert "Aggregate" in text and "Scan t" in text
+
+    def test_explain_analyze_non_select_rejected(self, eng):
+        with pytest.raises(Exception, match="EXPLAIN ANALYZE SELECT"):
+            eng.execute("EXPLAIN ANALYZE INSERT INTO t VALUES (9,'z')")
+
+    def test_show_statements(self, eng):
+        eng.execute("SELECT a FROM t WHERE a = 1")
+        eng.execute("SELECT a FROM t WHERE a = 2")
+        rows = eng.execute("SHOW STATEMENTS").rows
+        by_fp = {r[0]: r for r in rows}
+        fp = "SELECT a FROM t WHERE a = _"
+        assert by_fp[fp][1] == 2          # count
+        assert by_fp[fp][4] == 2          # total rows
+        assert by_fp[fp][2] > 0           # mean latency
+
+    def test_failures_counted(self, eng):
+        with pytest.raises(Exception):
+            eng.execute("SELECT nope FROM t")
+        rows = eng.execute("SHOW STATEMENTS").rows
+        assert any(r[0] == "SELECT nope FROM t" and r[5] == 1
+                   for r in rows)
+
+    def test_plan_cache_tag(self, eng):
+        with eng.tracer.capture("c") as rec:
+            eng.execute("SELECT a FROM t WHERE a = 1")
+        assert rec.find("plan") is not None
