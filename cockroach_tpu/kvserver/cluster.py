@@ -44,8 +44,10 @@ class NotLeaseholderError(Exception):
 
 class Cluster:
     def __init__(self, n_nodes: int = 3, seed: int = 0,
-                 liveness_ttl: int = 30):
-        self.transport = LocalTransport()
+                 liveness_ttl: int = 30, transport=None):
+        # pass transport=ChaosTransport(seed) for an adversarial
+        # reorder/duplicate/delay delivery schedule
+        self.transport = transport or LocalTransport()
         self.liveness = NodeLiveness(ttl_ticks=liveness_ttl)
         self.clock = Clock()
         self.stores: dict[int, Store] = {}
@@ -107,6 +109,26 @@ class Cluster:
             for nid, store in self.stores.items():
                 if nid not in self.down:
                     store.handle_ready_all()
+
+    def check_replica_consistency(self, range_id: int) -> None:
+        """Assert every up replica of a range holds identical applied
+        MVCC state — the consistency-checker queue's checksum compare
+        (kvserver/consistency_queue.go), done by direct comparison.
+        Call after draining traffic; raises AssertionError on
+        divergence."""
+        states = {}
+        for nid, s in self.stores.items():
+            if nid in self.down or range_id not in s.replicas:
+                continue
+            rep = s.replicas[range_id]
+            states[nid] = rep._snapshot_state()
+        vals = list(states.values())
+        for nid, st in states.items():
+            if st != vals[0]:
+                first = next(iter(states))
+                raise AssertionError(
+                    f"replica divergence on r{range_id}: node {nid} "
+                    f"!= node {first}")
 
     def tick_closed_ts(self) -> None:
         """One side-transport round: every live leaseholder closes up

@@ -277,7 +277,9 @@ class Replica:
         if cmd_id:
             self._applied_ids.add(cmd_id)
             self._applied_order.append(cmd_id)
-            while len(self._applied_order) > 10000:
+            from ..utils.metamorphic import metamorphic_int
+            while len(self._applied_order) > metamorphic_int(
+                    "kvserver.dedup_window", 10000, 200, 10000):
                 self._applied_ids.discard(self._applied_order.popleft())
         result = self._eval(cmd)
         done = self._waiters.pop(cmd_id, None)
@@ -448,12 +450,17 @@ class Store:
     """All replicas on one node (pkg/kv/kvserver/store.go)."""
 
     def __init__(self, node_id: int, transport, clock: Optional[Clock] = None,
-                 liveness=None, raft_log_max: int = 1 << 20, seed: int = 0,
+                 liveness=None, raft_log_max: int | None = None,
+                 seed: int = 0,
                  closedts_target_ns: int = int(3e9)):
         self.node_id = node_id
         self.transport = transport
         self.clock = clock or Clock()
         self.liveness = liveness
+        from ..utils.metamorphic import metamorphic_pow2
+        if raft_log_max is None:
+            raft_log_max = metamorphic_pow2(
+                "kvserver.raft_log_max", 1 << 20, 12, 20)
         self.raft_log_max = raft_log_max
         # how far behind now the leaseholder closes (the reference's
         # kv.closed_timestamp.target_duration, default 3s)

@@ -94,3 +94,41 @@ class LocalTransport:
 
     def pending(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+
+class ChaosTransport(LocalTransport):
+    """LocalTransport with a seeded adversarial delivery schedule:
+    per-queue message REORDERING, DUPLICATION, and DELAY (requeue for a
+    later pump). The raft/MVCC planes must converge to identical state
+    regardless — the in-process stand-in for the reference's kvnemesis
+    + raft message-race coverage, which our strictly-FIFO default
+    transport cannot exercise."""
+
+    def __init__(self, seed: int = 0, p_dup: float = 0.1,
+                 p_delay: float = 0.15, shuffle: bool = True):
+        super().__init__(rng=random.Random(seed))
+        self.p_dup = p_dup
+        self.p_delay = p_delay
+        self.shuffle = shuffle
+
+    def deliver_all(self) -> int:
+        n = 0
+        for node_id, q in self._queues.items():
+            batch = list(q)
+            q.clear()
+            if self.shuffle:
+                self._rng.shuffle(batch)
+            for frm, msg in batch:
+                if self._blocked(frm, node_id) or node_id in self._down:
+                    self.dropped += 1
+                    continue
+                if self._rng.random() < self.p_delay:
+                    q.append((frm, msg))  # deliver on a later pump
+                    continue
+                self._handlers[node_id](frm, msg)
+                n += 1
+                if self._rng.random() < self.p_dup:
+                    self._handlers[node_id](frm, msg)  # duplicate
+                    n += 1
+        self.delivered += n
+        return n

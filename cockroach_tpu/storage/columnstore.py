@@ -130,7 +130,13 @@ class TableData:
 class ColumnStore:
     """All tables of one store (one node's data plane)."""
 
-    def __init__(self, chunk_rows: int = 1 << 20):
+    def __init__(self, chunk_rows: int | None = None):
+        from ..utils.metamorphic import metamorphic_pow2
+        if chunk_rows is None:
+            # metamorphic: chunk size is perf-only; results must not
+            # change at 64 rows or 1M rows
+            chunk_rows = metamorphic_pow2(
+                "columnstore.chunk_rows", 1 << 20, 6, 20)
         self._lock = threading.RLock()
         self.tables: dict[str, TableData] = {}
         self.chunk_rows = chunk_rows

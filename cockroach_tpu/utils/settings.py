@@ -109,6 +109,11 @@ def _register_builtins(s: Settings):
                "(the HBM analogue of --max-sql-memory / workmem)")
 
 
+def _meta_page_rows() -> int:
+    from .metamorphic import metamorphic_pow2
+    return metamorphic_pow2("sql.streaming_page_rows", 1 << 21, 12, 21)
+
+
 @dataclass
 class SessionVars:
     """Session variables with reference-compatible names where sensible."""
@@ -116,7 +121,7 @@ class SessionVars:
         "vectorize": "on",           # on | off  (off = host row engine)
         "distsql": "auto",           # auto | on | off | always
         "streaming": "auto",         # auto | off (beyond-HBM paging)
-        "streaming_page_rows": 1 << 21,
+        "streaming_page_rows": _meta_page_rows(),
         "direct_columnar_scans_enabled": True,
         "hash_group_capacity": 1 << 17,
         # opt-in one-pass Pallas kernel for dense float GROUP BY

@@ -10,6 +10,10 @@ without TPU hardware.
 
 import os
 
+# expensive structural invariant checks are on for the whole suite
+# (the reference's CrdbTestBuild assertions; utils/invariants.py)
+os.environ.setdefault("COCKROACH_TPU_INVARIANTS", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
