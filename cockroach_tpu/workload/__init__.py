@@ -9,7 +9,9 @@ TPC-H lives in models/tpch.py (it doubles as the bench's flagship
 
 from .bank import Bank
 from .kvload import KVLoad
+from .movr import MovR
 from .ssb import SSB
+from .tpcc import TPCC
 from .ycsb import YCSB
 
 WORKLOADS = {
@@ -17,6 +19,9 @@ WORKLOADS = {
     "kv": KVLoad,
     "ycsb": YCSB,
     "ssb": SSB,
+    "tpcc": TPCC,
+    "movr": MovR,
 }
 
-__all__ = ["Bank", "KVLoad", "YCSB", "SSB", "WORKLOADS"]
+__all__ = ["Bank", "KVLoad", "YCSB", "SSB", "TPCC", "MovR",
+           "WORKLOADS"]

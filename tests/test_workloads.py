@@ -100,4 +100,5 @@ class TestSSB:
         assert years == sorted(years)
 
     def test_registry_names(self):
-        assert set(WORKLOADS) == {"bank", "kv", "ycsb", "ssb"}
+        assert set(WORKLOADS) == {"bank", "kv", "ycsb", "ssb",
+                                  "tpcc", "movr"}
