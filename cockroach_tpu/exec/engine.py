@@ -288,7 +288,7 @@ class Engine:
 
     def _dispatch_stmt(self, stmt: ast.Statement, session: Session,
                        sql_text: str = "") -> Result:
-        if isinstance(stmt, ast.Select):
+        if isinstance(stmt, (ast.Select, ast.SetOp)):
             return self._exec_select(stmt, session, sql_text)
         if isinstance(stmt, ast.CreateTable):
             return self._exec_create(stmt)
@@ -757,13 +757,126 @@ class Engine:
             raise EngineError("can only prepare table-reading SELECTs")
         return self._prepare_select(stmt, session, sql_text=sql)
 
-    def _exec_select(self, sel: ast.Select, session: Session,
+    def _exec_select(self, sel, session: Session,
                      sql_text: str) -> Result:
+        if isinstance(sel, ast.SetOp):
+            return self._exec_setop(sel, session, sql_text)
         if sel.ctes or self._has_derived(sel):
             return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
             return self._exec_table_free(sel, session)
         return self._prepare_select(sel, session, sql_text).run()
+
+    def _exec_setop(self, so: ast.SetOp, session: Session,
+                    sql_text: str) -> Result:
+        """UNION / INTERSECT / EXCEPT [ALL]: both branches execute as
+        ordinary statements (each fully device-compiled); the combine
+        is a host multiset merge over decoded rows — matching the
+        reference's setOpNode, which likewise merges above the
+        vectorized inputs (sql/union.go)."""
+        import copy
+        if so.ctes:
+            # WITH over a set op: materialize temps then recurse with
+            # names rewritten in both branches
+            temps: list[str] = []
+            mapping: dict[str, str] = {}
+            so = copy.copy(so)
+            try:
+                for name, cols, sub in so.ctes:
+                    sub = _rewrite_table_names(sub, mapping)
+                    res = self._exec_select(sub, session,
+                                            f"(cte {sub!r})")
+                    tname = f"__cte{self._temp_seq()}_{name}"
+                    self._materialize_temp(tname, res, cols)
+                    mapping[name] = tname
+                    temps.append(tname)
+                so.ctes = []
+                so = _rewrite_table_names(so, mapping)
+                return self._exec_setop(so, session, sql_text)
+            finally:
+                for t in temps:
+                    if t in self.store.tables:
+                        self.store.drop_table(t)
+                        for k in [k for k in self._device_tables
+                                  if k[0] == t]:
+                            self._evict_device(k)
+        left = self._exec_select(so.left, session,
+                                 f"(setop-l {so.left!r})")
+        right = self._exec_select(so.right, session,
+                                  f"(setop-r {so.right!r})")
+        if len(left.names) != len(right.names):
+            raise EngineError(
+                f"each {so.op.upper()} branch must have the same "
+                f"number of columns ({len(left.names)} vs "
+                f"{len(right.names)})")
+        for lt, rt in zip(left.types, right.types):
+            if lt.family != rt.family and \
+                    "unknown" not in (lt.family.value, rt.family.value):
+                raise EngineError(
+                    f"{so.op.upper()} branch column types do not "
+                    f"match: {lt} vs {rt}")
+        lrows, rrows = list(left.rows), list(right.rows)
+        if so.op == "union":
+            rows = lrows + rrows
+            if not so.all:
+                rows = list(dict.fromkeys(rows))
+        elif so.op == "intersect":
+            from collections import Counter
+            rc = Counter(rrows)
+            if so.all:
+                rows = []
+                for r in lrows:
+                    if rc[r] > 0:
+                        rc[r] -= 1
+                        rows.append(r)
+            else:
+                rset = set(rrows)
+                rows = list(dict.fromkeys(
+                    r for r in lrows if r in rset))
+        else:  # except
+            from collections import Counter
+            rc = Counter(rrows)
+            if so.all:
+                rows = []
+                for r in lrows:
+                    if rc[r] > 0:
+                        rc[r] -= 1
+                    else:
+                        rows.append(r)
+            else:
+                rset = set(rrows)
+                rows = list(dict.fromkeys(
+                    r for r in lrows if r not in rset))
+        if so.order_by:
+            rows = self._sort_decoded(rows, left.names, so.order_by)
+        if so.offset:
+            rows = rows[so.offset:]
+        if so.limit is not None:
+            rows = rows[:so.limit]
+        return Result(names=list(left.names), rows=rows,
+                      types=list(left.types))
+
+    @staticmethod
+    def _sort_decoded(rows: list, names: list, order_by) -> list:
+        """Host sort of decoded rows by output columns/positions; pg
+        NULL ordering (last for asc, first for desc)."""
+        out = list(rows)
+        for ob in reversed(order_by):
+            if isinstance(ob.expr, ast.Literal) \
+                    and isinstance(ob.expr.value, int):
+                i = ob.expr.value - 1
+            elif isinstance(ob.expr, ast.ColumnRef) \
+                    and ob.expr.name in names:
+                i = names.index(ob.expr.name)
+            else:
+                raise EngineError(
+                    "set-op ORDER BY must reference output columns")
+
+            def key(r, i=i):
+                v = r[i]
+                return (v is None, v)
+            out.sort(key=key, reverse=ob.desc)
+        return out
 
     def _check_join_builds(self, node, read_ts: Timestamp) -> None:
         """The device hash join gathers ONE build row per probe key
@@ -1847,12 +1960,19 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     return out
 
 
-def _rewrite_table_names(sel: ast.Select, mapping: dict) -> ast.Select:
-    """Deep-copy a Select with CTE names replaced by their materialized
-    temp-table names — in FROM/JOIN refs and inside expression
-    subqueries (which execute while the temps are still live)."""
+def _rewrite_table_names(sel, mapping: dict):
+    """Deep-copy a Select/SetOp with CTE names replaced by their
+    materialized temp-table names — in FROM/JOIN refs and inside
+    expression subqueries (which execute while the temps are live)."""
     import copy
     if not mapping:
+        return sel
+    if isinstance(sel, ast.SetOp):
+        sel = copy.copy(sel)
+        shadowed = {name for name, _, _ in sel.ctes}
+        inner = {k: v for k, v in mapping.items() if k not in shadowed}
+        sel.left = _rewrite_table_names(sel.left, inner)
+        sel.right = _rewrite_table_names(sel.right, inner)
         return sel
     sel = copy.deepcopy(sel)
 
@@ -1886,7 +2006,11 @@ def _rewrite_table_names(sel: ast.Select, mapping: dict) -> ast.Select:
             fix_expr(c)
             fix_expr(v)
 
-    def fix_select(s: ast.Select):
+    def fix_select(s):
+        if isinstance(s, ast.SetOp):
+            fix_select(s.left)
+            fix_select(s.right)
+            return
         # a CTE of the same name in an inner scope shadows the outer
         shadowed = {name for name, _, _ in s.ctes}
         inner = {k: v for k, v in mapping.items() if k not in shadowed}

@@ -184,6 +184,20 @@ class Select(Statement):
 
 
 @dataclass
+class SetOp(Statement):
+    """UNION / INTERSECT / EXCEPT [ALL]; ORDER BY/LIMIT hoisted from
+    the last branch apply to the combined result (pg grammar)."""
+    op: str  # union | intersect | except
+    all: bool
+    left: Statement  # Select or SetOp
+    right: Statement
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: list[tuple] = field(default_factory=list)  # WITH over a set op
+
+
+@dataclass
 class ColumnDef:
     name: str
     type: SQLType

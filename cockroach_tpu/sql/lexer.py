@@ -33,7 +33,7 @@ KEYWORDS = {
     "analyze", "if", "coalesce", "nulls", "first", "last", "default",
     "cluster", "setting", "extract", "substring", "backup", "restore",
     "to", "with", "over", "partition", "recursive", "rows", "range",
-    "groups", "alter", "add", "column", "for",
+    "groups", "alter", "add", "column", "for", "intersect", "except",
 }
 
 MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]

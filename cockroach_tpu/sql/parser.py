@@ -99,7 +99,7 @@ class Parser:
     def parse_statement(self) -> ast.Statement:
         t = self.peek()
         if t.is_kw("select"):
-            return self.parse_select()
+            return self.parse_select_stmt()
         if t.is_kw("with"):
             return self.parse_with()
         if t.is_kw("create"):
@@ -191,6 +191,29 @@ class Parser:
             raise ParseError(f"trailing tokens at {self.peek()}")
 
     # -- SELECT ------------------------------------------------------------
+    def parse_select_stmt(self) -> ast.Statement:
+        """A select possibly chained with UNION/INTERSECT/EXCEPT
+        (left-associative); ORDER BY/LIMIT parsed into the last branch
+        hoist to the set op, matching pg's grammar."""
+        node: ast.Statement = self.parse_select()
+        while self.peek().is_kw("union", "intersect", "except"):
+            op = self.next().text
+            all_ = self.accept_kw("all")
+            if self.accept_kw("distinct"):
+                all_ = False
+            right = self.parse_select()
+            node = ast.SetOp(op, all_, node, right)
+        if isinstance(node, ast.SetOp):
+            last = node.right
+            if isinstance(last, ast.Select) and (
+                    last.order_by or last.limit is not None
+                    or last.offset is not None):
+                node.order_by = last.order_by
+                node.limit, node.offset = last.limit, last.offset
+                last.order_by = []
+                last.limit = last.offset = None
+        return node
+
     def parse_with(self) -> ast.Select:
         """WITH name [(cols)] AS (select) [, ...] SELECT ... — the CTEs
         attach to the main Select (non-recursive; RECURSIVE rejected)."""
@@ -209,12 +232,12 @@ class Parser:
             self.expect_kw("as")
             self.expect_op("(")
             sub = self.parse_with() if self.peek().is_kw("with") \
-                else self.parse_select()
+                else self.parse_select_stmt()
             self.expect_op(")")
             ctes.append((name, cols, sub))
             if not self.accept_op(","):
                 break
-        sel = self.parse_select()
+        sel = self.parse_select_stmt()
         sel.ctes = ctes + sel.ctes
         return sel
 
@@ -282,7 +305,7 @@ class Parser:
             # derived table: FROM (SELECT ...) [AS] alias
             self.next()
             sub = self.parse_with() if self.peek().is_kw("with") \
-                else self.parse_select()
+                else self.parse_select_stmt()
             self.expect_op(")")
             self.accept_kw("as")
             alias = self.expect_ident()
@@ -313,8 +336,13 @@ class Parser:
             self.next()
             self.expect_kw("join")
             return "cross"
-        if t.is_kw("right") or t.is_kw("full"):
-            raise ParseError(f"{t.text.upper()} JOIN not supported yet")
+        if t.is_kw("right"):
+            self.next()
+            self.accept_kw("outer")
+            self.expect_kw("join")
+            return "right"
+        if t.is_kw("full"):
+            raise ParseError("FULL JOIN not supported yet")
         if t.kind == Tok.OP and t.text == ",":
             nxt = self.peek(1)
             # comma-join only when followed by a table name (not a
@@ -385,7 +413,7 @@ class Parser:
             self.expect_op("(")
             if self.peek().is_kw("select", "with"):
                 sub = self.parse_with() if self.peek().is_kw("with") \
-                    else self.parse_select()
+                    else self.parse_select_stmt()
                 self.expect_op(")")
                 return ast.InSubquery(left, sub, negated=negated)
             items = [self.parse_expr()]
@@ -436,7 +464,7 @@ class Parser:
         if t.kind == Tok.OP and t.text == "(":
             if self.peek().is_kw("select", "with"):
                 sub = self.parse_with() if self.peek().is_kw("with") \
-                    else self.parse_select()
+                    else self.parse_select_stmt()
                 self.expect_op(")")
                 return ast.Subquery(sub)
             e = self.parse_expr()
@@ -445,7 +473,7 @@ class Parser:
         if t.is_kw("exists"):
             self.expect_op("(")
             sub = self.parse_with() if self.peek().is_kw("with") \
-                else self.parse_select()
+                else self.parse_select_stmt()
             self.expect_op(")")
             return ast.Exists(sub)
         if t.is_kw("case"):
@@ -696,7 +724,8 @@ class Parser:
                 columns.append(self.expect_ident())
             self.expect_op(")")
         if self.peek().is_kw("select"):
-            return ast.Insert(table, columns, select=self.parse_select())
+            return ast.Insert(table, columns,
+                              select=self.parse_select_stmt())
         self.expect_kw("values")
         rows: list[list[ast.Expr]] = []
         while True:

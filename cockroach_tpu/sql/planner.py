@@ -119,6 +119,18 @@ class Planner:
     def plan_select(self, sel: ast.Select) -> tuple[plan.PlanNode, plan.OutputMeta]:
         if sel.table is None:
             raise PlanError("SELECT without FROM not supported")
+        if any(j.join_type == "right" for j in sel.joins):
+            # a RIGHT JOIN b == b LEFT JOIN a: rewrite when it is the
+            # sole join (the general interior-right case needs full
+            # join reassociation — memo/xform territory)
+            if len(sel.joins) != 1:
+                raise PlanError(
+                    "RIGHT JOIN supported only as the sole join")
+            import copy
+            sel = copy.copy(sel)
+            j = sel.joins[0]
+            sel.table, sel.joins = j.table, [
+                ast.JoinClause(sel.table, "left", j.on)]
 
         # ---- scopes & scans -------------------------------------------------
         scope = Scope()

@@ -1,0 +1,112 @@
+"""UNION / INTERSECT / EXCEPT [ALL] + RIGHT JOIN.
+
+Reference: sql/union.go (setOpNode), logictest union/except files;
+RIGHT JOIN rewrites to the mirrored LEFT JOIN."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE a (x INT, s STRING)")
+    e.execute("CREATE TABLE b (x INT, s STRING)")
+    e.execute("INSERT INTO a VALUES (1,'p'),(2,'q'),(2,'q'),(3,'r')")
+    e.execute("INSERT INTO b VALUES (2,'q'),(3,'r'),(4,'s')")
+    return e
+
+
+def rows(eng, sql):
+    return eng.execute(sql).rows
+
+
+class TestSetOps:
+    def test_union_dedups(self, eng):
+        assert rows(eng, "SELECT x FROM a UNION SELECT x FROM b "
+                         "ORDER BY x") == [(1,), (2,), (3,), (4,)]
+
+    def test_union_all_keeps_duplicates(self, eng):
+        assert rows(eng, "SELECT x FROM a UNION ALL SELECT x FROM b "
+                         "ORDER BY x") == \
+            [(1,), (2,), (2,), (2,), (3,), (3,), (4,)]
+
+    def test_intersect(self, eng):
+        assert rows(eng, "SELECT x FROM a INTERSECT SELECT x FROM b "
+                         "ORDER BY x") == [(2,), (3,)]
+
+    def test_except_and_except_all(self, eng):
+        assert rows(eng, "SELECT x FROM a EXCEPT SELECT x FROM b") \
+            == [(1,)]
+        # multiset: a has two 2s, b consumes one
+        assert rows(eng, "SELECT x FROM a EXCEPT ALL SELECT x FROM b "
+                         "ORDER BY x") == [(1,), (2,)]
+
+    def test_chained_with_order_limit(self, eng):
+        assert rows(eng, "SELECT x FROM a UNION SELECT x FROM b "
+                         "UNION SELECT 99 AS x FROM b "
+                         "ORDER BY x DESC LIMIT 3") == \
+            [(99,), (4,), (3,)]
+
+    def test_string_columns(self, eng):
+        assert rows(eng, "SELECT s FROM a UNION SELECT s FROM b "
+                         "ORDER BY s") == [("p",), ("q",), ("r",), ("s",)]
+
+    def test_arity_mismatch_rejected(self, eng):
+        with pytest.raises(EngineError, match="same number"):
+            rows(eng, "SELECT x, s FROM a UNION SELECT x FROM b")
+
+    def test_type_mismatch_rejected(self, eng):
+        with pytest.raises(EngineError, match="types do not match"):
+            rows(eng, "SELECT x FROM a UNION SELECT s FROM b")
+
+    def test_with_over_union(self, eng):
+        assert rows(eng, "WITH c AS (SELECT x FROM a WHERE x > 1) "
+                         "SELECT x FROM c UNION SELECT x FROM b "
+                         "ORDER BY x") == [(2,), (3,), (4,)]
+
+    def test_union_in_subquery(self, eng):
+        got = rows(eng, "SELECT x FROM a WHERE x IN "
+                        "(SELECT x FROM b UNION SELECT 1 AS y FROM b) "
+                        "ORDER BY x")
+        assert got == [(1,), (2,), (2,), (3,)]
+
+    def test_union_as_derived_table(self, eng):
+        assert rows(eng, "SELECT count(*) FROM "
+                         "(SELECT x FROM a UNION SELECT x FROM b) u") \
+            == [(4,)]
+
+    def test_insert_from_union(self, eng):
+        e = Engine()
+        e.execute("CREATE TABLE src1 (x INT)")
+        e.execute("CREATE TABLE src2 (x INT)")
+        e.execute("CREATE TABLE dst (x INT)")
+        e.execute("INSERT INTO src1 VALUES (1),(2)")
+        e.execute("INSERT INTO src2 VALUES (2),(3)")
+        e.execute("INSERT INTO dst SELECT x FROM src1 UNION "
+                  "SELECT x FROM src2")
+        assert e.execute("SELECT x FROM dst ORDER BY x").rows == \
+            [(1,), (2,), (3,)]
+
+
+class TestRightJoin:
+    def test_rewritten_to_left(self):
+        e = Engine()
+        e.execute("CREATE TABLE dim (k INT PRIMARY KEY, label STRING)")
+        e.execute("INSERT INTO dim VALUES (1,'one'),(2,'two')")
+        e.execute("CREATE TABLE fact (k INT, v INT)")
+        e.execute("INSERT INTO fact VALUES (1,10),(3,30)")
+        got = e.execute(
+            "SELECT f.k, f.v, d.label FROM dim d "
+            "RIGHT JOIN fact f ON d.k = f.k ORDER BY f.k").rows
+        assert got == [(1, 10, "one"), (3, 30, None)]
+
+    def test_interior_right_join_rejected(self):
+        e = Engine()
+        for t in ("t1", "t2", "t3"):
+            e.execute(f"CREATE TABLE {t} (k INT PRIMARY KEY)")
+            e.execute(f"INSERT INTO {t} VALUES (1)")
+        with pytest.raises(Exception, match="RIGHT JOIN"):
+            e.execute("SELECT t1.k FROM t1 JOIN t2 ON t1.k = t2.k "
+                      "RIGHT JOIN t3 ON t2.k = t3.k")
