@@ -1347,7 +1347,23 @@ class Engine:
                                 lambda: BackupResumer(self))
             self._jobs.register(RESTORE_JOB,
                                 lambda: RestoreResumer(self))
+            from ..jobs.ttl import TTL_JOB, TTLResumer
+            self._jobs.register(TTL_JOB, lambda: TTLResumer(self))
         return self._jobs
+
+    def run_ttl(self, table: str, ttl_col: str,
+                ttl_seconds: int) -> int:
+        """One row-TTL pass over `table` (pkg/ttl analogue): deletes
+        rows whose ttl_col is older than ttl_seconds; returns the job
+        id. Scheduling the pass is the caller's loop."""
+        from ..jobs.ttl import TTL_JOB
+        jid = self.jobs.create(TTL_JOB, {
+            "table": table, "ttl_col": ttl_col,
+            "ttl_seconds": ttl_seconds})
+        rec = self.jobs.run_job(jid)
+        if rec.status != "succeeded":
+            raise EngineError(f"TTL job failed: {rec.error}")
+        return jid
 
     def create_changefeed(self, table: str, sink: str,
                           cursor: int = 0,
