@@ -122,7 +122,8 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
             lb = leftf(rc)
             rb = rightf(rc)
             return hash_join(lb, rb, jn.left_keys, jn.right_keys,
-                             jn.payload, jn.join_type)
+                             jn.payload, jn.join_type,
+                             expand=jn.expand)
         return run_join
     if isinstance(node, P.Aggregate):
         return _compile_aggregate(node, params)

@@ -919,17 +919,24 @@ class Engine:
             if sname is None:
                 return  # cannot map back to storage; accept
             stored.append(sname)
-        if not self.store.keys_unique_for_read(b.table, tuple(stored),
-                                               read_ts.to_int()):
-            # NB: checked at TABLE granularity — a build whose pushed
-            # filter would make the keys unique (latest-version-style
-            # predicates) is conservatively rejected too; filtered
-            # uniqueness needs host predicate evaluation (future work)
+        if self.store.keys_unique_for_read(b.table, tuple(stored),
+                                           read_ts.to_int()):
+            join.expand = 1
+            return
+        # duplicate-keyed build: measure the max multiplicity among
+        # visible rows and bake it in as the STATIC expansion factor
+        # (ops/join.py expansion path). NB: measured at TABLE
+        # granularity — a pushed build filter can only reduce the true
+        # multiplicity, so K is a safe upper bound.
+        k = self.store.key_max_multiplicity(b.table, tuple(stored),
+                                            read_ts.to_int())
+        if k > self.MAX_JOIN_EXPANSION:
             raise EngineError(
-                f"hash join build side {b.table!r} has duplicate join "
-                f"keys {stored}; make the uniquely-keyed table the "
-                "build side (duplicate-key build emission is not "
-                "supported yet)")
+                f"hash join build side {b.table!r} has up to {k} "
+                f"duplicate rows per key {stored} (limit "
+                f"{self.MAX_JOIN_EXPANSION}); make the lower-"
+                "multiplicity table the build side")
+        join.expand = max(k, 1)
 
     def _dist_decision(self, node, session: Session):
         """Choose distributed (SPMD over the mesh) vs single-device —
@@ -988,6 +995,8 @@ class Engine:
 
     # -- hash-partitioned spill ---------------------------------------------
     MAX_SPILL_PARTITIONS = 256
+    # duplicate-key join expansion cap: output rows = probe.n * K
+    MAX_JOIN_EXPANSION = 32
 
     def _run_partitioned(self, prep: "Prepared",
                          read_ts: Optional[Timestamp]) -> Result:

@@ -38,9 +38,18 @@ def _next_pow2(x: int) -> int:
 def hash_join(probe: ColumnBatch, build: ColumnBatch,
               probe_keys: list[str], build_keys: list[str],
               build_payload: list[str], join_type: str = "inner",
-              suffix: str = "") -> ColumnBatch:
-    """Join `probe` against `build` (unique-keyed) and return the probe
-    batch extended with `build_payload` columns gathered from matches."""
+              suffix: str = "", expand: int = 1) -> ColumnBatch:
+    """Join `probe` against `build` and return the probe batch extended
+    with `build_payload` columns gathered from matches.
+
+    expand=1: unique build keys, one gather per payload column.
+    expand=K>1: duplicate-keyed build sides — the engine measured the
+    max key multiplicity host-side at prepare time (a STATIC bound, so
+    XLA keeps static shapes), the output has probe.n * K rows, and
+    copy j of probe row p follows the build side's per-key duplicate
+    chain j hops (the two-pass count+materialize of the reference's
+    hashjoiner.go:870, reshaped for the compiler: chains come from one
+    lexsort, emission is K strided gathers)."""
     cap = _next_pow2(max(2 * build.n, 16))
     bkeys = tuple(build.col(k) for k in build_keys)
     pkeys = tuple(probe.col(k) for k in probe_keys)
@@ -58,19 +67,79 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
     # A probe row can land on a build row that was masked out (dead build
     # rows never insert, so claim only holds live rows — no extra check).
 
-    out = probe
     if join_type == "semi":
-        return out.and_sel(matched)
+        return probe.and_sel(matched)
     if join_type == "anti":
-        return out.and_sel(jnp.logical_not(matched))
+        return probe.and_sel(jnp.logical_not(matched))
+    if join_type not in ("inner", "left"):
+        raise ValueError(f"unsupported join type {join_type!r}")
 
+    if expand <= 1:
+        out = probe
+        for name in build_payload:
+            data = build.col(name)[build_row]
+            valid = jnp.logical_and(build.col_valid(name)[build_row],
+                                    matched)
+            out = out.with_column(name + suffix, data, valid)
+        return out.and_sel(matched) if join_type == "inner" else out
+
+    return _expand_join(probe, build, bkeys, bmask, matched, build_row,
+                        build_payload, join_type, suffix, expand)
+
+
+def _dup_chain(bkeys: tuple, bmask, n: int):
+    """next_dup[i] = the next live build row with row i's key (or n).
+    One stable lexsort: equal live keys become adjacent runs in
+    ascending row order, so chaining is a shifted compare. The chain
+    start (min rowid per key) is exactly the row hashtable.build's
+    claim resolves to."""
+    dead = jnp.logical_not(bmask).astype(jnp.int32)
+    order = jnp.lexsort(tuple(reversed(bkeys)) + (dead,))
+    same = jnp.ones((n - 1,), dtype=jnp.bool_) if n > 1 else \
+        jnp.zeros((0,), dtype=jnp.bool_)
+    for k in bkeys:
+        s = k[order]
+        same = jnp.logical_and(same, s[1:] == s[:-1])
+    m_s = bmask[order]
+    same = jnp.logical_and(same,
+                           jnp.logical_and(m_s[1:], m_s[:-1]))
+    nxt = jnp.where(same, order[1:], n)
+    return jnp.full((n,), n, dtype=order.dtype).at[order[:-1]].set(nxt)
+
+
+def _expand_join(probe, build, bkeys, bmask, matched, build_row,
+                 build_payload, join_type, suffix, K: int):
+    n_b = build.n
+    next_dup = _dup_chain(bkeys, bmask, n_b)
+    # walk the chain K-1 hops: rows_j / has_j per output copy
+    rows = [build_row]
+    has = [matched]
+    for _ in range(K - 1):
+        nxt = next_dup[jnp.clip(rows[-1], 0, n_b - 1)]
+        has.append(jnp.logical_and(has[-1], nxt < n_b))
+        rows.append(jnp.minimum(nxt, n_b - 1))
+
+    def interleave(cols):  # K arrays of [n] -> [n*K], copy-minor
+        return jnp.stack(cols, axis=1).reshape(-1)
+
+    has_i = interleave(has)
+    cols, valid, names = {}, {}, []
+    for i, name in enumerate(probe.names):
+        d, v = probe.data[i], probe.valid[i]
+        cols[name] = jnp.repeat(d, K)
+        valid[name] = jnp.repeat(v, K)
     for name in build_payload:
-        data = build.col(name)[build_row]
-        valid = jnp.logical_and(build.col_valid(name)[build_row], matched)
-        out = out.with_column(name + suffix, data, valid)
-
+        src, srcv = build.col(name), build.col_valid(name)
+        cols[name + suffix] = interleave([src[r] for r in rows])
+        valid[name + suffix] = jnp.logical_and(
+            interleave([srcv[r] for r in rows]), has_i)
+    sel = jnp.repeat(probe.sel, K)
     if join_type == "inner":
-        return out.and_sel(matched)
-    if join_type == "left":
-        return out
-    raise ValueError(f"unsupported join type {join_type!r}")
+        sel = jnp.logical_and(sel, has_i)
+    else:  # left: unmatched probe rows keep exactly copy 0
+        copy0 = jnp.tile(
+            jnp.arange(K) == 0, probe.n)
+        keep = jnp.where(interleave([matched] * K),
+                         has_i, copy0)
+        sel = jnp.logical_and(sel, keep)
+    return ColumnBatch.from_dict(cols, valid, sel=sel)

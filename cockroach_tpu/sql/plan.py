@@ -42,11 +42,15 @@ class Filter(PlanNode):
 @dataclass
 class HashJoin(PlanNode):
     left: PlanNode           # probe side
-    right: PlanNode          # build side (unique keys)
+    right: PlanNode          # build side
     left_keys: list[str] = field(default_factory=list)
     right_keys: list[str] = field(default_factory=list)
     payload: list[str] = field(default_factory=list)  # build cols to carry
     join_type: str = "inner"
+    # output copies per probe row: 1 for unique build keys; the
+    # engine's host-side max-multiplicity probe sets K>1 for
+    # duplicate-keyed builds (static expansion bound)
+    expand: int = 1
 
 
 @dataclass

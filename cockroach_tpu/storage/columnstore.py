@@ -695,6 +695,37 @@ class ColumnStore:
                 td, cols, lambda c: c.live_mask(read_ts_int))
             return d == n
 
+    def key_max_multiplicity(self, name: str, cols: tuple,
+                             read_ts_int: int) -> int:
+        """Max duplicate count of (cols) among rows visible at read_ts
+        (NULL-keyed rows excluded — they never join). Sizes the hash
+        join's expansion factor for duplicate-keyed build sides."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            parts: list[list[np.ndarray]] = [[] for _ in cols]
+            for chunk in td.chunks:
+                m = chunk.live_mask(read_ts_int)
+                for c in cols:
+                    m = m & chunk.valid[c]
+                for i, c in enumerate(cols):
+                    parts[i].append(chunk.data[c][m])
+            if not parts or not parts[0]:
+                return 0
+            cat = [np.concatenate(p) for p in parts]
+            n = len(cat[0])
+            if n == 0:
+                return 0
+            order = np.lexsort(tuple(reversed(cat)))
+            change = np.zeros(n, dtype=bool)
+            change[0] = True
+            for c in cat:
+                s = c[order]
+                change[1:] |= s[1:] != s[:-1]
+            starts = np.flatnonzero(change)
+            runs = np.diff(np.append(starts, n))
+            return int(runs.max())
+
     # -- GC ------------------------------------------------------------------
     def gc(self, name: str, threshold: Timestamp) -> int:
         """Drop row versions deleted before `threshold` (the analogue of

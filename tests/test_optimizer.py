@@ -58,17 +58,18 @@ class TestJoinOrdering:
 
 
 class TestBuildUniqueness:
-    def test_duplicate_build_keys_is_clean_error(self):
-        # duplicates on BOTH sides: a many-to-many join that no side
-        # swap can fix — must be a clean error, never silently-dropped
-        # matches (was: each probe row matched only the first build row)
+    def test_many_to_many_join_expands(self):
+        # duplicates on BOTH sides: no side swap can fix it; the
+        # measured-K expansion path (ops/join.py) answers exactly
+        # (was a clean error before expansion landed)
         e = Engine()
         e.execute("CREATE TABLE f (k INT8 NOT NULL)")
         e.execute("CREATE TABLE d (k INT8 NOT NULL)")
         e.execute("INSERT INTO f VALUES (1), (2), (2)")
         e.execute("INSERT INTO d VALUES (1), (1), (2)")
-        with pytest.raises(EngineError, match="duplicate join keys"):
-            e.execute("SELECT count(*) AS c FROM f JOIN d ON f.k = d.k")
+        # 1: 1x2 pairs; 2: 2x1 pairs -> 4 total
+        assert e.execute("SELECT count(*) AS c FROM f "
+                         "JOIN d ON f.k = d.k").rows == [(4,)]
 
     def test_one_sided_duplicates_fixed_by_swap(self):
         # duplicates only on the syntactic build side: the optimizer
@@ -169,12 +170,13 @@ class TestSnapshotAwareGuard:
         e.execute("SELECT count(*) AS c FROM fx", s)  # pin activity
         # concurrent session dedups dx
         e.execute("DELETE FROM dx WHERE ver = 2")
-        # now-live rows are unique, but s's snapshot is not:
-        from cockroach_tpu.exec.engine import EngineError
-        with pytest.raises(EngineError, match="duplicate join keys"):
-            e.execute("SELECT count(*) AS c FROM fx "
+        # now-live rows are unique, but s's snapshot is not: the
+        # expansion factor must be measured AT THE SNAPSHOT (K=2), so
+        # the stale txn still sees both versions — 2 probe x 2 build
+        r = e.execute("SELECT count(*) AS c FROM fx "
                       "JOIN dx ON fx.k = dx.k", s)
+        assert r.rows == [(4,)]
         e.execute("ROLLBACK", s)
-        # a FRESH read (post-delete snapshot) is unique and works
+        # a FRESH read (post-delete snapshot) is unique: 2 matches
         r = e.execute("SELECT count(*) AS c FROM fx JOIN dx ON fx.k = dx.k")
         assert r.rows == [(2,)]
