@@ -67,9 +67,27 @@ def masked_max(data, mask):
 # ---------------------------------------------------------------------------
 # grouped aggregates over dense group ids
 # ---------------------------------------------------------------------------
+#
+# Two formulations, chosen by group count:
+# - small G (dense strategy, e.g. TPC-H Q1's 12 code slots): G unrolled
+#   masked REDUCTIONS — linear VPU passes XLA fuses aggressively.
+#   segment_* lowers to scatter, and scatter is catastrophically slow
+#   on TPU (measured: Q1 at 8M rows was ~1000x slower via scatter-add
+#   than via unrolled reductions on a v5e).
+# - large G (hash strategy, capacity 2^17): scatter is the only
+#   shape-sane option; those group ids are hash slots.
+
+UNROLL_GROUPS = 32
+
 
 def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None):
     d = data.astype(acc_dtype) if acc_dtype is not None else data
+    if num_groups <= UNROLL_GROUPS:
+        z = jnp.zeros_like(d)
+        return jnp.stack([
+            jnp.sum(jnp.where(jnp.logical_and(mask, group_ids == g),
+                              d, z))
+            for g in range(num_groups)])
     d = jnp.where(mask, d, jnp.zeros_like(d))
     # Dead rows scatter to group 0 with value 0 — harmless.
     gid = jnp.where(mask, group_ids, 0)
@@ -77,19 +95,36 @@ def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None):
 
 
 def group_count(group_ids, mask, num_groups: int):
+    if num_groups <= UNROLL_GROUPS:
+        return jnp.stack([
+            jnp.sum(jnp.logical_and(mask, group_ids == g)
+                    .astype(jnp.int64))
+            for g in range(num_groups)])
     return jax.ops.segment_sum(mask.astype(jnp.int64),
                                jnp.where(mask, group_ids, 0),
                                num_segments=num_groups)
 
 
 def group_min(data, group_ids, mask, num_groups: int):
-    d = jnp.where(mask, data, _minident(data.dtype))
+    ident = _minident(data.dtype)
+    if num_groups <= UNROLL_GROUPS:
+        return jnp.stack([
+            jnp.min(jnp.where(jnp.logical_and(mask, group_ids == g),
+                              data, ident))
+            for g in range(num_groups)])
+    d = jnp.where(mask, data, ident)
     gid = jnp.where(mask, group_ids, 0)
     return jax.ops.segment_min(d, gid, num_segments=num_groups)
 
 
 def group_max(data, group_ids, mask, num_groups: int):
-    d = jnp.where(mask, data, _maxident(data.dtype))
+    ident = _maxident(data.dtype)
+    if num_groups <= UNROLL_GROUPS:
+        return jnp.stack([
+            jnp.max(jnp.where(jnp.logical_and(mask, group_ids == g),
+                              data, ident))
+            for g in range(num_groups)])
+    d = jnp.where(mask, data, ident)
     gid = jnp.where(mask, group_ids, 0)
     return jax.ops.segment_max(d, gid, num_segments=num_groups)
 
