@@ -125,11 +125,13 @@ class Replica:
         server-side CheckRequest validation in the reference: a scan
         must not silently return a partial answer after a split."""
         read_ts = _dec_ts(op["ts"])
+        txn = TxnMeta.from_json(op["txn"].encode()) \
+            if op.get("txn") else None
         if op["op"] == "get":
             key = op["key"].encode("latin1")
             if not self.desc.contains(key):
                 raise RangeBoundsError(self.desc, key)
-            mv = self.mvcc.get(key, read_ts)
+            mv = self.mvcc.get(key, read_ts, txn=txn)
             return None if mv is None else mv.value
         if op["op"] == "scan":
             start = op["start"].encode("latin1")
@@ -137,7 +139,8 @@ class Replica:
             if not self.desc.contains(start) or end > self.desc.end_key:
                 raise RangeBoundsError(self.desc, start)
             return [(mv.key, mv.value) for mv in self.mvcc.scan(
-                start, end, read_ts, max_keys=op.get("limit", 0))]
+                start, end, read_ts, txn=txn,
+                max_keys=op.get("limit", 0))]
         raise ValueError(f"unknown read op {op['op']}")
 
     # -- closed timestamps / follower reads -----------------------------

