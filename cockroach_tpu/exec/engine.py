@@ -207,6 +207,11 @@ class Engine:
         from ..utils.tracing import Tracer
         self.tracer = Tracer()
         self.sqlstats = StatsRegistry()
+        # admission control in front of execution (pkg/util/admission):
+        # bounded priority queue so overload rejects cleanly instead of
+        # stacking unbounded latency behind the statement lock
+        from ..utils.admission import AdmissionController
+        self.admission = AdmissionController(slots=4, max_queue=64)
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
@@ -255,6 +260,8 @@ class Engine:
                 "until end of transaction block")
         import time as _time
         t0 = _time.monotonic()
+        prio = session.vars.get("admission_priority", "normal")
+        self.admission.acquire(priority=prio)
         try:
             with self.tracer.span(
                     f"stmt:{type(stmt).__name__.lower()}"):
@@ -285,6 +292,8 @@ class Engine:
                     stmt, ast.BeginTxn):
                 session.txn_aborted = True
             raise
+        finally:
+            self.admission.release()
 
     def _dispatch_stmt(self, stmt: ast.Statement, session: Session,
                        sql_text: str = "") -> Result:

@@ -1,0 +1,80 @@
+"""Admission control: bounded, priority-ordered statement admission.
+
+The analogue of pkg/util/admission (work queues in front of each
+resource). Here the guarded resource is engine execution slots: each
+statement acquires a slot before running; when slots are exhausted,
+waiters queue ordered by (priority, arrival) and a bounded queue
+rejects overload with a clean error instead of letting latency grow
+unboundedly (the reference's admission.WorkQueue ordering + the
+sql.conn.max_open semantics folded together)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+PRIORITIES = {"high": 0, "normal": 1, "low": 2}
+
+
+class AdmissionRejected(Exception):
+    pass
+
+
+@dataclass(order=True)
+class _Waiter:
+    rank: tuple
+    event: threading.Event = field(compare=False)
+    granted: bool = field(default=False, compare=False)
+
+
+class AdmissionController:
+    def __init__(self, slots: int = 4, max_queue: int = 64):
+        self.slots = slots
+        self.max_queue = max_queue
+        self._mu = threading.Lock()
+        self._in_use = 0
+        self._queue: list[_Waiter] = []
+        self._seq = itertools.count()
+        self.admitted = 0
+        self.rejected = 0
+        self.queued = 0
+
+    def acquire(self, priority: str = "normal",
+                timeout: float = 30.0) -> None:
+        p = PRIORITIES.get(priority, 1)
+        with self._mu:
+            if self._in_use < self.slots and not self._queue:
+                self._in_use += 1
+                self.admitted += 1
+                return
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                raise AdmissionRejected(
+                    f"admission queue full ({self.max_queue} waiters)")
+            w = _Waiter((p, next(self._seq)), threading.Event())
+            import bisect
+            bisect.insort(self._queue, w)
+            self.queued += 1
+        if not w.event.wait(timeout):
+            with self._mu:
+                if w in self._queue:
+                    self._queue.remove(w)
+                    self.rejected += 1
+                    raise AdmissionRejected(
+                        f"admission wait exceeded {timeout}s")
+            # granted between timeout and lock: fall through
+        self.admitted += 1
+
+    def release(self) -> None:
+        with self._mu:
+            if self._queue:
+                w = self._queue.pop(0)  # best (priority, arrival)
+                w.granted = True
+                w.event.set()
+                return  # slot hands off directly
+            self._in_use = max(0, self._in_use - 1)
+
+    def depth(self) -> int:
+        with self._mu:
+            return len(self._queue)
