@@ -156,11 +156,13 @@ def main():
     pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
 
-    # q1/q14's 8-aggregate working set (~12GB of XLA temps at 2^27)
-    # runs at a resident-friendly row count; q6 takes the full size
-    cap_multi = 1 << 25 if mode.startswith("tpu") else rows
-    rows_by_query = {q: (rows if q == "q6" else min(rows, cap_multi))
-                     for q in queries}
+    # q1/q14 run at resident-friendly row counts; q6 takes the full
+    # size. q14's gather-bound join (~17M rows/s on a tunnel-attached
+    # v5e) gets a smaller cap so its child can never eat the round's
+    # bench budget.
+    caps = ({"q1": 1 << 25, "q14": 1 << 23}
+            if mode.startswith("tpu") else {})
+    rows_by_query = {q: min(rows, caps.get(q, rows)) for q in queries}
 
     if mode in ("cpu", "tpu_child"):
         # leaf mode: measure in-process and emit one JSON line
@@ -188,7 +190,7 @@ def main():
     # healthy children finish well inside this; a wedged compile eats
     # one timeout then retries in a fresh process
     child_timeout = int(os.environ.get(
-        "BENCH_CHILD_TIMEOUT", max(600, rows >> 17)))
+        "BENCH_CHILD_TIMEOUT", max(900, rows >> 17)))
     results = {}
     rows_used = {}
     for q in queries:  # q6 first: the primary metric lands early

@@ -123,7 +123,7 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
             rb = rightf(rc)
             return hash_join(lb, rb, jn.left_keys, jn.right_keys,
                              jn.payload, jn.join_type,
-                             expand=jn.expand)
+                             expand=jn.expand, direct=jn.direct)
         return run_join
     if isinstance(node, P.Aggregate):
         return _compile_aggregate(node, params)

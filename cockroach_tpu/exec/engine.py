@@ -666,8 +666,13 @@ class Engine:
                                              session))
         read_ts = self._read_ts(session)
         # the join-build uniqueness guard is snapshot-aware: it must
-        # judge the rows visible at THIS query's read timestamp
-        self._check_join_builds(node, read_ts)
+        # judge the rows visible at THIS query's read timestamp — and
+        # know about txn-buffered build rows the store can't see
+        overlay_puts = {
+            t: sum(1 for tb, op in session.effects
+                   if tb == t and op[0] == "put")
+            for t in overlay}
+        self._check_join_builds(node, read_ts, overlay_puts)
 
         scans = {}
         gens = []
@@ -887,7 +892,8 @@ class Engine:
             out.sort(key=key, reverse=ob.desc)
         return out
 
-    def _check_join_builds(self, node, read_ts: Timestamp) -> None:
+    def _check_join_builds(self, node, read_ts: Timestamp,
+                           overlay: set = frozenset()) -> None:
         """The device hash join gathers ONE build row per probe key
         (ops/join.py: exact for unique build keys). Verify build-side
         key uniqueness on the host over the rows VISIBLE at the query's
@@ -899,7 +905,7 @@ class Engine:
         def walk(n):
             if isinstance(n, P.HashJoin):
                 if n.join_type in ("inner", "left"):
-                    self._check_one_build(n, read_ts)
+                    self._check_one_build(n, read_ts, overlay)
                 walk(n.left)
                 walk(n.right)
                 return
@@ -910,16 +916,19 @@ class Engine:
 
         walk(node)
 
-    def _check_one_build(self, join, read_ts: Timestamp) -> None:
+    def _check_one_build(self, join, read_ts: Timestamp,
+                         overlay: set) -> None:
         from ..sql.stats import _underlying_col
         b = join.right
         if not isinstance(b, P.Scan):
             return
         stored = []
+        all_plain = True  # every key is a stored column, not computed
         computed = dict(b.computed)
         for rk in join.right_keys:
             sname = b.columns.get(rk)
             if sname is None:
+                all_plain = False
                 # computed key: a dictionary-code remap of a column is
                 # injective, so check the underlying column instead
                 inner = _underlying_col(computed.get(rk))
@@ -928,8 +937,18 @@ class Engine:
             if sname is None:
                 return  # cannot map back to storage; accept
             stored.append(sname)
-        if self.store.keys_unique_for_read(b.table, tuple(stored),
-                                           read_ts.to_int()):
+        # direct addressing needs the RUNTIME key values' range, so
+        # only plain stored keys qualify (a remapped key's codes live
+        # in the other dictionary's space)
+        if all_plain:
+            self._maybe_direct_join(join, b, stored, read_ts, overlay)
+        # txn-buffered writes to the build table are invisible to the
+        # store's committed-rows measurements: each buffered put can
+        # add one more row per key, so it widens the bound — and
+        # forfeits the uniqueness fast path
+        buffered_puts = self._overlay_put_count(b.table, overlay)
+        if buffered_puts == 0 and self.store.keys_unique_for_read(
+                b.table, tuple(stored), read_ts.to_int()):
             join.expand = 1
             return
         # duplicate-keyed build: measure the max multiplicity among
@@ -938,7 +957,8 @@ class Engine:
         # granularity — a pushed build filter can only reduce the true
         # multiplicity, so K is a safe upper bound.
         k = self.store.key_max_multiplicity(b.table, tuple(stored),
-                                            read_ts.to_int())
+                                            read_ts.to_int()) \
+            + buffered_puts
         if k > self.MAX_JOIN_EXPANSION:
             raise EngineError(
                 f"hash join build side {b.table!r} has up to {k} "
@@ -946,6 +966,39 @@ class Engine:
                 f"{self.MAX_JOIN_EXPANSION}); make the lower-"
                 "multiplicity table the build side")
         join.expand = max(k, 1)
+
+    @staticmethod
+    def _overlay_put_count(table: str, overlay) -> int:
+        """Buffered put-ops on `table` in the current txn (0 when the
+        caller passed a plain membership set)."""
+        if isinstance(overlay, dict):
+            return overlay.get(table, 0)
+        return 0
+
+    MAX_DIRECT_JOIN_SLOTS = 1 << 22
+
+    def _maybe_direct_join(self, join, b, stored, read_ts,
+                           overlay: set) -> None:
+        """Direct-address the join when the single build key is
+        int-family with a dense live-value range (dimension pks, dict
+        codes): one scatter + one gather instead of hash-table
+        while_loops, which TPUs execute ~100x slower. Skipped for
+        txn-overlay builds — uncommitted rows could fall outside the
+        measured range and steal slots from committed matches."""
+        join.direct = None
+        if len(stored) != 1 or b.table in overlay:
+            return
+        col = self.store.table(b.table).schema.column(stored[0])
+        if col.type.family == Family.FLOAT:
+            return
+        r = self.store.key_int_range(b.table, stored[0])
+        if r is None:
+            return
+        lo, hi, n_all = r
+        span = hi - lo + 1
+        if span <= max(4 * n_all, 1024) \
+                and span + 1 <= self.MAX_DIRECT_JOIN_SLOTS:
+            join.direct = (lo, span + 1)
 
     def _dist_decision(self, node, session: Session):
         """Choose distributed (SPMD over the mesh) vs single-device —

@@ -38,7 +38,8 @@ def _next_pow2(x: int) -> int:
 def hash_join(probe: ColumnBatch, build: ColumnBatch,
               probe_keys: list[str], build_keys: list[str],
               build_payload: list[str], join_type: str = "inner",
-              suffix: str = "", expand: int = 1) -> ColumnBatch:
+              suffix: str = "", expand: int = 1,
+              direct=None) -> ColumnBatch:
     """Join `probe` against `build` and return the probe batch extended
     with `build_payload` columns gathered from matches.
 
@@ -50,7 +51,6 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
     chain j hops (the two-pass count+materialize of the reference's
     hashjoiner.go:870, reshaped for the compiler: chains come from one
     lexsort, emission is K strided gathers)."""
-    cap = _next_pow2(max(2 * build.n, 16))
     bkeys = tuple(build.col(k) for k in build_keys)
     pkeys = tuple(probe.col(k) for k in probe_keys)
     bmask = build.sel
@@ -61,9 +61,34 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
     for k in probe_keys:
         pmask = jnp.logical_and(pmask, probe.col_valid(k))
 
-    claim, _, _ = hashtable.build(bkeys, bmask, cap)  # cap>=2N: converges
-    matched, build_row = hashtable.probe(claim, bkeys, pkeys, pmask, cap,
-                                         build.n)
+    if direct is not None and len(bkeys) == 1:
+        # Direct addressing: TPU scatters/gathers inside the hash
+        # table's while_loops are ~100x slower than straight-line ops,
+        # and dimension join keys are almost always dense ints (pks,
+        # dict codes). One scatter builds key->row; one gather probes.
+        base, size = direct
+        bidx = jnp.clip(bkeys[0] - base, 0, size - 1).astype(jnp.int32)
+        bslot = jnp.where(bmask, bidx, size - 1)
+        # .min keeps the FIRST (lowest-rowid) duplicate — the same
+        # chain head _dup_chain produces
+        table = jnp.full((size,), build.n, dtype=jnp.int32) \
+            .at[bslot].min(jnp.arange(build.n, dtype=jnp.int32))
+        pk0 = pkeys[0]
+        in_range = jnp.logical_and(pk0 >= base, pk0 - base < size - 1)
+        pidx = jnp.clip(pk0 - base, 0, size - 1).astype(jnp.int32)
+        build_row = jnp.minimum(table[pidx], build.n - 1)
+        hit = table[pidx] < build.n
+        # the sentinel slot (size-1) may hold a real masked-out row's
+        # id only if a live key mapped there — excluded by in_range
+        matched = jnp.logical_and(jnp.logical_and(pmask, in_range), hit)
+        # guard exactness: the slot's owner must actually carry the key
+        matched = jnp.logical_and(matched,
+                                  bkeys[0][build_row] == pk0)
+    else:
+        cap = _next_pow2(max(2 * build.n, 16))
+        claim, _, _ = hashtable.build(bkeys, bmask, cap)  # cap>=2N
+        matched, build_row = hashtable.probe(claim, bkeys, pkeys, pmask,
+                                             cap, build.n)
     # A probe row can land on a build row that was masked out (dead build
     # rows never insert, so claim only holds live rows — no extra check).
 

@@ -51,6 +51,12 @@ class HashJoin(PlanNode):
     # engine's host-side max-multiplicity probe sets K>1 for
     # duplicate-keyed builds (static expansion bound)
     expand: int = 1
+    # direct-address join (the TPU fast path): when the single build
+    # key is int-family with a dense value range (dimension pks, dict
+    # codes), the engine sets (base, size) and the join becomes one
+    # scatter to build + one gather to probe — no hash table, no
+    # while_loop. None = open-addressing hash table.
+    direct: Optional[tuple] = None  # (base, table_size)
 
 
 @dataclass

@@ -726,6 +726,35 @@ class ColumnStore:
             runs = np.diff(np.append(starts, n))
             return int(runs.max())
 
+    def key_int_range(self, name: str, col: str):
+        """(min, max, count) of an int-family key column over ALL
+        versions (NULLs excluded), or None when empty. Sizes the
+        direct-address join table (ops/join.py): the all-versions
+        range is a superset of every snapshot's, so a table sized by
+        it is correct at any read ts — and the result caches per
+        generation (like key_distinct_cache)."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            ck = ("__int_range__", col)
+            hit = td.key_distinct_cache.get(ck)
+            if hit is not None and hit[0] == td.generation:
+                return hit[1]
+            lo = hi = None
+            n = 0
+            for chunk in td.chunks:
+                m = chunk.valid[col]
+                if not m.any():
+                    continue
+                vals = chunk.data[col][m]
+                cmin, cmax = int(vals.min()), int(vals.max())
+                lo = cmin if lo is None else min(lo, cmin)
+                hi = cmax if hi is None else max(hi, cmax)
+                n += int(m.sum())
+            out = None if lo is None else (lo, hi, n)
+            td.key_distinct_cache[ck] = (td.generation, out)
+            return out
+
     # -- GC ------------------------------------------------------------------
     def gc(self, name: str, threshold: Timestamp) -> int:
         """Drop row versions deleted before `threshold` (the analogue of
