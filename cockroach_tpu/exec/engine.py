@@ -95,6 +95,9 @@ class Session:
     # "current transaction is aborted" until ROLLBACK) — this keeps
     # statements atomic without kv-level savepoints
     txn_aborted: bool = False
+    # SET tracing = on: span recordings per statement, rendered by
+    # SHOW TRACE FOR SESSION (the reference's session tracing)
+    trace: list = field(default_factory=list)
 
     @property
     def in_txn(self) -> bool:
@@ -262,11 +265,22 @@ class Engine:
         t0 = _time.monotonic()
         prio = session.vars.get("admission_priority", "normal")
         self.admission.acquire(priority=prio)
+        tracing = session.vars.get("tracing", "off") == "on" \
+            and not isinstance(stmt, ast.ShowTrace)
         try:
-            with self.tracer.span(
-                    f"stmt:{type(stmt).__name__.lower()}"):
-                with self._stmt_lock:
-                    res = self._dispatch_stmt(stmt, session, sql_text)
+            if tracing:
+                with self.tracer.capture(sql_text or
+                                         type(stmt).__name__) as rec:
+                    with self._stmt_lock:
+                        res = self._dispatch_stmt(stmt, session,
+                                                  sql_text)
+                session.trace.append(rec)
+            else:
+                with self.tracer.span(
+                        f"stmt:{type(stmt).__name__.lower()}"):
+                    with self._stmt_lock:
+                        res = self._dispatch_stmt(stmt, session,
+                                                  sql_text)
             self.metrics.counter(
                 f"sql.{type(stmt).__name__.lower()}.count",
                 "statements executed, by type").inc()
@@ -382,6 +396,19 @@ class Engine:
                           rows=[(line,) for line in
                                 tree.rstrip().split("\n")],
                           tag="EXPLAIN")
+        if isinstance(stmt, ast.ShowAll):
+            return Result(
+                names=["variable", "value"],
+                rows=sorted((k, str(v))
+                            for k, v in session.vars.values.items()),
+                tag="SHOW ALL")
+        if isinstance(stmt, ast.ShowTrace):
+            rows = []
+            for rec in session.trace:
+                for line in rec.tree_lines():
+                    rows.append((line,))
+            return Result(names=["span"], rows=rows,
+                          tag="SHOW TRACE")
         if isinstance(stmt, ast.ShowStatements):
             return Result(
                 names=["fingerprint", "count", "mean_latency_ms",

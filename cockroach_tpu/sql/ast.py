@@ -286,6 +286,18 @@ class ShowStatements(Statement):
 
 
 @dataclass
+class ShowTrace(Statement):
+    """SHOW TRACE FOR SESSION: spans recorded since SET tracing=on."""
+    pass
+
+
+@dataclass
+class ShowAll(Statement):
+    """SHOW ALL: every session variable and its current value."""
+    pass
+
+
+@dataclass
 class CancelJob(Statement):
     job_id: int
 

@@ -130,6 +130,17 @@ class Parser:
                     and self.peek().text == "statements":
                 self.next()
                 return ast.ShowStatements()
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "trace":
+                self.next()
+                self.expect_kw("for")
+                if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                        and self.peek().text == "session"):
+                    raise ParseError("expected SESSION after TRACE FOR")
+                self.next()
+                return ast.ShowTrace()
+            if self.accept_kw("all"):
+                return ast.ShowAll()
             self.accept_kw("cluster")
             self.accept_kw("setting")
             return ast.ShowVar(self.dotted_name())

@@ -111,3 +111,23 @@ class TestEngineIntegration:
         with eng.tracer.capture("c") as rec:
             eng.execute("SELECT a FROM t WHERE a = 1")
         assert rec.find("plan") is not None
+
+    def test_session_tracing_and_show_trace(self, eng):
+        s = eng.session()
+        eng.execute("SET tracing = on", session=s)
+        eng.execute("SELECT count(*) FROM t", session=s)
+        eng.execute("SET tracing = off", session=s)
+        rows = eng.execute("SHOW TRACE FOR SESSION", session=s).rows
+        text = "\n".join(r[0] for r in rows)
+        assert "SELECT count(*) FROM t" in text
+        assert "dispatch:" in text
+        # tracing=off stops recording
+        n = len(rows)
+        eng.execute("SELECT count(*) FROM t", session=s)
+        assert len(eng.execute("SHOW TRACE FOR SESSION",
+                               session=s).rows) == n
+
+    def test_show_all(self, eng):
+        rows = dict(eng.execute("SHOW ALL").rows)
+        assert rows["distsql"] == "auto"
+        assert "hash_group_capacity" in rows
