@@ -1449,6 +1449,29 @@ class Engine:
             self._jobs.register(TTL_JOB, lambda: TTLResumer(self))
         return self._jobs
 
+    @property
+    def protectedts(self):
+        if getattr(self, "_pts", None) is None:
+            from ..kv.protectedts import ProtectedTimestamps
+            self._pts = ProtectedTimestamps(self.kv)
+        return self._pts
+
+    def run_gc(self, table: str) -> int:
+        """One MVCC GC pass (mvcc_gc_queue analogue): drop versions
+        deleted more than kv.gc.ttl_seconds ago, clamped below the
+        oldest protected timestamp covering the table."""
+        ttl_ns = int(self.settings.get("kv.gc.ttl_seconds")) * 10 ** 9
+        threshold = self.clock.now().wall - ttl_ns
+        prot = self.protectedts.min_protected(table)
+        if prot is not None:
+            threshold = min(threshold, prot - 1)
+        if threshold <= 0:
+            return 0
+        n = self.store.gc(table, Timestamp(threshold, 0))
+        if n:
+            self._evict(table)
+        return n
+
     def run_ttl(self, table: str, ttl_col: str,
                 ttl_seconds: int) -> int:
         """One row-TTL pass over `table` (pkg/ttl analogue): deletes

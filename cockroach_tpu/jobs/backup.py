@@ -96,6 +96,14 @@ class BackupResumer:
             }
         manifest["layers"].append(layer)
         _save_manifest(dest, manifest)
+        # the incremental chain needs every version since end_ts to
+        # survive GC until the NEXT layer runs: move the chain's
+        # protection record forward (pkg/kv/kvserver/protectedts)
+        pts = self.engine.protectedts
+        for rec_id, _ts, _tables, meta in pts.records():
+            if meta == dest:
+                pts.release(rec_id)
+        pts.protect(end_ts, p["tables"], meta=dest)
 
     def _export_table(self, table: str, lo: int, hi: int,
                       path: str) -> None:

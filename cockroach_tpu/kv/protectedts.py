@@ -1,0 +1,65 @@
+"""Protected timestamps: records that hold back MVCC garbage
+collection (the analogue of pkg/kv/kvserver/protectedts).
+
+A protection record pins history at-and-after its timestamp for a set
+of tables; GC computes its threshold as min(now - ttl, oldest
+protection - 1). Backups are the canonical user: an incremental chain
+needs every version since the previous layer's end_ts to still exist,
+so each completed backup leaves a record at its end_ts (replacing the
+chain's previous one) and the next layer's window algebra stays sound
+no matter how aggressive the GC TTL is.
+
+Records are transactional KV rows (/pts/<id>), so they replicate and
+survive like everything else.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Optional
+
+PTS_PREFIX = b"/pts/"
+
+
+def _key(rec_id: str) -> bytes:
+    return PTS_PREFIX + rec_id.encode()
+
+
+class ProtectedTimestamps:
+    def __init__(self, kv):
+        self.kv = kv
+
+    def protect(self, ts_int: int, tables: list[str],
+                meta: str = "") -> str:
+        """New protection record; returns its id."""
+        rec_id = uuid.uuid4().hex[:12]
+        payload = json.dumps({"ts": int(ts_int),
+                              "tables": sorted(tables),
+                              "meta": meta}).encode()
+        self.kv.txn(lambda t: t.put(_key(rec_id), payload))
+        return rec_id
+
+    def release(self, rec_id: str) -> None:
+        self.kv.txn(lambda t: t.delete(_key(rec_id)))
+
+    def records(self) -> list[tuple[str, int, list[str], str]]:
+        def fn(t):
+            out = []
+            for k, v in t.scan(PTS_PREFIX, PTS_PREFIX + b"\xff"):
+                o = json.loads(v.decode())
+                out.append((k[len(PTS_PREFIX):].decode(), o["ts"],
+                            o["tables"], o.get("meta", "")))
+            return out
+        return self.kv.txn(fn)
+
+    def min_protected(self, table: str) -> Optional[int]:
+        """Oldest protection covering `table` (empty tables list =
+        cluster-wide), or None."""
+        lo = None
+        for _id, ts, tables, _m in self.records():
+            if tables and table not in tables:
+                continue
+            if lo is None or ts < lo:
+                lo = ts
+        return lo
