@@ -319,6 +319,34 @@ class Engine:
             return self._exec_drop(stmt)
         if isinstance(stmt, ast.AlterTable):
             return self._exec_alter(stmt, session)
+        if isinstance(stmt, ast.ConfigureZone):
+            import json as _json
+            if stmt.table not in self.store.tables:
+                raise EngineError(
+                    f"table {stmt.table!r} does not exist")
+            allowed = {"gc.ttl_seconds", "range_max_bytes"}
+            bad = set(stmt.options) - allowed
+            if bad:
+                raise EngineError(
+                    f"unknown zone option(s) {sorted(bad)}; "
+                    f"supported: {sorted(allowed)}")
+            cur = self.zone_config(stmt.table)
+            cur.update(stmt.options)
+            self.kv.txn(lambda t: t.put(
+                b"/zone/" + stmt.table.encode(),
+                _json.dumps(cur, sort_keys=True).encode()))
+            return Result(tag="CONFIGURE ZONE")
+        if isinstance(stmt, ast.ShowZone):
+            z = self.zone_config(stmt.table)
+            if not z:
+                z = {"gc.ttl_seconds":
+                     self.settings.get("kv.gc.ttl_seconds"),
+                     "range_max_bytes":
+                     self.settings.get("kv.range.max_bytes")}
+            return Result(names=["option", "value"],
+                          rows=sorted((k, str(v))
+                                      for k, v in z.items()),
+                          tag="SHOW ZONE CONFIGURATION")
         if isinstance(stmt, ast.Insert):
             return self._exec_insert(stmt, session)
         if isinstance(stmt, ast.Update):
@@ -1456,11 +1484,23 @@ class Engine:
             self._pts = ProtectedTimestamps(self.kv)
         return self._pts
 
+    def zone_config(self, table: str) -> dict:
+        """Per-table config overrides (the spanconfig analogue),
+        stored at /zone/<table>; empty = cluster defaults apply."""
+        import json as _json
+        raw = self.kv.txn(
+            lambda t: t.get(b"/zone/" + table.encode()))
+        return _json.loads(raw.decode()) if raw else {}
+
     def run_gc(self, table: str) -> int:
         """One MVCC GC pass (mvcc_gc_queue analogue): drop versions
-        deleted more than kv.gc.ttl_seconds ago, clamped below the
-        oldest protected timestamp covering the table."""
-        ttl_ns = int(self.settings.get("kv.gc.ttl_seconds")) * 10 ** 9
+        deleted more than the gc ttl ago (zone override, else the
+        cluster setting), clamped below the oldest protected timestamp
+        covering the table."""
+        zone = self.zone_config(table)
+        ttl_s = zone.get("gc.ttl_seconds",
+                         self.settings.get("kv.gc.ttl_seconds"))
+        ttl_ns = int(ttl_s) * 10 ** 9
         threshold = self.clock.now().wall - ttl_ns
         prot = self.protectedts.min_protected(table)
         if prot is not None:

@@ -230,6 +230,21 @@ class AlterTable(Statement):
 
 
 @dataclass
+class ConfigureZone(Statement):
+    """ALTER TABLE <t> CONFIGURE ZONE USING k = v, ... — per-table
+    config overrides (gc.ttl_seconds, range_max_bytes), the spanconfig
+    analogue."""
+    table: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class ShowZone(Statement):
+    """SHOW ZONE CONFIGURATION FOR TABLE <t>."""
+    table: str
+
+
+@dataclass
 class Insert(Statement):
     table: str
     columns: list[str]  # empty = all

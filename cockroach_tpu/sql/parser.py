@@ -131,6 +131,17 @@ class Parser:
                 self.next()
                 return ast.ShowStatements()
             if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "zone":
+                self.next()
+                for word in ("configuration", "for"):
+                    if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                            and self.peek().text == word):
+                        raise ParseError(
+                            "expected ZONE CONFIGURATION FOR TABLE")
+                    self.next()
+                self.expect_kw("table")
+                return ast.ShowZone(self.expect_ident())
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
                     and self.peek().text == "trace":
                 self.next()
                 self.expect_kw("for")
@@ -691,6 +702,30 @@ class Parser:
         self.expect_kw("alter")
         self.expect_kw("table")
         table = self.expect_ident()
+        if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == "configure":
+            self.next()
+            if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                    and self.peek().text == "zone"):
+                raise ParseError("expected ZONE after CONFIGURE")
+            self.next()
+            if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
+                    and self.peek().text == "using"):
+                raise ParseError("expected USING")
+            self.next()
+            opts = {}
+            while True:
+                name = self.dotted_name()
+                self.expect_op("=")
+                t = self.next()
+                if t.kind == Tok.NUMBER:
+                    opts[name] = (float(t.text) if "." in t.text
+                                  else int(t.text))
+                else:
+                    opts[name] = t.text
+                if not self.accept_op(","):
+                    break
+            return ast.ConfigureZone(table, opts)
         if self.accept_kw("add"):
             self.accept_kw("column")
             cname = self.expect_ident()

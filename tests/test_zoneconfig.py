@@ -1,0 +1,47 @@
+"""Per-table zone configs (spanconfig analogue)."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE t (a INT PRIMARY KEY)")
+    e.execute("INSERT INTO t VALUES (1),(2)")
+    e.execute("DELETE FROM t WHERE a = 2")
+    e.store.seal("t")
+    return e
+
+
+class TestZoneConfig:
+    def test_defaults_shown(self, eng):
+        rows = dict(eng.execute(
+            "SHOW ZONE CONFIGURATION FOR TABLE t").rows)
+        assert rows["gc.ttl_seconds"] == "14400"
+
+    def test_override_drives_gc(self, eng):
+        assert eng.run_gc("t") == 0  # 4h default ttl: nothing old
+        eng.execute("ALTER TABLE t CONFIGURE ZONE USING "
+                    "gc.ttl_seconds = 0")
+        assert eng.run_gc("t") == 1
+
+    def test_options_merge(self, eng):
+        eng.execute("ALTER TABLE t CONFIGURE ZONE USING "
+                    "gc.ttl_seconds = 60")
+        eng.execute("ALTER TABLE t CONFIGURE ZONE USING "
+                    "range_max_bytes = 1024")
+        rows = dict(eng.execute(
+            "SHOW ZONE CONFIGURATION FOR TABLE t").rows)
+        assert rows == {"gc.ttl_seconds": "60",
+                        "range_max_bytes": "1024"}
+
+    def test_unknown_option_rejected(self, eng):
+        with pytest.raises(EngineError, match="unknown zone option"):
+            eng.execute("ALTER TABLE t CONFIGURE ZONE USING nope = 1")
+
+    def test_missing_table_rejected(self, eng):
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("ALTER TABLE ghost CONFIGURE ZONE USING "
+                        "gc.ttl_seconds = 1")
