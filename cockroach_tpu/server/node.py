@@ -34,6 +34,10 @@ class NodeConfig:
     rpc_port: int | None = None
     join: dict | None = None
     gossip_interval: float = 0.2
+    # background maintenance loop: orphaned-job adoption + MVCC GC
+    # passes (the store queues / job registry adoption loops of the
+    # reference); None disables
+    maintenance_interval: float | None = None
 
 
 class Node:
@@ -45,9 +49,12 @@ class Node:
         self.engine = Engine(store=self.store, clock=self.clock,
                              settings=self.settings,
                              mesh=self.config.mesh)
-        from ..jobs import IMPORT_JOB, ImportResumer, Registry
-        self.jobs = Registry(self.engine.kv,
-                             session_id=f"node-{self.config.node_id}")
+        from ..jobs import IMPORT_JOB, ImportResumer
+        # share the engine's registry (schema-change/changefeed/backup/
+        # restore/ttl resumers pre-registered) so the maintenance loop
+        # can adopt ANY orphaned job type
+        self.jobs = self.engine.jobs
+        self.jobs.session_id = f"node-{self.config.node_id}"
         self.jobs.register(IMPORT_JOB, lambda: ImportResumer(self.engine))
         self.pg: PgServer | None = None
         self._http = None
@@ -177,10 +184,41 @@ class Node:
             self._start_status_server()
         if self.config.rpc_port is not None:
             self._start_fabric()
+        if self.config.maintenance_interval is not None:
+            self._start_maintenance()
         self._started = True
         return self
 
+    def _start_maintenance(self):
+        """Adopt orphaned jobs (registry.go:1508 adoption loop) and run
+        MVCC GC passes (mvcc_gc_queue) on a background cadence."""
+        import threading
+
+        self._maint_stop = threading.Event()
+
+        def loop():
+            while not self._maint_stop.wait(
+                    self.config.maintenance_interval):
+                try:
+                    self.jobs.adopt_and_run_all()
+                except Exception:
+                    pass  # job failures land in their records
+                for name in list(self.engine.store.tables):
+                    if name.startswith("__"):
+                        continue
+                    try:
+                        self.engine.run_gc(name)
+                    except Exception:
+                        pass
+
+        self._maint_thread = threading.Thread(target=loop, daemon=True)
+        self._maint_thread.start()
+
     def stop(self):
+        if getattr(self, "_maint_stop", None) is not None:
+            self._maint_stop.set()
+            self._maint_thread.join(timeout=5)
+            self._maint_stop = None
         if self._gossip_stop is not None:
             self._gossip_stop.set()
             self._gossip_thread.join(timeout=5)

@@ -1,0 +1,58 @@
+"""Node background maintenance: job adoption + GC passes (the server
+analogue of the store queues and the jobs adoption loop)."""
+
+import time
+
+import pytest
+
+from cockroach_tpu.jobs import SCHEMA_CHANGE_JOB, Registry, SchemaChangeResumer
+from cockroach_tpu.server import Node, NodeConfig
+
+
+def wait(cond, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestNodeMaintenance:
+    def test_adopts_orphaned_job_and_runs_gc(self):
+        with Node(NodeConfig(maintenance_interval=0.05)) as n:
+            e = n.engine
+            e.execute("CREATE TABLE t (a INT PRIMARY KEY)")
+            e.execute("INSERT INTO t VALUES (1),(2)")
+            e.execute("DELETE FROM t WHERE a = 2")
+            e.store.seal("t")
+            e.execute("ALTER TABLE t CONFIGURE ZONE USING "
+                      "gc.ttl_seconds = 0")
+
+            # orphan a schema-change job (dead coordinator with an
+            # instantly-lapsing lease)
+            from cockroach_tpu.catalog.descriptor import (WRITE_ONLY,
+                                                          ColumnDescriptor)
+            from cockroach_tpu.sql.types import INT8, ColumnSchema
+            desc = e.catalog.get_by_name("t")
+            desc.columns.append(
+                ColumnDescriptor("bf", INT8, True, WRITE_ONLY, 7))
+            e.leases.publish(desc)
+            e.store.add_column("t", ColumnSchema("bf", INT8),
+                               default=7, hidden=True)
+            dead = Registry(e.kv, session_id="dead",
+                            lease_seconds=0.01)
+            dead.register(SCHEMA_CHANGE_JOB,
+                          lambda: SchemaChangeResumer(e))
+            jid = dead.create(SCHEMA_CHANGE_JOB,
+                              {"table": "t", "column": "bf"})
+
+            assert wait(lambda: n.jobs.job(jid).status == "succeeded")
+            assert e.execute("SELECT a, bf FROM t").rows == [(1, 7)]
+            # the GC pass collected the tombstoned version
+            assert wait(lambda: sum(
+                c.n for c in e.store.table("t").chunks) == 1)
+
+    def test_maintenance_off_by_default(self):
+        with Node(NodeConfig()) as n:
+            assert getattr(n, "_maint_stop", None) is None
