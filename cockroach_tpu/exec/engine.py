@@ -424,6 +424,14 @@ class Engine:
                           rows=[(line,) for line in
                                 tree.rstrip().split("\n")],
                           tag="EXPLAIN")
+        if isinstance(stmt, ast.ShowCreateTable):
+            d = self.catalog.get_by_name(stmt.table)
+            if d is None:
+                raise EngineError(
+                    f"table {stmt.table!r} does not exist")
+            return Result(names=["table_name", "create_statement"],
+                          rows=[(d.name, _render_create(d))],
+                          tag="SHOW CREATE TABLE")
         if isinstance(stmt, ast.ShowAll):
             return Result(
                 names=["variable", "value"],
@@ -2135,6 +2143,31 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     out = np.full(n, fill, dtype=a.dtype)
     out[: a.shape[0]] = a
     return out
+
+
+def _render_create(desc) -> str:
+    """Reconstruct CREATE TABLE DDL from a descriptor (SHOW CREATE)."""
+    def ty(t):
+        f = t.family.value
+        names = {"int": "INT8", "float": "FLOAT8", "bool": "BOOL",
+                 "string": "STRING", "date": "DATE",
+                 "timestamp": "TIMESTAMP", "interval": "INTERVAL"}
+        if f == "decimal":
+            return f"DECIMAL({t.precision},{t.scale})"
+        return names.get(f, f.upper())
+
+    parts = []
+    for c in desc.columns:
+        if c.state != "public":
+            continue
+        s = f"{c.name} {ty(c.type)}"
+        if not c.nullable:
+            s += " NOT NULL"
+        parts.append(s)
+    if desc.primary_key:
+        parts.append(f"PRIMARY KEY ({', '.join(desc.primary_key)})")
+    cols = ",\n  ".join(parts)
+    return f"CREATE TABLE {desc.name} (\n  {cols}\n)"
 
 
 def _rewrite_table_names(sel, mapping: dict):

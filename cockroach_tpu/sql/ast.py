@@ -313,6 +313,12 @@ class ShowAll(Statement):
 
 
 @dataclass
+class ShowCreateTable(Statement):
+    """SHOW CREATE TABLE <t>: reconstructed DDL from the descriptor."""
+    table: str
+
+
+@dataclass
 class CancelJob(Statement):
     job_id: int
 

@@ -130,6 +130,10 @@ class Parser:
                     and self.peek().text == "statements":
                 self.next()
                 return ast.ShowStatements()
+            if self.peek().is_kw("create"):
+                self.next()
+                self.expect_kw("table")
+                return ast.ShowCreateTable(self.expect_ident())
             if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
                     and self.peek().text == "zone":
                 self.next()

@@ -168,3 +168,36 @@ class TestEngineCatalogIntegration:
         with pytest.raises(Exception, match="already exists"):
             e.execute("CREATE TABLE dup (a INT)")
         e.execute("CREATE TABLE IF NOT EXISTS dup (a INT)")  # no error
+
+
+class TestShowCreateTable:
+    def test_roundtrip(self):
+        e = Engine()
+        e.execute("CREATE TABLE rt (a INT PRIMARY KEY, "
+                  "s STRING NOT NULL, m DECIMAL(10,2), d DATE)")
+        ddl = e.execute("SHOW CREATE TABLE rt").rows[0][1]
+        e2 = Engine()
+        e2.execute(ddl)  # rendered DDL reparses
+        d1 = e.catalog.get_by_name("rt")
+        d2 = e2.catalog.get_by_name("rt")
+        assert [(c.name, c.type, c.nullable) for c in d1.columns] == \
+            [(c.name, c.type, c.nullable) for c in d2.columns]
+        assert d1.primary_key == d2.primary_key
+
+    def test_hides_nonpublic_columns(self):
+        e = Engine()
+        e.execute("CREATE TABLE rt (a INT)")
+        from cockroach_tpu.catalog.descriptor import (WRITE_ONLY,
+                                                      ColumnDescriptor)
+        from cockroach_tpu.sql.types import INT8
+        d = e.catalog.get_by_name("rt")
+        d.columns.append(ColumnDescriptor("mid_add", INT8, True,
+                                          WRITE_ONLY))
+        e.catalog.write_new_version(d)
+        assert "mid_add" not in e.execute(
+            "SHOW CREATE TABLE rt").rows[0][1]
+
+    def test_missing_table(self):
+        e = Engine()
+        with pytest.raises(Exception, match="does not exist"):
+            e.execute("SHOW CREATE TABLE ghost")
