@@ -1848,7 +1848,7 @@ class Engine:
                 if codec.synthetic_pk:
                     r[ROWID] = self.store.alloc_rowids(ins.table, 1)[0]
                 key = codec.key(r)
-                if not codec.synthetic_pk:
+                if not codec.synthetic_pk and not ins.upsert:
                     # duplicate-key check = CPut semantics: a KV read
                     # (sees concurrent intents, registers the span)
                     # plus the scan-plane live index (covers
@@ -1866,7 +1866,8 @@ class Engine:
                 new_rows.append((key, r))
             for key, r in new_rows:
                 effects.append((ins.table, ("put", key, r)))
-            return Result(row_count=len(rows), tag="INSERT")
+            return Result(row_count=len(rows),
+                          tag="UPSERT" if ins.upsert else "INSERT")
 
         return self._dml(session, fn)
 

@@ -250,6 +250,9 @@ class Insert(Statement):
     columns: list[str]  # empty = all
     rows: list[list[Expr]] = field(default_factory=list)
     select: Optional[Select] = None
+    # UPSERT: a duplicate primary key replaces the row instead of
+    # erroring (CRDB's UPSERT whole-row semantics)
+    upsert: bool = False
 
 
 @dataclass

@@ -34,6 +34,7 @@ KEYWORDS = {
     "cluster", "setting", "extract", "substring", "backup", "restore",
     "to", "with", "over", "partition", "recursive", "rows", "range",
     "groups", "alter", "add", "column", "for", "intersect", "except",
+    "upsert",
 }
 
 MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]

@@ -110,6 +110,8 @@ class Parser:
             return self.parse_alter()
         if t.is_kw("insert"):
             return self.parse_insert()
+        if t.is_kw("upsert"):
+            return self.parse_insert(upsert=True)
         if t.is_kw("update"):
             return self.parse_update()
         if t.is_kw("delete"):
@@ -763,8 +765,11 @@ class Parser:
             if_exists = True
         return ast.DropTable(self.expect_ident(), if_exists)
 
-    def parse_insert(self) -> ast.Statement:
-        self.expect_kw("insert")
+    def parse_insert(self, upsert: bool = False) -> ast.Statement:
+        if upsert:
+            self.expect_kw("upsert")
+        else:
+            self.expect_kw("insert")
         self.expect_kw("into")
         table = self.expect_ident()
         columns: list[str] = []
@@ -775,7 +780,8 @@ class Parser:
             self.expect_op(")")
         if self.peek().is_kw("select"):
             return ast.Insert(table, columns,
-                              select=self.parse_select_stmt())
+                              select=self.parse_select_stmt(),
+                              upsert=upsert)
         self.expect_kw("values")
         rows: list[list[ast.Expr]] = []
         while True:
@@ -787,7 +793,8 @@ class Parser:
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return ast.Insert(table, columns, rows=rows)
+        return ast.Insert(table, columns, rows=rows,
+                          upsert=upsert)
 
     def parse_update(self) -> ast.Statement:
         self.expect_kw("update")

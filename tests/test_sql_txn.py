@@ -319,3 +319,41 @@ class TestOverlaySnapshotCorrectness:
             eng.execute("INSERT INTO se (k) VALUES (1)", s)
         eng.execute("ROLLBACK", s)
         assert eng.execute("SELECT count(*) AS c FROM se").rows[0][0] == 0
+
+
+class TestUpsert:
+    def test_upsert_insert_or_replace(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (k INT PRIMARY KEY, v STRING)")
+        e.execute("INSERT INTO t VALUES (1,'a'),(2,'b')")
+        r = e.execute("UPSERT INTO t VALUES (2,'B'),(3,'c')")
+        assert r.tag == "UPSERT" and r.row_count == 2
+        assert e.execute("SELECT k, v FROM t ORDER BY k").rows == \
+            [(1, "a"), (2, "B"), (3, "c")]
+
+    def test_upsert_transactional(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (k INT PRIMARY KEY, v STRING)")
+        e.execute("INSERT INTO t VALUES (1,'a')")
+        s = e.session()
+        e.execute("BEGIN", session=s)
+        e.execute("UPSERT INTO t VALUES (1,'X')", session=s)
+        assert e.execute("SELECT v FROM t WHERE k = 1",
+                         session=s).rows == [("X",)]
+        e.execute("ROLLBACK", session=s)
+        assert e.execute("SELECT v FROM t WHERE k = 1").rows == \
+            [("a",)]
+
+    def test_plain_insert_still_rejects_duplicates(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (k INT PRIMARY KEY)")
+        e.execute("INSERT INTO t VALUES (1)")
+        with pytest.raises(EngineError, match="duplicate key"):
+            e.execute("INSERT INTO t VALUES (1)")
+
+    def test_upsert_twice_one_live_row(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (k INT PRIMARY KEY, v INT)")
+        for i in range(3):
+            e.execute(f"UPSERT INTO t VALUES (7, {i})")
+        assert e.execute("SELECT k, v FROM t").rows == [(7, 2)]
