@@ -830,6 +830,14 @@ class Engine:
         host<->device round trip per query."""
         session = session or self.session()
         stmt = parser.parse(sql)
+        if isinstance(stmt, ast.SetOp) or (
+                isinstance(stmt, ast.Select)
+                and (stmt.ctes or self._has_derived(stmt))):
+            # CTE/set-op/derived statements materialize temps per
+            # execution: prepare degrades to a re-execute handle (the
+            # reference's portals likewise re-plan non-cacheable
+            # statements)
+            return _RerunPrepared(self, session, stmt, sql)
         if not isinstance(stmt, ast.Select) or stmt.table is None:
             raise EngineError("can only prepare table-reading SELECTs")
         return self._prepare_select(stmt, session, sql_text=sql)
@@ -2144,6 +2152,25 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     out = np.full(n, fill, dtype=a.dtype)
     out[: a.shape[0]] = a
     return out
+
+
+@dataclass
+class _RerunPrepared:
+    """Prepared handle for statements that cannot pin one compiled
+    program (CTEs materialize fresh temps per run; set ops merge on
+    the host): each run() re-executes through the engine."""
+    engine: "Engine"
+    session: "Session"
+    stmt: object
+    sql_text: str
+
+    def run(self, read_ts=None) -> "Result":
+        return self.engine._exec_select(self.stmt, self.session,
+                                        self.sql_text)
+
+    def dispatch(self, *a, **kw):
+        raise EngineError(
+            "this statement shape cannot dispatch asynchronously")
 
 
 def _render_create(desc) -> str:

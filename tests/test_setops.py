@@ -110,3 +110,18 @@ class TestRightJoin:
         with pytest.raises(Exception, match="RIGHT JOIN"):
             e.execute("SELECT t1.k FROM t1 JOIN t2 ON t1.k = t2.k "
                       "RIGHT JOIN t3 ON t2.k = t3.k")
+
+
+class TestPreparedFallback:
+    def test_prepare_cte_and_setop_rerun(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT)")
+        e.execute("INSERT INTO t VALUES (1),(2)")
+        p = e.prepare("WITH c AS (SELECT a FROM t) "
+                      "SELECT count(*) FROM c")
+        assert p.run().rows == [(2,)]
+        e.execute("INSERT INTO t VALUES (3)")
+        assert p.run().rows == [(3,)]  # re-executes, sees fresh data
+        p2 = e.prepare("SELECT a FROM t UNION SELECT a FROM t "
+                       "ORDER BY a")
+        assert p2.run().rows == [(1,), (2,), (3,)]
