@@ -10,9 +10,10 @@ for use until every lease on version-2 is released or expired.
 """
 
 from .catalog import Catalog, CatalogError, DESC_PREFIX, NSP_PREFIX
-from .descriptor import TableDescriptor, ColumnDescriptor
+from .descriptor import (TableDescriptor, ColumnDescriptor,
+                         IndexDescriptor)
 from .lease import LeaseManager, LeasedDescriptor
 
 __all__ = ["Catalog", "CatalogError", "TableDescriptor",
-           "ColumnDescriptor", "LeaseManager", "LeasedDescriptor",
-           "DESC_PREFIX", "NSP_PREFIX"]
+           "ColumnDescriptor", "IndexDescriptor", "LeaseManager",
+           "LeasedDescriptor", "DESC_PREFIX", "NSP_PREFIX"]

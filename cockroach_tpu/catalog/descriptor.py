@@ -33,6 +33,23 @@ class ColumnDescriptor:
 
 
 @dataclass
+class IndexDescriptor:
+    """A secondary index (the analogue of descpb.IndexDescriptor).
+
+    ``index_id`` numbers the index's keyspace under the table prefix
+    (primary is 1, like the reference); unique indexes materialize KV
+    entries at /Table/<tid>/<index_id>/<vals> so concurrent writers
+    of the same value conflict transactionally. Non-unique indexes
+    are scan-plane accelerators only (rebuilt lazily per generation,
+    storage/columnstore.py ensure_secondary_index)."""
+    name: str
+    index_id: int
+    columns: list = field(default_factory=list)
+    unique: bool = False
+    state: str = PUBLIC
+
+
+@dataclass
 class TableDescriptor:
     id: int
     name: str
@@ -40,6 +57,7 @@ class TableDescriptor:
     columns: list[ColumnDescriptor] = field(default_factory=list)
     primary_key: list[str] = field(default_factory=list)
     state: str = PUBLIC  # table-level: public | dropped
+    indexes: list[IndexDescriptor] = field(default_factory=list)
 
     # -- schema views -------------------------------------------------------
     def public_schema(self) -> TableSchema:
@@ -72,6 +90,13 @@ class TableDescriptor:
                 "state": c.state,
                 "default": c.default,
             } for c in self.columns],
+            "indexes": [{
+                "name": i.name,
+                "index_id": i.index_id,
+                "columns": list(i.columns),
+                "unique": i.unique,
+                "state": i.state,
+            } for i in self.indexes],
         }).encode()
 
     @classmethod
@@ -82,7 +107,11 @@ class TableDescriptor:
             state=o["state"], primary_key=list(o["primary_key"]),
             columns=[ColumnDescriptor(
                 c["name"], _dec_type(c["type"]), c["nullable"],
-                c["state"], c.get("default")) for c in o["columns"]])
+                c["state"], c.get("default")) for c in o["columns"]],
+            indexes=[IndexDescriptor(
+                i["name"], i["index_id"], list(i["columns"]),
+                i["unique"], i["state"])
+                for i in o.get("indexes", [])])
 
     @classmethod
     def from_schema(cls, schema: TableSchema) -> "TableDescriptor":

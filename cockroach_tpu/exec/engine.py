@@ -39,6 +39,7 @@ from ..sql.bound import BConst
 from ..sql.planner import CatalogView, Planner
 from ..sql.rowenc import ROWID
 from ..sql.types import ColumnSchema, Family, TableSchema
+from ..storage import keys as K
 from ..storage.columnstore import MAX_TS_INT, Chunk, ColumnStore
 from ..storage.hlc import Clock, Timestamp
 from ..utils.metric import MetricRegistry
@@ -220,6 +221,9 @@ class Engine:
         self.mesh = mesh
         self._device_tables: dict[tuple, ColumnBatch] = {}
         self._exec_cache: dict[tuple, tuple] = {}
+        # per-table secondary-index descriptors, cached off the catalog
+        # (invalidated by index DDL; a fresh engine lazily reloads)
+        self._index_defs: dict[str, list] = {}
         # statement execution is serialized per engine: pgwire serves
         # each connection on its own thread, and the plan/device caches
         # plus columnstore publish are not safe under concurrent
@@ -347,6 +351,23 @@ class Engine:
                           rows=sorted((k, str(v))
                                       for k, v in z.items()),
                           tag="SHOW ZONE CONFIGURATION")
+        if isinstance(stmt, ast.CreateIndex):
+            return self._exec_create_index(stmt, session)
+        if isinstance(stmt, ast.DropIndex):
+            return self._exec_drop_index(stmt, session)
+        if isinstance(stmt, ast.ShowIndexes):
+            d = self.catalog.get_by_name(stmt.table)
+            if d is None:
+                raise EngineError(
+                    f"table {stmt.table!r} does not exist")
+            rows = [(stmt.table, "primary",
+                     ", ".join(d.primary_key) or ROWID, True, "public")]
+            rows += [(stmt.table, i.name, ", ".join(i.columns),
+                      i.unique, i.state) for i in d.indexes]
+            return Result(
+                names=["table_name", "index_name", "columns",
+                       "unique", "state"],
+                rows=rows, tag="SHOW INDEXES")
         if isinstance(stmt, ast.Insert):
             return self._exec_insert(stmt, session)
         if isinstance(stmt, ast.Update):
@@ -420,10 +441,29 @@ class Engine:
             node, _ = self._plan(stmt.stmt, session)
             costs = estimate(node, self.catalog_view().stats)
             tree = P.plan_tree_repr(node, costs=costs)
-            return Result(names=["plan"],
-                          rows=[(line,) for line in
-                                tree.rstrip().split("\n")],
-                          tag="EXPLAIN")
+            rows = []
+            if isinstance(stmt.stmt, ast.Select):
+                m = self._index_fastpath_match(stmt.stmt, session)
+                if m is not None:
+                    label, cols, vals = m
+                    # mirror the runtime selectivity guard when a warm
+                    # locator exists; never BUILD one here — EXPLAIN
+                    # must stay metadata-only (no O(table) work)
+                    tname = stmt.stmt.table.name
+                    td = self.store.table(tname)
+                    lim = int(session.vars.get(
+                        "index_lookup_limit", 4096))
+                    cached = td.sec_index_cache.get(cols)
+                    declined = (
+                        cached is not None
+                        and cached[0] == td.generation
+                        and len(cached[1].get(vals, [])) > lim)
+                    if not declined:
+                        rows.append((
+                            f"index scan {tname}@{label} "
+                            f"({', '.join(cols)}) = {vals!r}",))
+            rows += [(line,) for line in tree.rstrip().split("\n")]
+            return Result(names=["plan"], rows=rows, tag="EXPLAIN")
         if isinstance(stmt, ast.ShowCreateTable):
             d = self.catalog.get_by_name(stmt.table)
             if d is None:
@@ -850,7 +890,188 @@ class Engine:
             return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
             return self._exec_table_free(sel, session)
+        match = self._index_fastpath_match(sel, session)
+        if match is not None:
+            res = self._exec_index_fastpath(sel, session, match)
+            if res is not None:
+                self.metrics.counter(
+                    "sql.select.index_fastpath",
+                    "SELECTs served by the index point-read path").inc()
+                return res
         return self._prepare_select(sel, session, sql_text).run()
+
+    def _dml_index_candidates(self, table: str, where,
+                              session: Session):
+        """Chunk indexes that can hold rows matching `where`'s
+        equality conjuncts, per an available index — so a point
+        UPDATE/DELETE evaluates its predicate over one chunk instead
+        of the whole table. None = no usable index, scan every chunk.
+        The candidate set covers ALL row versions, so pruned chunks
+        provably contain no match at any timestamp."""
+        if where is None:
+            return None
+        probe = ast.Select(
+            items=[ast.SelectItem(None, star=True)],
+            table=ast.TableRef(table), where=where)
+        match = self._index_fastpath_match(probe, session)
+        if match is None:
+            return None
+        _label, cols, vals = match
+        sec = self.store.ensure_secondary_index(table, cols)
+        return {ci for ci, _ri in sec.get(vals, [])}
+
+    # -- index point-read fast path ------------------------------------------
+    # The OLTP read path: a selective equality lookup is served from
+    # the host-side index locator + per-row extraction instead of
+    # compiling and dispatching a full device scan — the analogue of
+    # the reference's constrained index scan (opt/idxconstraint +
+    # colfetcher point lookups through DistSender), where a point read
+    # touches one range instead of streaming the table.
+
+    def _index_fastpath_match(self, sel: ast.Select, session: Session):
+        """Return (label, cols, vals) when this SELECT is an equality
+        lookup covering all columns of a usable index: single table,
+        projection-only items, conjunctive WHERE with constant
+        equalities. None = use the compiled scan path."""
+        if (sel.table is None or sel.joins or sel.group_by
+                or sel.having or sel.distinct or sel.ctes):
+            return None
+        if session.vars.get("index_scan", "on") == "off":
+            return None
+        tname = sel.table.name
+        if sel.table.alias not in (None, tname):
+            return None
+        if tname not in self.store.tables:
+            return None
+        schema = self.store.table(tname).schema
+        visible = {c.name for c in schema.columns
+                   if not getattr(c, "hidden", False)}
+        projected = set()
+        for item in sel.items:
+            if item.star:
+                projected |= visible
+                continue
+            e = item.expr
+            if not (isinstance(e, ast.ColumnRef)
+                    and e.table in (None, tname)
+                    and e.name in visible):
+                return None
+            projected.add(item.alias or e.name)
+        for ob in sel.order_by:
+            if not (isinstance(ob.expr, ast.ColumnRef)
+                    and ob.expr.name in projected):
+                return None
+        if sel.where is None:
+            return None
+        eq: dict[str, object] = {}
+        for c in split_conjuncts_ast(sel.where):
+            if not (isinstance(c, ast.BinOp) and c.op == "="):
+                continue
+            lhs, rhs = c.left, c.right
+            if isinstance(rhs, ast.ColumnRef) and isinstance(
+                    lhs, ast.Literal):
+                lhs, rhs = rhs, lhs
+            if (isinstance(lhs, ast.ColumnRef)
+                    and lhs.table in (None, tname)
+                    and lhs.name in visible
+                    and isinstance(rhs, ast.Literal)
+                    and rhs.value is not None
+                    and lhs.name not in eq):
+                eq[lhs.name] = rhs
+        if not eq:
+            return None
+        # candidate indexes, best first: primary, unique, non-unique
+        cands = []
+        if schema.primary_key:
+            cands.append(("primary", tuple(schema.primary_key), 0))
+        for idx in self._table_indexes(tname):
+            if idx.state != "public":
+                continue
+            cands.append((idx.name, tuple(idx.columns),
+                          1 if idx.unique else 2))
+        cands.sort(key=lambda c: c[2])
+        for label, cols, _rank in cands:
+            if not all(cn in eq for cn in cols):
+                continue
+            binder = Binder(Scope())
+            vals = []
+            ok = True
+            for cn in cols:
+                col = schema.column(cn)
+                try:
+                    b = binder.bind(eq[cn])
+                    v = binder._const_to(b, col.type).value
+                except Exception:
+                    ok = False
+                    break
+                if v is None:
+                    ok = False
+                    break
+                vals.append(v)
+            if ok:
+                return (label, cols, tuple(vals))
+        return None
+
+    def _exec_index_fastpath(self, sel: ast.Select, session: Session,
+                             match) -> Optional[Result]:
+        label, cols, vals = match
+        tname = sel.table.name
+        td = self.store.table(tname)
+        read_ts = self._read_ts(session)
+        rts = read_ts.to_int()
+        sec = self.store.ensure_secondary_index(tname, cols)
+        positions = sec.get(vals, [])
+        limit = int(session.vars.get("index_lookup_limit", 4096))
+        if len(positions) > limit:
+            # low selectivity: the compiled device scan wins
+            return None
+        self._register_table_read(session.txn, tname, read_ts)
+        pending = (self._txn_key_state(session.effects, tname)
+                   if session.txn is not None else {})
+        rows = []
+        for ci, ri in positions:
+            c = td.chunks[ci]
+            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
+                continue
+            row = self.store.extract_row(td, c, ri)
+            if pending and td.codec.key(row) in pending:
+                continue  # superseded by this txn's buffered effects
+            rows.append(row)
+        for _key, r in pending.items():
+            if r is None:
+                continue
+            r = dict(r)
+            if td.codec.synthetic_pk and ROWID not in r:
+                r[ROWID] = 0
+            if tuple(r.get(cn) for cn in cols) == vals:
+                rows.append(r)
+        if rows:
+            scope, _ = self._dml_scope(tname)
+            predf = self._chunk_pred(tname, sel.where, scope, session)
+            mini = self._delta_chunk(td, rows, rts)
+            mask = np.asarray(predf(mini))
+            rows = [r for r, m in zip(rows, mask) if m]
+        schema = td.schema
+        out: list[tuple[str, object]] = []  # (output name, column)
+        for item in sel.items:
+            if item.star:
+                for c in schema.columns:
+                    if not getattr(c, "hidden", False):
+                        out.append((c.name, c))
+            else:
+                col = schema.column(item.expr.name)
+                out.append((item.alias or item.expr.name, col))
+        names = [n for n, _ in out]
+        types = [c.type for _, c in out]
+        res_rows = [tuple(_decode_storage_value(r.get(c.name), c.type)
+                          for _, c in out) for r in rows]
+        if sel.order_by:
+            res_rows = self._sort_decoded(res_rows, names, sel.order_by)
+        if sel.offset:
+            res_rows = res_rows[sel.offset:]
+        if sel.limit is not None:
+            res_rows = res_rows[:sel.limit]
+        return Result(names=names, rows=res_rows, types=types)
 
     def _exec_setop(self, so: ast.SetOp, session: Session,
                     sql_text: str) -> Result:
@@ -1463,9 +1684,165 @@ class Engine:
         except CatalogError:
             pass  # store-only table (pre-catalog tests); still drop it
         self.store.drop_table(d.name)
+        self._index_defs.pop(d.name, None)
         for k in [k for k in self._device_tables if k[0] == d.name]:
             self._evict_device(k)
         return Result(tag="DROP TABLE")
+
+    # -- secondary indexes ----------------------------------------------------
+    # Design (vs pkg/sql/rowenc + colfetcher/index_join.go): the scan
+    # plane is columnar and the analytic path never decodes keys, so a
+    # non-unique index is a *derived* host-side locator over the
+    # columnstore (generation-cached, storage/columnstore.py
+    # ensure_secondary_index) used for point-read/DML acceleration.
+    # UNIQUE indexes additionally materialize KV entries at
+    # /Table/<tid>/<index_id>/<vals> -> pk-key through the row-plane
+    # txn, so two concurrent writers of the same value conflict
+    # transactionally — the same guarantee the reference gets from
+    # CPut on index keys (pkg/sql/row/writer.go).
+
+    def _table_indexes(self, table: str) -> list:
+        cached = self._index_defs.get(table)
+        if cached is not None:
+            return cached
+        # a transient catalog error must fail the statement, NOT be
+        # cached as "no indexes" (which would silently drop unique
+        # enforcement); a missing descriptor (pre-catalog test table)
+        # legitimately has none
+        d = self.catalog.get_by_name(table)
+        idxs = list(d.indexes) if d is not None else []
+        self._index_defs[table] = idxs
+        return idxs
+
+    def _exec_create_index(self, c: ast.CreateIndex,
+                           session: Session) -> Result:
+        from ..catalog import IndexDescriptor
+        from ..catalog.descriptor import WRITE_ONLY
+        from ..jobs.schemachange import INDEX_BACKFILL_JOB
+        if c.table not in self.store.tables:
+            raise EngineError(f"table {c.table!r} does not exist")
+        td = self.store.table(c.table)
+        for cn in c.columns:
+            try:
+                td.schema.column(cn)
+            except KeyError:
+                raise EngineError(
+                    f"column {cn!r} does not exist in {c.table!r}")
+        desc = self.catalog.get_by_name(c.table)
+        if desc is None:
+            raise EngineError(
+                f"table {c.table!r} has no descriptor (pre-catalog)")
+        if c.name == "primary":
+            raise EngineError(
+                "index name 'primary' is reserved for the primary key")
+        if any(i.name == c.name for i in desc.indexes):
+            if c.if_not_exists:
+                return Result(tag="CREATE INDEX")
+            raise EngineError(
+                f"index {c.name!r} already exists on {c.table!r}")
+        next_id = 1 + max([i.index_id for i in desc.indexes],
+                          default=1)  # primary index is 1
+        # step 1: WRITE_ONLY — after the lease drain every writer
+        # maintains the index, but readers don't use it yet
+        desc.indexes.append(IndexDescriptor(
+            c.name, next_id, list(c.columns), c.unique, WRITE_ONLY))
+        desc = self.leases.publish(desc)
+        self._index_defs.pop(c.table, None)
+        # step 2: chunk-checkpointed backfill + validation + PUBLIC
+        # publish as a durable job (resumable after a crash), like the
+        # reference's index backfiller (pkg/sql/backfill via pkg/jobs)
+        job_id = self.jobs.create(INDEX_BACKFILL_JOB,
+                                  {"table": c.table, "index": c.name})
+        rec = self.jobs.run_job(job_id)
+        self._index_defs.pop(c.table, None)
+        if rec.status != "succeeded":
+            raise EngineError(
+                f"CREATE INDEX failed: {rec.error or rec.status}")
+        return Result(tag="CREATE INDEX")
+
+    def _exec_drop_index(self, d_stmt: ast.DropIndex,
+                         session: Session) -> Result:
+        found = []
+        for desc in self.catalog.list_tables():
+            for i in desc.indexes:
+                if i.name == d_stmt.name:
+                    found.append((desc, i))
+        if not found:
+            if d_stmt.if_exists:
+                return Result(tag="DROP INDEX")
+            raise EngineError(f"index {d_stmt.name!r} does not exist")
+        if len(found) > 1:
+            tables = sorted(d.name for d, _ in found)
+            raise EngineError(
+                f"index name {d_stmt.name!r} is ambiguous (exists on "
+                f"tables {tables}); drop and recreate with distinct "
+                f"names")
+        desc, idx = found[0]
+        desc.indexes = [i for i in desc.indexes if i.name != idx.name]
+        self.leases.publish(desc)
+        self._index_defs.pop(desc.name, None)
+        if idx.unique:
+            # clear the index keyspace (the reference runs this as a
+            # GC-TTL'd schema-change job; immediate here)
+            p = K.table_prefix(desc.id, idx.index_id)
+            self.kv.txn(lambda t: t.delete_range(p, K.prefix_end(p)))
+        return Result(tag="DROP INDEX")
+
+    def _maintain_indexes(self, table: str, td, t: Txn, pending: dict,
+                          old_row, new_row, rts: int) -> None:
+        """Per-row index maintenance inside a DML txn: drop stale
+        unique-index KV entries for old_row, uniqueness-check and
+        write entries for new_row. NULL in any indexed column exempts
+        the row (SQL unique semantics)."""
+        idxs = self._table_indexes(table)
+        if not idxs:
+            return
+        tid = td.schema.table_id
+        for idx in idxs:
+            cols = tuple(idx.columns)
+            old_vals = (tuple(old_row.get(cn) for cn in cols)
+                        if old_row is not None else None)
+            if old_vals is not None and any(v is None for v in old_vals):
+                old_vals = None
+            new_vals = (tuple(new_row.get(cn) for cn in cols)
+                        if new_row is not None else None)
+            if new_vals is not None and any(v is None for v in new_vals):
+                new_vals = None
+            if not idx.unique or old_vals == new_vals:
+                continue
+            if old_vals is not None:
+                t.delete(K.table_key(tid, old_vals, idx.index_id))
+            if new_vals is not None:
+                self._check_unique(table, td, idx, new_vals, t,
+                                   pending, new_row, rts)
+                t.put(K.table_key(tid, new_vals, idx.index_id),
+                      td.codec.key(new_row))
+
+    def _check_unique(self, table: str, td, idx, vals: tuple, t: Txn,
+                      pending: dict, new_row: dict, rts: int) -> None:
+        tid = td.schema.table_id
+        new_key = td.codec.key(new_row)
+        # 1. the KV entry: covers committed rows written through the
+        # row plane AND this txn's earlier writes (MVCC reads see own
+        # intents); concurrent writers conflict on this same key
+        raw = t.get(K.table_key(tid, vals, idx.index_id))
+        if raw is not None and raw != new_key:
+            raise EngineError(
+                f"duplicate key value {vals!r} violates unique "
+                f"index {idx.name!r} of {table!r}")
+        # 2. the scan plane: covers bulk-ingested rows that never had
+        # KV pairs (tpch.load-style ingest); visibility at our read ts
+        sec = self.store.ensure_secondary_index(table, tuple(idx.columns))
+        for ci, ri in sec.get(vals, []):
+            c = td.chunks[ci]
+            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
+                continue
+            rk = self.store.row_key(td, c, ri)
+            if rk == new_key or rk in pending:
+                continue  # the row being replaced / superseded in-txn
+            raise EngineError(
+                f"duplicate key value {vals!r} violates unique "
+                f"index {idx.name!r} of {table!r}")
 
     # -- schema changes -------------------------------------------------------
     @property
@@ -1475,12 +1852,16 @@ class Engine:
         if getattr(self, "_jobs", None) is None:
             from ..cdc import CHANGEFEED_JOB, ChangefeedResumer
             from ..jobs import Registry
-            from ..jobs.schemachange import (SCHEMA_CHANGE_JOB,
+            from ..jobs.schemachange import (INDEX_BACKFILL_JOB,
+                                             SCHEMA_CHANGE_JOB,
+                                             IndexBackfillResumer,
                                              SchemaChangeResumer)
             self._jobs = Registry(self.kv,
                                   session_id=f"engine-{id(self)}")
             self._jobs.register(SCHEMA_CHANGE_JOB,
                                 lambda: SchemaChangeResumer(self))
+            self._jobs.register(INDEX_BACKFILL_JOB,
+                                lambda: IndexBackfillResumer(self))
             self._jobs.register(CHANGEFEED_JOB,
                                 lambda: ChangefeedResumer(self))
             from ..jobs.backup import (BACKUP_JOB, RESTORE_JOB,
@@ -1523,9 +1904,14 @@ class Engine:
             threshold = min(threshold, prot - 1)
         if threshold <= 0:
             return 0
-        n = self.store.gc(table, Timestamp(threshold, 0))
-        if n:
-            self._evict(table)
+        # GC compacts td.chunks (positions shift); statements hold
+        # locator (chunk, row) positions across store-lock sections, so
+        # GC must serialize with statement execution — the maintenance
+        # thread calls this directly (server/node.py)
+        with self._stmt_lock:
+            n = self.store.gc(table, Timestamp(threshold, 0))
+            if n:
+                self._evict(table)
         return n
 
     def run_ttl(self, table: str, ttl_col: str,
@@ -1588,6 +1974,12 @@ class Engine:
             if colname in desc.primary_key:
                 raise EngineError(
                     f"cannot drop primary key column {colname!r}")
+            refs = [i.name for i in desc.indexes
+                    if colname in i.columns]
+            if refs:
+                raise EngineError(
+                    f"cannot drop column {colname!r}: referenced by "
+                    f"index(es) {sorted(refs)}; drop them first")
             # step 1: hide from readers, publish, drain leases
             desc.column(colname).state = WRITE_ONLY
             self.store.hide_column(a.table, colname)
@@ -1850,12 +2242,14 @@ class Engine:
         def fn(t: Txn, effects: list) -> Result:
             pending = self._txn_key_state(effects, ins.table)
             idx = self.store.ensure_pk_index(ins.table)
+            rts = t.meta.read_ts.to_int()
             new_rows = []
             for row in rows:
                 r = dict(row)
                 if codec.synthetic_pk:
                     r[ROWID] = self.store.alloc_rowids(ins.table, 1)[0]
                 key = codec.key(r)
+                old_row = None
                 if not codec.synthetic_pk and not ins.upsert:
                     # duplicate-key check = CPut semantics: a KV read
                     # (sees concurrent intents, registers the span)
@@ -1869,6 +2263,18 @@ class Engine:
                         raise EngineError(
                             f"duplicate key value {pk!r} violates "
                             f"primary key of {ins.table!r}")
+                elif ins.upsert:
+                    # the row being replaced (if any), for secondary-
+                    # index entry cleanup
+                    in_txn = pending.get(key, "absent")
+                    if in_txn not in (None, "absent"):
+                        old_row = in_txn
+                    elif key in idx:
+                        ci, ri = idx[key]
+                        old_row = self.store.extract_row(
+                            td, td.chunks[ci], ri)
+                self._maintain_indexes(ins.table, td, t, pending,
+                                       old_row, r, rts)
                 t.put(key, codec.encode_value(r))
                 pending[key] = r
                 new_rows.append((key, r))
@@ -1939,11 +2345,20 @@ class Engine:
             self._register_table_read(t, d.table, read_ts)
             rts = read_ts.to_int()
             n = 0
-            for chunk in self._overlay_chunks(d.table, effects, read_ts):
+            pending = self._txn_key_state(effects, d.table)
+            cand = self._dml_index_candidates(d.table, d.where, session)
+            n_committed = len(td.chunks)
+            for ci, chunk in enumerate(
+                    self._overlay_chunks(d.table, effects, read_ts)):
+                if cand is not None and ci < n_committed \
+                        and ci not in cand:
+                    continue
                 mask = chunk.live_mask(rts) & predf(chunk)
                 for ri in np.nonzero(mask)[0]:
                     row = self.store.extract_row(td, chunk, int(ri))
                     key = codec.key(row)
+                    self._maintain_indexes(d.table, td, t, pending,
+                                           row, None, rts)
                     t.delete(key)
                     effects.append((d.table, ("del", key)))
                     n += 1
@@ -2007,7 +2422,13 @@ class Engine:
             idx = self.store.ensure_pk_index(u.table)
             n = 0
             todo = []
-            for chunk in self._overlay_chunks(u.table, effects, read_ts):
+            cand = self._dml_index_candidates(u.table, u.where, session)
+            n_committed = len(td.chunks)
+            for ci, chunk in enumerate(
+                    self._overlay_chunks(u.table, effects, read_ts)):
+                if cand is not None and ci < n_committed \
+                        and ci not in cand:
+                    continue
                 mask = chunk.live_mask(rts) & predf(chunk)
                 if not mask.any():
                     continue
@@ -2041,6 +2462,8 @@ class Engine:
                     t.delete(okey)
                     effects.append((u.table, ("del", okey)))
                     pending[okey] = None
+                self._maintain_indexes(u.table, td, t, pending,
+                                       old, new, rts)
                 t.put(nkey, codec.encode_value(new))
                 effects.append((u.table, ("put", nkey, new)))
                 pending[nkey] = new
@@ -2194,6 +2617,11 @@ def _render_create(desc) -> str:
         parts.append(s)
     if desc.primary_key:
         parts.append(f"PRIMARY KEY ({', '.join(desc.primary_key)})")
+    for i in desc.indexes:
+        if i.state != "public":
+            continue
+        kw = "UNIQUE INDEX" if i.unique else "INDEX"
+        parts.append(f"{kw} {i.name} ({', '.join(i.columns)})")
     cols = ",\n  ".join(parts)
     return f"CREATE TABLE {desc.name} (\n  {cols}\n)"
 
@@ -2273,6 +2701,33 @@ def _rewrite_table_names(sel, mapping: dict):
 
     fix_select(sel)
     return sel
+
+
+def split_conjuncts_ast(e: ast.Expr) -> list:
+    """Flatten a WHERE tree into its AND-conjuncts (AST level; the
+    planner's split_conjuncts does the same over bound exprs)."""
+    out: list = []
+
+    def walk(x):
+        if isinstance(x, ast.BinOp) and x.op == "and":
+            walk(x.left)
+            walk(x.right)
+        else:
+            out.append(x)
+
+    walk(e)
+    return out
+
+
+def _decode_storage_value(v, ty):
+    """Storage-logical value (extract_row form: strings pre-decoded,
+    numerics physical) -> client value. Delegates to _decode_scalar so
+    the fastpath and the compiled path share one decoding."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    return _decode_scalar(v, True, ty, None)
 
 
 def _decode_scalar(v, valid: bool, ty, dictionary):

@@ -79,3 +79,125 @@ class SchemaChangeResumer:
                 self.engine.catalog.write_new_version(desc)
         except KeyError:
             pass
+
+
+INDEX_BACKFILL_JOB = "index-backfill"
+
+
+class IndexBackfillResumer:
+    """Online CREATE INDEX (pkg/sql/backfill's index backfiller as a
+    job). The descriptor is already published in WRITE_ONLY — every
+    writer maintains the index — so this job only has to cover the
+    rows that existed before: for UNIQUE indexes it validates
+    uniqueness over the live scan plane and materializes the KV
+    entries chunk by chunk (each chunk a checkpoint); non-unique
+    indexes are derived lazily from the scan plane and need no
+    backfill beyond validation that the columns exist.
+
+    payload: {table, index}; progress: {chunks_done}."""
+
+    def __init__(self, engine, crash_after_chunk=None):
+        self.engine = engine
+        self.crash_after_chunk = crash_after_chunk
+
+    def resume(self, ctx: JobContext) -> None:
+        from ..catalog import CatalogError
+        from ..catalog.descriptor import PUBLIC
+        from ..storage import keys as K
+        from ..storage.columnstore import MAX_TS_INT
+        p = ctx.payload
+        table, iname = p["table"], p["index"]
+        engine = self.engine
+        store = engine.store
+        desc = engine.catalog.get_by_name(table)
+        if desc is None:
+            raise CatalogError(f"table {table!r} vanished mid-change")
+        idx = next((i for i in desc.indexes if i.name == iname), None)
+        if idx is None:
+            raise CatalogError(f"index {iname!r} vanished mid-change")
+        if idx.state != PUBLIC:
+            td = store.table(table)
+            cols = tuple(idx.columns)
+            if idx.unique:
+                # validate: no two live rows share a value (writers
+                # racing the backfill already maintain KV entries, so
+                # they are covered by the same check)
+                sec = store.ensure_secondary_index(table, cols)
+                for vals, positions in sec.items():
+                    ctx.check_cancel()
+                    live = [(ci, ri) for ci, ri in positions
+                            if td.chunks[ci].mvcc_del[ri] == MAX_TS_INT]
+                    if len(live) > 1:
+                        raise ValueError(
+                            f"duplicate key value {vals!r} violates "
+                            f"unique index {iname!r} of {table!r}")
+                # materialize KV entries chunk by chunk, checkpointed.
+                # The cursor is positional, so it is only valid for
+                # the chunk layout it was taken against: a GC pass
+                # between crash and resume compacts td.chunks and
+                # shifts indices — stamp the generation and restart
+                # from 0 on mismatch (entry puts are idempotent).
+                done = int(ctx.progress().get("chunks_done", 0))
+                if int(ctx.progress().get("generation", -1)) != \
+                        td.generation:
+                    done = 0
+                tid = desc.id
+                while True:
+                    ctx.check_cancel()
+                    n_chunks = len(td.chunks)
+                    if done >= n_chunks:
+                        break
+                    for ci in range(done, n_chunks):
+                        ctx.check_cancel()
+                        chunk = td.chunks[ci]
+
+                        def fill(t, ci=ci, chunk=chunk):
+                            for ri in range(chunk.n):
+                                if chunk.mvcc_del[ri] != MAX_TS_INT:
+                                    continue
+                                row = store.extract_row(td, chunk, ri)
+                                vals = tuple(row.get(cn) for cn in cols)
+                                if any(v is None for v in vals):
+                                    continue
+                                t.put(K.table_key(tid, vals,
+                                                  idx.index_id),
+                                      store.row_key(td, chunk, ri))
+                        engine.kv.txn(fill)
+                        done = ci + 1
+                        if (self.crash_after_chunk is not None
+                                and done >= self.crash_after_chunk):
+                            from .registry import _CrashForTesting
+                            raise _CrashForTesting()
+                        ctx.checkpoint({"chunks_done": done,
+                                        "generation": td.generation})
+            else:
+                # warm the derived locator once (also validates the
+                # column set against the live schema)
+                store.ensure_secondary_index(table, cols)
+            idx.state = PUBLIC
+            engine.leases.publish(desc)
+            engine._index_defs.pop(table, None)
+        ctx.checkpoint({"chunks_done": ctx.progress().get(
+            "chunks_done", 0), "published": True}, fraction=1.0)
+
+    def on_fail_or_cancel(self, ctx: JobContext) -> None:
+        """Roll back: remove the half-built index descriptor and any
+        materialized KV entries."""
+        from ..storage import keys as K
+        p = ctx.payload
+        engine = self.engine
+        desc = engine.catalog.get_by_name(p["table"])
+        if desc is None:
+            return
+        idx = next((i for i in desc.indexes
+                    if i.name == p["index"]), None)
+        if idx is None:
+            return
+        desc.indexes = [i for i in desc.indexes
+                        if i.name != p["index"]]
+        engine.catalog.write_new_version(desc)
+        engine._index_defs.pop(p["table"], None)
+        if idx.unique:
+            pref = K.table_prefix(desc.id, idx.index_id)
+            engine.kv.txn(
+                lambda t: t.delete_range(pref, K.prefix_end(pref)))

@@ -220,6 +220,31 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateIndex(Statement):
+    """CREATE [UNIQUE] INDEX <name> ON <table> (cols...). Unique
+    indexes write KV entries at /Table/<tid>/<index_id>/<vals> so
+    concurrent violations conflict in the KV plane, like the
+    reference's index rows (pkg/sql/rowenc/index_encoding.go)."""
+    name: str
+    table: str
+    columns: list[str] = field(default_factory=list)
+    unique: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndex(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowIndexes(Statement):
+    """SHOW INDEXES FROM <table>."""
+    table: str
+
+
+@dataclass
 class AlterTable(Statement):
     """ALTER TABLE <t> ADD COLUMN <def> [DEFAULT lit] | DROP COLUMN <c>.
     Executed as an online schema change (jobs/schemachange.py)."""

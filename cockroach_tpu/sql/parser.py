@@ -132,6 +132,11 @@ class Parser:
                     and self.peek().text == "statements":
                 self.next()
                 return ast.ShowStatements()
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "indexes":
+                self.next()
+                self.expect_kw("from")
+                return ast.ShowIndexes(self.expect_ident())
             if self.peek().is_kw("create"):
                 self.next()
                 self.expect_kw("table")
@@ -659,6 +664,31 @@ class Parser:
             if t.kind != Tok.STRING:
                 raise ParseError("sink must be a string literal")
             return ast.CreateChangefeed(table, t.text)
+        unique = False
+        if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == "unique":
+            self.next()
+            unique = True
+        if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == "index":
+            self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            iname = self.expect_ident()
+            self.expect_kw("on")
+            table = self.expect_ident()
+            self.expect_op("(")
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+            return ast.CreateIndex(iname, table, cols, unique,
+                                   if_not_exists)
+        if unique:
+            raise ParseError("expected INDEX after CREATE UNIQUE")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -758,6 +788,14 @@ class Parser:
 
     def parse_drop(self) -> ast.Statement:
         self.expect_kw("drop")
+        if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == "index":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropIndex(self.expect_ident(), if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -114,6 +114,13 @@ class TableData:
     # cached multi-column distinct counts for join-uniqueness checks:
     # (cols tuple) -> (generation, distinct, live_rows)
     key_distinct_cache: dict = field(default_factory=dict)
+    # secondary-index locators: (cols tuple) -> (generation, mapping)
+    # where mapping is value-tuple -> [(chunk, row), ...] over ALL row
+    # versions (lookups filter by MVCC visibility), rebuilt lazily
+    # when the generation moves (storage analogue of an index that is
+    # maintained by the write path in the reference; here the scan
+    # plane is the source of truth and the index is derived)
+    sec_index_cache: dict = field(default_factory=dict)
 
     @property
     def row_count(self) -> int:
@@ -317,6 +324,8 @@ class ColumnStore:
     def seal(self, name: str) -> None:
         td = self.table(name)
         with self._lock:
+            if not td.open_ts:
+                return  # nothing buffered: data unchanged, caches stay
             self._seal_locked(td)
             td.generation += 1
 
@@ -610,12 +619,70 @@ class ColumnStore:
                 self._seal_locked(td)
                 for i, (k, _) in enumerate(live):
                     idx[k] = (base_ci, i)
+            # keep warm secondary-index locators valid across the
+            # publish instead of forcing an O(table) rebuild per DML
+            # statement (the scan-plane analogue of the reference's
+            # write path maintaining index KV entries in place)
+            if td.sec_index_cache:
+                defaults = getattr(td, "column_defaults", {})
+                for cols, (gen, mapping) in list(
+                        td.sec_index_cache.items()):
+                    if gen != td.generation:
+                        del td.sec_index_cache[cols]
+                        continue
+                    if live:
+                        for i, (_k, row) in enumerate(live):
+                            vals = tuple(row.get(cn, defaults.get(cn))
+                                         for cn in cols)
+                            if any(v is None for v in vals):
+                                continue
+                            mapping.setdefault(vals, []).append(
+                                (base_ci, i))
+                    td.sec_index_cache[cols] = (td.generation + 1,
+                                                mapping)
             td.generation += 1
 
     def _next_rowid_locked(self, td: TableData) -> int:
         r = td.next_rowid
         td.next_rowid += 1
         return r
+
+    def ensure_secondary_index(self, name: str, cols: tuple) -> dict:
+        """Build (lazily, generation-cached) the value-tuple ->
+        [(chunk, row), ...] locator over ALL row versions of `cols`.
+        Rows with a NULL in any indexed column are excluded (SQL
+        uniqueness and equality both ignore NULLs). Lookups must
+        filter positions by MVCC visibility at their read timestamp —
+        superseded versions are indexed on purpose so historical
+        reads (txn-pinned / follower-read timestamps) stay correct."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            cached = td.sec_index_cache.get(cols)
+            if cached is not None and cached[0] == td.generation:
+                return cached[1]
+            idx: dict[tuple, list] = {}
+            for ci, chunk in enumerate(td.chunks):
+                valid = np.ones(chunk.n, dtype=bool)
+                arrs = []
+                for cn in cols:
+                    valid &= chunk.valid[cn]
+                    col = td.schema.column(cn)
+                    if col.type.family == Family.STRING:
+                        arrs.append(td.dictionaries[cn].decode_array(
+                            chunk.data[cn]))
+                    else:
+                        arrs.append(chunk.data[cn])
+                for ri in np.nonzero(valid)[0]:
+                    key = tuple(a[ri].item() if hasattr(a[ri], "item")
+                                else a[ri] for a in arrs)
+                    idx.setdefault(key, []).append((ci, int(ri)))
+            stale = [k for k, v in td.sec_index_cache.items()
+                     if v[0] != td.generation]
+            for k in stale:
+                del td.sec_index_cache[k]
+            td.sec_index_cache[cols] = (td.generation, idx)
+            return idx
 
     # -- statistics ----------------------------------------------------------
     def analyze(self, name: str):
