@@ -58,6 +58,11 @@ class TableDescriptor:
     primary_key: list[str] = field(default_factory=list)
     state: str = PUBLIC  # table-level: public | dropped
     indexes: list[IndexDescriptor] = field(default_factory=list)
+    # views: the body SQL text; re-planned (expanded as a derived
+    # table) at each use, like the reference's view descriptors
+    # (pkg/sql/create_view.go stores the rewritten query text)
+    view_sql: str = ""
+    view_columns: list = field(default_factory=list)  # output renames
 
     # -- schema views -------------------------------------------------------
     def public_schema(self) -> TableSchema:
@@ -97,6 +102,8 @@ class TableDescriptor:
                 "unique": i.unique,
                 "state": i.state,
             } for i in self.indexes],
+            "view_sql": self.view_sql,
+            "view_columns": list(self.view_columns),
         }).encode()
 
     @classmethod
@@ -111,7 +118,9 @@ class TableDescriptor:
             indexes=[IndexDescriptor(
                 i["name"], i["index_id"], list(i["columns"]),
                 i["unique"], i["state"])
-                for i in o.get("indexes", [])])
+                for i in o.get("indexes", [])],
+            view_sql=o.get("view_sql", ""),
+            view_columns=list(o.get("view_columns", [])))
 
     @classmethod
     def from_schema(cls, schema: TableSchema) -> "TableDescriptor":

@@ -99,6 +99,8 @@ class Session:
     # SET tracing = on: span recordings per statement, rendered by
     # SHOW TRACE FOR SESSION (the reference's session tracing)
     trace: list = field(default_factory=list)
+    # currval() state: sequence name -> last nextval in this session
+    seq_currval: dict = field(default_factory=dict)
 
     @property
     def in_txn(self) -> bool:
@@ -351,6 +353,35 @@ class Engine:
                           rows=sorted((k, str(v))
                                       for k, v in z.items()),
                           tag="SHOW ZONE CONFIGURATION")
+        if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete,
+                             ast.Truncate, ast.AlterTable)):
+            tbl = getattr(stmt, "table", None)
+            if tbl in self._view_map():
+                raise EngineError(
+                    f"{tbl!r} is a view; views are not modifiable")
+        if isinstance(stmt, ast.CreateView):
+            return self._exec_create_view(stmt, session)
+        if isinstance(stmt, ast.DropView):
+            return self._exec_drop_view(stmt)
+        if isinstance(stmt, ast.CreateSequence):
+            return self._exec_create_sequence(stmt)
+        if isinstance(stmt, ast.DropSequence):
+            return self._exec_drop_sequence(stmt)
+        if isinstance(stmt, ast.ShowSequences):
+            import json as _json
+            rows = []
+            for k, v in self.kv.scan(self.SEQ_PREFIX,
+                                     K.prefix_end(self.SEQ_PREFIX)):
+                d = _json.loads(v.decode())
+                rows.append((k[len(self.SEQ_PREFIX):].decode(),
+                             d["start"], d["increment"],
+                             d.get("value")))
+            return Result(
+                names=["sequence_name", "start", "increment",
+                       "last_value"],
+                rows=sorted(rows), tag="SHOW SEQUENCES")
+        if isinstance(stmt, ast.Truncate):
+            return self._exec_truncate(stmt)
         if isinstance(stmt, ast.CreateIndex):
             return self._exec_create_index(stmt, session)
         if isinstance(stmt, ast.DropIndex):
@@ -438,18 +469,31 @@ class Engine:
             if stmt.analyze:
                 return self._explain_analyze(stmt.stmt, session,
                                              sql_text)
-            node, _ = self._plan(stmt.stmt, session)
+            target = stmt.stmt
+            if isinstance(target, ast.Select):
+                target = self._expand_views(target)
+            if isinstance(target, ast.Select) and (
+                    target.ctes or self._has_derived(target)):
+                # composite shapes (CTEs / derived / views): explain
+                # each sub-plan; the main stage re-plans over the
+                # materialized temps at execution time
+                return Result(
+                    names=["plan"],
+                    rows=[(ln,) for ln in
+                          self._explain_composite(target, session)],
+                    tag="EXPLAIN")
+            node, _ = self._plan(target, session, for_explain=True)
             costs = estimate(node, self.catalog_view().stats)
             tree = P.plan_tree_repr(node, costs=costs)
             rows = []
-            if isinstance(stmt.stmt, ast.Select):
-                m = self._index_fastpath_match(stmt.stmt, session)
+            if isinstance(target, ast.Select):
+                m = self._index_fastpath_match(target, session)
                 if m is not None:
                     label, cols, vals = m
                     # mirror the runtime selectivity guard when a warm
                     # locator exists; never BUILD one here — EXPLAIN
                     # must stay metadata-only (no O(table) work)
-                    tname = stmt.stmt.table.name
+                    tname = target.table.name
                     td = self.store.table(tname)
                     lim = int(session.vars.get(
                         "index_lookup_limit", 4096))
@@ -469,8 +513,14 @@ class Engine:
             if d is None:
                 raise EngineError(
                     f"table {stmt.table!r} does not exist")
+            if d.view_sql:
+                cols = (f" ({', '.join(d.view_columns)})"
+                        if d.view_columns else "")
+                ddl = f"CREATE VIEW {d.name}{cols} AS {d.view_sql}"
+            else:
+                ddl = _render_create(d)
             return Result(names=["table_name", "create_statement"],
-                          rows=[(d.name, _render_create(d))],
+                          rows=[(d.name, ddl)],
                           tag="SHOW CREATE TABLE")
         if isinstance(stmt, ast.ShowAll):
             return Result(
@@ -535,6 +585,45 @@ class Engine:
             session.txn_aborted = False
             return Result(tag="ROLLBACK")
         raise EngineError(f"unsupported statement {type(stmt).__name__}")
+
+    def _explain_composite(self, sel: ast.Select,
+                           session: Session) -> list[str]:
+        """EXPLAIN for CTE / derived-table / view shapes: one plan
+        block per sub-select (the reference similarly renders each
+        WithExpr's bound plan); the main stage is re-planned over the
+        materialized temps at execution."""
+        from ..sql.stats import estimate
+        lines: list[str] = []
+
+        def emit(label: str, sub):
+            if isinstance(sub, ast.Select):
+                sub = self._expand_views(sub)
+            lines.append(f"{label}:")
+            if isinstance(sub, ast.Select) and (
+                    sub.ctes or self._has_derived(sub)):
+                lines.extend("  " + ln for ln in
+                             self._explain_composite(sub, session))
+            elif isinstance(sub, ast.Select) and sub.table is not None:
+                node, _ = self._plan(sub, session, for_explain=True)
+                costs = estimate(node, self.catalog_view().stats)
+                lines.extend(
+                    "  " + ln for ln in P.plan_tree_repr(
+                        node, costs=costs).rstrip().split("\n"))
+            else:
+                lines.append(
+                    "  (table-free or set-op; planned at execution)")
+
+        for name, _cols, s in sel.ctes:
+            emit(f"cte {name}", s)
+        refs = ([sel.table] if sel.table is not None else []) \
+            + [j.table for j in sel.joins]
+        for r in refs:
+            if r.subquery is not None:
+                emit(f"derived {r.alias or r.name}", r.subquery)
+        lines.append(
+            "main: re-planned over the materialized temps at "
+            "execution")
+        return lines
 
     def _explain_analyze(self, sel, session: Session,
                          sql_text: str) -> Result:
@@ -607,16 +696,73 @@ class Engine:
         return session.txn_read_ts or self.clock.now()
 
     # -- SELECT --------------------------------------------------------------
-    def _plan(self, stmt, session):
+    def _plan(self, stmt, session, for_explain: bool = False):
         if not isinstance(stmt, ast.Select):
             raise EngineError("can only EXPLAIN SELECT")
         read_ts = self._read_ts(session)
+        # EXPLAIN must not execute volatile functions: sequences bind
+        # to a placeholder instead of allocating (pg EXPLAIN semantics)
+        seq_ops = ((lambda fn, name, arg: 0) if for_explain
+                   else self._sequence_ops(session))
         planner = Planner(
             self.catalog_view(),
             subquery_eval=lambda sel, lim: self._eval_subquery(
                 sel, session, lim),
-            now_micros=read_ts.wall // 1000)
+            now_micros=read_ts.wall // 1000,
+            sequence_ops=seq_ops)
         return planner.plan_select(stmt)
+
+    # -- sequences ------------------------------------------------------------
+    SEQ_PREFIX = b"/seq/"
+
+    def _sequence_ops(self, session: Session):
+        return lambda fn, name, arg: self._sequence_op(
+            session, fn, name, arg)
+
+    def _seq_desc(self, name: str) -> dict:
+        import json as _json
+        raw = self.kv.txn(
+            lambda t: t.get(self.SEQ_PREFIX + name.encode()))
+        if raw is None:
+            raise EngineError(f"sequence {name!r} does not exist")
+        return _json.loads(raw.decode())
+
+    def _sequence_op(self, session: Session, fn: str, name: str,
+                     arg) -> int:
+        """nextval/currval/setval. nextval allocates in its OWN KV
+        txn — sequence values are never rolled back (pg semantics;
+        the reference likewise increments outside the user txn,
+        pkg/sql/sequence.go)."""
+        import json as _json
+        key = self.SEQ_PREFIX + name.encode()
+        if fn == "currval":
+            if name not in session.seq_currval:
+                raise EngineError(
+                    f"currval of sequence {name!r} is not yet "
+                    f"defined in this session")
+            return session.seq_currval[name]
+        if fn == "nextval":
+            def bump(t):
+                raw = t.get(key)
+                if raw is None:
+                    raise EngineError(
+                        f"sequence {name!r} does not exist")
+                d = _json.loads(raw.decode())
+                if d.get("value") is None:
+                    d["value"] = d["start"]
+                else:
+                    d["value"] += d["increment"]
+                t.put(key, _json.dumps(d).encode())
+                return d["value"]
+            v = self.kv.txn(bump)
+        else:  # setval
+            desc = self._seq_desc(name)
+            desc["value"] = int(arg)
+            self.kv.txn(lambda t: t.put(
+                key, _json.dumps(desc).encode()))
+            v = int(arg)
+        session.seq_currval[name] = v
+        return v
 
     # -- subqueries / CTEs ---------------------------------------------------
     def _eval_subquery(self, sel: ast.Select, session: Session,
@@ -870,6 +1016,8 @@ class Engine:
         host<->device round trip per query."""
         session = session or self.session()
         stmt = parser.parse(sql)
+        if isinstance(stmt, ast.Select):
+            stmt = self._expand_views(stmt)
         if isinstance(stmt, ast.SetOp) or (
                 isinstance(stmt, ast.Select)
                 and (stmt.ctes or self._has_derived(stmt))):
@@ -886,6 +1034,7 @@ class Engine:
                      sql_text: str) -> Result:
         if isinstance(sel, ast.SetOp):
             return self._exec_setop(sel, session, sql_text)
+        sel = self._expand_views(sel)
         if sel.ctes or self._has_derived(sel):
             return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
@@ -1307,6 +1456,41 @@ class Engine:
         d = dist_analyze(node)
         return d if d.ok else None
 
+    def _maybe_generate_series(self, sel: ast.Select, binder: Binder):
+        """SELECT generate_series(a, b [, step]) — the one supported
+        set-returning function (pg SRF in the select list), table-free
+        context only; args must fold to constants."""
+        if len(sel.items) != 1 or sel.items[0].star:
+            return None
+        e = sel.items[0].expr
+        if not (isinstance(e, ast.FuncCall)
+                and e.name == "generate_series"):
+            return None
+        if len(e.args) not in (2, 3):
+            raise EngineError("generate_series(start, stop [, step])")
+        vals = []
+        for a in e.args:
+            b = binder.bind(a)
+            if not isinstance(b, BConst) or b.value is None:
+                raise EngineError(
+                    "generate_series arguments must be constants")
+            vals.append(int(b.value))
+        start, stop = vals[0], vals[1]
+        step = vals[2] if len(vals) == 3 else 1
+        if step == 0:
+            raise EngineError("generate_series step cannot be 0")
+        series = range(start, stop + (1 if step > 0 else -1), step)
+        name = sel.items[0].alias or "generate_series"
+        rows = [(int(v),) for v in series]
+        if sel.order_by:
+            rows = self._sort_decoded(rows, [name], sel.order_by)
+        if sel.offset:
+            rows = rows[sel.offset:]
+        if sel.limit is not None:
+            rows = rows[:sel.limit]
+        from ..sql.types import INT8
+        return Result(names=[name], rows=rows, types=[INT8])
+
     def _exec_table_free(self, sel: ast.Select,
                          session: Session | None = None) -> Result:
         """SELECT <exprs> with no FROM."""
@@ -1316,7 +1500,11 @@ class Engine:
             Scope(),
             subquery_eval=lambda s, lim: self._eval_subquery(
                 s, session, lim),
-            now_micros=read_ts.wall // 1000)
+            now_micros=read_ts.wall // 1000,
+            sequence_ops=self._sequence_ops(session))
+        srf = self._maybe_generate_series(sel, binder)
+        if srf is not None:
+            return srf
         names, exprs = [], []
         for it in sel.items:
             if it.star:
@@ -1675,6 +1863,16 @@ class Engine:
 
     def _exec_drop(self, d: ast.DropTable) -> Result:
         from ..catalog import CatalogError
+        if d.name in self._view_map():
+            raise EngineError(
+                f"{d.name!r} is a view; use DROP VIEW")
+        deps = [v for v, vd in self._view_map().items()
+                if d.name in _stmt_table_refs(
+                    parser.parse(vd.view_sql))]
+        if deps:
+            raise EngineError(
+                f"cannot drop table {d.name!r}: view(s) "
+                f"{sorted(deps)} depend on it")
         if d.name not in self.store.tables:
             if d.if_exists:
                 return Result(tag="DROP TABLE")
@@ -1787,6 +1985,156 @@ class Engine:
             p = K.table_prefix(desc.id, idx.index_id)
             self.kv.txn(lambda t: t.delete_range(p, K.prefix_end(p)))
         return Result(tag="DROP INDEX")
+
+    # -- views ----------------------------------------------------------------
+    # A view is a descriptor carrying SQL text; every use re-plans it
+    # as a derived table (pkg/sql/create_view.go + opt view expansion).
+
+    def _view_map(self) -> dict:
+        if getattr(self, "_view_defs", None) is None:
+            self._view_defs = {
+                d.name: d for d in self.catalog.list_tables()
+                if d.view_sql}
+        return self._view_defs
+
+    def _expand_views(self, sel: ast.Select,
+                      depth: int = 0) -> ast.Select:
+        views = self._view_map()
+        # SQL scoping: a CTE binding shadows a same-named view
+        cte_names = {name for name, _c, _s in sel.ctes}
+        if cte_names:
+            views = {k: v for k, v in views.items()
+                     if k not in cte_names}
+        if not views:
+            return sel
+        if depth > 16:
+            raise EngineError("view nesting too deep (cycle?)")
+        import copy
+        refs = ([sel.table] if sel.table is not None else []) \
+            + [j.table for j in sel.joins]
+        if not any(r.subquery is None and r.name in views
+                   for r in refs):
+            return sel
+        sel = copy.copy(sel)
+
+        def expand_ref(ref: ast.TableRef) -> ast.TableRef:
+            if ref.subquery is not None or ref.name not in views:
+                return ref
+            d = views[ref.name]
+            body = parser.parse(d.view_sql)
+            if not isinstance(body, ast.Select):
+                raise EngineError(
+                    f"view {d.name!r} body is not a plain SELECT")
+            body = self._expand_views(body, depth + 1)
+            if d.view_columns:
+                body = copy.copy(body)
+                body.items = [
+                    ast.SelectItem(it.expr, alias=cn, star=False)
+                    for it, cn in zip(body.items, d.view_columns)]
+            return ast.TableRef(name=f"__view_{d.name}",
+                                alias=ref.alias or ref.name,
+                                subquery=body)
+
+        if sel.table is not None:
+            sel.table = expand_ref(sel.table)
+        sel.joins = [ast.JoinClause(expand_ref(j.table), j.join_type,
+                                    j.on) for j in sel.joins]
+        return sel
+
+    def _exec_create_view(self, c: ast.CreateView,
+                          session: Session) -> Result:
+        import copy
+        from ..catalog import CatalogError, TableDescriptor
+        if c.name in self.store.tables or c.name in self._view_map():
+            if c.if_not_exists:
+                return Result(tag="CREATE VIEW")
+            raise EngineError(f"relation {c.name!r} already exists")
+        if not isinstance(c.select, ast.Select):
+            raise EngineError(
+                "CREATE VIEW body must be a plain SELECT")
+        if c.columns is not None and any(
+                it.star for it in c.select.items):
+            raise EngineError(
+                "view column list requires explicit select items")
+        # validate by executing the body with LIMIT 0 — catches
+        # unknown tables/columns and type errors at DDL time, like the
+        # reference's view dependency check
+        probe = copy.deepcopy(c.select)
+        probe.limit = 0
+        res = self._exec_select(probe, session,
+                                f"(create-view {c.name})")
+        if c.columns is not None and len(c.columns) != len(res.names):
+            raise EngineError(
+                f"view column list has {len(c.columns)} names, "
+                f"SELECT produces {len(res.names)}")
+        try:
+            self.catalog.create_table(TableDescriptor(
+                id=0, name=c.name, view_sql=c.sql,
+                view_columns=list(c.columns or [])))
+        except CatalogError as e:
+            if c.if_not_exists:
+                return Result(tag="CREATE VIEW")
+            raise EngineError(str(e)) from e
+        self._view_defs = None
+        return Result(tag="CREATE VIEW")
+
+    def _exec_drop_view(self, d: ast.DropView) -> Result:
+        if d.name not in self._view_map():
+            if d.if_exists:
+                return Result(tag="DROP VIEW")
+            raise EngineError(f"view {d.name!r} does not exist")
+        self.catalog.drop_table(d.name)
+        self._view_defs = None
+        return Result(tag="DROP VIEW")
+
+    # -- sequences (DDL) ------------------------------------------------------
+    def _exec_create_sequence(self, c: ast.CreateSequence) -> Result:
+        import json as _json
+        key = self.SEQ_PREFIX + c.name.encode()
+
+        def fn(t):
+            if t.get(key) is not None:
+                if c.if_not_exists:
+                    return
+                raise EngineError(
+                    f"sequence {c.name!r} already exists")
+            t.put(key, _json.dumps({
+                "start": c.start, "increment": c.increment,
+                "value": None}).encode())
+        self.kv.txn(fn)
+        return Result(tag="CREATE SEQUENCE")
+
+    def _exec_drop_sequence(self, d: ast.DropSequence) -> Result:
+        key = self.SEQ_PREFIX + d.name.encode()
+
+        def fn(t):
+            if t.get(key) is None:
+                if d.if_exists:
+                    return
+                raise EngineError(
+                    f"sequence {d.name!r} does not exist")
+            t.delete(key)
+        self.kv.txn(fn)
+        return Result(tag="DROP SEQUENCE")
+
+    # -- TRUNCATE -------------------------------------------------------------
+    def _exec_truncate(self, tr: ast.Truncate) -> Result:
+        """Clear all rows + KV pairs + index entries, keep the schema
+        (the reference swaps in fresh empty indexes and lets GC reap
+        the old keyspace, pkg/sql/truncate.go)."""
+        if tr.table not in self.store.tables:
+            raise EngineError(f"table {tr.table!r} does not exist")
+        td = self.store.table(tr.table)
+        schema = td.schema
+        # the whole table keyspace: every index id under the table
+        base = bytearray(K.TABLE_PREFIX)
+        K.encode_int(base, schema.table_id)
+        base = bytes(base)
+        self.kv.txn(lambda t: t.delete_range(base, K.prefix_end(base)))
+        self.store.drop_table(tr.table)
+        self.store.create_table(schema)
+        self._evict(tr.table)
+        return Result(tag="TRUNCATE")
 
     def _maintain_indexes(self, table: str, td, t: Txn, pending: dict,
                           old_row, new_row, rts: int) -> None:
@@ -2214,7 +2562,8 @@ class Engine:
             rows = [self._encode_row(schema, r) for r in rows]
         else:
             cols = ins.columns or schema.column_names
-            binder = Binder(Scope())
+            binder = Binder(Scope(),
+                            sequence_ops=self._sequence_ops(session))
             rows = []
             for row_exprs in ins.rows:
                 if len(row_exprs) != len(cols):
@@ -2369,7 +2718,8 @@ class Engine:
     def _exec_update(self, u: ast.Update, session: Session) -> Result:
         scope, schema = self._dml_scope(u.table)
         td = self.store.table(u.table)
-        binder = Binder(scope)
+        binder = Binder(scope,
+                        sequence_ops=self._sequence_ops(session))
         assigned = {}
         for cname, e in u.assignments:
             col = schema.column(cname)
@@ -2701,6 +3051,33 @@ def _rewrite_table_names(sel, mapping: dict):
 
     fix_select(sel)
     return sel
+
+
+def _stmt_table_refs(node) -> set:
+    """All table names a statement references (FROM/JOIN refs plus
+    expression subqueries and CTE bodies), via a generic dataclass
+    walk — used for view dependency checks at DROP TABLE."""
+    import dataclasses
+    out: set = set()
+    seen: set = set()
+
+    def walk(x):
+        if id(x) in seen:
+            return
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+            return
+        if not dataclasses.is_dataclass(x) or isinstance(x, type):
+            return
+        seen.add(id(x))
+        if isinstance(x, ast.TableRef) and x.subquery is None:
+            out.add(x.name)
+        for f in dataclasses.fields(x):
+            walk(getattr(x, f.name))
+
+    walk(node)
+    return out
 
 
 def split_conjuncts_ast(e: ast.Expr) -> list:

@@ -245,6 +245,51 @@ class ShowIndexes(Statement):
 
 
 @dataclass
+class CreateView(Statement):
+    """CREATE VIEW <name> [(cols)] AS <select>. The view body is
+    stored as SQL text in the descriptor and re-planned (expanded as a
+    derived table) at each use, like the reference's view descriptors
+    (pkg/sql/create_view.go)."""
+    name: str
+    columns: Optional[list] = None
+    select: Optional["Statement"] = None  # parsed body (validation)
+    sql: str = ""                          # body text (persisted)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateSequence(Statement):
+    name: str
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowSequences(Statement):
+    pass
+
+
+@dataclass
+class Truncate(Statement):
+    """TRUNCATE [TABLE] <t>: clear all rows + index entries, keep the
+    schema (pkg/sql/truncate.go swaps in fresh empty indexes)."""
+    table: str
+
+
+@dataclass
 class AlterTable(Statement):
     """ALTER TABLE <t> ADD COLUMN <def> [DEFAULT lit] | DROP COLUMN <c>.
     Executed as an online schema change (jobs/schemachange.py)."""

@@ -163,7 +163,8 @@ def _leap(y: int) -> bool:
 
 class Binder:
     def __init__(self, scope: Scope, subquery_eval=None,
-                 now_micros: Optional[int] = None):
+                 now_micros: Optional[int] = None,
+                 sequence_ops=None):
         self.scope = scope
         # populated by bind_with_aggs
         self.aggs: list[BoundAgg] = []
@@ -175,6 +176,10 @@ class Binder:
         self.subquery_eval = subquery_eval
         # statement timestamp in unix micros for now()/current_date
         self.now_micros = now_micros
+        # sequence_ops(fn, seq_name, arg) -> int: volatile sequence
+        # builtins (nextval/currval/setval), folded to constants at
+        # bind time; None when no engine is attached
+        self.sequence_ops = sequence_ops
         # window function instances (bind_with_windows)
         self.windows: list[BoundWindow] = []
         self._collect_windows = False
@@ -701,6 +706,30 @@ class Binder:
             if not self._collect_aggs:
                 raise BindError(f"aggregate {name} not allowed here")
             return self._bind_agg(e)
+        if name in ("nextval", "currval", "setval"):
+            if self.sequence_ops is None:
+                raise BindError(
+                    f"{name} is not available in this context")
+            if not e.args or not isinstance(e.args[0], ast.Literal) \
+                    or not isinstance(e.args[0].value, str):
+                raise BindError(
+                    f"{name} takes a sequence name string literal")
+            seq = e.args[0].value
+            arg = None
+            if name == "setval":
+                if len(e.args) != 2:
+                    raise BindError("setval(seq, value)")
+                v = self.bind(e.args[1])
+                if not isinstance(v, BConst) or v.value is None:
+                    raise BindError("setval(seq, value) takes a "
+                                    "constant value")
+                try:
+                    arg = int(v.value)
+                except (TypeError, ValueError):
+                    raise BindError(
+                        f"setval value must be an integer, got "
+                        f"{v.value!r}")
+            return BConst(self.sequence_ops(name, seq, arg), INT8)
         if name == "coalesce":
             args = [self.bind(a) for a in e.args]
             rty = next((a.type for a in args

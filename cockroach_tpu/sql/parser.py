@@ -48,6 +48,7 @@ TYPE_NAMES = {
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql  # kept for view-body text capture
         self.toks = lex(sql)
         self.i = 0
 
@@ -137,6 +138,10 @@ class Parser:
                 self.next()
                 self.expect_kw("from")
                 return ast.ShowIndexes(self.expect_ident())
+            if self.peek().kind == Tok.IDENT \
+                    and self.peek().text == "sequences":
+                self.next()
+                return ast.ShowSequences()
             if self.peek().is_kw("create"):
                 self.next()
                 self.expect_kw("table")
@@ -173,6 +178,10 @@ class Parser:
         if t.is_kw("analyze"):
             self.next()
             return ast.Analyze(self.expect_ident())
+        if t.kind == Tok.IDENT and t.text == "truncate":
+            self.next()
+            self.accept_kw("table")
+            return ast.Truncate(self.expect_ident())
         if t.kind in (Tok.IDENT, Tok.KEYWORD) and t.text == "cancel":
             self.next()
             if not (self.peek().kind in (Tok.IDENT, Tok.KEYWORD)
@@ -689,6 +698,52 @@ class Parser:
                                    if_not_exists)
         if unique:
             raise ParseError("expected INDEX after CREATE UNIQUE")
+        if self.peek().kind == Tok.IDENT and self.peek().text == "view":
+            self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            vname = self.expect_ident()
+            cols = None
+            if self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+            self.expect_kw("as")
+            body_start = self.peek().pos
+            sel = self.parse_select_stmt()
+            body = self.sql[body_start:].strip().rstrip(";").strip()
+            return ast.CreateView(vname, cols, sel, body,
+                                  if_not_exists)
+        if self.peek().kind == Tok.IDENT \
+                and self.peek().text == "sequence":
+            self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            sname = self.expect_ident()
+            start, increment = 1, 1
+            while self.peek().kind == Tok.IDENT and \
+                    self.peek().text in ("start", "increment"):
+                which = self.next().text
+                self.accept_kw("with")
+                if self.peek().kind == Tok.IDENT \
+                        and self.peek().text == "by":
+                    self.next()
+                t = self.next()
+                if t.kind != Tok.NUMBER:
+                    raise ParseError(f"expected number after {which}")
+                if which == "start":
+                    start = int(t.text)
+                else:
+                    increment = int(t.text)
+            return ast.CreateSequence(sname, start, increment,
+                                      if_not_exists)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -796,6 +851,16 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return ast.DropIndex(self.expect_ident(), if_exists)
+        if self.peek().kind == Tok.IDENT and self.peek().text in (
+                "view", "sequence"):
+            kind = self.next().text
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            return (ast.DropView(name, if_exists) if kind == "view"
+                    else ast.DropSequence(name, if_exists))
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -71,12 +71,14 @@ def and_all(conjuncts: list[BExpr]) -> BExpr:
 
 class Planner:
     def __init__(self, catalog: CatalogView, subquery_eval=None,
-                 now_micros=None):
+                 now_micros=None, sequence_ops=None):
         self.catalog = catalog
         # engine-supplied hooks: subquery execution + statement
-        # timestamp for now()/current_date (binder.py)
+        # timestamp for now()/current_date + sequence builtins
+        # (binder.py)
         self.subquery_eval = subquery_eval
         self.now_micros = now_micros
+        self.sequence_ops = sequence_ops
 
     def _keys_unique(self, cand_alias: str, cand_table: str, pool,
                      other_side: set, _key_side, scans) -> bool:
@@ -157,7 +159,8 @@ class Planner:
             add_table(j.table)
 
         binder = Binder(scope, subquery_eval=self.subquery_eval,
-                        now_micros=self.now_micros)
+                        now_micros=self.now_micros,
+                        sequence_ops=self.sequence_ops)
 
         # ---- gather predicates ---------------------------------------------
         conjuncts: list[BExpr] = []

@@ -1,0 +1,194 @@
+"""Views, sequences, TRUNCATE, generate_series.
+
+Reference capabilities mirrored: view descriptors re-planned at use
+(pkg/sql/create_view.go), sequences with non-transactional nextval
+(pkg/sql/sequence.go), TRUNCATE swapping in an empty keyspace
+(pkg/sql/truncate.go), and the generate_series SRF (sem/builtins).
+"""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE t (a INT PRIMARY KEY, b INT, s STRING)")
+    e.execute("INSERT INTO t VALUES (1,2,'x'),(2,3,'y'),(3,3,'z')")
+    return e
+
+
+class TestViews:
+    def test_basic_and_join(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a, b FROM t WHERE b > 2")
+        assert sorted(eng.execute("SELECT * FROM v").rows) == \
+            [(2, 3), (3, 3)]
+        assert sorted(eng.execute(
+            "SELECT v.a FROM v JOIN t ON v.a = t.a").rows) == \
+            [(2,), (3,)]
+
+    def test_nested_with_renames(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a, b FROM t WHERE b > 2")
+        eng.execute("CREATE VIEW v2 (x, y) AS SELECT a, b FROM v")
+        assert sorted(eng.execute(
+            "SELECT x FROM v2 WHERE y = 3").rows) == [(2,), (3,)]
+        ddl = eng.execute("SHOW CREATE TABLE v2").rows[0][1]
+        assert ddl == "CREATE VIEW v2 (x, y) AS SELECT a, b FROM v"
+
+    def test_view_sees_new_rows(self, eng):
+        """Views are expanded per use, not materialized at CREATE."""
+        eng.execute("CREATE VIEW v AS SELECT a FROM t WHERE b = 3")
+        eng.execute("INSERT INTO t VALUES (4,3,'w')")
+        assert sorted(eng.execute("SELECT * FROM v").rows) == \
+            [(2,), (3,), (4,)]
+
+    def test_aggregating_view(self, eng):
+        eng.execute("CREATE VIEW agg AS SELECT b, count(*) AS c "
+                    "FROM t GROUP BY b")
+        assert sorted(eng.execute("SELECT * FROM agg").rows) == \
+            [(2, 1), (3, 2)]
+
+    def test_guards(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t")
+        with pytest.raises(EngineError, match="not modifiable"):
+            eng.execute("INSERT INTO t2 VALUES (1)"
+                        if False else "DELETE FROM v")
+        with pytest.raises(EngineError, match="use DROP VIEW"):
+            eng.execute("DROP TABLE v")
+        with pytest.raises(EngineError, match="already exists"):
+            eng.execute("CREATE VIEW v AS SELECT 1")
+        with pytest.raises(Exception, match="nope"):
+            eng.execute("CREATE VIEW bad AS SELECT nope FROM t")
+        eng.execute("DROP VIEW v")
+        with pytest.raises(Exception):
+            eng.execute("SELECT * FROM v")
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("DROP VIEW v")
+        eng.execute("DROP VIEW IF EXISTS v")
+
+    def test_survives_engine_restart_cache(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t WHERE b = 2")
+        eng._view_defs = None  # simulate a fresh SQL pod's cache
+        assert eng.execute("SELECT * FROM v").rows == [(1,)]
+
+
+class TestSequences:
+    def test_nextval_currval_setval(self, eng):
+        eng.execute("CREATE SEQUENCE sq START 5 INCREMENT 2")
+        s = eng.session()
+        assert [eng.execute("SELECT nextval('sq')", s).rows[0][0]
+                for _ in range(3)] == [5, 7, 9]
+        assert eng.execute("SELECT currval('sq')", s).rows[0][0] == 9
+        # currval is session-scoped
+        with pytest.raises(EngineError, match="not yet defined"):
+            eng.execute("SELECT currval('sq')")
+        eng.execute("SELECT setval('sq', 100)", s)
+        assert eng.execute("SELECT nextval('sq')", s).rows[0][0] == 102
+
+    def test_insert_per_row_values(self, eng):
+        eng.execute("CREATE SEQUENCE ids")
+        eng.execute("CREATE TABLE u (a INT PRIMARY KEY, s STRING)")
+        eng.execute("INSERT INTO u VALUES (nextval('ids'),'p'),"
+                    "(nextval('ids'),'q')")
+        assert sorted(eng.execute("SELECT a FROM u").rows) == \
+            [(1,), (2,)]
+
+    def test_nextval_not_rolled_back(self, eng):
+        """Sequence allocation is non-transactional (pg semantics)."""
+        eng.execute("CREATE SEQUENCE sq")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        assert eng.execute("SELECT nextval('sq')", s).rows[0][0] == 1
+        eng.execute("ROLLBACK", s)
+        assert eng.execute("SELECT nextval('sq')").rows[0][0] == 2
+
+    def test_ddl_guards(self, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        with pytest.raises(EngineError, match="already exists"):
+            eng.execute("CREATE SEQUENCE sq")
+        eng.execute("CREATE SEQUENCE IF NOT EXISTS sq")
+        assert eng.execute("SHOW SEQUENCES").rows == [
+            ("sq", 1, 1, None)]
+        eng.execute("DROP SEQUENCE sq")
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("SELECT nextval('sq')")
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("DROP SEQUENCE sq")
+        eng.execute("DROP SEQUENCE IF EXISTS sq")
+
+
+class TestTruncate:
+    def test_truncate_keeps_schema_clears_indexes(self, eng):
+        eng.execute("CREATE UNIQUE INDEX si ON t (s)")
+        eng.execute("TRUNCATE TABLE t")
+        assert eng.execute("SELECT count(*) FROM t").rows == [(0,)]
+        # unique entries cleared with the rows
+        eng.execute("INSERT INTO t VALUES (1,1,'x')")
+        eng.execute("INSERT INTO t VALUES (2,1,'y')")
+        # index still enforced for NEW rows
+        with pytest.raises(EngineError, match="unique index"):
+            eng.execute("INSERT INTO t VALUES (3,1,'x')")
+
+    def test_truncate_missing(self, eng):
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("TRUNCATE TABLE nope")
+
+
+class TestGenerateSeries:
+    def test_basic(self, eng):
+        assert eng.execute("SELECT generate_series(1,4)").rows == \
+            [(1,), (2,), (3,), (4,)]
+
+    def test_step_alias_order_limit(self, eng):
+        r = eng.execute("SELECT generate_series(10,1,-3) AS g "
+                        "ORDER BY g LIMIT 3").rows
+        assert r == [(1,), (4,), (7,)]
+
+    def test_errors(self, eng):
+        with pytest.raises(EngineError, match="step"):
+            eng.execute("SELECT generate_series(1,5,0)")
+
+
+class TestReviewRegressions:
+    def test_cte_shadows_view(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t")
+        r = eng.execute("WITH v AS (SELECT 99 AS x) SELECT * FROM v")
+        assert r.rows == [(99,)]
+
+    def test_explain_view_and_cte(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t WHERE b = 3")
+        plan = "\n".join(r[0] for r in
+                         eng.execute("EXPLAIN SELECT * FROM v").rows)
+        assert "derived v" in plan and "Scan t" in plan
+        plan = "\n".join(r[0] for r in eng.execute(
+            "EXPLAIN WITH w AS (SELECT a FROM t) SELECT * FROM w").rows)
+        assert "cte w" in plan
+
+    def test_prepare_view(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t WHERE b = 2")
+        assert eng.prepare("SELECT * FROM v").run().rows == [(1,)]
+
+    def test_explain_does_not_advance_sequence(self, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        eng.execute("EXPLAIN SELECT nextval('sq') FROM t")
+        assert eng.execute("SELECT nextval('sq')").rows == [(1,)]
+
+    def test_update_with_nextval(self, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        eng.execute("UPDATE t SET b = nextval('sq') WHERE a = 1")
+        assert eng.execute("SELECT b FROM t WHERE a = 1").rows == [(1,)]
+
+    def test_setval_negative_and_bad_value(self, eng):
+        from cockroach_tpu.sql.binder import BindError
+        eng.execute("CREATE SEQUENCE sq")
+        assert eng.execute("SELECT setval('sq', -5)").rows == [(-5,)]
+        with pytest.raises(BindError, match="integer"):
+            eng.execute("SELECT setval('sq', 'abc')")
+
+    def test_drop_table_with_dependent_view(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t")
+        with pytest.raises(EngineError, match="depend"):
+            eng.execute("DROP TABLE t")
+        eng.execute("DROP VIEW v")
+        eng.execute("DROP TABLE t")
