@@ -1466,6 +1466,11 @@ class Engine:
         if not (isinstance(e, ast.FuncCall)
                 and e.name == "generate_series"):
             return None
+        if sel.where is not None or sel.distinct or sel.group_by \
+                or sel.having:
+            raise EngineError(
+                "generate_series supports only ORDER BY/LIMIT/OFFSET "
+                "(materialize it in a CTE for WHERE/GROUP BY)")
         if len(e.args) not in (2, 3):
             raise EngineError("generate_series(start, stop [, step])")
         vals = []
@@ -2083,6 +2088,13 @@ class Engine:
             if d.if_exists:
                 return Result(tag="DROP VIEW")
             raise EngineError(f"view {d.name!r} does not exist")
+        deps = [v for v, vd in self._view_map().items()
+                if v != d.name and d.name in _stmt_table_refs(
+                    parser.parse(vd.view_sql))]
+        if deps:
+            raise EngineError(
+                f"cannot drop view {d.name!r}: view(s) "
+                f"{sorted(deps)} depend on it")
         self.catalog.drop_table(d.name)
         self._view_defs = None
         return Result(tag="DROP VIEW")
@@ -2553,6 +2565,13 @@ class Engine:
         td = self.store.table(ins.table)
         schema = td.schema
         if ins.select is not None:
+            if _contains_func(ins.select, "nextval"):
+                # the select binds nextval ONCE, which would hand every
+                # produced row the same value (pg allocates per row);
+                # reject instead of silently corrupting keys
+                raise EngineError(
+                    "nextval inside INSERT ... SELECT is not "
+                    "supported; insert explicit VALUES instead")
             # cache key must identify the inner select (repr is stable
             # and content-based for the AST dataclasses)
             src = self._exec_select(ins.select, session,
@@ -2723,6 +2742,21 @@ class Engine:
         assigned = {}
         for cname, e in u.assignments:
             col = schema.column(cname)
+            # nextval is volatile and must allocate PER ROW (pg
+            # semantics): a bare nextval('s') assignment allocates in
+            # the row loop below; nextval nested inside a larger
+            # expression would fold to one shared value — reject it
+            if isinstance(e, ast.FuncCall) and e.name == "nextval" \
+                    and len(e.args) == 1 \
+                    and isinstance(e.args[0], ast.Literal):
+                self._seq_desc(e.args[0].value)  # must exist
+                assigned[cname] = ("seq", e.args[0].value)
+                continue
+            if _contains_func(e, "nextval"):
+                raise EngineError(
+                    "nextval may only be the entire SET expression "
+                    "(per-row allocation); fold it into a bare "
+                    "nextval('seq') assignment")
             b = binder.bind(e)
             if isinstance(b, BConst) and isinstance(b.value, str) \
                     and col.type.family == Family.STRING:
@@ -2745,7 +2779,13 @@ class Engine:
                 cn = c.name
                 if cn in assigned:
                     kind, v = assigned[cn]
-                    if kind == "const":
+                    if kind == "seq":
+                        # placeholder; allocated per row in the todo
+                        # loop (volatile, must not fold per chunk)
+                        data[cn] = np.zeros(len(idx),
+                                            dtype=c.type.np_dtype)
+                        valid[cn] = np.ones(len(idx), dtype=bool)
+                    elif kind == "const":
                         if v is None:
                             data[cn] = np.zeros(len(idx), dtype=c.type.np_dtype)
                             valid[cn] = np.zeros(len(idx), dtype=bool)
@@ -2795,6 +2835,10 @@ class Engine:
                                 int(data[cn][j])]
                         else:
                             new[cn] = data[cn][j].item()
+                    for cn, kv in assigned.items():
+                        if kv[0] == "seq":
+                            new[cn] = self._sequence_op(
+                                session, "nextval", kv[1], None)
                     todo.append((old, new))
             pending = self._txn_key_state(effects, u.table)
             for old, new in todo:
@@ -3051,6 +3095,31 @@ def _rewrite_table_names(sel, mapping: dict):
 
     fix_select(sel)
     return sel
+
+
+def _contains_func(node, fname: str) -> bool:
+    """Does any expression under `node` call function `fname`?
+    Generic dataclass walk (volatile-function detection)."""
+    import dataclasses
+    found = [False]
+
+    def walk(x):
+        if found[0]:
+            return
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+            return
+        if not dataclasses.is_dataclass(x) or isinstance(x, type):
+            return
+        if isinstance(x, ast.FuncCall) and x.name == fname:
+            found[0] = True
+            return
+        for f in dataclasses.fields(x):
+            walk(getattr(x, f.name))
+
+    walk(node)
+    return found[0]
 
 
 def _stmt_table_refs(node) -> set:

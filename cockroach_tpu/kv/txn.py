@@ -42,6 +42,10 @@ class KVStore:
         self.tscache = TimestampCache()
         self.txns = TxnRegistry()
         self.clock = clock or Clock()
+        # async batch intent cleanup (intentresolver analogue); the
+        # sweep is driven by the node maintenance loop
+        from .intentresolver import IntentResolver
+        self.intent_resolver = IntentResolver(self)
 
 
 class Txn:

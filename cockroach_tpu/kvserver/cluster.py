@@ -22,6 +22,7 @@ from cockroach_tpu.kvserver.store import (EngineKey, Lease, RangeDescriptor,
                                           Replica, Store, _enc_ts)
 from cockroach_tpu.kvserver.transport import LocalTransport
 from cockroach_tpu.storage.hlc import Clock
+from cockroach_tpu.utils.circuit import Breaker, BreakerTrippedError
 
 
 class AmbiguousResultError(RuntimeError):
@@ -54,6 +55,11 @@ class Cluster:
         self.descriptors: dict[int, RangeDescriptor] = {}
         self.down: set[int] = set()
         self._next_range_id = 1
+        # per-range circuit breakers on the data path (the analogue of
+        # per-replica breakers, replica_circuit_breaker.go): an
+        # unavailable range fails fast instead of hanging each request
+        # through the full proposal retry loop
+        self.breakers: dict[int, Breaker] = {}
         for node_id in range(1, n_nodes + 1):
             self.stores[node_id] = Store(node_id, self.transport,
                                          clock=self.clock,
@@ -84,16 +90,35 @@ class Cluster:
     # ------------------------------------------------------------------
     # pump (the scheduler: ticks, ready handling, message delivery)
     # ------------------------------------------------------------------
+    def _decommissioned(self, nid: int) -> bool:
+        rec = self.liveness.records.get(nid)
+        return rec is not None and rec.decommissioning
+
     def _can_heartbeat(self, nid: int) -> bool:
         """Liveness records live in a replicated system range; a node
         that cannot reach a quorum of the cluster cannot write its
-        heartbeat (so partitioned nodes lapse, like the reference)."""
-        n = len(self.stores)
+        heartbeat (so partitioned nodes lapse, like the reference).
+        Decommissioned nodes are out of the membership entirely."""
+        if self._decommissioned(nid):
+            return False  # out of the cluster: no more heartbeats
+        members = [p for p in self.stores
+                   if not self._decommissioned(p)]
+        n = len(members)
         reachable = 1 + sum(
-            1 for p in self.stores
+            1 for p in members
             if p != nid and p not in self.down
             and not self.transport._blocked(nid, p))
         return reachable > n // 2
+
+    def decommission(self, node_id: int) -> None:
+        """Permanently remove a (dead) node from the cluster membership
+        (the operator's `node decommission`): it stops counting toward
+        the liveness-write majority and can never hold leases again —
+        the prerequisite for loss-of-quorum recovery when a majority of
+        nodes is gone for good."""
+        rec = self.liveness.records.get(node_id)
+        if rec is not None:
+            rec.decommissioning = True
 
     def pump(self, iterations: int = 1) -> None:
         for _ in range(iterations):
@@ -175,6 +200,13 @@ class Cluster:
             if node_id in desc.replicas and \
                     desc.range_id not in store.replicas:
                 store.create_replica(desc)
+        # replicaGC husks: ranges whose config moved on while the node
+        # was down (e.g. loss-of-quorum recovery excluded it) — the
+        # meta descriptor is authoritative
+        for rid in [rid for rid, r in store.replicas.items()
+                    if rid in self.descriptors
+                    and node_id not in self.descriptors[rid].replicas]:
+            store.remove_replica(rid)
 
     # ------------------------------------------------------------------
     # range lifecycle (split/merge queues + replicate queue/allocator)
@@ -231,9 +263,14 @@ class Cluster:
             "kind": "split", "key": key.decode("latin1"),
             "new_range_id": new_id,
         })
+        # mirror _apply_split's generation bumps so the cluster-side
+        # descriptors stay in sync with the replicas' state machines
+        # (change_replicas' stale-config guard compares generations)
         self.descriptors[new_id] = RangeDescriptor(
-            new_id, key, lhs.end_key, list(lhs.replicas))
+            new_id, key, lhs.end_key, list(lhs.replicas),
+            generation=lhs.generation + 1)
         lhs.end_key = key
+        lhs.generation += 1
         return self.descriptors[new_id]
 
     def merge_ranges(self, lhs_range_id: int) -> RangeDescriptor:
@@ -271,6 +308,7 @@ class Cluster:
             "rhs_state": rhs_state,
         })
         lhs.end_key = rhs.end_key
+        lhs.generation += 1  # mirror _apply_merge's bump
         del self.descriptors[rhs.range_id]
         return lhs
 
@@ -309,17 +347,21 @@ class Cluster:
                 raise RuntimeError(
                     f"r{range_id}: lease transfer to n{target} did not "
                     "apply")
+        newgen = desc.generation + 1
         if add is not None:
             # materialize the learner replica before the config commits
-            # so it can receive raft traffic (snapshot-before-voter)
+            # so it can receive raft traffic (snapshot-before-voter);
+            # it is born at the NEW generation so log replay of older
+            # config changes cannot remove it
             self.stores[add].create_replica(
                 RangeDescriptor(range_id, desc.start_key, desc.end_key,
-                                list(new), desc.generation + 1))
+                                list(new), newgen))
         self._propose_admin(range_id, {
             "kind": "change_replicas", "replicas": new,
+            "generation": newgen,
         })
         desc.replicas = new
-        desc.generation += 1
+        desc.generation = newgen
         if remove is not None and remove in self.stores:
             # replicaGC-queue analogue: the removed node stops getting
             # raft traffic before it can apply its own removal, so the
@@ -429,26 +471,115 @@ class Cluster:
         return None
 
     # ------------------------------------------------------------------
+    # circuit breakers + loss-of-quorum recovery
+    # ------------------------------------------------------------------
+    def breaker(self, range_id: int) -> Breaker:
+        b = self.breakers.get(range_id)
+        if b is None:
+            b = Breaker(f"r{range_id}", threshold=1,
+                        probe=lambda: self._probe_range(range_id))
+            self.breakers[range_id] = b
+        return b
+
+    def _probe_range(self, range_id: int) -> bool:
+        """Breaker probe: can the range commit a no-op quickly? Bounded
+        pump budget — orders of magnitude cheaper than the data path's
+        own retry loop (the reference's probe proposes a lease/noop,
+        replica_circuit_breaker.go sendProbe)."""
+        desc = self.descriptors.get(range_id)
+        if desc is None:
+            return True
+        lh = self.leaseholder(range_id)
+        if lh is None:
+            for nid in desc.replicas:
+                if nid not in self.down and \
+                        self.acquire_lease(range_id, nid, max_iter=25):
+                    lh = nid
+                    break
+        if lh is None:
+            return False
+        rep = self.stores[lh].replicas[range_id]
+        out = {}
+        if not rep.propose({"kind": "batch", "ops": []},
+                           lambda r: out.setdefault("ok", True)):
+            return False
+        return self.pump_until(lambda: "ok" in out, 25)
+
+    def loq_recover(self, range_id: Optional[int] = None) -> list[str]:
+        """Loss-of-quorum recovery (pkg/kv/kvserver/loqrecovery): for
+        each range whose live replicas cannot form a quorum, rewrite
+        the replica set down to the most-advanced live survivor, which
+        then serves alone (and the replicate queue re-replicates).
+        Accepts losing writes the survivor never saw — run only when
+        the dead nodes are really gone, like the reference's
+        ``debug recover`` plan/apply flow."""
+        actions = []
+        targets = ([self.descriptors[range_id]] if range_id is not None
+                   else list(self.descriptors.values()))
+        for desc in targets:
+            live = [n for n in desc.replicas if n not in self.down]
+            if len(live) > len(desc.replicas) // 2:
+                continue  # quorum intact; nothing to recover
+            if not live:
+                actions.append(
+                    f"r{desc.range_id}: unrecoverable (no live replica)")
+                continue
+            best = max(live, key=lambda n: (
+                self.stores[n].replicas[desc.range_id].applied_index,
+                self.stores[n].replicas[desc.range_id].raft.term))
+            dead = sorted(n for n in desc.replicas if n not in live)
+            rep = self.stores[best].replicas[desc.range_id]
+            # replicaGC the other live minority members NOW: a stale
+            # survivor (e.g. the old leaseholder) must not keep
+            # serving the range beside the recovered one (split brain)
+            for n in live:
+                if n != best:
+                    self.stores[n].remove_replica(desc.range_id)
+            desc.replicas = [best]
+            desc.generation += 1
+            rep.desc.replicas = [best]
+            rep.raft.update_membership([best])
+            # NOTE: the lease record is left untouched — it is part of
+            # the replicated state machine, and acquire_lease already
+            # treats a holder outside desc.replicas as fenced; mutating
+            # it here would diverge the survivor from later learners
+            # replaying the log
+            self.breakers.pop(desc.range_id, None)
+            actions.append(
+                f"r{desc.range_id}: reset to survivor n{best} "
+                f"(lost {dead})")
+        return actions
+
+    # ------------------------------------------------------------------
     # KV client API (simple router; DistSender supersedes this)
     # ------------------------------------------------------------------
     def _leaseholder_replica(self, key: bytes) -> Replica:
         desc = self.range_for_key(key)
         if desc is None:
             raise KeyError(f"no range for key {key!r}")
+        b = self.breaker(desc.range_id)
+        b.check()
         lh = self.ensure_lease(desc.range_id)
         if lh is None:
+            b.report_failure()
             raise RuntimeError(f"r{desc.range_id}: no leaseholder "
                                "(quorum lost?)")
         return self.stores[lh].replicas[desc.range_id]
 
     def put(self, key: bytes, value: bytes, max_iter: int = 500) -> None:
         rep = self._leaseholder_replica(key)
+        b = self.breaker(rep.desc.range_id)
         cmd = {"kind": "batch", "ops": [{
             "op": "put", "key": key.decode("latin1"),
             "value": value.decode("latin1"),
             "ts": _enc_ts(self.clock.now()),
         }]}
-        self.propose_and_wait(rep, cmd, max_iter)
+        try:
+            self.propose_and_wait(rep, cmd, max_iter)
+        except (RuntimeError, AmbiguousResultError):
+            b.report_failure()
+            raise
+        b.report_success()
 
     def get(self, key: bytes) -> Optional[bytes]:
         rep = self._leaseholder_replica(key)

@@ -360,9 +360,19 @@ class Replica:
         return self.desc
 
     def _apply_change_replicas(self, cmd: dict) -> RangeDescriptor:
+        gen = cmd.get("generation")
+        if gen is not None and gen <= self.desc.generation:
+            # stale config from log replay: a learner created at
+            # generation G starts with the config of its own addition;
+            # replaying an older change (e.g. one that predates its
+            # membership) must not remove it (the reference seeds new
+            # replicas via snapshot at a log position, so they never
+            # see pre-membership entries)
+            return self.desc
         new_replicas = list(cmd["replicas"])
         self.desc.replicas = new_replicas
-        self.desc.generation += 1
+        self.desc.generation = (gen if gen is not None
+                                else self.desc.generation + 1)
         if self.store.node_id not in new_replicas:
             self.store.remove_replica(self.desc.range_id)
         else:

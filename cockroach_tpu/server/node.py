@@ -210,6 +210,13 @@ class Node:
                         self.engine.run_gc(name)
                     except Exception:
                         pass
+                try:
+                    # abandoned-intent sweep (intentresolver analogue):
+                    # clears intents of crashed coordinators so reads
+                    # never pay a push for them
+                    self.engine.kv.store.intent_resolver.clean_span()
+                except Exception:
+                    pass
 
         self._maint_thread = threading.Thread(target=loop, daemon=True)
         self._maint_thread.start()

@@ -1,0 +1,62 @@
+"""Circuit breakers: fail fast on unavailable resources.
+
+The analogue of pkg/util/circuit (probe-driven breakers) as used by
+per-replica breakers (kvserver/replica_circuit_breaker.go): once a
+resource reports enough consecutive failures the breaker trips, and
+every subsequent check fails fast with BreakerTrippedError instead of
+hanging a full timeout — until a (cheap) probe succeeds and resets it.
+
+The reference probes from a background goroutine; this deterministic
+harness probes inline at check time, which keeps the fail-fast
+property (a probe is bounded and much cheaper than the operation's
+own retry loop) without background threads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class BreakerTrippedError(RuntimeError):
+    """The resource is unavailable; the operation was not attempted."""
+
+
+class Breaker:
+    def __init__(self, name: str, threshold: int = 1,
+                 probe: Optional[Callable[[], bool]] = None):
+        self.name = name
+        self.threshold = threshold
+        self.probe = probe
+        self.failures = 0      # consecutive
+        self.tripped = False
+        self.trip_count = 0    # total trips (metrics)
+
+    def check(self) -> None:
+        """Raise BreakerTrippedError if tripped and the probe cannot
+        demonstrate recovery; no-op when healthy."""
+        if not self.tripped:
+            return
+        if self.probe is not None:
+            try:
+                ok = self.probe()
+            except Exception:
+                ok = False
+            if ok:
+                self.reset()
+                return
+        raise BreakerTrippedError(
+            f"{self.name}: breaker tripped (probe failed; "
+            f"{self.failures} consecutive failures)")
+
+    def report_failure(self) -> None:
+        self.failures += 1
+        if self.failures >= self.threshold and not self.tripped:
+            self.tripped = True
+            self.trip_count += 1
+
+    def report_success(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.failures = 0
+        self.tripped = False

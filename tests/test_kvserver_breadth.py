@@ -1,0 +1,228 @@
+"""KV-server breadth: circuit breakers, loss-of-quorum recovery,
+async intent resolution.
+
+Reference analogues: per-replica circuit breakers
+(kvserver/replica_circuit_breaker.go + pkg/util/circuit),
+loss-of-quorum recovery (kvserver/loqrecovery), and the intent
+resolver (kvserver/intentresolver/intent_resolver.go:132).
+"""
+
+import time
+
+import pytest
+
+from cockroach_tpu.kv.txn import DB, KVStore, Txn
+from cockroach_tpu.kvserver.cluster import Cluster
+from cockroach_tpu.utils.circuit import Breaker, BreakerTrippedError
+
+
+def make_range(c: Cluster):
+    d = c.create_range(b"a", b"z")
+    c.pump_until(lambda: c.ensure_lease(d.range_id) is not None, 200)
+    return d
+
+
+class TestBreaker:
+    def test_unit(self):
+        state = {"ok": False}
+        b = Breaker("x", threshold=2, probe=lambda: state["ok"])
+        b.check()  # healthy: no-op
+        b.report_failure()
+        b.check()  # below threshold
+        b.report_failure()
+        with pytest.raises(BreakerTrippedError):
+            b.check()
+        assert b.trip_count == 1
+        state["ok"] = True
+        b.check()  # probe succeeds -> reset
+        assert not b.tripped
+
+    def test_range_fails_fast_and_recovers(self):
+        c = Cluster(n_nodes=3)
+        d = make_range(c)
+        c.put(b"k", b"v1")
+        # lose quorum
+        lh = c.leaseholder(d.range_id)
+        victims = [n for n in d.replicas if n != lh][:2]
+        for n in victims:
+            c.stop_node(n)
+        c.pump(40)  # liveness lapses
+        with pytest.raises(RuntimeError):
+            c.put(b"k", b"v2")  # slow path: full retry loop, trips
+        assert c.breaker(d.range_id).tripped
+        # now fail fast: the breaker check raises before any proposal
+        with pytest.raises(BreakerTrippedError):
+            c.put(b"k", b"v3")
+        with pytest.raises(BreakerTrippedError):
+            c.get(b"k")
+        # recovery: nodes return, probe resets the breaker inline
+        for n in victims:
+            c.restart_node(n)
+        c.pump(30)
+        c.put(b"k", b"v4")
+        assert not c.breaker(d.range_id).tripped
+        assert c.get(b"k") == b"v4"
+
+
+class TestLoqRecovery:
+    def test_recover_after_permanent_loss(self):
+        """The full operator flow: a majority of a range's replicas die
+        for good -> decommission the dead nodes -> loq_recover resets
+        the range to its most-advanced survivor -> the replicate queue
+        re-replicates onto spare nodes."""
+        c = Cluster(n_nodes=5)
+        d = c.create_range(b"a", b"z", replicas=[1, 2, 3])
+        c.pump_until(lambda: c.ensure_lease(d.range_id) is not None,
+                     200)
+        c.put(b"k1", b"v1")
+        c.put(b"k2", b"v2")
+        # make sure every replica applied, then kill two permanently
+        c.pump(20)
+        lh = c.leaseholder(d.range_id)
+        victims = [n for n in d.replicas if n != lh][:2]
+        for n in victims:
+            c.stop_node(n)
+        c.pump(40)
+        with pytest.raises(RuntimeError):
+            c.put(b"k3", b"v3")
+        for n in victims:
+            c.decommission(n)
+        actions = c.loq_recover()
+        assert len(actions) == 1 and "reset to survivor" in actions[0]
+        assert d.replicas == [lh]
+        # the survivor serves reads and writes again
+        assert c.pump_until(
+            lambda: c.ensure_lease(d.range_id) is not None, 300)
+        assert c.get(b"k1") == b"v1"
+        c.put(b"k3", b"v3")
+        assert c.get(b"k3") == b"v3"
+        # replicate queue restores the replication factor on the
+        # remaining healthy nodes (one change per range per pass)
+        for _ in range(3):
+            c.replicate_queue_scan(target=3)
+            c.pump(30)
+        assert sorted(d.replicas) == sorted({lh, 4, 5})
+
+        def caught_up():
+            reps = [c.stores[n].replicas[d.range_id]
+                    for n in d.replicas]
+            return len({r.applied_index for r in reps}) == 1
+        assert c.pump_until(caught_up, 300)
+        c.check_replica_consistency(d.range_id)
+
+    def test_quorum_intact_is_noop(self):
+        c = Cluster(n_nodes=3)
+        d = make_range(c)
+        c.stop_node([n for n in d.replicas
+                     if n != c.leaseholder(d.range_id)][0])
+        assert c.loq_recover() == []
+        assert len(d.replicas) == 3
+
+
+class TestIntentResolver:
+    def test_abandoned_intents_cleaned(self):
+        db = DB()
+        store = db.store
+        # a coordinator that dies mid-txn: intents left behind
+        t = Txn(store)
+        t.put(b"x", b"1")
+        t.put(b"y", b"2")
+        # simulate crash: no rollback, no heartbeat; expire the record
+        rec = store.txns.get(t.meta.id)
+        rec.last_heartbeat = time.monotonic() - 10.0
+        n = store.intent_resolver.clean_span()
+        assert n == 2
+        # reads see no intents and no values (txn aborted)
+        assert db.get(b"x") is None
+        assert db.get(b"y") is None
+
+    def test_committed_intents_resolve(self):
+        """Intents whose txn committed (record still present) resolve
+        to the committed value."""
+        db = DB()
+        store = db.store
+        t = Txn(store)
+        t.put(b"x", b"1")
+        # commit the record but skip intent resolution + removal
+        # (crash between EndTxn and resolution — the recovery window)
+        from cockroach_tpu.storage.mvcc import TxnStatus
+        store.txns.end(t.meta.id, TxnStatus.COMMITTED,
+                       commit_ts=t.meta.write_ts)
+        n = store.intent_resolver.clean_span()
+        assert n == 1
+        assert db.get(b"x") == b"1"
+
+    def test_live_txn_intents_left_alone(self):
+        db = DB()
+        store = db.store
+        t = Txn(store)
+        t.put(b"x", b"1")
+        assert store.intent_resolver.clean_span() == 0
+        t.commit()
+        assert db.get(b"x") == b"1"
+
+    def test_queue_batching(self):
+        db = DB()
+        store = db.store
+        txns = []
+        for i in range(5):
+            t = Txn(store)
+            t.put(f"k{i}".encode(), b"v")
+            store.txns.get(t.meta.id).last_heartbeat = \
+                time.monotonic() - 10.0
+            txns.append(t)
+        n = store.intent_resolver.clean_span()
+        assert n == 5
+        assert store.intent_resolver.resolved_total == 5
+
+
+class TestConfigGenerationSync:
+    def test_change_replicas_after_split(self):
+        """Membership changes must keep working after splits: the
+        stale-config guard compares generations, which split/merge
+        also bump (review regression)."""
+        c = Cluster(n_nodes=4)
+        d = c.create_range(b"a", b"z", replicas=[1, 2, 3])
+        c.pump_until(lambda: c.ensure_lease(d.range_id) is not None,
+                     200)
+        c.put(b"b", b"1")
+        c.put(b"m", b"2")
+        c.split_range(b"m")
+        c.change_replicas(d.range_id, add=4)
+        c.change_replicas(d.range_id, remove=3)
+        c.pump(30)
+        # the new voter really joined: node 3 gone, node 4 applies
+        assert sorted(d.replicas) == [1, 2, 4]
+        rep4 = c.stores[4].replicas[d.range_id]
+        assert c.pump_until(lambda: rep4.applied_index > 0, 200)
+        assert c.get(b"b") == b"1"
+        c.put(b"b", b"3")
+        assert c.get(b"b") == b"3"
+
+    def test_loq_removes_stale_live_minority(self):
+        """A live minority replica that is NOT the chosen survivor is
+        replicaGC'd so it cannot keep serving (split brain)."""
+        c = Cluster(n_nodes=5)
+        d = c.create_range(b"a", b"z", replicas=[1, 2, 3, 4, 5])
+        c.pump_until(lambda: c.ensure_lease(d.range_id) is not None,
+                     200)
+        c.put(b"k", b"v")
+        c.pump(20)
+        for n in (3, 4, 5):
+            c.stop_node(n)
+            c.decommission(n)
+        c.pump(40)
+        c.loq_recover()
+        assert len(d.replicas) == 1
+        survivor = d.replicas[0]
+        other = 1 if survivor == 2 else 2
+        assert d.range_id not in c.stores[other].replicas
+        assert c.pump_until(
+            lambda: c.ensure_lease(d.range_id) is not None, 300)
+        assert c.leaseholder(d.range_id) == survivor
+
+    def test_decommissioned_node_cannot_heartbeat(self):
+        c = Cluster(n_nodes=3)
+        c.decommission(3)
+        c.pump(40)
+        assert not c.liveness.is_live(3)

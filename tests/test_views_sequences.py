@@ -192,3 +192,30 @@ class TestReviewRegressions:
             eng.execute("DROP TABLE t")
         eng.execute("DROP VIEW v")
         eng.execute("DROP TABLE t")
+
+    def test_nextval_per_row_update(self, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        eng.execute("UPDATE t SET b = nextval('sq')")
+        vals = sorted(r[0] for r in
+                      eng.execute("SELECT b FROM t").rows)
+        assert vals == [1, 2, 3]
+
+    def test_nextval_in_expressions_rejected(self, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        with pytest.raises(EngineError, match="nextval"):
+            eng.execute("UPDATE t SET b = nextval('sq') + 1")
+        eng.execute("CREATE TABLE u (a INT PRIMARY KEY)")
+        with pytest.raises(EngineError, match="nextval"):
+            eng.execute("INSERT INTO u SELECT nextval('sq') FROM t")
+
+    def test_drop_view_with_dependent_view(self, eng):
+        eng.execute("CREATE VIEW v AS SELECT a FROM t")
+        eng.execute("CREATE VIEW v2 AS SELECT a FROM v")
+        with pytest.raises(EngineError, match="depend"):
+            eng.execute("DROP VIEW v")
+        eng.execute("DROP VIEW v2")
+        eng.execute("DROP VIEW v")
+
+    def test_generate_series_rejects_where(self, eng):
+        with pytest.raises(EngineError, match="generate_series"):
+            eng.execute("SELECT generate_series(1,4) WHERE 1 = 0")
