@@ -106,6 +106,53 @@ def run(rows_by_query, pipeline, repeats, tag=""):
     return results, rows_used
 
 
+def run_ssb(rows, pipeline, repeats):
+    """SSB full flight (BASELINE.md config 4): star-schema joins.
+    Reports per-query pipelined throughput plus the flight rate
+    (total lineorder rows scanned / total time)."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.workload import ssb
+
+    eng = Engine()
+    t0 = time.time()
+    ssb.load(eng, sf=rows / ssb.LINEORDER_PER_SF, rows=rows)
+    print(f"# ssb datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    per = {}
+    total_t = 0.0
+    for name, sql in ssb.QUERIES.items():
+        eng.drop_device_cache()
+        rps, lat, warm_s, rates = bench_query(eng, sql, rows,
+                                              pipeline, repeats)
+        per[name.replace(".", "_")] = rps
+        total_t += rows / rps
+        print(f"# ssb {name}: rows_per_sec={rps:.3e} "
+              f"median_latency_s={lat:.4f} warmup_s={warm_s:.1f}",
+              file=sys.stderr)
+    flight = rows * len(ssb.QUERIES) / total_t
+    return flight, per
+
+
+def run_ycsb_e(records, steps):
+    """YCSB-E (BASELINE.md config 5): 95% short MVCC range scans with
+    predicate pushdown + 5% inserts, served by the host-side ordered
+    index-range fastpath (no per-literal XLA compiles)."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.workload.ycsb import YCSB
+
+    eng = Engine()
+    w = YCSB(eng, workload="E", records=records, seed=1)
+    t0 = time.time()
+    w.setup()
+    print(f"# ycsb-e setup_s={time.time() - t0:.1f} "
+          f"records={records}", file=sys.stderr)
+    w.run(steps=min(100, steps))  # warm plan/locator caches
+    out = w.run(steps=steps)
+    print(f"# ycsb-e: ops_per_sec={out['ops_per_sec']:.0f} "
+          f"ops={out['ops']}", file=sys.stderr)
+    return out["ops_per_sec"]
+
+
 def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
               mode: str = "tpu_child"):
     """One query/measurement in its own subprocess: a fresh backend
@@ -164,6 +211,24 @@ def main():
             if mode.startswith("tpu") else {})
     rows_by_query = {q: min(rows, caps.get(q, rows)) for q in queries}
 
+    if mode == "ssb_child":
+        flight, per = run_ssb(rows, pipeline,
+                              max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "ssb_flight_rows_per_sec",
+            "value": round(flight), "unit": "rows/s", "rows": rows,
+            **{f"ssb_{w}_rows_per_sec": round(r)
+               for w, r in per.items()},
+        }))
+        return
+    if mode == "ycsb_child":
+        ops = run_ycsb_e(
+            int(os.environ.get("BENCH_YCSB_RECORDS", 20000)),
+            int(os.environ.get("BENCH_YCSB_STEPS", 2000)))
+        print(json.dumps({
+            "metric": "ycsb_e_ops_per_sec", "value": round(ops),
+            "unit": "ops/s"}))
+        return
     if mode in ("cpu", "tpu_child"):
         # leaf mode: measure in-process and emit one JSON line
         tag = "cpu " if mode == "cpu" else ""
@@ -223,6 +288,21 @@ def main():
         out["cpu_rows"] = cpu.get("rows")
         if cpu["value"] and cpu_query == primary:
             out["vs_cpu"] = round(results[primary] / cpu["value"], 3)
+
+    # the rest of the BASELINE.md bench ladder: SSB star-schema joins
+    # (config 4) + YCSB-E range scans (config 5)
+    if os.environ.get("BENCH_SSB", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_SSB_ROWS", 1 << 21)),
+                      "flight", child_timeout, mode="ssb_child")
+        if r is not None:
+            out["ssb_flight_rows_per_sec"] = r["value"]
+            out["ssb_rows"] = r["rows"]
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("ssb_q")})
+    if os.environ.get("BENCH_YCSB", "1") != "0":
+        r = run_child(0, "ycsb_e", 900, mode="ycsb_child")
+        if r is not None:
+            out["ycsb_e_ops_per_sec"] = r["value"]
     print(json.dumps(out))
 
 

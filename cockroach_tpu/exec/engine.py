@@ -489,7 +489,7 @@ class Engine:
             if isinstance(target, ast.Select):
                 m = self._index_fastpath_match(target, session)
                 if m is not None:
-                    label, cols, vals = m
+                    label, cols, vals, _residual = m
                     # mirror the runtime selectivity guard when a warm
                     # locator exists; never BUILD one here — EXPLAIN
                     # must stay metadata-only (no O(table) work)
@@ -1047,6 +1047,15 @@ class Engine:
                     "sql.select.index_fastpath",
                     "SELECTs served by the index point-read path").inc()
                 return res
+        rmatch = self._range_fastpath_match(sel, session)
+        if rmatch is not None:
+            res = self._exec_range_fastpath(sel, session, rmatch)
+            if res is not None:
+                self.metrics.counter(
+                    "sql.select.range_fastpath",
+                    "SELECTs served by the ordered index-range "
+                    "path").inc()
+                return res
         return self._prepare_select(sel, session, sql_text).run()
 
     def _dml_index_candidates(self, table: str, where,
@@ -1065,7 +1074,7 @@ class Engine:
         match = self._index_fastpath_match(probe, session)
         if match is None:
             return None
-        _label, cols, vals = match
+        _label, cols, vals, _residual = match
         sec = self.store.ensure_secondary_index(table, cols)
         return {ci for ci, _ri in sec.get(vals, [])}
 
@@ -1077,11 +1086,10 @@ class Engine:
     # colfetcher point lookups through DistSender), where a point read
     # touches one range instead of streaming the table.
 
-    def _index_fastpath_match(self, sel: ast.Select, session: Session):
-        """Return (label, cols, vals) when this SELECT is an equality
-        lookup covering all columns of a usable index: single table,
-        projection-only items, conjunctive WHERE with constant
-        equalities. None = use the compiled scan path."""
+    def _fastpath_shape(self, sel: ast.Select, session: Session):
+        """Common structural gate for host-side index fastpaths:
+        single stored table, projection-only items. Returns
+        (tname, schema, visible, projected) or None."""
         if (sel.table is None or sel.joins or sel.group_by
                 or sel.having or sel.distinct or sel.ctes):
             return None
@@ -1106,6 +1114,17 @@ class Engine:
                     and e.name in visible):
                 return None
             projected.add(item.alias or e.name)
+        return (tname, schema, visible, projected)
+
+    def _index_fastpath_match(self, sel: ast.Select, session: Session):
+        """Return (label, cols, vals) when this SELECT is an equality
+        lookup covering all columns of a usable index: single table,
+        projection-only items, conjunctive WHERE with constant
+        equalities. None = use the compiled scan path."""
+        shape = self._fastpath_shape(sel, session)
+        if shape is None:
+            return None
+        tname, schema, visible, projected = shape
         for ob in sel.order_by:
             if not (isinstance(ob.expr, ast.ColumnRef)
                     and ob.expr.name in projected):
@@ -1113,7 +1132,9 @@ class Engine:
         if sel.where is None:
             return None
         eq: dict[str, object] = {}
-        for c in split_conjuncts_ast(sel.where):
+        eq_conjs: dict[str, object] = {}
+        conjs = split_conjuncts_ast(sel.where)
+        for c in conjs:
             if not (isinstance(c, ast.BinOp) and c.op == "="):
                 continue
             lhs, rhs = c.left, c.right
@@ -1127,6 +1148,7 @@ class Engine:
                     and rhs.value is not None
                     and lhs.name not in eq):
                 eq[lhs.name] = rhs
+                eq_conjs[lhs.name] = c
         if not eq:
             return None
         # candidate indexes, best first: primary, unique, non-unique
@@ -1142,28 +1164,24 @@ class Engine:
         for label, cols, _rank in cands:
             if not all(cn in eq for cn in cols):
                 continue
-            binder = Binder(Scope())
             vals = []
             ok = True
             for cn in cols:
-                col = schema.column(cn)
-                try:
-                    b = binder.bind(eq[cn])
-                    v = binder._const_to(b, col.type).value
-                except Exception:
-                    ok = False
-                    break
+                v = self._coerce_index_literal(schema.column(cn),
+                                               eq[cn])
                 if v is None:
                     ok = False
                     break
                 vals.append(v)
             if ok:
-                return (label, cols, tuple(vals))
+                consumed = {id(eq_conjs[cn]) for cn in cols}
+                residual = any(id(c) not in consumed for c in conjs)
+                return (label, cols, tuple(vals), residual)
         return None
 
     def _exec_index_fastpath(self, sel: ast.Select, session: Session,
                              match) -> Optional[Result]:
-        label, cols, vals = match
+        label, cols, vals, residual = match
         tname = sel.table.name
         td = self.store.table(tname)
         read_ts = self._read_ts(session)
@@ -1194,7 +1212,222 @@ class Engine:
                 r[ROWID] = 0
             if tuple(r.get(cn) for cn in cols) == vals:
                 rows.append(r)
-        if rows:
+        return self._fastpath_project(sel, session, td, rows, rts,
+                                      apply_where=residual)
+
+    _FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+    def _coerce_index_literal(self, col, lit):
+        """Bind + coerce a literal to `col`'s storage form for index
+        probing; None when the conversion fails OR is inexact — a
+        rounded probe value (0.5 -> 1 on an INT column) would answer
+        a DIFFERENT predicate, so those shapes must fall back to the
+        compiled path, which evaluates the original comparison."""
+        binder = Binder(Scope())
+        try:
+            b = binder.bind(lit)
+            v = binder._const_to(b, col.type).value
+        except Exception:
+            return None
+        if v is None:
+            return None
+        if isinstance(b.value, (int, float)) \
+                and not isinstance(b.value, bool):
+            orig = (b.value / 10 ** b.type.scale
+                    if b.type.family == Family.DECIMAL else b.value)
+            f = col.type.family
+            if f == Family.INT and float(v) != float(orig):
+                return None
+            if f == Family.DECIMAL and \
+                    float(v) / 10 ** col.type.scale != float(orig):
+                return None
+        return v
+
+    def _range_fastpath_match(self, sel: ast.Select,
+                              session: Session):
+        """Match an index-ordered range scan: equality on a prefix of
+        an index plus optional bounds on the next column — the
+        analogue of a constrained ordered index scan
+        (opt/idxconstraint + pebbleMVCCScanner over an index span).
+        Serves `WHERE k >= x ORDER BY k LIMIT n` (YCSB-E's scan shape)
+        host-side with early termination instead of compiling a
+        per-literal XLA program."""
+        shape = self._fastpath_shape(sel, session)
+        if shape is None or sel.where is None:
+            return None
+        tname, schema, visible, projected = shape
+        # normalize comparisons to (conj, col, op, literal)
+        comps = []
+        for c in split_conjuncts_ast(sel.where):
+            if isinstance(c, ast.BinOp) and c.op in (
+                    "=", "<", "<=", ">", ">="):
+                lhs, rhs, op = c.left, c.right, c.op
+                if isinstance(lhs, ast.Literal) and \
+                        isinstance(rhs, ast.ColumnRef):
+                    lhs, rhs = rhs, lhs
+                    op = self._FLIP_OP.get(op, op)
+                if (isinstance(lhs, ast.ColumnRef)
+                        and lhs.table in (None, tname)
+                        and lhs.name in visible
+                        and isinstance(rhs, ast.Literal)
+                        and rhs.value is not None):
+                    comps.append((c, lhs.name, op, rhs))
+                    continue
+            comps.append((c, None, None, None))
+        cands = []
+        if schema.primary_key:
+            cands.append(("primary", tuple(schema.primary_key)))
+        for idx in self._table_indexes(tname):
+            if idx.state == "public":
+                cands.append((idx.name, tuple(idx.columns)))
+        for label, cols in cands:
+            consumed = []
+            eq_vals = []
+            p = 0
+            for cn in cols:
+                hit = next((t for t in comps
+                            if t[1] == cn and t[2] == "="), None)
+                if hit is None:
+                    break
+                v = self._coerce_index_literal(schema.column(cn),
+                                               hit[3])
+                if v is None:
+                    break  # NOT consumed: stays in the residual
+                consumed.append(hit[0])
+                eq_vals.append(v)
+                p += 1
+            lo = hi = None
+            lo_strict = hi_strict = False
+            if p < len(cols):
+                rng_col = cols[p]
+                for t in comps:
+                    if t[1] != rng_col or t[2] in ("=", None):
+                        continue
+                    v = self._coerce_index_literal(
+                        schema.column(rng_col), t[3])
+                    if v is None:
+                        continue  # inexact bound: leave as residual
+                    if t[2] in (">", ">="):
+                        if lo is None or (v, t[2] == ">") > \
+                                (lo, lo_strict):
+                            lo, lo_strict = v, t[2] == ">"
+                    else:
+                        if hi is None or (v, t[2] == "<") < \
+                                (hi, hi_strict):
+                            hi, hi_strict = v, t[2] == "<"
+                    consumed.append(t[0])
+            if p == len(cols) or (p == 0 and lo is None
+                                  and hi is None):
+                continue  # full-eq (eq path) or unconstrained
+            residual = any(t[0] not in consumed for t in comps)
+            # index order serves: no ORDER BY, or ascending on the
+            # range column (eq-prefix columns are constants)
+            order_ok = not sel.order_by or (
+                p < len(cols)
+                and len(sel.order_by) == 1
+                and isinstance(sel.order_by[0].expr, ast.ColumnRef)
+                and sel.order_by[0].expr.name == cols[p]
+                and not sel.order_by[0].desc
+                and cols[p] in projected)
+            if sel.order_by and not order_ok:
+                if not all(isinstance(ob.expr, ast.ColumnRef)
+                           and ob.expr.name in projected
+                           for ob in sel.order_by):
+                    continue  # cannot even host-sort the output
+            return {"label": label, "cols": cols, "p": p,
+                    "eq_vals": tuple(eq_vals), "lo": lo,
+                    "lo_strict": lo_strict, "hi": hi,
+                    "hi_strict": hi_strict, "residual": residual,
+                    "order_ok": order_ok}
+        return None
+
+    def _exec_range_fastpath(self, sel: ast.Select, session: Session,
+                             m: dict) -> Optional[Result]:
+        import bisect
+        tname = sel.table.name
+        td = self.store.table(tname)
+        read_ts = self._read_ts(session)
+        rts = read_ts.to_int()
+        entries = self.store.ensure_sorted_index(tname, m["cols"])
+        p, eq_vals = m["p"], m["eq_vals"]
+        lo_key = eq_vals + ((m["lo"],) if m["lo"] is not None else ())
+        kl = len(lo_key)
+        if kl:
+            fn = (bisect.bisect_right if m["lo_strict"]
+                  else bisect.bisect_left)
+            start = fn(entries, lo_key, key=lambda e: e[0][:kl])
+        else:
+            start = 0
+        if m["hi"] is not None:
+            hi_key = eq_vals + (m["hi"],)
+            kh = len(hi_key)
+            fn = (bisect.bisect_left if m["hi_strict"]
+                  else bisect.bisect_right)
+            end = fn(entries, hi_key, key=lambda e: e[0][:kh])
+        elif p:
+            end = bisect.bisect_right(entries, eq_vals,
+                                      key=lambda e: e[0][:p])
+        else:
+            end = len(entries)
+        self._register_table_read(session.txn, tname, read_ts)
+        pending = (self._txn_key_state(session.effects, tname)
+                   if session.txn is not None else {})
+        limit = int(session.vars.get("index_lookup_limit", 4096))
+        # early termination is sound only when the index order is the
+        # output order, nothing further filters rows, and no txn
+        # overlay could add rows that sort earlier
+        want = None
+        if m["order_ok"] and not m["residual"] and not pending \
+                and sel.limit is not None:
+            want = sel.limit + (sel.offset or 0)
+        rows = []
+        for i in range(start, end):
+            _vals, ci, ri = entries[i]
+            c = td.chunks[ci]
+            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
+                continue
+            row = self.store.extract_row(td, c, ri)
+            if pending and td.codec.key(row) in pending:
+                continue
+            rows.append(row)
+            if want is not None and len(rows) >= want:
+                break
+            if len(rows) > limit:
+                return None  # low selectivity: compiled scan wins
+        for _key, r in pending.items():
+            if r is None:
+                continue
+            r = dict(r)
+            if td.codec.synthetic_pk and ROWID not in r:
+                r[ROWID] = 0
+            vals = tuple(r.get(cn) for cn in m["cols"])
+            if any(v is None for v in vals):
+                continue
+            if vals[:p] != eq_vals:
+                continue
+            if p < len(m["cols"]):
+                v = vals[p]
+                if m["lo"] is not None and (
+                        v < m["lo"] or (m["lo_strict"]
+                                        and v == m["lo"])):
+                    continue
+                if m["hi"] is not None and (
+                        v > m["hi"] or (m["hi_strict"]
+                                        and v == m["hi"])):
+                    continue
+            rows.append(r)
+        return self._fastpath_project(sel, session, td, rows, rts,
+                                      apply_where=m["residual"])
+
+    def _fastpath_project(self, sel: ast.Select, session: Session,
+                          td, rows: list, rts: int,
+                          apply_where: bool = True) -> Result:
+        """Shared fastpath tail: residual WHERE over a mini chunk
+        (skipped when the index consumed every conjunct — the mini
+        chunk costs an eager device round trip), projection,
+        ORDER BY / OFFSET / LIMIT, client decode."""
+        tname = sel.table.name
+        if apply_where and rows and sel.where is not None:
             scope, _ = self._dml_scope(tname)
             predf = self._chunk_pred(tname, sel.where, scope, session)
             mini = self._delta_chunk(td, rows, rts)
@@ -2681,6 +2914,13 @@ class Engine:
         scope.add_table(table, cols)
         return scope, td.schema
 
+    def _host_eval(self):
+        """Eager host-side expression evaluation context: pin to the
+        CPU backend so point-op predicates/assignments never pay a
+        device round trip (on a tunnel-attached TPU one eager sync
+        costs ~50-150ms — it would dominate every OLTP statement)."""
+        return jax.default_device(jax.devices("cpu")[0])
+
     def _chunk_pred(self, table: str, where, scope: Scope,
                     session: Session | None = None):
         if where is None:
@@ -2690,16 +2930,18 @@ class Engine:
             scope,
             subquery_eval=lambda s, lim: self._eval_subquery(
                 s, session, lim),
-            now_micros=self._read_ts(session).wall // 1000)
+            now_micros=self._read_ts(session).wall // 1000,
+            sequence_ops=self._sequence_ops(session))
         pred = binder.bind(where)
         predf = compile_expr(pred)
 
         def f(chunk):
-            ctx = ExprContext(
-                {f"{table}.{k}": (chunk.data[k], chunk.valid[k])
-                 for k in chunk.data}, chunk.n)
-            d, v = predf(ctx)
-            return np.asarray(jnp.logical_and(d, v))
+            with self._host_eval():
+                ctx = ExprContext(
+                    {f"{table}.{k}": (chunk.data[k], chunk.valid[k])
+                     for k in chunk.data}, chunk.n)
+                d, v = predf(ctx)
+                return np.asarray(jnp.logical_and(d, v))
         return f
 
     def _exec_delete(self, d: ast.Delete, session: Session) -> Result:
@@ -2769,7 +3011,7 @@ class Engine:
                 b2 = binder.coerce(b, col.type) if b.type.family != col.type.family else b
                 assigned[cname] = ("expr", compile_expr(b2))
 
-        def assign(chunk, mask):
+        def assign(chunk, mask, _he=self._host_eval):
             idx = np.nonzero(mask)[0]
             data, valid = {}, {}
             ctx = ExprContext(
@@ -2794,9 +3036,11 @@ class Engine:
                                                dtype=c.type.np_dtype)
                             valid[cn] = np.ones(len(idx), dtype=bool)
                     else:
-                        dd, vv = v(ctx)
-                        data[cn] = np.asarray(dd)[idx].astype(c.type.np_dtype)
-                        valid[cn] = np.asarray(vv)[idx]
+                        with _he():
+                            dd, vv = v(ctx)
+                            dd, vv = np.asarray(dd), np.asarray(vv)
+                        data[cn] = dd[idx].astype(c.type.np_dtype)
+                        valid[cn] = vv[idx]
                 else:
                     data[cn] = chunk.data[cn][idx]
                     valid[cn] = chunk.valid[cn][idx]

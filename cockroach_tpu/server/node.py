@@ -62,6 +62,10 @@ class Node:
         self.gossip = None
         self._gossip_stop = None
         self._started = False
+        # internal time-series DB: metrics recorded into the KV plane
+        # by the maintenance loop (pkg/ts analogue, server/ts.py)
+        from .ts import TimeSeriesDB
+        self.tsdb = TimeSeriesDB(self.engine.kv, self.engine.metrics)
 
     @property
     def sql_addr(self) -> tuple[str, int]:
@@ -90,6 +94,29 @@ class Node:
                 if self.path in ("/metrics", "/_status/vars"):
                     body = node.engine.metrics.to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/ts/query"):
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        pts = node.tsdb.query(
+                            q["name"][0],
+                            int(q.get("start", ["0"])[0]),
+                            int(q.get("end", [str(2**62)])[0]),
+                            downsample_s=int(
+                                q.get("downsample", ["10"])[0]),
+                            agg=q.get("agg", ["avg"])[0],
+                            rate=q.get("rate", ["0"])[0] == "1")
+                        body = json.dumps(pts).encode()
+                    except (KeyError, ValueError) as ex:
+                        self.send_response(400)
+                        self.end_headers()
+                        self.wfile.write(str(ex).encode())
+                        return
+                    ctype = "application/json"
+                elif self.path == "/ts/metrics":
+                    body = json.dumps(
+                        node.tsdb.list_metrics()).encode()
+                    ctype = "application/json"
                 elif self.path == "/healthz":
                     body = json.dumps({
                         "status": "ok",
@@ -215,6 +242,13 @@ class Node:
                     # clears intents of crashed coordinators so reads
                     # never pay a push for them
                     self.engine.kv.store.intent_resolver.clean_span()
+                except Exception:
+                    pass
+                try:
+                    # metric samples into the KV-backed time-series DB
+                    # + its rollup/prune pass (pkg/ts maintenance)
+                    self.tsdb.record()
+                    self.tsdb.maintain()
                 except Exception:
                     pass
 

@@ -401,6 +401,8 @@ class Binder:
             return BConst(v, target)  # already physical (days / micros)
         if f == Family.STRING and isinstance(v, str):
             return BConst(v, STRING)
+        if f == Family.BOOL and isinstance(v, (bool, int)):
+            return BConst(bool(v), target)
         raise BindError(f"cannot convert constant {v!r} to {target}")
 
     def _rescale_decimal(self, e: BExpr, scale: int) -> BExpr:

@@ -114,6 +114,10 @@ class TableData:
     # cached multi-column distinct counts for join-uniqueness checks:
     # (cols tuple) -> (generation, distinct, live_rows)
     key_distinct_cache: dict = field(default_factory=dict)
+    # sorted-index locators: (cols tuple) -> (generation, sorted list
+    # of (vals tuple, chunk, row)) over ALL versions — the range-scan
+    # analogue of sec_index_cache (binary search for bounds)
+    sorted_index_cache: dict = field(default_factory=dict)
     # secondary-index locators: (cols tuple) -> (generation, mapping)
     # where mapping is value-tuple -> [(chunk, row), ...] over ALL row
     # versions (lookups filter by MVCC visibility), rebuilt lazily
@@ -623,7 +627,8 @@ class ColumnStore:
             # publish instead of forcing an O(table) rebuild per DML
             # statement (the scan-plane analogue of the reference's
             # write path maintaining index KV entries in place)
-            if td.sec_index_cache:
+            if td.sec_index_cache or td.sorted_index_cache:
+                import bisect
                 defaults = getattr(td, "column_defaults", {})
                 for cols, (gen, mapping) in list(
                         td.sec_index_cache.items()):
@@ -640,6 +645,22 @@ class ColumnStore:
                                 (base_ci, i))
                     td.sec_index_cache[cols] = (td.generation + 1,
                                                 mapping)
+                for cols, (gen, entries) in list(
+                        td.sorted_index_cache.items()):
+                    if gen != td.generation:
+                        del td.sorted_index_cache[cols]
+                        continue
+                    if live:
+                        for i, (_k, row) in enumerate(live):
+                            vals = tuple(row.get(cn, defaults.get(cn))
+                                         for cn in cols)
+                            if any(v is None for v in vals):
+                                continue
+                            bisect.insort(entries,
+                                          (vals, base_ci, i),
+                                          key=lambda e: e[0])
+                    td.sorted_index_cache[cols] = (td.generation + 1,
+                                                   entries)
             td.generation += 1
 
     def _next_rowid_locked(self, td: TableData) -> int:
@@ -683,6 +704,42 @@ class ColumnStore:
                 del td.sec_index_cache[k]
             td.sec_index_cache[cols] = (td.generation, idx)
             return idx
+
+    def ensure_sorted_index(self, name: str, cols: tuple) -> list:
+        """Sorted [(vals, chunk, row)] over ALL row versions of `cols`
+        (generation-cached): binary search gives range bounds, ordered
+        iteration gives index order — the host-side analogue of an
+        ordered KV index scan (pebbleMVCCScanner over an index span).
+        NULL rows are excluded like ensure_secondary_index."""
+        td = self.table(name)
+        with self._lock:
+            self._seal_locked(td)
+            cached = td.sorted_index_cache.get(cols)
+            if cached is not None and cached[0] == td.generation:
+                return cached[1]
+            entries: list = []
+            for ci, chunk in enumerate(td.chunks):
+                valid = np.ones(chunk.n, dtype=bool)
+                arrs = []
+                for cn in cols:
+                    valid &= chunk.valid[cn]
+                    col = td.schema.column(cn)
+                    if col.type.family == Family.STRING:
+                        arrs.append(td.dictionaries[cn].decode_array(
+                            chunk.data[cn]))
+                    else:
+                        arrs.append(chunk.data[cn])
+                for ri in np.nonzero(valid)[0]:
+                    vals = tuple(a[ri].item() if hasattr(a[ri], "item")
+                                 else a[ri] for a in arrs)
+                    entries.append((vals, ci, int(ri)))
+            entries.sort(key=lambda e: e[0])
+            stale = [k for k, v in td.sorted_index_cache.items()
+                     if v[0] != td.generation]
+            for k in stale:
+                del td.sorted_index_cache[k]
+            td.sorted_index_cache[cols] = (td.generation, entries)
+            return entries
 
     # -- statistics ----------------------------------------------------------
     def analyze(self, name: str):

@@ -251,3 +251,108 @@ class TestReviewRegressions:
         eng.execute("CREATE INDEX dup ON t2 (b)")
         with pytest.raises(EngineError, match="ambiguous"):
             eng.execute("DROP INDEX dup")
+
+
+class TestRangeFastPath:
+    """Ordered index-range scans served host-side (the YCSB-E shape:
+    WHERE k >= x ORDER BY k LIMIT n) — analogue of a constrained
+    ordered index scan (opt/idxconstraint)."""
+
+    @pytest.fixture
+    def reng(self):
+        e = Engine()
+        e.execute("CREATE TABLE r (k INT PRIMARY KEY, v INT, s STRING)")
+        e.execute("INSERT INTO r VALUES " + ",".join(
+            f"({i},{i * 3 % 7},'s{i}')" for i in range(100)))
+        e.execute("CREATE INDEX vi ON r (v, k)")
+        return e
+
+    def rboth(self, e, q, ordered=True):
+        s_on, s_off = e.session(), e.session()
+        s_off.vars.set("index_scan", "off")
+        on, off = e.execute(q, s_on), e.execute(q, s_off)
+        if ordered:
+            assert on.rows == off.rows, (q, on.rows[:5], off.rows[:5])
+        else:
+            assert sorted(map(repr, on.rows)) == \
+                sorted(map(repr, off.rows)), q
+        return on.rows
+
+    def test_shapes_match_compiled_scan(self, reng):
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 90 ORDER BY k LIMIT 5")
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k > 5 AND k < 9 ORDER BY k")
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 50", ordered=False)
+        assert self.rboth(
+            reng,
+            "SELECT k, v FROM r WHERE v = 2 AND k >= 50 "
+            "ORDER BY k LIMIT 3")
+        assert self.rboth(
+            reng,
+            "SELECT k FROM r WHERE v = 3 AND k > 50 AND s = 's57' "
+            "ORDER BY k") == [(57,)]
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 95 ORDER BY k DESC")
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 200 ORDER BY k") == []
+
+    def test_counts_as_range_fastpath(self, reng):
+        c = reng.metrics.counter("sql.select.range_fastpath", "x")
+        base = c.value()
+        reng.execute("SELECT k FROM r WHERE k >= 90 ORDER BY k LIMIT 3")
+        assert c.value() == base + 1
+
+    def test_txn_overlay(self, reng):
+        s = reng.session()
+        reng.execute("BEGIN", s)
+        reng.execute("INSERT INTO r VALUES (1000, 1, 'new')", s)
+        reng.execute("DELETE FROM r WHERE k = 99", s)
+        rows = reng.execute(
+            "SELECT k FROM r WHERE k >= 98 ORDER BY k", s).rows
+        assert rows == [(98,), (1000,)]
+        reng.execute("ROLLBACK", s)
+        rows = reng.execute(
+            "SELECT k FROM r WHERE k >= 98 ORDER BY k").rows
+        assert rows == [(98,), (99,)]
+
+    def test_limit_early_stop_correct(self, reng):
+        """Early termination must not drop rows: LIMIT+OFFSET over an
+        ordered range equals the full-scan answer."""
+        for off in (0, 3):
+            q = (f"SELECT k FROM r WHERE k >= 10 ORDER BY k "
+                 f"LIMIT 4 OFFSET {off}")
+            assert self.rboth(reng, q) == [
+                (10 + off,), (11 + off,), (12 + off,), (13 + off,)]
+
+    def test_stays_fresh_after_dml(self, reng):
+        reng.execute("DELETE FROM r WHERE k >= 95")
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 90 ORDER BY k") == [
+            (90,), (91,), (92,), (93,), (94,)]
+        reng.execute("INSERT INTO r VALUES (97, 0, 'x')")
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k >= 94 ORDER BY k") == [
+            (94,), (97,)]
+
+    def test_inexact_literals_fall_back(self, reng):
+        """Rounded probe values must not change the predicate: 0.5 on
+        an INT column is unanswerable by an integer index probe."""
+        assert self.rboth(reng, "SELECT k FROM r WHERE k > 0.5 "
+                          "ORDER BY k LIMIT 3") == [(1,), (2,), (3,)]
+        assert self.rboth(reng, "SELECT k FROM r WHERE k <= 2.5 "
+                          "ORDER BY k") == [(0,), (1,), (2,)]
+        assert self.rboth(reng, "SELECT k FROM r WHERE k = 0.5",
+                          ordered=False) == []
+
+    def test_uncoercible_eq_is_an_error_both_paths(self, reng):
+        import pytest as _pytest
+        from cockroach_tpu.sql.binder import BindError
+        for sess_vars in ({}, {"index_scan": "off"}):
+            s = reng.session()
+            for k, v in sess_vars.items():
+                s.vars.set(k, v)
+            with _pytest.raises(BindError):
+                reng.execute(
+                    "SELECT k FROM r WHERE k = 'zz' AND k > 10", s)

@@ -1,0 +1,141 @@
+"""Internal time-series DB: metrics persisted into the KV plane.
+
+The analogue of pkg/ts (db.go:91,214): fine-resolution samples in
+hourly slabs, rollup to coarse resolution, retention pruning, and the
+query/downsample path that backs the console graphs.
+"""
+
+import json
+import urllib.request
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.server.ts import (COARSE_RES_S, FINE_RES_S, SLAB_S,
+                                     TimeSeriesDB)
+
+
+class FakeClock:
+    def __init__(self, start=1_000_000 - 1_000_000 % SLAB_S):
+        self.t = start
+
+    def __call__(self):
+        return self.t
+
+
+def make_tsdb():
+    e = Engine()
+    clock = FakeClock()
+    ts = TimeSeriesDB(e.kv, e.metrics, now_s=clock)
+    return e, ts, clock
+
+
+class TestRecordQuery:
+    def test_roundtrip_and_downsample(self):
+        e, ts, clock = make_tsdb()
+        g = e.metrics.gauge("test.gauge", "x")
+        t0 = clock.t
+        for i in range(12):
+            g.set(float(i))
+            ts.record()
+            clock.t += FINE_RES_S
+        pts = ts.query("test.gauge", t0, clock.t)
+        assert len(pts) == 12
+        assert pts[0] == (t0, 0.0) and pts[-1][1] == 11.0
+        # downsample to 60s buckets, avg of 6 samples each
+        ds = ts.query("test.gauge", t0, clock.t, downsample_s=60)
+        assert len(ds) == 2
+        assert ds[0][1] == sum(range(6)) / 6
+        assert ds[1][1] == sum(range(6, 12)) / 6
+        mx = ts.query("test.gauge", t0, clock.t, downsample_s=60,
+                      agg="max")
+        assert [v for _, v in mx] == [5.0, 11.0]
+
+    def test_rate_of_counter(self):
+        e, ts, clock = make_tsdb()
+        c = e.metrics.counter("test.ctr", "x")
+        t0 = clock.t
+        for _ in range(5):
+            c.inc(20)
+            ts.record()
+            clock.t += FINE_RES_S
+        pts = ts.query("test.ctr", t0, clock.t, rate=True)
+        # 20 per 10s = 2/s between consecutive samples
+        assert all(abs(v - 2.0) < 1e-9 for _, v in pts)
+
+    def test_window_filtering_and_list(self):
+        e, ts, clock = make_tsdb()
+        g = e.metrics.gauge("a.b", "x")
+        t0 = clock.t
+        for i in range(6):
+            g.set(i)
+            ts.record()
+            clock.t += FINE_RES_S
+        mid = t0 + 2 * FINE_RES_S
+        pts = ts.query("a.b", mid, mid + 2 * FINE_RES_S)
+        assert [v for _, v in pts] == [2.0, 3.0]
+        assert "a.b" in ts.list_metrics()
+
+    def test_slab_boundary(self):
+        """Samples spanning an hour boundary land in two slabs and
+        query as one contiguous series."""
+        e, ts, clock = make_tsdb()
+        clock.t += SLAB_S - FINE_RES_S  # last sample slot of the slab
+        g = e.metrics.gauge("x.y", "x")
+        t0 = clock.t
+        for i in range(3):
+            g.set(i)
+            ts.record()
+            clock.t += FINE_RES_S
+        pts = ts.query("x.y", t0, clock.t)
+        assert [v for _, v in pts] == [0.0, 1.0, 2.0]
+
+
+class TestMaintenance:
+    def test_rollup_and_prune(self):
+        e, ts, clock = make_tsdb()
+        g = e.metrics.gauge("m.n", "x")
+        t0 = clock.t
+        # one hour of samples at 10s
+        for i in range(SLAB_S // FINE_RES_S):
+            g.set(float(i % 30))
+            ts.record()
+            clock.t += FINE_RES_S
+        # advance past the fine retention; roll up
+        clock.t += 7 * 3600
+        out = ts.maintain(retention_fine_s=6 * 3600)
+        assert out["rolled_up"] == 1
+        # fine samples are gone, coarse remain and answer queries
+        pts = ts.query("m.n", t0, t0 + SLAB_S,
+                       downsample_s=COARSE_RES_S)
+        assert len(pts) == SLAB_S // COARSE_RES_S
+        # each coarse bucket is the average of its fine samples
+        assert abs(pts[0][1] - sum(i % 30 for i in range(30)) / 30) \
+            < 1e-9
+        # prune everything beyond coarse retention
+        clock.t += 40 * 24 * 3600
+        out = ts.maintain(retention_coarse_s=30 * 24 * 3600)
+        assert out["pruned"] >= 1
+        assert ts.query("m.n", t0, t0 + SLAB_S) == []
+
+
+class TestNodeIntegration:
+    def test_http_endpoints(self):
+        from cockroach_tpu.server.node import Node, NodeConfig
+        n = Node(NodeConfig(http_port=0, listen_port=0))
+        n.start()
+        try:
+            n.engine.execute("CREATE TABLE t (a INT)")
+            n.engine.execute("INSERT INTO t VALUES (1)")
+            n.tsdb.record()
+            host, port = n.http_addr
+            names = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/ts/metrics",
+                timeout=5).read())
+            assert "sql.exec.latency" not in names  # histograms skipped
+            assert any(x.startswith("sql.") for x in names)
+            name = names[0]
+            pts = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/ts/query?name={name}"
+                f"&start=0&end=4000000000", timeout=5).read())
+            assert isinstance(pts, list) and pts
+        finally:
+            n.stop()
