@@ -109,7 +109,8 @@ class DistSQLNode:
 
     def _run_local(self, spec: FlowSpec):
         eng = self.engine
-        node, meta = Planner(eng.catalog_view()).plan_select(
+        node, meta = Planner(eng.catalog_view(),
+                             use_memo=False).plan_select(
             parser.parse(spec.sql))
         # duplicate-keyed join builds must error, not silently drop
         # matches — same guard as the gateway's _prepare_select
@@ -207,7 +208,8 @@ class Gateway:
     def run(self, sql: str, chunk_rows: int = 65536):
         eng = self.own.engine
         transport = self.own.transport
-        node, meta = Planner(eng.catalog_view()).plan_select(
+        node, meta = Planner(eng.catalog_view(),
+                             use_memo=False).plan_select(
             parser.parse(sql))
         self._check_join_placement(node)
         stage = split(node)

@@ -482,10 +482,17 @@ class Engine:
                     rows=[(ln,) for ln in
                           self._explain_composite(target, session)],
                     tag="EXPLAIN")
-            node, _ = self._plan(target, session, for_explain=True)
+            node, emeta = self._plan(target, session,
+                                     for_explain=True)
             costs = estimate(node, self.catalog_view().stats)
             tree = P.plan_tree_repr(node, costs=costs)
             rows = []
+            if emeta.memo is not None:
+                m_ = emeta.memo
+                rows.append((
+                    f"memo: {m_.groups} groups, {m_.considered} "
+                    f"plans costed; best order "
+                    f"{[m_.root] + m_.order} cost≈{m_.cost:.0f}",))
             if isinstance(target, ast.Select):
                 m = self._index_fastpath_match(target, session)
                 if m is not None:
@@ -696,7 +703,8 @@ class Engine:
         return session.txn_read_ts or self.clock.now()
 
     # -- SELECT --------------------------------------------------------------
-    def _plan(self, stmt, session, for_explain: bool = False):
+    def _plan(self, stmt, session, for_explain: bool = False,
+              no_memo: bool = False):
         if not isinstance(stmt, ast.Select):
             raise EngineError("can only EXPLAIN SELECT")
         read_ts = self._read_ts(session)
@@ -709,7 +717,10 @@ class Engine:
             subquery_eval=lambda sel, lim: self._eval_subquery(
                 sel, session, lim),
             now_micros=read_ts.wall // 1000,
-            sequence_ops=seq_ops)
+            sequence_ops=seq_ops,
+            use_memo=(not no_memo
+                      and session.vars.get("optimizer", "on")
+                      != "off"))
         return planner.plan_select(stmt)
 
     # -- sequences ------------------------------------------------------------
@@ -893,12 +904,13 @@ class Engine:
                                   valid=valid)
 
     def _prepare_select(self, sel: ast.Select, session: Session,
-                        sql_text: str) -> "Prepared":
+                        sql_text: str,
+                        no_memo: bool = False) -> "Prepared":
         for td in self.store.tables.values():
             if td.open_ts:
                 self.store.seal(td.schema.name)
         with self.tracer.span("plan"):
-            node, meta = self._plan(sel, session)
+            node, meta = self._plan(sel, session, no_memo=no_memo)
 
         scan_aliases = _collect_scans(node)
         scan_cols = _collect_scan_columns(node)
@@ -921,7 +933,19 @@ class Engine:
             t: sum(1 for tb, op in session.effects
                    if tb == t and op[0] == "put")
             for t in overlay}
-        self._check_join_builds(node, read_ts, overlay_puts)
+        try:
+            self._check_join_builds(node, read_ts, overlay_puts)
+        except EngineError:
+            if meta.memo is not None and not no_memo:
+                # the memo's stats-estimated build order violated the
+                # engine's EXACT multiplicity cap (avg vs max skew):
+                # replan with the greedy orderer, which consults the
+                # store's exact probes (the reference's optimizer
+                # likewise falls back when exploration yields no
+                # executable plan)
+                return self._prepare_select(sel, session, sql_text,
+                                            no_memo=True)
+            raise
 
         scans = {}
         gens = []
@@ -1307,14 +1331,19 @@ class Engine:
                         schema.column(rng_col), t[3])
                     if v is None:
                         continue  # inexact bound: leave as residual
+                    strict = t[2] in (">", "<")
                     if t[2] in (">", ">="):
-                        if lo is None or (v, t[2] == ">") > \
-                                (lo, lo_strict):
-                            lo, lo_strict = v, t[2] == ">"
+                        # tighter lower bound: higher value wins;
+                        # at a tie, strict (>) excludes more
+                        if lo is None or v > lo or \
+                                (v == lo and strict and not lo_strict):
+                            lo, lo_strict = v, strict
                     else:
-                        if hi is None or (v, t[2] == "<") < \
-                                (hi, hi_strict):
-                            hi, hi_strict = v, t[2] == "<"
+                        # tighter upper bound: lower value wins;
+                        # at a tie, strict (<) excludes more
+                        if hi is None or v < hi or \
+                                (v == hi and strict and not hi_strict):
+                            hi, hi_strict = v, strict
                     consumed.append(t[0])
             if p == len(cols) or (p == 0 and lo is None
                                   and hi is None):

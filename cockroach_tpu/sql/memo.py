@@ -1,0 +1,116 @@
+"""Memoized cost-based join-order search.
+
+The compact tier of the reference's optimizer (pkg/sql/opt:
+optbuilder -> memo -> xform exploration -> costing,
+opt/xform/optimizer.go:239). The full optgen rule engine is not
+rebuilt; what IS rebuilt is the part that changes plans on this
+engine: exploration of join orders with memoized per-group best
+plans and a stats-driven cost model.
+
+The physical join here is a broadcast-build device hash join over a
+left-deep chain (ops/join.py; the build side is always a base-table
+scan), so the search space is: choice of probe root x order of
+builds, constrained to equi-connected prefixes. That is exactly the
+classic System-R dynamic program — ``best[subset]`` memoizes the
+cheapest plan producing each connected subset of tables (the memo
+group), and larger groups are explored by extending smaller ones
+(the xform step).
+
+Cost model (relative weights tuned to the device execution profile):
+  scan:   est_rows (post-filter, from stats selectivities)
+  join:   BUILD_W * build_rows   (hash-table build / direct scatter)
+        + PROBE_W * probe_rows   (gather per probe row)
+        + OUT_W   * out_rows     (materialized join output)
+  out_rows = probe_rows * build_rows * sel,
+  sel      = product over key pairs of 1 / max(distinct_l, distinct_r)
+(the standard independence estimate; distinct counts from ANALYZE).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+BUILD_W = 2.0
+PROBE_W = 1.0
+OUT_W = 0.5
+# the device join expands duplicate-keyed builds by gathering K slots
+# per probe, capped at MAX per-key duplicates = 32 (engine
+# MAX_JOIN_EXPANSION). Stats give the AVERAGE multiplicity
+# (rows/distinct); real key distributions are skewed, so builds whose
+# average exceeds 32/SKEW_MARGIN are penalized — conservative: a
+# falsely-penalized order merely yields a safer plan, while a
+# falsely-allowed one fails at execution
+SKEW_MARGIN = 4.0
+MAX_BUILD_MULT = 32.0 / SKEW_MARGIN
+MULT_PENALTY = 1e9
+
+
+@dataclass
+class GroupPlan:
+    cost: float
+    rows: float
+    root: str
+    order: list = field(default_factory=list)  # build aliases in order
+
+
+@dataclass
+class MemoResult:
+    root: str
+    order: list           # [alias, ...] build order
+    cost: float
+    rows: float
+    groups: int           # memo groups materialized
+    considered: int       # candidate plans costed
+
+
+def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
+    """Find the cheapest connected left-deep join order.
+
+    scan_rows(alias) -> estimated post-filter scan rows.
+    join_info(left_set, alias) -> (selectivity, build_multiplicity)
+    — build_multiplicity is the estimated duplicate rows per join key
+    on the build side `alias` — or None when no equality condition
+    connects `alias` to `left_set` (disconnected extensions are not
+    explored — cartesian products are rejected by the planner anyway).
+
+    Returns None when no fully connected order exists.
+    """
+    n = len(aliases)
+    best: dict[frozenset, GroupPlan] = {}
+    considered = 0
+    for a in aliases:
+        r = max(scan_rows(a), 1.0)
+        best[frozenset([a])] = GroupPlan(cost=r, rows=r, root=a)
+    for size in range(2, n + 1):
+        for combo in itertools.combinations(aliases, size):
+            s = frozenset(combo)
+            champion = None
+            for last in combo:
+                rest = s - {last}
+                b = best.get(rest)
+                if b is None:
+                    continue
+                info = join_info(rest, last)
+                if info is None:
+                    continue
+                sel, build_mult = info
+                build = max(scan_rows(last), 1.0)
+                out = max(b.rows * build * sel, 1.0)
+                cost = (b.cost + BUILD_W * build
+                        + PROBE_W * b.rows + OUT_W * out)
+                if build_mult > MAX_BUILD_MULT:
+                    cost += MULT_PENALTY * build_mult
+                considered += 1
+                if champion is None or cost < champion.cost:
+                    champion = GroupPlan(cost=cost, rows=out,
+                                         root=b.root,
+                                         order=b.order + [last])
+            if champion is not None:
+                best[s] = champion
+    full = best.get(frozenset(aliases))
+    if full is None:
+        return None
+    return MemoResult(root=full.root, order=full.order,
+                      cost=full.cost, rows=full.rows,
+                      groups=len(best), considered=considered)

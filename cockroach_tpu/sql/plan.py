@@ -108,6 +108,9 @@ class OutputMeta:
     names: list[str] = field(default_factory=list)
     types: list[SQLType] = field(default_factory=list)
     dictionaries: dict[str, object] = field(default_factory=dict)
+    # set when the memoized join-order search ran (sql/memo.py):
+    # EXPLAIN surfaces the exploration summary
+    memo: object = None
 
 
 def plan_tree_repr(node: PlanNode, indent: int = 0,

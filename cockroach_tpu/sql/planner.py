@@ -70,8 +70,12 @@ def and_all(conjuncts: list[BExpr]) -> BExpr:
 
 
 class Planner:
+    # tables beyond this use the greedy orderer (2^n memo groups)
+    MEMO_MAX_TABLES = 12
+
     def __init__(self, catalog: CatalogView, subquery_eval=None,
-                 now_micros=None, sequence_ops=None):
+                 now_micros=None, sequence_ops=None,
+                 use_memo: bool = True):
         self.catalog = catalog
         # engine-supplied hooks: subquery execution + statement
         # timestamp for now()/current_date + sequence builtins
@@ -79,6 +83,8 @@ class Planner:
         self.subquery_eval = subquery_eval
         self.now_micros = now_micros
         self.sequence_ops = sequence_ops
+        self.use_memo = use_memo
+        self.last_memo = None  # sql/memo.MemoResult of the last plan
 
     def _keys_unique(self, cand_alias: str, cand_table: str, pool,
                      other_side: set, _key_side, scans) -> bool:
@@ -117,6 +123,97 @@ class Planner:
             return False
         distinct, nonnull = fn(cand_table, tuple(stored))
         return distinct == nonnull
+
+    def _memo_order(self, tables, ordered, conjuncts, alias_table,
+                    tables_of, _key_side):
+        """Run the memoized join-order search over this query's join
+        graph; None = not applicable (disconnected, or no orderable
+        shape) — caller falls back to the greedy orderer."""
+        from . import memo as memomod
+        from .stats import _pred_selectivity
+        aliases = [tables[0][0]] + [e[0] for e in ordered]
+        if len(set(aliases)) != len(aliases):
+            return None  # self-join aliasing handled by greedy path
+        pool_all = (list(conjuncts)
+                    + [c for _, _, oc in ordered for c in oc])
+        stats_map = self.catalog.stats
+        # cost-based search engages only when column statistics exist
+        # for every table (ANALYZE); without distinct counts the
+        # multiplicity/selectivity estimates are guesses and the
+        # greedy smallest-build heuristic is safer (the reference
+        # likewise falls back without table_statistics)
+        for a in aliases:
+            st = stats_map.get(alias_table[a])
+            if st is None or not st.distinct:
+                return None
+
+        def scan_rows(alias: str) -> float:
+            st = stats_map.get(alias_table[alias])
+            rc = max(self.catalog.row_count(alias_table[alias]), 1.0)
+            sel = 1.0
+            for c in pool_all:
+                if tables_of(c) == {alias}:
+                    sel *= _pred_selectivity(c, st)
+            return rc * sel
+
+        def _distinct(al: str, cn) -> float | None:
+            st = stats_map.get(alias_table[al])
+            if st is None or cn is None:
+                return None
+            dd = st.distinct.get(cn.split(".", 1)[-1])
+            return float(dd) if dd else None
+
+        # resolve each equality conjunct's sides ONCE — join_info runs
+        # per memo extension (O(2^n * n) calls), so per-call conjunct
+        # rescans would dominate planning at the table cap
+        edges = []
+        for c in pool_all:
+            if not (isinstance(c, BBin) and c.op == "="):
+                continue
+            ta, na, _ea = _key_side(c.left)
+            tb, nb, _eb = _key_side(c.right)
+            if ta is not None and tb is not None:
+                edges.append((ta, na, tb, nb))
+
+        def join_info(left_set, right):
+            sel = None
+            build_key_distinct = 1.0
+            build_known = True
+            for ta, na, tb, nb in edges:
+                if ta in left_set and tb == right:
+                    sides = ((ta, na), (tb, nb))
+                elif tb in left_set and ta == right:
+                    sides = ((tb, nb), (ta, na))
+                else:
+                    continue
+                # independence estimate: 1/max(distinct_l, distinct_r)
+                d = 1.0
+                for al, cn in sides:
+                    dd = _distinct(al, cn)
+                    if dd:
+                        d = max(d, dd)
+                if d <= 1.0:
+                    d = max(*(self.catalog.row_count(alias_table[al])
+                              for al, _ in sides), 1.0)
+                s = 1.0 / d
+                sel = s if sel is None else sel * s
+                bd = _distinct(sides[1][0], sides[1][1])
+                if bd:
+                    build_key_distinct *= bd
+                else:
+                    build_known = False
+            if sel is None:
+                return None
+            # duplicate rows per key on the build side: the device
+            # join expands these, capped by the engine — estimate
+            # from the UNFILTERED base rows (pushdown filters do not
+            # reduce per-key multiplicity reliably)
+            base = max(self.catalog.row_count(alias_table[right]), 1.0)
+            mult = (base / max(build_key_distinct, 1.0)
+                    if build_known else 1.0)
+            return sel, mult
+
+        return memomod.search(aliases, scan_rows, join_info)
 
     def plan_select(self, sel: ast.Select) -> tuple[plan.PlanNode, plan.OutputMeta]:
         if sel.table is None:
@@ -253,13 +350,31 @@ class Planner:
         def _rc(alias: str) -> float:
             return self.catalog.row_count(alias_table[alias])
 
-        # Stats-driven join ordering (VERDICT #10; the memo/xform
-        # search of opt/xform/optimizer.go:239 is later-round work):
-        # when every join is INNER/cross, greedily build against the
-        # smallest joinable table next — smaller build sides mean
-        # smaller device hash tables and fewer gathered columns.
-        if ordered and all(jt in ("inner", "cross")
-                           for _, jt, _ in ordered):
+        # Join ordering. Preferred: the memoized cost-based search
+        # (sql/memo.py — the compact analogue of opt/xform's
+        # exploration + costing), which chooses BOTH the probe root
+        # and the build order over all connected left-deep plans.
+        # Fallback: the greedy smallest-next heuristic.
+        memo_done = False
+        if ordered and self.use_memo \
+                and len(tables) <= self.MEMO_MAX_TABLES \
+                and all(jt in ("inner", "cross")
+                        for _, jt, _ in ordered):
+            res = self._memo_order(tables, ordered, conjuncts,
+                                   alias_table, tables_of, _key_side)
+            if res is not None:
+                self.last_memo = res
+                pool_all = [c for _, _, oc in ordered for c in oc]
+                node = scans[res.root]
+                probe_root = res.root
+                joined = {res.root}
+                # inner-join ON conditions pool with WHERE (identical
+                # semantics); each reordered step draws its keys there
+                remaining_conjuncts = list(conjuncts) + pool_all
+                ordered = [(a, "inner", []) for a in res.order]
+                memo_done = True
+        if ordered and not memo_done and all(
+                jt in ("inner", "cross") for _, jt, _ in ordered):
             remaining = list(ordered)
             reordered = []
             sim_joined = set(joined)
@@ -495,6 +610,7 @@ class Planner:
                 if d is not None:
                     meta.dictionaries[name] = d
         plan.prune_scan_columns(node)
+        meta.memo = self.last_memo
         return node, meta
 
     def _static_group_bound(self, group_exprs, scope: Scope):

@@ -651,6 +651,11 @@ class ColumnStore:
                         del td.sorted_index_cache[cols]
                         continue
                     if live:
+                        # copy-on-write: in-place insort would SHIFT
+                        # positions under a reader iterating the old
+                        # list (range fastpath holds it outside the
+                        # lock); a published list is never mutated
+                        entries = list(entries)
                         for i, (_k, row) in enumerate(live):
                             vals = tuple(row.get(cn, defaults.get(cn))
                                          for cn in cols)

@@ -356,3 +356,13 @@ class TestRangeFastPath:
             with _pytest.raises(BindError):
                 reng.execute(
                     "SELECT k FROM r WHERE k = 'zz' AND k > 10", s)
+
+    def test_bound_tightness_at_ties(self, reng):
+        """A strict bound at the same value is TIGHTER than a
+        non-strict one and must win (review regression)."""
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k < 5 AND k <= 5 ORDER BY k"
+        ) == [(0,), (1,), (2,), (3,), (4,)]
+        assert self.rboth(
+            reng, "SELECT k FROM r WHERE k > 5 AND k >= 5 "
+            "ORDER BY k LIMIT 2") == [(6,), (7,)]
