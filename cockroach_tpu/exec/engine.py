@@ -2827,13 +2827,15 @@ class Engine:
         td = self.store.table(ins.table)
         schema = td.schema
         if ins.select is not None:
-            if _contains_func(ins.select, "nextval"):
-                # the select binds nextval ONCE, which would hand every
-                # produced row the same value (pg allocates per row);
-                # reject instead of silently corrupting keys
-                raise EngineError(
-                    "nextval inside INSERT ... SELECT is not "
-                    "supported; insert explicit VALUES instead")
+            for vol in ("nextval", "gen_random_uuid"):
+                if _contains_func(ins.select, vol):
+                    # the select binds the volatile fn ONCE, handing
+                    # every produced row the same value (pg evaluates
+                    # per row); reject instead of silently corrupting
+                    # keys/uuids
+                    raise EngineError(
+                        f"{vol} inside INSERT ... SELECT is not "
+                        "supported; insert explicit VALUES instead")
             # cache key must identify the inner select (repr is stable
             # and content-based for the AST dataclasses)
             src = self._exec_select(ins.select, session,
@@ -3028,6 +3030,11 @@ class Engine:
                     "nextval may only be the entire SET expression "
                     "(per-row allocation); fold it into a bare "
                     "nextval('seq') assignment")
+            if _contains_func(e, "gen_random_uuid"):
+                raise EngineError(
+                    "gen_random_uuid in UPDATE SET would give every "
+                    "row the same uuid (bound once per statement); "
+                    "not supported")
             b = binder.bind(e)
             if isinstance(b, BConst) and isinstance(b.value, str) \
                     and col.type.family == Family.STRING:

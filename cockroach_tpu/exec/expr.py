@@ -299,6 +299,15 @@ def _compile_func(e: BFunc) -> CompiledExpr:
         def f_mod(ctx):
             return K.mod(fs[0](ctx), fs[1](ctx))
         return f_mod
+    if name == "logb":
+        def f_logb(ctx):
+            # args are [base, x] (pg's log(b, x))
+            (b, vb), (x, vx) = fs[0](ctx), fs[1](ctx)
+            ok = jnp.logical_and(b > 0, x > 0)
+            d = jnp.log(jnp.where(ok, x, 1.0)) / \
+                jnp.log(jnp.where(ok, b, 2.0))
+            return d, jnp.logical_and(jnp.logical_and(vb, vx), ok)
+        return f_logb
     if name == "div":
         def f_div(ctx):
             (a, va), (b, vb) = fs[0](ctx), fs[1](ctx)

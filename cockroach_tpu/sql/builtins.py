@@ -121,6 +121,67 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         return BFunc("nullif", [l, r], l.type)
     if name == "pi":
         return BConst(math.pi, FLOAT8)
+    if name == "log":
+        # pg: log(x) = base-10; log(b, x) = arbitrary base
+        xs = [binder.coerce(a, FLOAT8) for a in args]
+        if len(xs) == 1:
+            return _fold("log", xs, math.log10, FLOAT8) \
+                or BFunc("log10", xs, FLOAT8)
+        if len(xs) == 2:
+            return _fold("log", xs,
+                         lambda b, x: math.log(x) / math.log(b),
+                         FLOAT8) or BFunc("logb", xs, FLOAT8)
+        raise BuiltinError("log(x) or log(base, x)")
+    if name == "random":
+        # volatile; folded per bind like the sequence builtins (NB:
+        # one value per statement, not per row — the device kernels
+        # have no RNG key plumbing yet)
+        import random as _random
+        return BConst(_random.random(), FLOAT8)
+    if name == "gen_random_uuid":
+        import uuid as _uuid
+        return BConst(str(_uuid.uuid4()), STRING)
+    if name == "version":
+        from .. import __version__
+        return BConst(f"cockroach-tpu {__version__}", STRING)
+    if name == "chr":
+        x = binder.coerce(args[0], INT8)
+        out = _fold("chr", [x], lambda v: chr(int(v)), STRING)
+        if out is None:
+            raise BuiltinError("chr over columns not supported "
+                               "(constant only)")
+        return out
+    if name == "to_hex":
+        x = binder.coerce(args[0], INT8)
+        # negatives render as 64-bit two's complement, like pg
+        out = _fold("to_hex", [x],
+                    lambda v: format(int(v) & 0xFFFFFFFFFFFFFFFF, "x"),
+                    STRING)
+        if out is None:
+            raise BuiltinError("to_hex over columns not supported "
+                               "(constant only)")
+        return out
+    if name == "format":
+        if not args or not isinstance(args[0], BConst):
+            raise BuiltinError("format needs a constant template")
+        if not all(isinstance(a, BConst) for a in args):
+            raise BuiltinError("format over columns not supported "
+                               "(constants only)")
+        if args[0].value is None:
+            return BConst(None, STRING)  # NULL template -> NULL (pg)
+        tmpl = str(args[0].value)
+        vals = []
+        for a in args[1:]:
+            v = a.value
+            if v is None:
+                v = ""  # pg renders NULL args as empty via %s
+            elif a.type.family == Family.DECIMAL:
+                v = v / 10 ** a.type.scale
+            vals.append(v)
+        try:
+            return BConst(tmpl % tuple(vals), STRING)
+        except (TypeError, ValueError) as err:
+            raise BuiltinError(f"format: {err}")
     if name == "isnan":
         x = binder.coerce(args[0], FLOAT8)
         return BFunc("isnan", [x], BOOL)
@@ -178,12 +239,48 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         raise BuiltinError("make_date requires constants")
     if name == "age":
         if len(args) == 2:
-            l, r = args
-            if l.type.family == r.type.family == Family.TIMESTAMP:
-                from .bound import BBin
-                from .types import INTERVAL
+            from .bound import BBin
+            from .types import INTERVAL
+
+            def _to_ts(a):
+                if a.type.family == Family.TIMESTAMP:
+                    return a
+                if a.type.family == Family.DATE:
+                    # days -> micros (both are epoch-relative ints)
+                    return BBin("*", a,
+                                BConst(86_400_000_000, INT8),
+                                TIMESTAMP)
+                if isinstance(a, BConst) and isinstance(a.value, str):
+                    from .binder import parse_timestamp
+                    return BConst(parse_timestamp(a.value), TIMESTAMP)
+                return None
+            l, r = _to_ts(args[0]), _to_ts(args[1])
+            if l is not None and r is not None:
                 return BBin("-", l, r, INTERVAL)
         raise BuiltinError("age(timestamp, timestamp)")
+    if name == "to_char":
+        # to_char(date|timestamp, 'pattern') over constants or a
+        # dictionary-free context: pattern subset YYYY MM DD HH24 MI SS
+        if len(args) != 2 or not isinstance(args[1], BConst):
+            raise BuiltinError("to_char(expr, 'pattern')")
+        x, pat = args[0], str(args[1].value)
+        if not isinstance(x, BConst):
+            raise BuiltinError("to_char over columns not supported "
+                               "(constant only)")
+        if x.value is None:
+            return BConst(None, STRING)
+        if x.type.family == Family.DATE:
+            dt = datetime.date(1970, 1, 1) + \
+                datetime.timedelta(days=int(x.value))
+        elif x.type.family == Family.TIMESTAMP:
+            dt = datetime.datetime(1970, 1, 1) + \
+                datetime.timedelta(microseconds=int(x.value))
+        else:
+            raise BuiltinError("to_char needs a date/timestamp")
+        fmt = (pat.replace("YYYY", "%Y").replace("MM", "%m")
+               .replace("DD", "%d").replace("HH24", "%H")
+               .replace("MI", "%M").replace("SS", "%S"))
+        return BConst(dt.strftime(fmt), STRING)
 
     # ---- strings over dictionaries ---------------------------------------
     out = _bind_string_builtin(binder, name, args)
@@ -213,6 +310,9 @@ _STR_TO_STR = {
     "rpad": lambda s, n, fill=" ": _pad(s, n, fill, left=False),
     "substr": lambda s, start, length=None: _substr(s, start, length),
     "substring": lambda s, start, length=None: _substr(s, start, length),
+    "split_part": lambda s, d, n: _split_part(s, d, n),
+    "quote_ident": lambda s: '"' + s.replace('"', '""') + '"',
+    "quote_literal": lambda s: "'" + s.replace("'", "''") + "'",
     "concat": None,  # variadic, handled specially
     "md5": None,     # needs hashlib, handled specially
 }
@@ -236,6 +336,17 @@ def _pad(s, n, fill, left):
         return s[:n]
     pad = (fill * n)[: n - len(s)]
     return pad + s if left else s + pad
+
+
+def _split_part(s: str, delim, n):
+    if delim is None or n is None:
+        return None  # NULL in, NULL out (str.split(None) would
+        # silently mean whitespace-split)
+    n = int(n)
+    if n < 1:
+        raise BuiltinError("split_part field must be >= 1")
+    parts = s.split(delim)
+    return parts[n - 1] if n <= len(parts) else ""
 
 
 def _substr(s, start, length=None):
@@ -281,6 +392,8 @@ def _bind_string_builtin(binder, name: str, args: list) -> BExpr | None:
                 raise BuiltinError(
                     f"{name}: non-leading arguments must be constants")
             cvals.append(c.value)
+        if any(v is None for v in cvals):
+            return BConst(None, STRING)  # strict: NULL arg -> NULL
         fn = _STR_TO_STR[name]
         return _dict_transform(binder, name, x,
                                lambda s: fn(s, *cvals))
@@ -293,6 +406,8 @@ def _bind_string_builtin(binder, name: str, args: list) -> BExpr | None:
                 raise BuiltinError(
                     f"{name}: non-leading arguments must be constants")
             cvals.append(c.value)
+        if any(v is None for v in cvals):
+            return BConst(None, ty)  # strict: NULL arg -> NULL
         if isinstance(x, BConst):
             if x.value is None:
                 return BConst(None, ty)

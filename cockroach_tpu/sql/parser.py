@@ -551,7 +551,10 @@ class Parser:
             return ast.FuncCall("coalesce", args)
         if t.is_kw("extract"):
             self.expect_op("(")
-            part = self.expect_ident()
+            if self.peek().kind == Tok.STRING:
+                part = self.next().text  # extract('year' from x)
+            else:
+                part = self.expect_ident()
             self.expect_kw("from")
             e = self.parse_expr()
             self.expect_op(")")
@@ -559,6 +562,14 @@ class Parser:
         if t.is_kw("substring"):
             self.expect_op("(")
             e = self.parse_expr()
+            if self.accept_op(","):
+                # pg's comma form: substring(s, start [, length])
+                start = self.parse_expr()
+                length = None
+                if self.accept_op(","):
+                    length = self.parse_expr()
+                self.expect_op(")")
+                return ast.Substring(e, start, length)
             self.expect_kw("from")
             start = self.parse_expr()
             length = None

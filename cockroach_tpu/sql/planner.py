@@ -21,7 +21,7 @@ from dataclasses import dataclass
 
 from . import ast, plan
 from .binder import Binder, BindError, ColumnBinding, Scope
-from .bound import (BAggRef, BBin, BCol, BDictRemap, BExpr,
+from .bound import (BAggRef, BBin, BCol, BConst, BDictRemap, BExpr,
                     referenced_columns, walk)
 from .types import Family, TableSchema
 
@@ -528,6 +528,7 @@ class Planner:
         try:
             for name, expr in items:
                 b = binder.bind_with_aggs(expr)
+                b = _encode_const_string_item(b)
                 bound_items.append((name, b))
                 if any(isinstance(n, BAggRef) for n in walk(b)):
                     any_agg = True
@@ -664,6 +665,22 @@ class Planner:
                     if isinstance(ge, BCol):
                         return self._dict_by_batch_name(ge.name, scope)
         return None
+
+
+def _encode_const_string_item(b: BExpr) -> BExpr:
+    """A constant-string output item (SELECT 'lit' FROM t, or a folded
+    string builtin like trim(' x ')) compiles to dictionary code 0 +
+    an ad-hoc one-entry output dictionary — the same representation
+    CASE gives its constant string branches (binder.bind_case)."""
+    if isinstance(b, BConst) and b.type.family == Family.STRING \
+            and isinstance(b.value, str) \
+            and getattr(b, "dictionary", None) is None:
+        from ..storage.columnstore import Dictionary
+        d = Dictionary()
+        out = BConst(d.encode(b.value), b.type)
+        out.dictionary = d
+        return out
+    return b
 
 
 def _default_name(e: ast.Expr) -> str:

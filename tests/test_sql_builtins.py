@@ -244,3 +244,77 @@ class TestCTEs:
                       "(WITH m AS (SELECT f FROM t WHERE f IS NOT NULL) "
                       "SELECT max(f) FROM m)")
         assert r == [(3,)]
+
+
+class TestRound2Builtins:
+    """log/chr/split_part/to_hex/random/uuid/version/format/to_char +
+    constant-string projection in table context (ad-hoc dictionary)."""
+
+    @pytest.fixture
+    def beng(self):
+        e = Engine()
+        e.execute("CREATE TABLE b (a INT, s STRING, d DATE)")
+        e.execute("INSERT INTO b VALUES (1,'Hello World','2024-03-15'),"
+                  "(2,'x y','2023-01-01')")
+        return e
+
+    def test_const_string_projection(self, beng):
+        assert beng.execute("SELECT 'lit' FROM b").rows == \
+            [("lit",), ("lit",)]
+        assert beng.execute("SELECT trim(' pad ') FROM b").rows[0] == \
+            ("pad",)
+        assert beng.execute(
+            "SELECT lpad('7', 3, '0') FROM b").rows[0] == ("007",)
+
+    def test_new_functions(self, beng):
+        one = lambda q: beng.execute(f"SELECT {q} FROM b LIMIT 1").rows[0][0]
+        assert one("log(100.0)") == 2.0
+        assert one("log(2.0, 8.0)") == 3.0
+        assert one("chr(66)") == "B"
+        assert one("to_hex(255)") == "ff"
+        assert one("format('%s=%s', 'a', 1)") == "a=1"
+        assert one("version()").startswith("cockroach-tpu")
+        assert 0.0 <= one("random()") < 1.0
+        assert len(one("gen_random_uuid()")) == 36
+
+    def test_split_part_over_column(self, beng):
+        rows = beng.execute(
+            "SELECT split_part(s, ' ', 2) FROM b ORDER BY a").rows
+        assert rows == [("World",), ("y",)]
+
+    def test_substring_comma_and_extract_string(self, beng):
+        assert beng.execute(
+            "SELECT substring(s, 1, 5) FROM b ORDER BY a").rows[0] == \
+            ("Hello",)
+        assert beng.execute(
+            "SELECT extract('year' from d) FROM b ORDER BY a").rows == \
+            [(2024,), (2023,)]
+
+    def test_to_char_and_age(self, beng):
+        r = beng.execute("SELECT to_char('2024-03-15'::date, "
+                         "'YYYY-MM-DD') FROM b LIMIT 1").rows
+        assert r == [("2024-03-15",)]
+        r = beng.execute("SELECT age('2024-03-15 00:00:00', "
+                         "'2024-03-14 00:00:00') FROM b LIMIT 1").rows
+        assert r[0][0] is not None
+
+    def test_review_regressions(self, beng):
+        # logb kernel over a column
+        beng.execute("ALTER TABLE b ADD COLUMN f FLOAT DEFAULT 8.0")
+        r = beng.execute("SELECT log(2.0, f) FROM b LIMIT 1").rows
+        assert r == [(3.0,)]
+        # NULL handling: strict string fns + format
+        one = lambda q: beng.execute(
+            f"SELECT {q} FROM b LIMIT 1").rows[0][0]
+        assert one("split_part(s, NULL, 1)") is None
+        assert one("format('%s', NULL)") == ""
+        assert one("format(NULL, 1)") is None
+        assert one("to_hex(-255)") == "ffffffffffffff01"
+        # volatile uuid guarded against multi-row folding
+        from cockroach_tpu.exec.engine import EngineError
+        beng.execute("CREATE TABLE u2 (s STRING)")
+        with pytest.raises(EngineError, match="gen_random_uuid"):
+            beng.execute(
+                "INSERT INTO u2 SELECT gen_random_uuid() FROM b")
+        with pytest.raises(EngineError, match="gen_random_uuid"):
+            beng.execute("UPDATE b SET s = gen_random_uuid()")
