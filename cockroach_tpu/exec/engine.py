@@ -134,6 +134,8 @@ class Prepared:
     # beyond-HBM paging: (alias, page_rows) of the streamed fact table
     stream: Optional[tuple] = None
     stream_cols: Optional[frozenset] = None
+    # AS OF SYSTEM TIME: fixed historical read timestamp
+    as_of: Optional[Timestamp] = None
 
     def _refresh(self) -> "Prepared":
         cur = tuple((t, self.engine.store.table(t).generation)
@@ -150,7 +152,10 @@ class Prepared:
             self.jfn, self.scans, self.meta, self.gens = \
                 p.jfn, p.scans, p.meta, p.gens
             self.stream, self.stream_cols = p.stream, p.stream_cols
-        ts = read_ts or self.engine._read_ts(self.session)
+            self.as_of = p.as_of  # keep guard + execution timestamps
+            # consistent (interval forms re-resolve on refresh)
+        ts = read_ts or self.as_of or \
+            self.engine._read_ts(self.session)
         # np scalar: a jnp.int64() upload would cost a blocking
         # host->device round trip before the query even dispatches.
         tsv = np.int64(ts.to_int())
@@ -702,12 +707,57 @@ class Engine:
     def _read_ts(self, session: Session) -> Timestamp:
         return session.txn_read_ts or self.clock.now()
 
+    def _as_of_ts(self, sel, session: Session):
+        """Resolve AS OF SYSTEM TIME to a Timestamp, or None when the
+        statement has no AS OF clause. Accepted forms (a subset of
+        the reference's, sql/as_of.go): a negative interval string
+        ('-10s', '-2m', '-1h'), a timestamp string, or a decimal HLC
+        wall-nanos value."""
+        aso = getattr(sel, "as_of", None)
+        if aso is None:
+            return None
+        if session.txn is not None:
+            raise EngineError(
+                "AS OF SYSTEM TIME is not allowed inside a "
+                "transaction")
+        if not isinstance(aso, ast.Literal):
+            raise EngineError(
+                "AS OF SYSTEM TIME requires a constant")
+        v = aso.value
+        if isinstance(v, str):
+            import re as _re
+            m = _re.fullmatch(r"-(\d+(?:\.\d+)?)([smh])", v.strip())
+            if m:
+                mult = {"s": 1e9, "m": 60e9, "h": 3600e9}[m.group(2)]
+                wall = self.clock.now().wall - int(
+                    float(m.group(1)) * mult)
+            else:
+                from ..sql.binder import parse_timestamp
+                try:
+                    wall = parse_timestamp(v) * 1000  # micros -> ns
+                except Exception:
+                    raise EngineError(
+                        f"cannot parse AS OF SYSTEM TIME {v!r}")
+        elif isinstance(v, (int, float)):
+            wall = int(v)
+        else:
+            raise EngineError(
+                f"cannot parse AS OF SYSTEM TIME {v!r}")
+        if wall <= 0 or wall > self.clock.now().wall:
+            raise EngineError(
+                "AS OF SYSTEM TIME must be in the past")
+        return Timestamp(int(wall), 0)
+
     # -- SELECT --------------------------------------------------------------
     def _plan(self, stmt, session, for_explain: bool = False,
               no_memo: bool = False):
         if not isinstance(stmt, ast.Select):
             raise EngineError("can only EXPLAIN SELECT")
-        read_ts = self._read_ts(session)
+        # AS OF pins the whole statement: now() and plan-time
+        # subquery evaluation read at the historical timestamp too
+        # (the reference pins the txn's read ts, sql/as_of.go)
+        read_ts = self._as_of_ts(stmt, session) or \
+            self._read_ts(session)
         # EXPLAIN must not execute volatile functions: sequences bind
         # to a placeholder instead of allocating (pg EXPLAIN semantics)
         seq_ops = ((lambda fn, name, arg: 0) if for_explain
@@ -715,7 +765,7 @@ class Engine:
         planner = Planner(
             self.catalog_view(),
             subquery_eval=lambda sel, lim: self._eval_subquery(
-                sel, session, lim),
+                _propagate_as_of(sel, stmt), session, lim),
             now_micros=read_ts.wall // 1000,
             sequence_ops=seq_ops,
             use_memo=(not no_memo
@@ -808,7 +858,8 @@ class Engine:
         mapping: dict[str, str] = {}
         try:
             for name, cols, sub in sel.ctes:
-                sub = _rewrite_table_names(sub, mapping)
+                sub = _propagate_as_of(
+                    _rewrite_table_names(sub, mapping), sel)
                 res = self._exec_select(sub, session, f"(cte {sub!r})")
                 tname = f"__cte{self._temp_seq()}_{name}"
                 self._materialize_temp(tname, res, cols)
@@ -821,7 +872,8 @@ class Engine:
                 ref = obj if kind == "table" else obj.table
                 if ref.subquery is None:
                     continue
-                sub = _rewrite_table_names(ref.subquery, mapping)
+                sub = _propagate_as_of(
+                    _rewrite_table_names(ref.subquery, mapping), sel)
                 res = self._exec_select(sub, session,
                                         f"(derived {sub!r})")
                 tname = f"__cte{self._temp_seq()}_{ref.alias}"
@@ -929,6 +981,9 @@ class Engine:
         # the join-build uniqueness guard is snapshot-aware: it must
         # judge the rows visible at THIS query's read timestamp — and
         # know about txn-buffered build rows the store can't see
+        as_of = self._as_of_ts(sel, session)
+        if as_of is not None:
+            read_ts = as_of
         overlay_puts = {
             t: sum(1 for tb, op in session.effects
                    if tb == t and op[0] == "put")
@@ -1029,7 +1084,8 @@ class Engine:
         return Prepared(self, session, sel, sql_text, jfn, scans, meta,
                         gens, stream=stream,
                         stream_cols=(scan_cols.get(stream[0])
-                                     if stream else None))
+                                     if stream else None),
+                        as_of=as_of)
 
     def prepare(self, sql: str, session: Session | None = None) -> "Prepared":
         """Prepare a SELECT for repeated execution (the pgwire
@@ -1208,7 +1264,8 @@ class Engine:
         label, cols, vals, residual = match
         tname = sel.table.name
         td = self.store.table(tname)
-        read_ts = self._read_ts(session)
+        read_ts = self._as_of_ts(sel, session) or \
+            self._read_ts(session)
         rts = read_ts.to_int()
         sec = self.store.ensure_secondary_index(tname, cols)
         positions = sec.get(vals, [])
@@ -1375,7 +1432,8 @@ class Engine:
         import bisect
         tname = sel.table.name
         td = self.store.table(tname)
-        read_ts = self._read_ts(session)
+        read_ts = self._as_of_ts(sel, session) or \
+            self._read_ts(session)
         rts = read_ts.to_int()
         entries = self.store.ensure_sorted_index(tname, m["cols"])
         p, eq_vals = m["p"], m["eq_vals"]
@@ -3375,6 +3433,21 @@ def _rewrite_table_names(sel, mapping: dict):
 
     fix_select(sel)
     return sel
+
+
+def _propagate_as_of(inner, outer):
+    """AS OF SYSTEM TIME covers the whole statement: sub-selects
+    (expression subqueries, CTEs, derived tables) inherit the outer
+    clause unless they carry their own."""
+    if not isinstance(inner, ast.Select) \
+            or not isinstance(outer, ast.Select):
+        return inner
+    if outer.as_of is None or inner.as_of is not None:
+        return inner
+    import copy
+    inner = copy.copy(inner)
+    inner.as_of = outer.as_of
+    return inner
 
 
 def _contains_func(node, fname: str) -> bool:

@@ -181,6 +181,9 @@ class Select(Statement):
     # WITH name [(col,...)] AS (SELECT ...) — non-recursive CTEs,
     # materialized in order before the main query
     ctes: list[tuple] = field(default_factory=list)  # (name, cols|None, Select)
+    # AS OF SYSTEM TIME <expr>: historical read timestamp (CRDB's
+    # time-travel queries; served by MVCC visibility at that ts)
+    as_of: Optional[Expr] = None
 
 
 @dataclass

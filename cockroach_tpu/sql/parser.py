@@ -312,6 +312,18 @@ class Parser:
                     self.expect_kw("on")
                     on = self.parse_expr()
                 sel.joins.append(ast.JoinClause(tbl, jt, on))
+            if self.peek().is_kw("as") and \
+                    self.peek(1).kind == Tok.IDENT \
+                    and self.peek(1).text == "of":
+                # AS OF SYSTEM TIME <expr> (historical read)
+                self.next()
+                self.next()
+                for word in ("system", "time"):
+                    t = self.next()
+                    if not (t.kind == Tok.IDENT and t.text == word):
+                        raise ParseError("expected SYSTEM TIME after "
+                                         "AS OF")
+                sel.as_of = self.parse_expr()
         if self.accept_kw("where"):
             sel.where = self.parse_expr()
         if self.accept_kw("group"):
@@ -354,9 +366,13 @@ class Parser:
             return ast.TableRef(alias, alias, subquery=sub)
         name = self.expect_ident()
         alias = None
-        if self.accept_kw("as"):
+        if self.peek().is_kw("as") and not (
+                self.peek(1).kind == Tok.IDENT
+                and self.peek(1).text == "of"):
+            self.next()
             alias = self.expect_ident()
-        elif self.peek().kind == Tok.IDENT:
+        elif self.peek().kind == Tok.IDENT \
+                and self.peek().text != "of":
             alias = self.next().text
         return ast.TableRef(name, alias)
 
