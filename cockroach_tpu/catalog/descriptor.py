@@ -63,6 +63,12 @@ class TableDescriptor:
     # (pkg/sql/create_view.go stores the rewritten query text)
     view_sql: str = ""
     view_columns: list = field(default_factory=list)  # output renames
+    # CHECK constraints: [{"name", "expr_sql"}] — re-bound at each
+    # DML against the live schema (pkg/sql/catalog descpb checks)
+    checks: list = field(default_factory=list)
+    # FOREIGN KEYs (RESTRICT): [{"name", "columns", "ref_table",
+    # "ref_columns"}]
+    fks: list = field(default_factory=list)
 
     # -- schema views -------------------------------------------------------
     def public_schema(self) -> TableSchema:
@@ -104,6 +110,8 @@ class TableDescriptor:
             } for i in self.indexes],
             "view_sql": self.view_sql,
             "view_columns": list(self.view_columns),
+            "checks": list(self.checks),
+            "fks": list(self.fks),
         }).encode()
 
     @classmethod
@@ -120,7 +128,9 @@ class TableDescriptor:
                 i["unique"], i["state"])
                 for i in o.get("indexes", [])],
             view_sql=o.get("view_sql", ""),
-            view_columns=list(o.get("view_columns", [])))
+            view_columns=list(o.get("view_columns", [])),
+            checks=list(o.get("checks", [])),
+            fks=list(o.get("fks", [])))
 
     @classmethod
     def from_schema(cls, schema: TableSchema) -> "TableDescriptor":

@@ -231,6 +231,9 @@ class Engine:
         # per-table secondary-index descriptors, cached off the catalog
         # (invalidated by index DDL; a fresh engine lazily reloads)
         self._index_defs: dict[str, list] = {}
+        # per-table (checks, fks) cache + reverse fk map, same policy
+        self._constraint_defs: dict[str, tuple] = {}
+        self._fk_children: dict | None = None
         # statement execution is serialized per engine: pgwire serves
         # each connection on its own thread, and the plan/device caches
         # plus columnstore publish are not safe under concurrent
@@ -2161,7 +2164,8 @@ class Engine:
 
     # -- DDL -----------------------------------------------------------------
     def _exec_create(self, c: ast.CreateTable) -> Result:
-        from ..catalog import CatalogError, TableDescriptor
+        from ..catalog import (CatalogError, IndexDescriptor,
+                               TableDescriptor)
         if c.name in self.store.tables:
             if c.if_not_exists:
                 return Result(tag="CREATE TABLE")
@@ -2171,19 +2175,85 @@ class Engine:
             columns=[ColumnSchema(d.name, d.type, d.nullable)
                      for d in c.columns],
             primary_key=list(c.primary_key))
+        colnames = {d.name for d in c.columns}
+        # validate FK references now (the reference resolves them in
+        # the descriptor builder): target must exist and the referenced
+        # columns must be its primary key or a unique index
+        # unique column / table constraints become unique indexes at
+        # birth (the table is empty — no backfill, straight to PUBLIC)
+        uniq_sets = [[d.name] for d in c.columns if d.unique] \
+            + [list(u) for u in c.uniques]
+        fk_records = []
+        for fkname, lcols, rt, rcols in c.foreign_keys:
+            for cn in lcols:
+                if cn not in colnames:
+                    raise EngineError(f"fk column {cn!r} not in table")
+            if rt == c.name:
+                # self-referential: validate against the in-flight
+                # definition (the table does not exist yet)
+                rcols = rcols or list(c.primary_key)
+                unique_sets = [tuple(c.primary_key)] + \
+                    [tuple(u) for u in uniq_sets]
+            elif rt in self.store.tables:
+                rschema = self.store.table(rt).schema
+                rcols = rcols or list(rschema.primary_key)
+                unique_sets = [tuple(rschema.primary_key)] + [
+                    tuple(i.columns) for i in self._table_indexes(rt)
+                    if i.unique]
+            else:
+                raise EngineError(
+                    f"referenced table {rt!r} does not exist")
+            if tuple(rcols) not in unique_sets:
+                raise EngineError(
+                    f"foreign key must reference a primary key or "
+                    f"unique index of {rt!r} (got {rcols})")
+            if len(rcols) != len(lcols):
+                raise EngineError("foreign key column count mismatch")
+            fk_records.append({"name": fkname, "columns": list(lcols),
+                               "ref_table": rt,
+                               "ref_columns": list(rcols)})
+        for u in uniq_sets:
+            for cn in u:
+                if cn not in colnames:
+                    raise EngineError(
+                        f"unique column {cn!r} not in table")
+        desc0 = TableDescriptor.from_schema(schema)
+        desc0.checks = [{"name": n, "expr_sql": text}
+                        for n, _e, text in c.checks]
+        desc0.fks = fk_records
+        desc0.indexes = [
+            IndexDescriptor(f"{c.name}_{'_'.join(u)}_key", 2 + i,
+                            list(u), True, "public")
+            for i, u in enumerate(uniq_sets)]
         # the descriptor (catalog, system of record) is written first,
         # transactionally — two racing CREATEs conflict on the
         # namespace key; the columnstore table is the scan-plane
         # materialization keyed by the allocated descriptor id
         try:
-            desc = self.catalog.create_table(
-                TableDescriptor.from_schema(schema))
+            desc = self.catalog.create_table(desc0)
         except CatalogError as e:
             if c.if_not_exists:
                 return Result(tag="CREATE TABLE")
             raise EngineError(str(e)) from e
         schema.table_id = desc.id
         self.store.create_table(schema)
+        self._index_defs.pop(c.name, None)
+        self._constraint_defs.pop(c.name, None)
+        self._fk_children = None
+        # CHECK expressions must bind against the new schema (catches
+        # unknown columns / type errors at DDL time)
+        try:
+            scope, _ = self._dml_scope(c.name)
+            for n, e, _text in c.checks:
+                b = Binder(scope).bind(e)
+                if b.type.family != Family.BOOL:
+                    raise EngineError(
+                        f"check constraint {n!r} must be boolean")
+        except Exception:
+            self.store.drop_table(c.name)
+            self.catalog.drop_table(c.name)
+            self._fk_children = None
+            raise
         return Result(tag="CREATE TABLE")
 
     def _exec_drop(self, d: ast.DropTable) -> Result:
@@ -2198,6 +2268,13 @@ class Engine:
             raise EngineError(
                 f"cannot drop table {d.name!r}: view(s) "
                 f"{sorted(deps)} depend on it")
+        fk_deps = sorted({child for child, _fk in
+                          self._fk_children_of(d.name)
+                          if child != d.name})
+        if fk_deps:
+            raise EngineError(
+                f"cannot drop table {d.name!r}: foreign key(s) on "
+                f"{fk_deps} reference it")
         if d.name not in self.store.tables:
             if d.if_exists:
                 return Result(tag="DROP TABLE")
@@ -2208,6 +2285,8 @@ class Engine:
             pass  # store-only table (pre-catalog tests); still drop it
         self.store.drop_table(d.name)
         self._index_defs.pop(d.name, None)
+        self._constraint_defs.pop(d.name, None)
+        self._fk_children = None
         for k in [k for k in self._device_tables if k[0] == d.name]:
             self._evict_device(k)
         return Result(tag="DROP TABLE")
@@ -2456,6 +2535,13 @@ class Engine:
         the old keyspace, pkg/sql/truncate.go)."""
         if tr.table not in self.store.tables:
             raise EngineError(f"table {tr.table!r} does not exist")
+        fk_deps = sorted({child for child, _fk in
+                          self._fk_children_of(tr.table)
+                          if child != tr.table})
+        if fk_deps:
+            raise EngineError(
+                f"cannot truncate {tr.table!r}: foreign key(s) on "
+                f"{fk_deps} reference it")
         td = self.store.table(tr.table)
         schema = td.schema
         # the whole table keyspace: every index id under the table
@@ -2467,6 +2553,162 @@ class Engine:
         self.store.create_table(schema)
         self._evict(tr.table)
         return Result(tag="TRUNCATE")
+
+    # -- constraints (CHECK + FOREIGN KEY, restrict semantics) ---------------
+    # The analogue of the reference's row-level constraint checks
+    # (pkg/sql/row/fk_existence_*.go, check constraints in the
+    # writer). FK existence probes run against the scan-plane index
+    # locators plus this txn's buffered effects; concurrent-txn races
+    # are serialized by the KV plane the same way unique indexes are.
+
+    def _table_constraints(self, table: str) -> tuple:
+        cached = self._constraint_defs.get(table)
+        if cached is not None:
+            return cached
+        d = self.catalog.get_by_name(table)
+        out = ((list(d.checks), list(d.fks)) if d is not None
+               else ([], []))
+        self._constraint_defs[table] = out
+        return out
+
+    def _fk_children_of(self, table: str) -> list:
+        """[(child_table, fk_record)] of FKs referencing `table`."""
+        if self._fk_children is None:
+            m: dict[str, list] = {}
+            for d in self.catalog.list_tables():
+                for fk in d.fks:
+                    m.setdefault(fk["ref_table"], []).append(
+                        (d.name, fk))
+            self._fk_children = m
+        return self._fk_children.get(table, [])
+
+    def _enforce_checks(self, table: str, td, rows: list,
+                        rts: int) -> None:
+        checks, _ = self._table_constraints(table)
+        if not checks or not rows:
+            return
+        # the mini chunk must be built FIRST: encoding the new rows
+        # can append fresh string values to the table dictionaries,
+        # and the compiled predicate bakes dictionary lookup tables —
+        # compiling before the growth would miss the new codes
+        mini = self._delta_chunk(td, rows, rts)
+        # compiled per (table, string-dictionary sizes): dictionary
+        # growth recompiles — same fingerprint idea as the plan cache
+        dictlens = tuple(sorted((cn, len(d)) for cn, d in
+                                td.dictionaries.items()))
+        key = (table, dictlens)
+        fns = getattr(self, "_check_fn_cache", None)
+        if fns is None:
+            fns = self._check_fn_cache = {}
+        compiled = fns.get(key)
+        if compiled is None:
+            scope, _s = self._dml_scope(table)
+            compiled = []
+            for ck in checks:
+                e = parser.Parser(ck["expr_sql"]).parse_expr()
+                b = Binder(scope).bind(e)
+                compiled.append((ck, compile_expr(b)))
+            # evict stale entries for THIS table (old dictlens), keep
+            # other tables' hot entries
+            for k in [k for k in fns if k[0] == table]:
+                del fns[k]
+            fns[key] = compiled
+        ctx = ExprContext(
+            {f"{table}.{k}": (mini.data[k], mini.valid[k])
+             for k in mini.data}, mini.n)
+        for ck, f in compiled:
+            with self._host_eval():
+                d, v = f(ctx)
+                # SQL: CHECK fails only on FALSE (NULL passes)
+                viol = np.asarray(jnp.logical_and(
+                    jnp.logical_not(d), v))
+            if viol.any():
+                raise EngineError(
+                    f"new row violates check constraint "
+                    f"{ck['name']!r} ({ck['expr_sql']})")
+
+    def _fk_parent_exists(self, fk: dict, vals: tuple, session,
+                          rts: int) -> bool:
+        rt = fk["ref_table"]
+        rtd = self.store.table(rt)
+        pending = (self._txn_key_state(session.effects, rt)
+                   if session is not None and session.txn is not None
+                   else {})
+        sec = self.store.ensure_secondary_index(
+            rt, tuple(fk["ref_columns"]))
+        for ci, ri in sec.get(vals, []):
+            ch = rtd.chunks[ci]
+            if not (ch.mvcc_ts[ri] <= rts < ch.mvcc_del[ri]):
+                continue
+            if pending and self.store.row_key(rtd, ch, ri) in pending:
+                continue  # deleted/superseded in this txn
+            return True
+        for _k, r in pending.items():
+            if r is None:
+                continue
+            if tuple(r.get(c) for c in fk["ref_columns"]) == vals:
+                return True
+        return False
+
+    def _enforce_fks(self, table: str, rows: list, session,
+                     rts: int) -> None:
+        """Child-side: every non-NULL FK value in `rows` must have a
+        visible parent row."""
+        _checks, fks = self._table_constraints(table)
+        for fk in fks:
+            # self-FKs may be satisfied by rows of this very statement
+            self_vals = None
+            if fk["ref_table"] == table:
+                self_vals = {tuple(r.get(c) for c in fk["ref_columns"])
+                             for r in rows}
+            for r in rows:
+                vals = tuple(r.get(c) for c in fk["columns"])
+                if any(v is None for v in vals):
+                    continue
+                if self_vals is not None and vals in self_vals:
+                    continue
+                if not self._fk_parent_exists(fk, vals, session, rts):
+                    raise EngineError(
+                        f"insert on {table!r} violates foreign key "
+                        f"{fk['name']!r}: no row in "
+                        f"{fk['ref_table']!r} with "
+                        f"{fk['ref_columns']} = {vals!r}")
+
+    def _enforce_fk_restrict(self, table: str, removed_rows: list,
+                             session, rts: int) -> None:
+        """Parent-side RESTRICT: removing/changing a referenced key
+        fails while child rows still point at it."""
+        for child, fk in self._fk_children_of(table):
+            if child not in self.store.tables:
+                continue
+            ctd = self.store.table(child)
+            pending = (self._txn_key_state(session.effects, child)
+                       if session is not None
+                       and session.txn is not None else {})
+            sec = self.store.ensure_secondary_index(
+                child, tuple(fk["columns"]))
+            for row in removed_rows:
+                vals = tuple(row.get(c) for c in fk["ref_columns"])
+                if any(v is None for v in vals):
+                    continue
+                for ci, ri in sec.get(vals, []):
+                    ch = ctd.chunks[ci]
+                    if not (ch.mvcc_ts[ri] <= rts < ch.mvcc_del[ri]):
+                        continue
+                    if pending and self.store.row_key(
+                            ctd, ch, ri) in pending:
+                        continue
+                    raise EngineError(
+                        f"delete/update on {table!r} violates "
+                        f"foreign key {fk['name']!r} on {child!r}: "
+                        f"row still references {vals!r}")
+                for _k, r in pending.items():
+                    if r is not None and tuple(
+                            r.get(c) for c in fk["columns"]) == vals:
+                        raise EngineError(
+                            f"delete/update on {table!r} violates "
+                            f"foreign key {fk['name']!r} on "
+                            f"{child!r} (pending row)")
 
     def _maintain_indexes(self, table: str, td, t: Txn, pending: dict,
                           old_row, new_row, rts: int) -> None:
@@ -2933,6 +3175,8 @@ class Engine:
             pending = self._txn_key_state(effects, ins.table)
             idx = self.store.ensure_pk_index(ins.table)
             rts = t.meta.read_ts.to_int()
+            self._enforce_checks(ins.table, td, rows, rts)
+            self._enforce_fks(ins.table, rows, session, rts)
             new_rows = []
             for row in rows:
                 r = dict(row)
@@ -2955,7 +3199,7 @@ class Engine:
                             f"primary key of {ins.table!r}")
                 elif ins.upsert:
                     # the row being replaced (if any), for secondary-
-                    # index entry cleanup
+                    # index entry cleanup and FK RESTRICT
                     in_txn = pending.get(key, "absent")
                     if in_txn not in (None, "absent"):
                         old_row = in_txn
@@ -2963,6 +3207,16 @@ class Engine:
                         ci, ri = idx[key]
                         old_row = self.store.extract_row(
                             td, td.chunks[ci], ri)
+                    if old_row is not None:
+                        ref_cols = set()
+                        for _ch, fk in self._fk_children_of(
+                                ins.table):
+                            ref_cols |= set(fk["ref_columns"])
+                        if ref_cols and any(
+                                old_row.get(cn) != r.get(cn)
+                                for cn in ref_cols):
+                            self._enforce_fk_restrict(
+                                ins.table, [old_row], session, rts)
                 self._maintain_indexes(ins.table, td, t, pending,
                                        old_row, r, rts)
                 t.put(key, codec.encode_value(r))
@@ -3047,6 +3301,7 @@ class Engine:
             pending = self._txn_key_state(effects, d.table)
             cand = self._dml_index_candidates(d.table, d.where, session)
             n_committed = len(td.chunks)
+            victims: list[tuple[bytes, dict]] = []
             for ci, chunk in enumerate(
                     self._overlay_chunks(d.table, effects, read_ts)):
                 if cand is not None and ci < n_committed \
@@ -3055,12 +3310,19 @@ class Engine:
                 mask = chunk.live_mask(rts) & predf(chunk)
                 for ri in np.nonzero(mask)[0]:
                     row = self.store.extract_row(td, chunk, int(ri))
-                    key = codec.key(row)
-                    self._maintain_indexes(d.table, td, t, pending,
-                                           row, None, rts)
-                    t.delete(key)
-                    effects.append((d.table, ("del", key)))
-                    n += 1
+                    victims.append((codec.key(row), row))
+            # one batched RESTRICT probe for the whole statement (the
+            # txn aborts wholly on violation, so ordering vs the
+            # deletes below is immaterial)
+            self._enforce_fk_restrict(d.table,
+                                      [r for _k, r in victims],
+                                      session, rts)
+            for key, row in victims:
+                self._maintain_indexes(d.table, td, t, pending,
+                                       row, None, rts)
+                t.delete(key)
+                effects.append((d.table, ("del", key)))
+                n += 1
             return Result(row_count=n, tag="DELETE")
 
         return self._dml(session, fn)
@@ -3179,6 +3441,19 @@ class Engine:
                                 session, "nextval", kv[1], None)
                     todo.append((old, new))
             pending = self._txn_key_state(effects, u.table)
+            self._enforce_checks(u.table, td,
+                                 [new for _o, new in todo], rts)
+            self._enforce_fks(u.table, [new for _o, new in todo],
+                              session, rts)
+            ref_cols_changed = set()
+            for child, fk in self._fk_children_of(u.table):
+                ref_cols_changed |= set(fk["ref_columns"])
+            for old, new in todo:
+                if ref_cols_changed and any(
+                        old.get(c) != new.get(c)
+                        for c in ref_cols_changed):
+                    self._enforce_fk_restrict(u.table, [old],
+                                              session, rts)
             for old, new in todo:
                 okey = codec.key(old)
                 nkey = codec.key(new)
@@ -3354,6 +3629,14 @@ def _render_create(desc) -> str:
             continue
         kw = "UNIQUE INDEX" if i.unique else "INDEX"
         parts.append(f"{kw} {i.name} ({', '.join(i.columns)})")
+    for ck in desc.checks:
+        parts.append(f"CONSTRAINT {ck['name']} CHECK "
+                     f"({ck['expr_sql']})")
+    for fk in desc.fks:
+        parts.append(
+            f"CONSTRAINT {fk['name']} FOREIGN KEY "
+            f"({', '.join(fk['columns'])}) REFERENCES "
+            f"{fk['ref_table']} ({', '.join(fk['ref_columns'])})")
     cols = ",\n  ".join(parts)
     return f"CREATE TABLE {desc.name} (\n  {cols}\n)"
 

@@ -206,6 +206,7 @@ class ColumnDef:
     type: SQLType
     nullable: bool = True
     primary: bool = False
+    unique: bool = False  # column UNIQUE -> auto unique index
 
 
 @dataclass
@@ -214,6 +215,13 @@ class CreateTable(Statement):
     columns: list[ColumnDef]
     primary_key: list[str]
     if_not_exists: bool = False
+    # CHECK constraints: (name, bound-later Expr, source sql text)
+    checks: list = field(default_factory=list)
+    # FOREIGN KEYs (RESTRICT semantics):
+    # (name, [cols], ref_table, [ref_cols])
+    foreign_keys: list = field(default_factory=list)
+    # table-level UNIQUE (cols) -> auto unique index
+    uniques: list = field(default_factory=list)
 
 
 @dataclass

@@ -781,6 +781,34 @@ class Parser:
         self.expect_op("(")
         cols: list[ast.ColumnDef] = []
         pk: list[str] = []
+        checks: list = []
+        fks: list = []
+        uniques: list = []  # table-level UNIQUE (cols)
+
+        def _is_word(w: str) -> bool:
+            return self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                and self.peek().text == w
+
+        def parse_check():
+            self.expect_op("(")
+            start = self.peek().pos
+            e = self.parse_expr()
+            end = self.peek().pos
+            self.expect_op(")")
+            text = self.sql[start:end].strip()
+            checks.append((f"check_{name}_{len(checks) + 1}", e, text))
+
+        def parse_references(local_cols: list[str]):
+            rt = self.expect_ident()
+            rcols = []
+            if self.accept_op("("):
+                rcols.append(self.expect_ident())
+                while self.accept_op(","):
+                    rcols.append(self.expect_ident())
+                self.expect_op(")")
+            fks.append((f"fk_{name}_{len(fks) + 1}", local_cols, rt,
+                        rcols))
+
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -789,11 +817,36 @@ class Parser:
                 while self.accept_op(","):
                     pk.append(self.expect_ident())
                 self.expect_op(")")
+            elif _is_word("check"):
+                self.next()
+                parse_check()
+            elif _is_word("foreign"):
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                lcols = [self.expect_ident()]
+                while self.accept_op(","):
+                    lcols.append(self.expect_ident())
+                self.expect_op(")")
+                if not _is_word("references"):
+                    raise ParseError("expected REFERENCES")
+                self.next()
+                parse_references(lcols)
+            elif _is_word("unique") and self.peek(1).kind == Tok.OP \
+                    and self.peek(1).text == "(":
+                self.next()
+                self.expect_op("(")
+                ucols = [self.expect_ident()]
+                while self.accept_op(","):
+                    ucols.append(self.expect_ident())
+                self.expect_op(")")
+                uniques.append(ucols)
             else:
                 cname = self.expect_ident()
                 ctype = self.parse_type()
                 nullable = True
                 primary = False
+                unique = False
                 while True:
                     if self.accept_kw("not"):
                         self.expect_kw("null")
@@ -806,15 +859,27 @@ class Parser:
                         nullable = False
                     elif self.accept_kw("default"):
                         self.parse_expr()  # accepted, ignored for now
+                    elif _is_word("check"):
+                        self.next()
+                        parse_check()
+                    elif _is_word("references"):
+                        self.next()
+                        parse_references([cname])
+                    elif _is_word("unique"):
+                        self.next()
+                        unique = True
                     else:
                         break
-                cols.append(ast.ColumnDef(cname, ctype, nullable, primary))
+                cols.append(ast.ColumnDef(cname, ctype, nullable,
+                                          primary, unique))
                 if primary:
                     pk.append(cname)
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        return ast.CreateTable(name, cols, pk, if_not_exists)
+        return ast.CreateTable(name, cols, pk, if_not_exists,
+                               checks=checks, foreign_keys=fks,
+                               uniques=uniques)
 
     def parse_alter(self) -> ast.Statement:
         self.expect_kw("alter")

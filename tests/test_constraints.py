@@ -1,0 +1,154 @@
+"""CHECK constraints, FOREIGN KEYs (RESTRICT), column/table UNIQUE.
+
+Reference analogues: check constraints evaluated in the row writer,
+FK existence/restrict probes (pkg/sql/row/fk_existence_*.go), and
+UNIQUE constraints materialized as unique indexes
+(pkg/sql/catalog/tabledesc).
+"""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    e.execute("CREATE TABLE p (id INT PRIMARY KEY, "
+              "v INT CHECK (v > 0), u INT UNIQUE)")
+    e.execute("INSERT INTO p VALUES (1, 5, 100)")
+    e.execute("CREATE TABLE c (id INT PRIMARY KEY, "
+              "pid INT REFERENCES p (id))")
+    return e
+
+
+class TestCheck:
+    def test_insert_update_enforced(self, eng):
+        with pytest.raises(EngineError, match="check constraint"):
+            eng.execute("INSERT INTO p VALUES (2, -1, 101)")
+        with pytest.raises(EngineError, match="check constraint"):
+            eng.execute("UPDATE p SET v = 0 WHERE id = 1")
+        eng.execute("UPDATE p SET v = 9 WHERE id = 1")
+
+    def test_null_passes(self, eng):
+        eng.execute("INSERT INTO p VALUES (2, NULL, 101)")
+
+    def test_bad_check_rejected_at_ddl(self, eng):
+        with pytest.raises(Exception, match="nope|boolean"):
+            eng.execute("CREATE TABLE bad (a INT CHECK (nope > 0))")
+        with pytest.raises(Exception):
+            eng.execute("CREATE TABLE bad2 (a INT CHECK (a + 1))")
+        # failed DDL left nothing behind
+        eng.execute("CREATE TABLE bad2 (a INT)")
+
+    def test_shows_in_create(self, eng):
+        ddl = eng.execute("SHOW CREATE TABLE p").rows[0][1]
+        assert "CHECK (v > 0)" in ddl
+
+
+class TestUniqueConstraint:
+    def test_column_unique(self, eng):
+        with pytest.raises(EngineError, match="unique index"):
+            eng.execute("INSERT INTO p VALUES (3, 1, 100)")
+        eng.execute("INSERT INTO p VALUES (3, 1, NULL)")
+        eng.execute("INSERT INTO p VALUES (4, 1, NULL)")  # NULLs ok
+
+    def test_table_level_unique(self, eng):
+        eng.execute("CREATE TABLE m (a INT PRIMARY KEY, b INT, "
+                    "c INT, UNIQUE (b, c))")
+        eng.execute("INSERT INTO m VALUES (1, 1, 2)")
+        with pytest.raises(EngineError, match="unique index"):
+            eng.execute("INSERT INTO m VALUES (2, 1, 2)")
+        eng.execute("INSERT INTO m VALUES (2, 1, 3)")
+
+
+class TestForeignKey:
+    def test_child_existence(self, eng):
+        eng.execute("INSERT INTO c VALUES (10, 1)")
+        eng.execute("INSERT INTO c VALUES (11, NULL)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("INSERT INTO c VALUES (12, 99)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("UPDATE c SET pid = 42 WHERE id = 10")
+
+    def test_parent_restrict(self, eng):
+        eng.execute("INSERT INTO c VALUES (10, 1)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("DELETE FROM p WHERE id = 1")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("UPDATE p SET id = 50 WHERE id = 1")
+        eng.execute("DELETE FROM c WHERE id = 10")
+        eng.execute("DELETE FROM p WHERE id = 1")
+
+    def test_ddl_guards(self, eng):
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("DROP TABLE p")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("TRUNCATE TABLE p")
+        eng.execute("DROP TABLE c")
+        eng.execute("DROP TABLE p")
+
+    def test_same_txn_parent_and_child(self, eng):
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO p VALUES (3, 7, 102)", s)
+        eng.execute("INSERT INTO c VALUES (13, 3)", s)
+        eng.execute("COMMIT", s)
+        s2 = eng.session()
+        eng.execute("BEGIN", s2)
+        eng.execute("DELETE FROM c WHERE id = 13", s2)
+        eng.execute("DELETE FROM p WHERE id = 3", s2)
+        eng.execute("COMMIT", s2)
+        assert eng.execute("SELECT count(*) FROM c").rows == [(0,)]
+
+    def test_fk_must_reference_unique(self, eng):
+        with pytest.raises(EngineError, match="unique"):
+            eng.execute("CREATE TABLE c2 (id INT PRIMARY KEY, "
+                        "x INT REFERENCES p (v))")
+        # referencing a UNIQUE column works
+        eng.execute("CREATE TABLE c3 (id INT PRIMARY KEY, "
+                    "x INT REFERENCES p (u))")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("INSERT INTO c3 VALUES (1, 12345)")
+        eng.execute("INSERT INTO c3 VALUES (1, 100)")
+
+    def test_missing_ref_table(self, eng):
+        with pytest.raises(EngineError, match="does not exist"):
+            eng.execute("CREATE TABLE cX (a INT REFERENCES nope (x))")
+
+
+class TestReviewRegressions:
+    def test_upsert_respects_restrict(self, eng):
+        eng.execute("CREATE TABLE c3 (id INT PRIMARY KEY, "
+                    "x INT REFERENCES p (u))")
+        eng.execute("INSERT INTO c3 VALUES (1, 100)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("UPSERT INTO p VALUES (1, 5, 999)")
+        # upsert keeping the referenced value is fine
+        eng.execute("UPSERT INTO p VALUES (1, 7, 100)")
+
+    def test_self_referential_fk(self, eng):
+        eng.execute("CREATE TABLE tree (id INT PRIMARY KEY, "
+                    "parent INT REFERENCES tree (id))")
+        eng.execute("INSERT INTO tree VALUES (1, NULL)")
+        eng.execute("INSERT INTO tree VALUES (2, 1)")
+        eng.execute("INSERT INTO tree VALUES (3, 3)")  # self-row ok
+        # one statement inserting parent+child together
+        eng.execute("INSERT INTO tree VALUES (4, NULL), (5, 4)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("INSERT INTO tree VALUES (9, 42)")
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("DELETE FROM tree WHERE id = 1")
+        eng.execute("DELETE FROM tree WHERE id = 2")
+        eng.execute("DELETE FROM tree WHERE id = 1")
+
+    def test_check_cache_survives_dictionary_growth(self, eng):
+        eng.execute("CREATE TABLE sc (a INT PRIMARY KEY, s STRING, "
+                    "CHECK (s != 'bad'))")
+        eng.execute("INSERT INTO sc VALUES (1, 'ok')")
+        with pytest.raises(EngineError, match="check"):
+            eng.execute("INSERT INTO sc VALUES (2, 'bad')")
+        # new dictionary entries after the first compile
+        eng.execute("INSERT INTO sc VALUES (3, 'fresh')")
+        with pytest.raises(EngineError, match="check"):
+            eng.execute("INSERT INTO sc VALUES (4, 'bad')")
