@@ -18,24 +18,35 @@ A from-scratch rebuild of the capabilities of CockroachDB (reference:
 
 Layer map (mirrors SURVEY.md §1):
 
-    sql/        parser, AST, semantic analysis, logical planner
+    sql/        parser, AST, semantic analysis, logical planner,
+                memoized cost-based join ordering (memo.py), stats
     exec/       logical plan -> compiled JAX program (the "colexec"):
-                streaming beyond-HBM scans, hash-partitioned spill
+                streaming beyond-HBM scans, hash-partitioned spill,
+                host-side index point/range fastpaths, constraints
     ops/        device columnar core: ColumnBatch, kernels, agg, join
-    storage/    host columnar MVCC store + memtable/LSM + HLC
+                (+ ops/pallas: hand-written TPU kernels)
+    storage/    host columnar MVCC store + memtable/LSM + HLC, index
+                locators (hash + sorted, generation-cached)
+    catalog/    versioned descriptors in KV, leases, views, indexes,
+                checks/fks
     kv/         transactional KV client (txn coordinator, latches,
-                DistSender + range cache)
-    kvserver/   ranges: raft, leases, liveness, splits/merges, queues
+                DistSender + range cache, intent resolver)
+    kvserver/   ranges: raft, leases, liveness, splits/merges, queues,
+                circuit breakers, loss-of-quorum recovery
     parallel/   mesh partitioning, shard_map flows, collectives
     distsql/    cross-node flow runtime (specs, registry, outbox/inbox)
-    server/     node lifecycle + pgwire v3 wire protocol
-    jobs/       durable job registry, checkpoint/resume, IMPORT
+    server/     node lifecycle + pgwire v3 + KV-backed time-series DB
+    jobs/       durable job registry, checkpoint/resume, IMPORT,
+                schema changes, index backfill, BACKUP/RESTORE, TTL
+    cdc/        changefeeds over rangefeeds
+    workload/   TPC-C, YCSB A-F, SSB, bank, kv, MovR generators
     models/     flagship query "models" (TPC-H workloads) for bench
-    utils/      settings
+    utils/      settings, metrics, tracing, admission, circuit, mon
+    native/     C++ hot-path components (batch key encoder)
     cli.py      cockroach-tpu start / sql / demo
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 # The engine's physical types require 64-bit lanes (HLC timestamps and
 # scaled-decimal int64 accumulation); JAX disables x64 by default.
