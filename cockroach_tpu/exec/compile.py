@@ -405,6 +405,8 @@ def _compile_window(node: P.Window, params: ExecParams) -> CompiledNode:
                 d, v = W.rank(order, seg_start, peer_start, sel_s)
             elif w.func == "dense_rank":
                 d, v = W.dense_rank(order, seg_start, peer_start, sel_s)
+            elif w.func == "ntile":
+                d, v = W.ntile(order, seg_start, sel_s, w.offset)
             elif w.func in ("lag", "lead"):
                 ad, av = argf(ctx)
                 off = w.offset if w.func == "lag" else -w.offset
@@ -501,13 +503,17 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 group_cols[name] = (d[rep], v[rep])
 
         pslots = None
-        if (params.pallas_groupagg and dense and groupfs
+        # the one-pass kernel serves dense GROUP BY and UNGROUPED
+        # aggregation alike (Q6 is the num_groups == 1 case)
+        if (params.pallas_groupagg and (dense or not groupfs)
                 and num_groups <= 64 and b.n % 128 == 0):
             pslots = _pallas_agg_slots([a for a, _ in aggfs])
         overflow = jnp.bool_(False)
         if pslots is not None:
+            pgid = (gid if gid is not None
+                    else jnp.zeros((b.n,), dtype=jnp.int32))
             aggs_out = _pallas_dense_partials(
-                pslots, aggfs, b, ctx, gid, num_groups, axis,
+                pslots, aggfs, b, ctx, pgid, num_groups, axis,
                 params.pallas_interpret)
         else:
             aggs_out = []

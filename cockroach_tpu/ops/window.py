@@ -136,6 +136,24 @@ def lag_lead(order, seg_start, sel_s, data, valid, offset: int):
     return scatter_back(order, out, jnp.logical_and(outv, sel_s), n)
 
 
+def ntile(order, seg_start, sel_s, buckets: int):
+    """pg semantics: rows split sequentially into `buckets` groups as
+    evenly as possible — the first (size % buckets) groups get one
+    extra row; when size < buckets, row r lands in bucket r."""
+    n = order.shape[0]
+    idx = jnp.arange(n)
+    rn = idx - seg_start  # 0-based row number within the partition
+    size = _seg_end(seg_start, n) - seg_start + 1
+    q = size // buckets          # small-bucket size
+    rem = size % buckets         # groups with q+1 rows
+    big_span = rem * (q + 1)     # rows covered by the big groups
+    in_big = rn < big_span
+    b_big = rn // jnp.maximum(q + 1, 1) + 1
+    b_small = rem + (rn - big_span) // jnp.maximum(q, 1) + 1
+    b = jnp.where(in_big, b_big, b_small)
+    return scatter_back(order, b.astype(jnp.int64), sel_s, n)
+
+
 def _seg_end(seg_start, n):
     idx = jnp.arange(n)
     is_last = jnp.concatenate([seg_start[1:] != seg_start[:-1],

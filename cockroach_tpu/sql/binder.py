@@ -836,6 +836,19 @@ class Binder:
                 raise BindError(f"{name}(expr)")
             arg = self.bind(e.args[0])
             ty = arg.type
+        elif name == "ntile":
+            if len(e.args) != 1:
+                raise BindError("ntile(buckets)")
+            if not orders:
+                raise BindError("ntile() requires ORDER BY")
+            nb = self.bind(e.args[0])
+            if not isinstance(nb, BConst) \
+                    or nb.type.family != Family.INT \
+                    or nb.value is None or int(nb.value) < 1:
+                raise BindError("ntile bucket count must be a "
+                                "positive integer constant")
+            offset = int(nb.value)  # bucket count rides the offset slot
+            ty = INT8
         elif name == "count" and e.star:
             ty = INT8
             name = "count_rows"

@@ -126,3 +126,33 @@ class TestEnginePallasGroupBy:
         got = eng.execute(sql, session=s).rows
         assert not eng._pallas_calls  # ineligible: never routed
         assert got == want  # exact equality: same int64 fixed-point sums
+
+
+class TestUngroupedPallas:
+    """The one-pass kernel also serves ungrouped aggregation
+    (num_groups == 1) — the Q6 shape."""
+
+    def test_matches_xla(self):
+        from cockroach_tpu.exec.engine import Engine
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT, f FLOAT)")
+        e.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i / 7})" for i in range(256)))
+        s = e.session()
+        s.vars.set("pallas_groupagg", "on")
+        q = ("SELECT count(*), avg(f), min(f), max(f) FROM t "
+             "WHERE a >= 128")
+        r_p = e.execute(q, s).rows[0]
+        r_x = e.execute(q).rows[0]
+        assert all(abs(a - b) < 1e-4 for a, b in zip(r_p, r_x))
+
+    def test_q6_shape(self):
+        from cockroach_tpu.exec.engine import Engine
+        from cockroach_tpu.models import tpch
+        e = Engine()
+        tpch.load(e, sf=0.01, rows=8192)
+        want = tpch.ref_q6(tpch.gen_lineitem(0.01, rows=8192))
+        s = e.session()
+        s.vars.set("pallas_groupagg", "on")
+        got = e.execute(tpch.Q6, s).rows[0][0]
+        assert abs(got - want) < max(1e-4 * abs(want), 1e-4)

@@ -161,3 +161,37 @@ class TestDistinctAggregates:
                    "(2, 1.50)")
         assert e2.execute("SELECT g, sum(DISTINCT m) FROM p GROUP BY g "
                           "ORDER BY g").rows == [(1, 3.75), (2, 1.50)]
+
+
+def test_ntile():
+    from cockroach_tpu.exec.engine import Engine
+    e = Engine()
+    e.execute("CREATE TABLE wn (g STRING, v INT)")
+    e.execute("INSERT INTO wn VALUES ('a',1),('a',2),('a',3),"
+              "('a',4),('a',5),('b',10),('b',20)")
+    r = e.execute(
+        "SELECT v, ntile(2) OVER (ORDER BY v) FROM wn ORDER BY v").rows
+    assert [b for _, b in r] == [1, 1, 1, 1, 2, 2, 2]
+    r = e.execute("SELECT g, v, ntile(2) OVER "
+                  "(PARTITION BY g ORDER BY v) FROM wn "
+                  "ORDER BY g, v").rows
+    assert [b for _, _, b in r] == [1, 1, 1, 2, 2, 1, 2]
+
+
+def test_ntile_pg_edge_cases():
+    import pytest as _pytest
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.sql.binder import BindError
+    e = Engine()
+    e.execute("CREATE TABLE wn2 (v INT)")
+    e.execute("INSERT INTO wn2 VALUES (1),(2)")
+    # more buckets than rows: sequential 1..size, no gaps (pg)
+    r = e.execute(
+        "SELECT v, ntile(5) OVER (ORDER BY v) FROM wn2 ORDER BY v").rows
+    assert [b for _, b in r] == [1, 2]
+    with _pytest.raises(BindError, match="integer"):
+        e.execute("SELECT ntile(2.5) OVER (ORDER BY v) FROM wn2")
+    with _pytest.raises(BindError, match="integer"):
+        e.execute("SELECT ntile('abc') OVER (ORDER BY v) FROM wn2")
+    with _pytest.raises(BindError, match="positive"):
+        e.execute("SELECT ntile(0) OVER (ORDER BY v) FROM wn2")
