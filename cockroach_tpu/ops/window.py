@@ -148,7 +148,7 @@ def ntile(order, seg_start, sel_s, buckets: int):
     rem = size % buckets         # groups with q+1 rows
     big_span = rem * (q + 1)     # rows covered by the big groups
     in_big = rn < big_span
-    b_big = rn // jnp.maximum(q + 1, 1) + 1
+    b_big = rn // (q + 1) + 1  # q >= 0, so the divisor is >= 1
     b_small = rem + (rn - big_span) // jnp.maximum(q, 1) + 1
     b = jnp.where(in_big, b_big, b_small)
     return scatter_back(order, b.astype(jnp.int64), sel_s, n)

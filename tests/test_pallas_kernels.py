@@ -130,11 +130,25 @@ class TestEnginePallasGroupBy:
 
 class TestUngroupedPallas:
     """The one-pass kernel also serves ungrouped aggregation
-    (num_groups == 1) — the Q6 shape."""
+    (num_groups == 1) — the Q6 shape. The monkeypatched counter
+    asserts the kernel really fired (a silent fallback to XLA would
+    make result comparison vacuous)."""
 
-    def test_matches_xla(self):
+    @pytest.fixture()
+    def ueng(self, monkeypatch):
+        from cockroach_tpu.exec import compile as C
         from cockroach_tpu.exec.engine import Engine
+        calls = []
+        orig = C._pallas_dense_partials
+        monkeypatch.setattr(
+            C, "_pallas_dense_partials",
+            lambda *a, **k: (calls.append(1), orig(*a, **k))[1])
         e = Engine()
+        e._pallas_calls = calls
+        return e
+
+    def test_matches_xla(self, ueng):
+        e = ueng
         e.execute("CREATE TABLE t (a INT, f FLOAT)")
         e.execute("INSERT INTO t VALUES " + ",".join(
             f"({i},{i / 7})" for i in range(256)))
@@ -143,13 +157,13 @@ class TestUngroupedPallas:
         q = ("SELECT count(*), avg(f), min(f), max(f) FROM t "
              "WHERE a >= 128")
         r_p = e.execute(q, s).rows[0]
+        assert e._pallas_calls, "ungrouped kernel gate never fired"
         r_x = e.execute(q).rows[0]
         assert all(abs(a - b) < 1e-4 for a, b in zip(r_p, r_x))
 
-    def test_q6_shape(self):
-        from cockroach_tpu.exec.engine import Engine
+    def test_q6_shape(self, ueng):
         from cockroach_tpu.models import tpch
-        e = Engine()
+        e = ueng
         tpch.load(e, sf=0.01, rows=8192)
         want = tpch.ref_q6(tpch.gen_lineitem(0.01, rows=8192))
         s = e.session()
