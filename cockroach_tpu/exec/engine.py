@@ -394,6 +394,20 @@ class Engine:
             return self._exec_create_index(stmt, session)
         if isinstance(stmt, ast.DropIndex):
             return self._exec_drop_index(stmt, session)
+        if isinstance(stmt, ast.ShowColumns):
+            d = self.catalog.get_by_name(stmt.table)
+            if d is None:
+                raise EngineError(
+                    f"table {stmt.table!r} does not exist")
+            idx_cols = {cn for i in d.indexes for cn in i.columns} \
+                | set(d.primary_key)
+            return Result(
+                names=["column_name", "data_type", "is_nullable",
+                       "indexed"],
+                rows=[(c.name, str(c.type), c.nullable,
+                       c.name in idx_cols)
+                      for c in d.columns if c.state == "public"],
+                tag="SHOW COLUMNS")
         if isinstance(stmt, ast.ShowIndexes):
             d = self.catalog.get_by_name(stmt.table)
             if d is None:

@@ -256,6 +256,12 @@ class ShowIndexes(Statement):
 
 
 @dataclass
+class ShowColumns(Statement):
+    """SHOW COLUMNS FROM <table>."""
+    table: str
+
+
+@dataclass
 class CreateView(Statement):
     """CREATE VIEW <name> [(cols)] AS <select>. The view body is
     stored as SQL text in the descriptor and re-planned (expanded as a

@@ -138,6 +138,11 @@ class Parser:
                 self.next()
                 self.expect_kw("from")
                 return ast.ShowIndexes(self.expect_ident())
+            if self.peek().kind in (Tok.IDENT, Tok.KEYWORD) \
+                    and self.peek().text == "columns":
+                self.next()
+                self.expect_kw("from")
+                return ast.ShowColumns(self.expect_ident())
             if self.peek().kind == Tok.IDENT \
                     and self.peek().text == "sequences":
                 self.next()

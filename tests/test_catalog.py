@@ -201,3 +201,20 @@ class TestShowCreateTable:
         e = Engine()
         with pytest.raises(Exception, match="does not exist"):
             e.execute("SHOW CREATE TABLE ghost")
+
+
+def test_show_columns():
+    from cockroach_tpu.exec.engine import Engine, EngineError
+    import pytest as _pytest
+    e = Engine()
+    e.execute("CREATE TABLE sc (a INT PRIMARY KEY, b INT, "
+              "s STRING NOT NULL)")
+    e.execute("CREATE INDEX bi ON sc (b)")
+    r = e.execute("SHOW COLUMNS FROM sc")
+    assert r.names[0] == "column_name"
+    by = {row[0]: row for row in r.rows}
+    assert by["a"][2] is False and by["a"][3] is True   # pk: not null, indexed
+    assert by["b"][3] is True                            # secondary index
+    assert by["s"][2] is False and by["s"][3] is False
+    with _pytest.raises(EngineError, match="does not exist"):
+        e.execute("SHOW COLUMNS FROM nope")
