@@ -29,9 +29,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from cockroach_tpu.kvserver.raft import RaftNode, Snapshot
-from cockroach_tpu.storage.hlc import Clock, Timestamp
+from cockroach_tpu.storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from cockroach_tpu.storage.keys import EngineKey
-from cockroach_tpu.storage.mvcc import MVCC, TxnMeta
+from cockroach_tpu.storage.mvcc import MVCC, TxnMeta, _dec_value
 
 
 @dataclass
@@ -333,6 +333,25 @@ class Replica:
                                                 include_tombstones=True)):
             if ek.key >= split_key:
                 moved.append((ek, v))
+        # txn records (b"\x00txn/") sort below every user key and would
+        # otherwise always stay on the LHS; move each with its anchor so
+        # pushes routed by the anchor key keep finding the record after
+        # the split (the reference's splitTrigger rewrites range-local
+        # keys.TransactionKey entries the same way)
+        for ek, v in list(self.mvcc.engine.scan(EngineKey(b"\x00txn/", -1),
+                                                include_tombstones=True)):
+            if not ek.key.startswith(b"\x00txn/"):
+                break
+            anchor = None
+            decoded = _dec_value(v) if v else None
+            if decoded:
+                try:
+                    anchor = json.loads(decoded.decode()).get(
+                        "anchor", "").encode("latin1")
+                except (ValueError, UnicodeDecodeError):
+                    anchor = None
+            if anchor and anchor >= split_key:
+                moved.append((ek, v))
         for ek, v in moved:
             if v is not None:
                 rhs_rep.mvcc.engine.put(ek, v)
@@ -398,6 +417,34 @@ class Replica:
             if txn is None:
                 self.rangefeed.on_value(key, None, wts)
             return True
+        if o == "txn_record":
+            # Conditional transaction-record write, the atomic moment of
+            # the push/commit protocol (batcheval/cmd_push_txn.go,
+            # cmd_end_transaction.go). Evaluated below raft so every
+            # replica decides identically in log order:
+            #   status=committed  -> fails if a pusher already poisoned
+            #                        the record ABORTED
+            #   status=aborted    -> keeps an existing COMMITTED record
+            #                        (pushing a committed txn resolves
+            #                        to its commit ts instead)
+            key = op["key"].encode("latin1")
+            want = op["status"]
+            mv = self.mvcc.get(key, MAX_TIMESTAMP, inconsistent=True)
+            if mv is not None:
+                existing = json.loads(mv.value.decode())
+                if existing["status"] != want:
+                    return {"ok": False, "existing": existing["status"],
+                            "existing_ts": existing["ts"]}
+                # idempotent retry: report the applied record's ts so a
+                # re-committed txn adopts it instead of minting a new one
+                return {"ok": True, "existing": existing["status"],
+                        "existing_ts": existing["ts"]}
+            # the anchor key travels in the record so splitTrigger can
+            # keep the record co-located with its anchor's range
+            rec = json.dumps({"status": want, "ts": op["ts"],
+                              "anchor": op.get("anchor", "")})
+            self.mvcc.put(key, wts, rec.encode())
+            return {"ok": True, "existing": None}
         if o == "resolve":
             key = op["key"].encode("latin1")
             commit = bool(op["commit"])

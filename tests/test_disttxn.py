@@ -10,7 +10,8 @@ kvserver/txnwait)."""
 
 import pytest
 
-from cockroach_tpu.kv.disttxn import DistTxn, read_txn_record
+from cockroach_tpu.kv.disttxn import (DistTxn, DistTxnError, TxnAbortedError,
+                                      read_txn_record)
 from cockroach_tpu.kvserver.cluster import Cluster
 from cockroach_tpu.kvserver.transport import ChaosTransport
 
@@ -54,18 +55,47 @@ class TestDistTxnCommit:
 
     def test_uncommitted_invisible_then_pushed(self):
         """A reader blocked by a foreign intent resolves it through
-        the txn record: pending/absent record = aborted."""
+        the txn record: an absent record means the pusher POISONS the
+        pushee (writes ABORTED) before removing the intent."""
         c = make_cluster(split_at=None)
         t = DistTxn(c)
         t.put(b"apple", b"1")
-        # a non-txn reader pushes the PENDING intent -> treated as
-        # aborted (coordinator presumed dead), intent removed
         reader = DistTxn(c)
         assert reader.get(b"apple") is None
-        # the original txn's intent is gone; commit still writes its
-        # record, but the value was already removed by the push — the
-        # reference aborts the pushee; assert the record tells the tale
-        assert read_txn_record(c, t._meta()) is None
+        # the push left an ABORTED record so the writer can never
+        # commit over its removed intent
+        rec = read_txn_record(c, t._meta())
+        assert rec is not None and rec[0] == "aborted"
+
+    def test_push_then_commit_is_retry_error(self):
+        """The round-2 lost-write interleaving: T1 writes an intent, T2
+        reads and pushes it away, T1 commits. T1 MUST observe the
+        poison and fail retryably — previously it committed 'ok' while
+        its write was silently gone (cmd_push_txn.go +
+        cmd_end_transaction.go's status check)."""
+        c = make_cluster(split_at=None)
+        t1 = DistTxn(c)
+        t1.put(b"apple", b"1")
+        reader = DistTxn(c)
+        assert reader.get(b"apple") is None     # push removed the intent
+        with pytest.raises(TxnAbortedError):
+            t1.commit()
+        assert t1.status == "aborted"
+        assert c.get(b"apple") is None          # nothing resurrected
+
+    def test_commit_then_push_resolves_to_commit(self):
+        """The other side of the race: the record commits first, the
+        pusher's conditional ABORT observes it and resolves the intent
+        to the commit ts instead of removing it."""
+        c = make_cluster(split_at=None)
+        t1 = DistTxn(c)
+        t1.put(b"apple", b"1")
+        # commit the record only: coordinator dies before resolve_all
+        res = t1._write_record("committed", c.clock.now())
+        assert res["ok"]
+        t1.status = "committed"
+        reader = DistTxn(c)
+        assert reader.get(b"apple") == b"1"
 
     def test_committed_intent_pushed_forward(self):
         """Coordinator crashes AFTER the record commit, BEFORE
@@ -108,6 +138,85 @@ class TestDistTxnFailures:
         assert c.get(b"apple") == b"1"
         assert c.get(b"pear") == b"2"
         c.check_replica_consistency(1)
+
+    def test_rollback_after_committed_record_refuses(self):
+        """Ambiguous-commit recovery: the COMMITTED record applied but
+        the client saw an error and falls back to rollback(). The
+        rollback must observe the record, refuse, and finish resolving
+        to commit — not destroy a committed txn's intents."""
+        c = make_cluster(split_at=None)
+        t1 = DistTxn(c)
+        t1.put(b"apple", b"1")
+        res = t1._write_record("committed", c.clock.now())
+        assert res["ok"]
+        # client-side state still says pending (the ambiguous window)
+        with pytest.raises(DistTxnError):
+            t1.rollback()
+        assert t1.status == "committed"
+        c.pump(5)
+        assert c.get(b"apple") == b"1"
+
+    def test_record_moves_with_anchor_on_split(self):
+        """Txn records sort below user keys; a split of the anchor
+        range must carry the record to whichever side the anchor lands
+        on, or a later pusher finds no record and poisons a committed
+        txn (destroying its intents)."""
+        c = make_cluster(split_at=None)
+        t1 = DistTxn(c)
+        t1.put(b"apple", b"1")
+        res = t1._write_record("committed", c.clock.now())
+        assert res["ok"]
+        t1.status = "committed"   # coordinator dies before resolve_all
+        c.split_range(b"app")     # anchor 'apple' moves to the RHS
+        c.pump(10)
+        # pusher routed by the anchor key must still find COMMITTED
+        rec = read_txn_record(c, t1._meta())
+        assert rec is not None and rec[0] == "committed"
+        reader = DistTxn(c)
+        assert reader.get(b"apple") == b"1"
+
+    def test_commit_retry_adopts_record_ts(self):
+        """Retrying commit after an ambiguous first attempt must adopt
+        the already-applied record's ts — otherwise intents resolved by
+        pushers (at the record ts) and by the retry (at a fresh ts)
+        split one txn across two commit timestamps."""
+        c = make_cluster(split_at=None)
+        t1 = DistTxn(c)
+        t1.put(b"apple", b"1")
+        t1._write_record("committed", c.clock.now())
+        first_ts = read_txn_record(c, t1._meta())[1]
+        # client saw an ambiguous error; state still 'pending' -> retry
+        got_ts = t1.commit()
+        assert got_ts == first_ts
+        c.pump(5)
+        assert c.get(b"apple") == b"1"
+
+    def test_push_commit_race_chaos(self):
+        """Nemesis schedule over ChaosTransport: many rounds of
+        writer-vs-pusher races; the invariant is that exactly one of
+        (commit succeeded and the value is visible) or (commit raised
+        TxnAbortedError and the value is absent) holds — never a
+        'successful' commit with a missing write."""
+        for seed in range(6):
+            c = make_cluster(split_at=None,
+                             transport=ChaosTransport(seed=seed))
+            t1 = DistTxn(c)
+            t1.put(b"k", b"v")
+            if seed % 2 == 0:
+                reader = DistTxn(c)
+                reader.get(b"k")         # pushes t1
+            try:
+                t1.commit()
+                committed = True
+            except TxnAbortedError:
+                committed = False
+            c.pump(40)
+            got = c.get(b"k")
+            if committed:
+                assert got == b"v", f"seed={seed}: lost committed write"
+            else:
+                assert got is None, f"seed={seed}: aborted txn leaked"
+            c.check_replica_consistency(1)
 
     def test_sequential_txns_supersede(self):
         c = make_cluster(split_at=None)
