@@ -30,6 +30,10 @@ class ColumnDescriptor:
     nullable: bool = True
     state: str = PUBLIC
     default: object = None  # constant backfill value
+    # stable per-table column id tagging value-side KV payloads
+    # (descpb.ColumnDescriptor.ID): survives DROP + re-ADD of a name
+    # with a different type without rewriting stored rows
+    col_id: int = 0
 
 
 @dataclass
@@ -69,13 +73,22 @@ class TableDescriptor:
     # FOREIGN KEYs (RESTRICT): [{"name", "columns", "ref_table",
     # "ref_columns"}]
     fks: list = field(default_factory=list)
+    # next col_id to allocate (never reused, like descpb NextColumnID)
+    next_col_id: int = 1
+
+    def allocate_col_ids(self) -> None:
+        for c in self.columns:
+            if c.col_id == 0:
+                c.col_id = self.next_col_id
+                self.next_col_id += 1
 
     # -- schema views -------------------------------------------------------
     def public_schema(self) -> TableSchema:
         """What readers/planners see: PUBLIC columns only."""
         return TableSchema(
             name=self.name,
-            columns=[ColumnSchema(c.name, c.type, c.nullable)
+            columns=[ColumnSchema(c.name, c.type, c.nullable,
+                                  cid=c.col_id)
                      for c in self.columns if c.state == PUBLIC],
             primary_key=list(self.primary_key),
             table_id=self.id)
@@ -100,6 +113,7 @@ class TableDescriptor:
                 "nullable": c.nullable,
                 "state": c.state,
                 "default": c.default,
+                "col_id": c.col_id,
             } for c in self.columns],
             "indexes": [{
                 "name": i.name,
@@ -112,6 +126,7 @@ class TableDescriptor:
             "view_columns": list(self.view_columns),
             "checks": list(self.checks),
             "fks": list(self.fks),
+            "next_col_id": self.next_col_id,
         }).encode()
 
     @classmethod
@@ -122,7 +137,8 @@ class TableDescriptor:
             state=o["state"], primary_key=list(o["primary_key"]),
             columns=[ColumnDescriptor(
                 c["name"], _dec_type(c["type"]), c["nullable"],
-                c["state"], c.get("default")) for c in o["columns"]],
+                c["state"], c.get("default"),
+                col_id=c.get("col_id", 0)) for c in o["columns"]],
             indexes=[IndexDescriptor(
                 i["name"], i["index_id"], list(i["columns"]),
                 i["unique"], i["state"])
@@ -130,15 +146,24 @@ class TableDescriptor:
             view_sql=o.get("view_sql", ""),
             view_columns=list(o.get("view_columns", [])),
             checks=list(o.get("checks", [])),
-            fks=list(o.get("fks", [])))
+            fks=list(o.get("fks", [])),
+            next_col_id=o.get("next_col_id", 1))
 
     @classmethod
     def from_schema(cls, schema: TableSchema) -> "TableDescriptor":
-        return cls(
+        # preserve stable column ids the schema already carries (e.g.
+        # RESTORE re-registering a backed-up table whose KV rows are
+        # tagged with the original ids); allocate only for the rest
+        d = cls(
             id=schema.table_id, name=schema.name,
-            columns=[ColumnDescriptor(c.name, c.type, c.nullable)
+            columns=[ColumnDescriptor(c.name, c.type, c.nullable,
+                                      col_id=getattr(c, "cid", 0))
                      for c in schema.columns],
             primary_key=list(schema.primary_key))
+        d.next_col_id = 1 + max(
+            (c.col_id for c in d.columns), default=0)
+        d.allocate_col_ids()
+        return d
 
 
 def _enc_type(t: SQLType) -> dict:

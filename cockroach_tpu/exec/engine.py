@@ -48,159 +48,52 @@ from ..utils.settings import SessionVars, Settings
 from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
 from .expr import ExprContext, compile_expr
+from .session import (EngineError, HashCapacityExceeded, Prepared,
+                      Result, Session)
+from .stmtutil import (_StreamFns, _RerunPrepared, _host_sort, _count_aggs,
+                      _collect_scan_columns, _collect_scans,
+                      _contains_func, _decode_column,
+                      _decode_scalar, _decode_storage_value,
+                      _next_pow2, _pad, _propagate_as_of,
+                      _render_create, _rewrite_table_names,
+                      _slice_chunks, _stmt_table_refs,
+                      split_conjuncts_ast)
 
 EPOCH_DATE = datetime.date(1970, 1, 1)
 EPOCH_DT = datetime.datetime(1970, 1, 1)
 
 
-class EngineError(Exception):
-    pass
+from .constraints import ConstraintMixin  # noqa: E402
+from .ddl import DDLMixin  # noqa: E402
+from .dml import DMLMixin  # noqa: E402
+from .fastpath import FastpathMixin  # noqa: E402
+from .maintenance import MaintenanceMixin  # noqa: E402
+from .scanplane import ScanPlaneMixin  # noqa: E402
 
 
-class HashCapacityExceeded(EngineError):
-    """GROUP BY distinct-key count exceeded the device hash table.
-    Prepared.run catches this and falls back to hash-partitioned
-    re-execution (the spill path)."""
-
-
-@dataclass
-class Result:
-    """Decoded query result."""
-    names: list[str] = field(default_factory=list)
-    rows: list[tuple] = field(default_factory=list)
-    row_count: int = 0  # for DML
-    tag: str = "SELECT"
-    types: list = field(default_factory=list)  # SQLTypes (SELECT only)
-
-    def column(self, name: str) -> list:
-        i = self.names.index(name)
-        return [r[i] for r in self.rows]
-
-    def __len__(self):
-        return len(self.rows)
-
-
-@dataclass
-class Session:
-    """Session state (the connExecutor's session data,
-    sessiondatapb/session_data.go). An open explicit transaction holds
-    a real kv.Txn: DML writes intents through it and buffers its
-    scan-plane effects; COMMIT publishes them at the commit timestamp,
-    ROLLBACK discards them (the reference's connExecutor txn state
-    machine, conn_executor.go:1835)."""
-    vars: SessionVars = field(default_factory=SessionVars)
-    txn: Optional[Txn] = None
-    # ordered (table, op) effects: ("put", key, row) | ("del", key)
-    effects: list = field(default_factory=list)
-    # a failed statement aborts the whole txn (postgres semantics:
-    # "current transaction is aborted" until ROLLBACK) — this keeps
-    # statements atomic without kv-level savepoints
-    txn_aborted: bool = False
-    # SET tracing = on: span recordings per statement, rendered by
-    # SHOW TRACE FOR SESSION (the reference's session tracing)
-    trace: list = field(default_factory=list)
-    # currval() state: sequence name -> last nextval in this session
-    seq_currval: dict = field(default_factory=dict)
-
-    @property
-    def in_txn(self) -> bool:
-        return self.txn is not None
-
-    @property
-    def txn_read_ts(self) -> Optional[Timestamp]:
-        return self.txn.meta.read_ts if self.txn is not None else None
-
-
-@dataclass
-class Prepared:
-    """A planned+compiled SELECT bound to device-resident tables.
-
-    ``dispatch()`` is asynchronous (returns the device-side output
-    batch immediately, XLA-style); ``run()`` dispatches and
-    materializes. The read timestamp is taken per execution and the
-    bound device tables are re-resolved if any scanned table's
-    generation moved (DML re-uploads), so a prepared statement sees
-    current data under the session's isolation rules, like a pgwire
-    portal re-executed after Bind."""
-
-    engine: "Engine"
-    session: "Session"
-    stmt: "ast.Select"
-    sql_text: str
-    jfn: object
-    scans: dict
-    meta: object
-    gens: tuple  # ((table, generation), ...) captured at prepare time
-    # beyond-HBM paging: (alias, page_rows) of the streamed fact table
-    stream: Optional[tuple] = None
-    stream_cols: Optional[frozenset] = None
-    # AS OF SYSTEM TIME: fixed historical read timestamp
-    as_of: Optional[Timestamp] = None
-
-    def _refresh(self) -> "Prepared":
-        cur = tuple((t, self.engine.store.table(t).generation)
-                    for t, _ in self.gens)
-        if cur == self.gens:
-            return self
-        return self.engine._prepare_select(self.stmt, self.session,
-                                           self.sql_text)
-
-    def dispatch(self, read_ts: Optional[Timestamp] = None,
-                 nparts: int = 1, pid: int = 0) -> ColumnBatch:
-        p = self._refresh()
-        if p is not self:
-            self.jfn, self.scans, self.meta, self.gens = \
-                p.jfn, p.scans, p.meta, p.gens
-            self.stream, self.stream_cols = p.stream, p.stream_cols
-            self.as_of = p.as_of  # keep guard + execution timestamps
-            # consistent (interval forms re-resolve on refresh)
-        ts = read_ts or self.as_of or \
-            self.engine._read_ts(self.session)
-        # np scalar: a jnp.int64() upload would cost a blocking
-        # host->device round trip before the query even dispatches.
-        tsv = np.int64(ts.to_int())
-        if self.stream is None:
-            return self.jfn(self.scans, tsv, np.int32(nparts),
-                            np.int32(pid))
-        # paged execution: every page's upload+compute dispatches
-        # asynchronously, so page i+1's host-side assembly overlaps
-        # page i's device work (the double-buffering of the
-        # reference's byte-limited KV paging, kv_batch_fetcher.go:191)
-        _alias, tname, page_rows = self.stream
-        fns: _StreamFns = self.jfn
-        state = None
-        scans = dict(self.scans)
-        for page in self.engine._iter_pages(tname, self.stream_cols,
-                                            page_rows):
-            scans[_alias] = page
-            s = fns.page(scans, tsv)
-            state = s if state is None else fns.combine(state, s)
-        return fns.final(state)
-
-    def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
-        tracer = self.engine.tracer
-        try:
-            with tracer.span("dispatch"):
-                out = self.dispatch(read_ts)
-            with tracer.span("materialize"):
-                return self.engine._materialize(out, self.meta)
-        except HashCapacityExceeded:
-            # partition-and-recurse (the reference's disk spiller,
-            # colexecdisk/disk_spiller.go:75, over HBM re-reads)
-            return self.engine._run_partitioned(self, read_ts)
-
-
-class Engine:
+class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
+             MaintenanceMixin, DMLMixin):
     def __init__(self, store: ColumnStore | None = None,
                  clock: Clock | None = None,
                  settings: Settings | None = None,
-                 mesh=None):
+                 mesh=None, cluster=None):
         self.store = store or ColumnStore()
-        self.clock = clock or Clock()
         # the transactional row plane: DML writes intents here via
         # kv.Txn (latches, tscache, pushes — kv/txn.py) and publishes
-        # committed effects into the columnstore scan plane
-        self.kv = KVDB(KVStore(clock=self.clock))
+        # committed effects into the columnstore scan plane. With a
+        # Cluster attached, that plane IS the raft-replicated range
+        # plane (kv/rangekv.py): intents, catalog, sequences and jobs
+        # all replicate and survive node failure; without one, a
+        # single-store embedded KV serves the same interface (the
+        # single-node deployment, like `cockroach start-single-node`).
+        self.cluster = cluster
+        if cluster is not None:
+            from ..kv.rangekv import ClusterKVStore
+            self.clock = cluster.clock
+            self.kv = KVDB(ClusterKVStore(cluster))
+        else:
+            self.clock = clock or Clock()
+            self.kv = KVDB(KVStore(clock=self.clock))
         self.settings = settings or Settings()
         # catalog: versioned descriptors in KV + leases (pkg/sql/catalog);
         # the columnstore's TableData.schema is the runtime cache of the
@@ -234,6 +127,14 @@ class Engine:
         # per-table (checks, fks) cache + reverse fk map, same policy
         self._constraint_defs: dict[str, tuple] = {}
         self._fk_children: dict | None = None
+        # live sessions (weakly held): non-transactional DDL like
+        # TRUNCATE must observe open txns' buffered effects (the
+        # reference serializes this via descriptor leases/intents)
+        import weakref
+        self._open_sessions = weakref.WeakSet()
+        # cluster mode: generation token each local materialization was
+        # built from (see dml.py _sync_scan_plane)
+        self._scan_gens: dict[str, bytes | None] = {}
         # statement execution is serialized per engine: pgwire serves
         # each connection on its own thread, and the plan/device caches
         # plus columnstore publish are not safe under concurrent
@@ -254,7 +155,9 @@ class Engine:
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:
-        return Session()
+        s = Session()
+        self._open_sessions.add(s)
+        return s
 
     def execute(self, sql: str, session: Session | None = None) -> Result:
         session = session or self.session()
@@ -275,6 +178,14 @@ class Engine:
             raise EngineError(
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block")
+        if self.cluster is not None:
+            # the scan plane is a cache of committed range data: check
+            # every referenced table's replicated generation token and
+            # re-materialize what other gateways changed. Under the
+            # statement lock — the refresh mutates the columnstore,
+            # which concurrent pgwire threads may be scanning.
+            with self._stmt_lock:
+                self._sync_scan_plane(stmt)
         import time as _time
         t0 = _time.monotonic()
         prio = session.vars.get("admission_priority", "normal")
@@ -598,7 +509,11 @@ class Engine:
                 # COMMIT of an aborted txn is a rollback (pg semantics)
                 t.rollback()
                 return Result(tag="ROLLBACK")
+            toks = {}
             try:
+                if self.cluster is not None and effects:
+                    toks = self._bump_table_gens(
+                        t, sorted({tb for tb, _ in effects}))
                 commit_ts = t.commit()
             except (TxnRetryError, TxnAbortedError) as e:
                 t.rollback()
@@ -606,6 +521,7 @@ class Engine:
                 # client must retry the whole txn
                 raise EngineError(f"restart transaction: {e}") from e
             self._publish(effects, commit_ts)
+            self._scan_gens.update(toks)
             return Result(tag="COMMIT")
         if isinstance(stmt, ast.RollbackTxn):
             if session.txn is not None:
@@ -787,7 +703,8 @@ class Engine:
             sequence_ops=seq_ops,
             use_memo=(not no_memo
                       and session.vars.get("optimizer", "on")
-                      != "off"))
+                      != "off"),
+            volatile_fold_ok=for_explain)
         return planner.plan_select(stmt)
 
     # -- sequences ------------------------------------------------------------
@@ -1074,6 +991,8 @@ class Engine:
             params = ExecParams(
                 hash_group_capacity=cap,
                 axis_name=SHARD_AXIS if decision is not None else None,
+                n_shards=(self.mesh.devices.size
+                          if decision is not None else 1),
                 pallas_groupagg=pallas,
                 pallas_interpret=jax.default_backend() != "tpu")
             if stream is not None:
@@ -1154,410 +1073,6 @@ class Engine:
                     "path").inc()
                 return res
         return self._prepare_select(sel, session, sql_text).run()
-
-    def _dml_index_candidates(self, table: str, where,
-                              session: Session):
-        """Chunk indexes that can hold rows matching `where`'s
-        equality conjuncts, per an available index — so a point
-        UPDATE/DELETE evaluates its predicate over one chunk instead
-        of the whole table. None = no usable index, scan every chunk.
-        The candidate set covers ALL row versions, so pruned chunks
-        provably contain no match at any timestamp."""
-        if where is None:
-            return None
-        probe = ast.Select(
-            items=[ast.SelectItem(None, star=True)],
-            table=ast.TableRef(table), where=where)
-        match = self._index_fastpath_match(probe, session)
-        if match is None:
-            return None
-        _label, cols, vals, _residual = match
-        sec = self.store.ensure_secondary_index(table, cols)
-        return {ci for ci, _ri in sec.get(vals, [])}
-
-    # -- index point-read fast path ------------------------------------------
-    # The OLTP read path: a selective equality lookup is served from
-    # the host-side index locator + per-row extraction instead of
-    # compiling and dispatching a full device scan — the analogue of
-    # the reference's constrained index scan (opt/idxconstraint +
-    # colfetcher point lookups through DistSender), where a point read
-    # touches one range instead of streaming the table.
-
-    def _fastpath_shape(self, sel: ast.Select, session: Session):
-        """Common structural gate for host-side index fastpaths:
-        single stored table, projection-only items. Returns
-        (tname, schema, visible, projected) or None."""
-        if (sel.table is None or sel.joins or sel.group_by
-                or sel.having or sel.distinct or sel.ctes):
-            return None
-        if session.vars.get("index_scan", "on") == "off":
-            return None
-        tname = sel.table.name
-        if sel.table.alias not in (None, tname):
-            return None
-        if tname not in self.store.tables:
-            return None
-        schema = self.store.table(tname).schema
-        visible = {c.name for c in schema.columns
-                   if not getattr(c, "hidden", False)}
-        projected = set()
-        for item in sel.items:
-            if item.star:
-                projected |= visible
-                continue
-            e = item.expr
-            if not (isinstance(e, ast.ColumnRef)
-                    and e.table in (None, tname)
-                    and e.name in visible):
-                return None
-            projected.add(item.alias or e.name)
-        return (tname, schema, visible, projected)
-
-    def _index_fastpath_match(self, sel: ast.Select, session: Session):
-        """Return (label, cols, vals) when this SELECT is an equality
-        lookup covering all columns of a usable index: single table,
-        projection-only items, conjunctive WHERE with constant
-        equalities. None = use the compiled scan path."""
-        shape = self._fastpath_shape(sel, session)
-        if shape is None:
-            return None
-        tname, schema, visible, projected = shape
-        for ob in sel.order_by:
-            if not (isinstance(ob.expr, ast.ColumnRef)
-                    and ob.expr.name in projected):
-                return None
-        if sel.where is None:
-            return None
-        eq: dict[str, object] = {}
-        eq_conjs: dict[str, object] = {}
-        conjs = split_conjuncts_ast(sel.where)
-        for c in conjs:
-            if not (isinstance(c, ast.BinOp) and c.op == "="):
-                continue
-            lhs, rhs = c.left, c.right
-            if isinstance(rhs, ast.ColumnRef) and isinstance(
-                    lhs, ast.Literal):
-                lhs, rhs = rhs, lhs
-            if (isinstance(lhs, ast.ColumnRef)
-                    and lhs.table in (None, tname)
-                    and lhs.name in visible
-                    and isinstance(rhs, ast.Literal)
-                    and rhs.value is not None
-                    and lhs.name not in eq):
-                eq[lhs.name] = rhs
-                eq_conjs[lhs.name] = c
-        if not eq:
-            return None
-        # candidate indexes, best first: primary, unique, non-unique
-        cands = []
-        if schema.primary_key:
-            cands.append(("primary", tuple(schema.primary_key), 0))
-        for idx in self._table_indexes(tname):
-            if idx.state != "public":
-                continue
-            cands.append((idx.name, tuple(idx.columns),
-                          1 if idx.unique else 2))
-        cands.sort(key=lambda c: c[2])
-        for label, cols, _rank in cands:
-            if not all(cn in eq for cn in cols):
-                continue
-            vals = []
-            ok = True
-            for cn in cols:
-                v = self._coerce_index_literal(schema.column(cn),
-                                               eq[cn])
-                if v is None:
-                    ok = False
-                    break
-                vals.append(v)
-            if ok:
-                consumed = {id(eq_conjs[cn]) for cn in cols}
-                residual = any(id(c) not in consumed for c in conjs)
-                return (label, cols, tuple(vals), residual)
-        return None
-
-    def _exec_index_fastpath(self, sel: ast.Select, session: Session,
-                             match) -> Optional[Result]:
-        label, cols, vals, residual = match
-        tname = sel.table.name
-        td = self.store.table(tname)
-        read_ts = self._as_of_ts(sel, session) or \
-            self._read_ts(session)
-        rts = read_ts.to_int()
-        sec = self.store.ensure_secondary_index(tname, cols)
-        positions = sec.get(vals, [])
-        limit = int(session.vars.get("index_lookup_limit", 4096))
-        if len(positions) > limit:
-            # low selectivity: the compiled device scan wins
-            return None
-        self._register_table_read(session.txn, tname, read_ts)
-        pending = (self._txn_key_state(session.effects, tname)
-                   if session.txn is not None else {})
-        rows = []
-        for ci, ri in positions:
-            c = td.chunks[ci]
-            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
-                continue
-            row = self.store.extract_row(td, c, ri)
-            if pending and td.codec.key(row) in pending:
-                continue  # superseded by this txn's buffered effects
-            rows.append(row)
-        for _key, r in pending.items():
-            if r is None:
-                continue
-            r = dict(r)
-            if td.codec.synthetic_pk and ROWID not in r:
-                r[ROWID] = 0
-            if tuple(r.get(cn) for cn in cols) == vals:
-                rows.append(r)
-        return self._fastpath_project(sel, session, td, rows, rts,
-                                      apply_where=residual)
-
-    _FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-
-    def _coerce_index_literal(self, col, lit):
-        """Bind + coerce a literal to `col`'s storage form for index
-        probing; None when the conversion fails OR is inexact — a
-        rounded probe value (0.5 -> 1 on an INT column) would answer
-        a DIFFERENT predicate, so those shapes must fall back to the
-        compiled path, which evaluates the original comparison."""
-        binder = Binder(Scope())
-        try:
-            b = binder.bind(lit)
-            v = binder._const_to(b, col.type).value
-        except Exception:
-            return None
-        if v is None:
-            return None
-        if isinstance(b.value, (int, float)) \
-                and not isinstance(b.value, bool):
-            orig = (b.value / 10 ** b.type.scale
-                    if b.type.family == Family.DECIMAL else b.value)
-            f = col.type.family
-            if f == Family.INT and float(v) != float(orig):
-                return None
-            if f == Family.DECIMAL and \
-                    float(v) / 10 ** col.type.scale != float(orig):
-                return None
-        return v
-
-    def _range_fastpath_match(self, sel: ast.Select,
-                              session: Session):
-        """Match an index-ordered range scan: equality on a prefix of
-        an index plus optional bounds on the next column — the
-        analogue of a constrained ordered index scan
-        (opt/idxconstraint + pebbleMVCCScanner over an index span).
-        Serves `WHERE k >= x ORDER BY k LIMIT n` (YCSB-E's scan shape)
-        host-side with early termination instead of compiling a
-        per-literal XLA program."""
-        shape = self._fastpath_shape(sel, session)
-        if shape is None or sel.where is None:
-            return None
-        tname, schema, visible, projected = shape
-        # normalize comparisons to (conj, col, op, literal)
-        comps = []
-        for c in split_conjuncts_ast(sel.where):
-            if isinstance(c, ast.BinOp) and c.op in (
-                    "=", "<", "<=", ">", ">="):
-                lhs, rhs, op = c.left, c.right, c.op
-                if isinstance(lhs, ast.Literal) and \
-                        isinstance(rhs, ast.ColumnRef):
-                    lhs, rhs = rhs, lhs
-                    op = self._FLIP_OP.get(op, op)
-                if (isinstance(lhs, ast.ColumnRef)
-                        and lhs.table in (None, tname)
-                        and lhs.name in visible
-                        and isinstance(rhs, ast.Literal)
-                        and rhs.value is not None):
-                    comps.append((c, lhs.name, op, rhs))
-                    continue
-            comps.append((c, None, None, None))
-        cands = []
-        if schema.primary_key:
-            cands.append(("primary", tuple(schema.primary_key)))
-        for idx in self._table_indexes(tname):
-            if idx.state == "public":
-                cands.append((idx.name, tuple(idx.columns)))
-        for label, cols in cands:
-            consumed = []
-            eq_vals = []
-            p = 0
-            for cn in cols:
-                hit = next((t for t in comps
-                            if t[1] == cn and t[2] == "="), None)
-                if hit is None:
-                    break
-                v = self._coerce_index_literal(schema.column(cn),
-                                               hit[3])
-                if v is None:
-                    break  # NOT consumed: stays in the residual
-                consumed.append(hit[0])
-                eq_vals.append(v)
-                p += 1
-            lo = hi = None
-            lo_strict = hi_strict = False
-            if p < len(cols):
-                rng_col = cols[p]
-                for t in comps:
-                    if t[1] != rng_col or t[2] in ("=", None):
-                        continue
-                    v = self._coerce_index_literal(
-                        schema.column(rng_col), t[3])
-                    if v is None:
-                        continue  # inexact bound: leave as residual
-                    strict = t[2] in (">", "<")
-                    if t[2] in (">", ">="):
-                        # tighter lower bound: higher value wins;
-                        # at a tie, strict (>) excludes more
-                        if lo is None or v > lo or \
-                                (v == lo and strict and not lo_strict):
-                            lo, lo_strict = v, strict
-                    else:
-                        # tighter upper bound: lower value wins;
-                        # at a tie, strict (<) excludes more
-                        if hi is None or v < hi or \
-                                (v == hi and strict and not hi_strict):
-                            hi, hi_strict = v, strict
-                    consumed.append(t[0])
-            if p == len(cols) or (p == 0 and lo is None
-                                  and hi is None):
-                continue  # full-eq (eq path) or unconstrained
-            residual = any(t[0] not in consumed for t in comps)
-            # index order serves: no ORDER BY, or ascending on the
-            # range column (eq-prefix columns are constants)
-            order_ok = not sel.order_by or (
-                p < len(cols)
-                and len(sel.order_by) == 1
-                and isinstance(sel.order_by[0].expr, ast.ColumnRef)
-                and sel.order_by[0].expr.name == cols[p]
-                and not sel.order_by[0].desc
-                and cols[p] in projected)
-            if sel.order_by and not order_ok:
-                if not all(isinstance(ob.expr, ast.ColumnRef)
-                           and ob.expr.name in projected
-                           for ob in sel.order_by):
-                    continue  # cannot even host-sort the output
-            return {"label": label, "cols": cols, "p": p,
-                    "eq_vals": tuple(eq_vals), "lo": lo,
-                    "lo_strict": lo_strict, "hi": hi,
-                    "hi_strict": hi_strict, "residual": residual,
-                    "order_ok": order_ok}
-        return None
-
-    def _exec_range_fastpath(self, sel: ast.Select, session: Session,
-                             m: dict) -> Optional[Result]:
-        import bisect
-        tname = sel.table.name
-        td = self.store.table(tname)
-        read_ts = self._as_of_ts(sel, session) or \
-            self._read_ts(session)
-        rts = read_ts.to_int()
-        entries = self.store.ensure_sorted_index(tname, m["cols"])
-        p, eq_vals = m["p"], m["eq_vals"]
-        lo_key = eq_vals + ((m["lo"],) if m["lo"] is not None else ())
-        kl = len(lo_key)
-        if kl:
-            fn = (bisect.bisect_right if m["lo_strict"]
-                  else bisect.bisect_left)
-            start = fn(entries, lo_key, key=lambda e: e[0][:kl])
-        else:
-            start = 0
-        if m["hi"] is not None:
-            hi_key = eq_vals + (m["hi"],)
-            kh = len(hi_key)
-            fn = (bisect.bisect_left if m["hi_strict"]
-                  else bisect.bisect_right)
-            end = fn(entries, hi_key, key=lambda e: e[0][:kh])
-        elif p:
-            end = bisect.bisect_right(entries, eq_vals,
-                                      key=lambda e: e[0][:p])
-        else:
-            end = len(entries)
-        self._register_table_read(session.txn, tname, read_ts)
-        pending = (self._txn_key_state(session.effects, tname)
-                   if session.txn is not None else {})
-        limit = int(session.vars.get("index_lookup_limit", 4096))
-        # early termination is sound only when the index order is the
-        # output order, nothing further filters rows, and no txn
-        # overlay could add rows that sort earlier
-        want = None
-        if m["order_ok"] and not m["residual"] and not pending \
-                and sel.limit is not None:
-            want = sel.limit + (sel.offset or 0)
-        rows = []
-        for i in range(start, end):
-            _vals, ci, ri = entries[i]
-            c = td.chunks[ci]
-            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
-                continue
-            row = self.store.extract_row(td, c, ri)
-            if pending and td.codec.key(row) in pending:
-                continue
-            rows.append(row)
-            if want is not None and len(rows) >= want:
-                break
-            if len(rows) > limit:
-                return None  # low selectivity: compiled scan wins
-        for _key, r in pending.items():
-            if r is None:
-                continue
-            r = dict(r)
-            if td.codec.synthetic_pk and ROWID not in r:
-                r[ROWID] = 0
-            vals = tuple(r.get(cn) for cn in m["cols"])
-            if any(v is None for v in vals):
-                continue
-            if vals[:p] != eq_vals:
-                continue
-            if p < len(m["cols"]):
-                v = vals[p]
-                if m["lo"] is not None and (
-                        v < m["lo"] or (m["lo_strict"]
-                                        and v == m["lo"])):
-                    continue
-                if m["hi"] is not None and (
-                        v > m["hi"] or (m["hi_strict"]
-                                        and v == m["hi"])):
-                    continue
-            rows.append(r)
-        return self._fastpath_project(sel, session, td, rows, rts,
-                                      apply_where=m["residual"])
-
-    def _fastpath_project(self, sel: ast.Select, session: Session,
-                          td, rows: list, rts: int,
-                          apply_where: bool = True) -> Result:
-        """Shared fastpath tail: residual WHERE over a mini chunk
-        (skipped when the index consumed every conjunct — the mini
-        chunk costs an eager device round trip), projection,
-        ORDER BY / OFFSET / LIMIT, client decode."""
-        tname = sel.table.name
-        if apply_where and rows and sel.where is not None:
-            scope, _ = self._dml_scope(tname)
-            predf = self._chunk_pred(tname, sel.where, scope, session)
-            mini = self._delta_chunk(td, rows, rts)
-            mask = np.asarray(predf(mini))
-            rows = [r for r, m in zip(rows, mask) if m]
-        schema = td.schema
-        out: list[tuple[str, object]] = []  # (output name, column)
-        for item in sel.items:
-            if item.star:
-                for c in schema.columns:
-                    if not getattr(c, "hidden", False):
-                        out.append((c.name, c))
-            else:
-                col = schema.column(item.expr.name)
-                out.append((item.alias or item.expr.name, col))
-        names = [n for n, _ in out]
-        types = [c.type for _, c in out]
-        res_rows = [tuple(_decode_storage_value(r.get(c.name), c.type)
-                          for _, c in out) for r in rows]
-        if sel.order_by:
-            res_rows = self._sort_decoded(res_rows, names, sel.order_by)
-        if sel.offset:
-            res_rows = res_rows[sel.offset:]
-        if sel.limit is not None:
-            res_rows = res_rows[:sel.limit]
-        return Result(names=names, rows=res_rows, types=types)
 
     def _exec_setop(self, so: ast.SetOp, session: Session,
                     sql_text: str) -> Result:
@@ -1877,1983 +1392,3 @@ class Engine:
             types.append(b.type)
         return Result(names=names, rows=[tuple(row)], types=types)
 
-    # -- hash-partitioned spill ---------------------------------------------
-    MAX_SPILL_PARTITIONS = 256
-    # duplicate-key join expansion cap: output rows = probe.n * K
-    MAX_JOIN_EXPANSION = 32
-
-    def _run_partitioned(self, prep: "Prepared",
-                         read_ts: Optional[Timestamp]) -> Result:
-        """Partition-and-recurse fallback for hash GROUP BY overflow.
-
-        The compiled program already takes (nparts, pid) scalars and
-        keeps only rows whose salted key-hash lands in partition pid
-        (ops/hashtable.py partition_mask), so spilling is: rerun the
-        SAME program once per partition, concatenate the per-partition
-        group rows on the host, then apply any Sort/Limit there
-        (device sort/limit would have been per-partition). Doubling
-        the partition count until every partition fits mirrors the
-        reference's recursive hash_based_partitioner; re-reads hit the
-        resident HBM table instead of disk.
-        """
-        node, meta = self._plan(prep.stmt, prep.session)
-        limit_node = sort_node = None
-        if isinstance(node, P.Limit):
-            limit_node, node = node, node.child
-        if isinstance(node, P.Sort):
-            sort_node, node = node, node.child
-        if not isinstance(node, P.Aggregate) or node.max_groups > 0:
-            raise HashCapacityExceeded(
-                "GROUP BY overflow in a non-spillable plan shape; "
-                "SET hash_group_capacity to a larger power of two")
-
-        # compile the STRIPPED plan (no device Sort/Limit — a per-
-        # partition limit would truncate wrongly); reuse prep's device
-        # scans, which already match the distribution decision
-        cap = int(prep.session.vars.get("hash_group_capacity", 1 << 17))
-        decision = self._dist_decision(node, prep.session)
-        shapes = tuple(sorted((a, b.n) for a, b in prep.scans.items()))
-        dictlens = tuple(
-            sorted((t, tuple(sorted((cn, len(d)) for cn, d in
-                                    self.store.table(t).dictionaries
-                                    .items())))
-                   for t, _ in prep.gens))
-        key = ("spill", prep.sql_text, shapes, dictlens, cap,
-               decision is not None, hash(repr(node)))
-        cached = self._exec_cache.get(key)
-        if cached is None:
-            params = ExecParams(
-                hash_group_capacity=cap,
-                axis_name=SHARD_AXIS if decision is not None else None)
-            runf = compile_plan(node, params, meta)
-            if decision is not None:
-                jfn = jax.jit(make_distributed_fn(
-                    runf, self.mesh, _collect_scans(node), decision))
-            else:
-                def fn(scans_in, ts_in, np_, pid_):
-                    return runf(RunContext(scans_in, ts_in, np_, pid_))
-                jfn = jax.jit(fn)
-            self._exec_cache[key] = (jfn, meta)
-        else:
-            jfn, meta = cached
-
-        ts = read_ts or self._read_ts(prep.session)
-        tsv = np.int64(ts.to_int())
-        nparts = 2
-        while nparts <= self.MAX_SPILL_PARTITIONS:
-            try:
-                all_rows: list[tuple] = []
-                for pid in range(nparts):
-                    out = jfn(prep.scans, tsv, np.int32(nparts),
-                              np.int32(pid))
-                    part = self._materialize(out, meta)
-                    all_rows.extend(part.rows)
-                break
-            except HashCapacityExceeded:
-                nparts *= 2
-        else:
-            raise HashCapacityExceeded(
-                f"GROUP BY did not fit hash_group_capacity even at "
-                f"{self.MAX_SPILL_PARTITIONS} spill partitions")
-
-        rows = all_rows
-        if sort_node is not None:
-            rows = _host_sort(rows, meta, sort_node.keys)
-        if limit_node is not None:
-            off = limit_node.offset or 0
-            end = (off + limit_node.limit
-                   if limit_node.limit is not None else None)
-            rows = rows[off:end]
-        return Result(names=list(meta.names), rows=rows)
-
-    # -- beyond-HBM streaming ------------------------------------------------
-    def _stream_decision(self, node, scan_aliases: dict, scan_cols: dict,
-                         session: Session):
-        """Page the fact table through HBM when its pruned upload would
-        not fit the device budget. Eligibility mirrors the mesh
-        distribution analysis (the plan must reduce to mergeable
-        aggregate partials); only the probe-spine scan streams.
-        Returns (alias, table, page_rows) or None."""
-        if session.vars.get("streaming", "auto") == "off":
-            return None
-        budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
-        if budget <= 0:
-            return None
-        if not can_stream(node):
-            # dist_analyze accepts more shapes (e.g. hash GROUP BY)
-            # than paging can compile; never pick those
-            return None
-        d = dist_analyze(node)
-        if not d.ok or len(d.sharded) != 1:
-            return None
-        alias = next(iter(d.sharded))
-        tname = scan_aliases[alias]
-        td = self.store.table(tname)
-        if td.row_count == 0:
-            return None
-        # working set = pruned upload + aggregation temporaries. XLA's
-        # segment reductions materialize ~2 n-length temps per
-        # aggregate concurrently (measured: TPC-H Q1 at 2^27 rows
-        # compiles to ~12GB of HLO temps), so a table that "fits" can
-        # still OOM at compile time without this term.
-        n_aggs = _count_aggs(node)
-        padded = max(_next_pow2(max(td.row_count, 1)), 1024)
-        temp_bytes = 16 * n_aggs * padded
-        if (self._table_device_bytes(td, scan_cols.get(alias))
-                + temp_bytes <= budget):
-            return None
-        # Build-side tables still upload whole: streaming the probe is
-        # strictly better than not, and an over-budget build fails
-        # upstream with a clean quota error rather than silently here.
-        page_rows = max(1024,
-                        int(session.vars.get("streaming_page_rows",
-                                             1 << 21)))
-        return (alias, tname, page_rows)
-
-    def _table_device_bytes(self, td, cols) -> int:
-        """Device bytes a pruned upload of this table would take."""
-        n = td.row_count
-        padded = max(_next_pow2(max(n, 1)), 1024)
-        total = 16 * padded  # the two MVCC int64 columns
-        for col in td.schema.columns:
-            if cols is not None and col.name not in cols:
-                continue
-            total += (np.dtype(col.type.np_dtype).itemsize + 1) * padded
-        return total
-
-    def _iter_pages(self, tname: str, cols, page_rows: int):
-        """Yield fixed-shape device pages of a table's chunks. Each
-        page is padded to page_rows with never-visible rows so one XLA
-        program serves every page."""
-        td = self.store.table(tname)
-        if td.open_ts:
-            self.store.seal(tname)
-        chunks = list(td.chunks)
-        total = sum(c.n for c in chunks)
-        names = [c.name for c in td.schema.columns
-                 if cols is None or c.name in cols]
-        start = 0
-        while start < total:
-            end = min(start + page_rows, total)
-            data = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.data[cn],
-                                      start, end)
-                    for cn in names}
-            valid = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.valid[cn],
-                                       start, end)
-                     for cn in names}
-            mts = _slice_chunks(chunks, lambda c: c.mvcc_ts, start, end)
-            mdl = _slice_chunks(chunks, lambda c: c.mvcc_del, start, end)
-            page = {cn: _pad(a, page_rows) for cn, a in data.items()}
-            page["_mvcc_ts"] = _pad(mts, page_rows, fill=np.int64(2**62))
-            page["_mvcc_del"] = _pad(mdl, page_rows, fill=np.int64(0))
-            vmap = {cn: _pad(v, page_rows) for cn, v in valid.items()
-                    if not v.all()}
-            yield ColumnBatch.from_dict(
-                {k: jnp.asarray(v) for k, v in page.items()},
-                {k: jnp.asarray(v) for k, v in vmap.items()})
-            start = end
-
-    # -- device table cache --------------------------------------------------
-    def _evict_device(self, key) -> None:
-        self._device_tables.pop(key, None)
-        self.hbm.release(key)
-
-    def drop_device_cache(self) -> None:
-        """Evict every resident table upload AND release its memory
-        reservation (a raw _device_tables.clear() would leak the
-        monitor's accounting)."""
-        for k in list(self._device_tables):
-            self._evict_device(k)
-
-    def _device_table(self, name: str, placement: str = "single",
-                      cols: frozenset | None = None) -> ColumnBatch:
-        td = self.store.table(name)
-        # a cached upload with a SUPERSET of the needed columns serves
-        # this scan directly (scans read columns by name); this keeps
-        # one resident copy per table instead of one per column set
-        for k, v in self._device_tables.items():
-            if (k[0] == name and k[1] == td.generation
-                    and k[2] == placement
-                    and (k[3] is None
-                         or (cols is not None and cols <= k[3]))):
-                return v
-        # evict stale generations of this table
-        for k in [k for k in self._device_tables if k[0] == name
-                  and k[1] != td.generation]:
-            self._evict_device(k)
-        if td.open_ts:
-            self.store.seal(name)
-        key = (name, td.generation, placement, cols)
-        # account BEFORE upload; replication costs a copy per device
-        nbytes = self._table_device_bytes(td, cols)
-        if placement == "replicated" and self.mesh is not None:
-            nbytes *= self.mesh.size
-        self.hbm.reserve(key, nbytes)
-        try:
-            b = self._batch_from_chunks(td, td.chunks, cols)
-            if placement == "sharded":
-                b = jax.device_put(b, meshmod.row_sharding(self.mesh))
-            elif placement == "replicated":
-                b = jax.device_put(b, meshmod.replicated(self.mesh))
-        except BaseException:
-            self.hbm.release(key)
-            raise
-        # drop now-redundant strict-subset uploads of the same table
-        for k in [k for k in self._device_tables
-                  if k[0] == name and k[1] == td.generation
-                  and k[2] == placement and k[3] is not None
-                  and (cols is None or k[3] < cols)]:
-            self._evict_device(k)
-        self._device_tables[key] = b
-        self.metrics.counter("sql.device.table_uploads",
-                             "resident table uploads to HBM").inc()
-        return b
-
-    def _batch_from_chunks(self, td, chunks: list,
-                           prune: frozenset | None = None) -> ColumnBatch:
-        """Concatenate chunks, pad to a power-of-two row bucket, and
-        upload as a device-resident ColumnBatch with MVCC columns.
-        With ``prune`` set, only those stored columns upload (the scan
-        projection; HBM is the scarce resource the reference's
-        needed-columns fetch logic protects, cfetcher.go:668)."""
-        cols: dict[str, np.ndarray] = {}
-        valid: dict[str, np.ndarray] = {}
-        n = sum(c.n for c in chunks)
-        padded = max(_next_pow2(max(n, 1)), 1024)
-        for col in td.schema.columns:
-            cn = col.name
-            if prune is not None and cn not in prune:
-                continue
-            parts = [c.data[cn] for c in chunks]
-            arr = (np.concatenate(parts) if parts
-                   else np.zeros(0, dtype=col.type.np_dtype))
-            vparts = [c.valid[cn] for c in chunks]
-            va = np.concatenate(vparts) if vparts else np.zeros(0, bool)
-            cols[cn] = _pad(arr, padded)
-            if not va.all():
-                # all-valid masks regenerate on device (ones) for free
-                # instead of paying PCIe for a constant
-                valid[cn] = _pad(va, padded)
-        ts_parts = [c.mvcc_ts for c in chunks]
-        del_parts = [c.mvcc_del for c in chunks]
-        mts = np.concatenate(ts_parts) if ts_parts else np.zeros(0, np.int64)
-        mdl = (np.concatenate(del_parts) if del_parts
-               else np.zeros(0, np.int64))
-        # padding rows are never visible: created at +inf
-        cols["_mvcc_ts"] = _pad(mts, padded, fill=np.int64(2**62))
-        cols["_mvcc_del"] = _pad(mdl, padded, fill=np.int64(0))
-        return ColumnBatch.from_dict(
-            {k: jnp.asarray(v) for k, v in cols.items()},
-            {k: jnp.asarray(v) for k, v in valid.items()})
-
-    def _overlay_batch(self, name: str, effects: list,
-                       read_ts: Timestamp) -> ColumnBatch:
-        """Uncached device snapshot of committed chunks + this txn's
-        buffered effects (read-your-own-writes)."""
-        td = self.store.table(name)
-        chunks = self._overlay_chunks(name, effects, read_ts)
-        return self._batch_from_chunks(td, chunks)
-
-    # -- result materialization ---------------------------------------------
-    def _materialize(self, out: ColumnBatch, meta: P.OutputMeta) -> Result:
-        if out.has("__ht_overflow"):
-            if bool(np.asarray(out.col("__ht_overflow"))[0]):
-                raise HashCapacityExceeded(
-                    "GROUP BY cardinality exceeded hash_group_capacity; "
-                    "SET hash_group_capacity to a larger power of two")
-        if out.has("__sum_overflow"):
-            if bool(np.asarray(out.col("__sum_overflow"))[0]):
-                raise EngineError(
-                    "decimal SUM overflowed int64 accumulation; "
-                    "CAST the argument to FLOAT to trade exactness for range")
-        host = out.to_host()
-        res = Result(names=list(meta.names), types=list(meta.types))
-        cols = []
-        for name, ty in zip(meta.names, meta.types):
-            arr = host[name]
-            d = meta.dictionaries.get(name)
-            cols.append(_decode_column(arr, ty, d))
-        res.rows = list(zip(*cols)) if cols else []
-        return res
-
-    # -- DDL -----------------------------------------------------------------
-    def _exec_create(self, c: ast.CreateTable) -> Result:
-        from ..catalog import (CatalogError, IndexDescriptor,
-                               TableDescriptor)
-        if c.name in self.store.tables:
-            if c.if_not_exists:
-                return Result(tag="CREATE TABLE")
-            raise EngineError(f"table {c.name!r} already exists")
-        schema = TableSchema(
-            name=c.name,
-            columns=[ColumnSchema(d.name, d.type, d.nullable)
-                     for d in c.columns],
-            primary_key=list(c.primary_key))
-        colnames = {d.name for d in c.columns}
-        # validate FK references now (the reference resolves them in
-        # the descriptor builder): target must exist and the referenced
-        # columns must be its primary key or a unique index
-        # unique column / table constraints become unique indexes at
-        # birth (the table is empty — no backfill, straight to PUBLIC)
-        uniq_sets = [[d.name] for d in c.columns if d.unique] \
-            + [list(u) for u in c.uniques]
-        fk_records = []
-        for fkname, lcols, rt, rcols in c.foreign_keys:
-            for cn in lcols:
-                if cn not in colnames:
-                    raise EngineError(f"fk column {cn!r} not in table")
-            if rt == c.name:
-                # self-referential: validate against the in-flight
-                # definition (the table does not exist yet)
-                rcols = rcols or list(c.primary_key)
-                unique_sets = [tuple(c.primary_key)] + \
-                    [tuple(u) for u in uniq_sets]
-            elif rt in self.store.tables:
-                rschema = self.store.table(rt).schema
-                rcols = rcols or list(rschema.primary_key)
-                unique_sets = [tuple(rschema.primary_key)] + [
-                    tuple(i.columns) for i in self._table_indexes(rt)
-                    if i.unique]
-            else:
-                raise EngineError(
-                    f"referenced table {rt!r} does not exist")
-            if tuple(rcols) not in unique_sets:
-                raise EngineError(
-                    f"foreign key must reference a primary key or "
-                    f"unique index of {rt!r} (got {rcols})")
-            if len(rcols) != len(lcols):
-                raise EngineError("foreign key column count mismatch")
-            fk_records.append({"name": fkname, "columns": list(lcols),
-                               "ref_table": rt,
-                               "ref_columns": list(rcols)})
-        for u in uniq_sets:
-            for cn in u:
-                if cn not in colnames:
-                    raise EngineError(
-                        f"unique column {cn!r} not in table")
-        desc0 = TableDescriptor.from_schema(schema)
-        desc0.checks = [{"name": n, "expr_sql": text}
-                        for n, _e, text in c.checks]
-        desc0.fks = fk_records
-        desc0.indexes = [
-            IndexDescriptor(f"{c.name}_{'_'.join(u)}_key", 2 + i,
-                            list(u), True, "public")
-            for i, u in enumerate(uniq_sets)]
-        # the descriptor (catalog, system of record) is written first,
-        # transactionally — two racing CREATEs conflict on the
-        # namespace key; the columnstore table is the scan-plane
-        # materialization keyed by the allocated descriptor id
-        try:
-            desc = self.catalog.create_table(desc0)
-        except CatalogError as e:
-            if c.if_not_exists:
-                return Result(tag="CREATE TABLE")
-            raise EngineError(str(e)) from e
-        schema.table_id = desc.id
-        self.store.create_table(schema)
-        self._index_defs.pop(c.name, None)
-        self._constraint_defs.pop(c.name, None)
-        self._fk_children = None
-        # CHECK expressions must bind against the new schema (catches
-        # unknown columns / type errors at DDL time)
-        try:
-            scope, _ = self._dml_scope(c.name)
-            for n, e, _text in c.checks:
-                b = Binder(scope).bind(e)
-                if b.type.family != Family.BOOL:
-                    raise EngineError(
-                        f"check constraint {n!r} must be boolean")
-        except Exception:
-            self.store.drop_table(c.name)
-            self.catalog.drop_table(c.name)
-            self._fk_children = None
-            raise
-        return Result(tag="CREATE TABLE")
-
-    def _exec_drop(self, d: ast.DropTable) -> Result:
-        from ..catalog import CatalogError
-        if d.name in self._view_map():
-            raise EngineError(
-                f"{d.name!r} is a view; use DROP VIEW")
-        deps = [v for v, vd in self._view_map().items()
-                if d.name in _stmt_table_refs(
-                    parser.parse(vd.view_sql))]
-        if deps:
-            raise EngineError(
-                f"cannot drop table {d.name!r}: view(s) "
-                f"{sorted(deps)} depend on it")
-        fk_deps = sorted({child for child, _fk in
-                          self._fk_children_of(d.name)
-                          if child != d.name})
-        if fk_deps:
-            raise EngineError(
-                f"cannot drop table {d.name!r}: foreign key(s) on "
-                f"{fk_deps} reference it")
-        if d.name not in self.store.tables:
-            if d.if_exists:
-                return Result(tag="DROP TABLE")
-            raise EngineError(f"table {d.name!r} does not exist")
-        try:
-            self.catalog.drop_table(d.name)
-        except CatalogError:
-            pass  # store-only table (pre-catalog tests); still drop it
-        self.store.drop_table(d.name)
-        self._index_defs.pop(d.name, None)
-        self._constraint_defs.pop(d.name, None)
-        self._fk_children = None
-        for k in [k for k in self._device_tables if k[0] == d.name]:
-            self._evict_device(k)
-        return Result(tag="DROP TABLE")
-
-    # -- secondary indexes ----------------------------------------------------
-    # Design (vs pkg/sql/rowenc + colfetcher/index_join.go): the scan
-    # plane is columnar and the analytic path never decodes keys, so a
-    # non-unique index is a *derived* host-side locator over the
-    # columnstore (generation-cached, storage/columnstore.py
-    # ensure_secondary_index) used for point-read/DML acceleration.
-    # UNIQUE indexes additionally materialize KV entries at
-    # /Table/<tid>/<index_id>/<vals> -> pk-key through the row-plane
-    # txn, so two concurrent writers of the same value conflict
-    # transactionally — the same guarantee the reference gets from
-    # CPut on index keys (pkg/sql/row/writer.go).
-
-    def _table_indexes(self, table: str) -> list:
-        cached = self._index_defs.get(table)
-        if cached is not None:
-            return cached
-        # a transient catalog error must fail the statement, NOT be
-        # cached as "no indexes" (which would silently drop unique
-        # enforcement); a missing descriptor (pre-catalog test table)
-        # legitimately has none
-        d = self.catalog.get_by_name(table)
-        idxs = list(d.indexes) if d is not None else []
-        self._index_defs[table] = idxs
-        return idxs
-
-    def _exec_create_index(self, c: ast.CreateIndex,
-                           session: Session) -> Result:
-        from ..catalog import IndexDescriptor
-        from ..catalog.descriptor import WRITE_ONLY
-        from ..jobs.schemachange import INDEX_BACKFILL_JOB
-        if c.table not in self.store.tables:
-            raise EngineError(f"table {c.table!r} does not exist")
-        td = self.store.table(c.table)
-        for cn in c.columns:
-            try:
-                td.schema.column(cn)
-            except KeyError:
-                raise EngineError(
-                    f"column {cn!r} does not exist in {c.table!r}")
-        desc = self.catalog.get_by_name(c.table)
-        if desc is None:
-            raise EngineError(
-                f"table {c.table!r} has no descriptor (pre-catalog)")
-        if c.name == "primary":
-            raise EngineError(
-                "index name 'primary' is reserved for the primary key")
-        if any(i.name == c.name for i in desc.indexes):
-            if c.if_not_exists:
-                return Result(tag="CREATE INDEX")
-            raise EngineError(
-                f"index {c.name!r} already exists on {c.table!r}")
-        next_id = 1 + max([i.index_id for i in desc.indexes],
-                          default=1)  # primary index is 1
-        # step 1: WRITE_ONLY — after the lease drain every writer
-        # maintains the index, but readers don't use it yet
-        desc.indexes.append(IndexDescriptor(
-            c.name, next_id, list(c.columns), c.unique, WRITE_ONLY))
-        desc = self.leases.publish(desc)
-        self._index_defs.pop(c.table, None)
-        # step 2: chunk-checkpointed backfill + validation + PUBLIC
-        # publish as a durable job (resumable after a crash), like the
-        # reference's index backfiller (pkg/sql/backfill via pkg/jobs)
-        job_id = self.jobs.create(INDEX_BACKFILL_JOB,
-                                  {"table": c.table, "index": c.name})
-        rec = self.jobs.run_job(job_id)
-        self._index_defs.pop(c.table, None)
-        if rec.status != "succeeded":
-            raise EngineError(
-                f"CREATE INDEX failed: {rec.error or rec.status}")
-        return Result(tag="CREATE INDEX")
-
-    def _exec_drop_index(self, d_stmt: ast.DropIndex,
-                         session: Session) -> Result:
-        found = []
-        for desc in self.catalog.list_tables():
-            for i in desc.indexes:
-                if i.name == d_stmt.name:
-                    found.append((desc, i))
-        if not found:
-            if d_stmt.if_exists:
-                return Result(tag="DROP INDEX")
-            raise EngineError(f"index {d_stmt.name!r} does not exist")
-        if len(found) > 1:
-            tables = sorted(d.name for d, _ in found)
-            raise EngineError(
-                f"index name {d_stmt.name!r} is ambiguous (exists on "
-                f"tables {tables}); drop and recreate with distinct "
-                f"names")
-        desc, idx = found[0]
-        desc.indexes = [i for i in desc.indexes if i.name != idx.name]
-        self.leases.publish(desc)
-        self._index_defs.pop(desc.name, None)
-        if idx.unique:
-            # clear the index keyspace (the reference runs this as a
-            # GC-TTL'd schema-change job; immediate here)
-            p = K.table_prefix(desc.id, idx.index_id)
-            self.kv.txn(lambda t: t.delete_range(p, K.prefix_end(p)))
-        return Result(tag="DROP INDEX")
-
-    # -- views ----------------------------------------------------------------
-    # A view is a descriptor carrying SQL text; every use re-plans it
-    # as a derived table (pkg/sql/create_view.go + opt view expansion).
-
-    def _view_map(self) -> dict:
-        if getattr(self, "_view_defs", None) is None:
-            self._view_defs = {
-                d.name: d for d in self.catalog.list_tables()
-                if d.view_sql}
-        return self._view_defs
-
-    def _expand_views(self, sel: ast.Select,
-                      depth: int = 0) -> ast.Select:
-        views = self._view_map()
-        # SQL scoping: a CTE binding shadows a same-named view
-        cte_names = {name for name, _c, _s in sel.ctes}
-        if cte_names:
-            views = {k: v for k, v in views.items()
-                     if k not in cte_names}
-        if not views:
-            return sel
-        if depth > 16:
-            raise EngineError("view nesting too deep (cycle?)")
-        import copy
-        refs = ([sel.table] if sel.table is not None else []) \
-            + [j.table for j in sel.joins]
-        if not any(r.subquery is None and r.name in views
-                   for r in refs):
-            return sel
-        sel = copy.copy(sel)
-
-        def expand_ref(ref: ast.TableRef) -> ast.TableRef:
-            if ref.subquery is not None or ref.name not in views:
-                return ref
-            d = views[ref.name]
-            body = parser.parse(d.view_sql)
-            if not isinstance(body, ast.Select):
-                raise EngineError(
-                    f"view {d.name!r} body is not a plain SELECT")
-            body = self._expand_views(body, depth + 1)
-            if d.view_columns:
-                body = copy.copy(body)
-                body.items = [
-                    ast.SelectItem(it.expr, alias=cn, star=False)
-                    for it, cn in zip(body.items, d.view_columns)]
-            return ast.TableRef(name=f"__view_{d.name}",
-                                alias=ref.alias or ref.name,
-                                subquery=body)
-
-        if sel.table is not None:
-            sel.table = expand_ref(sel.table)
-        sel.joins = [ast.JoinClause(expand_ref(j.table), j.join_type,
-                                    j.on) for j in sel.joins]
-        return sel
-
-    def _exec_create_view(self, c: ast.CreateView,
-                          session: Session) -> Result:
-        import copy
-        from ..catalog import CatalogError, TableDescriptor
-        if c.name in self.store.tables or c.name in self._view_map():
-            if c.if_not_exists:
-                return Result(tag="CREATE VIEW")
-            raise EngineError(f"relation {c.name!r} already exists")
-        if not isinstance(c.select, ast.Select):
-            raise EngineError(
-                "CREATE VIEW body must be a plain SELECT")
-        if c.columns is not None and any(
-                it.star for it in c.select.items):
-            raise EngineError(
-                "view column list requires explicit select items")
-        # validate by executing the body with LIMIT 0 — catches
-        # unknown tables/columns and type errors at DDL time, like the
-        # reference's view dependency check
-        probe = copy.deepcopy(c.select)
-        probe.limit = 0
-        res = self._exec_select(probe, session,
-                                f"(create-view {c.name})")
-        if c.columns is not None and len(c.columns) != len(res.names):
-            raise EngineError(
-                f"view column list has {len(c.columns)} names, "
-                f"SELECT produces {len(res.names)}")
-        try:
-            self.catalog.create_table(TableDescriptor(
-                id=0, name=c.name, view_sql=c.sql,
-                view_columns=list(c.columns or [])))
-        except CatalogError as e:
-            if c.if_not_exists:
-                return Result(tag="CREATE VIEW")
-            raise EngineError(str(e)) from e
-        self._view_defs = None
-        return Result(tag="CREATE VIEW")
-
-    def _exec_drop_view(self, d: ast.DropView) -> Result:
-        if d.name not in self._view_map():
-            if d.if_exists:
-                return Result(tag="DROP VIEW")
-            raise EngineError(f"view {d.name!r} does not exist")
-        deps = [v for v, vd in self._view_map().items()
-                if v != d.name and d.name in _stmt_table_refs(
-                    parser.parse(vd.view_sql))]
-        if deps:
-            raise EngineError(
-                f"cannot drop view {d.name!r}: view(s) "
-                f"{sorted(deps)} depend on it")
-        self.catalog.drop_table(d.name)
-        self._view_defs = None
-        return Result(tag="DROP VIEW")
-
-    # -- sequences (DDL) ------------------------------------------------------
-    def _exec_create_sequence(self, c: ast.CreateSequence) -> Result:
-        import json as _json
-        key = self.SEQ_PREFIX + c.name.encode()
-
-        def fn(t):
-            if t.get(key) is not None:
-                if c.if_not_exists:
-                    return
-                raise EngineError(
-                    f"sequence {c.name!r} already exists")
-            t.put(key, _json.dumps({
-                "start": c.start, "increment": c.increment,
-                "value": None}).encode())
-        self.kv.txn(fn)
-        return Result(tag="CREATE SEQUENCE")
-
-    def _exec_drop_sequence(self, d: ast.DropSequence) -> Result:
-        key = self.SEQ_PREFIX + d.name.encode()
-
-        def fn(t):
-            if t.get(key) is None:
-                if d.if_exists:
-                    return
-                raise EngineError(
-                    f"sequence {d.name!r} does not exist")
-            t.delete(key)
-        self.kv.txn(fn)
-        return Result(tag="DROP SEQUENCE")
-
-    # -- TRUNCATE -------------------------------------------------------------
-    def _exec_truncate(self, tr: ast.Truncate) -> Result:
-        """Clear all rows + KV pairs + index entries, keep the schema
-        (the reference swaps in fresh empty indexes and lets GC reap
-        the old keyspace, pkg/sql/truncate.go)."""
-        if tr.table not in self.store.tables:
-            raise EngineError(f"table {tr.table!r} does not exist")
-        fk_deps = sorted({child for child, _fk in
-                          self._fk_children_of(tr.table)
-                          if child != tr.table})
-        if fk_deps:
-            raise EngineError(
-                f"cannot truncate {tr.table!r}: foreign key(s) on "
-                f"{fk_deps} reference it")
-        td = self.store.table(tr.table)
-        schema = td.schema
-        # the whole table keyspace: every index id under the table
-        base = bytearray(K.TABLE_PREFIX)
-        K.encode_int(base, schema.table_id)
-        base = bytes(base)
-        self.kv.txn(lambda t: t.delete_range(base, K.prefix_end(base)))
-        self.store.drop_table(tr.table)
-        self.store.create_table(schema)
-        self._evict(tr.table)
-        return Result(tag="TRUNCATE")
-
-    # -- constraints (CHECK + FOREIGN KEY, restrict semantics) ---------------
-    # The analogue of the reference's row-level constraint checks
-    # (pkg/sql/row/fk_existence_*.go, check constraints in the
-    # writer). FK existence probes run against the scan-plane index
-    # locators plus this txn's buffered effects; concurrent-txn races
-    # are serialized by the KV plane the same way unique indexes are.
-
-    def _table_constraints(self, table: str) -> tuple:
-        cached = self._constraint_defs.get(table)
-        if cached is not None:
-            return cached
-        d = self.catalog.get_by_name(table)
-        out = ((list(d.checks), list(d.fks)) if d is not None
-               else ([], []))
-        self._constraint_defs[table] = out
-        return out
-
-    def _fk_children_of(self, table: str) -> list:
-        """[(child_table, fk_record)] of FKs referencing `table`."""
-        if self._fk_children is None:
-            m: dict[str, list] = {}
-            for d in self.catalog.list_tables():
-                for fk in d.fks:
-                    m.setdefault(fk["ref_table"], []).append(
-                        (d.name, fk))
-            self._fk_children = m
-        return self._fk_children.get(table, [])
-
-    def _enforce_checks(self, table: str, td, rows: list,
-                        rts: int) -> None:
-        checks, _ = self._table_constraints(table)
-        if not checks or not rows:
-            return
-        # the mini chunk must be built FIRST: encoding the new rows
-        # can append fresh string values to the table dictionaries,
-        # and the compiled predicate bakes dictionary lookup tables —
-        # compiling before the growth would miss the new codes
-        mini = self._delta_chunk(td, rows, rts)
-        # compiled per (table, string-dictionary sizes): dictionary
-        # growth recompiles — same fingerprint idea as the plan cache
-        dictlens = tuple(sorted((cn, len(d)) for cn, d in
-                                td.dictionaries.items()))
-        key = (table, dictlens)
-        fns = getattr(self, "_check_fn_cache", None)
-        if fns is None:
-            fns = self._check_fn_cache = {}
-        compiled = fns.get(key)
-        if compiled is None:
-            scope, _s = self._dml_scope(table)
-            compiled = []
-            for ck in checks:
-                e = parser.Parser(ck["expr_sql"]).parse_expr()
-                b = Binder(scope).bind(e)
-                compiled.append((ck, compile_expr(b)))
-            # evict stale entries for THIS table (old dictlens), keep
-            # other tables' hot entries
-            for k in [k for k in fns if k[0] == table]:
-                del fns[k]
-            fns[key] = compiled
-        ctx = ExprContext(
-            {f"{table}.{k}": (mini.data[k], mini.valid[k])
-             for k in mini.data}, mini.n)
-        for ck, f in compiled:
-            with self._host_eval():
-                d, v = f(ctx)
-                # SQL: CHECK fails only on FALSE (NULL passes)
-                viol = np.asarray(jnp.logical_and(
-                    jnp.logical_not(d), v))
-            if viol.any():
-                raise EngineError(
-                    f"new row violates check constraint "
-                    f"{ck['name']!r} ({ck['expr_sql']})")
-
-    def _fk_parent_exists(self, fk: dict, vals: tuple, session,
-                          rts: int) -> bool:
-        rt = fk["ref_table"]
-        rtd = self.store.table(rt)
-        pending = (self._txn_key_state(session.effects, rt)
-                   if session is not None and session.txn is not None
-                   else {})
-        sec = self.store.ensure_secondary_index(
-            rt, tuple(fk["ref_columns"]))
-        for ci, ri in sec.get(vals, []):
-            ch = rtd.chunks[ci]
-            if not (ch.mvcc_ts[ri] <= rts < ch.mvcc_del[ri]):
-                continue
-            if pending and self.store.row_key(rtd, ch, ri) in pending:
-                continue  # deleted/superseded in this txn
-            return True
-        for _k, r in pending.items():
-            if r is None:
-                continue
-            if tuple(r.get(c) for c in fk["ref_columns"]) == vals:
-                return True
-        return False
-
-    def _enforce_fks(self, table: str, rows: list, session,
-                     rts: int) -> None:
-        """Child-side: every non-NULL FK value in `rows` must have a
-        visible parent row."""
-        _checks, fks = self._table_constraints(table)
-        for fk in fks:
-            # self-FKs may be satisfied by rows of this very statement
-            self_vals = None
-            if fk["ref_table"] == table:
-                self_vals = {tuple(r.get(c) for c in fk["ref_columns"])
-                             for r in rows}
-            for r in rows:
-                vals = tuple(r.get(c) for c in fk["columns"])
-                if any(v is None for v in vals):
-                    continue
-                if self_vals is not None and vals in self_vals:
-                    continue
-                if not self._fk_parent_exists(fk, vals, session, rts):
-                    raise EngineError(
-                        f"insert on {table!r} violates foreign key "
-                        f"{fk['name']!r}: no row in "
-                        f"{fk['ref_table']!r} with "
-                        f"{fk['ref_columns']} = {vals!r}")
-
-    def _enforce_fk_restrict(self, table: str, removed_rows: list,
-                             session, rts: int) -> None:
-        """Parent-side RESTRICT: removing/changing a referenced key
-        fails while child rows still point at it."""
-        for child, fk in self._fk_children_of(table):
-            if child not in self.store.tables:
-                continue
-            ctd = self.store.table(child)
-            pending = (self._txn_key_state(session.effects, child)
-                       if session is not None
-                       and session.txn is not None else {})
-            sec = self.store.ensure_secondary_index(
-                child, tuple(fk["columns"]))
-            for row in removed_rows:
-                vals = tuple(row.get(c) for c in fk["ref_columns"])
-                if any(v is None for v in vals):
-                    continue
-                for ci, ri in sec.get(vals, []):
-                    ch = ctd.chunks[ci]
-                    if not (ch.mvcc_ts[ri] <= rts < ch.mvcc_del[ri]):
-                        continue
-                    if pending and self.store.row_key(
-                            ctd, ch, ri) in pending:
-                        continue
-                    raise EngineError(
-                        f"delete/update on {table!r} violates "
-                        f"foreign key {fk['name']!r} on {child!r}: "
-                        f"row still references {vals!r}")
-                for _k, r in pending.items():
-                    if r is not None and tuple(
-                            r.get(c) for c in fk["columns"]) == vals:
-                        raise EngineError(
-                            f"delete/update on {table!r} violates "
-                            f"foreign key {fk['name']!r} on "
-                            f"{child!r} (pending row)")
-
-    def _maintain_indexes(self, table: str, td, t: Txn, pending: dict,
-                          old_row, new_row, rts: int) -> None:
-        """Per-row index maintenance inside a DML txn: drop stale
-        unique-index KV entries for old_row, uniqueness-check and
-        write entries for new_row. NULL in any indexed column exempts
-        the row (SQL unique semantics)."""
-        idxs = self._table_indexes(table)
-        if not idxs:
-            return
-        tid = td.schema.table_id
-        for idx in idxs:
-            cols = tuple(idx.columns)
-            old_vals = (tuple(old_row.get(cn) for cn in cols)
-                        if old_row is not None else None)
-            if old_vals is not None and any(v is None for v in old_vals):
-                old_vals = None
-            new_vals = (tuple(new_row.get(cn) for cn in cols)
-                        if new_row is not None else None)
-            if new_vals is not None and any(v is None for v in new_vals):
-                new_vals = None
-            if not idx.unique or old_vals == new_vals:
-                continue
-            if old_vals is not None:
-                t.delete(K.table_key(tid, old_vals, idx.index_id))
-            if new_vals is not None:
-                self._check_unique(table, td, idx, new_vals, t,
-                                   pending, new_row, rts)
-                t.put(K.table_key(tid, new_vals, idx.index_id),
-                      td.codec.key(new_row))
-
-    def _check_unique(self, table: str, td, idx, vals: tuple, t: Txn,
-                      pending: dict, new_row: dict, rts: int) -> None:
-        tid = td.schema.table_id
-        new_key = td.codec.key(new_row)
-        # 1. the KV entry: covers committed rows written through the
-        # row plane AND this txn's earlier writes (MVCC reads see own
-        # intents); concurrent writers conflict on this same key
-        raw = t.get(K.table_key(tid, vals, idx.index_id))
-        if raw is not None and raw != new_key:
-            raise EngineError(
-                f"duplicate key value {vals!r} violates unique "
-                f"index {idx.name!r} of {table!r}")
-        # 2. the scan plane: covers bulk-ingested rows that never had
-        # KV pairs (tpch.load-style ingest); visibility at our read ts
-        sec = self.store.ensure_secondary_index(table, tuple(idx.columns))
-        for ci, ri in sec.get(vals, []):
-            c = td.chunks[ci]
-            if not (c.mvcc_ts[ri] <= rts < c.mvcc_del[ri]):
-                continue
-            rk = self.store.row_key(td, c, ri)
-            if rk == new_key or rk in pending:
-                continue  # the row being replaced / superseded in-txn
-            raise EngineError(
-                f"duplicate key value {vals!r} violates unique "
-                f"index {idx.name!r} of {table!r}")
-
-    # -- schema changes -------------------------------------------------------
-    @property
-    def jobs(self):
-        """Lazily-built jobs registry for engine-initiated work
-        (schema changes); Nodes build their own adopting registry."""
-        if getattr(self, "_jobs", None) is None:
-            from ..cdc import CHANGEFEED_JOB, ChangefeedResumer
-            from ..jobs import Registry
-            from ..jobs.schemachange import (INDEX_BACKFILL_JOB,
-                                             SCHEMA_CHANGE_JOB,
-                                             IndexBackfillResumer,
-                                             SchemaChangeResumer)
-            self._jobs = Registry(self.kv,
-                                  session_id=f"engine-{id(self)}")
-            self._jobs.register(SCHEMA_CHANGE_JOB,
-                                lambda: SchemaChangeResumer(self))
-            self._jobs.register(INDEX_BACKFILL_JOB,
-                                lambda: IndexBackfillResumer(self))
-            self._jobs.register(CHANGEFEED_JOB,
-                                lambda: ChangefeedResumer(self))
-            from ..jobs.backup import (BACKUP_JOB, RESTORE_JOB,
-                                       BackupResumer, RestoreResumer)
-            self._jobs.register(BACKUP_JOB,
-                                lambda: BackupResumer(self))
-            self._jobs.register(RESTORE_JOB,
-                                lambda: RestoreResumer(self))
-            from ..jobs.ttl import TTL_JOB, TTLResumer
-            self._jobs.register(TTL_JOB, lambda: TTLResumer(self))
-        return self._jobs
-
-    @property
-    def protectedts(self):
-        if getattr(self, "_pts", None) is None:
-            from ..kv.protectedts import ProtectedTimestamps
-            self._pts = ProtectedTimestamps(self.kv)
-        return self._pts
-
-    def zone_config(self, table: str) -> dict:
-        """Per-table config overrides (the spanconfig analogue),
-        stored at /zone/<table>; empty = cluster defaults apply."""
-        import json as _json
-        raw = self.kv.txn(
-            lambda t: t.get(b"/zone/" + table.encode()))
-        return _json.loads(raw.decode()) if raw else {}
-
-    def run_gc(self, table: str) -> int:
-        """One MVCC GC pass (mvcc_gc_queue analogue): drop versions
-        deleted more than the gc ttl ago (zone override, else the
-        cluster setting), clamped below the oldest protected timestamp
-        covering the table."""
-        zone = self.zone_config(table)
-        ttl_s = zone.get("gc.ttl_seconds",
-                         self.settings.get("kv.gc.ttl_seconds"))
-        ttl_ns = int(ttl_s) * 10 ** 9
-        threshold = self.clock.now().wall - ttl_ns
-        prot = self.protectedts.min_protected(table)
-        if prot is not None:
-            threshold = min(threshold, prot - 1)
-        if threshold <= 0:
-            return 0
-        # GC compacts td.chunks (positions shift); statements hold
-        # locator (chunk, row) positions across store-lock sections, so
-        # GC must serialize with statement execution — the maintenance
-        # thread calls this directly (server/node.py)
-        with self._stmt_lock:
-            n = self.store.gc(table, Timestamp(threshold, 0))
-            if n:
-                self._evict(table)
-        return n
-
-    def run_ttl(self, table: str, ttl_col: str,
-                ttl_seconds: int) -> int:
-        """One row-TTL pass over `table` (pkg/ttl analogue): deletes
-        rows whose ttl_col is older than ttl_seconds; returns the job
-        id. Scheduling the pass is the caller's loop."""
-        from ..jobs.ttl import TTL_JOB
-        jid = self.jobs.create(TTL_JOB, {
-            "table": table, "ttl_col": ttl_col,
-            "ttl_seconds": ttl_seconds})
-        rec = self.jobs.run_job(jid)
-        if rec.status != "succeeded":
-            raise EngineError(f"TTL job failed: {rec.error}")
-        return jid
-
-    def create_changefeed(self, table: str, sink: str,
-                          cursor: int = 0,
-                          resolved_every_s: float = 0.05) -> int:
-        """Start a changefeed job tailing `table` into `sink`
-        (mem://name or file://path); returns the job id. Runs on a
-        background thread until canceled (jobs.cancel(id))."""
-        from ..cdc import CHANGEFEED_JOB
-        if table not in self.store.tables:
-            raise EngineError(f"table {table!r} does not exist")
-        job_id = self.jobs.create(CHANGEFEED_JOB, {
-            "table": table, "sink": sink, "cursor": cursor,
-            "resolved_every_s": resolved_every_s})
-        th = threading.Thread(target=self._run_changefeed,
-                              args=(job_id,), daemon=True)
-        self._cdc_threads[job_id] = th
-        th.start()
-        return job_id
-
-    def _run_changefeed(self, job_id: int) -> None:
-        from ..jobs import JobsError
-        try:
-            self.jobs.run_job(job_id)
-        except (JobsError, Exception):
-            pass  # terminal state is in the job record
-
-    def _exec_alter(self, a: ast.AlterTable, session: Session) -> Result:
-        """Online schema change: the descriptor moves through
-        WRITE_ONLY -> (backfill job) -> PUBLIC with a lease drain at
-        each version bump (catalog/lease.py), like the reference's
-        schema changer (pkg/sql/schemachanger via pkg/jobs)."""
-        from ..catalog import CatalogError
-        from ..catalog.descriptor import WRITE_ONLY, ColumnDescriptor
-        from ..jobs.schemachange import SCHEMA_CHANGE_JOB
-        if a.table not in self.store.tables:
-            raise EngineError(f"table {a.table!r} does not exist")
-        desc = self.catalog.get_by_name(a.table)
-        if desc is None:
-            raise EngineError(
-                f"table {a.table!r} has no descriptor (pre-catalog)")
-        if a.drop is not None:
-            colname = a.drop
-            if not any(c.name == colname for c in desc.columns):
-                raise EngineError(f"column {colname!r} does not exist")
-            if colname in desc.primary_key:
-                raise EngineError(
-                    f"cannot drop primary key column {colname!r}")
-            refs = [i.name for i in desc.indexes
-                    if colname in i.columns]
-            if refs:
-                raise EngineError(
-                    f"cannot drop column {colname!r}: referenced by "
-                    f"index(es) {sorted(refs)}; drop them first")
-            # step 1: hide from readers, publish, drain leases
-            desc.column(colname).state = WRITE_ONLY
-            self.store.hide_column(a.table, colname)
-            desc = self.leases.publish(desc)
-            # step 2: physically remove, publish the final version
-            desc.columns = [c for c in desc.columns
-                            if c.name != colname]
-            self.store.drop_column(a.table, colname)
-            self.leases.publish(desc)
-            for k in [k for k in self._device_tables
-                      if k[0] == a.table]:
-                self._evict_device(k)
-            return Result(tag="ALTER TABLE")
-
-        # ADD COLUMN
-        cdef = a.add
-        if any(c.name == cdef.name for c in desc.columns):
-            raise EngineError(f"column {cdef.name!r} already exists")
-        default_phys = None
-        if a.default is not None:
-            binder = Binder(Scope())
-            b = binder.bind(a.default)
-            if not isinstance(b, BConst):
-                raise EngineError("DEFAULT must be a constant")
-            if b.value is not None:
-                default_phys = binder.coerce(b, cdef.type).value
-        if not cdef.nullable and default_phys is None \
-                and self.store.table(a.table).row_count > 0:
-            raise EngineError(
-                "adding NOT NULL column to non-empty table requires "
-                "DEFAULT")
-        # step 1: WRITE_ONLY descriptor + hidden physical column —
-        # writes carry it, readers don't see it yet
-        desc.columns.append(ColumnDescriptor(
-            cdef.name, cdef.type, cdef.nullable, WRITE_ONLY,
-            default_phys))
-        desc = self.leases.publish(desc)
-        self.store.add_column(
-            a.table, ColumnSchema(cdef.name, cdef.type, cdef.nullable),
-            default=default_phys, hidden=True)
-        # step 2+3: chunk-checkpointed backfill + PUBLIC publish run as
-        # a durable job (resumable after a crash)
-        job_id = self.jobs.create(SCHEMA_CHANGE_JOB,
-                                  {"table": a.table,
-                                   "column": cdef.name})
-        rec = self.jobs.run_job(job_id)
-        if rec.status != "succeeded":
-            raise EngineError(
-                f"schema change failed: {rec.error or rec.status}")
-        for k in [k for k in self._device_tables if k[0] == a.table]:
-            self._evict_device(k)
-        return Result(tag="ALTER TABLE")
-
-    # -- DML (through the transactional KV plane) ----------------------------
-    # Every DML statement writes row intents through kv.Txn (latches,
-    # tscache floors, pushes, read refresh — the TxnCoordSender stack)
-    # and records scan-plane effects that are published into the
-    # columnstore only at the commit timestamp. Mirrors the reference's
-    # write path: sql/row writers -> kv.Txn -> intents, resolved at
-    # commit (pkg/kv/db.go:896, pkg/sql/row/writer.go).
-
-    def _dml(self, session: Session, fn) -> Result:
-        """Run fn(txn, effects)->Result in the session's open txn, or
-        in a fresh auto-commit txn with the kv retry loop."""
-        if session.txn is not None:
-            # a failed statement aborts the whole explicit txn: its
-            # partial intents are resolved away and nothing publishes.
-            # This is how statement atomicity holds without kv-level
-            # savepoints (pg's "aborted until end of txn block").
-            try:
-                return fn(session.txn, session.effects)
-            except (TxnRetryError, TxnAbortedError) as e:
-                session.txn_aborted = True
-                session.txn.rollback()
-                raise EngineError(f"restart transaction: {e}") from e
-            except BaseException:
-                session.txn_aborted = True
-                session.txn.rollback()
-                raise
-        last: Exception | None = None
-        for _ in range(KVDB.MAX_ATTEMPTS):
-            t = Txn(self.kv.store)
-            effects: list = []
-            try:
-                res = fn(t, effects)
-                commit_ts = t.commit()
-                self._publish(effects, commit_ts)
-                return res
-            except (TxnRetryError, TxnAbortedError) as e:
-                t.rollback()
-                last = e
-            except BaseException:
-                t.rollback()
-                raise
-        # still the retryable serialization class (pgwire maps the
-        # "restart transaction" phrasing to SQLSTATE 40001)
-        raise EngineError(f"restart transaction: DML exhausted "
-                          f"retries: {last}")
-
-    def _publish(self, effects: list, ts: Timestamp) -> None:
-        if not effects:
-            return
-        by_table: dict[str, list] = {}
-        order: list[str] = []
-        for table, op in effects:
-            if table not in by_table:
-                by_table[table] = []
-                order.append(table)
-            by_table[table].append(op)
-        for table in order:
-            self.store.apply_committed(table, by_table[table], ts)
-            self._evict(table)
-            for feed in self.cdc_feeds:
-                if feed.table == table:
-                    feed.on_publish(by_table[table], ts)
-
-    def _register_table_read(self, txn: Optional[Txn], table: str,
-                             read_ts: Timestamp) -> None:
-        """Record a scan-plane read in the KV concurrency plane: the
-        table span goes into the txn's refresh set and the timestamp
-        cache, so conflicting writers get pushed above our read — the
-        contract of Replica.Send read path + span refresher."""
-        codec = self.store.table(table).codec
-        start, end = codec.span()
-        span = Span(start, end)
-        self.kv.store.tscache.add(span, read_ts,
-                                  txn.meta.id if txn else None)
-        if txn is not None:
-            txn.read_spans.append(span)
-
-    def _txn_key_state(self, effects: list, table: str) -> dict:
-        """Net per-key state of buffered effects for one table:
-        key -> row dict (pending put) or None (pending delete)."""
-        state: dict[bytes, object] = {}
-        for tb, op in effects:
-            if tb != table:
-                continue
-            if op[0] == "put":
-                state[op[1]] = op[2]
-            else:
-                state[op[1]] = None
-        return state
-
-    def _overlay_chunks(self, table: str, effects: list,
-                        read_ts: Timestamp) -> list[Chunk]:
-        """Committed chunks with this txn's buffered effects applied:
-        pending deletes/overwrites tombstone the committed version
-        (copy-on-write of the deletion column), pending puts appear as
-        a delta chunk visible at the txn's read timestamp. This is the
-        read-your-own-writes overlay; the reference gets the same from
-        MVCC intents being visible to their own txn."""
-        td = self.store.table(table)
-        state = self._txn_key_state(effects, table)
-        if not state:
-            self.store.seal(table)
-            return list(td.chunks)
-        idx = self.store.ensure_pk_index(table)
-        rts = read_ts.to_int()
-        shadow: dict[int, np.ndarray] = {}   # chunk idx -> COW mvcc_del
-
-        def _tombstone(ci: int, ri: int):
-            if ci not in shadow:
-                shadow[ci] = td.chunks[ci].mvcc_del.copy()
-            shadow[ci][ri] = rts   # hidden from this txn's reads
-        for key in state:
-            pos = idx.get(key)
-            if pos is None:
-                continue
-            ci, ri = pos
-            if td.chunks[ci].mvcc_ts[ri] > rts:
-                # live version is newer than our snapshot (a concurrent
-                # txn superseded the key after our read_ts): it is
-                # already invisible at rts; the version we must hide is
-                # found by the superseded-after-rts sweep below
-                continue
-            _tombstone(ci, ri)
-        # Versions visible at rts but superseded/deleted after it are
-        # NOT in the live pk index, yet they are exactly what a pending
-        # write must shadow (otherwise the old version + our delta row
-        # would both surface). They satisfy rts < mvcc_del < MAX — a
-        # small candidate set (recent MVCC garbage) we key-match.
-        for ci, c in enumerate(td.chunks):
-            cand = np.nonzero((c.mvcc_ts <= rts) & (rts < c.mvcc_del)
-                              & (c.mvcc_del != MAX_TS_INT))[0]
-            for ri in cand:
-                if self.store.row_key(td, c, int(ri)) in state:
-                    _tombstone(ci, int(ri))
-        chunks = []
-        for ci, c in enumerate(td.chunks):
-            if ci in shadow:
-                c = Chunk(data=c.data, valid=c.valid, mvcc_ts=c.mvcc_ts,
-                          mvcc_del=shadow[ci], n=c.n, rowid=c.rowid)
-            chunks.append(c)
-        pending_rows = [r for r in state.values() if r is not None]
-        if pending_rows:
-            chunks.append(self._delta_chunk(td, pending_rows, rts))
-        return chunks
-
-    def _delta_chunk(self, td, rows: list[dict], ts_int: int) -> Chunk:
-        n = len(rows)
-        data, vmap = {}, {}
-        for col in td.schema.columns:
-            vals = [r.get(col.name) for r in rows]
-            v = np.array([x is not None for x in vals], dtype=bool)
-            if col.type.family == Family.STRING:
-                d = td.dictionaries[col.name]
-                arr = np.fromiter(
-                    (d.encode(x) if x is not None else 0 for x in vals),
-                    dtype=np.int32, count=n)
-            else:
-                arr = np.array([x if x is not None else 0 for x in vals],
-                               dtype=col.type.np_dtype)
-            data[col.name] = arr
-            vmap[col.name] = v
-        return Chunk(
-            data=data, valid=vmap,
-            mvcc_ts=np.full(n, ts_int, dtype=np.int64),
-            mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
-            rowid=np.asarray([int(r.get(ROWID, 0)) for r in rows],
-                             dtype=np.int64))
-
-    def _exec_insert(self, ins: ast.Insert, session: Session) -> Result:
-        td = self.store.table(ins.table)
-        schema = td.schema
-        if ins.select is not None:
-            for vol in ("nextval", "gen_random_uuid"):
-                if _contains_func(ins.select, vol):
-                    # the select binds the volatile fn ONCE, handing
-                    # every produced row the same value (pg evaluates
-                    # per row); reject instead of silently corrupting
-                    # keys/uuids
-                    raise EngineError(
-                        f"{vol} inside INSERT ... SELECT is not "
-                        "supported; insert explicit VALUES instead")
-            # cache key must identify the inner select (repr is stable
-            # and content-based for the AST dataclasses)
-            src = self._exec_select(ins.select, session,
-                                    sql_text="insert-select:" + repr(ins.select))
-            cols = ins.columns or schema.column_names
-            rows = [dict(zip(cols, r)) for r in src.rows]
-            rows = [self._encode_row(schema, r) for r in rows]
-        else:
-            cols = ins.columns or schema.column_names
-            binder = Binder(Scope(),
-                            sequence_ops=self._sequence_ops(session))
-            rows = []
-            for row_exprs in ins.rows:
-                if len(row_exprs) != len(cols):
-                    raise EngineError("INSERT value count mismatch")
-                row = {}
-                for cname, e in zip(cols, row_exprs):
-                    col = schema.column(cname)
-                    b = binder.bind(e)
-                    if not isinstance(b, BConst):
-                        raise EngineError("INSERT values must be constants")
-                    if b.value is None:
-                        if not col.nullable:
-                            raise EngineError(
-                                f"null in non-null column {cname}")
-                        row[cname] = None
-                    else:
-                        row[cname] = binder._const_to(b, col.type).value
-                rows.append(row)
-        for row in rows:
-            for col in schema.columns:
-                if not col.nullable and row.get(col.name) is None:
-                    raise EngineError(f"null in non-null column {col.name}")
-        codec = td.codec
-
-        def fn(t: Txn, effects: list) -> Result:
-            pending = self._txn_key_state(effects, ins.table)
-            idx = self.store.ensure_pk_index(ins.table)
-            rts = t.meta.read_ts.to_int()
-            self._enforce_checks(ins.table, td, rows, rts)
-            self._enforce_fks(ins.table, rows, session, rts)
-            new_rows = []
-            for row in rows:
-                r = dict(row)
-                if codec.synthetic_pk:
-                    r[ROWID] = self.store.alloc_rowids(ins.table, 1)[0]
-                key = codec.key(r)
-                old_row = None
-                if not codec.synthetic_pk and not ins.upsert:
-                    # duplicate-key check = CPut semantics: a KV read
-                    # (sees concurrent intents, registers the span)
-                    # plus the scan-plane live index (covers
-                    # bulk-ingested rows with no KV pair)
-                    in_txn = pending.get(key, "absent")
-                    committed = (t.get(key) is not None or key in idx)
-                    if in_txn not in (None, "absent") or \
-                            (committed and in_txn == "absent"):
-                        pk = codec.pk_values(r)
-                        raise EngineError(
-                            f"duplicate key value {pk!r} violates "
-                            f"primary key of {ins.table!r}")
-                elif ins.upsert:
-                    # the row being replaced (if any), for secondary-
-                    # index entry cleanup and FK RESTRICT
-                    in_txn = pending.get(key, "absent")
-                    if in_txn not in (None, "absent"):
-                        old_row = in_txn
-                    elif key in idx:
-                        ci, ri = idx[key]
-                        old_row = self.store.extract_row(
-                            td, td.chunks[ci], ri)
-                    if old_row is not None:
-                        ref_cols = set()
-                        for _ch, fk in self._fk_children_of(
-                                ins.table):
-                            ref_cols |= set(fk["ref_columns"])
-                        if ref_cols and any(
-                                old_row.get(cn) != r.get(cn)
-                                for cn in ref_cols):
-                            self._enforce_fk_restrict(
-                                ins.table, [old_row], session, rts)
-                self._maintain_indexes(ins.table, td, t, pending,
-                                       old_row, r, rts)
-                t.put(key, codec.encode_value(r))
-                pending[key] = r
-                new_rows.append((key, r))
-            for key, r in new_rows:
-                effects.append((ins.table, ("put", key, r)))
-            return Result(row_count=len(rows),
-                          tag="UPSERT" if ins.upsert else "INSERT")
-
-        return self._dml(session, fn)
-
-    def _encode_row(self, schema: TableSchema, row: dict) -> dict:
-        out = {}
-        for cname, v in row.items():
-            col = schema.column(cname)
-            if v is None:
-                out[cname] = None
-            elif col.type.family == Family.DECIMAL:
-                out[cname] = int(round(float(v) * 10 ** col.type.scale))
-            elif col.type.family == Family.DATE:
-                out[cname] = ((v - EPOCH_DATE).days
-                              if isinstance(v, datetime.date) else int(v))
-            elif col.type.family == Family.TIMESTAMP:
-                out[cname] = (int((v - EPOCH_DT).total_seconds() * 1e6)
-                              if isinstance(v, datetime.datetime) else int(v))
-            else:
-                out[cname] = v
-        return out
-
-    def _dml_scope(self, table: str) -> tuple[Scope, TableSchema]:
-        td = self.store.table(table)
-        scope = Scope()
-        cols = {}
-        for c in td.schema.columns:
-            cols[c.name] = ColumnBinding(
-                f"{table}.{c.name}", c.type, td.dictionaries.get(c.name))
-        scope.add_table(table, cols)
-        return scope, td.schema
-
-    def _host_eval(self):
-        """Eager host-side expression evaluation context: pin to the
-        CPU backend so point-op predicates/assignments never pay a
-        device round trip (on a tunnel-attached TPU one eager sync
-        costs ~50-150ms — it would dominate every OLTP statement)."""
-        return jax.default_device(jax.devices("cpu")[0])
-
-    def _chunk_pred(self, table: str, where, scope: Scope,
-                    session: Session | None = None):
-        if where is None:
-            return lambda chunk: np.ones(chunk.n, dtype=bool)
-        session = session or self.session()
-        binder = Binder(
-            scope,
-            subquery_eval=lambda s, lim: self._eval_subquery(
-                s, session, lim),
-            now_micros=self._read_ts(session).wall // 1000,
-            sequence_ops=self._sequence_ops(session))
-        pred = binder.bind(where)
-        predf = compile_expr(pred)
-
-        def f(chunk):
-            with self._host_eval():
-                ctx = ExprContext(
-                    {f"{table}.{k}": (chunk.data[k], chunk.valid[k])
-                     for k in chunk.data}, chunk.n)
-                d, v = predf(ctx)
-                return np.asarray(jnp.logical_and(d, v))
-        return f
-
-    def _exec_delete(self, d: ast.Delete, session: Session) -> Result:
-        scope, _ = self._dml_scope(d.table)
-        td = self.store.table(d.table)
-        codec = td.codec
-        predf = self._chunk_pred(d.table, d.where, scope, session)
-
-        def fn(t: Txn, effects: list) -> Result:
-            read_ts = t.meta.read_ts
-            self._register_table_read(t, d.table, read_ts)
-            rts = read_ts.to_int()
-            n = 0
-            pending = self._txn_key_state(effects, d.table)
-            cand = self._dml_index_candidates(d.table, d.where, session)
-            n_committed = len(td.chunks)
-            victims: list[tuple[bytes, dict]] = []
-            for ci, chunk in enumerate(
-                    self._overlay_chunks(d.table, effects, read_ts)):
-                if cand is not None and ci < n_committed \
-                        and ci not in cand:
-                    continue
-                mask = chunk.live_mask(rts) & predf(chunk)
-                for ri in np.nonzero(mask)[0]:
-                    row = self.store.extract_row(td, chunk, int(ri))
-                    victims.append((codec.key(row), row))
-            # one batched RESTRICT probe for the whole statement (the
-            # txn aborts wholly on violation, so ordering vs the
-            # deletes below is immaterial)
-            self._enforce_fk_restrict(d.table,
-                                      [r for _k, r in victims],
-                                      session, rts)
-            for key, row in victims:
-                self._maintain_indexes(d.table, td, t, pending,
-                                       row, None, rts)
-                t.delete(key)
-                effects.append((d.table, ("del", key)))
-                n += 1
-            return Result(row_count=n, tag="DELETE")
-
-        return self._dml(session, fn)
-
-    def _exec_update(self, u: ast.Update, session: Session) -> Result:
-        scope, schema = self._dml_scope(u.table)
-        td = self.store.table(u.table)
-        binder = Binder(scope,
-                        sequence_ops=self._sequence_ops(session))
-        assigned = {}
-        for cname, e in u.assignments:
-            col = schema.column(cname)
-            # nextval is volatile and must allocate PER ROW (pg
-            # semantics): a bare nextval('s') assignment allocates in
-            # the row loop below; nextval nested inside a larger
-            # expression would fold to one shared value — reject it
-            if isinstance(e, ast.FuncCall) and e.name == "nextval" \
-                    and len(e.args) == 1 \
-                    and isinstance(e.args[0], ast.Literal):
-                self._seq_desc(e.args[0].value)  # must exist
-                assigned[cname] = ("seq", e.args[0].value)
-                continue
-            if _contains_func(e, "nextval"):
-                raise EngineError(
-                    "nextval may only be the entire SET expression "
-                    "(per-row allocation); fold it into a bare "
-                    "nextval('seq') assignment")
-            if _contains_func(e, "gen_random_uuid"):
-                raise EngineError(
-                    "gen_random_uuid in UPDATE SET would give every "
-                    "row the same uuid (bound once per statement); "
-                    "not supported")
-            b = binder.bind(e)
-            if isinstance(b, BConst) and isinstance(b.value, str) \
-                    and col.type.family == Family.STRING:
-                code = td.dictionaries[cname].encode(b.value)
-                assigned[cname] = ("const", code)
-            elif isinstance(b, BConst):
-                phys = binder._const_to(b, col.type).value if b.value is not None else None
-                assigned[cname] = ("const", phys)
-            else:
-                b2 = binder.coerce(b, col.type) if b.type.family != col.type.family else b
-                assigned[cname] = ("expr", compile_expr(b2))
-
-        def assign(chunk, mask, _he=self._host_eval):
-            idx = np.nonzero(mask)[0]
-            data, valid = {}, {}
-            ctx = ExprContext(
-                {f"{u.table}.{k}": (chunk.data[k], chunk.valid[k])
-                 for k in chunk.data}, chunk.n)
-            for c in schema.columns:
-                cn = c.name
-                if cn in assigned:
-                    kind, v = assigned[cn]
-                    if kind == "seq":
-                        # placeholder; allocated per row in the todo
-                        # loop (volatile, must not fold per chunk)
-                        data[cn] = np.zeros(len(idx),
-                                            dtype=c.type.np_dtype)
-                        valid[cn] = np.ones(len(idx), dtype=bool)
-                    elif kind == "const":
-                        if v is None:
-                            data[cn] = np.zeros(len(idx), dtype=c.type.np_dtype)
-                            valid[cn] = np.zeros(len(idx), dtype=bool)
-                        else:
-                            data[cn] = np.full(len(idx), v,
-                                               dtype=c.type.np_dtype)
-                            valid[cn] = np.ones(len(idx), dtype=bool)
-                    else:
-                        with _he():
-                            dd, vv = v(ctx)
-                            dd, vv = np.asarray(dd), np.asarray(vv)
-                        data[cn] = dd[idx].astype(c.type.np_dtype)
-                        valid[cn] = vv[idx]
-                else:
-                    data[cn] = chunk.data[cn][idx]
-                    valid[cn] = chunk.valid[cn][idx]
-            return data, valid
-
-        codec = td.codec
-        predf = self._chunk_pred(u.table, u.where, scope, session)
-
-        def fn(t: Txn, effects: list) -> Result:
-            read_ts = t.meta.read_ts
-            self._register_table_read(t, u.table, read_ts)
-            rts = read_ts.to_int()
-            idx = self.store.ensure_pk_index(u.table)
-            n = 0
-            todo = []
-            cand = self._dml_index_candidates(u.table, u.where, session)
-            n_committed = len(td.chunks)
-            for ci, chunk in enumerate(
-                    self._overlay_chunks(u.table, effects, read_ts)):
-                if cand is not None and ci < n_committed \
-                        and ci not in cand:
-                    continue
-                mask = chunk.live_mask(rts) & predf(chunk)
-                if not mask.any():
-                    continue
-                data, valid = assign(chunk, mask)
-                for j, ri in enumerate(np.nonzero(mask)[0]):
-                    old = self.store.extract_row(td, chunk, int(ri))
-                    new = dict(old)
-                    for c in schema.columns:
-                        cn = c.name
-                        if not valid[cn][j]:
-                            new[cn] = None
-                        elif c.type.family == Family.STRING:
-                            new[cn] = td.dictionaries[cn].values[
-                                int(data[cn][j])]
-                        else:
-                            new[cn] = data[cn][j].item()
-                    for cn, kv in assigned.items():
-                        if kv[0] == "seq":
-                            new[cn] = self._sequence_op(
-                                session, "nextval", kv[1], None)
-                    todo.append((old, new))
-            pending = self._txn_key_state(effects, u.table)
-            self._enforce_checks(u.table, td,
-                                 [new for _o, new in todo], rts)
-            self._enforce_fks(u.table, [new for _o, new in todo],
-                              session, rts)
-            ref_cols_changed = set()
-            for child, fk in self._fk_children_of(u.table):
-                ref_cols_changed |= set(fk["ref_columns"])
-            for old, new in todo:
-                if ref_cols_changed and any(
-                        old.get(c) != new.get(c)
-                        for c in ref_cols_changed):
-                    self._enforce_fk_restrict(u.table, [old],
-                                              session, rts)
-            for old, new in todo:
-                okey = codec.key(old)
-                nkey = codec.key(new)
-                if nkey != okey:
-                    # pk change: delete old kv, insert new (dup-checked)
-                    in_txn = pending.get(nkey, "absent")
-                    committed = (t.get(nkey) is not None or nkey in idx)
-                    if in_txn not in (None, "absent") or \
-                            (committed and in_txn == "absent"):
-                        raise EngineError(
-                            f"duplicate key {codec.pk_values(new)!r} on "
-                            f"UPDATE of {u.table!r}")
-                    t.delete(okey)
-                    effects.append((u.table, ("del", okey)))
-                    pending[okey] = None
-                self._maintain_indexes(u.table, td, t, pending,
-                                       old, new, rts)
-                t.put(nkey, codec.encode_value(new))
-                effects.append((u.table, ("put", nkey, new)))
-                pending[nkey] = new
-                n += 1
-            return Result(row_count=n, tag="UPDATE")
-
-        return self._dml(session, fn)
-
-    def _evict(self, name: str):
-        for k in [k for k in self._device_tables if k[0] == name]:
-            self._evict_device(k)
-
-
-# ---------------------------------------------------------------------------
-# helpers
-# ---------------------------------------------------------------------------
-
-@dataclass
-class _StreamFns:
-    """The three jitted pieces of a paged plan (compile_streaming)."""
-    page: object
-    combine: object
-    final: object
-
-
-def _host_sort(rows: list, meta: P.OutputMeta, keys) -> list:
-    """Host-side ORDER BY over decoded result rows (spill path only).
-    Matches device semantics: ascending puts NULLs last, descending
-    puts NULLs first; strings compare lexicographically."""
-    out = list(rows)
-    for name, desc in reversed(list(keys)):
-        try:
-            i = meta.names.index(name)
-        except ValueError:
-            raise EngineError(
-                f"cannot host-sort spilled result by {name!r}") from None
-        out = sorted(out,
-                     key=lambda r, i=i: (r[i] is None,
-                                         0 if r[i] is None else r[i]),
-                     reverse=desc)
-    return out
-
-
-def _count_aggs(node: P.PlanNode) -> int:
-    """Aggregate-function count of the plan's root aggregate (for the
-    streaming working-set estimate)."""
-    n = node
-    if isinstance(n, P.Limit):
-        n = n.child
-    if isinstance(n, P.Sort):
-        n = n.child
-    if isinstance(n, P.Aggregate):
-        return max(len(n.aggs), 1)
-    return 1
-
-
-def _collect_scan_columns(node: P.PlanNode) -> dict[str, frozenset]:
-    """alias -> stored columns the plan's scans actually read (the
-    pruned upload set; cf. the reference's neededColumns in
-    colfetcher/cfetcher.go)."""
-    out: dict[str, set] = {}
-    if isinstance(node, P.Scan):
-        out.setdefault(node.alias, set()).update(node.columns.values())
-    for attr in ("child", "left", "right"):
-        c = getattr(node, attr, None)
-        if c is not None:
-            for a, s in _collect_scan_columns(c).items():
-                out.setdefault(a, set()).update(s)
-    return {a: frozenset(s) for a, s in out.items()}
-
-
-def _slice_chunks(chunks: list, getter, start: int, end: int) -> np.ndarray:
-    """Materialize rows [start, end) of a chunked column as one array."""
-    parts = []
-    off = 0
-    for c in chunks:
-        lo, hi = max(start - off, 0), min(end - off, c.n)
-        if lo < hi:
-            parts.append(getter(c)[lo:hi])
-        off += c.n
-        if off >= end:
-            break
-    if len(parts) == 1:
-        return parts[0]
-    return np.concatenate(parts) if parts else np.zeros(0)
-
-
-def _collect_scans(node: P.PlanNode) -> dict[str, str]:
-    out = {}
-    if isinstance(node, P.Scan):
-        out[node.alias] = node.table
-    for attr in ("child", "left", "right"):
-        c = getattr(node, attr, None)
-        if c is not None:
-            out.update(_collect_scans(c))
-    return out
-
-
-def _next_pow2(x: int) -> int:
-    n = 1
-    while n < x:
-        n <<= 1
-    return n
-
-
-def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
-    if a.shape[0] == n:
-        return a
-    out = np.full(n, fill, dtype=a.dtype)
-    out[: a.shape[0]] = a
-    return out
-
-
-@dataclass
-class _RerunPrepared:
-    """Prepared handle for statements that cannot pin one compiled
-    program (CTEs materialize fresh temps per run; set ops merge on
-    the host): each run() re-executes through the engine."""
-    engine: "Engine"
-    session: "Session"
-    stmt: object
-    sql_text: str
-
-    def run(self, read_ts=None) -> "Result":
-        return self.engine._exec_select(self.stmt, self.session,
-                                        self.sql_text)
-
-    def dispatch(self, *a, **kw):
-        raise EngineError(
-            "this statement shape cannot dispatch asynchronously")
-
-
-def _render_create(desc) -> str:
-    """Reconstruct CREATE TABLE DDL from a descriptor (SHOW CREATE)."""
-    def ty(t):
-        f = t.family.value
-        names = {"int": "INT8", "float": "FLOAT8", "bool": "BOOL",
-                 "string": "STRING", "date": "DATE",
-                 "timestamp": "TIMESTAMP", "interval": "INTERVAL"}
-        if f == "decimal":
-            return f"DECIMAL({t.precision},{t.scale})"
-        return names.get(f, f.upper())
-
-    parts = []
-    for c in desc.columns:
-        if c.state != "public":
-            continue
-        s = f"{c.name} {ty(c.type)}"
-        if not c.nullable:
-            s += " NOT NULL"
-        parts.append(s)
-    if desc.primary_key:
-        parts.append(f"PRIMARY KEY ({', '.join(desc.primary_key)})")
-    for i in desc.indexes:
-        if i.state != "public":
-            continue
-        kw = "UNIQUE INDEX" if i.unique else "INDEX"
-        parts.append(f"{kw} {i.name} ({', '.join(i.columns)})")
-    for ck in desc.checks:
-        parts.append(f"CONSTRAINT {ck['name']} CHECK "
-                     f"({ck['expr_sql']})")
-    for fk in desc.fks:
-        parts.append(
-            f"CONSTRAINT {fk['name']} FOREIGN KEY "
-            f"({', '.join(fk['columns'])}) REFERENCES "
-            f"{fk['ref_table']} ({', '.join(fk['ref_columns'])})")
-    cols = ",\n  ".join(parts)
-    return f"CREATE TABLE {desc.name} (\n  {cols}\n)"
-
-
-def _rewrite_table_names(sel, mapping: dict):
-    """Deep-copy a Select/SetOp with CTE names replaced by their
-    materialized temp-table names — in FROM/JOIN refs and inside
-    expression subqueries (which execute while the temps are live)."""
-    import copy
-    if not mapping:
-        return sel
-    if isinstance(sel, ast.SetOp):
-        sel = copy.copy(sel)
-        shadowed = {name for name, _, _ in sel.ctes}
-        inner = {k: v for k, v in mapping.items() if k not in shadowed}
-        sel.left = _rewrite_table_names(sel.left, inner)
-        sel.right = _rewrite_table_names(sel.right, inner)
-        return sel
-    sel = copy.deepcopy(sel)
-
-    def fix_ref(ref: ast.TableRef):
-        if ref is None or ref.subquery is not None:
-            if ref is not None and ref.subquery is not None:
-                fix_select(ref.subquery)
-            return
-        if ref.name in mapping:
-            ref.alias = ref.alias or ref.name
-            ref.name = mapping[ref.name]
-
-    def fix_expr(e):
-        if e is None:
-            return
-        if isinstance(e, (ast.Subquery, ast.Exists)):
-            fix_select(e.select)
-            return
-        if isinstance(e, ast.InSubquery):
-            fix_expr(e.expr)
-            fix_select(e.select)
-            return
-        for attr in ("left", "right", "operand", "expr", "lo", "hi",
-                     "start", "length", "else_"):
-            fix_expr(getattr(e, attr, None))
-        for a in getattr(e, "args", None) or []:
-            fix_expr(a)
-        for a in getattr(e, "items", None) or []:
-            fix_expr(a)
-        for c, v in getattr(e, "whens", None) or []:
-            fix_expr(c)
-            fix_expr(v)
-
-    def fix_select(s):
-        if isinstance(s, ast.SetOp):
-            fix_select(s.left)
-            fix_select(s.right)
-            return
-        # a CTE of the same name in an inner scope shadows the outer
-        shadowed = {name for name, _, _ in s.ctes}
-        inner = {k: v for k, v in mapping.items() if k not in shadowed}
-        if s is not sel and inner != mapping:
-            rewritten = _rewrite_table_names(s, inner)
-            s.__dict__.update(rewritten.__dict__)
-            return
-        fix_ref(s.table)
-        for j in s.joins:
-            fix_ref(j.table)
-            fix_expr(j.on)
-        fix_expr(s.where)
-        fix_expr(s.having)
-        for it in s.items:
-            fix_expr(it.expr)
-        for g in s.group_by:
-            fix_expr(g)
-        for ob in s.order_by:
-            fix_expr(ob.expr)
-        for _, _, sub in s.ctes:
-            fix_select(sub)
-
-    fix_select(sel)
-    return sel
-
-
-def _propagate_as_of(inner, outer):
-    """AS OF SYSTEM TIME covers the whole statement: sub-selects
-    (expression subqueries, CTEs, derived tables) inherit the outer
-    clause unless they carry their own."""
-    if not isinstance(inner, ast.Select) \
-            or not isinstance(outer, ast.Select):
-        return inner
-    if outer.as_of is None or inner.as_of is not None:
-        return inner
-    import copy
-    inner = copy.copy(inner)
-    inner.as_of = outer.as_of
-    return inner
-
-
-def _contains_func(node, fname: str) -> bool:
-    """Does any expression under `node` call function `fname`?
-    Generic dataclass walk (volatile-function detection)."""
-    import dataclasses
-    found = [False]
-
-    def walk(x):
-        if found[0]:
-            return
-        if isinstance(x, (list, tuple)):
-            for v in x:
-                walk(v)
-            return
-        if not dataclasses.is_dataclass(x) or isinstance(x, type):
-            return
-        if isinstance(x, ast.FuncCall) and x.name == fname:
-            found[0] = True
-            return
-        for f in dataclasses.fields(x):
-            walk(getattr(x, f.name))
-
-    walk(node)
-    return found[0]
-
-
-def _stmt_table_refs(node) -> set:
-    """All table names a statement references (FROM/JOIN refs plus
-    expression subqueries and CTE bodies), via a generic dataclass
-    walk — used for view dependency checks at DROP TABLE."""
-    import dataclasses
-    out: set = set()
-    seen: set = set()
-
-    def walk(x):
-        if id(x) in seen:
-            return
-        if isinstance(x, (list, tuple)):
-            for v in x:
-                walk(v)
-            return
-        if not dataclasses.is_dataclass(x) or isinstance(x, type):
-            return
-        seen.add(id(x))
-        if isinstance(x, ast.TableRef) and x.subquery is None:
-            out.add(x.name)
-        for f in dataclasses.fields(x):
-            walk(getattr(x, f.name))
-
-    walk(node)
-    return out
-
-
-def split_conjuncts_ast(e: ast.Expr) -> list:
-    """Flatten a WHERE tree into its AND-conjuncts (AST level; the
-    planner's split_conjuncts does the same over bound exprs)."""
-    out: list = []
-
-    def walk(x):
-        if isinstance(x, ast.BinOp) and x.op == "and":
-            walk(x.left)
-            walk(x.right)
-        else:
-            out.append(x)
-
-    walk(e)
-    return out
-
-
-def _decode_storage_value(v, ty):
-    """Storage-logical value (extract_row form: strings pre-decoded,
-    numerics physical) -> client value. Delegates to _decode_scalar so
-    the fastpath and the compiled path share one decoding."""
-    if v is None:
-        return None
-    if isinstance(v, str):
-        return v
-    return _decode_scalar(v, True, ty, None)
-
-
-def _decode_scalar(v, valid: bool, ty, dictionary):
-    if not valid:
-        return None
-    f = ty.family
-    if f == Family.DECIMAL:
-        return float(v) / 10 ** ty.scale
-    if f == Family.DATE:
-        return EPOCH_DATE + datetime.timedelta(days=int(v))
-    if f == Family.TIMESTAMP:
-        return EPOCH_DT + datetime.timedelta(microseconds=int(v))
-    if f == Family.STRING:
-        if dictionary is not None:
-            return dictionary.values[int(v)]
-        return int(v)
-    if f == Family.BOOL:
-        return bool(v)
-    if f == Family.INT:
-        return int(v)
-    if f == Family.FLOAT:
-        return float(v)
-    if isinstance(v, str):
-        return v
-    return v.item() if hasattr(v, "item") else v
-
-
-def _decode_column(arr: np.ma.MaskedArray, ty, dictionary) -> list:
-    data = np.asarray(arr.data)
-    mask = np.asarray(arr.mask) if arr.mask is not np.ma.nomask \
-        else np.zeros(len(data), bool)
-    return [_decode_scalar(d, not m, ty, dictionary)
-            for d, m in zip(data, mask)]

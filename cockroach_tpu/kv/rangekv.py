@@ -1,0 +1,188 @@
+"""The engine's KV plane served from raft-replicated ranges.
+
+This is round-3 VERDICT item #1: "make the replicated range plane the
+Engine's default data plane". The engine's entire transactional
+machinery (kv/txn.py: latches, tscache floors, intent pushes, span
+refresh, the DB retry loop) operates against an MVCC interface — so
+instead of translating the reference's TxnCoordSender/DistSender pair
+wholesale, we swap the MVCC *storage* underneath that machinery:
+
+- ``RangeMVCC`` implements the MVCC surface kv.Txn uses (get / scan /
+  put / resolve_intent / has_writes_between) by routing each key to
+  its range's leaseholder replica. Reads are served by the leaseholder
+  in-process (replica_read.go:43 — no consensus); writes and intent
+  resolution are proposed through raft and applied deterministically
+  on every replica (replica_raft.go:105 evalAndPropose -> apply).
+- MVCC conflicts during apply come back as *results* (store.py batch
+  eval catches WriteIntentError/WriteTooOldError) and are re-raised
+  here client-side, so the gateway's push/retry protocol sees exactly
+  the exceptions it sees on the local plane.
+- A txn write's timestamp may be bumped below raft (WriteTooOld
+  bumps the intent ts); the apply result reports the written ts and
+  the gateway adopts it, mirroring how the reference's BatchResponse
+  carries the pushed txn proto back to the TxnCoordSender.
+
+With this store under the engine, DML intents, the catalog, sequences,
+zone configs and job records all replicate and survive node failure —
+the columnstore becomes what its docstring claims: a scan-plane
+materialization of committed range data.
+
+Reference path being rebuilt: pkg/sql/row/kv_batch_fetcher.go:107 ->
+kv/kvclient/kvcoord/dist_sender.go:795 -> kvserver/replica_send.go:113.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..kvserver.store import _dec_ts, _enc_ts, raise_op_error
+from ..storage.hlc import Timestamp
+from ..storage.mvcc import MVCCValue, TxnMeta, TxnStatus
+from .concurrency import (SpanLatchManager, TimestampCache, TxnRegistry)
+from .txn import KVStore
+
+
+class RangeMVCC:
+    """MVCC facade over a Cluster: the storage half of DistSender.
+
+    Key->range routing consults the cluster's descriptors (the range
+    cache analogue); reads go straight at the leaseholder's engine,
+    writes ride raft. Only the surface kv.Txn/IntentResolver actually
+    use is implemented — anything else raises loudly.
+    """
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    # -- routing -----------------------------------------------------------
+    def _ranges_overlapping(self, start: bytes, end: bytes):
+        """Leaseholder replicas for each range overlapping [start,end),
+        in key order (DistSender's span iteration, dist_sender.go:795)."""
+        out = []
+        cur = start
+        guard = 0
+        while cur < end:
+            desc = self.cluster.range_for_key(cur)
+            if desc is None:
+                # gap in the keyspace (no range covers it): step to the
+                # next descriptor start above cur, if any
+                nxt = None
+                for d in self.cluster.descriptors.values():
+                    if d.start_key > cur and (nxt is None or
+                                              d.start_key < nxt.start_key):
+                        nxt = d
+                if nxt is None or nxt.start_key >= end:
+                    break
+                cur = nxt.start_key
+                continue
+            out.append((desc, self.cluster._leaseholder_replica(cur)))
+            cur = desc.end_key
+            guard += 1
+            if guard > 10000:
+                raise RuntimeError("range iteration did not advance")
+        return out
+
+    def _leaseholder(self, key: bytes):
+        return self.cluster._leaseholder_replica(key)
+
+    def _propose(self, key: bytes, op: dict) -> object:
+        rep = self._leaseholder(key)
+        out = self.cluster.propose_and_wait(
+            rep, {"kind": "batch", "ops": [op]})
+        return raise_op_error(out[0])
+
+    # -- reads (leaseholder, no consensus) ---------------------------------
+    def get(self, key: bytes, read_ts: Timestamp,
+            txn: Optional[TxnMeta] = None,
+            inconsistent: bool = False) -> Optional[MVCCValue]:
+        return self._leaseholder(key).mvcc.get(
+            key, read_ts, txn=txn, inconsistent=inconsistent)
+
+    def scan(self, start: bytes, end: bytes, read_ts: Timestamp,
+             txn: Optional[TxnMeta] = None, max_keys: int = 0,
+             inconsistent: bool = False,
+             intents_out: Optional[list] = None) -> list:
+        out: list = []
+        for desc, rep in self._ranges_overlapping(start, end):
+            lo = max(start, desc.start_key)
+            hi = min(end, desc.end_key)
+            out.extend(rep.mvcc.scan(
+                lo, hi, read_ts, txn=txn,
+                max_keys=(max_keys - len(out)) if max_keys else 0,
+                inconsistent=inconsistent, intents_out=intents_out))
+            if max_keys and len(out) >= max_keys:
+                break
+        return out
+
+    def has_writes_between(self, start: bytes, end: bytes,
+                           t0: Timestamp, t1: Timestamp,
+                           exclude_txn: Optional[str] = None) -> bool:
+        for desc, rep in self._ranges_overlapping(start, end):
+            lo = max(start, desc.start_key)
+            hi = min(end, desc.end_key)
+            if rep.mvcc.has_writes_between(lo, hi, t0, t1,
+                                           exclude_txn=exclude_txn):
+                return True
+        return False
+
+    # -- writes (raft-replicated) ------------------------------------------
+    def put(self, key: bytes, write_ts: Timestamp,
+            value: Optional[bytes],
+            txn: Optional[TxnMeta] = None) -> None:
+        op = {"op": "put" if value is not None else "delete",
+              "key": key.decode("latin1"),
+              "ts": _enc_ts(txn.write_ts if txn is not None
+                            else write_ts)}
+        if value is not None:
+            op["value"] = value.decode("latin1")
+        if txn is not None:
+            op["txn"] = txn.to_json().decode()
+        res = self._propose(key, op)
+        if txn is not None and isinstance(res, dict) and "wts" in res:
+            # adopt a below-raft WriteTooOld bump (refresh decides at
+            # commit whether the txn must restart)
+            wts = _dec_ts(res["wts"])
+            if txn.write_ts < wts:
+                txn.write_ts = wts
+
+    def delete(self, key: bytes, write_ts: Timestamp,
+               txn: Optional[TxnMeta] = None) -> None:
+        self.put(key, write_ts, None, txn)
+
+    def resolve_intent(self, key: bytes, txn: TxnMeta,
+                       status: TxnStatus,
+                       commit_ts: Optional[Timestamp] = None) -> bool:
+        op = {"op": "resolve", "key": key.decode("latin1"),
+              "txn": txn.to_json().decode(),
+              "commit": status == TxnStatus.COMMITTED}
+        if commit_ts is not None:
+            op["commit_ts"] = _enc_ts(commit_ts)
+        try:
+            self._propose(key, op)
+        except (KeyError, RuntimeError):
+            return False   # range gone / no quorum: a pusher cleans up
+        return True
+
+
+class ClusterKVStore(KVStore):
+    """A KVStore whose MVCC plane is the cluster's replicated ranges.
+
+    The gateway-local concurrency plane (latches, tscache, txn
+    registry) is per-SQL-gateway, like the reference's per-node
+    concurrency manager; cross-gateway conflicts serialize on the
+    replicated intents themselves. Known limitation (single writing
+    gateway assumed): a push from gateway B of gateway A's LIVE txn
+    maps the unknown id to ABORTED — moving txn records onto the
+    anchor range (kv/disttxn.py's conditional ``txn_record``) is the
+    multi-gateway fix and the next integration step.
+    """
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.mvcc = RangeMVCC(cluster)
+        self.latches = SpanLatchManager()
+        self.tscache = TimestampCache()
+        self.txns = TxnRegistry()
+        self.clock = cluster.clock
+        from .intentresolver import IntentResolver
+        self.intent_resolver = IntentResolver(self)

@@ -19,7 +19,8 @@ from typing import Optional
 
 from cockroach_tpu.kvserver.liveness import NodeLiveness
 from cockroach_tpu.kvserver.store import (EngineKey, Lease, RangeDescriptor,
-                                          Replica, Store, _enc_ts)
+                                          Replica, Store, _enc_ts,
+                                          raise_op_error)
 from cockroach_tpu.kvserver.transport import LocalTransport
 from cockroach_tpu.storage.hlc import Clock
 from cockroach_tpu.utils.circuit import Breaker, BreakerTrippedError
@@ -566,6 +567,53 @@ class Cluster:
                                "(quorum lost?)")
         return self.stores[lh].replicas[desc.range_id]
 
+    def gc_txn_records(self, ttl_ns: int = int(3600e9)) -> int:
+        """Delete ABORTED txn records older than ttl_ns (the txn-record
+        GC half of the reference's gc queue, gc/gc.go). A pusher racing
+        a fully-resolved commit can leave a bogus ABORTED record for a
+        finished txn (disttxn push_intent); this sweep bounds that
+        leak. SAFETY: ttl_ns must exceed any live txn's possible
+        lifetime (TxnLivenessThreshold) — deleting a LIVE pushee's
+        poison record would let its commit succeed over removed
+        intents."""
+        import json as _json
+        n = 0
+        now = self.clock.now().wall
+        seen: set[bytes] = set()
+        for desc in list(self.descriptors.values()):
+            lh = self.ensure_lease(desc.range_id)
+            if lh is None:
+                continue
+            rep = self.stores[lh].replicas.get(desc.range_id)
+            if rep is None:
+                continue
+            keys = set()
+            for ek, raw in list(rep.mvcc.engine.scan(
+                    EngineKey(b"\x00txn/", -1), include_tombstones=True)):
+                if not ek.key.startswith(b"\x00txn/"):
+                    break
+                keys.add(ek.key)
+            for key in keys - seen:
+                seen.add(key)
+                from ..storage.hlc import MAX_TIMESTAMP
+                mv = rep.mvcc.get(key, MAX_TIMESTAMP, inconsistent=True)
+                if mv is None:
+                    continue
+                try:
+                    rec = _json.loads(mv.value.decode())
+                except ValueError:
+                    continue
+                if rec.get("status") != "aborted":
+                    continue  # committed records are deleted by
+                    # resolve_all once every intent resolves
+                if now - mv.ts.wall < ttl_ns:
+                    continue
+                self.propose_and_wait(rep, {"kind": "batch", "ops": [{
+                    "op": "delete", "key": key.decode("latin1"),
+                    "ts": _enc_ts(self.clock.now())}]})
+                n += 1
+        return n
+
     def put(self, key: bytes, value: bytes, max_iter: int = 500) -> None:
         rep = self._leaseholder_replica(key)
         b = self.breaker(rep.desc.range_id)
@@ -575,11 +623,14 @@ class Cluster:
             "ts": _enc_ts(self.clock.now()),
         }]}
         try:
-            self.propose_and_wait(rep, cmd, max_iter)
+            res = self.propose_and_wait(rep, cmd, max_iter)[0]
         except (RuntimeError, AmbiguousResultError):
             b.report_failure()
             raise
         b.report_success()
+        # apply-time MVCC conflict (store.py batch eval): surface it
+        # (typed) rather than silently dropping the write
+        raise_op_error(res)
 
     def get(self, key: bytes) -> Optional[bytes]:
         rep = self._leaseholder_replica(key)

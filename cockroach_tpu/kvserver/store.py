@@ -73,6 +73,26 @@ def _dec_ts(v: list) -> Timestamp:
     return Timestamp(v[0], v[1])
 
 
+def raise_op_error(res: object) -> object:
+    """Decode ONE batch-eval result: MVCC conflicts captured below
+    raft (see Replica._eval) re-raise client-side as the same typed
+    exceptions the local MVCC plane throws. Every proposer of write
+    ops must route results through here (rangekv, distsender, disttxn,
+    Cluster.put) — the wire shape lives in exactly one place."""
+    if not (isinstance(res, dict) and "error" in res):
+        return res
+    from cockroach_tpu.storage.mvcc import (WriteIntentError,
+                                            WriteTooOldError)
+    if res["error"] == "write_intent":
+        raise WriteIntentError(
+            res["key"].encode("latin1"),
+            TxnMeta.from_json(res["txn"].encode()))
+    if res["error"] == "write_too_old":
+        raise WriteTooOldError.with_actual(
+            res["key"].encode("latin1"), _dec_ts(res["actual_ts"]))
+    raise RuntimeError(f"range write failed: {res['error']}")
+
+
 class FollowerReadError(Exception):
     """The follower's closed timestamp has not reached the read ts."""
 
@@ -292,9 +312,24 @@ class Replica:
     def _eval(self, cmd: dict) -> object:
         kind = cmd.get("kind")
         if kind == "batch":
+            from ..storage.mvcc import (WriteIntentError, WriteTooOldError)
             out = []
             for op in cmd["ops"]:
-                out.append(self._eval_op(op))
+                # MVCC conflicts surface as RESULTS, not exceptions:
+                # every replica computes the same error deterministically
+                # in log order and the proposer's waiter re-raises
+                # client-side (the eval-error half of the reference's
+                # below-raft apply contract, replica_application.go)
+                try:
+                    out.append(self._eval_op(op))
+                except WriteIntentError as e:
+                    out.append({"error": "write_intent",
+                                "key": e.key.decode("latin1"),
+                                "txn": e.txn_meta.to_json().decode()})
+                except WriteTooOldError as e:
+                    out.append({"error": "write_too_old",
+                                "key": e.key.decode("latin1"),
+                                "actual_ts": _enc_ts(e.actual_ts)})
             if "closed" in cmd:
                 # applied on every replica in log order: a follower's
                 # closed_ts never runs ahead of its applied state
@@ -337,21 +372,26 @@ class Replica:
         # otherwise always stay on the LHS; move each with its anchor so
         # pushes routed by the anchor key keep finding the record after
         # the split (the reference's splitTrigger rewrites range-local
-        # keys.TransactionKey entries the same way)
+        # keys.TransactionKey entries the same way). All versions of a
+        # record key travel together — moving the value but leaving its
+        # deletion tombstone behind would resurrect a resolved record.
+        rec_entries: dict[bytes, list] = {}
+        rec_anchor: dict[bytes, bytes] = {}
         for ek, v in list(self.mvcc.engine.scan(EngineKey(b"\x00txn/", -1),
                                                 include_tombstones=True)):
             if not ek.key.startswith(b"\x00txn/"):
                 break
-            anchor = None
+            rec_entries.setdefault(ek.key, []).append((ek, v))
             decoded = _dec_value(v) if v else None
-            if decoded:
+            if decoded and ek.key not in rec_anchor:
                 try:
-                    anchor = json.loads(decoded.decode()).get(
-                        "anchor", "").encode("latin1")
+                    rec_anchor[ek.key] = json.loads(
+                        decoded.decode()).get("anchor", "").encode("latin1")
                 except (ValueError, UnicodeDecodeError):
-                    anchor = None
-            if anchor and anchor >= split_key:
-                moved.append((ek, v))
+                    pass
+        for rkey, entries in rec_entries.items():
+            if rec_anchor.get(rkey, b"") >= split_key:
+                moved.extend(entries)
         for ek, v in moved:
             if v is not None:
                 rhs_rep.mvcc.engine.put(ek, v)
@@ -410,13 +450,18 @@ class Replica:
                 # committed immediately; intent writes emit at resolve
                 self.rangefeed.on_value(
                     key, op["value"].encode("latin1"), wts)
-            return True
+                return True
+            # mvcc.put may bump the intent ts past an existing version
+            # (WriteTooOld); report the ts actually written so a
+            # gateway txn coordinating over raft can adopt it
+            return {"ok": True, "wts": _enc_ts(txn.write_ts)}
         if o == "delete":
             key = op["key"].encode("latin1")
             self.mvcc.delete(key, wts, txn=txn)
             if txn is None:
                 self.rangefeed.on_value(key, None, wts)
-            return True
+                return True
+            return {"ok": True, "wts": _enc_ts(txn.write_ts)}
         if o == "txn_record":
             # Conditional transaction-record write, the atomic moment of
             # the push/commit protocol (batcheval/cmd_push_txn.go,

@@ -164,7 +164,7 @@ def _leap(y: int) -> bool:
 class Binder:
     def __init__(self, scope: Scope, subquery_eval=None,
                  now_micros: Optional[int] = None,
-                 sequence_ops=None):
+                 sequence_ops=None, volatile_fold_ok: bool = True):
         self.scope = scope
         # populated by bind_with_aggs
         self.aggs: list[BoundAgg] = []
@@ -183,6 +183,12 @@ class Binder:
         # window function instances (bind_with_windows)
         self.windows: list[BoundWindow] = []
         self._collect_windows = False
+        # volatile builtins (nextval/random/gen_random_uuid) fold to
+        # ONE constant per bind; in a SELECT with a FROM clause pg
+        # evaluates them per ROW, so folding silently corrupts results.
+        # plan_select sets this False for executed SELECTs; DML WHERE /
+        # EXPLAIN contexts keep the (documented) per-statement fold
+        self.volatile_fold_ok = volatile_fold_ok
 
     # -- main dispatch -------------------------------------------------------
     def bind(self, e: ast.Expr) -> BExpr:
@@ -704,6 +710,12 @@ class Binder:
     # -- functions & aggregates --------------------------------------------
     def bind_func(self, e: ast.FuncCall) -> BExpr:
         name = e.name
+        if name in ("nextval", "random", "gen_random_uuid") \
+                and self.scope.tables and not self.volatile_fold_ok:
+            raise BindError(
+                f"{name}() in a statement with a FROM clause is not "
+                "supported: it would fold to one value per statement "
+                "instead of one per row")
         if name in AGG_FUNCS:
             if not self._collect_aggs:
                 raise BindError(f"aggregate {name} not allowed here")

@@ -75,7 +75,7 @@ class Planner:
 
     def __init__(self, catalog: CatalogView, subquery_eval=None,
                  now_micros=None, sequence_ops=None,
-                 use_memo: bool = True):
+                 use_memo: bool = True, volatile_fold_ok: bool = True):
         self.catalog = catalog
         # engine-supplied hooks: subquery execution + statement
         # timestamp for now()/current_date + sequence builtins
@@ -84,6 +84,7 @@ class Planner:
         self.now_micros = now_micros
         self.sequence_ops = sequence_ops
         self.use_memo = use_memo
+        self.volatile_fold_ok = volatile_fold_ok
         self.last_memo = None  # sql/memo.MemoResult of the last plan
 
     def _keys_unique(self, cand_alias: str, cand_table: str, pool,
@@ -257,7 +258,8 @@ class Planner:
 
         binder = Binder(scope, subquery_eval=self.subquery_eval,
                         now_micros=self.now_micros,
-                        sequence_ops=self.sequence_ops)
+                        sequence_ops=self.sequence_ops,
+                        volatile_fold_ok=self.volatile_fold_ok)
 
         # ---- gather predicates ---------------------------------------------
         conjuncts: list[BExpr] = []

@@ -43,6 +43,13 @@ class RowCodec:
         # value, rowenc/valueside)
         self.value_cols = [c for c in schema.columns
                            if c.name not in self.pk_cols]
+        # precomputed wire tags (decode_value is the per-row hot path)
+        self._tag_of = {
+            c.name: ((b"#%d" % c.cid) if getattr(c, "cid", 0)
+                     else c.name.encode("utf-8"))
+            for c in self.value_cols}
+        self._col_by_tag = {t.decode("utf-8"): self.schema.column(n)
+                            for n, t in self._tag_of.items()}
 
     # -- spans -------------------------------------------------------------
     def span(self) -> tuple[bytes, bytes]:
@@ -62,55 +69,67 @@ class RowCodec:
         return keys.table_key(self.table_id, pk_vals)
 
     # -- values ------------------------------------------------------------
+    # Self-describing tagged encoding: each present (non-null) column
+    # is written as [tag_len:u8][tag][payload_len:u32][payload].
+    # Absent columns decode as NULL, unknown tags are skipped — so
+    # rows written under an older schema version decode correctly
+    # after ADD/DROP COLUMN without a KV rewrite, exactly why the
+    # reference tags value-side datums with column ids
+    # (pkg/sql/rowenc/valueside/encode.go). The tag is the stable
+    # catalog column id ("#<cid>") when the schema carries one —
+    # immune to DROP + re-ADD of a name with a different type — and
+    # the column name for catalog-less schemas (tests, bulk loaders).
     def encode_value(self, row: dict) -> bytes:
-        cols = self.value_cols
-        nulls = 0
         buf = bytearray()
-        for i, c in enumerate(cols):
+        n = 0
+        for c in self.value_cols:
             v = row.get(c.name)
             if v is None:
-                nulls |= 1 << i
                 continue
             f = c.type.family
             if f == Family.BOOL:
-                buf += struct.pack(">B", 1 if v else 0)
+                payload = struct.pack(">B", 1 if v else 0)
             elif f == Family.FLOAT:
-                buf += struct.pack(">d", float(v))
+                payload = struct.pack(">d", float(v))
             elif f in (Family.STRING, Family.BYTES):
-                raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
-                buf += struct.pack(">I", len(raw)) + raw
+                payload = v.encode("utf-8") if isinstance(v, str) \
+                    else bytes(v)
             else:  # INT / DECIMAL / DATE / TIMESTAMP / INTERVAL: int64
-                buf += struct.pack(">q", int(v))
-        nb = (len(cols) + 7) // 8
-        return nulls.to_bytes(nb, "little") + bytes(buf)
+                payload = struct.pack(">q", int(v))
+            tag = self._tag_of[c.name]
+            buf += struct.pack(">B", len(tag)) + tag
+            buf += struct.pack(">I", len(payload)) + payload
+            n += 1
+        return struct.pack(">H", n) + bytes(buf)
 
     def decode_value(self, b: bytes) -> dict:
-        cols = self.value_cols
-        nb = (len(cols) + 7) // 8
-        nulls = int.from_bytes(b[:nb], "little")
-        off = nb
-        row: dict = {}
-        for i, c in enumerate(cols):
-            if nulls & (1 << i):
-                row[c.name] = None
-                continue
+        row: dict = {c.name: None for c in self.value_cols}
+        (n,) = struct.unpack_from(">H", b, 0)
+        off = 2
+        by_tag = self._col_by_tag
+        for _ in range(n):
+            nl = b[off]
+            off += 1
+            tag = b[off:off + nl].decode("utf-8")
+            off += nl
+            (pl,) = struct.unpack_from(">I", b, off)
+            off += 4
+            payload = b[off:off + pl]
+            off += pl
+            c = by_tag.get(tag)
+            if c is None:
+                continue   # column dropped since this row was written
             f = c.type.family
             if f == Family.BOOL:
-                row[c.name] = bool(b[off])
-                off += 1
+                row[c.name] = bool(payload[0])
             elif f == Family.FLOAT:
-                (row[c.name],) = struct.unpack_from(">d", b, off)
-                off += 8
-            elif f in (Family.STRING, Family.BYTES):
-                (ln,) = struct.unpack_from(">I", b, off)
-                off += 4
-                raw = b[off:off + ln]
-                off += ln
-                row[c.name] = raw.decode("utf-8") if f == Family.STRING \
-                    else raw
+                (row[c.name],) = struct.unpack(">d", payload)
+            elif f == Family.STRING:
+                row[c.name] = payload.decode("utf-8")
+            elif f == Family.BYTES:
+                row[c.name] = payload
             else:
-                (row[c.name],) = struct.unpack_from(">q", b, off)
-                off += 8
+                (row[c.name],) = struct.unpack(">q", payload)
         return row
 
     def decode_key(self, key: bytes) -> tuple:

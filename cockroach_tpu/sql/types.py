@@ -189,6 +189,9 @@ class ColumnSchema:
     # WRITE_ONLY) exists physically — DML writes it — but planners and
     # SELECT * must not see it until the descriptor goes PUBLIC.
     hidden: bool = False
+    # stable catalog column id (ColumnDescriptor.col_id); 0 = unknown
+    # (schemas built outside the catalog). Tags value-side KV payloads.
+    cid: int = 0
 
 
 @dataclass

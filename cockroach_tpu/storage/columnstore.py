@@ -325,6 +325,66 @@ class ColumnStore:
         td.open_ts = []
         td.open_rowids = []
 
+    def insert_versions(self, name: str,
+                        versions: list[tuple[dict, int, int]]) -> int:
+        """Bulk ingest with explicit MVCC bounds: each element is
+        (row, mvcc_ts_int, mvcc_del_int). Used when materializing the
+        scan plane from committed range data (exec/dml.py
+        refresh_table_from_ranges) — the columnstore must reproduce
+        the range plane's version history, not re-stamp it, or open
+        snapshots and AS OF SYSTEM TIME reads go silently wrong."""
+        td = self.table(name)
+        from ..sql.rowenc import ROWID
+        if not versions:
+            with self._lock:
+                td.generation += 1
+            return 0
+        with self._lock:
+            self._seal_locked(td)   # don't interleave with open rows
+            n = len(versions)
+            data, vmap = {}, {}
+            for col in td.schema.columns:
+                vals = [r.get(col.name) for r, _t, _d in versions]
+                v = np.array([x is not None for x in vals], dtype=bool)
+                if col.type.family == Family.STRING:
+                    d = td.dictionaries[col.name]
+                    arr = np.fromiter(
+                        (d.encode(x) if x is not None else 0
+                         for x in vals), dtype=np.int32, count=n)
+                elif col.type.family == Family.DECIMAL:
+                    scale = 10 ** col.type.scale
+                    arr = np.fromiter(
+                        (0 if x is None else
+                         x if isinstance(x, (int, np.integer)) else
+                         int(round(float(x) * scale))
+                         for x in vals), dtype=np.int64, count=n)
+                else:
+                    arr = np.array(
+                        [x if x is not None else 0 for x in vals],
+                        dtype=col.type.np_dtype)
+                data[col.name] = arr
+                vmap[col.name] = v
+            rowids = []
+            for r, _t, _d in versions:
+                rid = r.get(ROWID)
+                if rid is None:
+                    rid = td.next_rowid
+                    td.next_rowid += 1
+                rowids.append(int(rid))
+            # synthetic-pk rowids came from the decoded keys: future
+            # inserts must allocate past them or keys collide
+            td.next_rowid = max(td.next_rowid, max(rowids) + 1)
+            td.chunks.append(Chunk(
+                data=data, valid=vmap,
+                mvcc_ts=np.asarray([t for _r, t, _d in versions],
+                                   dtype=np.int64),
+                mvcc_del=np.asarray([d for _r, _t, d in versions],
+                                    dtype=np.int64), n=n,
+                rowid=np.asarray(rowids, dtype=np.int64)))
+            td.pk_index = None
+            td.generation += 1
+        return n
+
     def seal(self, name: str) -> None:
         td = self.table(name)
         with self._lock:

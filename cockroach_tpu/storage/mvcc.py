@@ -88,6 +88,19 @@ class WriteTooOldError(Exception):
             f"write at {write_ts} too old for {key!r}; "
             f"existing committed value at {existing_ts}")
 
+    @classmethod
+    def with_actual(cls, key: bytes,
+                    actual_ts: Timestamp) -> "WriteTooOldError":
+        """Rebuild from a wire-carried actual_ts verbatim (batch-eval
+        error results already encode existing_ts.next(); running it
+        through __init__ would advance it a second time)."""
+        e = cls.__new__(cls)
+        Exception.__init__(
+            e, f"write too old on {key!r}; retry above {actual_ts}")
+        e.key = key
+        e.actual_ts = actual_ts
+        return e
+
 
 class KeyCollisionError(Exception):
     pass

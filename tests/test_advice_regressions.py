@@ -103,3 +103,155 @@ def test_transport_rejects_conflicting_registration():
     t.register(1, h1)            # same handler: fine (restart paths)
     with pytest.raises(ValueError):
         t.register(1, h2)
+
+
+# ---------------------------------------------------------------------------
+# round 2 ADVICE.md findings
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def eng():
+    from cockroach_tpu.exec.engine import Engine
+    return Engine()
+
+
+class TestFKRestrictOverfire:
+    def test_update_unrelated_ref_column(self, eng):
+        """ADVICE high: updating one referenced column must not probe
+        OTHER FKs (e.g. one on the PK) whose referencing rows are
+        untouched."""
+        eng.execute("CREATE TABLE parent (id INT PRIMARY KEY, "
+                    "a INT UNIQUE, b INT UNIQUE)")
+        eng.execute("CREATE TABLE child_a (x INT PRIMARY KEY, "
+                    "ra INT REFERENCES parent (a))")
+        eng.execute("CREATE TABLE child_b (x INT PRIMARY KEY, "
+                    "rb INT REFERENCES parent (b))")
+        eng.execute("INSERT INTO parent VALUES (1, 10, 100)")
+        eng.execute("INSERT INTO child_a VALUES (1, 10)")
+        # b is unreferenced: updating it must succeed even though
+        # child_a references column a of the same row
+        r = eng.execute("UPDATE parent SET b = 200 WHERE id = 1")
+        assert r.row_count == 1
+        # but updating a (still referenced) must fail
+        from cockroach_tpu.exec.engine import EngineError
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("UPDATE parent SET a = 11 WHERE id = 1")
+
+    def test_upsert_unrelated_ref_column(self, eng):
+        """Same over-fire through the UPSERT path."""
+        eng.execute("CREATE TABLE parent (id INT PRIMARY KEY, "
+                    "a INT UNIQUE, b INT UNIQUE)")
+        eng.execute("CREATE TABLE child_a (x INT PRIMARY KEY, "
+                    "ra INT REFERENCES parent (a))")
+        eng.execute("INSERT INTO parent VALUES (1, 10, 100)")
+        eng.execute("INSERT INTO child_a VALUES (1, 10)")
+        r = eng.execute("UPSERT INTO parent VALUES (1, 10, 200)")
+        assert r.row_count == 1
+        rows = eng.execute("SELECT b FROM parent WHERE id = 1").rows
+        assert rows == [(200,)]
+
+
+class TestSelfRefBulkDelete:
+    def test_delete_parent_and_child_together(self, eng):
+        """ADVICE medium: a bulk delete removing both parent and child
+        of a self-referential FK in one statement is legal in pg."""
+        eng.execute("CREATE TABLE emp (id INT PRIMARY KEY, "
+                    "mgr INT REFERENCES emp (id))")
+        eng.execute("INSERT INTO emp VALUES (1, NULL), (2, 1), (3, 2)")
+        r = eng.execute("DELETE FROM emp WHERE id >= 1")
+        assert r.row_count == 3
+        assert eng.execute("SELECT count(*) FROM emp").rows == [(0,)]
+
+    def test_delete_parent_and_child_in_explicit_txn(self, eng):
+        """Same statement inside BEGIN: the txn-buffered (pending) rows
+        being deleted must be excluded from the probe too."""
+        eng.execute("CREATE TABLE emp2 (id INT PRIMARY KEY, "
+                    "mgr INT REFERENCES emp2 (id))")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO emp2 VALUES (1, NULL), (2, 1)", s)
+        r = eng.execute("DELETE FROM emp2 WHERE id >= 1", s)
+        assert r.row_count == 2
+        eng.execute("COMMIT", s)
+        assert eng.execute("SELECT count(*) FROM emp2").rows == [(0,)]
+
+    def test_partial_delete_still_restricted(self, eng):
+        from cockroach_tpu.exec.engine import EngineError
+        eng.execute("CREATE TABLE emp (id INT PRIMARY KEY, "
+                    "mgr INT REFERENCES emp (id))")
+        eng.execute("INSERT INTO emp VALUES (1, NULL), (2, 1)")
+        # deleting only the referenced manager must still fail
+        with pytest.raises(EngineError, match="foreign key"):
+            eng.execute("DELETE FROM emp WHERE id = 1")
+
+
+class TestVolatileFoldGuards:
+    def test_nextval_in_select_with_from_rejected(self, eng):
+        """ADVICE medium: nextval() folded once per statement, so every
+        row of SELECT nextval('s') FROM t got the SAME value; reject
+        instead of silently corrupting."""
+        eng.execute("CREATE SEQUENCE sq")
+        eng.execute("CREATE TABLE t3 (x INT PRIMARY KEY)")
+        eng.execute("INSERT INTO t3 VALUES (1), (2), (3)")
+        with pytest.raises(Exception, match="FROM clause"):
+            eng.execute("SELECT nextval('sq') FROM t3")
+        # the sequence must not have advanced
+        assert eng.execute("SELECT nextval('sq')").rows == [(1,)]
+
+    def test_random_with_from_rejected(self, eng):
+        eng.execute("CREATE TABLE t4 (x INT PRIMARY KEY)")
+        eng.execute("INSERT INTO t4 VALUES (1), (2)")
+        with pytest.raises(Exception, match="FROM clause"):
+            eng.execute("SELECT random() FROM t4")
+        # without FROM both stay usable
+        assert len(eng.execute("SELECT random()").rows) == 1
+
+    def test_dml_where_volatile_still_works(self, eng):
+        """The guard is for executed SELECTs only: UPDATE/DELETE with
+        random() in WHERE (no FROM clause) keep the documented
+        per-statement fold."""
+        eng.execute("CREATE TABLE t6 (id INT PRIMARY KEY, x FLOAT)")
+        eng.execute("INSERT INTO t6 VALUES (1, 0.0)")
+        assert eng.execute(
+            "UPDATE t6 SET x = random() WHERE id = 1").row_count == 1
+        assert eng.execute(
+            "DELETE FROM t6 WHERE random() < 2.0").row_count == 1
+
+    def test_drop_table_rejected_with_pending_writes(self, eng):
+        """DROP TABLE shares the TRUNCATE hazard: a txn committing
+        after the drop would crash _publish on the missing table."""
+        from cockroach_tpu.exec.engine import EngineError
+        eng.execute("CREATE TABLE td1 (x INT PRIMARY KEY)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO td1 VALUES (1)", s)
+        with pytest.raises(EngineError, match="DROP TABLE"):
+            eng.execute("DROP TABLE td1")
+        eng.execute("ROLLBACK", s)
+        eng.execute("DROP TABLE td1")
+
+    def test_explain_still_allowed(self, eng):
+        eng.execute("CREATE SEQUENCE sq2")
+        eng.execute("CREATE TABLE t5 (x INT PRIMARY KEY)")
+        eng.execute("EXPLAIN SELECT nextval('sq2') FROM t5")
+        # EXPLAIN must not have allocated
+        assert eng.execute("SELECT nextval('sq2')").rows == [(1,)]
+
+
+class TestTruncateVsOpenTxn:
+    def test_truncate_rejected_with_pending_writes(self, eng):
+        """ADVICE low: a txn begun before TRUNCATE could commit after
+        it and resurrect rows; refuse while open txns hold buffered
+        effects on the table."""
+        from cockroach_tpu.exec.engine import EngineError
+        eng.execute("CREATE TABLE tt (x INT PRIMARY KEY)")
+        eng.execute("INSERT INTO tt VALUES (1)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO tt VALUES (2)", s)
+        with pytest.raises(EngineError, match="TRUNCATE"):
+            eng.execute("TRUNCATE tt")
+        eng.execute("COMMIT", s)
+        # after commit the truncate goes through
+        eng.execute("TRUNCATE tt")
+        assert eng.execute("SELECT count(*) FROM tt").rows == [(0,)]

@@ -218,6 +218,38 @@ class TestDistTxnFailures:
                 assert got is None, f"seed={seed}: aborted txn leaked"
             c.check_replica_consistency(1)
 
+    def test_record_deleted_after_full_resolution(self):
+        """Once every intent is resolved the record is deleted (EndTxn
+        analogue) so the record keyspace doesn't grow with history; a
+        txn with an unresolvable intent keeps its record."""
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        t.commit()
+        c.pump(5)
+        assert read_txn_record(c, t._meta()) is None
+        assert c.get(b"apple") == b"1"   # resolution preceded deletion
+        t2 = DistTxn(c)
+        t2.put(b"apple", b"9")
+        t2.rollback()
+        c.pump(5)
+        assert read_txn_record(c, t2._meta()) is None
+
+    def test_gc_reaps_aged_aborted_records(self):
+        """A pusher's poison record for a crashed coordinator outlives
+        the txn; the record GC reaps it after the liveness TTL (and
+        never touches young or committed records)."""
+        c = make_cluster(split_at=None)
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        reader = DistTxn(c)
+        reader.get(b"apple")            # poisons t (coordinator "dead")
+        assert read_txn_record(c, t._meta())[0] == "aborted"
+        assert c.gc_txn_records(ttl_ns=int(3600e9)) == 0  # too young
+        assert c.gc_txn_records(ttl_ns=0) == 1
+        assert read_txn_record(c, t._meta()) is None
+
     def test_sequential_txns_supersede(self):
         c = make_cluster(split_at=None)
         for i in range(5):

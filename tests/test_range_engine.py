@@ -1,0 +1,247 @@
+"""The Engine served from the raft-replicated range plane (round-3
+VERDICT #1): DML intents, catalog, sequences and jobs ride a real
+Cluster through kv/rangekv.py instead of the engine-local store.
+
+The reference path being pinned: sql/row writers -> kv.Txn ->
+DistSender -> Replica raft apply (pkg/sql/row/kv_batch_fetcher.go:107,
+kvcoord/dist_sender.go:795, kvserver/replica_send.go:113)."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, EngineError
+from cockroach_tpu.kvserver.cluster import Cluster
+
+
+def make_cluster(n_nodes=3, split_keys=()):
+    c = Cluster(n_nodes=n_nodes)
+    c.create_range(b"\x00", b"\xff")
+    c.pump_until(lambda: c.leaseholder(1) is not None)
+    for k in split_keys:
+        c.split_range(k)
+    return c
+
+
+@pytest.fixture
+def cluster():
+    return make_cluster()
+
+
+@pytest.fixture
+def eng(cluster):
+    return Engine(cluster=cluster)
+
+
+class TestRangeBackedEngine:
+    def test_ddl_dml_select_ride_ranges(self, cluster, eng):
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v STRING)")
+        eng.execute("INSERT INTO t VALUES (1,'a'),(2,'b'),(3,'c')")
+        assert eng.execute("SELECT id, v FROM t ORDER BY id").rows == \
+            [(1, "a"), (2, "b"), (3, "c")]
+        # the rows are physically on the ranges, not just in the
+        # engine's columnstore: raw range scans see the KV pairs
+        raw = cluster.scan(b"\x04", b"\x05")
+        assert len(raw) == 3
+        eng.execute("UPDATE t SET v='z' WHERE id=2")
+        eng.execute("DELETE FROM t WHERE id=3")
+        assert eng.execute("SELECT id, v FROM t ORDER BY id").rows == \
+            [(1, "a"), (2, "z")]
+        assert len(cluster.scan(b"\x04", b"\x05")) == 2
+
+    def test_explicit_txn_and_rollback(self, eng):
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s = eng.session()
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO t VALUES (1, 10)", s)
+        eng.execute("ROLLBACK", s)
+        assert eng.execute("SELECT count(*) FROM t").rows == [(0,)]
+        eng.execute("BEGIN", s)
+        eng.execute("INSERT INTO t VALUES (1, 10)", s)
+        eng.execute("COMMIT", s)
+        assert eng.execute("SELECT count(*) FROM t").rows == [(1,)]
+
+    def test_sequences_and_catalog_replicate(self, cluster, eng):
+        eng.execute("CREATE SEQUENCE sq")
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY)")
+        assert eng.execute("SELECT nextval('sq')").rows == [(1,)]
+        # a second gateway sees both, and sequence allocation is
+        # cluster-wide monotonic
+        e2 = Engine(cluster=cluster)
+        assert e2.execute("SELECT nextval('sq')").rows == [(2,)]
+        assert [d.name for d in e2.catalog.list_tables()] == ["t"]
+
+    def test_node_kill_loses_nothing(self, cluster, eng):
+        """VERDICT done-criterion (b): committed rows survive the
+        leaseholder's death and the engine keeps serving."""
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v STRING)")
+        for i in range(8):
+            eng.execute(f"INSERT INTO t VALUES ({i}, 'v{i}')")
+        victim = cluster.leaseholder(1)
+        cluster.stop_node(victim)
+        cluster.pump(60)   # failover: epoch lease fencing + new leader
+        eng.refresh_table_from_ranges("t")
+        assert eng.execute("SELECT count(*) FROM t").rows == [(8,)]
+        # and the engine still writes through the surviving quorum
+        eng.execute("INSERT INTO t VALUES (100, 'after')")
+        assert eng.execute("SELECT count(*) FROM t").rows == [(9,)]
+
+    def test_fresh_gateway_after_kill_sees_all(self, cluster, eng):
+        """Coordinator death: a brand-new engine on the same cluster
+        reconstructs catalog + data purely from range state."""
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        eng.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        victim = cluster.leaseholder(1)
+        cluster.stop_node(victim)
+        cluster.pump(60)
+        e2 = Engine(cluster=cluster)   # the old gateway is gone
+        assert e2.execute("SELECT sum(v) FROM t").rows == [(30,)]
+
+    def test_two_gateways_full_visibility(self, cluster):
+        """VERDICT done-criterion (c): nodes joined to the same ranges
+        serve the same data, including DDL."""
+        a = Engine(cluster=cluster)
+        b = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, v STRING)")
+        a.execute("INSERT INTO t VALUES (1,'a')")
+        assert b.execute("SELECT v FROM t").rows == [("a",)]
+        b.execute("INSERT INTO t VALUES (2,'b')")
+        assert a.execute("SELECT count(*) FROM t").rows == [(2,)]
+        a.execute("ALTER TABLE t ADD COLUMN w INT")
+        b.execute("UPDATE t SET w = 5 WHERE id = 1")
+        assert a.execute("SELECT w FROM t ORDER BY id").rows == \
+            [(5,), (None,)]
+        a.execute("DROP TABLE t")
+        with pytest.raises(Exception):
+            b.execute("SELECT * FROM t")
+
+    def test_spans_across_splits(self, eng, cluster):
+        """Table data spanning several ranges scans correctly."""
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        for i in range(20):
+            eng.execute(f"INSERT INTO t VALUES ({i}, {i * 10})")
+        # split inside the table keyspace and keep serving
+        raw = cluster.scan(b"\x04", b"\x05")
+        mid = sorted(k for k, _ in raw)[len(raw) // 2]
+        cluster.split_range(mid)
+        cluster.pump(10)
+        eng.refresh_table_from_ranges("t")
+        assert eng.execute("SELECT count(*), sum(v) FROM t").rows == \
+            [(20, sum(i * 10 for i in range(20)))]
+        eng.execute("INSERT INTO t VALUES (100, 1), (101, 2)")
+        assert eng.execute("SELECT count(*) FROM t").rows == [(22,)]
+
+    def test_secondary_index_unique_across_gateways(self, cluster):
+        a = Engine(cluster=cluster)
+        b = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, u INT UNIQUE)")
+        a.execute("INSERT INTO t VALUES (1, 7)")
+        with pytest.raises(EngineError, match="duplicate|unique"):
+            b.execute("INSERT INTO t VALUES (2, 7)")
+
+    def test_write_conflict_retry(self, eng):
+        """Two engine sessions contending on one key: the push
+        protocol force-aborts the blocker after its wait (deadlock-by-
+        timeout, kv/concurrency.py push), and the aborted txn's COMMIT
+        surfaces the retryable 40001 class — never a silent lost
+        write. Same semantics as the local KV plane, now through raft
+        intents."""
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        eng.execute("INSERT INTO t VALUES (1, 0)")
+        s1, s2 = eng.session(), eng.session()
+        eng.execute("BEGIN", s1)
+        eng.execute("UPDATE t SET v = 1 WHERE id = 1", s1)
+        eng.execute("BEGIN", s2)
+        # pushes s1 (which never heartbeats again) and wins
+        eng.execute("UPDATE t SET v = 2 WHERE id = 1", s2)
+        eng.execute("COMMIT", s2)
+        with pytest.raises(EngineError, match="restart|abort"):
+            eng.execute("COMMIT", s1)
+        assert eng.execute("SELECT v FROM t").rows == [(2,)]
+
+
+class TestSnapshotsSurviveRefresh:
+    def test_open_txn_snapshot_not_destroyed_by_remote_write(self, cluster):
+        """Reviewer scenario: gateway A holds an open txn snapshot at
+        T0; gateway B commits new rows; A's next statement triggers a
+        scan-plane refresh. The refresh must reproduce MVCC history —
+        A's snapshot keeps seeing exactly the T0 rows, not zero rows
+        (re-stamped) and not B's new ones."""
+        a = Engine(cluster=cluster)
+        b = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        a.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s = a.session()
+        a.execute("BEGIN", s)
+        assert a.execute("SELECT count(*) FROM t", s).rows == [(2,)]
+        b.execute("INSERT INTO t VALUES (3, 30)")
+        b.execute("DELETE FROM t WHERE id = 1")
+        # A's open snapshot must still see rows 1 and 2 only
+        assert a.execute("SELECT id FROM t ORDER BY id", s).rows == \
+            [(1,), (2,)]
+        a.execute("COMMIT", s)
+        # a NEW snapshot sees B's state
+        assert a.execute("SELECT id FROM t ORDER BY id").rows == \
+            [(2,), (3,)]
+
+    def test_as_of_system_time_after_refresh(self, cluster):
+        a = Engine(cluster=cluster)
+        b = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        a.execute("INSERT INTO t VALUES (1, 10)")
+        ts = a.clock.now().wall
+        b.execute("UPDATE t SET v = 99 WHERE id = 1")
+        # historical read below B's update, served after the refresh
+        rows = a.execute(
+            f"SELECT v FROM t AS OF SYSTEM TIME {ts}").rows
+        assert rows == [(10,)]
+        assert a.execute("SELECT v FROM t").rows == [(99,)]
+
+
+class TestSchemaEvolutionOnRanges:
+    def test_add_column_old_rows_decode_null(self, cluster):
+        a = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, v STRING)")
+        a.execute("INSERT INTO t VALUES (1,'x')")
+        a.execute("ALTER TABLE t ADD COLUMN w INT")
+        a.execute("INSERT INTO t (id, v) VALUES (2,'y')")
+        a.execute("UPDATE t SET w = 3 WHERE id = 2")
+        b = Engine(cluster=cluster)   # decodes all rows from ranges
+        assert b.execute("SELECT id, v, w FROM t ORDER BY id").rows == \
+            [(1, "x", None), (2, "y", 3)]
+
+
+class TestNodesOnSharedRanges:
+    def test_two_nodes_serve_same_ranges_over_pgwire(self):
+        """VERDICT r3 #1 done-criterion (c): Nodes built over one
+        Cluster serve the same replicated data through real sockets."""
+        from cockroach_tpu.cli import PgClient
+        from cockroach_tpu.server import Node, NodeConfig
+
+        cluster = make_cluster()
+        n1 = Node(NodeConfig(node_id=1, cluster=cluster))
+        n2 = Node(NodeConfig(node_id=2, cluster=cluster))
+        with n1, n2:
+            c1 = PgClient(*n1.sql_addr)
+            c2 = PgClient(*n2.sql_addr)
+            try:
+                c1.query("CREATE TABLE t (id INT PRIMARY KEY, v STRING)")
+                c1.query("INSERT INTO t VALUES (1,'from-n1')")
+                _n, rows, _t = c2.query("SELECT v FROM t")
+                assert [tuple(r) for r in rows] == [("from-n1",)]
+                c2.query("INSERT INTO t VALUES (2,'from-n2')")
+                _n, rows, _t = c1.query("SELECT count(*) FROM t")
+                assert int(rows[0][0]) == 2
+            finally:
+                c1.close()
+                c2.close()
+
+    def test_drop_then_readd_same_name_different_type(self, cluster):
+        """Stable column ids: a dropped column's name re-added with a
+        different type must read NULL for old rows, not decode the old
+        payload (name-tag type confusion)."""
+        a = Engine(cluster=cluster)
+        a.execute("CREATE TABLE t (id INT PRIMARY KEY, s STRING)")
+        a.execute("INSERT INTO t VALUES (1, 'hello')")
+        a.execute("ALTER TABLE t DROP COLUMN s")
+        a.execute("ALTER TABLE t ADD COLUMN s INT")
+        b = Engine(cluster=cluster)
+        assert b.execute("SELECT id, s FROM t").rows == [(1, None)]

@@ -274,8 +274,13 @@ class TestRound2Builtins:
         assert one("to_hex(255)") == "ff"
         assert one("format('%s=%s', 'a', 1)") == "a=1"
         assert one("version()").startswith("cockroach-tpu")
-        assert 0.0 <= one("random()") < 1.0
-        assert len(one("gen_random_uuid()")) == 36
+        # volatile fns are rejected with a FROM clause (per-statement
+        # fold would hand every row the same value) — use bare SELECT
+        bare = lambda q: beng.execute(f"SELECT {q}").rows[0][0]
+        assert 0.0 <= bare("random()") < 1.0
+        assert len(bare("gen_random_uuid()")) == 36
+        with pytest.raises(Exception, match="FROM clause"):
+            one("random()")
 
     def test_split_part_over_column(self, beng):
         rows = beng.execute(
