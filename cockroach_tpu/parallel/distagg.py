@@ -15,13 +15,17 @@ The TPU answer to DistSQL physical planning (SURVEY.md §2.2, §A.6):
                                           small; no shuffle needed
 
 Eligibility: the plan root chain must be Limit?/Sort?/Aggregate —
-ungrouped, dense segment-sum strategy, or hash strategy (round 2:
-shard-local hash groups merge via all_gather + re-group, see
-exec/compile.py _compile_hash_dist_aggregate) — with every HashJoin
-build subtree scan-only (replicated). DISTINCT aggregates fall back
-to single-device execution. After the collectives, all outputs are
-replicated, so Sort/Limit/HAVING above the Aggregate run identically
-on every shard.
+ungrouped, dense segment-sum strategy, or hash strategy (round 3:
+shard-local hash groups EXCHANGE to their hash-owner shard via the
+all_to_all shuffle, each shard merges only its 1/D of the groups, and
+the disjoint merged groups concatenate via one all_gather — see
+parallel/shuffle.py + exec/compile.py _compile_hash_dist_aggregate) —
+with every HashJoin build subtree scan-only (replicated).
+Sharded⋈sharded joins run through the same shuffle at the ops layer
+(shuffle.exchange both sides by join key, then a local join per
+shard). DISTINCT aggregates fall back to single-device execution.
+After the collectives, all outputs are replicated, so
+Sort/Limit/HAVING above the Aggregate run identically on every shard.
 """
 
 from __future__ import annotations

@@ -1,0 +1,91 @@
+"""Hash-partitioned all_to_all exchange: the TPU shuffle.
+
+Round-3 VERDICT #3. The reference moves rows between flow processors
+with the HashRouter (pkg/sql/colflow/routers.go:425): each producer
+hash-partitions its output stream and ships bucket i to consumer i
+over gRPC. The TPU formulation is one ``jax.lax.all_to_all`` over ICI
+inside the SPMD program:
+
+  1. every shard assigns each local row a destination
+     ``hash(key) % n_shards``;
+  2. rows sort by destination and scatter into a [n_shards, cap]
+     send buffer (static shapes — cap is the per-destination budget,
+     with an overflow flag when skew exceeds it);
+  3. ``all_to_all`` swaps buffer block d with shard d — after it,
+     every row with the same key hash lives on the same shard.
+
+That property is what unlocks sharded⋈sharded hash joins (both sides
+exchanged by their join key — no replicated build side) and
+hash-distributed GROUP BY whose merge touches only each shard's 1/D
+of the groups instead of all_gather-ing every group to every shard
+(the round-2 weakness this replaces, parallel/distagg.py:18-21).
+
+Skew/overflow contract: cap bounds what each shard can send to one
+destination. Overflow does NOT corrupt results — surplus rows are
+dropped from the send buffer and the returned flag is True, which the
+engine maps to HashCapacityExceeded and the partition-and-recurse
+retry path (exec/scanplane.py _run_partitioned), the same discipline
+the hash table uses for capacity overflow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.hashtable import _hash_columns
+from .mesh import SHARD_AXIS
+
+
+def dest_of(key_cols: tuple, n_shards: int) -> jnp.ndarray:
+    """Destination shard per row: hash(keys) % n_shards, decorrelated
+    from the hash-table slot hash by a salt column (the HashRouter
+    likewise uses its own hash function)."""
+    salt = jnp.full(key_cols[0].shape, 0x9E3779B9, dtype=jnp.int32)
+    h = _hash_columns(tuple(key_cols) + (salt,), 1 << 16)
+    return (h % jnp.int32(n_shards)).astype(jnp.int32)
+
+
+def pack_for_exchange(dest: jnp.ndarray, valid: jnp.ndarray,
+                      n_shards: int, cap: int, arrays: list):
+    """Scatter rows into a [n_shards * cap] send buffer, block d
+    holding (up to cap) rows destined for shard d.
+
+    Returns (packed_arrays, packed_valid, overflow)."""
+    n = dest.shape[0]
+    # invalid rows sort to the end (dest = n_shards sentinel)
+    d = jnp.where(valid, dest, jnp.int32(n_shards))
+    order = jnp.argsort(d, stable=True)
+    dsort = d[order]
+    starts = jnp.searchsorted(dsort, jnp.arange(n_shards, dtype=dsort.dtype))
+    rank = jnp.arange(n, dtype=jnp.int32) - \
+        starts[jnp.clip(dsort, 0, n_shards - 1)].astype(jnp.int32)
+    live = dsort < n_shards
+    fits = jnp.logical_and(live, rank < cap)
+    overflow = jnp.any(jnp.logical_and(live, rank >= cap))
+    slot = jnp.where(fits, dsort * cap + rank, n_shards * cap)
+    out_valid = jnp.zeros((n_shards * cap,), dtype=jnp.bool_) \
+        .at[slot].set(True, mode="drop")
+    packed = []
+    for a in arrays:
+        buf = jnp.zeros((n_shards * cap,) + a.shape[1:], dtype=a.dtype)
+        packed.append(buf.at[slot].set(a[order], mode="drop"))
+    return packed, out_valid, overflow
+
+
+def exchange(dest: jnp.ndarray, valid: jnp.ndarray, n_shards: int,
+             cap: int, arrays: list, axis: str = SHARD_AXIS):
+    """The shuffle: pack + all_to_all. Each shard returns with the
+    rows (from every shard) whose dest == its own index; row order is
+    (source shard, local order). Output length n_shards * cap."""
+    packed, pvalid, overflow = pack_for_exchange(
+        dest, valid, n_shards, cap, arrays)
+
+    def a2a(x):
+        return jax.lax.all_to_all(x, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    recv = [a2a(p) for p in packed]
+    rvalid = a2a(pvalid)
+    # every shard must agree on overflow (it is a retry signal)
+    any_ovf = jax.lax.psum(overflow.astype(jnp.int32), axis) > 0
+    return recv, rvalid, any_ovf
