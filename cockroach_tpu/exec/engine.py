@@ -772,6 +772,26 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         res = self._exec_select(sel, session, f"(subquery {sel!r})")
         return res.rows, res.types
 
+    def _decorrelate(self, sel: ast.Select) -> ast.Select:
+        """Unnest correlated (NOT) EXISTS into grouped LEFT JOINs
+        (sql/decorrelate.py; the opt/norm/decorrelate.go analogue)."""
+        from ..sql.decorrelate import decorrelate_exists
+
+        from ..sql.types import Family
+
+        def columns_of(name):
+            if name not in self.store.tables:
+                return None
+            return set(self.store.table(name).schema.column_names)
+
+        def is_string_col(table, col):
+            try:
+                sch = self.store.table(table).schema
+                return sch.column(col).type.family == Family.STRING
+            except KeyError:
+                return True   # unknown: refuse the min/max trick
+        return decorrelate_exists(sel, columns_of, is_string_col)
+
     @staticmethod
     def _has_derived(sel: ast.Select) -> bool:
         refs = ([sel.table] if sel.table is not None else []) + \
@@ -1051,6 +1071,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         if isinstance(sel, ast.SetOp):
             return self._exec_setop(sel, session, sql_text)
         sel = self._expand_views(sel)
+        sel = self._decorrelate(sel)
         if sel.ctes or self._has_derived(sel):
             return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
@@ -1289,7 +1310,13 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             return
         lo, hi, n_all = r
         span = hi - lo + 1
-        if span <= max(4 * n_all, 1024) \
+        # density is a MEMORY question, not a perf one: the build is a
+        # single scatter over the table regardless of sparsity, and a
+        # sparse table still beats the ~100x-slower while-loop hash
+        # probe. SSB's date dimension (YYYYMMDD ints: ~2.5K keys over a
+        # ~60K span) is the canonical sparse-but-small case round 2's
+        # 4x-density guard wrongly sent to the hash path.
+        if span <= max(256 * n_all, 4096) \
                 and span + 1 <= self.MAX_DIRECT_JOIN_SLOTS:
             join.direct = (lo, span + 1)
 

@@ -28,6 +28,20 @@ def _days(iso: str) -> int:
     return (datetime.date.fromisoformat(iso) - EPOCH).days
 
 
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT",
+           "ETHIOPIA", "FRANCE", "GERMANY", "INDIA", "INDONESIA",
+           "IRAN", "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO",
+           "MOZAMBIQUE", "PERU", "CHINA", "ROMANIA", "SAUDI ARABIA",
+           "VIETNAM", "RUSSIA", "UNITED KINGDOM", "UNITED STATES"]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+# nation -> region mapping per the TPC-H spec's nation table
+NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0,
+                 1, 2, 3, 4, 2, 3, 3, 1]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+            "HOUSEHOLD"]
+ORDER_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM",
+                    "4-NOT SPECIFIED", "5-LOW"]
+
 DDL = {
     "lineitem": """
 CREATE TABLE lineitem (
@@ -57,6 +71,49 @@ CREATE TABLE part (
     p_size        INT8 NOT NULL,
     p_container   STRING NOT NULL,
     p_retailprice DECIMAL(15,2) NOT NULL
+)""",
+    "orders": """
+CREATE TABLE orders (
+    o_orderkey      INT8 NOT NULL,
+    o_custkey       INT8 NOT NULL,
+    o_orderstatus   STRING NOT NULL,
+    o_totalprice    DECIMAL(15,2) NOT NULL,
+    o_orderdate     DATE NOT NULL,
+    o_orderpriority STRING NOT NULL,
+    o_shippriority  INT8 NOT NULL
+)""",
+    "customer": """
+CREATE TABLE customer (
+    c_custkey    INT8 NOT NULL,
+    c_name       STRING NOT NULL,
+    c_nationkey  INT8 NOT NULL,
+    c_acctbal    DECIMAL(15,2) NOT NULL,
+    c_mktsegment STRING NOT NULL
+)""",
+    "supplier": """
+CREATE TABLE supplier (
+    s_suppkey   INT8 NOT NULL,
+    s_name      STRING NOT NULL,
+    s_nationkey INT8 NOT NULL,
+    s_acctbal   DECIMAL(15,2) NOT NULL
+)""",
+    "partsupp": """
+CREATE TABLE partsupp (
+    ps_partkey    INT8 NOT NULL,
+    ps_suppkey    INT8 NOT NULL,
+    ps_availqty   INT8 NOT NULL,
+    ps_supplycost DECIMAL(15,2) NOT NULL
+)""",
+    "nation": """
+CREATE TABLE nation (
+    n_nationkey INT8 NOT NULL,
+    n_name      STRING NOT NULL,
+    n_regionkey INT8 NOT NULL
+)""",
+    "region": """
+CREATE TABLE region (
+    r_regionkey INT8 NOT NULL,
+    r_name      STRING NOT NULL
 )""",
 }
 
@@ -103,8 +160,11 @@ def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None,
     orderkey = np.sort(rng.integers(1, ORDERS_PER_SF * max(sf, 0.01) + 1,
                                     size=n).astype(np.int64))
     partkey = rng.integers(1, nparts + 1, size=n).astype(np.int64)
-    suppkey = rng.integers(1, max(int(SUPP_PER_SF * max(sf, 0.01)), 100) + 1,
-                           size=n).astype(np.int64)
+    # one of the part's 4 partsupp suppliers (gen_partsupp's rule), so
+    # lineitem⋈partsupp on (partkey, suppkey) never drops rows —
+    # the spec's referential guarantee
+    nsupp = max(int(SUPP_PER_SF * max(sf, 0.01)), 100)
+    suppkey = (partkey + rng.integers(0, 4, size=n) * 7) % nsupp + 1
     linenumber = rng.integers(1, 8, size=n).astype(np.int64)
     quantity = rng.integers(1, 51, size=n).astype(np.float64)
     # spec: extendedprice = quantity * part price; part price ~ 90000+...
@@ -180,6 +240,104 @@ def gen_part(sf: float, seed: int = 1, rows: int | None = None) -> dict:
     }
 
 
+def _n_orders(sf: float) -> int:
+    return int(ORDERS_PER_SF * max(sf, 0.01))
+
+
+def _n_supp(sf: float) -> int:
+    return max(int(SUPP_PER_SF * max(sf, 0.01)), 100)
+
+
+def _n_cust(sf: float) -> int:
+    return max(int(150_000 * max(sf, 0.01)), 500)
+
+
+def gen_orders(sf: float, seed: int = 2) -> dict:
+    n = _n_orders(sf)
+    rng = np.random.default_rng(seed)
+    orderkey = np.arange(1, n + 1, dtype=np.int64)
+    orderdate = rng.integers(_days("1992-01-01"), _days("1998-08-02"),
+                             size=n).astype(np.int32)
+    # F for 'old' orders (the spec derives status from line statuses;
+    # the date split yields the same three populations)
+    cutoff = _days("1995-06-17")
+    status = np.where(orderdate < cutoff - 90, "F",
+                      np.where(orderdate < cutoff, "P", "O")).astype(object)
+    return {
+        "o_orderkey": orderkey,
+        "o_custkey": rng.integers(1, _n_cust(sf) + 1,
+                                  size=n).astype(np.int64),
+        "o_orderstatus": status,
+        "o_totalprice": np.round(rng.uniform(900, 500000, size=n), 2),
+        "o_orderdate": orderdate,
+        "o_orderpriority": rng.choice(ORDER_PRIORITIES,
+                                      size=n).astype(object),
+        "o_shippriority": np.zeros(n, dtype=np.int64),
+    }
+
+
+def gen_customer(sf: float, seed: int = 3) -> dict:
+    n = _n_cust(sf)
+    rng = np.random.default_rng(seed)
+    custkey = np.arange(1, n + 1, dtype=np.int64)
+    return {
+        "c_custkey": custkey,
+        "c_name": np.array([f"Customer#{k:09d}" for k in custkey],
+                           dtype=object),
+        "c_nationkey": rng.integers(0, 25, size=n).astype(np.int64),
+        "c_acctbal": np.round(rng.uniform(-999, 9999, size=n), 2),
+        "c_mktsegment": rng.choice(SEGMENTS, size=n).astype(object),
+    }
+
+
+def gen_supplier(sf: float, seed: int = 4) -> dict:
+    n = _n_supp(sf)
+    rng = np.random.default_rng(seed)
+    suppkey = np.arange(1, n + 1, dtype=np.int64)
+    return {
+        "s_suppkey": suppkey,
+        "s_name": np.array([f"Supplier#{k:09d}" for k in suppkey],
+                           dtype=object),
+        "s_nationkey": rng.integers(0, 25, size=n).astype(np.int64),
+        "s_acctbal": np.round(rng.uniform(-999, 9999, size=n), 2),
+    }
+
+
+def gen_partsupp(sf: float) -> dict:
+    """4 suppliers per part, chosen by the same deterministic rule
+    gen_lineitem uses — so every lineitem (partkey, suppkey) pair has
+    a partsupp row, as the spec guarantees."""
+    nparts = max(int(PART_PER_SF * max(sf, 0.01)), 1000)
+    nsupp = _n_supp(sf)
+    partkey = np.repeat(np.arange(1, nparts + 1, dtype=np.int64), 4)
+    i = np.tile(np.arange(4, dtype=np.int64), nparts)
+    suppkey = (partkey + i * 7) % nsupp + 1
+    rng = np.random.default_rng(5)
+    return {
+        "ps_partkey": partkey,
+        "ps_suppkey": suppkey,
+        "ps_availqty": rng.integers(1, 10000,
+                                    size=len(partkey)).astype(np.int64),
+        "ps_supplycost": np.round(
+            rng.uniform(1, 1000, size=len(partkey)), 2),
+    }
+
+
+def gen_nation() -> dict:
+    return {
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": np.array(NATIONS, dtype=object),
+        "n_regionkey": np.array(NATION_REGION, dtype=np.int64),
+    }
+
+
+def gen_region() -> dict:
+    return {
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": np.array(REGIONS, dtype=object),
+    }
+
+
 def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
          rows: int | None = None, encoded: bool = False) -> None:
     """Create + bulk-ingest TPC-H tables into an Engine.
@@ -191,6 +349,15 @@ def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
     data and returnflag/linestatus values as the object path for a
     given seed, so the numpy oracles still agree)."""
     ts = engine.clock.now()
+    gens = {
+        "part": lambda: gen_part(sf),
+        "orders": lambda: gen_orders(sf),
+        "customer": lambda: gen_customer(sf),
+        "supplier": lambda: gen_supplier(sf),
+        "partsupp": lambda: gen_partsupp(sf),
+        "nation": gen_nation,
+        "region": gen_region,
+    }
     for t in tables:
         engine.execute(DDL[t])
         if t == "lineitem":
@@ -199,8 +366,12 @@ def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
                     engine.store.set_dictionary(t, cn, vals)
             cols = gen_lineitem(sf, seed=seed, rows=rows, encoded=encoded)
         else:
-            cols = gen_part(sf)
+            cols = gens[t]()
         engine.store.insert_columns(t, cols, ts)
+
+
+ALL_TABLES = ("lineitem", "part", "orders", "customer", "supplier",
+              "partsupp", "nation", "region")
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +416,147 @@ WHERE l_partkey = p_partkey
   AND l_shipdate < date '1995-09-01' + interval '1 month'
 """.replace("%%", "%")
 
-QUERIES = {"q1": Q1, "q6": Q6, "q14": Q14}
+Q3 = """
+SELECT
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) AS revenue,
+    o_orderdate,
+    o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < date '1995-03-15'
+  AND l_shipdate > date '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate, l_orderkey
+LIMIT 10
+"""
+
+Q5 = """
+SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey
+  AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA'
+  AND o_orderdate >= date '1994-01-01'
+  AND o_orderdate < date '1995-01-01'
+GROUP BY n_name
+ORDER BY revenue DESC, n_name
+"""
+
+Q9 = """
+SELECT nation, o_year, sum(amount) AS sum_profit
+FROM (
+    SELECT n_name AS nation,
+           extract(year FROM o_orderdate) AS o_year,
+           l_extendedprice * (1 - l_discount)
+               - ps_supplycost * l_quantity AS amount
+    FROM part, supplier, lineitem, partsupp, orders, nation
+    WHERE s_suppkey = l_suppkey
+      AND ps_suppkey = l_suppkey
+      AND ps_partkey = l_partkey
+      AND p_partkey = l_partkey
+      AND o_orderkey = l_orderkey
+      AND s_nationkey = n_nationkey
+      AND p_name LIKE '%%green%%'
+) AS profit
+GROUP BY nation, o_year
+ORDER BY nation, o_year DESC
+""".replace("%%", "%")
+
+Q12 = """
+SELECT l_shipmode,
+    sum(CASE WHEN o_orderpriority = '1-URGENT'
+               OR o_orderpriority = '2-HIGH'
+             THEN 1 ELSE 0 END) AS high_line_count,
+    sum(CASE WHEN o_orderpriority <> '1-URGENT'
+              AND o_orderpriority <> '2-HIGH'
+             THEN 1 ELSE 0 END) AS low_line_count
+FROM orders, lineitem
+WHERE o_orderkey = l_orderkey
+  AND l_shipmode IN ('MAIL', 'SHIP')
+  AND l_commitdate < l_receiptdate
+  AND l_shipdate < l_commitdate
+  AND l_receiptdate >= date '1994-01-01'
+  AND l_receiptdate < date '1995-01-01'
+GROUP BY l_shipmode
+ORDER BY l_shipmode
+"""
+
+# threshold parameterized: the spec's 300 is near-empty at tiny SFs
+Q18_TEMPLATE = """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity) AS total_qty
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (
+        SELECT l_orderkey FROM lineitem
+        GROUP BY l_orderkey HAVING sum(l_quantity) > {threshold})
+  AND c_custkey = o_custkey
+  AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate, o_orderkey
+LIMIT 100
+"""
+Q18 = Q18_TEMPLATE.format(threshold=300)
+
+# the join equality is factored out of the OR groups (semantically
+# identical to the spec text; lets the equi-join planner see it).
+# Containers/shipmodes use this generator's domains ('REG AIR').
+Q19 = """
+SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM lineitem, part
+WHERE p_partkey = l_partkey
+  AND l_shipinstruct = 'DELIVER IN PERSON'
+  AND l_shipmode IN ('AIR', 'REG AIR')
+  AND (
+      (p_brand = 'Brand#12'
+       AND p_container IN ('SM CASE', 'SM BOX')
+       AND l_quantity >= 1 AND l_quantity <= 11
+       AND p_size BETWEEN 1 AND 5)
+   OR (p_brand = 'Brand#23'
+       AND p_container IN ('MED BAG', 'MED BOX')
+       AND l_quantity >= 10 AND l_quantity <= 20
+       AND p_size BETWEEN 1 AND 10)
+   OR (p_brand = 'Brand#34'
+       AND p_container IN ('LG CASE', 'LG BOX')
+       AND l_quantity >= 20 AND l_quantity <= 30
+       AND p_size BETWEEN 1 AND 15)
+  )
+"""
+
+#  lineitem leads the FROM list so the fact table is the probe spine
+#  (build sides stay small: supplier/orders/nation + the grouped
+#  EXISTS tables) — semantically identical to the spec order
+Q21 = """
+SELECT s_name, count(*) AS numwait
+FROM lineitem l1, supplier, orders, nation
+WHERE s_suppkey = l1.l_suppkey
+  AND o_orderkey = l1.l_orderkey
+  AND o_orderstatus = 'F'
+  AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (
+      SELECT * FROM lineitem l2
+      WHERE l2.l_orderkey = l1.l_orderkey
+        AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (
+      SELECT * FROM lineitem l3
+      WHERE l3.l_orderkey = l1.l_orderkey
+        AND l3.l_suppkey <> l1.l_suppkey
+        AND l3.l_receiptdate > l3.l_commitdate)
+  AND s_nationkey = n_nationkey
+  AND n_name = 'SAUDI ARABIA'
+GROUP BY s_name
+ORDER BY numwait DESC, s_name
+LIMIT 100
+"""
+
+QUERIES = {"q1": Q1, "q3": Q3, "q5": Q5, "q6": Q6, "q9": Q9,
+           "q12": Q12, "q14": Q14, "q18": Q18, "q19": Q19, "q21": Q21}
 
 
 # ---------------------------------------------------------------------------
@@ -288,3 +599,179 @@ def ref_q14(li: dict, part: dict) -> float:
                       for t in types])
     rev = (li["l_extendedprice"][m] * (1 - li["l_discount"][m]))
     return float(100.0 * rev[promo].sum() / rev.sum())
+
+
+def ref_q3(li, orders, cust) -> list[tuple]:
+    building = cust["c_custkey"][cust["c_mktsegment"] == "BUILDING"]
+    bset = np.zeros(int(cust["c_custkey"].max()) + 1, dtype=bool)
+    bset[building] = True
+    cut = _days("1995-03-15")
+    om = (orders["o_orderdate"] < cut) & bset[orders["o_custkey"]]
+    ok_ok = orders["o_orderkey"][om]
+    odate = dict(zip(ok_ok.tolist(),
+                     orders["o_orderdate"][om].tolist()))
+    lm = li["l_shipdate"] > cut
+    rev: dict = {}
+    lk = li["l_orderkey"][lm]
+    r = (li["l_extendedprice"][lm] * (1 - li["l_discount"][lm]))
+    for k, v in zip(lk.tolist(), r.tolist()):
+        if k in odate:
+            rev[k] = rev.get(k, 0.0) + v
+    rows = [(k, rv, datetime.date.fromordinal(
+                EPOCH.toordinal() + odate[k]), 0)
+            for k, rv in rev.items()]
+    rows.sort(key=lambda t: (-t[1], t[2], t[0]))
+    return rows[:10]
+
+
+def ref_q5(li, orders, cust, supp) -> list[tuple]:
+    asia = set(np.where(np.array(NATION_REGION) == 2)[0].tolist())
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    om = (orders["o_orderdate"] >= d0) & (orders["o_orderdate"] < d1)
+    o_cust = dict(zip(orders["o_orderkey"][om].tolist(),
+                      orders["o_custkey"][om].tolist()))
+    c_nat = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_nationkey"].tolist()))
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    rev: dict = {}
+    r = li["l_extendedprice"] * (1 - li["l_discount"])
+    for ok, sk, v in zip(li["l_orderkey"].tolist(),
+                         li["l_suppkey"].tolist(), r.tolist()):
+        ck = o_cust.get(ok)
+        if ck is None:
+            continue
+        sn = s_nat[sk]
+        if sn not in asia or c_nat[ck] != sn:
+            continue
+        rev[NATIONS[sn]] = rev.get(NATIONS[sn], 0.0) + v
+    return sorted(rev.items(), key=lambda t: (-t[1], t[0]))
+
+
+def ref_q9(li, orders, supp, part, ps) -> list[tuple]:
+    green = np.array(["green" in n for n in part["p_name"]])
+    gset = np.zeros(int(part["p_partkey"].max()) + 1, dtype=bool)
+    gset[part["p_partkey"]] = green
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    cost = {(p, s): c for p, s, c in zip(
+        ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist(),
+        ps["ps_supplycost"].tolist())}
+    o_year = dict(zip(orders["o_orderkey"].tolist(),
+                      [datetime.date.fromordinal(
+                          EPOCH.toordinal() + int(d)).year
+                       for d in orders["o_orderdate"]]))
+    out: dict = {}
+    amount = li["l_extendedprice"] * (1 - li["l_discount"])
+    for i in range(len(li["l_orderkey"])):
+        pk = int(li["l_partkey"][i])
+        if not gset[pk]:
+            continue
+        sk = int(li["l_suppkey"][i])
+        amt = float(amount[i]) - cost[(pk, sk)] * float(li["l_quantity"][i])
+        key = (NATIONS[s_nat[sk]], o_year[int(li["l_orderkey"][i])])
+        out[key] = out.get(key, 0.0) + amt
+    return sorted(((n, y, v) for (n, y), v in out.items()),
+                  key=lambda t: (t[0], -t[1]))
+
+
+def ref_q12(li, orders) -> list[tuple]:
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    m = (np.isin(li["l_shipmode"], ["MAIL", "SHIP"])
+         & (li["l_commitdate"] < li["l_receiptdate"])
+         & (li["l_shipdate"] < li["l_commitdate"])
+         & (li["l_receiptdate"] >= d0) & (li["l_receiptdate"] < d1))
+    prio = dict(zip(orders["o_orderkey"].tolist(),
+                    orders["o_orderpriority"].tolist()))
+    out: dict = {}
+    for ok, sm in zip(li["l_orderkey"][m].tolist(),
+                      li["l_shipmode"][m].tolist()):
+        hi = prio[ok] in ("1-URGENT", "2-HIGH")
+        h, l = out.get(sm, (0, 0))
+        out[sm] = (h + (1 if hi else 0), l + (0 if hi else 1))
+    return sorted((sm, h, l) for sm, (h, l) in out.items())
+
+
+def ref_q18(li, orders, cust, threshold=300) -> list[tuple]:
+    qty: dict = {}
+    for k, q in zip(li["l_orderkey"].tolist(),
+                    li["l_quantity"].tolist()):
+        qty[k] = qty.get(k, 0.0) + q
+    big = {k for k, q in qty.items() if q > threshold}
+    cname = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_name"].tolist()))
+    rows = []
+    for i in range(len(orders["o_orderkey"])):
+        ok = int(orders["o_orderkey"][i])
+        if ok not in big:
+            continue
+        ck = int(orders["o_custkey"][i])
+        rows.append((cname[ck], ck, ok,
+                     datetime.date.fromordinal(
+                         EPOCH.toordinal()
+                         + int(orders["o_orderdate"][i])),
+                     float(orders["o_totalprice"][i]), qty[ok]))
+    rows.sort(key=lambda t: (-t[4], t[3], t[2]))
+    return rows[:100]
+
+
+def ref_q19(li, part) -> float:
+    pmax = int(part["p_partkey"].max()) + 1
+    brand = np.empty(pmax, dtype=object)
+    brand[part["p_partkey"]] = part["p_brand"]
+    cont = np.empty(pmax, dtype=object)
+    cont[part["p_partkey"]] = part["p_container"]
+    size = np.zeros(pmax, dtype=np.int64)
+    size[part["p_partkey"]] = part["p_size"]
+    b = brand[li["l_partkey"]]
+    c = cont[li["l_partkey"]]
+    s = size[li["l_partkey"]]
+    q = li["l_quantity"]
+    base = (np.isin(li["l_shipmode"], ["AIR", "REG AIR"])
+            & (li["l_shipinstruct"] == "DELIVER IN PERSON"))
+    g1 = ((b == "Brand#12") & np.isin(c, ["SM CASE", "SM BOX"])
+          & (q >= 1) & (q <= 11) & (s >= 1) & (s <= 5))
+    g2 = ((b == "Brand#23") & np.isin(c, ["MED BAG", "MED BOX"])
+          & (q >= 10) & (q <= 20) & (s >= 1) & (s <= 10))
+    g3 = ((b == "Brand#34") & np.isin(c, ["LG CASE", "LG BOX"])
+          & (q >= 20) & (q <= 30) & (s >= 1) & (s <= 15))
+    m = base & (g1 | g2 | g3)
+    return float((li["l_extendedprice"][m]
+                  * (1 - li["l_discount"][m])).sum())
+
+
+def ref_q21(li, orders, supp) -> list[tuple]:
+    saudi = NATIONS.index("SAUDI ARABIA")
+    f_orders = set(orders["o_orderkey"][
+        orders["o_orderstatus"] == "F"].tolist())
+    # per-order supplier sets: all, and late-only
+    all_supp: dict = {}
+    late_supp: dict = {}
+    late = li["l_receiptdate"] > li["l_commitdate"]
+    for i in range(len(li["l_orderkey"])):
+        ok = int(li["l_orderkey"][i])
+        sk = int(li["l_suppkey"][i])
+        all_supp.setdefault(ok, set()).add(sk)
+        if late[i]:
+            late_supp.setdefault(ok, set()).add(sk)
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    s_name = dict(zip(supp["s_suppkey"].tolist(),
+                      supp["s_name"].tolist()))
+    out: dict = {}
+    for i in range(len(li["l_orderkey"])):
+        ok = int(li["l_orderkey"][i])
+        sk = int(li["l_suppkey"][i])
+        if not late[i] or ok not in f_orders:
+            continue
+        if s_nat[sk] != saudi:
+            continue
+        others = all_supp[ok] - {sk}
+        if not others:
+            continue                      # EXISTS fails
+        late_others = late_supp.get(ok, set()) - {sk}
+        if late_others:
+            continue                      # NOT EXISTS fails
+        nm = s_name[sk]
+        out[nm] = out.get(nm, 0) + 1
+    return sorted(out.items(), key=lambda t: (-t[1], t[0]))[:100]
