@@ -84,7 +84,13 @@ def run(rows_by_query, pipeline, repeats, tag=""):
     for rows, queries in by_rows.items():
         eng = Engine()
         t0 = time.time()
-        tables = ("lineitem", "part") if "q14" in queries else ("lineitem",)
+        suite = {"q3", "q5", "q9", "q12", "q18", "q19", "q21"}
+        if suite & set(queries):
+            tables = tpch.ALL_TABLES
+        elif "q14" in queries:
+            tables = ("lineitem", "part")
+        else:
+            tables = ("lineitem",)
         tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
                   tables=tables, encoded=True)
         gen_s = time.time() - t0
@@ -198,16 +204,17 @@ def main():
     default_rows = 1 << 22 if mode == "cpu" else 1 << 25
     rows = int(os.environ.get("BENCH_ROWS", default_rows))
     qenv = os.environ.get("BENCH_QUERY", "all")
-    queries = (["q6", "q1", "q14"] if qenv == "all"
+    queries = (["q6", "q1", "q14", "q3", "q9", "q18"] if qenv == "all"
                else [q.strip() for q in qenv.split(",")])
     pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
 
     # q1/q14 run at resident-friendly row counts; q6 takes the full
-    # size. q14's gather-bound join (~17M rows/s on a tunnel-attached
-    # v5e) gets a smaller cap so its child can never eat the round's
-    # bench budget.
-    caps = ({"q1": 1 << 25, "q14": 1 << 23}
+    # size. The multi-table suite queries (q3/q9/q18: 3-6-way joins,
+    # derived tables, IN-subqueries) run smaller — their cost is joins
+    # and host orchestration, not scan rate.
+    caps = ({"q1": 1 << 25, "q14": 1 << 23, "q3": 1 << 22,
+             "q9": 1 << 22, "q18": 1 << 22}
             if mode.startswith("tpu") else {})
     rows_by_query = {q: min(rows, caps.get(q, rows)) for q in queries}
 

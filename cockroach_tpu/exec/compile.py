@@ -126,7 +126,8 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
             rb = rightf(rc)
             return hash_join(lb, rb, jn.left_keys, jn.right_keys,
                              jn.payload, jn.join_type,
-                             expand=jn.expand, direct=jn.direct)
+                             expand=jn.expand, direct=jn.direct,
+                             pack_payload=jn.pack_payload)
         return run_join
     if isinstance(node, P.Aggregate):
         return _compile_aggregate(node, params)

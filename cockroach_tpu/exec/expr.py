@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -231,9 +232,8 @@ def compile_expr(e: BExpr) -> CompiledExpr:
 
         def f_dict(ctx):
             d, v = xf(ctx)
-            lut = jnp.asarray(tbl)
             codes = jnp.clip(d, 0, tbl.shape[0] - 1)
-            return lut[codes], v
+            return _small_lut(tbl, codes), v
         return f_dict
 
     if isinstance(e, BDictRemap):
@@ -242,12 +242,39 @@ def compile_expr(e: BExpr) -> CompiledExpr:
 
         def f_remap(ctx):
             d, v = xf(ctx)
-            lut = jnp.asarray(rtbl)
             codes = jnp.clip(d, 0, rtbl.shape[0] - 1)
-            return lut[codes], v
+            return _small_lut(rtbl, codes), v
         return f_remap
 
     raise NotImplementedError(f"cannot compile {e!r}")
+
+
+# small-LUT gathers ride the MXU: TPU VPU dynamic gathers run ~100-200M
+# lookups/s, while a one-hot matmul against a <=512-entry table is
+# effectively free next to the surrounding streaming work (the MXU is
+# idle in scan programs). Measured on v5e (round 3): 8.4M boolean
+# lookups via gather +70ms, via one-hot matmul +0ms. f32 keeps integer
+# remap values exact (<= 2^24); the dictionary LIKE/IN/= predicates
+# TPC-H and SSB lean on are all <=512-entry LUTs.
+_ONE_HOT_MAX = 512
+
+
+def _small_lut(tbl: np.ndarray, codes):
+    L = tbl.shape[0]
+    if L > _ONE_HOT_MAX or (
+            tbl.dtype != np.bool_ and L > 0
+            and np.abs(tbl).max() >= (1 << 24)):
+        # f32 holds integers exactly only below 2^24: big remap values
+        # (SF100-class target dictionaries) stay on the gather path
+        return jnp.asarray(tbl)[codes]
+    lp = max(128, 1 << (L - 1).bit_length())
+    padded = np.zeros((lp,), dtype=np.float32)
+    padded[:L] = tbl.astype(np.float32)
+    oh = jax.nn.one_hot(codes, lp, dtype=jnp.float32)
+    out = oh @ jnp.asarray(padded)
+    if tbl.dtype == np.bool_:
+        return out > 0.5
+    return jnp.round(out).astype(tbl.dtype)
 
 
 # 1-arg elementwise builtin kernels (sql/builtins.py registry); all

@@ -22,6 +22,7 @@ materialization.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from . import hashtable
@@ -39,7 +40,7 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
               probe_keys: list[str], build_keys: list[str],
               build_payload: list[str], join_type: str = "inner",
               suffix: str = "", expand: int = 1,
-              direct=None) -> ColumnBatch:
+              direct=None, pack_payload=()) -> ColumnBatch:
     """Join `probe` against `build` and return the probe batch extended
     with `build_payload` columns gathered from matches.
 
@@ -76,6 +77,81 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
         pk0 = pkeys[0]
         in_range = jnp.logical_and(pk0 >= base, pk0 - base < size - 1)
         pidx = jnp.clip(pk0 - base, 0, size - 1).astype(jnp.int32)
+        if expand <= 1 and join_type in ("inner", "left", "semi",
+                                         "anti"):
+            # Payload folding (round-3 VERDICT #5): re-shape the
+            # tables so every probe-side gather is addressed by pidx
+            # DIRECTLY instead of the two-hop chain (gather owner,
+            # then gather payload at owner). The fold costs one
+            # build-side gather per payload over the (small) dimension
+            # domain; the probe side loses its serial dependency and
+            # one random int32 read per row — the Q14/SSB star-join
+            # gather ceiling BENCHMARKS.md round 2 measured.
+            owner_slot = jnp.minimum(table, build.n - 1)
+            vtab = table < build.n               # slot -> live build?
+            # Three-state packing: when a payload column is an int32
+            # dict code (>= 0), fold the match bit AND the null bit
+            # into the value table — the whole join then costs ONE
+            # probe-side gather (-2 = no build row, -1 = NULL payload,
+            # >= 0 = the code). Probe gathers are the star-join cost
+            # on TPU (~44 ms per 8M rows measured on v5e); every table
+            # here is built with size-length ops on the small build
+            # domain.
+            packable = [n_ for n_ in build_payload
+                        if n_ in pack_payload
+                        and build.col(n_).dtype in (jnp.int32,
+                                                    jnp.bool_)]
+            base_ok = jnp.logical_and(pmask, in_range)
+            matched = None
+            out = probe
+            if packable and join_type in ("inner", "left"):
+                first = packable[0]
+                for name in build_payload:
+                    if name in packable:
+                        col = build.col(name)
+                        is_bool = col.dtype == jnp.bool_
+                        code = (col.astype(jnp.int32)
+                                if is_bool else col)[owner_slot]
+                        pval = build.col_valid(name)[owner_slot]
+                        packed = jnp.where(
+                            vtab, jnp.where(pval, code,
+                                            jnp.int32(-1)),
+                            jnp.int32(-2))
+                        # barrier: XLA otherwise rematerializes the
+                        # gather once per consumer fusion (observed:
+                        # 2x probe-length gathers in the Q14 HLO)
+                        t = jax.lax.optimization_barrier(packed[pidx])
+                        if name == first:
+                            matched = jnp.logical_and(base_ok,
+                                                      t >= -1)
+                        data = (t == 1) if is_bool \
+                            else jnp.maximum(t, 0)
+                        valid = jnp.logical_and(t >= 0, base_ok)
+                        out = out.with_column(name + suffix, data,
+                                              valid)
+                    else:
+                        ptab = build.col(name)[owner_slot]
+                        pvtab = jnp.logical_and(
+                            build.col_valid(name)[owner_slot], vtab)
+                        out = out.with_column(
+                            name + suffix, ptab[pidx],
+                            jnp.logical_and(pvtab[pidx], base_ok))
+                return out.and_sel(matched) if join_type == "inner" \
+                    else out
+            matched = jnp.logical_and(base_ok, vtab[pidx])
+            if join_type == "semi":
+                return probe.and_sel(matched)
+            if join_type == "anti":
+                return probe.and_sel(jnp.logical_not(matched))
+            for name in build_payload:
+                ptab = build.col(name)[owner_slot]       # [size]
+                pvtab = jnp.logical_and(
+                    build.col_valid(name)[owner_slot], vtab)
+                data = ptab[pidx]
+                valid = jnp.logical_and(pvtab[pidx], matched)
+                out = out.with_column(name + suffix, data, valid)
+            return out.and_sel(matched) if join_type == "inner" \
+                else out
         owner = table[pidx]
         build_row = jnp.minimum(owner, build.n - 1)
         # No key-equality re-check needed: direct addressing is

@@ -57,6 +57,9 @@ class HashJoin(PlanNode):
     # scatter to build + one gather to probe — no hash table, no
     # while_loop. None = open-addressing hash table.
     direct: Optional[tuple] = None  # (base, table_size)
+    # payload columns that are dict codes (int32, >= 0): the direct
+    # fold packs match/null/value into one table -> one probe gather
+    pack_payload: list = field(default_factory=list)
 
 
 @dataclass

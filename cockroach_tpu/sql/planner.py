@@ -462,7 +462,10 @@ class Planner:
                     ([build.filter] if build.filter is not None else [])
                     + build_local)
             payload = [b.batch_name for b in scope.tables[alias].values()]
-            node = plan.HashJoin(node, build, lk, rk, payload, jt)
+            pack = [b.batch_name for b in scope.tables[alias].values()
+                    if b.dictionary is not None]
+            node = plan.HashJoin(node, build, lk, rk, payload, jt,
+                                 pack_payload=pack)
             joined.add(alias)
             # residual ON conjuncts of inner joins are plain filters
             remaining_conjuncts.extend(residual)
@@ -612,6 +615,8 @@ class Planner:
                                                scope, node)
                 if d is not None:
                     meta.dictionaries[name] = d
+        from .pushdown import push_build_exprs
+        push_build_exprs(node)
         plan.prune_scan_columns(node)
         meta.memo = self.last_memo
         return node, meta
