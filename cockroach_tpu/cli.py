@@ -133,6 +133,100 @@ class PgClient:
                     raise PgError(err)
                 return names, rows, tags
 
+    # -- extended protocol ---------------------------------------------------
+    def _send(self, typ: bytes, payload: bytes):
+        self.sock.sendall(typ + struct.pack("!I", len(payload) + 4)
+                          + payload)
+
+    def extended_query(self, sql: str, params=(), param_oids=(),
+                       binary=False, max_rows: int = 0):
+        """Parse/Bind/Describe/Execute/Sync round trip with parameters.
+
+        params: python values (None|int|float|bool|str); binary=True
+        sends int/float/bool in binary wire format (needs param_oids).
+        Returns (param_oids_described, names, rows, completed) —
+        completed False means the portal suspended at max_rows."""
+        # Parse
+        p = b"\x00" + sql.encode() + b"\x00"
+        p += struct.pack("!H", len(param_oids))
+        for o in param_oids:
+            p += struct.pack("!I", o)
+        self._send(b"P", p)
+        # Describe statement (parameter oids come back)
+        self._send(b"D", b"S\x00")
+        # Bind
+        b = b"\x00\x00"   # unnamed portal, unnamed stmt
+        if binary:
+            b += struct.pack("!H", len(params))
+            b += b"".join(struct.pack("!H", 1) for _ in params)
+        else:
+            b += struct.pack("!H", 0)
+        b += struct.pack("!H", len(params))
+        for i, v in enumerate(params):
+            if v is None:
+                b += struct.pack("!i", -1)
+                continue
+            if binary:
+                oid = param_oids[i] if i < len(param_oids) else 0
+                if oid == 20:
+                    raw = struct.pack("!q", int(v))
+                elif oid == 701:
+                    raw = struct.pack("!d", float(v))
+                elif oid == 16:
+                    raw = b"\x01" if v else b"\x00"
+                else:
+                    raw = str(v).encode()
+            else:
+                raw = ("t" if v is True else "f" if v is False
+                       else str(v)).encode()
+            b += struct.pack("!I", len(raw)) + raw
+        b += struct.pack("!H", 0)   # result-format codes: all text
+        self._send(b"B", b)
+        # Execute + Sync
+        self._send(b"E", b"\x00" + struct.pack("!i", max_rows))
+        self._send(b"S", b"")
+        oids_desc: list[int] = []
+        names: list[str] = []
+        rows: list[tuple] = []
+        completed = True
+        err = None
+        while True:
+            typ, body = self._msg()
+            if typ == b"t":
+                (n,) = struct.unpack_from("!H", body, 0)
+                oids_desc = [struct.unpack_from("!I", body, 2 + 4 * i)[0]
+                             for i in range(n)]
+            elif typ == b"T":
+                (n,) = struct.unpack_from("!H", body, 0)
+                off = 2
+                names = []
+                for _ in range(n):
+                    end = body.index(b"\x00", off)
+                    names.append(body[off:end].decode())
+                    off = end + 1 + 18
+            elif typ == b"D":
+                (n,) = struct.unpack_from("!H", body, 0)
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", body, off)
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif typ == b"s":
+                completed = False
+            elif typ == b"E":
+                err = self._err_fields(body)
+            elif typ == b"Z":
+                self.txn_status = body
+                if err:
+                    raise PgError(err)
+                return oids_desc, names, rows, completed
+
     def close(self):
         try:
             self.sock.sendall(b"X" + struct.pack("!I", 4))

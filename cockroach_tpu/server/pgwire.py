@@ -3,16 +3,18 @@
 The analogue of the reference's pgwire server (pkg/sql/pgwire/server.go:685
 ``ServeConn``; per-connection loop pkg/sql/pgwire/conn.go:280 ``serveImpl``).
 Scope: startup handshake (plus SSLRequest denial), trust auth, the simple
-query protocol (Query -> RowDescription/DataRow/CommandComplete), a minimal
-extended protocol (Parse/Bind/Describe/Execute/Close/Sync) sufficient for
-driver-style clients that never use parameters, and error reporting with
-SQLSTATE codes. Each connection owns an engine Session, so transaction
-state (idle / open / aborted) is per-connection exactly like the
-reference's connExecutor, and is reported in ReadyForQuery.
+query protocol (Query -> RowDescription/DataRow/CommandComplete), the
+extended protocol (Parse/Bind/Describe/Execute/Close/Sync) with text and
+binary parameter binding and row-limited Execute with portal suspension,
+and error reporting with SQLSTATE codes. Each connection owns an engine
+Session, so transaction state (idle / open / aborted) is per-connection
+exactly like the reference's connExecutor, and is reported in
+ReadyForQuery.
 
-No TLS, SCRAM, COPY, or portals-with-suspension: those are listed in
-SURVEY §2.1 as later-round work. The framing below is from the public
-PostgreSQL protocol documentation, not from the reference tree.
+No TLS, SCRAM, COPY, or binary RESULT encoding (binary result format
+codes are rejected with 0A000): later-round work per SURVEY §2.1. The
+framing below is from the public PostgreSQL protocol documentation, not
+from the reference tree.
 """
 
 from __future__ import annotations
@@ -188,6 +190,9 @@ class _Writer:
     def close_complete(self):
         self.msg(b"3")
 
+    def portal_suspended(self):
+        self.msg(b"s")
+
     def parameter_description(self, oids):
         self.msg(b"t", struct.pack("!H", len(oids)) +
                  b"".join(struct.pack("!I", o) for o in oids))
@@ -242,6 +247,129 @@ def _cstr(b: bytes, off: int) -> tuple[str, int]:
     return b[off:end].decode(), end + 1
 
 
+def _scan_placeholders(sql: str):
+    """Yield (start, end, index) for every $N outside string literals
+    and quoted identifiers."""
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+        elif c == "/" and i + 1 < n and sql[i + 1] == "*":
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        elif c == "'":
+            i += 1
+            while i < n:
+                if sql[i] == "'":
+                    if i + 1 < n and sql[i + 1] == "'":
+                        i += 2
+                        continue
+                    break
+                i += 1
+            i += 1
+        elif c == '"':
+            i = sql.find('"', i + 1)
+            i = n if i < 0 else i + 1
+        elif c == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            yield i, j, int(sql[i + 1:j])
+            i = j
+        else:
+            i += 1
+
+
+def _count_placeholders(sql: str) -> int:
+    return max((idx for _s, _e, idx in _scan_placeholders(sql)),
+               default=0)
+
+
+def _decode_param(raw: bytes | None, fmt: int, oid: int) -> str:
+    """One bound parameter -> SQL literal text. Text format re-quotes;
+    binary format decodes the common wire types (int2/4/8, float8,
+    bool, text) by declared oid."""
+    if raw is None:
+        return "NULL"
+    if fmt == 1:   # binary
+        if oid == OID_INT8:
+            return str(struct.unpack("!q", raw)[0])
+        if oid == 21 and len(raw) == 2:    # int2
+            return str(struct.unpack("!h", raw)[0])
+        if oid == 23 and len(raw) == 4:    # int4
+            return str(struct.unpack("!i", raw)[0])
+        if oid == OID_FLOAT8 and len(raw) == 8:
+            return repr(struct.unpack("!d", raw)[0])
+        if oid == OID_BOOL and len(raw) == 1:
+            return "TRUE" if raw[0] else "FALSE"
+        s = raw.decode("utf-8")            # text-like payloads
+    else:
+        s = raw.decode("utf-8")
+    if oid in (OID_INT8, 21, 23):
+        return "(%d)" % int(s)        # validate AND parenthesize:
+        # splicing raw text would let '-1' form a '--' comment or a
+        # crafted payload inject statement text
+    if oid in (OID_FLOAT8, 700, 1700):
+        return "(%s)" % repr(float(s))
+    if oid == OID_BOOL:
+        return "TRUE" if s.lower() in ("t", "true", "1", "on") \
+            else "FALSE"
+    return "'" + s.replace("'", "''") + "'"
+
+
+def _bind_params(sql: str, oids: list, body: bytes, off: int):
+    """Decode a Bind message's format codes + parameter values and
+    substitute them into the SQL as literals. The statement then rides
+    the normal parse/plan path — the reference binds placeholders into
+    the AST instead (sql/pgwire/conn.go + planner placeholders); text
+    substitution trades plan-cache hits across distinct values for a
+    much smaller surface, and is what several pg poolers/proxies do."""
+    (nfmt,) = struct.unpack_from("!H", body, off)
+    off += 2
+    fmts = []
+    for _ in range(nfmt):
+        (f,) = struct.unpack_from("!H", body, off)
+        fmts.append(f)
+        off += 2
+    (nvals,) = struct.unpack_from("!H", body, off)
+    off += 2
+    vals = []
+    for _ in range(nvals):
+        (ln,) = struct.unpack_from("!i", body, off)
+        off += 4
+        if ln < 0:
+            vals.append(None)
+        else:
+            vals.append(body[off:off + ln])
+            off += ln
+    lits = []
+    for i, raw in enumerate(vals):
+        fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1
+                                             else 0)
+        oid = oids[i] if i < len(oids) else 0
+        lits.append(_decode_param(raw, fmt, oid))
+    # result-format codes: binary results are not implemented — fail
+    # loudly instead of sending text bytes a driver will mis-decode
+    (nrfmt,) = struct.unpack_from("!H", body, off)
+    off += 2
+    for _ in range(nrfmt):
+        (rf,) = struct.unpack_from("!H", body, off)
+        off += 2
+        if rf == 1:
+            raise EngineError(
+                "binary result format is not supported")
+    # splice back-to-front so offsets stay valid
+    spots = sorted(_scan_placeholders(sql), reverse=True)
+    for s, e, idx in spots:
+        if idx < 1 or idx > len(lits):
+            raise EngineError(
+                f"there is no parameter ${idx}")
+        sql = sql[:s] + lits[idx - 1] + sql[e:]
+    return sql, off
+
+
 class _Conn:
     """One client connection: the serveImpl loop (conn.go:280)."""
 
@@ -254,9 +382,11 @@ class _Conn:
         self.r = _Reader(sock)
         self.w = _Writer(sock)
         self.session: Session = engine.session()
-        # extended-protocol state: prepared statements + bound portals
-        self.stmts: dict[str, str] = {}
-        self.portals: dict[str, str] = {}
+        # extended-protocol state: prepared statements (sql, declared
+        # param oids) + bound portals (sql with params substituted,
+        # plus any suspended result for row-limited Execute)
+        self.stmts: dict[str, tuple] = {}
+        self.portals: dict[str, dict] = {}
         self._errored = False  # skip-until-Sync after extended-proto error
 
     # -- helpers -------------------------------------------------------------
@@ -282,6 +412,32 @@ class _Conn:
             for row in res.rows:
                 self.w.data_row([_encode_text(v) for v in row])
         self.w.command_complete(self._complete_tag(res))
+
+    def _send_portal(self, p: dict, max_rows: int):
+        """Row-limited portal execution: emit up to max_rows, then
+        PortalSuspended; a later Execute on the same portal resumes
+        where it stopped (pg portal suspension semantics)."""
+        res = p["pending"]
+        if res.names and not p["described"]:
+            oids = [_infer_oid(res.rows, i)
+                    for i in range(len(res.names))]
+            self.w.row_description(res.names, oids)
+            p["described"] = True
+        rows = res.rows
+        start = p["cursor"]
+        end = len(rows) if max_rows <= 0 else min(len(rows),
+                                                  start + max_rows)
+        for row in rows[start:end]:
+            self.w.data_row([_encode_text(v) for v in row])
+        p["cursor"] = end
+        if end < len(rows):
+            self.w.portal_suspended()
+            return
+        tag = self._complete_tag(res)
+        self.w.command_complete(tag)
+        del p["pending"]
+        p["completed"] = True
+        p["tag"] = tag
 
     def _execute(self, sql: str) -> Result:
         return self.engine.execute(sql, self.session)
@@ -357,10 +513,18 @@ class _Conn:
                 name, off = _cstr(body, 0)
                 sql, off = _cstr(body, off)
                 (nparams,) = struct.unpack_from("!H", body, off)
-                if nparams:
-                    raise EngineError(
-                        "bind parameters are not supported yet")
-                self.stmts[name] = sql
+                off += 2
+                oids = []
+                for _ in range(nparams):
+                    (o,) = struct.unpack_from("!I", body, off)
+                    oids.append(o)
+                    off += 4
+                # placeholders present but undeclared: count $N in the
+                # text so Describe can report them (oid 0 = unknown)
+                n_ph = _count_placeholders(sql)
+                while len(oids) < n_ph:
+                    oids.append(0)
+                self.stmts[name] = (sql, oids)
                 self.w.parse_complete()
             elif typ == b"B":         # Bind
                 portal, off = _cstr(body, 0)
@@ -368,7 +532,9 @@ class _Conn:
                 if stmt not in self.stmts:
                     raise EngineError(f"unknown prepared statement "
                                       f"{stmt!r}")
-                self.portals[portal] = self.stmts[stmt]
+                sql, oids = self.stmts[stmt]
+                sql, off = _bind_params(sql, oids, body, off)
+                self.portals[portal] = {"sql": sql}
                 self.w.bind_complete()
             elif typ == b"D":         # Describe
                 kind, sql_name = body[:1], _cstr(body, 1)[0]
@@ -376,17 +542,29 @@ class _Conn:
                 if sql_name not in src:
                     raise EngineError(f"unknown {kind!r} {sql_name!r}")
                 if kind == b"S":
-                    self.w.parameter_description([])
+                    self.w.parameter_description(self.stmts[sql_name][1])
                 # row shape is only known post-execution here; NoData
                 # keeps drivers on the simple path (they re-describe
                 # from the result's RowDescription we emit on Execute)
                 self.w.no_data()
             elif typ == b"E":         # Execute
-                portal, _ = _cstr(body, 0)
+                portal, off = _cstr(body, 0)
                 if portal not in self.portals:
                     raise EngineError(f"unknown portal {portal!r}")
-                res = self._execute(self.portals[portal])
-                self._send_result(res)
+                (max_rows,) = struct.unpack_from("!i", body, off)
+                p = self.portals[portal]
+                if p.get("completed"):
+                    # executing a completed portal returns no further
+                    # rows (pg portal semantics) — never re-runs DML
+                    self.w.command_complete(p["tag"])
+                elif "pending" not in p:
+                    res = self._execute(p["sql"])
+                    p["pending"] = res
+                    p["cursor"] = 0
+                    p["described"] = False
+                    self._send_portal(p, max_rows)
+                else:
+                    self._send_portal(p, max_rows)
             elif typ == b"C":         # Close
                 kind, name = body[:1], _cstr(body, 1)[0]
                 (self.portals if kind == b"P" else self.stmts).pop(

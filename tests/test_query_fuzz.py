@@ -19,7 +19,7 @@ from cockroach_tpu.exec.engine import Engine
 
 import os
 
-N_QUERIES = int(os.environ.get("FUZZ_QUERIES", 25))
+N_QUERIES = int(os.environ.get("FUZZ_QUERIES", 120))
 SEED = int(os.environ.get("FUZZ_SEED", 20260730))
 
 
