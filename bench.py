@@ -204,8 +204,15 @@ def main():
     default_rows = 1 << 22 if mode == "cpu" else 1 << 25
     rows = int(os.environ.get("BENCH_ROWS", default_rows))
     qenv = os.environ.get("BENCH_QUERY", "all")
-    queries = (["q6", "q1", "q14", "q3", "q9", "q18"] if qenv == "all"
+    # default ladder: scan/agg/join shapes that complete reliably on
+    # the tunnel chip. q9/q18 RUN correctly (tests) but stay behind
+    # BENCH_SUITE=1: q9's composite-key partsupp join still rides the
+    # while-loop hash path (~140s/exec), and a q18 run crashed the TPU
+    # worker once — not worth risking the whole ladder on.
+    queries = (["q6", "q1", "q14", "q3"] if qenv == "all"
                else [q.strip() for q in qenv.split(",")])
+    if qenv == "all" and os.environ.get("BENCH_SUITE", "0") == "1":
+        queries += ["q9", "q18"]
     pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
 
@@ -213,8 +220,12 @@ def main():
     # size. The multi-table suite queries (q3/q9/q18: 3-6-way joins,
     # derived tables, IN-subqueries) run smaller — their cost is joins
     # and host orchestration, not scan rate.
-    caps = ({"q1": 1 << 25, "q14": 1 << 23, "q3": 1 << 22,
-             "q9": 1 << 22, "q18": 1 << 22}
+    # suite queries are compile-heavy (hash-strategy GROUP BY while
+    # loops: q3 ~5min XLA compile at 2^20) — keep their row counts
+    # small so each child stays inside its timeout; their metric is
+    # join/plan breadth, not scan rate
+    caps = ({"q1": 1 << 25, "q14": 1 << 23, "q3": 1 << 20,
+             "q9": 1 << 20, "q18": 1 << 20}
             if mode.startswith("tpu") else {})
     rows_by_query = {q: min(rows, caps.get(q, rows)) for q in queries}
 

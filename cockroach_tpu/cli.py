@@ -88,6 +88,32 @@ class PgClient:
                 self.params[k.decode()] = v.decode()
             # R (auth), K (key data), N (notice): nothing to do
 
+    @staticmethod
+    def _decode_row_desc(body) -> list[str]:
+        (n,) = struct.unpack_from("!H", body, 0)
+        off = 2
+        names = []
+        for _ in range(n):
+            end = body.index(b"\x00", off)
+            names.append(body[off:end].decode())
+            off = end + 1 + 18
+        return names
+
+    @staticmethod
+    def _decode_data_row(body) -> tuple:
+        (n,) = struct.unpack_from("!H", body, 0)
+        off = 2
+        row = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("!i", body, off)
+            off += 4
+            if ln < 0:
+                row.append(None)
+            else:
+                row.append(body[off:off + ln].decode())
+                off += ln
+        return tuple(row)
+
     # -- queries -------------------------------------------------------------
     def query(self, sql: str):
         """Run one simple-protocol Query; returns (names, rows, tags)."""
@@ -101,26 +127,9 @@ class PgClient:
         while True:
             typ, body = self._msg()
             if typ == b"T":
-                (n,) = struct.unpack_from("!H", body, 0)
-                off = 2
-                names = []
-                for _ in range(n):
-                    end = body.index(b"\x00", off)
-                    names.append(body[off:end].decode())
-                    off = end + 1 + 18
+                names = self._decode_row_desc(body)
             elif typ == b"D":
-                (n,) = struct.unpack_from("!H", body, 0)
-                off = 2
-                row = []
-                for _ in range(n):
-                    (ln,) = struct.unpack_from("!i", body, off)
-                    off += 4
-                    if ln < 0:
-                        row.append(None)
-                    else:
-                        row.append(body[off:off + ln].decode())
-                        off += ln
-                rows.append(tuple(row))
+                rows.append(self._decode_data_row(body))
             elif typ == b"C":
                 tags.append(body.rstrip(b"\x00").decode())
             elif typ == b"I":
@@ -197,26 +206,9 @@ class PgClient:
                 oids_desc = [struct.unpack_from("!I", body, 2 + 4 * i)[0]
                              for i in range(n)]
             elif typ == b"T":
-                (n,) = struct.unpack_from("!H", body, 0)
-                off = 2
-                names = []
-                for _ in range(n):
-                    end = body.index(b"\x00", off)
-                    names.append(body[off:end].decode())
-                    off = end + 1 + 18
+                names = self._decode_row_desc(body)
             elif typ == b"D":
-                (n,) = struct.unpack_from("!H", body, 0)
-                off = 2
-                row = []
-                for _ in range(n):
-                    (ln,) = struct.unpack_from("!i", body, off)
-                    off += 4
-                    if ln < 0:
-                        row.append(None)
-                    else:
-                        row.append(body[off:off + ln].decode())
-                        off += ln
-                rows.append(tuple(row))
+                rows.append(self._decode_data_row(body))
             elif typ == b"s":
                 completed = False
             elif typ == b"E":

@@ -61,6 +61,9 @@ class Cluster:
         # unavailable range fails fast instead of hanging each request
         # through the full proposal retry loop
         self.breakers: dict[int, Breaker] = {}
+        # per-range request counters (QPS stand-in) feeding the
+        # load-weighted lease rebalancer (store_rebalancer.go)
+        self.range_load: dict[int, int] = {}
         for node_id in range(1, n_nodes + 1):
             self.stores[node_id] = Store(node_id, self.transport,
                                          clock=self.clock,
@@ -406,6 +409,120 @@ class Cluster:
                 actions.append(f"r{d.range_id}: add n{add}")
         return actions
 
+    def add_node(self) -> int:
+        """Join a fresh empty store to the cluster (node addition; the
+        rebalancer then moves replicas/leases onto it)."""
+        node_id = max(self.stores) + 1
+        self.stores[node_id] = Store(node_id, self.transport,
+                                     clock=self.clock,
+                                     liveness=self.liveness)
+        self.liveness.heartbeat(node_id)
+        return node_id
+
+    def transfer_lease(self, range_id: int, to: int,
+                       max_iter: int = 500) -> bool:
+        """Cooperative lease transfer: the current holder proposes a
+        lease record naming `to` (TransferLease,
+        replica_range_lease.go). `to` must be a live replica member."""
+        desc = self.descriptors.get(range_id)
+        if desc is None or to not in desc.replicas or to in self.down \
+                or not self.liveness.is_live(to):
+            return False
+        cur = self.leaseholder(range_id)
+        if cur is None or cur == to:
+            return cur == to
+        lh_rep = self.stores[cur].replicas.get(range_id)
+        if lh_rep is None:
+            return False
+        self.propose_and_wait(lh_rep, {
+            "kind": "lease", "holder": to,
+            "epoch": self.liveness.epoch_of(to)}, max_iter)
+        return self.pump_until(
+            lambda: self.leaseholder(range_id) == to, max_iter)
+
+    def rebalance_scan(self, target: int = 3) -> list[str]:
+        """Load/space-aware rebalancing (the allocator's rebalance
+        actions + the store rebalancer: allocatorimpl/allocator.go:848,
+        store_rebalancer.go). Two passes, one move per range per scan:
+
+        1. replica counts: while the fullest live store holds 2+ more
+           replicas than the emptiest, move one replica of a range it
+           holds (and the emptiest lacks) over — add-then-remove, the
+           same one-at-a-time discipline as the repair path.
+        2. lease counts, weighted by per-range request load when the
+           cluster has observed any (`range_load`): transfer leases
+           from the busiest holder to the least-busy replica member.
+        """
+        actions: list[str] = []
+        live = [n for n in self.stores if n not in self.down
+                and self.liveness.is_live(n)]
+        if len(live) < 2:
+            return actions
+        # -- pass 1: replica placement by count --------------------------
+        counts = {n: 0 for n in live}
+        for d in self.descriptors.values():
+            for n in d.replicas:
+                if n in counts:
+                    counts[n] += 1
+        moved = True
+        while moved:
+            moved = False
+            full = max(live, key=lambda n: counts[n])
+            empty = min(live, key=lambda n: counts[n])
+            if counts[full] - counts[empty] < 2:
+                break
+            for d in self.descriptors.values():
+                if full in d.replicas and empty not in d.replicas:
+                    self.change_replicas(d.range_id, add=empty)
+                    self.change_replicas(d.range_id, remove=full)
+                    counts[full] -= 1
+                    counts[empty] += 1
+                    actions.append(f"r{d.range_id}: move replica "
+                                   f"n{full} -> n{empty}")
+                    moved = True
+                    break
+        # -- pass 2: lease placement by (load-weighted) count ------------
+        # exponential decay per scan: yesterday's hot range must not
+        # dominate today's placement (the reference uses decaying
+        # per-replica QPS, store_rebalancer.go)
+        for rid in list(self.range_load):
+            self.range_load[rid] //= 2
+            if self.range_load[rid] == 0:
+                del self.range_load[rid]
+        loads = self.range_load
+        def weight(rid):
+            return max(loads.get(rid, 0), 1)
+        holder_load = {n: 0 for n in live}
+        holders = {}
+        for d in self.descriptors.values():
+            lh = self.leaseholder(d.range_id)
+            holders[d.range_id] = lh
+            if lh in holder_load:
+                holder_load[lh] += weight(d.range_id)
+        moved = True
+        while moved:
+            moved = False
+            busy = max(live, key=lambda n: holder_load[n])
+            idle = min(live, key=lambda n: holder_load[n])
+            gap = holder_load[busy] - holder_load[idle]
+            for rid, lh in holders.items():
+                if lh != busy:
+                    continue
+                w = weight(rid)
+                if w * 2 > gap:   # moving it would overshoot
+                    continue
+                d = self.descriptors[rid]
+                if idle not in d.replicas or not \
+                        self.transfer_lease(rid, idle):
+                    continue
+                holder_load[busy] -= w
+                holder_load[idle] += w
+                holders[rid] = idle
+                actions.append(f"r{rid}: lease n{busy} -> n{idle}")
+                moved = True
+                break
+        return actions
+
     # ------------------------------------------------------------------
     # leases
     # ------------------------------------------------------------------
@@ -560,6 +677,10 @@ class Cluster:
             raise KeyError(f"no range for key {key!r}")
         b = self.breaker(desc.range_id)
         b.check()
+        # counted only for requests the breaker admitted: rejected
+        # traffic must not inflate a dead range's load signal
+        self.range_load[desc.range_id] = \
+            self.range_load.get(desc.range_id, 0) + 1
         lh = self.ensure_lease(desc.range_id)
         if lh is None:
             b.report_failure()

@@ -168,12 +168,18 @@ class Node:
             self.rpc.connect(nid, tuple(addr))
             peers.append(nid)
         self.gossip = Gossip(cfg.node_id, self.rpc, peers=peers)
+        # fabric liveness: heartbeats + per-peer breakers + clock-skew
+        # checks ride the same loop (pkg/rpc/heartbeat.go analogue)
+        from ..rpc.heartbeat import PeerMonitor
+        self.peer_monitor = PeerMonitor(cfg.node_id, self.rpc)
         # extensible fabric dispatch: gossip consumes its own payloads
         # (handle() returns False otherwise); other subsystems add
         # themselves under a message "kind" without clobbering gossip
         self.rpc_handlers: dict[str, object] = {}
 
         def dispatch(frm, msg):
+            if self.peer_monitor.handle(frm, msg):
+                return
             if self.gossip.handle(frm, msg):
                 return
             kind = msg.get("kind") if isinstance(msg, dict) else None
@@ -188,11 +194,14 @@ class Node:
         self._gossip_stop = threading.Event()
         rpc, gossip, stop = self.rpc, self.gossip, self._gossip_stop
 
+        monitor = self.peer_monitor
+
         def loop():
             # locals, not self.*: stop() nulls the attributes while
             # this thread may still be mid-tick
             while not stop.is_set():
                 gossip.tick()
+                monitor.tick()
                 rpc.deliver_all()
                 stop.wait(cfg.gossip_interval)
 

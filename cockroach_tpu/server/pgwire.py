@@ -293,15 +293,16 @@ def _decode_param(raw: bytes | None, fmt: int, oid: int) -> str:
     bool, text) by declared oid."""
     if raw is None:
         return "NULL"
-    if fmt == 1:   # binary
+    if fmt == 1:   # binary — parenthesized like the text path, or a
+        # negative value forms a '--' comment in the spliced SQL
         if oid == OID_INT8:
-            return str(struct.unpack("!q", raw)[0])
+            return "(%d)" % struct.unpack("!q", raw)[0]
         if oid == 21 and len(raw) == 2:    # int2
-            return str(struct.unpack("!h", raw)[0])
+            return "(%d)" % struct.unpack("!h", raw)[0]
         if oid == 23 and len(raw) == 4:    # int4
-            return str(struct.unpack("!i", raw)[0])
+            return "(%d)" % struct.unpack("!i", raw)[0]
         if oid == OID_FLOAT8 and len(raw) == 8:
-            return repr(struct.unpack("!d", raw)[0])
+            return "(%s)" % repr(struct.unpack("!d", raw)[0])
         if oid == OID_BOOL and len(raw) == 1:
             return "TRUE" if raw[0] else "FALSE"
         s = raw.decode("utf-8")            # text-like payloads
@@ -314,8 +315,13 @@ def _decode_param(raw: bytes | None, fmt: int, oid: int) -> str:
     if oid in (OID_FLOAT8, 700, 1700):
         return "(%s)" % repr(float(s))
     if oid == OID_BOOL:
-        return "TRUE" if s.lower() in ("t", "true", "1", "on") \
-            else "FALSE"
+        low = s.lower()
+        if low in ("t", "true", "1", "on", "yes"):
+            return "TRUE"
+        if low in ("f", "false", "0", "off", "no"):
+            return "FALSE"
+        raise EngineError(
+            f"invalid input syntax for type boolean: {s!r}")
     return "'" + s.replace("'", "''") + "'"
 
 

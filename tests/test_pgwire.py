@@ -333,3 +333,23 @@ class TestExtendedParams:
                                  param_oids=(20,))
         finally:
             c.close()
+
+    def test_negative_binary_param_not_a_comment(self, node):
+        c = PgClient(*node.sql_addr)
+        try:
+            _o, _n, rows, _d = c.extended_query(
+                "SELECT 3-$1", params=(-1,), param_oids=(20,),
+                binary=True)
+            assert rows[0][0] == "4"
+        finally:
+            c.close()
+
+    def test_invalid_bool_text_param_rejected(self, node):
+        c = PgClient(*node.sql_addr)
+        try:
+            import pytest as _pytest
+            with _pytest.raises(PgError):
+                c.extended_query("SELECT $1", params=("garbage",),
+                                 param_oids=(16,))
+        finally:
+            c.close()

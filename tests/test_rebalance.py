@@ -1,0 +1,114 @@
+"""Load/space-aware allocator + lease rebalancing (round-3 VERDICT #9;
+the allocator rebalance actions + store rebalancer:
+allocatorimpl/allocator.go:848, store_rebalancer.go)."""
+
+from cockroach_tpu.kvserver.cluster import Cluster
+
+
+def make_skewed(n_nodes=5, n_ranges=8):
+    """All ranges piled on nodes 1-3 of a 5-node cluster."""
+    c = Cluster(n_nodes=n_nodes)
+    bounds = [bytes([ord('a') + i]) for i in range(n_ranges + 1)]
+    for i in range(n_ranges):
+        c.create_range(bounds[i], bounds[i + 1], replicas=[1, 2, 3])
+    for i in range(n_ranges):
+        c.pump_until(lambda i=i: c.ensure_lease(i + 1) is not None)
+    return c
+
+
+def replica_counts(c):
+    out = {n: 0 for n in c.stores if n not in c.down}
+    for d in c.descriptors.values():
+        for n in d.replicas:
+            if n in out:
+                out[n] += 1
+    return out
+
+
+def lease_counts(c):
+    out = {n: 0 for n in c.stores if n not in c.down}
+    for d in c.descriptors.values():
+        lh = c.leaseholder(d.range_id)
+        if lh in out:
+            out[lh] += 1
+    return out
+
+
+class TestReplicaRebalance:
+    def test_skewed_cluster_converges(self):
+        c = make_skewed()
+        before = replica_counts(c)
+        assert before[4] == 0 and before[5] == 0
+        for _ in range(6):
+            if not c.rebalance_scan():
+                break
+            c.pump(10)
+        after = replica_counts(c)
+        assert max(after.values()) - min(after.values()) <= 1, after
+        # every range still fully replicated and serving
+        for d in c.descriptors.values():
+            assert len(d.replicas) == 3
+        c.put(b"a1", b"v")
+        assert c.get(b"a1") == b"v"
+
+    def test_node_add_triggers_rebalance(self):
+        c = Cluster(n_nodes=3)
+        for i in range(6):
+            lo = bytes([ord('a') + i])
+            hi = bytes([ord('a') + i + 1])
+            c.create_range(lo, hi, replicas=[1, 2, 3])
+        for i in range(6):
+            c.pump_until(lambda i=i: c.ensure_lease(i + 1) is not None)
+        for _ in range(6):              # settle initial lease placement
+            if not c.rebalance_scan():
+                break
+            c.pump(10)
+        assert not c.rebalance_scan()   # 3 nodes, 3x: quiescent
+        c.add_node()
+        for _ in range(8):
+            if not c.rebalance_scan():
+                break
+            c.pump(10)
+        after = replica_counts(c)
+        assert after[4] > 0, after   # the new node picked up replicas
+        assert max(after.values()) - min(after.values()) <= 2, after
+
+    def test_lease_rebalance_spreads_holders(self):
+        c = make_skewed(n_ranges=6)
+        # all leases start on whichever nodes acquired them; force a
+        # pile-up on node 1
+        for rid in list(c.descriptors):
+            c.transfer_lease(rid, 1)
+        assert lease_counts(c)[1] == 6
+        for _ in range(8):
+            acts = c.rebalance_scan()
+            c.pump(10)
+            if not acts:
+                break
+        lc = lease_counts(c)
+        assert max(lc[n] for n in (1, 2, 3)) <= 3, lc
+
+    def test_load_weighted_lease_rebalance(self):
+        c = make_skewed(n_ranges=4)
+        for rid in list(c.descriptors):
+            c.transfer_lease(rid, 1)
+        # range 1 is hot; the rest are idle
+        c.range_load = {1: 1000, 2: 1, 3: 1, 4: 1}
+        for _ in range(8):
+            if not c.rebalance_scan():
+                break
+            c.pump(10)
+        # the hot range's lease still counts as one holder slot but the
+        # idle leases moved away from node 1
+        lc = lease_counts(c)
+        hot_holder = c.leaseholder(1)
+        assert lc[hot_holder] <= 2, (lc, hot_holder)
+
+    def test_transfer_lease_api(self):
+        c = make_skewed(n_ranges=1)
+        lh = c.leaseholder(1)
+        target = next(n for n in (1, 2, 3) if n != lh)
+        assert c.transfer_lease(1, target)
+        assert c.leaseholder(1) == target
+        # non-member target refused
+        assert not c.transfer_lease(1, 5)
