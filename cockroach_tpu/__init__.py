@@ -46,7 +46,7 @@ Layer map (mirrors SURVEY.md §1):
     cli.py      cockroach-tpu start / sql / demo
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 # The engine's physical types require 64-bit lanes (HLC timestamps and
 # scaled-decimal int64 accumulation); JAX disables x64 by default.
