@@ -109,7 +109,11 @@ class DistSQLNode:
 
     def _run_local(self, spec: FlowSpec):
         eng = self.engine
-        node, meta = Planner(eng.catalog_view(),
+        node, meta = Planner(
+            # int_ranges off: key_int_range reflects only this node's
+            # LOCAL shard — per-node plans must stay deterministic and
+            # range-independent across the fabric
+            eng.catalog_view(int_ranges=False),
                              use_memo=False).plan_select(
             parser.parse(spec.sql))
         # duplicate-keyed join builds must error, not silently drop
@@ -208,7 +212,11 @@ class Gateway:
     def run(self, sql: str, chunk_rows: int = 65536):
         eng = self.own.engine
         transport = self.own.transport
-        node, meta = Planner(eng.catalog_view(),
+        node, meta = Planner(
+            # int_ranges off: key_int_range reflects only this node's
+            # LOCAL shard — per-node plans must stay deterministic and
+            # range-independent across the fabric
+            eng.catalog_view(int_ranges=False),
                              use_memo=False).plan_select(
             parser.parse(sql))
         self._check_join_placement(node)

@@ -178,7 +178,9 @@ def _rows_stage(wrappers, core) -> StagePlan:
                             list(core.aggs), core.having,
                             list(core.items),
                             0 if strings else core.max_groups,
-                            [] if strings else list(core.group_dims))
+                            [] if strings else list(core.group_dims),
+                            group_lo=([] if strings
+                                      else list(core.group_lo)))
         # output -> group name -> source column (two hops)
         group_src = {gn: ge.name for gn, ge in core.group_by
                      if isinstance(ge, BCol) and ge.name in strings}
@@ -263,7 +265,8 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
                     for j, la in enumerate(local_aggs)]
     local = P.Aggregate(core.child, list(core.group_by), local_aggs,
                         None, local_items, core.max_groups,
-                        list(core.group_dims))
+                        list(core.group_dims),
+                        group_lo=list(core.group_lo))
     strings = _string_union_cols(list(core.group_by))
 
     union_cols = gnames + [partial_name(j)
@@ -279,7 +282,9 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
     final = P.Aggregate(final_child, final_group, final_aggs,
                         final_having, final_items,
                         0 if strings else core.max_groups,
-                        [] if strings else list(core.group_dims))
+                        [] if strings else list(core.group_dims),
+                        group_lo=([] if strings
+                                  else list(core.group_lo)))
     dict_outputs = {n: e.name for n, e in final_items
                     if isinstance(e, BCol) and e.name in strings}
     return StagePlan("partial_agg", local, _rewrap(wrappers, final),

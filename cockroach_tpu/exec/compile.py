@@ -447,6 +447,7 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
     havingf = compile_expr(node.having) if node.having is not None else None
     dense = node.max_groups > 0
     dims = list(node.group_dims)
+    los = list(node.group_lo) or [0] * len(dims)
     axis = params.axis_name
     if axis and node.group_by and not dense:
         # hash-strategy group ids are shard-local; merge via
@@ -467,9 +468,9 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
             gid = jnp.zeros((b.n,), dtype=jnp.int32)
             num_groups = 1
             gvals = []
-            for (name, gf), dim in zip(groupfs, dims):
+            for (name, gf), dim, lo in zip(groupfs, dims, los):
                 d, v = gf(ctx)
-                code = jnp.where(v, d.astype(jnp.int32), dim)
+                code = jnp.where(v, (d - lo).astype(jnp.int32), dim)
                 gid = gid * (dim + 1) + code
                 num_groups *= dim + 1
                 gvals.append((name, dim))
@@ -482,9 +483,13 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 strides.append(s)
                 s *= dim + 1
             strides.reverse()
-            for ((name, gf), dim, st) in zip(groupfs, dims, strides):
+            for ((name, gf), dim, st, lo) in zip(groupfs, dims,
+                                                 strides, los):
                 code = (garange // st) % (dim + 1)
-                group_cols[name] = (code, code < dim)
+                # int dims decode in int64: lo can exceed int32
+                val = code if lo == 0 else \
+                    code.astype(jnp.int64) + lo
+                group_cols[name] = (val, code < dim)
         else:
             # hash strategy: key cols -> dense ids via the device table
             keycols = []
@@ -771,6 +776,7 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
     itemfs = [(name, compile_expr(e)) for name, e in agg.items]
     havingf = compile_expr(agg.having) if agg.having is not None else None
     dims = list(agg.group_dims)
+    slos = list(agg.group_lo) or [0] * len(dims)
     num_groups = 1
     for dim in dims:
         num_groups *= dim + 1
@@ -784,9 +790,9 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
             gid = None
         else:
             gid = jnp.zeros((b.n,), dtype=jnp.int32)
-            for (name, gf), dim in zip(groupfs, dims):
+            for (name, gf), dim, lo in zip(groupfs, dims, slos):
                 d, v = gf(ctx)
-                code = jnp.where(v, d.astype(jnp.int32), dim)
+                code = jnp.where(v, (d - lo).astype(jnp.int32), dim)
                 gid = gid * (dim + 1) + code
         state = []
         for a, argf in aggfs:
@@ -814,9 +820,12 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
                 strides.append(s)
                 s *= dim + 1
             strides.reverse()
-            for ((name, _), dim, st) in zip(groupfs, dims, strides):
+            for ((name, _), dim, st, lo) in zip(groupfs, dims,
+                                                strides, slos):
                 code = (garange // st) % (dim + 1)
-                group_cols[name] = (code, code < dim)
+                val = code if lo == 0 else \
+                    code.astype(jnp.int64) + lo
+                group_cols[name] = (val, code < dim)
         i = 0
         aggs_out = []
         overflow = jnp.bool_(False)

@@ -602,7 +602,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                       tag="EXPLAIN ANALYZE")
 
     # -- catalog -------------------------------------------------------------
-    def catalog_view(self) -> CatalogView:
+    def catalog_view(self, int_ranges: bool = True) -> CatalogView:
         from ..sql.stats import TableStats
         # planners see the PUBLIC schema: columns mid-add (WRITE_ONLY
         # descriptor state, schemachange.py) are physically present but
@@ -635,7 +635,9 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 st = TableStats(row_count=td.row_count)
             stats[n] = st
         return CatalogView(schemas, dicts, stats,
-                           key_distinct_fn=self.store.key_distinct)
+                           key_distinct_fn=self.store.key_distinct,
+                           int_range_fn=(self.store.key_int_range
+                                         if int_ranges else None))
 
     def _read_ts(self, session: Session) -> Timestamp:
         return session.txn_read_ts or self.clock.now()
@@ -696,7 +698,10 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         seq_ops = ((lambda fn, name, arg: 0) if for_explain
                    else self._sequence_ops(session))
         planner = Planner(
-            self.catalog_view(),
+            # int-range dense GROUP BY is withheld inside explicit
+            # txns: overlay rows could fall outside the committed range
+            # and corrupt the mixed-radix group code
+            self.catalog_view(int_ranges=(session.txn is None)),
             subquery_eval=lambda sel, lim: self._eval_subquery(
                 _propagate_as_of(sel, stmt), session, lim),
             now_micros=read_ts.wall // 1000,

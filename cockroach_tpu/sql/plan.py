@@ -81,6 +81,9 @@ class Aggregate(PlanNode):
     # strategy: gid = mixed-radix code over these dims, +1 slot per dim
     # for NULL); empty when the hash-table strategy is required
     group_dims: list[int] = field(default_factory=list)
+    # per-dim value offsets: code = value - lo (0 for dict/bool dims;
+    # nonzero for small-range INT keys proven dense by stats)
+    group_lo: list[int] = field(default_factory=list)
 
 
 @dataclass

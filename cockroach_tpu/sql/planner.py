@@ -38,11 +38,18 @@ class CatalogView:
     (cached per generation); None when no store is attached."""
 
     def __init__(self, schemas, dictionaries, stats=None,
-                 key_distinct_fn=None):
+                 key_distinct_fn=None, int_range_fn=None):
         self.schemas = schemas
         self.dictionaries = dictionaries
         self.stats = stats or {}
         self.key_distinct_fn = key_distinct_fn
+        # int_range_fn(table, col) -> (lo, hi, count) | None: exact
+        # all-versions value range of an int column (generation-
+        # cached). Lets GROUP BY over small-range int keys (years,
+        # status codes) take the dense segment-sum strategy instead of
+        # the while-loop hash table. The engine withholds it for
+        # txn-overlay reads (uncommitted rows could exceed the range).
+        self.int_range_fn = int_range_fn
 
     def schema(self, name: str) -> TableSchema:
         s = self.schemas.get(name)
@@ -560,20 +567,23 @@ class Planner:
                 having_b = _replace_group_refs(having_b, group_exprs)
             for name, b in rewritten:
                 _check_agg_valid(b, group_exprs)
-            max_groups, dims = self._static_group_bound(group_exprs, scope)
+            max_groups, dims, glos = self._static_group_bound(
+                group_exprs, scope, tables)
             node = plan.Aggregate(node, group_exprs, binder.aggs,
-                                  having_b, rewritten, max_groups, dims)
+                                  having_b, rewritten, max_groups, dims,
+                                  group_lo=glos)
             out_names = [n for n, _ in rewritten]
             out_types = [b.type for _, b in rewritten]
         elif sel.distinct:
             node = plan.Project(node, bound_items)
             group_exprs = [(n, BCol(n, b.type)) for n, b in bound_items]
-            dmax, ddims = self._static_group_bound(group_exprs, scope)
+            dmax, ddims, dlos = self._static_group_bound(
+                group_exprs, scope, tables)
             node = plan.Aggregate(node, group_exprs, [], None,
                                   [(n, BCol(g, b.type))
                                    for (n, b), (g, _) in
                                    zip(bound_items, group_exprs)],
-                                  dmax, ddims)
+                                  dmax, ddims, group_lo=dlos)
             out_names = [n for n, _ in bound_items]
             out_types = [b.type for _, b in bound_items]
         else:
@@ -621,28 +631,56 @@ class Planner:
         meta.memo = self.last_memo
         return node, meta
 
-    def _static_group_bound(self, group_exprs, scope: Scope):
-        """If every group key is a dict-encoded column or bool, the group
-        count is bounded by the product of dictionary sizes — the planner
-        can then use dense codes + segment_sum with a static size (TPC-H
-        Q1: 4). Returns (bound, dims); bound 0 when unbounded. Each dim
-        gets one extra NULL slot at compile time."""
+    MAX_INT_GROUP_SPAN = 1 << 12
+
+    def _static_group_bound(self, group_exprs, scope: Scope,
+                            tables=None):
+        """If every group key is a dict-encoded column, bool, or an int
+        column with a small PROVEN value range, the group count is
+        bounded by the product of code-space sizes — the planner then
+        uses dense codes + segment_sum with a static size (TPC-H Q1: 4;
+        SSB's GROUP BY d_year) instead of the while-loop hash table.
+        Returns (bound, dims, los); bound 0 when unbounded. Each dim
+        gets one extra NULL slot at compile time; los are per-dim value
+        offsets (code = value - lo)."""
+        alias_to_table = dict(tables or [])
         bound = 1
         dims = []
+        los = []
         for _, e in group_exprs:
             if isinstance(e, BCol) and e.type.family == Family.STRING:
                 d = self._dict_by_batch_name(e.name, scope)
                 if d is None:
-                    return 0, []
+                    return 0, [], []
                 dims.append(max(len(d), 1))
+                los.append(0)
             elif isinstance(e, BCol) and e.type.family == Family.BOOL:
                 dims.append(2)
+                los.append(0)
+            elif isinstance(e, BCol) and e.type.family == Family.INT \
+                    and self.catalog.int_range_fn is not None \
+                    and "." in e.name:
+                alias, col = e.name.split(".", 1)
+                tname = alias_to_table.get(alias)
+                try:
+                    r = (self.catalog.int_range_fn(tname, col)
+                         if tname else None)
+                except KeyError:   # renamed/computed: not a stored col
+                    r = None
+                if r is None:
+                    return 0, [], []
+                lo, hi, _n = r
+                span = hi - lo + 1
+                if span > self.MAX_INT_GROUP_SPAN:
+                    return 0, [], []
+                dims.append(int(span))
+                los.append(int(lo))
             else:
-                return 0, []
+                return 0, [], []
             bound *= dims[-1] + 1
             if bound > 1 << 16:
-                return 0, []
-        return bound, dims
+                return 0, [], []
+        return bound, dims, los
 
     def _dict_by_batch_name(self, name, scope: Scope):
         for t in scope.tables.values():
