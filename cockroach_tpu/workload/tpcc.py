@@ -1,11 +1,11 @@
 """TPC-C workload: the OLTP benchmark the reference gates releases on.
 
 The analogue of pkg/workload/tpcc (tpcc.go): the full 9-table schema
-at configurable (scaled-down) cardinalities and the three highest-
-weight transactions — NEW-ORDER (45%), PAYMENT (43%), ORDER-STATUS
-(4%) — implemented as real multi-statement SQL transactions through
-the engine's txn layer (BEGIN..COMMIT, retry on 40001), per TPC-C
-v5.11 clause 2. Delivery/stock-level are round-3 additions.
+at configurable (scaled-down) cardinalities and all five spec
+transactions — NEW-ORDER (45%), PAYMENT (43%), ORDER-STATUS,
+DELIVERY and STOCK-LEVEL (4% each) — implemented as real
+multi-statement SQL transactions through the engine's txn layer
+(BEGIN..COMMIT, retry on 40001), per TPC-C v5.11 clause 2.
 
 Scaled defaults (items/customers per district) keep CI-sized runs
 fast; the ratios and the per-txn read/write shapes match the spec, so
@@ -38,7 +38,8 @@ DDL = {
         PRIMARY KEY (s_w_id, s_i_id))""",
     "orders": """CREATE TABLE orders (
         o_id INT, o_d_id INT, o_w_id INT, o_c_id INT,
-        o_entry_d TIMESTAMP, o_ol_cnt INT, o_all_local INT,
+        o_entry_d TIMESTAMP, o_carrier_id INT, o_ol_cnt INT,
+        o_all_local INT,
         PRIMARY KEY (o_w_id, o_d_id, o_id))""",
     "new_order": """CREATE TABLE new_order (
         no_o_id INT, no_d_id INT, no_w_id INT,
@@ -71,6 +72,8 @@ class TPCC:
         self.new_orders = 0
         self.payments = 0
         self.order_statuses = 0
+        self.deliveries = 0
+        self.stock_levels = 0
         self.retries = 0
 
     # -- load ---------------------------------------------------------------
@@ -146,7 +149,7 @@ class TPCC:
                       f"WHERE d_w_id = {w} AND d_id = {d}", session=s)
             e.execute(
                 f"INSERT INTO orders VALUES ({o_id}, {d}, {w}, {c}, "
-                f"timestamp '2026-01-01 00:00:00', {ol_cnt}, 1)",
+                f"timestamp '2026-01-01 00:00:00', NULL, {ol_cnt}, 1)",
                 session=s)
             e.execute(f"INSERT INTO new_order VALUES ({o_id}, {d}, {w})",
                       session=s)
@@ -222,8 +225,81 @@ class TPCC:
             f"WHERE ol_w_id = {w} AND ol_d_id = {d} "
             f"AND ol_o_id = {o_id} ORDER BY ol_number").rows
 
+    def delivery(self, carrier: int | None = None,
+                 w: int | None = None) -> int:
+        """TPC-C 2.7: batch-deliver the oldest undelivered order of
+        every district of one warehouse in a single transaction —
+        the spec's deferred-execution txn. Returns orders delivered."""
+        rng = self.rng
+        w = w or int(rng.integers(1, self.W + 1))
+        carrier = carrier or int(rng.integers(1, 11))
+
+        def fn(s):
+            e = self.engine
+            delivered = 0
+            for d in range(1, self.D + 1):
+                rows = e.execute(
+                    f"SELECT min(no_o_id) FROM new_order "
+                    f"WHERE no_w_id = {w} AND no_d_id = {d}",
+                    session=s).rows
+                o_id = rows[0][0] if rows else None
+                if o_id is None:
+                    continue  # spec 2.7.4.2: skip empty districts
+                e.execute(
+                    f"DELETE FROM new_order WHERE no_w_id = {w} "
+                    f"AND no_d_id = {d} AND no_o_id = {o_id}",
+                    session=s)
+                c = e.execute(
+                    f"SELECT o_c_id FROM orders WHERE o_w_id = {w} "
+                    f"AND o_d_id = {d} AND o_id = {o_id}",
+                    session=s).rows[0][0]
+                e.execute(
+                    f"UPDATE orders SET o_carrier_id = {carrier} "
+                    f"WHERE o_w_id = {w} AND o_d_id = {d} "
+                    f"AND o_id = {o_id}", session=s)
+                amount = e.execute(
+                    f"SELECT sum(ol_amount) FROM order_line "
+                    f"WHERE ol_w_id = {w} AND ol_d_id = {d} "
+                    f"AND ol_o_id = {o_id}", session=s).rows[0][0]
+                e.execute(
+                    f"UPDATE customer SET c_balance = c_balance + "
+                    f"{float(amount):.2f} WHERE c_w_id = {w} "
+                    f"AND c_d_id = {d} AND c_id = {c}", session=s)
+                delivered += 1
+            return delivered
+
+        out = self._txn(fn)
+        self.deliveries += 1
+        return out
+
+    def stock_level(self, threshold: int | None = None,
+                    d: int | None = None,
+                    w: int | None = None) -> int:
+        """TPC-C 2.8: read-only — distinct items among the district's
+        last 20 orders whose stock sits below a threshold."""
+        rng = self.rng
+        w = w or int(rng.integers(1, self.W + 1))
+        d = d or int(rng.integers(1, self.D + 1))
+        if threshold is None:
+            threshold = int(rng.integers(10, 21))
+        e = self.engine
+        next_o = e.execute(
+            f"SELECT d_next_o_id FROM district WHERE d_w_id = {w} "
+            f"AND d_id = {d}").rows[0][0]
+        n = e.execute(
+            f"SELECT count(DISTINCT s_i_id) FROM order_line "
+            f"JOIN stock ON s_w_id = ol_w_id AND s_i_id = ol_i_id "
+            f"WHERE ol_w_id = {w} AND ol_d_id = {d} "
+            f"AND ol_o_id >= {next_o - 20} AND ol_o_id < {next_o} "
+            f"AND s_quantity < {threshold}").rows[0][0]
+        self.stock_levels += 1
+        return int(n or 0)
+
     # -- the mix ------------------------------------------------------------
     def step(self) -> str:
+        """Full five-transaction mix at the spec's minimum weights:
+        NEW-ORDER 45%, PAYMENT 43%, ORDER-STATUS/DELIVERY/STOCK-LEVEL
+        4% each (tpcc.go uses the same deck weights)."""
         r = self.rng.random()
         if r < 0.45:
             self.new_order()
@@ -231,18 +307,36 @@ class TPCC:
         if r < 0.88:
             self.payment()
             return "payment"
-        self.order_status()
-        return "order_status"
+        if r < 0.92:
+            self.order_status()
+            return "order_status"
+        if r < 0.96:
+            self.delivery()
+            return "delivery"
+        self.stock_level()
+        return "stock_level"
 
     def run(self, steps: int = 50) -> dict:
+        """Drive the mix; counters in the result are deltas for THIS
+        run (the instance counters stay cumulative), so a warmup pass
+        before a measured pass doesn't inflate tpm_c."""
         import time
+        before = (self.new_orders, self.payments, self.order_statuses,
+                  self.deliveries, self.stock_levels, self.retries)
         t0 = time.monotonic()
         for _ in range(steps):
             self.step()
         dt = time.monotonic() - t0
+        no, pay, osts, dlv, stk, rty = (
+            a - b for a, b in zip(
+                (self.new_orders, self.payments, self.order_statuses,
+                 self.deliveries, self.stock_levels, self.retries),
+                before))
         return {"steps": steps, "elapsed_s": dt,
-                "tpm_c": self.new_orders / dt * 60 if dt else 0.0,
-                "new_orders": self.new_orders,
-                "payments": self.payments,
-                "order_statuses": self.order_statuses,
-                "retries": self.retries}
+                "tpm_c": no / dt * 60 if dt else 0.0,
+                "new_orders": no,
+                "payments": pay,
+                "order_statuses": osts,
+                "deliveries": dlv,
+                "stock_levels": stk,
+                "retries": rty}

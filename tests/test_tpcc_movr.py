@@ -82,10 +82,78 @@ class TestTPCC:
                 break
         assert got is not None
 
+    def test_delivery_drains_oldest_per_district(self, tpcc):
+        e = tpcc.engine
+        for _ in range(8):
+            tpcc.new_order(w=1)
+        queued = e.execute(
+            "SELECT no_d_id, min(no_o_id) FROM new_order "
+            "GROUP BY no_d_id ORDER BY no_d_id").rows
+        assert queued, "setup should have queued orders"
+        oldest = dict(queued)
+        before = e.execute("SELECT count(*) FROM new_order").rows[0][0]
+        n = tpcc.delivery(carrier=7, w=1)
+        assert n == len(oldest)
+        after = e.execute("SELECT count(*) FROM new_order").rows[0][0]
+        assert after == before - n
+        for d, o_id in oldest.items():
+            # delivered order got the carrier; its queue row is gone
+            assert e.execute(
+                f"SELECT o_carrier_id FROM orders WHERE o_w_id = 1 "
+                f"AND o_d_id = {d} AND o_id = {o_id}").rows == [(7,)]
+            assert e.execute(
+                f"SELECT count(*) FROM new_order WHERE no_w_id = 1 "
+                f"AND no_d_id = {d} AND no_o_id = {o_id}")\
+                .rows[0][0] == 0
+
+    def test_delivery_credits_customer_balance(self, tpcc):
+        e = tpcc.engine
+        tpcc.new_order(w=1)
+        o_d, o_id, o_c = e.execute(
+            "SELECT o_d_id, o_id, o_c_id FROM orders "
+            "ORDER BY o_d_id, o_id LIMIT 1").rows[0]
+        bal0 = e.execute(
+            f"SELECT c_balance FROM customer WHERE c_w_id = 1 "
+            f"AND c_d_id = {o_d} AND c_id = {o_c}").rows[0][0]
+        total = e.execute(
+            f"SELECT sum(ol_amount) FROM order_line "
+            f"WHERE ol_w_id = 1 AND ol_d_id = {o_d} "
+            f"AND ol_o_id = {o_id}").rows[0][0]
+        tpcc.delivery(w=1)
+        bal1 = e.execute(
+            f"SELECT c_balance FROM customer WHERE c_w_id = 1 "
+            f"AND c_d_id = {o_d} AND c_id = {o_c}").rows[0][0]
+        assert float(bal1) == pytest.approx(float(bal0) + float(total))
+
+    def test_delivery_empty_queue_is_noop(self, tpcc):
+        assert tpcc.delivery() == 0
+
+    def test_stock_level_counts_low_stock(self, tpcc):
+        e = tpcc.engine
+        for _ in range(4):
+            tpcc.new_order(w=1)
+        # threshold above every s_quantity → every distinct ordered
+        # item in the window counts; below the floor → zero
+        d = e.execute(
+            "SELECT o_d_id FROM orders LIMIT 1").rows[0][0]
+        next_o = e.execute(
+            f"SELECT d_next_o_id FROM district WHERE d_w_id = 1 "
+            f"AND d_id = {d}").rows[0][0]
+        want = e.execute(
+            f"SELECT count(DISTINCT ol_i_id) FROM order_line "
+            f"WHERE ol_w_id = 1 AND ol_d_id = {d} "
+            f"AND ol_o_id >= {next_o - 20} AND ol_o_id < {next_o}")\
+            .rows[0][0]
+        assert want > 0
+        # threshold above every s_quantity (stock init caps at 100)
+        assert tpcc.stock_level(threshold=1000, d=d, w=1) == want
+        assert tpcc.stock_level(threshold=0, d=d, w=1) == 0
+
     def test_mix_run(self, tpcc):
         out = tpcc.run(steps=12)
         assert out["new_orders"] + out["payments"] + \
-            out["order_statuses"] >= 12
+            out["order_statuses"] + out["deliveries"] + \
+            out["stock_levels"] >= 12
         assert out["tpm_c"] >= 0
 
     def test_district_sequences_isolated(self, tpcc):
