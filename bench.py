@@ -74,8 +74,11 @@ def bench_query(eng, sql, rows, pipeline, repeats, lat_probes=3):
 # per-query (pipeline, repeats, latency_probes) overrides: the
 # compile-heavy suite shapes run seconds per execution — a 16-deep
 # pipeline (or even the default 3 single-shot latency probes, for
-# q9's ~140s/exec) would blow the child timeout measuring nothing new
-QUERY_OVERRIDES = {"q3": (2, 3, 1), "q9": (1, 2, 1), "q18": (2, 3, 1)}
+# q9's ~140s/exec) would blow the child timeout measuring nothing new.
+# q3's dense-group + memo-ordered joins + fused top-k (round 3) cut
+# its warmup 360s -> 33s and exec 11s -> 0.7s, so it takes a deeper
+# pipeline now
+QUERY_OVERRIDES = {"q3": (8, 3, 2), "q9": (1, 2, 1), "q18": (2, 3, 1)}
 
 
 def run(rows_by_query, pipeline, repeats, tag=""):
@@ -91,7 +94,8 @@ def run(rows_by_query, pipeline, repeats, tag=""):
     for rows, queries in by_rows.items():
         eng = Engine()
         t0 = time.time()
-        suite = {"q3", "q5", "q9", "q12", "q18", "q19", "q21"}
+        suite = {"q3", "q5", "q9", "q12", "q17", "q18", "q19", "q21",
+                 "q22"}
         if suite & set(queries):
             tables = tpch.ALL_TABLES
         elif "q14" in queries:

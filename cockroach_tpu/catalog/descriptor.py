@@ -88,7 +88,7 @@ class TableDescriptor:
         return TableSchema(
             name=self.name,
             columns=[ColumnSchema(c.name, c.type, c.nullable,
-                                  cid=c.col_id)
+                                  cid=c.col_id, default=c.default)
                      for c in self.columns if c.state == PUBLIC],
             primary_key=list(self.primary_key),
             table_id=self.id)
@@ -157,7 +157,8 @@ class TableDescriptor:
         d = cls(
             id=schema.table_id, name=schema.name,
             columns=[ColumnDescriptor(c.name, c.type, c.nullable,
-                                      col_id=getattr(c, "cid", 0))
+                                      col_id=getattr(c, "cid", 0),
+                                      default=getattr(c, "default", None))
                      for c in schema.columns],
             primary_key=list(schema.primary_key))
         d.next_col_id = 1 + max(

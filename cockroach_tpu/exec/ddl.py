@@ -24,6 +24,33 @@ class DDLMixin:
     """Engine methods for this concern; mixed into exec.engine.Engine
     (all state lives on the Engine instance)."""
 
+    def _eval_column_default(self, d: ast.ColumnDef):
+        """DEFAULT expr -> physical constant, or {"__seq__": name} for
+        nextval('name') (evaluated per row at INSERT; pg stores the
+        expression, we support the constant + sequence shapes)."""
+        if d.default is None:
+            return None
+        e = d.default
+        if isinstance(e, ast.FuncCall) and e.name == "nextval" \
+                and len(e.args) == 1 \
+                and isinstance(e.args[0], ast.Literal):
+            return {"__seq__": str(e.args[0].value)}
+        from ..sql.binder import Scope
+        from ..sql.bound import BConst
+        binder = Binder(Scope())
+        try:
+            b = binder.bind(e)
+        except Exception as ex:
+            raise EngineError(f"unsupported DEFAULT for column "
+                              f"{d.name!r}: {ex}") from ex
+        if not isinstance(b, BConst):
+            raise EngineError(
+                f"DEFAULT for column {d.name!r} must be a constant "
+                f"or nextval(...)")
+        if b.value is None:
+            return None
+        return binder._const_to(b, d.type).value
+
     # -- DDL -----------------------------------------------------------------
     def _exec_create(self, c: ast.CreateTable) -> Result:
         from ..catalog import (CatalogError, IndexDescriptor,
@@ -34,7 +61,8 @@ class DDLMixin:
             raise EngineError(f"table {c.name!r} already exists")
         schema = TableSchema(
             name=c.name,
-            columns=[ColumnSchema(d.name, d.type, d.nullable)
+            columns=[ColumnSchema(d.name, d.type, d.nullable,
+                                  default=self._eval_column_default(d))
                      for d in c.columns],
             primary_key=list(c.primary_key))
         colnames = {d.name for d in c.columns}
@@ -122,6 +150,9 @@ class DDLMixin:
             self.catalog.drop_table(c.name)
             self._fk_children = None
             raise
+        from ..utils import log
+        log.structured(log.SQL_SCHEMA, "create_table", table=c.name,
+                       columns=len(c.columns))
         return Result(tag="CREATE TABLE")
 
     def _check_no_open_txn_effects(self, table: str, verb: str) -> None:

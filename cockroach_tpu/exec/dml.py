@@ -348,6 +348,28 @@ class DMLMixin:
             rowid=np.asarray([int(r.get(ROWID, 0)) for r in rows],
                              dtype=np.int64))
 
+    def _apply_column_defaults(self, schema, provided_cols, rows,
+                               session) -> None:
+        """Fill DEFAULT values for columns absent from the INSERT
+        column list; {"__seq__": name} defaults draw nextval per row
+        (pg evaluates defaults row-at-a-time)."""
+        defaulted = [c for c in schema.columns
+                     if c.name not in provided_cols
+                     and getattr(c, "default", None) is not None]
+        if not defaulted:
+            return
+        seq_ops = self._sequence_ops(session)
+        for row in rows:
+            for c in defaulted:
+                if row.get(c.name) is not None:
+                    continue
+                d = c.default
+                if isinstance(d, dict) and "__seq__" in d:
+                    row[c.name] = int(seq_ops("nextval", d["__seq__"],
+                                              None))
+                else:
+                    row[c.name] = d
+
     def _exec_insert(self, ins: ast.Insert, session: Session) -> Result:
         td = self.store.table(ins.table)
         schema = td.schema
@@ -390,6 +412,7 @@ class DMLMixin:
                     else:
                         row[cname] = binder._const_to(b, col.type).value
                 rows.append(row)
+        self._apply_column_defaults(schema, set(cols), rows, session)
         for row in rows:
             for col in schema.columns:
                 if not col.nullable and row.get(col.name) is None:

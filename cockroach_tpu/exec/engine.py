@@ -602,7 +602,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                       tag="EXPLAIN ANALYZE")
 
     # -- catalog -------------------------------------------------------------
-    def catalog_view(self, int_ranges: bool = True) -> CatalogView:
+    def catalog_view(self, int_ranges: bool = True,
+                     read_ts: Timestamp | None = None) -> CatalogView:
         from ..sql.stats import TableStats
         # planners see the PUBLIC schema: columns mid-add (WRITE_ONLY
         # descriptor state, schemachange.py) are physically present but
@@ -634,10 +635,17 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             else:
                 st = TableStats(row_count=td.row_count)
             stats[n] = st
+        unique_fn = None
+        if read_ts is not None:
+            rti = read_ts.to_int()
+
+            def unique_fn(t, cols, _rti=rti):
+                return self.store.keys_unique_for_read(t, cols, _rti)
         return CatalogView(schemas, dicts, stats,
                            key_distinct_fn=self.store.key_distinct,
                            int_range_fn=(self.store.key_int_range
-                                         if int_ranges else None))
+                                         if int_ranges else None),
+                           keys_unique_fn=unique_fn)
 
     def _read_ts(self, session: Session) -> Timestamp:
         return session.txn_read_ts or self.clock.now()
@@ -701,7 +709,9 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             # int-range dense GROUP BY is withheld inside explicit
             # txns: overlay rows could fall outside the committed range
             # and corrupt the mixed-radix group code
-            self.catalog_view(int_ranges=(session.txn is None)),
+            self.catalog_view(int_ranges=(session.txn is None),
+                              read_ts=(read_ts if session.txn is None
+                                       else None)),
             subquery_eval=lambda sel, lim: self._eval_subquery(
                 _propagate_as_of(sel, stmt), session, lim),
             now_micros=read_ts.wall // 1000,
@@ -778,9 +788,11 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         return res.rows, res.types
 
     def _decorrelate(self, sel: ast.Select) -> ast.Select:
-        """Unnest correlated (NOT) EXISTS into grouped LEFT JOINs
-        (sql/decorrelate.py; the opt/norm/decorrelate.go analogue)."""
-        from ..sql.decorrelate import decorrelate_exists
+        """Unnest correlated (NOT) EXISTS and correlated scalar
+        subqueries into grouped LEFT JOINs (sql/decorrelate.py; the
+        opt/norm/decorrelate.go analogue)."""
+        from ..sql.decorrelate import (decorrelate_exists,
+                                       decorrelate_scalar)
 
         from ..sql.types import Family
 
@@ -795,7 +807,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 return sch.column(col).type.family == Family.STRING
             except KeyError:
                 return True   # unknown: refuse the min/max trick
-        return decorrelate_exists(sel, columns_of, is_string_col)
+        sel = decorrelate_exists(sel, columns_of, is_string_col)
+        return decorrelate_scalar(sel, columns_of)
 
     @staticmethod
     def _has_derived(sel: ast.Select) -> bool:
@@ -916,7 +929,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
 
     def _prepare_select(self, sel: ast.Select, session: Session,
                         sql_text: str,
-                        no_memo: bool = False) -> "Prepared":
+                        no_memo: bool = False,
+                        no_topk: bool = False) -> "Prepared":
         for td in self.store.tables.values():
             if td.open_ts:
                 self.store.seal(td.schema.name)
@@ -1009,7 +1023,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         # sql_text alone would hand back a stale compiled constant
         plan_fp = hash(repr(node))
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
-               stream, cap, pallas, plan_fp)
+               stream, cap, pallas, plan_fp, no_topk)
         cached = self._exec_cache.get(key)
         self.tracer.tag(plan_cache="hit" if cached else "miss")
         if cached is None:
@@ -1019,7 +1033,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 n_shards=(self.mesh.devices.size
                           if decision is not None else 1),
                 pallas_groupagg=pallas,
-                pallas_interpret=jax.default_backend() != "tpu")
+                pallas_interpret=jax.default_backend() != "tpu",
+                topk_sort=not no_topk)
             if stream is not None:
                 splan = compile_streaming(node, params, meta)
 
@@ -1142,13 +1157,46 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 f"each {so.op.upper()} branch must have the same "
                 f"number of columns ({len(left.names)} vs "
                 f"{len(right.names)})")
-        for lt, rt in zip(left.types, right.types):
-            if lt.family != rt.family and \
-                    "unknown" not in (lt.family.value, rt.family.value):
-                raise EngineError(
-                    f"{so.op.upper()} branch column types do not "
-                    f"match: {lt} vs {rt}")
+        numeric = (Family.INT, Family.FLOAT, Family.DECIMAL)
+        out_types = list(left.types)
+        coerce_cols = {}  # column index -> unified SQLType
+        for i, (lt, rt) in enumerate(zip(left.types, right.types)):
+            if lt.family == rt.family or \
+                    "unknown" in (lt.family.value, rt.family.value):
+                continue
+            if lt.family in numeric and rt.family in numeric:
+                # unify like expression arithmetic would
+                # (common_numeric_type): the merged rows and the
+                # declared column type must agree, or a temp-table
+                # materialization / pgwire OID would mis-encode
+                from ..sql.types import common_numeric_type
+                ut = common_numeric_type(lt, rt)
+                out_types[i] = ut
+                coerce_cols[i] = ut
+                continue
+            raise EngineError(
+                f"{so.op.upper()} branch column types do not "
+                f"match: {lt} vs {rt}")
         lrows, rrows = list(left.rows), list(right.rows)
+        if coerce_cols:
+            import decimal as _dec
+
+            def _unify(rows):
+                out = []
+                for r in rows:
+                    r = list(r)
+                    for i, ut in coerce_cols.items():
+                        v = r[i]
+                        if v is None:
+                            continue
+                        if ut.family == Family.FLOAT:
+                            r[i] = float(v)
+                        elif ut.family == Family.DECIMAL:
+                            r[i] = _dec.Decimal(str(v))
+                    out.append(tuple(r))
+                return out
+            lrows, rrows = _unify(lrows), _unify(rrows)
+        left.types = out_types
         if so.op == "union":
             rows = lrows + rrows
             if not so.all:

@@ -188,7 +188,12 @@ def compile_expr(e: BExpr) -> CompiledExpr:
                 return d.astype(jnp.int64), v
             if dst.family == Family.INT:
                 if src.family == Family.DECIMAL:
-                    d = d // (10 ** src.scale)
+                    # numeric -> int rounds half away from zero
+                    div = 10 ** src.scale
+                    mag = (jnp.abs(d) + div // 2) // div
+                    d = jnp.where(d < 0, -mag, mag)
+                elif src.family == Family.FLOAT:
+                    d = jnp.rint(d)  # float -> int: half-even (pg)
                 return d.astype(_np_dtype(dst)), v
             if dst.family == Family.BOOL:
                 return d.astype(jnp.bool_), v

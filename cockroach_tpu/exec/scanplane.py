@@ -26,6 +26,7 @@ EPOCH_DATE = datetime.date(1970, 1, 1)
 EPOCH_DT = datetime.datetime(1970, 1, 1)
 
 from .session import (EngineError, HashCapacityExceeded, Prepared,
+                      TopKInexact,
                       Result, Session)
 from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _host_sort, _next_pow2, _pad, _slice_chunks)
 
@@ -325,6 +326,11 @@ class ScanPlaneMixin:
                 raise EngineError(
                     "decimal SUM overflowed int64 accumulation; "
                     "CAST the argument to FLOAT to trade exactness for range")
+        if out.has("__topk_inexact"):
+            if bool(np.asarray(out.col("__topk_inexact"))[0]):
+                raise TopKInexact(
+                    "top-k cut crossed a primary-key tie group; "
+                    "replanning with the full sort")
         host = out.to_host()
         res = Result(names=list(meta.names), types=list(meta.types))
         cols = []

@@ -29,6 +29,12 @@ class HashCapacityExceeded(EngineError):
     re-execution (the spill path)."""
 
 
+class TopKInexact(EngineError):
+    """The fused top-k ORDER BY ... LIMIT cut crossed a primary-key
+    tie group (compile.py topk_sort_limit_batch). Prepared.run
+    catches this and replans with the full device sort."""
+
+
 @dataclass
 class Result:
     """Decoded query result."""
@@ -154,5 +160,12 @@ class Prepared:
             # partition-and-recurse (the reference's disk spiller,
             # colexecdisk/disk_spiller.go:75, over HBM re-reads)
             return self.engine._run_partitioned(self, read_ts)
+        except TopKInexact:
+            # primary-key ties crossed the top-k candidate cut:
+            # replan with the full (slow-to-compile, always-exact)
+            # device sort
+            return self.engine._prepare_select(
+                self.stmt, self.session, self.sql_text,
+                no_topk=True).run(read_ts)
 
 

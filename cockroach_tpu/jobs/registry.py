@@ -202,6 +202,9 @@ class Registry:
         rec = self._try_claim(job_id)
         if rec is None:
             return self.job(job_id)
+        from ..utils import log
+        log.structured(log.JOBS, "job_run", job_id=job_id,
+                       job_type=rec.type, owner=self.session_id)
         factory = self._resumers.get(rec.type)
         if factory is None:
             return self._update(job_id, status=FAILED,
@@ -231,6 +234,9 @@ class Registry:
             # — only lease expiry lets another registry adopt it
             raise
         except Exception as e:  # Resumer failure -> terminal FAILED
+            from ..utils import log
+            log.error(log.JOBS, "job %s (%s) failed: %s",
+                      job_id, rec.type, e)
             if hasattr(resumer, "on_fail_or_cancel"):
                 try:
                     resumer.on_fail_or_cancel(ctx)

@@ -275,6 +275,10 @@ class Cluster:
             generation=lhs.generation + 1)
         lhs.end_key = key
         lhs.generation += 1
+        from ..utils import log
+        log.structured(log.STORAGE, "range_split",
+                       lhs=lhs.range_id, rhs=new_id,
+                       split_key=key.decode("latin1"))
         return self.descriptors[new_id]
 
     def merge_ranges(self, lhs_range_id: int) -> RangeDescriptor:

@@ -87,6 +87,7 @@ CREATE TABLE customer (
     c_custkey    INT8 NOT NULL,
     c_name       STRING NOT NULL,
     c_nationkey  INT8 NOT NULL,
+    c_phone      STRING NOT NULL,
     c_acctbal    DECIMAL(15,2) NOT NULL,
     c_mktsegment STRING NOT NULL
 )""",
@@ -263,10 +264,16 @@ def gen_orders(sf: float, seed: int = 2) -> dict:
     cutoff = _days("1995-06-17")
     status = np.where(orderdate < cutoff - 90, "F",
                       np.where(orderdate < cutoff, "P", "O")).astype(object)
+    # spec 4.2.3: custkeys divisible by 3 never place orders (this is
+    # what gives Q22's anti-join a non-empty answer); draw uniformly
+    # over the valid keys so per-key multiplicity stays flat
+    ncust = _n_cust(sf)
+    m = ncust - ncust // 3  # count of keys in [1, ncust] not % 3 == 0
+    idx = rng.integers(0, m, size=n).astype(np.int64)
+    ck = 3 * (idx // 2) + 1 + (idx % 2)
     return {
         "o_orderkey": orderkey,
-        "o_custkey": rng.integers(1, _n_cust(sf) + 1,
-                                  size=n).astype(np.int64),
+        "o_custkey": ck,
         "o_orderstatus": status,
         "o_totalprice": np.round(rng.uniform(900, 500000, size=n), 2),
         "o_orderdate": orderdate,
@@ -284,7 +291,13 @@ def gen_customer(sf: float, seed: int = 3) -> dict:
         "c_custkey": custkey,
         "c_name": np.array([f"Customer#{k:09d}" for k in custkey],
                            dtype=object),
-        "c_nationkey": rng.integers(0, 25, size=n).astype(np.int64),
+        "c_nationkey": (nat := rng.integers(0, 25, size=n).astype(
+            np.int64)),
+        # spec 4.2.2.9: country code = nationkey + 10
+        "c_phone": np.array(
+            [f"{nk + 10}-{rng.integers(100, 999)}-"
+             f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+             for nk in nat], dtype=object),
         "c_acctbal": np.round(rng.uniform(-999, 9999, size=n), 2),
         "c_mktsegment": rng.choice(SEGMENTS, size=n).astype(object),
     }
@@ -368,6 +381,10 @@ def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
         else:
             cols = gens[t]()
         engine.store.insert_columns(t, cols, ts)
+        # column stats unlock the memo's cost-based join ordering
+        # (sql/memo.py engages only with distinct counts; the
+        # reference's workloads rely on auto-stats the same way)
+        engine.execute(f"ANALYZE {t}")
 
 
 ALL_TABLES = ("lineitem", "part", "orders", "customer", "supplier",
@@ -555,8 +572,44 @@ ORDER BY numwait DESC, s_name
 LIMIT 100
 """
 
+# Q17 (queries.go's small-quantity-order revenue): the correlated
+# scalar avg decorrelates into a grouped LEFT JOIN
+# (sql/decorrelate.py decorrelate_scalar)
+Q17 = """
+SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+FROM lineitem, part
+WHERE p_partkey = l_partkey
+  AND p_brand = 'Brand#23'
+  AND p_container = 'MED BOX'
+  AND l_quantity < (
+      SELECT 0.2 * avg(l2.l_quantity)
+      FROM lineitem AS l2
+      WHERE l2.l_partkey = p_partkey)
+"""
+
+# Q22 (global sales opportunity): uncorrelated scalar avg +
+# NOT EXISTS anti-join + substring country codes
+Q22 = """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (
+  SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal, c_custkey
+  FROM customer
+  WHERE substring(c_phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+) AS custsale
+WHERE c_acctbal > (
+      SELECT avg(c_acctbal) FROM customer
+      WHERE c_acctbal > 0.00
+        AND substring(c_phone, 1, 2)
+            IN ('13', '31', '23', '29', '30', '18', '17'))
+  AND NOT EXISTS (
+      SELECT * FROM orders WHERE o_custkey = c_custkey)
+GROUP BY cntrycode
+ORDER BY cntrycode
+"""
+
 QUERIES = {"q1": Q1, "q3": Q3, "q5": Q5, "q6": Q6, "q9": Q9,
-           "q12": Q12, "q14": Q14, "q18": Q18, "q19": Q19, "q21": Q21}
+           "q12": Q12, "q14": Q14, "q17": Q17, "q18": Q18, "q19": Q19,
+           "q21": Q21, "q22": Q22}
 
 
 # ---------------------------------------------------------------------------
@@ -738,6 +791,36 @@ def ref_q19(li, part) -> float:
     m = base & (g1 | g2 | g3)
     return float((li["l_extendedprice"][m]
                   * (1 - li["l_discount"][m])).sum())
+
+
+def ref_q17(li, part) -> float:
+    keys = li["l_partkey"]
+    qty = li["l_quantity"]
+    size = int(keys.max()) + 1
+    sums = np.bincount(keys, weights=qty, minlength=size)
+    counts = np.bincount(keys, minlength=size)
+    avg = sums / np.maximum(counts, 1)
+    pm = (part["p_brand"] == "Brand#23") & \
+        (part["p_container"] == "MED BOX")
+    sel = np.zeros(size, dtype=bool)
+    sel[part["p_partkey"][pm]] = True
+    m = sel[keys] & (qty < 0.2 * avg[keys])
+    return float(li["l_extendedprice"][m].sum() / 7.0)
+
+
+def ref_q22(cust, orders) -> list[tuple]:
+    codes = np.array([p[:2] for p in cust["c_phone"]], dtype=object)
+    in_list = np.isin(codes, ["13", "31", "23", "29", "30", "18", "17"])
+    pos = in_list & (cust["c_acctbal"] > 0.0)
+    avg_bal = float(cust["c_acctbal"][pos].mean())
+    has_orders = set(orders["o_custkey"].tolist())
+    m = in_list & (cust["c_acctbal"] > avg_bal) & np.array(
+        [int(k) not in has_orders for k in cust["c_custkey"]])
+    out: dict = {}
+    for c, b in zip(codes[m], cust["c_acctbal"][m]):
+        n, s = out.get(c, (0, 0.0))
+        out[c] = (n + 1, s + float(b))
+    return sorted((c, n, round(s, 2)) for c, (n, s) in out.items())
 
 
 def ref_q21(li, orders, supp) -> list[tuple]:

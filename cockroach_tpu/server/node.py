@@ -232,6 +232,10 @@ class Node:
         if self.config.maintenance_interval is not None:
             self._start_maintenance()
         self._started = True
+        from ..utils import log
+        log.structured(log.OPS, "node_start",
+                       node_id=self.config.node_id,
+                       sql_addr="%s:%d" % self.pg.addr)
         return self
 
     def _start_maintenance(self):
@@ -296,6 +300,10 @@ class Node:
             self._http.shutdown()
             self._http.server_close()
             self._http = None
+        if self._started:
+            from ..utils import log
+            log.structured(log.OPS, "node_stop",
+                           node_id=self.config.node_id)
         self._started = False
 
     def __enter__(self):

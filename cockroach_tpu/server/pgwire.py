@@ -464,6 +464,7 @@ class _Conn:
                 self.w.flush()
                 return False
             break
+        self.user = params.get("user", "root")
         self.w.auth_ok()
         self.w.parameter_status("server_version", "13.0 cockroach-tpu "
                                 + self.version)
@@ -477,6 +478,9 @@ class _Conn:
     def serve(self):
         if not self.handshake():
             return
+        from ..utils import log
+        log.info(log.SESSIONS, "client session opened user=%s",
+                 getattr(self, "user", "?"))
         while True:
             typ, body = self.r.message()
             if typ == b"X":          # Terminate

@@ -207,6 +207,7 @@ class ColumnDef:
     nullable: bool = True
     primary: bool = False
     unique: bool = False  # column UNIQUE -> auto unique index
+    default: object = None  # DEFAULT expr (unbound AST)
 
 
 @dataclass

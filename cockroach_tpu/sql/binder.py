@@ -101,9 +101,12 @@ def parse_timestamp(s: str) -> int:
     return int((dt - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
 
 
+# longer unit spellings must precede their prefixes in the alternation
+# (regex | is first-match: "minute" before "minutes" would strand the s)
 _INTERVAL_RE = re.compile(
-    r"\s*(-?\d+)\s*(year|years|month|months|mon|mons|day|days|hour|hours|"
-    r"minute|minutes|min|mins|second|seconds|sec|secs)\s*", re.I)
+    r"\s*(-?\d+)\s*(years|year|months|mons|month|mon|days|day|"
+    r"hours|hour|minutes|mins|minute|min|seconds|secs|second|sec)\s*",
+    re.I)
 
 
 @dataclass
@@ -202,6 +205,8 @@ class Binder:
         if isinstance(e, ast.UnaryOp):
             o = self.bind(e.operand)
             if e.op == "not":
+                if o.type.family == Family.UNKNOWN:
+                    return BConst(None, BOOL)  # NOT NULL is NULL
                 if o.type.family != Family.BOOL:
                     raise BindError("NOT requires boolean")
                 return BUnary("not", o, BOOL)
@@ -265,8 +270,17 @@ class Binder:
                 raise BindError("IN subquery must return one column")
             items = [self._subquery_const(r[0], types[0]) for r in rows
                      if r[0] is not None]
-            return self._bind_in_consts(self.bind(e.expr), items,
-                                        e.negated)
+            had_null = any(r[0] is None for r in rows)
+            out = self._bind_in_consts(self.bind(e.expr), items,
+                                       e.negated)
+            if had_null:
+                # three-valued IN: a NULL in the list means "maybe" —
+                # x NOT IN (..., NULL) is never TRUE (false on match,
+                # else NULL); x IN (..., NULL) is never FALSE. AND/OR
+                # with NULL realizes exactly that truth table.
+                out = BBin("and" if e.negated else "or",
+                           out, BConst(None, BOOL), BOOL)
+            return out
         raise BindError(f"cannot bind {e!r}")
 
     # -- subqueries ---------------------------------------------------------
@@ -375,6 +389,9 @@ class Binder:
         if t.family == Family.STRING and target.family == Family.DATE \
                 and isinstance(e, BConst):
             return BConst(parse_date(e.value), DATE)
+        if t.family == Family.DATE and target.family == Family.TIMESTAMP:
+            # days -> micros: a date is midnight of that day
+            return BBin("*", e, BConst(86_400_000_000, INT8), TIMESTAMP)
         raise BindError(f"cannot coerce {t} to {target}")
 
     def _const_to(self, e: BConst, target: SQLType) -> BConst:
@@ -397,6 +414,15 @@ class Binder:
                 logical = v / 10 ** e.type.scale
                 return BConst(int(logical + (0.5 if logical >= 0 else -0.5)),
                               target)
+            if isinstance(v, float):
+                return BConst(round(v), target)  # half-even (pg float8)
+            if isinstance(v, str):
+                try:
+                    return BConst(int(v.strip()), target)
+                except ValueError:
+                    raise BindError(
+                        f"cannot convert constant {v!r} to {target}") \
+                        from None
             return BConst(int(v), target)
         if f == Family.DATE and isinstance(v, str):
             return BConst(parse_date(v), DATE)
@@ -405,10 +431,32 @@ class Binder:
         if f in (Family.DATE, Family.TIMESTAMP) \
                 and e.type.family == f and isinstance(v, int):
             return BConst(v, target)  # already physical (days / micros)
-        if f == Family.STRING and isinstance(v, str):
-            return BConst(v, STRING)
-        if f == Family.BOOL and isinstance(v, (bool, int)):
-            return BConst(bool(v), target)
+        if f == Family.TIMESTAMP and e.type.family == Family.DATE \
+                and isinstance(v, int):
+            return BConst(v * 86_400_000_000, TIMESTAMP)  # days -> us
+        if f == Family.DATE and e.type.family == Family.TIMESTAMP \
+                and isinstance(v, int):
+            return BConst(v // 86_400_000_000, DATE)
+        if f == Family.STRING:
+            if isinstance(v, str):
+                return BConst(v, STRING)
+            if isinstance(v, bool):
+                return BConst("true" if v else "false", STRING)
+            if e.type.family == Family.DECIMAL:
+                return BConst(f"{v / 10 ** e.type.scale:.{e.type.scale}f}",
+                              STRING)
+            if isinstance(v, (int, float)):
+                return BConst(str(v), STRING)
+        if f == Family.BOOL:
+            if isinstance(v, str):
+                s = v.strip().lower()
+                if s in ("t", "true", "yes", "on", "1"):
+                    return BConst(True, target)
+                if s in ("f", "false", "no", "off", "0"):
+                    return BConst(False, target)
+                raise BindError(f"invalid bool value {v!r}")
+            if isinstance(v, (bool, int)):
+                return BConst(bool(v), target)
         raise BindError(f"cannot convert constant {v!r} to {target}")
 
     def _rescale_decimal(self, e: BExpr, scale: int) -> BExpr:
@@ -421,7 +469,11 @@ class Binder:
                 return BConst(None, ty)
             if scale > cur:
                 return BConst(e.value * 10 ** (scale - cur), ty)
-            return BConst(e.value // 10 ** (cur - scale), ty)
+            # numeric rounds half away from zero on scale reduction
+            div = 10 ** (cur - scale)
+            q, r = divmod(abs(e.value), div)
+            mag = q + (1 if 2 * r >= div else 0)
+            return BConst(-mag if e.value < 0 else mag, ty)
         if scale > cur:
             return BBin("*", e, BConst(10 ** (scale - cur), INT8), ty)
         return BBin("//", e, BConst(10 ** (cur - scale), INT8), ty)
@@ -495,7 +547,18 @@ class Binder:
         if op == "%":
             l2, r2, t = self._align2(l, r)
             return BBin("%", l2, r2, t)
+        if op == "^":
+            from . import builtins as bi
+            try:
+                return bi.bind_builtin(self, "pow", [l, r], e)
+            except bi.BuiltinError as err:
+                raise BindError(str(err)) from err
         if op == "||":
+            # unlike concat() (which skips NULL args, pg-style), the
+            # || operator is strict: NULL || x IS NULL
+            if (isinstance(l, BConst) and l.value is None) or \
+                    (isinstance(r, BConst) and r.value is None):
+                return BConst(None, STRING)
             from . import builtins as bi
             try:
                 out = bi.bind_builtin(self, "concat", [l, r], e)
@@ -555,6 +618,13 @@ class Binder:
     def _bind_string_compare(self, op, l, r):
         if l.type.family != Family.STRING and r.type.family != Family.STRING:
             return None
+        if isinstance(l, BConst) and isinstance(r, BConst):
+            if l.value is None or r.value is None:
+                return BConst(None, BOOL)
+            lv, rv = str(l.value), str(r.value)
+            res = {"=": lv == rv, "!=": lv != rv, "<": lv < rv,
+                   "<=": lv <= rv, ">": lv > rv, ">=": lv >= rv}[op]
+            return BConst(res, BOOL)
         col, lit, flip = None, None, False
         if isinstance(r, BConst) and isinstance(r.value, str):
             col, lit = l, r.value
@@ -599,14 +669,20 @@ class Binder:
     def bind_like(self, e: ast.BinOp) -> BExpr:
         col = self.bind(e.left)
         pat = self.bind(e.right)
+        if isinstance(pat, BConst) and pat.value is None:
+            return BConst(None, BOOL)  # x LIKE NULL is NULL
         if not isinstance(pat, BConst) or not isinstance(pat.value, str):
             raise BindError("LIKE pattern must be a constant")
-        d = self._dict_of(col)
-        if d is None:
-            raise BindError("LIKE on non-dictionary column")
         rx = re.compile(
             "^" + re.escape(pat.value).replace("%", ".*").replace("_", ".")
             + "$", re.S)
+        if isinstance(col, BConst):
+            if col.value is None:
+                return BConst(None, BOOL)  # NULL LIKE p is NULL
+            return BConst(rx.match(str(col.value)) is not None, BOOL)
+        d = self._dict_of(col)
+        if d is None:
+            raise BindError("LIKE on non-dictionary column")
         table = np.fromiter((rx.match(v) is not None for v in d.values),
                             dtype=bool, count=len(d.values))
         return BDictLookup(col, table, BOOL)

@@ -99,6 +99,180 @@ def _side(e, inner_alias: str, inner_cols: set, outer_aliases: set):
     return sides.pop() if len(sides) == 1 else None
 
 
+_AGG_FNS = {"sum", "avg", "min", "max", "count"}
+
+
+def _agg_only(e) -> str | None:
+    """Classify a select-item expression that must collapse to one row
+    per group: every ColumnRef sits under an aggregate FuncCall and at
+    least one aggregate exists. Returns "count" when the expression is
+    exactly count(...) (whose empty-group value is 0, not NULL),
+    "agg" for other aggregate-only shapes, None when not aggregate-only."""
+    import dataclasses
+    if isinstance(e, ast.FuncCall) and e.name in _AGG_FNS:
+        return "count" if e.name == "count" else "agg"
+    if isinstance(e, ast.ColumnRef):
+        return None
+    if isinstance(e, (ast.Exists, ast.Subquery, ast.InSubquery)):
+        return None
+    kinds = []
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if isinstance(x, ast.Expr):
+                    k = _agg_only(x)
+                    if k is None and _refs(x, []):
+                        return None  # bare column ref outside an agg
+                    if k is not None:
+                        kinds.append(k)
+    if not kinds:
+        return None
+    if "count" in kinds:
+        # arithmetic over count (e.g. count(*) + 1) would need the
+        # empty group to evaluate the expression at count = 0, but the
+        # LEFT JOIN yields NULL — not rewritable
+        return None
+    return "agg"
+
+
+def _walk_subqueries(e, visit):
+    """Depth-first over an expr/statement tree, calling visit(node,
+    setter) for every ast.Subquery; setter(replacement) swaps it out
+    in place. Mutates e (callers pass a private copy)."""
+    import dataclasses
+    if not (dataclasses.is_dataclass(e) and not isinstance(e, type)):
+        return
+    if isinstance(e, (ast.Exists, ast.InSubquery)):
+        return  # handled by the EXISTS/IN paths; do not descend
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Subquery):
+            def setter(repl, _e=e, _n=f.name):
+                setattr(_e, _n, repl)
+            visit(v, setter)
+        elif isinstance(v, ast.Expr):
+            _walk_subqueries(v, visit)
+        elif isinstance(v, (list, tuple)):
+            for i, x in enumerate(v):
+                if isinstance(x, ast.Subquery):
+                    def setter(repl, _v=v, _i=i):
+                        _v[_i] = repl
+                    visit(x, setter)
+                elif isinstance(x, ast.Expr):
+                    _walk_subqueries(x, visit)
+
+
+def decorrelate_scalar(sel: ast.Select, columns_of) -> ast.Select:
+    """Rewrite correlated scalar subqueries in sel's SELECT items and
+    WHERE into grouped LEFT JOINs (TPC-H q2/q17/q20/q22 shapes):
+
+        x < (SELECT agg(e) FROM T WHERE T.k = outer.k AND <residual>)
+
+    becomes LEFT JOIN (SELECT k AS __k0, agg(e) AS __v FROM T WHERE
+    <residual> GROUP BY k) AS __scN ON __scN.__k0 = outer.k, with the
+    subquery replaced by __scN.__v. Missing groups join as NULL —
+    exactly the empty scalar subquery's value — except count(...),
+    which yields 0 and gets a coalesce. Non-rewritable subqueries are
+    left untouched (uncorrelated ones bind as constants; genuinely
+    unsupported ones keep the clear bind error)."""
+    import copy
+    outer_aliases = set()
+    if sel.table is not None:
+        outer_aliases.add(sel.table.alias or sel.table.name)
+    for j in sel.joins:
+        outer_aliases.add(j.table.alias or j.table.name)
+    if not outer_aliases:
+        return sel
+
+    sel = copy.deepcopy(sel)
+    new_joins = []
+
+    def visit(sub, setter):
+        out = _rewrite_scalar(sub.select, outer_aliases, columns_of)
+        if out is None:
+            return
+        join, repl = out
+        new_joins.append(join)
+        setter(repl)
+
+    for item in sel.items:
+        _walk_subqueries(item, visit)
+    if sel.where is not None:
+        _walk_subqueries(sel.where, visit)
+    if not new_joins:
+        return sel
+    sel.joins = list(sel.joins) + new_joins
+    return sel
+
+
+def _rewrite_scalar(sub: ast.Select, outer_aliases: set, columns_of):
+    """One correlated scalar subquery -> (JoinClause, replacement
+    expr), or None."""
+    if sub is None or sub.table is None or \
+            sub.table.subquery is not None or sub.joins or \
+            sub.group_by or sub.having or sub.ctes or sub.distinct or \
+            sub.limit is not None or sub.where is None or \
+            len(sub.items) != 1:
+        return None
+    kind = _agg_only(sub.items[0].expr)
+    if kind is None:
+        return None
+    inner_alias = sub.table.alias or sub.table.name
+    inner_cols = columns_of(sub.table.name)
+    if inner_cols is None or inner_alias in outer_aliases:
+        return None
+
+    eq_corr = []
+    residual = []
+    for p in _conjuncts(sub.where):
+        s = _side(p, inner_alias, inner_cols, outer_aliases)
+        if s == "inner":
+            residual.append(p)
+            continue
+        if isinstance(p, ast.BinOp) and p.op == "=":
+            ls = _side(p.left, inner_alias, inner_cols, outer_aliases)
+            rs = _side(p.right, inner_alias, inner_cols, outer_aliases)
+            pair = None
+            if ls == "inner" and rs == "outer" and \
+                    isinstance(p.left, ast.ColumnRef):
+                pair = (p.left, p.right)
+            elif rs == "inner" and ls == "outer" and \
+                    isinstance(p.right, ast.ColumnRef):
+                pair = (p.right, p.left)
+            if pair is not None:
+                eq_corr.append(pair)
+                continue
+        return None
+    if not eq_corr:
+        return None  # uncorrelated: the binder inlines it already
+
+    dn = f"__sc{next(_counter)}"
+    items = []
+    group_by = []
+    on_parts = []
+    for i, (icol, oexpr) in enumerate(eq_corr):
+        inner = ast.ColumnRef(icol.name, inner_alias)
+        items.append(ast.SelectItem(inner, alias=f"__k{i}"))
+        group_by.append(inner)
+        on_parts.append(ast.BinOp("=", ast.ColumnRef(f"__k{i}", dn),
+                                  oexpr))
+    items.append(ast.SelectItem(sub.items[0].expr, alias="__v"))
+    derived = ast.Select(
+        items=items,
+        table=ast.TableRef(sub.table.name, alias=inner_alias),
+        where=_and_all(residual),
+        group_by=group_by)
+    join = ast.JoinClause(
+        table=ast.TableRef(dn, alias=dn, subquery=derived),
+        join_type="left", on=_and_all(on_parts))
+    repl: ast.Expr = ast.ColumnRef("__v", dn)
+    if kind == "count":
+        repl = ast.FuncCall("coalesce", [repl, ast.Literal(0)])
+    return join, repl
+
+
 def _match_exists(c):
     """(exists_node, negated) or (None, False)."""
     if isinstance(c, ast.Exists):

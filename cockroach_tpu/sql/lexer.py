@@ -38,7 +38,7 @@ KEYWORDS = {
 }
 
 MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]
-SINGLE_OPS = "+-*/%(),.<>=;"
+SINGLE_OPS = "+-*/%(),.<>=;^"
 
 
 @dataclass

@@ -34,6 +34,15 @@ from dataclasses import dataclass, field
 BUILD_W = 2.0
 PROBE_W = 1.0
 OUT_W = 0.5
+# a duplicate-keyed build cannot take the one-scatter direct path:
+# it falls to the while-loop hash build + K-slot probe gathers,
+# measured ~100x the per-row cost of a unique direct build on the
+# TPU (and minutes of XLA compile at 10^6 rows). Charging hash
+# builds near their real weight steers the DP toward fact-table
+# probe spines with unique dimension builds (q3: customer,orders,
+# lineitem spec order would otherwise build on 540K dup-keyed
+# lineitem rows instead of probing lineitem through unique orders)
+HASH_BUILD_W = 100.0
 # the device join expands duplicate-keyed builds by gathering K slots
 # per probe, capped at MAX per-key duplicates = 32 (engine
 # MAX_JOIN_EXPANSION). Stats give the AVERAGE multiplicity
@@ -97,7 +106,8 @@ def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
                 sel, build_mult = info
                 build = max(scan_rows(last), 1.0)
                 out = max(b.rows * build * sel, 1.0)
-                cost = (b.cost + BUILD_W * build
+                bw = BUILD_W if build_mult <= 1.05 else HASH_BUILD_W
+                cost = (b.cost + bw * build
                         + PROBE_W * b.rows + OUT_W * out)
                 if build_mult > MAX_BUILD_MULT:
                     cost += MULT_PENALTY * build_mult

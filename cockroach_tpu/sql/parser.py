@@ -33,6 +33,7 @@ PRECEDENCE = {
     "||": 45,
     "+": 50, "-": 50,
     "*": 60, "/": 60, "%": 60,
+    "^": 70,  # below unary +/- (pg: -2 ^ 2 = (-2)^2 = 4)
     "::": 80,
 }
 
@@ -440,10 +441,13 @@ class Parser:
                 if self.accept_kw("null"):
                     left = ast.IsNull(left, negated=neg)
                 elif self.accept_kw("true"):
-                    cmp = ast.BinOp("=", left, ast.Literal(True, BOOL))
+                    # IS TRUE never returns NULL: (x IS NOT NULL) AND x
+                    cmp = ast.BinOp("and", ast.IsNull(left, negated=True),
+                                    left)
                     left = ast.UnaryOp("not", cmp) if neg else cmp
                 elif self.accept_kw("false"):
-                    cmp = ast.BinOp("=", left, ast.Literal(False, BOOL))
+                    cmp = ast.BinOp("and", ast.IsNull(left, negated=True),
+                                    ast.UnaryOp("not", left))
                     left = ast.UnaryOp("not", cmp) if neg else cmp
                 else:
                     raise ParseError(f"expected NULL/TRUE/FALSE after IS at {self.peek()}")
@@ -521,9 +525,11 @@ class Parser:
         if t.is_kw("not"):
             return ast.UnaryOp("not", self.parse_expr(25))
         if t.kind == Tok.OP and t.text == "-":
-            return ast.UnaryOp("-", self.parse_expr(70))
+            # pg precedence: unary minus binds TIGHTER than ^
+            # (-2 ^ 2 is (-2)^2 = 4), so the operand stops before ^
+            return ast.UnaryOp("-", self.parse_expr(75))
         if t.kind == Tok.OP and t.text == "+":
-            return self.parse_expr(70)
+            return self.parse_expr(75)
         if t.kind == Tok.OP and t.text == "(":
             if self.peek().is_kw("select", "with"):
                 sub = self.parse_with() if self.peek().is_kw("with") \
@@ -580,6 +586,22 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return ast.Extract(part, e)
+        if t.kind in (Tok.IDENT, Tok.KEYWORD) and t.text == "position" \
+                and self.peek().kind == Tok.OP \
+                and self.peek().text == "(":
+            # position(needle IN haystack) -> strpos(haystack, needle);
+            # the comma form position(haystack, needle) stays a plain call
+            self.expect_op("(")
+            first = self.parse_expr(min_bp=36)  # stop before IN (bp 35)
+            if self.accept_kw("in"):
+                hay = self.parse_expr()
+                self.expect_op(")")
+                return ast.FuncCall("strpos", [hay, first])
+            args = [first]
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("position", args)
         if t.is_kw("substring"):
             self.expect_op("(")
             e = self.parse_expr()
@@ -852,6 +874,7 @@ class Parser:
                 nullable = True
                 primary = False
                 unique = False
+                default = None
                 while True:
                     if self.accept_kw("not"):
                         self.expect_kw("null")
@@ -863,7 +886,7 @@ class Parser:
                         primary = True
                         nullable = False
                     elif self.accept_kw("default"):
-                        self.parse_expr()  # accepted, ignored for now
+                        default = self.parse_expr()
                     elif _is_word("check"):
                         self.next()
                         parse_check()
@@ -876,7 +899,8 @@ class Parser:
                     else:
                         break
                 cols.append(ast.ColumnDef(cname, ctype, nullable,
-                                          primary, unique))
+                                          primary, unique,
+                                          default=default))
                 if primary:
                     pk.append(cname)
             if not self.accept_op(","):

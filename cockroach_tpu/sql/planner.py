@@ -38,11 +38,18 @@ class CatalogView:
     (cached per generation); None when no store is attached."""
 
     def __init__(self, schemas, dictionaries, stats=None,
-                 key_distinct_fn=None, int_range_fn=None):
+                 key_distinct_fn=None, int_range_fn=None,
+                 keys_unique_fn=None):
         self.schemas = schemas
         self.dictionaries = dictionaries
         self.stats = stats or {}
         self.key_distinct_fn = key_distinct_fn
+        # keys_unique_fn(table, cols) -> bool: SNAPSHOT-AWARE
+        # uniqueness at the statement's read timestamp — required for
+        # correctness-bearing rewrites (FD group-key reduction), where
+        # the live-rows distinct probe could disagree with an AS OF
+        # read's visible rows
+        self.keys_unique_fn = keys_unique_fn
         # int_range_fn(table, col) -> (lo, hi, count) | None: exact
         # all-versions value range of an int column (generation-
         # cached). Lets GROUP BY over small-range int keys (years,
@@ -558,13 +565,32 @@ class Planner:
         meta = plan.OutputMeta()
 
         if has_group or binder.aggs:
+            # FD reduction: engage only when it unlocks the dense
+            # segment-sum strategy the hash path couldn't use — the
+            # hash path handles multi-key groups fine as-is
+            fd_repl = []
+            if len(group_exprs) >= 2 and self._static_group_bound(
+                    group_exprs, scope, tables)[0] == 0:
+                n_aggs = len(binder.aggs)
+                reduced, repl = self._reduce_fd_group_keys(
+                    group_exprs, node, tables, binder)
+                if repl and self._static_group_bound(
+                        reduced, scope, tables)[0] > 0:
+                    group_exprs, fd_repl = reduced, repl
+                else:
+                    del binder.aggs[n_aggs:]  # undo speculative aggs
             # rewrite grouped output exprs: replace group-expr occurrences
             # with group column refs
             rewritten = []
             for name, b in bound_items:
-                rewritten.append((name, _replace_group_refs(b, group_exprs)))
+                b2 = _replace_group_refs(b, group_exprs)
+                if fd_repl:
+                    b2 = _substitute(b2, fd_repl)
+                rewritten.append((name, b2))
             if having_b is not None:
                 having_b = _replace_group_refs(having_b, group_exprs)
+                if fd_repl:
+                    having_b = _substitute(having_b, fd_repl)
             for name, b in rewritten:
                 _check_agg_valid(b, group_exprs)
             max_groups, dims, glos = self._static_group_bound(
@@ -610,6 +636,14 @@ class Planner:
                     hname = f"__ord{i}"
                     node.items.append((hname, b))
                     keys.append((hname, ob.desc))
+                    # a hidden dict-encoded string key must still sort
+                    # by value rank, not code (sort_batch consults
+                    # meta.dictionaries by key name)
+                    if b.type.family == Family.STRING:
+                        d = self._find_dict_for_output(
+                            hname, node.items, [], scope, node)
+                        if d is not None:
+                            meta.dictionaries[hname] = d
                 else:
                     raise PlanError("ORDER BY must reference output columns")
             node = plan.Sort(node, keys)
@@ -632,6 +666,106 @@ class Planner:
         return node, meta
 
     MAX_INT_GROUP_SPAN = 1 << 12
+    # a SINGLE int key may span much further: one dense scatter-add
+    # buffer per agg at 2M slots is ~16MB HBM and runs in ~1ms on a
+    # v5e, where the while-loop hash build takes seconds (q3's
+    # 262K-group GROUP BY l_orderkey: measured 0.1-3.5ms dense vs
+    # ~11s hashed, with compile 1s vs 385s)
+    MAX_INT_GROUP_SPAN_SINGLE = 1 << 21
+
+    def _reduce_fd_group_keys(self, group_exprs, node, tables, binder):
+        """Functional-dependency reduction of GROUP BY keys (the one
+        FD the reference's optimizer derives that dominates star
+        queries, pkg/sql/opt/props/func_dep.go): a group key that is a
+        column of a table equi-joined on its single-column PRIMARY KEY
+        to another group key is constant within every group of that
+        other key — drop it from the keys and carry its value as a
+        max() aggregate instead. TPC-H q3's GROUP BY l_orderkey,
+        o_orderdate, o_shippriority (orders PK-joined on o_orderkey =
+        l_orderkey) collapses to the ONE dense int key l_orderkey.
+
+        Returns (reduced_group_exprs, [(orig_expr, BAggRef), ...]);
+        the second list is empty when nothing reduced."""
+        from .bound import BAggRef, BoundAgg
+        if len(group_exprs) < 2:
+            return group_exprs, []
+        alias_to_table = dict(tables or [])
+
+        # equi-join pairs from the planned FROM tree
+        pairs = []
+
+        def _collect(n):
+            if isinstance(n, plan.HashJoin):
+                if n.join_type in ("inner", "left"):
+                    pairs.extend(zip(n.left_keys, n.right_keys))
+                _collect(n.left)
+                _collect(n.right)
+            elif hasattr(n, "child"):
+                _collect(n.child)
+        _collect(node)
+        if not pairs:
+            return group_exprs, []
+
+        def _is_unique(alias, qual_col):
+            """qual_col ("alias.col") is unique within its table:
+            single-column PK, or the SNAPSHOT-AWARE uniqueness probe
+            (TPC-H schemas declare no PKs; o_orderkey is unique by
+            data). The live-rows distinct probe is NOT enough here:
+            an AS OF read could see rows the current generation
+            deleted, merging distinct groups."""
+            t = alias_to_table.get(alias)
+            if t is None:
+                return False
+            sch = self.catalog.schemas.get(t)
+            col = qual_col.split(".", 1)[1]
+            if sch is not None and sch.primary_key == [col]:
+                return True
+            fn = self.catalog.keys_unique_fn
+            if fn is None:
+                return False
+            try:
+                return bool(fn(t, (col,)))
+            except KeyError:
+                return False
+
+        key_cols = {ge.name for _, ge in group_exprs
+                    if isinstance(ge, BCol) and "." in ge.name}
+        kept = []
+        repl = []
+        for gname, ge in group_exprs:
+            dependent = False
+            if isinstance(ge, BCol) and "." in ge.name \
+                    and ge.type.family != Family.STRING:
+                alias = ge.name.split(".", 1)[0]
+                # (a) a sibling group key is a unique key of this table
+                for kc in key_cols:
+                    if kc != ge.name and kc.split(".", 1)[0] == alias \
+                            and _is_unique(alias, kc):
+                        dependent = True
+                        break
+                # (b) a unique key of this table is equi-joined to a
+                # group key outside the table
+                if not dependent:
+                    for a, b in pairs:
+                        mine, other = None, None
+                        if a.split(".", 1)[0] == alias:
+                            mine, other = a, b
+                        elif b.split(".", 1)[0] == alias:
+                            mine, other = b, a
+                        if mine is None or mine == ge.name or \
+                                other.split(".", 1)[0] == alias:
+                            continue
+                        if other in key_cols and _is_unique(alias, mine):
+                            dependent = True
+                            break
+            if dependent:
+                binder.aggs.append(BoundAgg("max", ge, type=ge.type))
+                repl.append((ge, BAggRef(len(binder.aggs) - 1, ge.type)))
+            else:
+                kept.append((gname, ge))
+        if not repl or not kept:
+            return group_exprs, []
+        return kept, repl
 
     def _static_group_bound(self, group_exprs, scope: Scope,
                             tables=None):
@@ -671,14 +805,18 @@ class Planner:
                     return 0, [], []
                 lo, hi, _n = r
                 span = hi - lo + 1
-                if span > self.MAX_INT_GROUP_SPAN:
+                span_cap = (self.MAX_INT_GROUP_SPAN_SINGLE
+                            if len(group_exprs) == 1
+                            else self.MAX_INT_GROUP_SPAN)
+                if span > span_cap:
                     return 0, [], []
                 dims.append(int(span))
                 los.append(int(lo))
             else:
                 return 0, [], []
             bound *= dims[-1] + 1
-            if bound > 1 << 16:
+            if bound > ((1 << 21) + 2 if len(group_exprs) == 1
+                        else 1 << 16):
                 return 0, [], []
         return bound, dims, los
 
@@ -739,35 +877,40 @@ def _default_name(e: ast.Expr) -> str:
 def _replace_group_refs(e: BExpr, group_exprs) -> BExpr:
     """Replace occurrences of a group expression with a ref to the group
     output column (so post-agg projection sees [G]-shaped arrays)."""
-    for gname, gexpr in group_exprs:
-        if repr(e) == repr(gexpr):
-            return BCol(gname, gexpr.type)
+    return _substitute(e, [(gexpr, BCol(gname, gexpr.type))
+                           for gname, gexpr in group_exprs])
+
+
+def _substitute(e: BExpr, pairs) -> BExpr:
+    """Replace repr-equal occurrences of each (expr, replacement)."""
+    for orig, repl in pairs:
+        if repr(e) == repr(orig):
+            return repl
     # recurse
     import copy
     e2 = copy.copy(e)
     from .bound import (BBetween, BCase, BCast, BCoalesce, BDictLookup,
                         BExtract, BInList, BIsNull, BUnary)
     if isinstance(e2, BBin):
-        e2.left = _replace_group_refs(e2.left, group_exprs)
-        e2.right = _replace_group_refs(e2.right, group_exprs)
+        e2.left = _substitute(e2.left, pairs)
+        e2.right = _substitute(e2.right, pairs)
     elif isinstance(e2, BUnary):
-        e2.operand = _replace_group_refs(e2.operand, group_exprs)
+        e2.operand = _substitute(e2.operand, pairs)
     elif isinstance(e2, BBetween):
-        e2.expr = _replace_group_refs(e2.expr, group_exprs)
-        e2.lo = _replace_group_refs(e2.lo, group_exprs)
-        e2.hi = _replace_group_refs(e2.hi, group_exprs)
+        e2.expr = _substitute(e2.expr, pairs)
+        e2.lo = _substitute(e2.lo, pairs)
+        e2.hi = _substitute(e2.hi, pairs)
     elif isinstance(e2, (BInList, BIsNull, BCast, BDictLookup, BDictRemap)):
-        e2.expr = _replace_group_refs(e2.expr, group_exprs)
+        e2.expr = _substitute(e2.expr, pairs)
     elif isinstance(e2, BExtract):
-        e2.expr = _replace_group_refs(e2.expr, group_exprs)
+        e2.expr = _substitute(e2.expr, pairs)
     elif isinstance(e2, BCase):
-        e2.whens = [(_replace_group_refs(c, group_exprs),
-                     _replace_group_refs(v, group_exprs))
+        e2.whens = [(_substitute(c, pairs), _substitute(v, pairs))
                     for c, v in e2.whens]
         if e2.else_ is not None:
-            e2.else_ = _replace_group_refs(e2.else_, group_exprs)
+            e2.else_ = _substitute(e2.else_, pairs)
     elif isinstance(e2, BCoalesce):
-        e2.args = [_replace_group_refs(a, group_exprs) for a in e2.args]
+        e2.args = [_substitute(a, pairs) for a in e2.args]
     return e2
 
 

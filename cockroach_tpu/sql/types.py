@@ -173,6 +173,8 @@ def common_numeric_type(a: SQLType, b: SQLType) -> SQLType:
         return DATE  # date +/- int days
     if Family.TIMESTAMP in fams and Family.INTERVAL in fams:
         return TIMESTAMP
+    if fams == {Family.DATE, Family.TIMESTAMP}:
+        return TIMESTAMP  # date promotes (pg: date is midnight ts)
     if len(fams) == 1:
         return a
     raise TypeError(f"incompatible types {a} and {b}")
@@ -192,6 +194,9 @@ class ColumnSchema:
     # stable catalog column id (ColumnDescriptor.col_id); 0 = unknown
     # (schemas built outside the catalog). Tags value-side KV payloads.
     cid: int = 0
+    # DEFAULT: physical constant, or {"__seq__": name} for
+    # DEFAULT nextval('name') (evaluated per inserted row)
+    default: object = None
 
 
 @dataclass

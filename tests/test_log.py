@@ -1,0 +1,131 @@
+"""Structured logging plane (pkg/util/log analogue): channels,
+severities, sinks, redaction markers, structured events, and the
+call sites wired into the engine."""
+
+import json
+import os
+
+import pytest
+
+from cockroach_tpu.utils import log
+
+
+class TestRedaction:
+    def test_args_are_wrapped_and_redactable(self):
+        with log.scope() as mem:
+            log.info(log.DEV, "user %s did %s", "alice", "a thing")
+        [e] = mem.entries
+        assert "‹alice›" in e.msg
+        assert log.redact(e.msg) == "user ××× did ×××"
+        assert log.strip_markers(e.msg) == "user alice did a thing"
+
+    def test_literal_text_survives_redaction(self):
+        assert log.redact("plain message") == "plain message"
+        assert log.redact("a ‹secret› b ‹two› c") == "a ××× b ××× c"
+
+    def test_redacted_sink_renders_masked(self):
+        with log.scope(log.MemorySink(redacted=True)) as mem:
+            log.info(log.DEV, "key=%s", "hunter2")
+        assert mem.lines()[0].endswith("key=×××")
+        assert "hunter2" not in mem.lines()[0]
+
+
+class TestSinks:
+    def test_severity_threshold(self):
+        with log.scope(log.MemorySink(threshold=log.WARNING)) as mem:
+            log.info(log.DEV, "quiet")
+            log.warning(log.DEV, "loud")
+            log.error(log.DEV, "louder")
+        assert [e.severity for e in mem.entries] == ["W", "E"]
+
+    def test_channel_filter(self):
+        with log.scope(log.MemorySink(channels={log.OPS})) as mem:
+            log.info(log.DEV, "dev")
+            log.info(log.OPS, "ops")
+        assert [e.channel for e in mem.entries] == ["OPS"]
+
+    def test_multiple_sinks_fan_out(self):
+        a = log.MemorySink(channels={log.OPS})
+        b = log.MemorySink()
+        with log.scope(a, b):
+            log.info(log.OPS, "x")
+            log.info(log.DEV, "y")
+        assert len(a.entries) == 1 and len(b.entries) == 2
+
+    def test_file_sink_json(self, tmp_path):
+        p = os.path.join(tmp_path, "logs", "node.log")
+        s = log.FileSink(p, format="json", redacted=True)
+        with log.scope(s):
+            log.info(log.HEALTH, "heartbeat from %s", "n1")
+        s.close()
+        [line] = open(p).read().splitlines()
+        obj = json.loads(line)
+        assert obj["channel"] == "HEALTH"
+        assert obj["message"] == "heartbeat from ×××"
+
+    def test_file_sink_crdb_format(self, tmp_path):
+        p = os.path.join(tmp_path, "node.log")
+        s = log.FileSink(p)
+        with log.scope(s):
+            log.warning(log.STORAGE, "compaction lagging")
+        s.close()
+        line = open(p).read().strip()
+        assert line.startswith("W") and "[STORAGE]" in line
+
+
+class TestStructuredEvents:
+    def test_event_payload(self):
+        with log.scope() as mem:
+            log.structured(log.OPS, "node_start", node_id=3,
+                           sql_addr="localhost:5432")
+        [e] = mem.entries
+        assert e.event["type"] == "node_start"
+        assert e.event["node_id"] == 3
+        line = e.render(redacted=False)
+        assert "node_start" in line and "localhost:5432" in line
+        masked = e.render(redacted=True)
+        assert "localhost:5432" not in masked
+
+    def test_fatal_raises(self):
+        with log.scope():
+            with pytest.raises(SystemExit):
+                log.fatal(log.OPS, "disk gone")
+
+
+class TestCallSites:
+    def test_create_table_emits_schema_event(self):
+        from cockroach_tpu.exec.engine import Engine
+        e = Engine()
+        with log.scope() as mem:
+            e.execute("CREATE TABLE logged (k INT PRIMARY KEY)")
+        evs = [x for x in mem.entries
+               if x.event and x.event["type"] == "create_table"]
+        assert len(evs) == 1
+        assert evs[0].channel == log.SQL_SCHEMA
+
+    def test_job_run_emits_event(self):
+        from cockroach_tpu.exec.engine import Engine
+        eng = Engine()
+        reg = eng.jobs
+
+        class NopResumer:
+            def resume(self, ctx):
+                pass
+        reg.register("nop", NopResumer)
+        job_id = reg.create("nop", {})
+        with log.scope() as mem:
+            reg.run_job(job_id)
+        evs = [x for x in mem.entries
+               if x.event and x.event["type"] == "job_run"]
+        assert evs and evs[0].channel == log.JOBS
+
+    def test_range_split_emits_storage_event(self):
+        from cockroach_tpu.kvserver.cluster import Cluster
+        c = Cluster(n_nodes=3)
+        c.create_range(b"\x00", b"\xff")
+        c.pump_until(lambda: c.leaseholder(1) is not None)
+        with log.scope() as mem:
+            c.split_range(b"m")
+        evs = [x for x in mem.entries
+               if x.event and x.event["type"] == "range_split"]
+        assert evs and evs[0].channel == log.STORAGE

@@ -20,7 +20,12 @@ def _mk(n_rows: int, n_keys: int, distsql="off") -> tuple:
     eng = Engine()
     eng.execute("CREATE TABLE sp (k INT8 NOT NULL, v INT8, s STRING)")
     rng = np.random.default_rng(3)
-    k = rng.integers(0, n_keys, size=n_rows).astype(np.int64)
+    # scatter keys over a ~10^12 range: the stats-proven dense
+    # segment-sum path (planner MAX_INT_GROUP_SPAN_SINGLE) must NOT
+    # apply, or these tests would never reach the hash/spill strategy
+    # they exist to exercise
+    k = rng.integers(0, n_keys, size=n_rows).astype(np.int64) \
+        * 1_000_003 + 7
     v = rng.integers(-100, 100, size=n_rows).astype(np.int64)
     s = np.array(["aa", "bb", "cc"], dtype=object)[k % 3]
     eng.store.insert_columns("sp", {"k": k, "v": v, "s": s},

@@ -162,6 +162,29 @@ class TestSuiteBreadth:
         assert got == pytest.approx(tpch.ref_q19(d["li"], d["part"]),
                                     abs=1e-3)
 
+    def test_q17(self, suite_eng, suite_data):
+        """Correlated scalar avg subquery, decorrelated to a grouped
+        LEFT JOIN (sql/decorrelate.py decorrelate_scalar)."""
+        d = suite_data
+        got = suite_eng.execute(tpch.Q17).rows[0][0]
+        want = tpch.ref_q17(d["li"], d["part"])
+        if want == 0.0:
+            assert got is None or got == pytest.approx(0.0)
+        else:
+            assert float(got) == pytest.approx(want, rel=1e-6)
+
+    def test_q22(self, suite_eng, suite_data):
+        """Uncorrelated scalar avg + NOT EXISTS anti-join over
+        substring country codes."""
+        d = suite_data
+        got = [(str(a), b, float(c)) for a, b, c in
+               suite_eng.execute(tpch.Q22).rows]
+        want = tpch.ref_q22(d["cust"], d["orders"])
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and g[1] == w[1]
+            assert g[2] == pytest.approx(w[2], abs=1e-2)
+
     def test_q21(self, suite_eng, suite_data):
         """Correlated EXISTS + NOT EXISTS with a <> correlation,
         decorrelated to grouped LEFT JOINs (sql/decorrelate.py)."""
