@@ -94,11 +94,12 @@ def run(rows_by_query, pipeline, repeats, tag=""):
     for rows, queries in by_rows.items():
         eng = Engine()
         t0 = time.time()
-        suite = {"q3", "q5", "q9", "q12", "q17", "q18", "q19", "q21",
-                 "q22"}
+        suite = {"q2", "q3", "q4", "q5", "q7", "q8", "q9", "q10",
+                 "q11", "q12", "q13", "q15", "q16", "q18", "q20",
+                 "q21", "q22"}
         if suite & set(queries):
             tables = tpch.ALL_TABLES
-        elif "q14" in queries:
+        elif {"q14", "q17", "q19"} & set(queries):
             tables = ("lineitem", "part")
         else:
             tables = ("lineitem",)

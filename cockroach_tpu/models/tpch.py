@@ -607,9 +607,193 @@ GROUP BY cntrycode
 ORDER BY cntrycode
 """
 
-QUERIES = {"q1": Q1, "q3": Q3, "q5": Q5, "q6": Q6, "q9": Q9,
-           "q12": Q12, "q14": Q14, "q17": Q17, "q18": Q18, "q19": Q19,
-           "q21": Q21, "q22": Q22}
+# Q2 (minimum-cost supplier): the correlated min over a four-table
+# subquery decorrelates into a grouped LEFT JOIN whose derived table
+# carries the joins (decorrelate_scalar's multi-table shape); the
+# outer five-table graph reorders around the pinned left join
+Q2 = """
+SELECT s_acctbal, s_name, n_name, p_partkey, p_mfgr
+FROM part, supplier, partsupp, nation, region
+WHERE p_partkey = ps_partkey
+  AND s_suppkey = ps_suppkey
+  AND p_size = 15
+  AND p_type LIKE '%BRASS'
+  AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey
+  AND r_name = 'EUROPE'
+  AND ps_supplycost = (
+      SELECT min(ps2.ps_supplycost)
+      FROM partsupp AS ps2, supplier AS s2, nation AS n2, region AS r2
+      WHERE ps2.ps_partkey = p_partkey
+        AND s2.s_suppkey = ps2.ps_suppkey
+        AND s2.s_nationkey = n2.n_nationkey
+        AND n2.n_regionkey = r2.r_regionkey
+        AND r2.r_name = 'EUROPE')
+ORDER BY s_acctbal DESC, n_name, s_name, p_partkey
+LIMIT 100
+"""
+
+# Q4 (order priority checking): EXISTS semi-join
+Q4 = """
+SELECT o_orderpriority, count(*) AS order_count
+FROM orders
+WHERE o_orderdate >= date '1993-07-01'
+  AND o_orderdate < date '1993-10-01'
+  AND EXISTS (
+      SELECT * FROM lineitem
+      WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority
+"""
+
+# Q7 (volume shipping): six-table join + year extraction + the
+# symmetric two-nation OR predicate
+Q7 = """
+SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+FROM (
+  SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+         extract(year FROM l_shipdate) AS l_year,
+         l_extendedprice * (1 - l_discount) AS volume
+  FROM supplier, lineitem, orders, customer, nation AS n1, nation AS n2
+  WHERE s_suppkey = l_suppkey
+    AND o_orderkey = l_orderkey
+    AND c_custkey = o_custkey
+    AND s_nationkey = n1.n_nationkey
+    AND c_nationkey = n2.n_nationkey
+    AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+      OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+    AND l_shipdate BETWEEN date '1995-01-01' AND date '1996-12-31'
+) AS shipping
+GROUP BY supp_nation, cust_nation, l_year
+ORDER BY supp_nation, cust_nation, l_year
+"""
+
+# Q8 (national market share): eight tables, conditional share ratio
+Q8 = """
+SELECT o_year,
+       sum(CASE WHEN nation = 'BRAZIL' THEN volume ELSE 0 END)
+           / sum(volume) AS mkt_share
+FROM (
+  SELECT extract(year FROM o_orderdate) AS o_year,
+         l_extendedprice * (1 - l_discount) AS volume,
+         n2.n_name AS nation
+  FROM part, supplier, lineitem, orders, customer,
+       nation AS n1, nation AS n2, region
+  WHERE p_partkey = l_partkey
+    AND s_suppkey = l_suppkey
+    AND l_orderkey = o_orderkey
+    AND o_custkey = c_custkey
+    AND c_nationkey = n1.n_nationkey
+    AND n1.n_regionkey = r_regionkey
+    AND r_name = 'AMERICA'
+    AND s_nationkey = n2.n_nationkey
+    AND o_orderdate BETWEEN date '1995-01-01' AND date '1996-12-31'
+    AND p_type = 'ECONOMY ANODIZED STEEL'
+) AS all_nations
+GROUP BY o_year
+ORDER BY o_year
+"""
+
+# Q10 (returned-item reporting)
+Q10 = """
+SELECT c_custkey, c_name,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       c_acctbal, n_name
+FROM customer, orders, lineitem, nation
+WHERE c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate >= date '1993-10-01'
+  AND o_orderdate < date '1994-01-01'
+  AND l_returnflag = 'R'
+  AND c_nationkey = n_nationkey
+GROUP BY c_custkey, c_name, c_acctbal, n_name
+ORDER BY revenue DESC
+LIMIT 20
+"""
+
+# Q11 (important stock): grouped HAVING against an uncorrelated
+# scalar threshold over the same join
+Q11 = """
+SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS value
+FROM partsupp, supplier, nation
+WHERE ps_suppkey = s_suppkey
+  AND s_nationkey = n_nationkey
+  AND n_name = 'GERMANY'
+GROUP BY ps_partkey
+HAVING sum(ps_supplycost * ps_availqty) > (
+    SELECT sum(ps_supplycost * ps_availqty) * 0.0001
+    FROM partsupp, supplier, nation
+    WHERE ps_suppkey = s_suppkey
+      AND s_nationkey = n_nationkey
+      AND n_name = 'GERMANY')
+ORDER BY value DESC
+"""
+
+# Q13 (customer distribution): LEFT JOIN + two-level grouping
+Q13 = """
+SELECT c_count, count(*) AS custdist
+FROM (
+  SELECT c_custkey, count(o_orderkey) AS c_count
+  FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+  GROUP BY c_custkey
+) AS c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC
+"""
+
+# Q15 (top supplier): CTE revenue view + uncorrelated max
+Q15 = """
+WITH revenue AS (
+  SELECT l_suppkey AS supplier_no,
+         sum(l_extendedprice * (1 - l_discount)) AS total_revenue
+  FROM lineitem
+  WHERE l_shipdate >= date '1996-01-01'
+    AND l_shipdate < date '1996-04-01'
+  GROUP BY l_suppkey)
+SELECT s_suppkey, s_name, total_revenue
+FROM supplier, revenue
+WHERE s_suppkey = supplier_no
+  AND total_revenue = (SELECT max(total_revenue) FROM revenue)
+ORDER BY s_suppkey
+"""
+
+# Q16 (parts/supplier relationship): NOT IN subquery + count distinct
+Q16 = """
+SELECT p_brand, p_type, p_size, count(DISTINCT ps_suppkey) AS supplier_cnt
+FROM partsupp, part
+WHERE p_partkey = ps_partkey
+  AND p_brand <> 'Brand#45'
+  AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+  AND ps_suppkey NOT IN (
+      SELECT s_suppkey FROM supplier WHERE s_acctbal < 0)
+GROUP BY p_brand, p_type, p_size
+ORDER BY supplier_cnt DESC, p_brand, p_type, p_size
+"""
+
+# Q20 (potential part promotion): nested IN subqueries + a
+# two-key-correlated scalar half-sum threshold
+Q20 = """
+SELECT s_name
+FROM supplier, nation
+WHERE s_suppkey IN (
+    SELECT ps_suppkey FROM partsupp
+    WHERE ps_partkey IN (
+        SELECT p_partkey FROM part WHERE p_name LIKE 'forest%')
+      AND ps_availqty > (
+          SELECT 0.5 * sum(l_quantity) FROM lineitem
+          WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+            AND l_shipdate >= date '1994-01-01'
+            AND l_shipdate < date '1995-01-01'))
+  AND s_nationkey = n_nationkey
+  AND n_name = 'CANADA'
+ORDER BY s_name
+"""
+
+QUERIES = {"q1": Q1, "q2": Q2, "q3": Q3, "q4": Q4, "q5": Q5, "q6": Q6,
+           "q7": Q7, "q8": Q8, "q9": Q9, "q10": Q10, "q11": Q11,
+           "q12": Q12, "q13": Q13, "q14": Q14, "q15": Q15, "q16": Q16,
+           "q17": Q17, "q18": Q18, "q19": Q19, "q20": Q20, "q21": Q21,
+           "q22": Q22}
 
 
 # ---------------------------------------------------------------------------
@@ -821,6 +1005,236 @@ def ref_q22(cust, orders) -> list[tuple]:
         n, s = out.get(c, (0, 0.0))
         out[c] = (n + 1, s + float(b))
     return sorted((c, n, round(s, 2)) for c, (n, s) in out.items())
+
+
+def ref_q2(part, supp, ps, nation, region) -> list[tuple]:
+    eur = region["r_regionkey"][region["r_name"] == "EUROPE"][0]
+    nat_eur = set(nation["n_nationkey"][
+        nation["n_regionkey"] == eur].tolist())
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    # min EUROPE supplycost per part
+    min_cost: dict = {}
+    for pk, sk, cost in zip(ps["ps_partkey"].tolist(),
+                            ps["ps_suppkey"].tolist(),
+                            ps["ps_supplycost"].tolist()):
+        if s_nat[sk] in nat_eur:
+            if pk not in min_cost or cost < min_cost[pk]:
+                min_cost[pk] = cost
+    pm = (part["p_size"] == 15) & np.array(
+        [t.endswith("BRASS") for t in part["p_type"]])
+    psel = set(part["p_partkey"][pm].tolist())
+    p_mfgr = dict(zip(part["p_partkey"].tolist(),
+                      part["p_mfgr"].tolist()))
+    s_name = dict(zip(supp["s_suppkey"].tolist(),
+                      supp["s_name"].tolist()))
+    s_bal = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_acctbal"].tolist()))
+    out = []
+    for pk, sk, cost in zip(ps["ps_partkey"].tolist(),
+                            ps["ps_suppkey"].tolist(),
+                            ps["ps_supplycost"].tolist()):
+        if pk in psel and s_nat[sk] in nat_eur \
+                and cost == min_cost.get(pk):
+            out.append((round(s_bal[sk], 2), s_name[sk],
+                        NATIONS[s_nat[sk]], pk, p_mfgr[pk]))
+    out.sort(key=lambda t: (-t[0], t[2], t[1], t[3]))
+    return out[:100]
+
+
+def ref_q4(li, orders) -> list[tuple]:
+    d0, d1 = _days("1993-07-01"), _days("1993-10-01")
+    late = set(li["l_orderkey"][
+        li["l_commitdate"] < li["l_receiptdate"]].tolist())
+    m = (orders["o_orderdate"] >= d0) & (orders["o_orderdate"] < d1)
+    out: dict = {}
+    for ok, pri in zip(orders["o_orderkey"][m].tolist(),
+                       orders["o_orderpriority"][m]):
+        if ok in late:
+            out[pri] = out.get(pri, 0) + 1
+    return sorted(out.items())
+
+
+def ref_q7(li, orders, cust, supp, nation) -> list[tuple]:
+    d0, d1 = _days("1995-01-01"), _days("1996-12-31")
+    n_name = dict(zip(nation["n_nationkey"].tolist(),
+                      nation["n_name"]))
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    c_nat = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_nationkey"].tolist()))
+    o_cust = dict(zip(orders["o_orderkey"].tolist(),
+                      orders["o_custkey"].tolist()))
+    out: dict = {}
+    m = (li["l_shipdate"] >= d0) & (li["l_shipdate"] <= d1)
+    for i in np.nonzero(m)[0]:
+        sn = n_name[s_nat[int(li["l_suppkey"][i])]]
+        cn = n_name[c_nat[o_cust[int(li["l_orderkey"][i])]]]
+        if {sn, cn} != {"FRANCE", "GERMANY"}:
+            continue
+        yr = (EPOCH + datetime.timedelta(
+            days=int(li["l_shipdate"][i]))).year
+        vol = float(li["l_extendedprice"][i]) * \
+            (1 - float(li["l_discount"][i]))
+        k = (sn, cn, yr)
+        out[k] = out.get(k, 0.0) + vol
+    return sorted((k + (v,) for k, v in out.items()))
+
+
+def ref_q8(li, orders, cust, supp, part, nation, region) -> list[tuple]:
+    d0, d1 = _days("1995-01-01"), _days("1996-12-31")
+    amer = region["r_regionkey"][region["r_name"] == "AMERICA"][0]
+    nat_amer = set(nation["n_nationkey"][
+        nation["n_regionkey"] == amer].tolist())
+    p_sel = set(part["p_partkey"][
+        part["p_type"] == "ECONOMY ANODIZED STEEL"].tolist())
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    c_nat = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_nationkey"].tolist()))
+    o_cust = dict(zip(orders["o_orderkey"].tolist(),
+                      orders["o_custkey"].tolist()))
+    o_date = dict(zip(orders["o_orderkey"].tolist(),
+                      orders["o_orderdate"].tolist()))
+    num: dict = {}
+    den: dict = {}
+    for i in range(len(li["l_orderkey"])):
+        pk = int(li["l_partkey"][i])
+        if pk not in p_sel:
+            continue
+        ok = int(li["l_orderkey"][i])
+        od = o_date[ok]
+        if not (d0 <= od <= d1):
+            continue
+        if c_nat[o_cust[ok]] not in nat_amer:
+            continue
+        yr = (EPOCH + datetime.timedelta(days=int(od))).year
+        vol = float(li["l_extendedprice"][i]) * \
+            (1 - float(li["l_discount"][i]))
+        den[yr] = den.get(yr, 0.0) + vol
+        if NATIONS[s_nat[int(li["l_suppkey"][i])]] == "BRAZIL":
+            num[yr] = num.get(yr, 0.0) + vol
+    return sorted((yr, num.get(yr, 0.0) / d) for yr, d in den.items())
+
+
+def ref_q10(li, orders, cust, nation) -> list[tuple]:
+    d0, d1 = _days("1993-10-01"), _days("1994-01-01")
+    osel = {ok: ck for ok, ck, od in zip(
+        orders["o_orderkey"].tolist(), orders["o_custkey"].tolist(),
+        orders["o_orderdate"].tolist()) if d0 <= od < d1}
+    rev: dict = {}
+    rf = li["l_returnflag"]
+    for i in np.nonzero(rf == "R")[0]:
+        ok = int(li["l_orderkey"][i])
+        ck = osel.get(ok)
+        if ck is None:
+            continue
+        rev[ck] = rev.get(ck, 0.0) + \
+            float(li["l_extendedprice"][i]) * \
+            (1 - float(li["l_discount"][i]))
+    n_name = dict(zip(nation["n_nationkey"].tolist(), nation["n_name"]))
+    c_name = dict(zip(cust["c_custkey"].tolist(), cust["c_name"]))
+    c_bal = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_acctbal"].tolist()))
+    c_nat = dict(zip(cust["c_custkey"].tolist(),
+                     cust["c_nationkey"].tolist()))
+    rows = [(ck, c_name[ck], r, round(c_bal[ck], 2),
+             n_name[c_nat[ck]]) for ck, r in rev.items()]
+    rows.sort(key=lambda t: -t[2])
+    return rows[:20]
+
+
+def ref_q11(ps, supp, nation) -> list[tuple]:
+    ger = nation["n_nationkey"][nation["n_name"] == "GERMANY"][0]
+    s_sel = set(supp["s_suppkey"][
+        supp["s_nationkey"] == ger].tolist())
+    val: dict = {}
+    total = 0.0
+    for pk, sk, cost, q in zip(ps["ps_partkey"].tolist(),
+                               ps["ps_suppkey"].tolist(),
+                               ps["ps_supplycost"].tolist(),
+                               ps["ps_availqty"].tolist()):
+        if sk in s_sel:
+            v = cost * q
+            val[pk] = val.get(pk, 0.0) + v
+            total += v
+    thr = total * 0.0001
+    rows = [(pk, v) for pk, v in val.items() if v > thr]
+    rows.sort(key=lambda t: -t[1])
+    return rows
+
+
+def ref_q13(orders, cust) -> list[tuple]:
+    cnt: dict = {int(k): 0 for k in cust["c_custkey"]}
+    for ck in orders["o_custkey"].tolist():
+        cnt[ck] += 1
+    dist: dict = {}
+    for c in cnt.values():
+        dist[c] = dist.get(c, 0) + 1
+    return sorted(dist.items(), key=lambda t: (-t[1], -t[0]))
+
+
+def ref_q15(li, supp) -> list[tuple]:
+    d0, d1 = _days("1996-01-01"), _days("1996-04-01")
+    m = (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+    rev: dict = {}
+    for i in np.nonzero(m)[0]:
+        sk = int(li["l_suppkey"][i])
+        rev[sk] = rev.get(sk, 0.0) + \
+            float(li["l_extendedprice"][i]) * \
+            (1 - float(li["l_discount"][i]))
+    if not rev:
+        return []
+    mx = max(rev.values())
+    s_name = dict(zip(supp["s_suppkey"].tolist(), supp["s_name"]))
+    return sorted((sk, s_name[sk], r) for sk, r in rev.items()
+                  if r == mx)
+
+
+def ref_q16(part, ps, supp) -> list[tuple]:
+    bad_supp = set(supp["s_suppkey"][
+        supp["s_acctbal"] < 0].tolist())
+    sizes = {49, 14, 23, 45, 19, 3, 36, 9}
+    pm = (part["p_brand"] != "Brand#45") & np.array(
+        [int(s) in sizes for s in part["p_size"]])
+    pinfo = {int(pk): (b, t, int(sz)) for pk, b, t, sz in zip(
+        part["p_partkey"][pm], part["p_brand"][pm],
+        part["p_type"][pm], part["p_size"][pm])}
+    groups: dict = {}
+    for pk, sk in zip(ps["ps_partkey"].tolist(),
+                      ps["ps_suppkey"].tolist()):
+        info = pinfo.get(pk)
+        if info is None or sk in bad_supp:
+            continue
+        groups.setdefault(info, set()).add(sk)
+    rows = [(b, t, sz, len(s)) for (b, t, sz), s in groups.items()]
+    rows.sort(key=lambda r: (-r[3], r[0], r[1], r[2]))
+    return rows
+
+
+def ref_q20(li, supp, part, ps, nation) -> list[tuple]:
+    can = nation["n_nationkey"][nation["n_name"] == "CANADA"][0]
+    forest = set(part["p_partkey"][np.array(
+        [n.startswith("forest") for n in part["p_name"]])].tolist())
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    m = (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+    qty: dict = {}
+    for i in np.nonzero(m)[0]:
+        k = (int(li["l_partkey"][i]), int(li["l_suppkey"][i]))
+        qty[k] = qty.get(k, 0.0) + float(li["l_quantity"][i])
+    sel_supp = set()
+    for pk, sk, avail in zip(ps["ps_partkey"].tolist(),
+                             ps["ps_suppkey"].tolist(),
+                             ps["ps_availqty"].tolist()):
+        # empty scalar subquery is NULL: avail > NULL never passes
+        if pk in forest and (pk, sk) in qty \
+                and avail > 0.5 * qty[(pk, sk)]:
+            sel_supp.add(sk)
+    s_nat = dict(zip(supp["s_suppkey"].tolist(),
+                     supp["s_nationkey"].tolist()))
+    s_name = dict(zip(supp["s_suppkey"].tolist(), supp["s_name"]))
+    return sorted((s_name[sk],) for sk in sel_supp
+                  if s_nat[sk] == can)
 
 
 def ref_q21(li, orders, supp) -> list[tuple]:

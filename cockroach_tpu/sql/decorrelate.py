@@ -80,15 +80,18 @@ def _refs(e, out):
     return out
 
 
-def _side(e, inner_alias: str, inner_cols: set, outer_aliases: set):
-    """'inner' / 'outer' / None (mixed or unresolvable)."""
+def _side(e, inner_aliases, inner_cols: set, outer_aliases: set):
+    """'inner' / 'outer' / None (mixed or unresolvable).
+    inner_aliases: a str (one table) or a set of aliases."""
+    if isinstance(inner_aliases, str):
+        inner_aliases = {inner_aliases}
     refs = _refs(e, [])
     if any(r is None for r in refs):
         return None
     sides = set()
     for r in refs:
-        if r.table == inner_alias or (r.table is None
-                                      and r.name in inner_cols):
+        if r.table in inner_aliases or (r.table is None
+                                        and r.name in inner_cols):
             sides.add("inner")
         elif r.table in outer_aliases or r.table is None:
             sides.add("outer")
@@ -209,9 +212,12 @@ def decorrelate_scalar(sel: ast.Select, columns_of) -> ast.Select:
 
 def _rewrite_scalar(sub: ast.Select, outer_aliases: set, columns_of):
     """One correlated scalar subquery -> (JoinClause, replacement
-    expr), or None."""
+    expr), or None. The subquery may itself join several tables
+    (TPC-H q2's min-supplycost over partsupp x supplier x nation x
+    region) as long as every join is inner/comma with inner-only ON
+    conditions — the whole inner FROM moves into the derived table."""
     if sub is None or sub.table is None or \
-            sub.table.subquery is not None or sub.joins or \
+            sub.table.subquery is not None or \
             sub.group_by or sub.having or sub.ctes or sub.distinct or \
             sub.limit is not None or sub.where is None or \
             len(sub.items) != 1:
@@ -219,21 +225,38 @@ def _rewrite_scalar(sub: ast.Select, outer_aliases: set, columns_of):
     kind = _agg_only(sub.items[0].expr)
     if kind is None:
         return None
-    inner_alias = sub.table.alias or sub.table.name
+    inner_aliases = {sub.table.alias or sub.table.name}
     inner_cols = columns_of(sub.table.name)
-    if inner_cols is None or inner_alias in outer_aliases:
+    if inner_cols is None:
         return None
+    inner_cols = set(inner_cols)
+    for j in sub.joins:
+        if j.join_type not in ("inner", "cross") or \
+                j.table.subquery is not None:
+            return None
+        cols = columns_of(j.table.name)
+        if cols is None:
+            return None
+        inner_aliases.add(j.table.alias or j.table.name)
+        inner_cols |= cols
+    if inner_aliases & outer_aliases:
+        return None
+    for j in sub.joins:
+        if j.on is not None and _side(j.on, inner_aliases, inner_cols,
+                                      outer_aliases) != "inner":
+            return None
 
     eq_corr = []
     residual = []
     for p in _conjuncts(sub.where):
-        s = _side(p, inner_alias, inner_cols, outer_aliases)
+        s = _side(p, inner_aliases, inner_cols, outer_aliases)
         if s == "inner":
             residual.append(p)
             continue
         if isinstance(p, ast.BinOp) and p.op == "=":
-            ls = _side(p.left, inner_alias, inner_cols, outer_aliases)
-            rs = _side(p.right, inner_alias, inner_cols, outer_aliases)
+            ls = _side(p.left, inner_aliases, inner_cols, outer_aliases)
+            rs = _side(p.right, inner_aliases, inner_cols,
+                       outer_aliases)
             pair = None
             if ls == "inner" and rs == "outer" and \
                     isinstance(p.left, ast.ColumnRef):
@@ -253,7 +276,7 @@ def _rewrite_scalar(sub: ast.Select, outer_aliases: set, columns_of):
     group_by = []
     on_parts = []
     for i, (icol, oexpr) in enumerate(eq_corr):
-        inner = ast.ColumnRef(icol.name, inner_alias)
+        inner = ast.ColumnRef(icol.name, icol.table)
         items.append(ast.SelectItem(inner, alias=f"__k{i}"))
         group_by.append(inner)
         on_parts.append(ast.BinOp("=", ast.ColumnRef(f"__k{i}", dn),
@@ -261,7 +284,8 @@ def _rewrite_scalar(sub: ast.Select, outer_aliases: set, columns_of):
     items.append(ast.SelectItem(sub.items[0].expr, alias="__v"))
     derived = ast.Select(
         items=items,
-        table=ast.TableRef(sub.table.name, alias=inner_alias),
+        table=sub.table,
+        joins=list(sub.joins),
         where=_and_all(residual),
         group_by=group_by)
     join = ast.JoinClause(

@@ -366,6 +366,54 @@ class Planner:
         def _rc(alias: str) -> float:
             return self.catalog.row_count(alias_table[alias])
 
+        # LEFT JOINs whose ON references only the inner tables (the
+        # decorrelated __exists/__sc derived joins, and plain
+        # fact LEFT dim) pin to the TAIL, freeing the inner prefix
+        # for cost-based reordering — without this, one decorrelated
+        # subquery would force the whole FROM list into syntax order
+        # (q2's five-table outer join graph is unorderable that way)
+        pinned_lefts = []
+        if ordered and not all(jt in ("inner", "cross")
+                               for _, jt, _ in ordered):
+            inners = [e for e in ordered if e[1] in ("inner", "cross")]
+            lefts = [e for e in ordered if e[1] == "left"]
+            if len(inners) + len(lefts) == len(ordered) and lefts:
+                inner_aliases = {tables[0][0]} | {e[0] for e in inners}
+                left_aliases = {e[0] for e in lefts}
+                ok = True
+                for la, _, lon in lefts:
+                    for c in lon:
+                        if not tables_of(c) <= inner_aliases | {la}:
+                            ok = False  # left ON sees another left
+                for _, _, oc in inners:
+                    for c in oc:
+                        if tables_of(c) & left_aliases:
+                            ok = False  # inner keyed on a left output
+                if ok:
+                    # every inner must stay equi-reachable WITHOUT the
+                    # left aliases: a WHERE key routed through a left
+                    # table (FROM a LEFT b, c WHERE c.x = b.y) would
+                    # otherwise strand the inner once lefts move to
+                    # the tail
+                    pool_noleft = [
+                        c for c in conjuncts
+                        if not (tables_of(c) & left_aliases)]
+                    for _, _, oc in inners:
+                        pool_noleft += oc
+                    sim = {tables[0][0]}
+                    rem = [e[0] for e in inners]
+                    while rem and ok:
+                        nxt = next((a for a in rem if _has_equi_keys(
+                            pool_noleft, sim, a)), None)
+                        if nxt is None:
+                            ok = False
+                        else:
+                            sim.add(nxt)
+                            rem.remove(nxt)
+                if ok:
+                    pinned_lefts = lefts
+                    ordered = inners
+
         # Join ordering. Preferred: the memoized cost-based search
         # (sql/memo.py — the compact analogue of opt/xform's
         # exploration + costing), which chooses BOTH the probe root
@@ -432,6 +480,7 @@ class Planner:
                     ordered[0] = (root, first_jt, first_on)
                     probe_root = first_alias
 
+        ordered = ordered + pinned_lefts
         for alias, jt, on_conj in ordered:
             # LEFT JOIN must not consume WHERE conjuncts as join keys —
             # ON and WHERE have different outer-join semantics

@@ -98,3 +98,44 @@ class TestPreparedRefresh:
         assert p.run().rows == [(1.0,)]
         e.execute("INSERT INTO pr VALUES (3, 4.00)")
         assert p.run().rows == [(5.0,)]
+
+
+def test_inner_table_keyed_through_left_join_output():
+    """Round-3 review: pinning LEFT JOINs to the tail for join
+    reordering must not strand an inner table whose only equality
+    link runs through the left-joined table's columns."""
+    e = Engine()
+    e.execute("CREATE TABLE p (pk INT PRIMARY KEY)")
+    e.execute("CREATE TABLE l (lk INT PRIMARY KEY, pk INT, ok INT)")
+    e.execute("CREATE TABLE o (ok INT PRIMARY KEY)")
+    e.execute("INSERT INTO p VALUES (1), (2)")
+    e.execute("INSERT INTO l VALUES (10, 1, 100), (11, 2, 101)")
+    e.execute("INSERT INTO o VALUES (100), (101)")
+    r = e.execute("SELECT count(*) FROM p LEFT JOIN l ON l.pk = p.pk, o "
+                  "WHERE o.ok = l.ok")
+    assert r.rows == [(2,)]
+
+
+def test_decorrelated_scalar_with_joined_subquery():
+    """Round 3: a correlated scalar over a joined inner FROM
+    decorrelates (q2's min-supplycost shape) and the outer join graph
+    reorders around the pinned derived LEFT JOIN."""
+    e = Engine()
+    e.execute("CREATE TABLE item (ik INT PRIMARY KEY, grp INT)")
+    e.execute("CREATE TABLE offer (ofk INT PRIMARY KEY, ik INT, "
+              "vendor INT, price INT)")
+    e.execute("CREATE TABLE vend (vk INT PRIMARY KEY, ok BOOL)")
+    e.execute("INSERT INTO item VALUES (1, 7), (2, 7)")
+    e.execute("INSERT INTO vend VALUES (1, true), (2, false)")
+    e.execute("INSERT INTO offer VALUES (10, 1, 1, 50), (11, 1, 2, 10),"
+              " (12, 2, 1, 30), (13, 2, 1, 40)")
+    # min price among OK vendors, correlated on item key
+    r = e.execute(
+        "SELECT o.ofk FROM item, offer AS o, vend "
+        "WHERE o.ik = item.ik AND vend.vk = o.vendor AND vend.ok "
+        "AND o.price = (SELECT min(o2.price) FROM offer AS o2, "
+        "vend AS v2 WHERE o2.ik = item.ik AND v2.vk = o2.vendor "
+        "AND v2.ok) ORDER BY o.ofk")
+    # item 1: ok-vendor offers {10:50} -> min 50 -> ofk 10
+    # item 2: {12:30, 13:40} -> min 30 -> ofk 12
+    assert r.rows == [(10,), (12,)]

@@ -162,6 +162,95 @@ class TestSuiteBreadth:
         assert got == pytest.approx(tpch.ref_q19(d["li"], d["part"]),
                                     abs=1e-3)
 
+    def test_q2(self, suite_eng, suite_data):
+        """Correlated multi-table min subquery (decorrelate_scalar's
+        joined-inner shape) + left-pinned join reordering."""
+        d = suite_data
+        got = suite_eng.execute(tpch.Q2).rows
+        want = tpch.ref_q2(d["part"], d["supp"], d["ps"],
+                           d["nation"], tpch.gen_region())
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert float(g[0]) == pytest.approx(w[0], abs=1e-2)
+            assert (str(g[1]), str(g[2]), g[3], str(g[4])) == \
+                (w[1], w[2], w[3], w[4])
+
+    def test_q4(self, suite_eng, suite_data):
+        d = suite_data
+        got = [(str(a), b) for a, b in
+               suite_eng.execute(tpch.Q4).rows]
+        want = tpch.ref_q4(d["li"], d["orders"])
+        assert got == [(a, b) for a, b in want] and len(got) > 0
+
+    def test_q7(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q7).rows
+        want = tpch.ref_q7(d["li"], d["orders"], d["cust"],
+                           d["supp"], d["nation"])
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert (str(g[0]), str(g[1]), g[2]) == (w[0], w[1], w[2])
+            assert float(g[3]) == pytest.approx(w[3], rel=1e-6)
+
+    def test_q8(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q8).rows
+        want = tpch.ref_q8(d["li"], d["orders"], d["cust"], d["supp"],
+                           d["part"], d["nation"], tpch.gen_region())
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0]
+            assert float(g[1]) == pytest.approx(w[1], abs=1e-9)
+
+    def test_q10(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q10).rows
+        want = tpch.ref_q10(d["li"], d["orders"], d["cust"],
+                            d["nation"])
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and str(g[1]) == w[1]
+            assert float(g[2]) == pytest.approx(w[2], rel=1e-6)
+            assert str(g[4]) == w[4]
+
+    def test_q11(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q11).rows
+        want = tpch.ref_q11(d["ps"], d["supp"], d["nation"])
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0]
+            assert float(g[1]) == pytest.approx(w[1], rel=1e-6)
+
+    def test_q13(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q13).rows
+        want = tpch.ref_q13(d["orders"], d["cust"])
+        assert [(a, b) for a, b in got] == want and len(got) > 0
+
+    def test_q15(self, suite_eng, suite_data):
+        d = suite_data
+        got = suite_eng.execute(tpch.Q15).rows
+        want = tpch.ref_q15(d["li"], d["supp"])
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and str(g[1]) == w[1]
+            assert float(g[2]) == pytest.approx(w[2], rel=1e-6)
+
+    def test_q16(self, suite_eng, suite_data):
+        d = suite_data
+        got = [(str(a), str(b), c, n) for a, b, c, n in
+               suite_eng.execute(tpch.Q16).rows]
+        want = tpch.ref_q16(d["part"], d["ps"], d["supp"])
+        assert got == want and len(got) > 0
+
+    def test_q20(self, suite_eng, suite_data):
+        d = suite_data
+        got = [(str(a),) for (a,) in suite_eng.execute(tpch.Q20).rows]
+        want = tpch.ref_q20(d["li"], d["supp"], d["part"], d["ps"],
+                            d["nation"])
+        assert got == want and len(got) > 0
+
     def test_q17(self, suite_eng, suite_data):
         """Correlated scalar avg subquery, decorrelated to a grouped
         LEFT JOIN (sql/decorrelate.py decorrelate_scalar)."""
