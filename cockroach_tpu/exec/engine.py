@@ -825,7 +825,12 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         is a temp scan-plane table the main program reads like any
         other."""
         import copy
-        sel = copy.copy(sel)
+        # DEEP copy: the rewrites below assign into nested JoinClause/
+        # TableRef objects; a shallow copy would corrupt the caller's
+        # AST, which prepared statements re-execute (decorrelate's
+        # deepcopy used to mask this, but it now skips subquery-free
+        # statements)
+        sel = copy.deepcopy(sel)
         temps: list[str] = []
         mapping: dict[str, str] = {}
         try:

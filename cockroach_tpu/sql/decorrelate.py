@@ -189,6 +189,16 @@ def decorrelate_scalar(sel: ast.Select, columns_of) -> ast.Select:
     if not outer_aliases:
         return sel
 
+    # the deepcopy below is ~25% of a point-lookup's latency; skip it
+    # (and the walks) when no scalar subquery exists at all
+    found = []
+    for item in sel.items:
+        _walk_subqueries(item, lambda s, _set: found.append(s))
+    if sel.where is not None:
+        _walk_subqueries(sel.where, lambda s, _set: found.append(s))
+    if not found:
+        return sel
+
     sel = copy.deepcopy(sel)
     new_joins = []
 

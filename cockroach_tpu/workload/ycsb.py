@@ -22,19 +22,35 @@ MIXES = {
 }
 
 
-class _Zipf:
+class _CdfSampler:
+    """Batched inverse-CDF sampler: rng.choice(p=...) rebuilds the
+    distribution per draw (O(n)); searchsorted over a buffered
+    uniform block is ~100x cheaper and was 14% of measured YCSB-E op
+    latency."""
+
+    def __init__(self, weights, rng, batch: int = 4096):
+        self.rng = rng
+        w = np.asarray(weights, dtype=np.float64)
+        self.cdf = np.cumsum(w / w.sum())
+        self.n = len(w)
+        self.batch = batch
+        self._buf: list = []
+
+    def sample(self) -> int:
+        if not self._buf:
+            u = self.rng.random(self.batch)
+            self._buf = np.minimum(
+                np.searchsorted(self.cdf, u), self.n - 1).tolist()
+        return int(self._buf.pop())
+
+
+class _Zipf(_CdfSampler):
     """Bounded zipfian sampler (the YCSB ScrambledZipfian without the
     scramble; theta 0.99 like the spec)."""
 
     def __init__(self, n: int, rng, theta: float = 0.99):
-        self.rng = rng
         ranks = np.arange(1, n + 1, dtype=np.float64)
-        w = 1.0 / np.power(ranks, theta)
-        self.p = w / w.sum()
-        self.n = n
-
-    def sample(self) -> int:
-        return int(self.rng.choice(self.n, p=self.p))
+        super().__init__(1.0 / np.power(ranks, theta), rng)
 
 
 class YCSB:
@@ -58,8 +74,8 @@ class YCSB:
         self.ops = {op: 0 for op in
                     ("read", "update", "insert", "scan", "rmw")}
         # hoisted: the mix is fixed, don't rebuild per step
-        self._op_names, self._op_probs = zip(*self.mix.items())
-        self._op_probs = np.asarray(self._op_probs)
+        self._op_names, op_probs = zip(*self.mix.items())
+        self._op_sampler = _CdfSampler(op_probs, self.rng, batch=1024)
 
     def setup(self) -> None:
         e = self.engine
@@ -82,7 +98,7 @@ class YCSB:
         return int(self.rng.integers(0, self.records))
 
     def step(self) -> str:
-        op = self.rng.choice(self._op_names, p=self._op_probs)
+        op = self._op_names[self._op_sampler.sample()]
         e = self.engine
         k = self._key()
         if op == "read":

@@ -139,3 +139,19 @@ def test_decorrelated_scalar_with_joined_subquery():
     # item 1: ok-vendor offers {10:50} -> min 50 -> ofk 10
     # item 2: {12:30, 13:40} -> min 30 -> ofk 12
     assert r.rows == [(10,), (12,)]
+
+
+def test_prepared_derived_join_reexecutes():
+    """Round-3 review: re-running a statement whose FROM holds a
+    derived table must not see the first run's (dropped) temp table —
+    the temp rewrite operates on a private deep copy of the AST."""
+    e = Engine()
+    e.execute("CREATE TABLE t (k INT PRIMARY KEY, v INT)")
+    e.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    sql = ("SELECT t.k, d.mx FROM t JOIN "
+           "(SELECT k AS dk, max(v) AS mx FROM t GROUP BY k) AS d "
+           "ON d.dk = t.k ORDER BY t.k")
+    p = e.prepare(sql)  # derived joins ride the rerun-prepared path
+    first = p.run().rows
+    second = p.run().rows
+    assert first == second == [(1, 10), (2, 20)]
