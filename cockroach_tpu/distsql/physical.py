@@ -126,7 +126,8 @@ def _subst_aggrefs(e: BExpr, mapping: dict[int, BExpr]) -> BExpr:
     return e
 
 
-SPLITTABLE = {"sum", "sum_int", "count", "count_rows", "min", "max", "avg"}
+SPLITTABLE = {"sum", "sum_int", "count", "count_rows", "min", "max",
+              "any", "avg"}
 
 
 def split(node: P.PlanNode) -> StagePlan:
@@ -254,7 +255,8 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
         f = len(final_aggs)
         merge_func = {"sum": "sum", "sum_int": "sum_int",
                       "count": "sum_int", "count_rows": "sum_int",
-                      "min": "min", "max": "max"}[a.func]
+                      "min": "min", "max": "max",
+                      "any": "max"}[a.func]
         final_aggs.append(BoundAgg(merge_func,
                                    BCol(partial_name(j), a.type), a.type))
         final_ref[i] = BAggRef(f, a.type)

@@ -274,18 +274,35 @@ def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
         overflow = None
         if acc == jnp.int64:
             # int64 keeps decimal sums exact through the SF100 target,
-            # but a large-enough scan wraps silently — run a float64
-            # shadow sum and flag divergence (SURVEY.md §7 "Decimals":
-            # the overflow correctness gate)
-            if grouped:
-                shadow = aggops.group_sum(d0.astype(jnp.float64), gid, mask,
-                                          num_groups)
-            else:
-                shadow = aggops.masked_sum(d0.astype(jnp.float64), mask)[None]
-            shadow = psum(shadow)
-            err = jnp.abs(d.astype(jnp.float64) - shadow)
-            tol = jnp.maximum(jnp.abs(shadow) * 1e-3, 1e12)
-            overflow = jnp.any(err > tol)
+            # but a large-enough scan wraps silently. The overflow
+            # gate: a cheap global bound (rows x max|value|, one fast
+            # reduction) proves most scans CANNOT overflow; only when
+            # the bound trips does the f64 shadow-sum comparison run
+            # (SURVEY.md §7 "Decimals") — 64-bit scatters are
+            # software-emulated on TPU (~200ms at 2M rows), so the
+            # always-on shadow doubled every grouped decimal sum
+            n_rows = jnp.asarray(d0.shape[0], jnp.float64)
+            max_abs = jnp.max(jnp.abs(jnp.where(
+                mask, d0, jnp.zeros_like(d0)))).astype(jnp.float64)
+            # psum makes the bound (and so the cond predicate) global:
+            # every shard takes the same branch, so the collectives
+            # inside _shadow cannot diverge
+            cannot = psum(n_rows * max_abs) < jnp.float64(2 ** 62)
+
+            def _shadow(_):
+                if grouped:
+                    sh = aggops.group_sum(d0.astype(jnp.float64), gid,
+                                          mask, num_groups)
+                else:
+                    sh = aggops.masked_sum(
+                        d0.astype(jnp.float64), mask)[None]
+                sh = psum(sh)
+                err = jnp.abs(d.astype(jnp.float64) - sh)
+                tol = jnp.maximum(jnp.abs(sh) * 1e-3, 1e12)
+                return jnp.any(err > tol)
+            overflow = jax.lax.cond(cannot,
+                                    lambda _: jnp.bool_(False),
+                                    _shadow, operand=None)
         return d, nonempty, overflow
     if a.func == "avg":
         scale = (10.0 ** a.arg.type.scale
@@ -297,6 +314,15 @@ def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
             s = aggops.masked_sum(df, mask)[None]
         d = psum(s) / jnp.maximum(cnt, 1).astype(jnp.float64)
         return d, nonempty, None
+    if a.func == "any":
+        # per-group-constant representative (the planner's FD-reduced
+        # group keys): scatter-SET, which stays on the fast 32-bit
+        # scatter path where min/max on 64-bit dtypes are emulated
+        if grouped:
+            d = aggops.group_any(d0, gid, mask, num_groups)
+        else:
+            d = aggops.masked_max(d0, mask)[None]
+        return pmax(d), nonempty, None
     if a.func == "min":
         if grouped:
             d = aggops.group_min(d0, gid, mask, num_groups)
@@ -749,7 +775,10 @@ def _agg_state_ops(a: BoundAgg) -> tuple:
         return ("add", "add")
     if a.func == "min":
         return ("min", "add")
-    if a.func == "max":
+    if a.func in ("max", "any"):
+        # "any" carries a per-group-constant value; max-combining
+        # page partials (identity: group_any's very-negative fill)
+        # picks the one real value
         return ("max", "add")
     raise ExecError(f"aggregate {a.func} cannot stream")
 
@@ -774,9 +803,24 @@ def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups) -> tuple:
         d = (aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc)
              if grouped else aggops.masked_sum(d0, mask, acc_dtype=acc)[None])
         if acc == jnp.int64:
-            sh = (aggops.group_sum(d0.astype(jnp.float64), gid, mask,
-                                   num_groups) if grouped
-                  else aggops.masked_sum(d0.astype(jnp.float64), mask)[None])
+            # same gate as _agg_partials: when this page's rows*max
+            # bound proves its partial cannot wrap, its int64 sum cast
+            # to f64 IS its shadow (within f64 rounding, inside the
+            # finalize tolerance) — skipping the software-emulated
+            # 64-bit shadow scatter per page
+            n_rows = jnp.asarray(d0.shape[0], jnp.float64)
+            max_abs = jnp.max(jnp.abs(jnp.where(
+                mask, d0, jnp.zeros_like(d0)))).astype(jnp.float64)
+            cannot = n_rows * max_abs < jnp.float64(2 ** 62)
+
+            def _shadow(_):
+                return (aggops.group_sum(d0.astype(jnp.float64), gid,
+                                         mask, num_groups) if grouped
+                        else aggops.masked_sum(
+                            d0.astype(jnp.float64), mask)[None])
+            sh = jax.lax.cond(cannot,
+                              lambda _: d.astype(jnp.float64),
+                              _shadow, operand=None)
             return (d, cnt, sh)
         return (d, cnt)
     if a.func == "avg":
@@ -792,6 +836,10 @@ def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups) -> tuple:
         return (m, cnt)
     if a.func == "max":
         m = (aggops.group_max(d0, gid, mask, num_groups) if grouped
+             else aggops.masked_max(d0, mask)[None])
+        return (m, cnt)
+    if a.func == "any":
+        m = (aggops.group_any(d0, gid, mask, num_groups) if grouped
              else aggops.masked_max(d0, mask)[None])
         return (m, cnt)
     raise ExecError(f"aggregate {a.func} cannot stream")
@@ -813,7 +861,7 @@ def _agg_finalize(a: BoundAgg, arrs: tuple):
     if a.func == "avg":
         s, cnt = arrs
         return s / jnp.maximum(cnt, 1).astype(jnp.float64), cnt > 0, None
-    if a.func in ("min", "max"):
+    if a.func in ("min", "max", "any"):
         m, cnt = arrs
         return m, cnt > 0, None
     raise ExecError(f"aggregate {a.func} cannot stream")

@@ -808,7 +808,10 @@ class Planner:
                             dependent = True
                             break
             if dependent:
-                binder.aggs.append(BoundAgg("max", ge, type=ge.type))
+                # "any": per-group-constant by construction — the
+                # scatter-SET kernel, not the (64-bit-emulated, ~12x
+                # slower) scatter-max (ops/agg.py group_any)
+                binder.aggs.append(BoundAgg("any", ge, type=ge.type))
                 repl.append((ge, BAggRef(len(binder.aggs) - 1, ge.type)))
             else:
                 kept.append((gname, ge))

@@ -116,6 +116,32 @@ class TestAgg:
         mx = agg.group_max(d, g, m, 4)
         assert int(mx[1]) == 4
 
+    def test_group_any_constant_groups(self):
+        """group_any picks the per-group value (inputs constant per
+        group by the FD-reduction contract) across dtypes, including
+        the 64-bit limb path and negative values; empty/masked groups
+        hold a very negative identity (pmax-merge safe)."""
+        g = jnp.array([0, 0, 1, 2, 1], dtype=jnp.int32)
+        m = jnp.array([True, True, True, False, True])
+        for G in (4, 40):  # 4 = unrolled small-G branch, 40 = limbs
+            for dtype, vals in [
+                (jnp.int64, [-7, -7, 123456789012345, 9,
+                             123456789012345]),
+                (jnp.int32, [5, 5, -2, 9, -2]),
+                (jnp.float64, [1.5, 1.5, -2.25, 9.0, -2.25]),
+                (jnp.float32, [1.5, 1.5, -2.25, 9.0, -2.25]),
+            ]:
+                d = jnp.array(vals, dtype=dtype)
+                out = np.asarray(agg.group_any(d, g, m, G))
+                assert out[0] == vals[0] and out[1] == vals[2], \
+                    (G, dtype, out)
+                # masked-out group 2 and the never-scattered empty
+                # group 3 both hold the identity: below any real value
+                for slot in (2, 3):
+                    assert out[slot] < -1e15 \
+                        or out[slot] == np.iinfo(np.int32).min \
+                        or out[slot] == -np.inf, (G, dtype, slot, out)
+
     def test_avg_decomposition(self):
         spec = agg.AggSpec("avg", "x", "avg_x")
         assert spec.local_funcs == ["sum", "count"]
