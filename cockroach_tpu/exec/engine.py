@@ -36,7 +36,7 @@ from ..sql import ast, parser
 from ..sql import plan as P
 from ..sql.binder import Binder, ColumnBinding, Scope
 from ..sql.bound import BConst
-from ..sql.planner import CatalogView, Planner
+from ..sql.planner import CatalogView, PlanError, Planner
 from ..sql.rowenc import ROWID
 from ..sql.types import ColumnSchema, Family, TableSchema
 from ..storage import keys as K
@@ -837,9 +837,9 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             for name, cols, sub in sel.ctes:
                 sub = _propagate_as_of(
                     _rewrite_table_names(sub, mapping), sel)
-                res = self._exec_select(sub, session, f"(cte {sub!r})")
                 tname = f"__cte{self._temp_seq()}_{name}"
-                self._materialize_temp(tname, res, cols)
+                self._materialize_temp_select(tname, sub, session,
+                                              cols, f"(cte {sub!r})")
                 mapping[name] = tname
                 temps.append(tname)
             sel.ctes = []
@@ -851,10 +851,9 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     continue
                 sub = _propagate_as_of(
                     _rewrite_table_names(ref.subquery, mapping), sel)
-                res = self._exec_select(sub, session,
-                                        f"(derived {sub!r})")
                 tname = f"__cte{self._temp_seq()}_{ref.alias}"
-                self._materialize_temp(tname, res, None)
+                self._materialize_temp_select(
+                    tname, sub, session, None, f"(derived {sub!r})")
                 temps.append(tname)
                 newref = ast.TableRef(tname, ref.alias)
                 if kind == "table":
@@ -876,6 +875,113 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
     def _temp_seq(self) -> int:
         self._temp_counter[0] += 1
         return self._temp_counter[0]
+
+    def _materialize_temp_select(self, tname: str, sub: ast.Select,
+                                 session: Session, rename,
+                                 sql_text: str) -> None:
+        """Materialize a CTE/derived-table SELECT into a temp table.
+
+        Fast path: run the compiled program and ingest the DEVICE
+        output columns directly — they are already in storage-physical
+        form (scaled-int decimals, day/micro ints, dictionary codes),
+        so nothing round-trips through per-value Python decode/encode
+        (q9's 134K-row derived table cost ~18s that way; the columnar
+        ingest is ~0.1s). Falls back to the decoded-row path for
+        shapes the direct prepare cannot serve (spill recursion,
+        top-k tie fallback, nested CTEs/fastpath-only statements)."""
+        from .session import TopKInexact
+        try:
+            if not isinstance(sub, ast.Select) or sub.ctes:
+                # set-op bodies and nested CTEs take the row path
+                raise EngineError("shape takes the row path")
+            prep = self._prepare_select(sub, session, sql_text)
+            runner = getattr(prep, "jfn", None)
+            if runner is None or prep.stream is not None:
+                raise EngineError("shape takes the row path")
+            out = prep.dispatch()
+            for sentinel, exc in (
+                    ("__ht_overflow", HashCapacityExceeded),
+                    ("__topk_inexact", TopKInexact)):
+                if out.has(sentinel) and bool(
+                        np.asarray(out.col(sentinel))[0]):
+                    raise exc(sentinel)
+            if out.has("__sum_overflow") and bool(
+                    np.asarray(out.col("__sum_overflow"))[0]):
+                # a user-facing error, not a row-path retry: the row
+                # path would raise the same thing
+                raise EngineError(
+                    "decimal SUM overflowed int64 accumulation; "
+                    "CAST the argument to FLOAT to trade exactness "
+                    "for range")
+            meta = prep.meta
+            names = list(meta.names)
+            if rename is not None:
+                if len(rename) != len(names):
+                    raise EngineError(
+                        "CTE column list length does not match query")
+                names = list(rename)
+            if len(set(names)) != len(names):
+                raise EngineError(f"duplicate column names in {tname}")
+            schema = TableSchema(
+                name=tname,
+                columns=[ColumnSchema(n, t, True)
+                         for n, t in zip(names, meta.types)],
+                primary_key=[],
+                table_id=self.store.alloc_table_id())
+            self.store.create_table(schema)
+            sel = np.asarray(out.sel)
+            live = np.nonzero(sel)[0]
+            gather_idx = None
+            if len(live) * 2 < len(sel) and len(live):
+                # join-expanded outputs are mostly dead rows: gather
+                # the live ones ON DEVICE so the host transfer moves
+                # only real data (q9's derived table: 134K live of a
+                # multi-million-row padded batch — the full-batch
+                # transfer through the tunnel was ~18s). Padded to a
+                # pow2 so the gather program's compile caches across
+                # executions.
+                padded = max(_next_pow2(len(live)), 1024)
+                idx = np.full(padded, live[-1], dtype=np.int32)
+                idx[:len(live)] = live
+                gather_idx = jax.device_put(idx)
+            cols: dict[str, np.ndarray] = {}
+            valid: dict[str, np.ndarray] = {}
+            for cname, oname, ty in zip(names, meta.names, meta.types):
+                if gather_idx is not None:
+                    arr = np.asarray(jnp.take(out.col(oname),
+                                              gather_idx))[:len(live)]
+                    v = np.asarray(jnp.take(out.col_valid(oname),
+                                            gather_idx))[:len(live)]
+                else:
+                    arr = np.asarray(out.col(oname))[sel]
+                    v = np.asarray(out.col_valid(oname))[sel]
+                if ty.family == Family.STRING:
+                    d = meta.dictionaries.get(oname)
+                    if d is None:
+                        raise EngineError(
+                            "undictionaried string takes the row path")
+                    self.store.set_dictionary(tname, cname,
+                                              list(d.values))
+                    arr = np.clip(arr.astype(np.int32), 0,
+                                  max(len(d) - 1, 0))
+                cols[cname] = arr
+                valid[cname] = v
+            if len(sel) and sel.any():
+                self.store.insert_columns(tname, cols, Timestamp(1, 0),
+                                          valid=valid)
+            return
+        except (EngineError, PlanError) as e:
+            if tname in self.store.tables:
+                self.store.drop_table(tname)
+            if not (isinstance(e, (HashCapacityExceeded, TopKInexact,
+                                   PlanError))
+                    or str(e).endswith("row path")):
+                raise
+            # fall through: spill recursion / top-k tie fallback /
+            # row-path-only shapes; PlanError lets the row path replan
+            # with its wider strategy set (fastpath, set ops)
+        res = self._exec_select(sub, session, sql_text)
+        self._materialize_temp(tname, res, rename)
 
     def _materialize_temp(self, tname: str, res: Result,
                           rename: list | None) -> None:
@@ -1348,6 +1454,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         return 0
 
     MAX_DIRECT_JOIN_SLOTS = 1 << 22
+    # packed composite keys size the table by the SPAN PRODUCT
+    MAX_PACKED_JOIN_SLOTS = 1 << 27
 
     def _maybe_direct_join(self, join, b, stored, read_ts,
                            overlay: set) -> None:
@@ -1358,25 +1466,52 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         txn-overlay builds — uncommitted rows could fall outside the
         measured range and steal slots from committed matches."""
         join.direct = None
-        if len(stored) != 1 or b.table in overlay:
+        if b.table in overlay:
             return
-        col = self.store.table(b.table).schema.column(stored[0])
-        if col.type.family == Family.FLOAT:
+        ranges = []
+        n_all = 0
+        for s in stored:
+            col = self.store.table(b.table).schema.column(s)
+            if col.type.family == Family.FLOAT:
+                return
+            r = self.store.key_int_range(b.table, s)
+            if r is None:
+                return
+            lo, hi, n_all = r
+            ranges.append((lo, hi - lo + 1))
+        if len(ranges) == 1:
+            lo, span = ranges[0]
+            # density is a MEMORY question, not a perf one: the build
+            # is a single scatter over the table regardless of
+            # sparsity, and a sparse table still beats the
+            # ~100x-slower while-loop hash probe. SSB's date dimension
+            # (YYYYMMDD ints: ~2.5K keys over a ~60K span) is the
+            # canonical sparse-but-small case round 2's 4x-density
+            # guard wrongly sent to the hash path.
+            if span <= max(256 * n_all, 4096) \
+                    and span + 1 <= self.MAX_DIRECT_JOIN_SLOTS:
+                join.direct = (lo, span + 1)
             return
-        r = self.store.key_int_range(b.table, stored[0])
-        if r is None:
+        # composite keys (q9's partsupp (ps_partkey, ps_suppkey)):
+        # mixed-radix-pack the components; the span PRODUCT sizes the
+        # table, so the cap is higher (an int32 slot table at 2^27 is
+        # 0.5GB of HBM — cheap next to the while-loop hash path's
+        # ~140s/exec) and the sparsity allowance wider
+        total = 1
+        for _, span in ranges:
+            total *= span
+            if total > self.MAX_PACKED_JOIN_SLOTS:
+                return
+        # the payload-folding path allocates ~one size-length table
+        # per carried payload column on top of the slot table: budget
+        # TOTAL slot-table cells, not just the key table (2^29 cells
+        # ~= 2-4GB transient HBM worst case; duplicate-keyed builds
+        # take the expand path, which builds only the slot table)
+        if total * (2 + len(join.payload)) > 1 << 29:
             return
-        lo, hi, n_all = r
-        span = hi - lo + 1
-        # density is a MEMORY question, not a perf one: the build is a
-        # single scatter over the table regardless of sparsity, and a
-        # sparse table still beats the ~100x-slower while-loop hash
-        # probe. SSB's date dimension (YYYYMMDD ints: ~2.5K keys over a
-        # ~60K span) is the canonical sparse-but-small case round 2's
-        # 4x-density guard wrongly sent to the hash path.
-        if span <= max(256 * n_all, 4096) \
-                and span + 1 <= self.MAX_DIRECT_JOIN_SLOTS:
-            join.direct = (lo, span + 1)
+        if total <= max(2048 * n_all, 4096):
+            join.direct = ("packed", tuple(lo for lo, _ in ranges),
+                           tuple(span for _, span in ranges))
 
     def _dist_decision(self, node, session: Session):
         """Choose distributed (SPMD over the mesh) vs single-device —

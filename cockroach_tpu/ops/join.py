@@ -62,6 +62,32 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
     for k in probe_keys:
         pmask = jnp.logical_and(pmask, probe.col_valid(k))
 
+    if direct is not None and direct[0] == "packed":
+        # Composite-key direct addressing (q9's partsupp (partkey,
+        # suppkey)): mixed-radix-pack the components into ONE synthetic
+        # key, then reuse the single-key direct machinery unchanged.
+        # The engine proved every component's value range; the packed
+        # span product fits the slot cap.
+        _, los, spans = direct
+        bp = jnp.zeros_like(bkeys[0], dtype=jnp.int64)
+        pp = jnp.zeros_like(pkeys[0], dtype=jnp.int64)
+        ok_p = None
+        for kb, kp, lo, span in zip(bkeys, pkeys, los, spans):
+            bp = bp * span + (kb.astype(jnp.int64) - lo)
+            pp = pp * span + (kp.astype(jnp.int64) - lo)
+            comp = jnp.logical_and(kp >= lo, kp - lo < span)
+            ok_p = comp if ok_p is None else jnp.logical_and(ok_p, comp)
+        size = 1
+        for span in spans:
+            size *= int(span)
+        size += 1
+        # an out-of-range component would alias a neighbouring slot
+        # after packing: steer the whole packed key out of range so
+        # the standard in_range check rejects the row
+        pp = jnp.where(ok_p, pp, jnp.int64(size))
+        bkeys, pkeys = (bp,), (pp,)
+        direct = (0, size)
+
     if direct is not None and len(bkeys) == 1:
         # Direct addressing: TPU scatters/gathers inside the hash
         # table's while_loops are ~100x slower than straight-line ops,

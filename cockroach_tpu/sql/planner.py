@@ -843,19 +843,29 @@ class Planner:
             elif isinstance(e, BCol) and e.type.family == Family.BOOL:
                 dims.append(2)
                 los.append(0)
-            elif isinstance(e, BCol) and e.type.family == Family.INT \
-                    and self.catalog.int_range_fn is not None \
-                    and "." in e.name:
-                alias, col = e.name.split(".", 1)
-                tname = alias_to_table.get(alias)
-                try:
-                    r = (self.catalog.int_range_fn(tname, col)
-                         if tname else None)
-                except KeyError:   # renamed/computed: not a stored col
-                    r = None
-                if r is None:
-                    return 0, [], []
-                lo, hi, _n = r
+            else:
+                if isinstance(e, BCol) and e.type.family == Family.INT \
+                        and self.catalog.int_range_fn is not None \
+                        and "." in e.name:
+                    alias, col = e.name.split(".", 1)
+                    tname = alias_to_table.get(alias)
+                    try:
+                        r = (self.catalog.int_range_fn(tname, col)
+                             if tname else None)
+                    except KeyError:  # renamed/computed: not stored
+                        r = None
+                    if r is None:
+                        return 0, [], []
+                    lo, hi, _n = r
+                else:
+                    # GROUP BY extract(year FROM datecol): the stored
+                    # column's value range bounds the year span
+                    # (TPC-H q7/q8/q9's o_year — 7 years, not a hash
+                    # table)
+                    yr = self._year_extract_range(e, alias_to_table)
+                    if yr is None:
+                        return 0, [], []
+                    lo, hi = yr
                 span = hi - lo + 1
                 span_cap = (self.MAX_INT_GROUP_SPAN_SINGLE
                             if len(group_exprs) == 1
@@ -864,13 +874,41 @@ class Planner:
                     return 0, [], []
                 dims.append(int(span))
                 los.append(int(lo))
-            else:
-                return 0, [], []
             bound *= dims[-1] + 1
             if bound > ((1 << 21) + 2 if len(group_exprs) == 1
                         else 1 << 16):
                 return 0, [], []
         return bound, dims, los
+
+    def _year_extract_range(self, e, alias_to_table):
+        """(lo_year, hi_year) when e is extract(year FROM <stored
+        date/timestamp column>) and the column's value range is
+        provable, else None."""
+        from .bound import BExtract
+        if not (isinstance(e, BExtract) and e.part == "year"
+                and isinstance(e.expr, BCol)
+                and e.expr.type.family in (Family.DATE,
+                                           Family.TIMESTAMP)
+                and self.catalog.int_range_fn is not None
+                and "." in e.expr.name):
+            return None
+        alias, col = e.expr.name.split(".", 1)
+        tname = alias_to_table.get(alias)
+        if tname is None:
+            return None
+        try:
+            r = self.catalog.int_range_fn(tname, col)
+        except KeyError:
+            return None
+        if r is None:
+            return None
+        lo, hi, _n = r
+        if e.expr.type.family == Family.TIMESTAMP:
+            lo, hi = lo // 86_400_000_000, hi // 86_400_000_000
+        import datetime as _dt
+        epoch = _dt.date(1970, 1, 1)
+        return ((epoch + _dt.timedelta(days=int(lo))).year,
+                (epoch + _dt.timedelta(days=int(hi))).year)
 
     def _dict_by_batch_name(self, name, scope: Scope):
         for t in scope.tables.values():
