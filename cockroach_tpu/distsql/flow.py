@@ -12,10 +12,30 @@ Rebuilds the flow runtime of the reference:
   arrival order.
 - ``Outbox``/``Inbox`` — streaming producer/consumer of serialized
   columnar chunks (colflow/colrpc/outbox.go:150, inbox.go:326).
+
+Flow control (round 3): the reference rides gRPC's HTTP/2 stream
+windows for backpressure and a context for cancellation
+(colrpc/outbox.go's stream.Send blocks on window exhaustion;
+flowinfra/flow.go cancels every processor through the flow ctx). Our
+framed-chunk fabric has neither, so both are explicit protocol here:
+
+- **credits**: the consumer acks every data chunk it receives
+  (``flow_ack``); the producer stops sending once
+  ``sent - acked >= window`` and pumps its transport until credits
+  return. One slow/overloaded gateway therefore bounds every
+  producer's in-flight bytes at ``window * chunk_rows`` rows instead
+  of letting fast producers queue an entire result set into memory.
+- **cancellation**: the gateway broadcasts ``cancel_flow`` on any
+  failure (remote error, stall, unhealthy peer); producers abort
+  between chunks and ship nothing further. A flow cancelled before
+  its SetupFlow arrives is remembered, so the late arrival is
+  dropped instead of executed (the reference's flow registry keeps
+  the same tombstone while the ctx is already dead).
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
@@ -23,6 +43,11 @@ from typing import Optional
 import numpy as np
 
 from cockroach_tpu.distsql import serde
+
+
+class FlowCancelled(Exception):
+    """The gateway cancelled this flow; abort quietly (no error ships
+    back — the consumer is gone or no longer listening)."""
 
 
 @dataclass
@@ -34,12 +59,14 @@ class FlowSpec:
     stream_id: int               # output stream on the gateway
     chunk_rows: int = 65536
     read_ts: Optional[int] = None
+    window: int = 8              # max unacked chunks in flight
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
                 "stage": self.stage, "sql": self.sql,
                 "stream_id": self.stream_id,
-                "chunk_rows": self.chunk_rows, "read_ts": self.read_ts}
+                "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
+                "window": self.window}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":
@@ -92,15 +119,29 @@ class FlowRegistry:
 
 class Outbox:
     """Chunks a host batch and pushes frames to the gateway's inbox via
-    the transport (FlowStream)."""
+    the transport (FlowStream).
+
+    With a ``node`` (the owning DistSQLNode) attached, each data chunk
+    consumes one credit: once ``window`` chunks are unacked the send
+    loop pumps the transport until the consumer's ``flow_ack``s return
+    (or the flow is cancelled / the credit wait times out). EOF/error
+    frames never wait — they must always be deliverable so the gateway
+    can finish."""
+
+    CREDIT_TIMEOUT = 300.0       # idle bound, same spirit as the
+    # gateway's FLOW_TIMEOUT: only true silence fails the stream
 
     def __init__(self, transport, frm: int, to: int, flow_id: str,
-                 stream_id: int):
+                 stream_id: int, node=None, window: int = 0):
         self.transport = transport
         self.frm = frm
         self.to = to
         self.flow_id = flow_id
         self.stream_id = stream_id
+        self.node = node
+        self.window = window
+        self.chunks_sent = 0
+        self.max_outstanding = 0
 
     def _send(self, chunk: Optional[bytes], eof: bool,
               error: Optional[str] = None) -> None:
@@ -108,20 +149,63 @@ class Outbox:
                             ("flow_stream", self.flow_id, self.stream_id,
                              chunk, eof, error))
 
+    def _check_cancel(self) -> None:
+        if self.node is not None and \
+                self.flow_id in self.node.cancelled_flows:
+            raise FlowCancelled(self.flow_id)
+
+    def _outstanding(self) -> int:
+        acked = self.node.acks.get((self.flow_id, self.stream_id), 0) \
+            if self.node is not None else self.chunks_sent
+        return self.chunks_sent - acked
+
+    def _await_credit(self) -> None:
+        if self.node is None or self.window <= 0:
+            return
+        deadline = time.monotonic() + self.CREDIT_TIMEOUT
+        while self._outstanding() >= self.window:
+            self._check_cancel()
+            # pump our own transport: acks arrive on it. With the
+            # shared in-process transport this re-enters deliver_all
+            # (which drains a snapshot, so recursion terminates); on
+            # the socket fabric it drains this node's listener queue.
+            moved = self.transport.deliver_all()
+            if moved:
+                deadline = time.monotonic() + self.CREDIT_TIMEOUT
+                continue
+            if self.transport.pending() == 0 and \
+                    not getattr(self.transport, "is_async", False):
+                raise RuntimeError(
+                    f"flow {self.flow_id}/{self.stream_id}: awaiting "
+                    "credits on an idle synchronous transport "
+                    "(consumer never acked)")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"flow {self.flow_id}/{self.stream_id}: credit "
+                    f"wait timed out ({self.CREDIT_TIMEOUT}s)")
+            time.sleep(0.001)
+
+    def _send_chunk(self, chunk: bytes) -> None:
+        self._check_cancel()
+        self._await_credit()
+        self._send(chunk, False)
+        self.chunks_sent += 1
+        self.max_outstanding = max(self.max_outstanding,
+                                   self._outstanding())
+
     def send_arrays(self, n: int, cols: dict[str, np.ndarray],
                     valid: dict[str, np.ndarray],
                     chunk_rows: int) -> None:
         if n == 0:
-            self._send(serde.encode_columns(0, {k: v[:0] for k, v in
-                                                cols.items()},
-                                            {k: v[:0] for k, v in
-                                             valid.items()}), False)
+            self._send_chunk(serde.encode_columns(
+                0, {k: v[:0] for k, v in cols.items()},
+                {k: v[:0] for k, v in valid.items()}))
         for lo in range(0, n, chunk_rows):
             hi = min(n, lo + chunk_rows)
-            self._send(serde.encode_columns(
+            self._send_chunk(serde.encode_columns(
                 hi - lo,
                 {k: v[lo:hi] for k, v in cols.items()},
-                {k: v[lo:hi] for k, v in valid.items()}), False)
+                {k: v[lo:hi] for k, v in valid.items()}))
 
     def close(self, error: Optional[str] = None) -> None:
         self._send(None, True, error)

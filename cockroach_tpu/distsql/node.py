@@ -20,12 +20,14 @@ from __future__ import annotations
 
 import time as _time
 import uuid
+from collections import deque
 
 import jax.numpy as jnp
 import numpy as np
 
 from cockroach_tpu.distsql import serde
-from cockroach_tpu.distsql.flow import FlowRegistry, FlowSpec, Outbox
+from cockroach_tpu.distsql.flow import (FlowCancelled, FlowRegistry,
+                                        FlowSpec, Outbox)
 from cockroach_tpu.distsql.physical import UNION, split
 from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
 from cockroach_tpu.ops.batch import ColumnBatch
@@ -38,6 +40,10 @@ class FlowError(Exception):
 
 
 class DistSQLNode:
+    # remember this many cancelled flow ids, so a cancel that races
+    # ahead of its SetupFlow still tombstones the late arrival
+    CANCEL_MEMORY = 256
+
     def __init__(self, node_id: int, engine, transport):
         self.node_id = node_id
         self.engine = engine
@@ -45,6 +51,14 @@ class DistSQLNode:
         self.registry = FlowRegistry()
         transport.register(node_id, self._handle)
         self.flows_run = 0
+        self.flows_cancelled = 0
+        self.max_outstanding = 0   # high-water unacked chunks (stats)
+        # producer-side credit state: (flow_id, stream_id) -> chunks
+        # the consumer has acked (read by the Outbox's credit wait)
+        self.acks: dict[tuple[str, int], int] = {}
+        self._producing: set[tuple[str, int]] = set()
+        self.cancelled_flows: set[str] = set()
+        self._cancel_order: deque = deque()
 
     # -- rpc handlers ----------------------------------------------
     def _handle(self, frm: int, payload) -> None:
@@ -53,12 +67,44 @@ class DistSQLNode:
             self._setup_flow(FlowSpec.from_wire(payload[1]))
         elif kind == "flow_stream":
             _, flow_id, stream_id, chunk, eof, error = payload
+            if flow_id in self.cancelled_flows:
+                # stale frame for a released/cancelled flow: dropping
+                # it (no inbox, no ack) is what keeps late chunks from
+                # re-creating registry entries nobody will ever drain
+                return
             self.registry.inbox(flow_id, stream_id).push(chunk, eof, error)
+            if chunk is not None:
+                # consumer side of the credit loop: one ack per data
+                # chunk, returned to the producer that sent it
+                self.transport.send(self.node_id, frm,
+                                    ("flow_ack", flow_id, stream_id, 1))
+        elif kind == "flow_ack":
+            _, flow_id, stream_id, n = payload
+            key = (flow_id, stream_id)
+            if key in self._producing:   # late acks for finished
+                # streams would otherwise re-create state forever
+                self.acks[key] = self.acks.get(key, 0) + n
+        elif kind == "cancel_flow":
+            self._cancel(payload[1])
+
+    def _cancel(self, flow_id: str) -> None:
+        if flow_id in self.cancelled_flows:
+            return
+        self.cancelled_flows.add(flow_id)
+        self._cancel_order.append(flow_id)
+        while len(self._cancel_order) > self.CANCEL_MEMORY:
+            self.cancelled_flows.discard(self._cancel_order.popleft())
 
     # -- local stage execution -------------------------------------
     def _setup_flow(self, spec: FlowSpec) -> None:
         outbox = Outbox(self.transport, self.node_id, spec.gateway,
-                        spec.flow_id, spec.stream_id)
+                        spec.flow_id, spec.stream_id,
+                        node=self, window=spec.window)
+        if spec.flow_id in self.cancelled_flows:
+            # cancel raced ahead of the SetupFlow: drop it unexecuted
+            self.flows_cancelled += 1
+            return
+        self._producing.add((spec.flow_id, spec.stream_id))
         try:
             self.flows_run += 1
             batch, stage = self._run_local(spec)
@@ -104,8 +150,17 @@ class DistSQLNode:
                 cols[name] = np.where(valid[name], vals, b"")
             outbox.send_arrays(n, cols, valid, spec.chunk_rows)
             outbox.close()
+        except FlowCancelled:
+            # the gateway told us to stop: abort quietly, nothing to
+            # ship (the consumer released the flow already)
+            self.flows_cancelled += 1
         except Exception as e:          # noqa: BLE001 — ships to gateway
             outbox.close(error=f"{type(e).__name__}: {e}")
+        finally:
+            self.max_outstanding = max(self.max_outstanding,
+                                       outbox.max_outstanding)
+            self._producing.discard((spec.flow_id, spec.stream_id))
+            self.acks.pop((spec.flow_id, spec.stream_id), None)
 
     def _run_local(self, spec: FlowSpec):
         eng = self.engine
@@ -182,7 +237,8 @@ class Gateway:
 
     def __init__(self, own: DistSQLNode, data_nodes: list[int],
                  replicated_tables: set | None = None,
-                 flow_timeout: float = FLOW_TIMEOUT):
+                 flow_timeout: float = FLOW_TIMEOUT,
+                 monitor=None, window: int = 8):
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -190,6 +246,13 @@ class Gateway:
         # join would silently lose cross-node matches
         self.replicated_tables = replicated_tables or set()
         self.flow_timeout = flow_timeout
+        # rpc.heartbeat.PeerMonitor (or anything with healthy(node)):
+        # lets the gateway fail fast on a breaker-tripped peer instead
+        # of waiting out flow_timeout of silence (the reference checks
+        # connection health before scheduling flows,
+        # distsql_physical_planner.go CheckNodeHealthAndVersion)
+        self.monitor = monitor
+        self.window = window
 
     def _check_join_placement(self, plan_node) -> None:
         from cockroach_tpu.distsql.physical import DistUnsupported
@@ -224,13 +287,23 @@ class Gateway:
         flow_id = uuid.uuid4().hex[:12]
         read_ts = int(eng.clock.now().to_int())
 
+        # fail fast on breaker-tripped peers: scheduling a flow onto a
+        # dead node would only discover it after flow_timeout of silence
+        if self.monitor is not None:
+            sick = [n for n in self.nodes if n != self.own.node_id
+                    and not self.monitor.healthy(n)]
+            if sick:
+                raise FlowError(
+                    f"node(s) {sick} unhealthy (rpc breaker tripped); "
+                    "not scheduling flow")
+
         # SetupFlow to each participant; stream i <- node i
         registry = self.own.registry
         inboxes = []
         for i, nid in enumerate(self.nodes):
             spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
                             stream_id=i, chunk_rows=chunk_rows,
-                            read_ts=read_ts)
+                            read_ts=read_ts, window=self.window)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
@@ -243,9 +316,22 @@ class Gateway:
         # a long multi-chunk stream never starves a later chunk of
         # budget — only true silence for flow_timeout fails the flow
         deadline = _time.monotonic() + self.flow_timeout
-        for _ in range(100_000_000):
+        fail_fast = None
+        for spin in range(100_000_000):
             if all(ib.eof for ib in inboxes):
                 break
+            if self.monitor is not None and spin % 256 == 255:
+                # a peer that trips mid-flow will never send EOF;
+                # stop waiting for it the moment the breaker says so
+                waiting = [self.nodes[i] for i, ib in enumerate(inboxes)
+                           if not ib.eof and
+                           self.nodes[i] != self.own.node_id]
+                sick = [n for n in waiting
+                        if not self.monitor.healthy(n)]
+                if sick:
+                    fail_fast = FlowError(
+                        f"node(s) {sick} became unhealthy mid-flow")
+                    break
             if transport.deliver_all() == 0 and \
                     transport.pending() == 0:
                 if not is_async:
@@ -256,6 +342,8 @@ class Gateway:
             else:
                 deadline = _time.monotonic() + self.flow_timeout
         try:
+            if fail_fast is not None:
+                raise fail_fast
             errs = [ib.error for ib in inboxes if ib.error]
             if errs:
                 raise FlowError("; ".join(errs))
@@ -264,8 +352,22 @@ class Gateway:
             union, merged_dicts = self._union_batch(
                 [c for ib in inboxes for c in ib.drain_arrays()],
                 stage.union_columns, stage.string_cols)
+        except Exception:
+            # tell every producer to stop: without this a stalled or
+            # errored flow leaves remote stages running and pushing
+            # chunks at a gateway that has already given up
+            # (flowinfra's ctx cancellation)
+            for nid in self.nodes:
+                transport.send(self.own.node_id, nid,
+                               ("cancel_flow", flow_id))
+            raise
         finally:
             registry.release(flow_id)
+            # tombstone on the consuming node too: chunks still in
+            # flight after release (failed flow, or frames behind the
+            # EOFs we already drained) are dropped instead of
+            # re-creating registry inboxes nobody will drain
+            self.own._cancel(flow_id)
 
         # output dictionaries come from the merged wire strings, not the
         # gateway's (possibly empty) local shard

@@ -894,6 +894,13 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             if not isinstance(sub, ast.Select) or sub.ctes:
                 # set-op bodies and nested CTEs take the row path
                 raise EngineError("shape takes the row path")
+            # same preprocessing _exec_select performs: view bodies and
+            # correlated subqueries must be rewritten BEFORE prepare,
+            # or the binder rejects what the row path would serve
+            sub = self._decorrelate(self._expand_views(sub))
+            if sub.ctes or self._has_derived(sub):
+                # decorrelation can introduce derived tables
+                raise EngineError("shape takes the row path")
             prep = self._prepare_select(sub, session, sql_text)
             runner = getattr(prep, "jfn", None)
             if runner is None or prep.stream is not None:

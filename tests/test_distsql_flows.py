@@ -115,6 +115,112 @@ class TestFlows:
             gw.run(tpch.Q6)
 
 
+class TestFlowControl:
+    """Round-3 flow-control protocol: credit backpressure, cancel
+    broadcast, and heartbeat-informed fail-fast (the analogues of
+    gRPC HTTP/2 stream windows + flow ctx cancellation the reference
+    leans on, colrpc/outbox.go + flowinfra/flow.go)."""
+
+    def _two_node_fabric(self, rows=300):
+        transport = LocalTransport()
+        data = Engine()
+        tpch.load(data, sf=0.01, rows=rows)
+        gw_eng = Engine()          # schema only: the gateway holds no rows
+        gw_eng.execute(tpch.DDL["lineitem"])
+        gw_eng.execute(tpch.DDL["part"])
+        gw_node = DistSQLNode(0, gw_eng, transport)
+        data_node = DistSQLNode(1, data, transport)
+        return transport, gw_node, data_node
+
+    def test_backpressure_bounds_inflight_chunks(self):
+        """chunk_rows=1 + window=2 over hundreds of rows: the producer
+        must never have more than `window` unacked chunks in flight,
+        and the result must still be exact."""
+        transport, gw_node, data_node = self._two_node_fabric(rows=240)
+        gw = Gateway(gw_node, [1], window=2)
+        q = ("SELECT l_orderkey, l_quantity FROM lineitem "
+             "WHERE l_quantity < 10 ORDER BY l_orderkey, l_quantity "
+             "LIMIT 50")
+        got = gw.run(q, chunk_rows=1)
+        want = data_node.engine.execute(q)
+        assert_rows_close(got.rows, want.rows)
+        assert 0 < data_node.max_outstanding <= 2
+        # producer-side credit state is cleaned up after the flow
+        assert data_node.acks == {}
+
+    def test_cancel_races_ahead_of_setup_flow(self):
+        """A cancel arriving before its SetupFlow tombstones the flow:
+        the late setup is dropped unexecuted and ships nothing."""
+        transport, gw_node, data_node = self._two_node_fabric(rows=50)
+        from cockroach_tpu.distsql.flow import FlowSpec
+        spec = FlowSpec("f-cancelled", gateway=0, stage="rows",
+                        sql="SELECT l_orderkey FROM lineitem",
+                        stream_id=0)
+        transport.send(0, 1, ("cancel_flow", "f-cancelled"))
+        transport.send(0, 1, ("setup_flow", spec.to_wire()))
+        for _ in range(10):
+            if transport.deliver_all() == 0:
+                break
+        assert data_node.flows_cancelled == 1
+        assert data_node.flows_run == 0
+        inbox = gw_node.registry.inbox("f-cancelled", 0)
+        assert not inbox.eof and not inbox.chunks
+
+    def test_gateway_broadcasts_cancel_on_remote_error(self):
+        """When one producer errors, the gateway must cancel the
+        others so they stop pushing at a consumer that gave up."""
+        transport = LocalTransport()
+        ok = Engine()
+        tpch.load(ok, sf=0.01, rows=100)
+        broken = Engine()          # no lineitem table at all
+        n1 = DistSQLNode(1, ok, transport)
+        n2 = DistSQLNode(2, broken, transport)
+        gw = Gateway(n1, [1, 2])
+        with pytest.raises(FlowError, match="lineitem"):
+            gw.run(tpch.Q6)
+        for _ in range(10):
+            if transport.deliver_all() == 0:
+                break
+        assert len(n1.cancelled_flows) == 1
+        assert len(n2.cancelled_flows) == 1
+
+    def test_late_chunks_after_release_are_dropped(self):
+        """Round-3 review: a flow_stream frame arriving after the
+        gateway released the flow must not re-create a registry inbox
+        (nobody will ever drain it) nor ack the dead stream."""
+        transport, gw_node, data_node = self._two_node_fabric(rows=50)
+        gw = Gateway(gw_node, [1])
+        got = gw.run("SELECT count(*) FROM lineitem")
+        assert got.rows[0][0] == 50
+        # the finished flow is tombstoned on the gateway node
+        assert len(gw_node.cancelled_flows) == 1
+        dead = next(iter(gw_node.cancelled_flows))
+        # a straggler chunk for it is dropped: no inbox, no ack
+        transport.send(1, 0, ("flow_stream", dead, 0, b"x", False, None))
+        for _ in range(10):
+            if transport.deliver_all() == 0:
+                break
+        assert (dead, 0) not in gw_node.registry._inboxes
+        assert data_node.acks == {}
+
+    def test_gateway_fails_fast_on_tripped_peer(self):
+        """A breaker-tripped peer fails the flow at scheduling time
+        (CheckNodeHealthAndVersion), not after flow_timeout of
+        silence."""
+        transport, gw_node, data_node = self._two_node_fabric(rows=50)
+
+        class Monitor:
+            def healthy(self, n):
+                return n != 1
+
+        gw = Gateway(gw_node, [1], monitor=Monitor())
+        with pytest.raises(FlowError, match="unhealthy"):
+            gw.run("SELECT count(*) FROM lineitem")
+        # the sick node never even saw a SetupFlow
+        transport.deliver_all()
+        assert data_node.flows_run == 0
+
+
 class TestSerde:
     def test_roundtrip(self):
         rng = np.random.default_rng(0)

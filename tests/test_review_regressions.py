@@ -155,3 +155,30 @@ def test_prepared_derived_join_reexecutes():
     first = p.run().rows
     second = p.run().rows
     assert first == second == [(1, 10), (2, 20)]
+
+
+def test_cte_body_with_correlated_subquery_takes_row_path():
+    """Round-3 review: the columnar CTE fast path called
+    _prepare_select on the raw body, skipping the decorrelation /
+    view-expansion preprocessing _exec_select performs — a CTE whose
+    body holds a correlated subquery raised BindError instead of
+    executing (BindError is not fallback-eligible)."""
+    e = Engine()
+    e.execute("CREATE TABLE t (a INT, b INT)")
+    e.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 30)")
+    r = e.execute(
+        "WITH c AS (SELECT a FROM t WHERE b = (SELECT max(b) FROM t "
+        "AS t2 WHERE t2.a = t.a)) SELECT count(*) FROM c")
+    assert r.rows == [(2,)]
+
+
+def test_cte_body_over_view_expands():
+    """Same preprocessing gap, view flavor: a CTE selecting from a
+    view must expand the view before the columnar prepare."""
+    e = Engine()
+    e.execute("CREATE TABLE base (k INT PRIMARY KEY, v INT)")
+    e.execute("INSERT INTO base VALUES (1, 5), (2, 6)")
+    e.execute("CREATE VIEW vw AS SELECT k, v * 2 AS v2 FROM base")
+    r = e.execute("WITH c AS (SELECT v2 FROM vw) "
+                  "SELECT sum(v2) FROM c")
+    assert r.rows == [(22,)]
