@@ -168,10 +168,14 @@ class TableDescriptor:
 
 
 def _enc_type(t: SQLType) -> dict:
-    return {"family": t.family.value, "width": t.width,
-            "precision": t.precision, "scale": t.scale}
+    out = {"family": t.family.value, "width": t.width,
+           "precision": t.precision, "scale": t.scale}
+    if t.elem is not None:           # ARRAY element type
+        out["elem"] = _enc_type(t.elem)
+    return out
 
 
 def _dec_type(o: dict) -> SQLType:
     return SQLType(Family(o["family"]), width=o["width"],
-                   precision=o["precision"], scale=o["scale"])
+                   precision=o["precision"], scale=o["scale"],
+                   elem=_dec_type(o["elem"]) if "elem" in o else None)

@@ -146,7 +146,7 @@ def _string_union_cols(pairs) -> dict:
     out = {}
     for n, e in pairs:
         ty = getattr(e, "type", None)
-        if ty is not None and ty.family == Family.STRING:
+        if ty is not None and ty.uses_dictionary:
             if not isinstance(e, BCol):
                 raise DistUnsupported(
                     f"string output {n!r} is not a plain column")

@@ -331,7 +331,7 @@ class DMLMixin:
         for col in td.schema.columns:
             vals = [r.get(col.name) for r in rows]
             v = np.array([x is not None for x in vals], dtype=bool)
-            if col.type.family == Family.STRING:
+            if col.type.uses_dictionary:
                 d = td.dictionaries[col.name]
                 arr = np.fromiter(
                     (d.encode(x) if x is not None else 0 for x in vals),
@@ -609,7 +609,9 @@ class DMLMixin:
                     "not supported")
             b = binder.bind(e)
             if isinstance(b, BConst) and isinstance(b.value, str) \
-                    and col.type.family == Family.STRING:
+                    and col.type.uses_dictionary:
+                if col.type.family != Family.STRING:
+                    b = binder.coerce(b, col.type)  # canonicalize datum
                 code = td.dictionaries[cname].encode(b.value)
                 assigned[cname] = ("const", code)
             elif isinstance(b, BConst):
@@ -682,7 +684,7 @@ class DMLMixin:
                         cn = c.name
                         if not valid[cn][j]:
                             new[cn] = None
-                        elif c.type.family == Family.STRING:
+                        elif c.type.uses_dictionary:
                             new[cn] = td.dictionaries[cn].values[
                                 int(data[cn][j])]
                         else:

@@ -804,7 +804,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         def is_string_col(table, col):
             try:
                 sch = self.store.table(table).schema
-                return sch.column(col).type.family == Family.STRING
+                return sch.column(col).type.uses_dictionary
             except KeyError:
                 return True   # unknown: refuse the min/max trick
         sel = decorrelate_exists(sel, columns_of, is_string_col)
@@ -962,7 +962,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 else:
                     arr = np.asarray(out.col(oname))[sel]
                     v = np.asarray(out.col_valid(oname))[sel]
-                if ty.family == Family.STRING:
+                if ty.uses_dictionary:
                     d = meta.dictionaries.get(oname)
                     if d is None:
                         raise EngineError(
@@ -1023,6 +1023,13 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             if f == Family.STRING:
                 arr = np.array([x if x is not None else "" for x in vals],
                                dtype=object)
+            elif f in (Family.ARRAY, Family.JSON):
+                # decoded rows hold python lists/dicts: re-canonicalize
+                from ..sql import datum as dtm
+                arr = np.array(
+                    [(dtm.canon_array(x, ty.elem) if f == Family.ARRAY
+                      else dtm.canon_json(x)) if x is not None else ""
+                     for x in vals], dtype=object)
             elif f == Family.DATE:
                 arr = np.array(
                     [(x - EPOCH_DATE).days if isinstance(x, datetime.date)
@@ -1542,6 +1549,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         if len(sel.items) != 1 or sel.items[0].star:
             return None
         e = sel.items[0].expr
+        if isinstance(e, ast.FuncCall) and e.name == "unnest":
+            return self._exec_unnest(sel, e, binder)
         if not (isinstance(e, ast.FuncCall)
                 and e.name == "generate_series"):
             return None
@@ -1574,6 +1583,41 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             rows = rows[:sel.limit]
         from ..sql.types import INT8
         return Result(names=[name], rows=rows, types=[INT8])
+
+    def _exec_unnest(self, sel: ast.Select, e: ast.FuncCall,
+                     binder: Binder):
+        """SELECT unnest(ARRAY[...]) — constant-array SRF, table-free
+        context (pg's unnest over a column needs a lateral row
+        explosion; materialize via a CTE + join instead)."""
+        from ..sql import datum as dtm
+        from ..sql.types import Family
+        if sel.where is not None or sel.distinct or sel.group_by \
+                or sel.having:
+            raise EngineError(
+                "unnest supports only ORDER BY/LIMIT/OFFSET here "
+                "(materialize it in a CTE for WHERE/GROUP BY)")
+        if len(e.args) != 1:
+            raise EngineError("unnest(array)")
+        b = binder.bind(e.args[0])
+        if not isinstance(b, BConst):
+            raise EngineError(
+                "unnest over columns is not supported (constant "
+                "arrays only)")
+        name = sel.items[0].alias or "unnest"
+        if b.value is None:
+            return Result(names=[name], rows=[], types=[b.type.elem
+                          if b.type.family == Family.ARRAY else b.type])
+        if b.type.family != Family.ARRAY:
+            raise EngineError("unnest needs an array argument")
+        vals = dtm.parse_array(b.value, b.type.elem)
+        rows = [(v,) for v in vals]
+        if sel.order_by:
+            rows = self._sort_decoded(rows, [name], sel.order_by)
+        if sel.offset:
+            rows = rows[sel.offset:]
+        if sel.limit is not None:
+            rows = rows[:sel.limit]
+        return Result(names=[name], rows=rows, types=[b.type.elem])
 
     def _exec_table_free(self, sel: ast.Select,
                          session: Session | None = None) -> Result:
@@ -1610,6 +1654,10 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     v = EPOCH_DATE + datetime.timedelta(days=int(v))
                 elif b.type.family == Family.TIMESTAMP and v is not None:
                     v = EPOCH_DT + datetime.timedelta(microseconds=int(v))
+                elif b.type.family in (Family.ARRAY, Family.JSON) \
+                        and v is not None:
+                    from ..sql import datum as dtm
+                    v = dtm.decode_text(v, b.type)
                 row.append(v)
                 types.append(b.type)
                 continue

@@ -223,11 +223,15 @@ def compile_expr(e: BExpr) -> CompiledExpr:
     if isinstance(e, BDictGather):
         xf = compile_expr(e.expr)
         tbl = np.asarray(e.table)
+        ntbl = (np.asarray(e.null_table, dtype=bool)
+                if e.null_table is not None else None)
 
         def f_gather(ctx):
             d, v = xf(ctx)
             lut = jnp.asarray(tbl)
             codes = jnp.clip(d, 0, tbl.shape[0] - 1)
+            if ntbl is not None:
+                v = v & _small_lut(ntbl, codes)
             return lut[codes], v
         return f_gather
 
@@ -244,10 +248,14 @@ def compile_expr(e: BExpr) -> CompiledExpr:
     if isinstance(e, BDictRemap):
         xf = compile_expr(e.expr)
         rtbl = np.asarray(e.table, dtype=np.int32)
+        ntbl = (np.asarray(e.null_table, dtype=bool)
+                if e.null_table is not None else None)
 
         def f_remap(ctx):
             d, v = xf(ctx)
             codes = jnp.clip(d, 0, rtbl.shape[0] - 1)
+            if ntbl is not None:
+                v = v & _small_lut(ntbl, codes)
             return _small_lut(rtbl, codes), v
         return f_remap
 

@@ -149,6 +149,10 @@ def _render_create(desc) -> str:
                  "timestamp": "TIMESTAMP", "interval": "INTERVAL"}
         if f == "decimal":
             return f"DECIMAL({t.precision},{t.scale})"
+        if f == "array":
+            return f"{ty(t.elem)}[]"
+        if f == "json":
+            return "JSONB"
         return names.get(f, f.upper())
 
     parts = []
@@ -345,6 +349,10 @@ def _decode_storage_value(v, ty):
     if v is None:
         return None
     if isinstance(v, str):
+        if ty.family in (Family.ARRAY, Family.JSON):
+            # datum columns extract as their canonical text
+            from ..sql import datum as dtm
+            return dtm.decode_text(v, ty)
         return v
     return _decode_scalar(v, True, ty, None)
 
@@ -362,6 +370,11 @@ def _decode_scalar(v, valid: bool, ty, dictionary):
     if f == Family.STRING:
         if dictionary is not None:
             return dictionary.values[int(v)]
+        return int(v)
+    if f in (Family.ARRAY, Family.JSON):
+        if dictionary is not None:
+            from ..sql import datum as dtm
+            return dtm.decode_text(dictionary.values[int(v)], ty)
         return int(v)
     if f == Family.BOOL:
         return bool(v)

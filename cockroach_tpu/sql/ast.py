@@ -77,6 +77,19 @@ class Cast(Expr):
 
 
 @dataclass
+class Subscript(Expr):
+    """``arr[i]`` — 1-based array element access (pg semantics)."""
+    expr: Expr
+    index: Expr
+
+
+@dataclass
+class ArrayLit(Expr):
+    """``ARRAY[e1, e2, ...]`` constructor."""
+    items: list[Expr]
+
+
+@dataclass
 class FuncCall(Expr):
     name: str  # lowercased
     args: list[Expr]

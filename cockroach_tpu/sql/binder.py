@@ -26,9 +26,11 @@ from typing import Optional
 import numpy as np
 
 from . import ast
+from . import datum as dtm
 from .bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce, BCol,
-                    BConst, BDictLookup, BDictRemap, BExpr, BExtract, BInList,
-                    BIsNull, BoundAgg, BoundWindow, BUnary, BWinRef)
+                    BConst, BDictGather, BDictLookup, BDictRemap, BExpr,
+                    BExtract, BInList, BIsNull, BoundAgg, BoundWindow,
+                    BUnary, BWinRef)
 from .types import (BOOL, DATE, FLOAT8, INT8, INTERVAL, STRING, TIMESTAMP,
                     Family, SQLType, common_numeric_type)
 
@@ -225,6 +227,10 @@ class Binder:
             return BIsNull(self.bind(e.expr), e.negated, BOOL)
         if isinstance(e, ast.Case):
             return self.bind_case(e)
+        if isinstance(e, ast.Subscript):
+            return self.bind_subscript(e)
+        if isinstance(e, ast.ArrayLit):
+            return self.bind_array_lit(e)
         if isinstance(e, ast.Cast):
             return self.bind_cast(self.bind(e.expr), e.to)
         if isinstance(e, ast.FuncCall):
@@ -399,6 +405,21 @@ class Binder:
         f = target.family
         if v is None:
             return BConst(None, target)
+        if f in (Family.JSON, Family.ARRAY):
+            if e.type.family == f:
+                # re-canonicalize (e.g. INT[] -> FLOAT[] not supported;
+                # same family means text is already canonical)
+                return BConst(v, target) if e.type == target else \
+                    BConst(dtm.canon_text(str(v), target), target)
+            if isinstance(v, str):
+                try:
+                    return BConst(dtm.canon_text(v, target), target)
+                except dtm.DatumError as err:
+                    raise BindError(str(err)) from None
+            raise BindError(f"cannot convert constant {v!r} to {target}")
+        if e.type.family in (Family.JSON, Family.ARRAY) \
+                and f == Family.STRING:
+            return BConst(str(v), STRING)
         if f == Family.DECIMAL:
             if e.type.family == Family.DECIMAL:
                 return self._rescale_decimal(e, target.scale)
@@ -520,6 +541,21 @@ class Binder:
                     raise BindError("interval - date is invalid")
                 return self._fold_interval(a, b.value, sign)
 
+        # json/array operators and datum-typed operands take the
+        # dictionary-LUT path (host-precomputed per-entry tables)
+        datum_fams = (Family.JSON, Family.ARRAY)
+        if op in ("->", "->>", "@>", "<@", "?") or (
+                op in ("=", "!=", "<>", "||")
+                and (l.type.family in datum_fams
+                     or r.type.family in datum_fams)):
+            return self._bind_datum_op("!=" if op == "<>" else op, l, r)
+        if op in ("<", "<=", ">", ">=") and (
+                l.type.family in datum_fams
+                or r.type.family in datum_fams):
+            raise BindError(
+                "array/jsonb values are not orderable here (codes "
+                "order by insertion, not value; only =/!= supported)")
+
         if op in ("=", "!=", "<>", "<", "<=", ">", ">="):
             if op == "<>":
                 op = "!="
@@ -608,7 +644,7 @@ class Binder:
         d = getattr(e, "dictionary", None)
         if d is not None:
             return d
-        if isinstance(e, BCol) and e.type.family == Family.STRING:
+        if isinstance(e, BCol) and e.type.uses_dictionary:
             for t in self.scope.tables.values():
                 for b in t.values():
                     if b.batch_name == e.name:
@@ -686,6 +722,357 @@ class Binder:
         table = np.fromiter((rx.match(v) is not None for v in d.values),
                             dtype=bool, count=len(d.values))
         return BDictLookup(col, table, BOOL)
+
+    # -- datum types (ARRAY / JSONB) over dictionaries ------------------------
+    #
+    # Same playbook as strings: each distinct value is interned under
+    # its canonical text (sql/datum.py), so per-row operators become
+    # host-precomputed tables over the dictionary — one
+    # BDictLookup/BDictRemap/BDictGather on device. The reference
+    # instead walks per-element host objects through tree.Datum
+    # (coldata/datum_vec.go, util/json) — per-row host work we never do.
+
+    _MISSING = object()
+
+    def _datum_dict(self, col: BExpr):
+        d = self._dict_of(col)
+        if d is None:
+            raise BindError(
+                f"{col.type} operator on a column with no dictionary")
+        parsed = [dtm.decode_text(v, col.type) for v in d.values]
+        return d, parsed
+
+    @staticmethod
+    def _json_get(pv, key):
+        """jsonb -> field/element access; _MISSING when absent."""
+        if isinstance(pv, dict) and isinstance(key, str):
+            return pv.get(key, Binder._MISSING)
+        if isinstance(pv, list) and isinstance(key, int) \
+                and not isinstance(key, bool):
+            i = key if key >= 0 else len(pv) + key
+            return pv[i] if 0 <= i < len(pv) else Binder._MISSING
+        return Binder._MISSING
+
+    @staticmethod
+    def _json_contains(a, b) -> bool:
+        """jsonb @> containment (pg semantics, recursive)."""
+        if isinstance(a, dict) and isinstance(b, dict):
+            return all(k in a and Binder._json_contains(a[k], v)
+                       for k, v in b.items())
+        if isinstance(a, list):
+            if isinstance(b, list):
+                return all(any(Binder._json_contains(x, y) for x in a)
+                           for y in b)
+            # a scalar is contained in a top-level array (pg quirk)
+            return any(Binder._json_contains(x, b) for x in a)
+        return a == b
+
+    def _datum_rhs_value(self, r: BConst, ty):
+        """Parse the constant right operand of a datum operator."""
+        if r.value is None:
+            return None
+        if r.type.family in (Family.JSON, Family.ARRAY):
+            return dtm.decode_text(r.value, r.type)
+        if ty.family == Family.JSON and isinstance(r.value, str) \
+                and r.type.family == Family.STRING:
+            # bare string literal on @>/? : treat as jsonb when it
+            # parses ('{"a":1}'), else as a key string
+            return r.value
+        return r.value
+
+    def _bind_datum_op(self, op: str, l: BExpr, r: BExpr) -> BExpr:
+        from ..storage.columnstore import Dictionary
+        if op == "<@":
+            return self._bind_datum_op("@>", r, l)
+        if op in ("=", "!="):
+            return self._datum_eq(op, l, r)
+        if op == "||":
+            return self._datum_concat(l, r)
+        # -> / ->> / @> / ? : constant right operand required (the LUT
+        # is precomputed per dictionary entry)
+        if isinstance(l, BConst) and isinstance(r, BConst):
+            return self._fold_datum_op(op, l, r)
+        if not isinstance(r, BConst):
+            raise BindError(f"{op} requires a constant right operand")
+        if l.type.family not in (Family.JSON, Family.ARRAY):
+            raise BindError(f"{op} on {l.type}")
+        if r.value is None:
+            # NULL result types: predicates are BOOL, ->> is text,
+            # -> keeps the datum type (matches the fold path)
+            return BConst(None, BOOL if op in ("@>", "?")
+                          else STRING if op == "->>" else l.type)
+        d, parsed = self._datum_dict(l)
+        rv = self._datum_rhs_value(r, l.type)
+
+        if op in ("->", "->>"):
+            if l.type.family != Family.JSON:
+                raise BindError(f"{op} on {l.type}")
+            if isinstance(r.value, int) and r.type.family == Family.INT:
+                key = int(r.value)
+            elif isinstance(rv, str):
+                key = rv
+            else:
+                raise BindError(f"{op} key must be a string or integer")
+            results = [self._json_get(pv, key) for pv in parsed]
+            if op == "->":
+                d2 = Dictionary()
+                table = np.fromiter(
+                    (d2.encode(dtm.canon_json(res))
+                     if res is not Binder._MISSING else -1
+                     for res in results),
+                    dtype=np.int32, count=len(results))
+                nulls = np.fromiter(
+                    (res is not Binder._MISSING for res in results),
+                    dtype=bool, count=len(results))
+                out = BDictRemap(l, table, SQLType.json(),
+                                 null_table=nulls)
+                out.dictionary = d2
+                return out
+            # ->> : text, with JSON null and missing both SQL NULL
+            d2 = Dictionary()
+            texts = [None if res is Binder._MISSING or res is None
+                     else (res if isinstance(res, str)
+                           else dtm.canon_json(res))
+                     for res in results]
+            table = np.fromiter(
+                (d2.encode(t) if t is not None else -1 for t in texts),
+                dtype=np.int32, count=len(texts))
+            nulls = np.fromiter((t is not None for t in texts),
+                                dtype=bool, count=len(texts))
+            out = BDictRemap(l, table, STRING, null_table=nulls)
+            out.dictionary = d2
+            return out
+
+        if op == "@>":
+            if l.type.family == Family.JSON:
+                if isinstance(rv, str) and r.type.family == Family.STRING:
+                    rv = dtm.parse_json(rv)
+                table = np.fromiter(
+                    (self._json_contains(pv, rv) for pv in parsed),
+                    dtype=bool, count=len(parsed))
+            else:
+                if not isinstance(rv, list):
+                    raise BindError("array @> needs an array operand")
+                table = np.fromiter(
+                    (all(y in pv for y in rv) for pv in parsed),
+                    dtype=bool, count=len(parsed))
+            return BDictLookup(l, table, BOOL)
+
+        if op == "?":
+            if not isinstance(rv, str):
+                raise BindError("? needs a string key")
+
+            def has_key(pv):
+                if isinstance(pv, dict):
+                    return rv in pv
+                if isinstance(pv, list):
+                    return rv in pv
+                return pv == rv
+            table = np.fromiter((has_key(pv) for pv in parsed),
+                                dtype=bool, count=len(parsed))
+            return BDictLookup(l, table, BOOL)
+
+        raise BindError(f"unsupported datum operator {op}")
+
+    def _datum_eq(self, op: str, l: BExpr, r: BExpr) -> BExpr:
+        if isinstance(l, BConst) and not isinstance(r, BConst):
+            l, r = r, l
+        if isinstance(l, BConst) and isinstance(r, BConst):
+            if l.value is None or r.value is None:
+                return BConst(None, BOOL)
+            eq = str(l.value) == str(r.value)  # canonical text
+            return BConst(eq if op == "=" else not eq, BOOL)
+        if isinstance(r, BConst):
+            if r.value is None:
+                return BConst(None, BOOL)
+            d = self._dict_of(l)
+            if d is None:
+                raise BindError("datum compare on non-dictionary column")
+            text = r.value if r.type.family in (Family.JSON, Family.ARRAY) \
+                else dtm.canon_text(str(r.value), l.type)
+            code = d.codes.get(text)
+            if code is None:
+                return BConst(op == "!=", BOOL)
+            return BBin(op, l, BConst(code, l.type), BOOL)
+        # col-col: same dictionary -> direct code compare; else remap
+        dl, dr = self._dict_of(l), self._dict_of(r)
+        if dl is None or dr is None:
+            raise BindError("datum compare on non-dictionary column")
+        if dl is dr:
+            return BBin(op, l, r, BOOL)
+        table = np.fromiter((dl.codes.get(v, -1) for v in dr.values),
+                            dtype=np.int32, count=len(dr.values))
+        return BBin(op, l, BDictRemap(r, table, l.type), BOOL)
+
+    def _datum_concat(self, l: BExpr, r: BExpr) -> BExpr:
+        from ..storage.columnstore import Dictionary
+        if isinstance(l, BConst) and not isinstance(r, BConst):
+            raise BindError("const || column arrays not supported")
+        if (isinstance(l, BConst) and l.value is None) or \
+                (isinstance(r, BConst) and r.value is None):
+            return BConst(None, l.type if not isinstance(l, BConst)
+                          or l.value is not None else r.type)
+        # jsonb || jsonb: a bare string literal operand must BE jsonb
+        # (pg rejects jsonb || text); parse it so '{"z":true}' merges
+        # as an object instead of appending as a scalar string
+        if l.type.family == Family.JSON and isinstance(r, BConst) \
+                and r.type.family == Family.STRING:
+            r = self._const_to(r, SQLType.json())
+        if isinstance(l, BConst) and isinstance(r, BConst):
+            if l.type.family == Family.ARRAY:
+                elem = l.type.elem
+                vals = dtm.parse_array(l.value, elem) + \
+                    dtm.parse_array(r.value, r.type.elem)
+                return BConst(dtm.canon_array(vals, elem), l.type)
+            a, b = dtm.parse_json(l.value), dtm.parse_json(r.value)
+            if isinstance(a, dict) and isinstance(b, dict):
+                return BConst(dtm.canon_json({**a, **b}), l.type)
+            la = a if isinstance(a, list) else [a]
+            lb = b if isinstance(b, list) else [b]
+            return BConst(dtm.canon_json(la + lb), l.type)
+        if not isinstance(r, BConst):
+            raise BindError("array || array needs a constant operand")
+        d, parsed = self._datum_dict(l)
+        rv = self._datum_rhs_value(r, l.type)
+        d2 = Dictionary()
+        if l.type.family == Family.ARRAY:
+            if not isinstance(rv, list):
+                rv = [rv]
+            texts = [dtm.canon_array(pv + rv, l.type.elem)
+                     for pv in parsed]
+        else:
+            def joinj(pv):
+                if isinstance(pv, dict) and isinstance(rv, dict):
+                    return dtm.canon_json({**pv, **rv})
+                la = pv if isinstance(pv, list) else [pv]
+                lb = rv if isinstance(rv, list) else [rv]
+                return dtm.canon_json(la + lb)
+            texts = [joinj(pv) for pv in parsed]
+        table = np.fromiter((d2.encode(t) for t in texts),
+                            dtype=np.int32, count=len(texts))
+        out = BDictRemap(l, table, l.type)
+        out.dictionary = d2
+        return out
+
+    def bind_subscript(self, e: ast.Subscript) -> BExpr:
+        x = self.bind(e.expr)
+        if x.type.family == Family.JSON:
+            return self._bind_datum_op("->", x, self.bind(e.index))
+        if x.type.family != Family.ARRAY:
+            raise BindError(f"cannot subscript {x.type}")
+        idx = self.bind(e.index)
+        if not isinstance(idx, BConst) or \
+                idx.type.family != Family.INT:
+            raise BindError("array index must be a constant integer")
+        i = int(idx.value)
+        elem = x.type.elem
+        if isinstance(x, BConst):
+            if x.value is None:
+                return BConst(None, elem)
+            vals = dtm.parse_array(x.value, elem)
+            v = vals[i - 1] if 1 <= i <= len(vals) else None
+            return self._elem_const(v, elem)
+        d, parsed = self._datum_dict(x)
+        picks = [pv[i - 1] if 1 <= i <= len(pv) else None
+                 for pv in parsed]
+        return self._elem_lut(x, picks, elem)
+
+    def _elem_const(self, v, elem: SQLType) -> BConst:
+        if v is None:
+            return BConst(None, elem)
+        if elem.family == Family.DECIMAL:
+            return BConst(int(round(float(v) * 10 ** elem.scale)), elem)
+        return BConst(v, elem)
+
+    def _elem_lut(self, col: BExpr, picks: list, elem: SQLType) -> BExpr:
+        """Per-dictionary-entry element values -> one typed LUT node."""
+        from ..storage.columnstore import Dictionary
+        nulls = np.fromiter((p is not None for p in picks),
+                            dtype=bool, count=len(picks))
+        if elem.family == Family.STRING:
+            d2 = Dictionary()
+            table = np.fromiter(
+                (d2.encode(p) if p is not None else -1 for p in picks),
+                dtype=np.int32, count=len(picks))
+            out = BDictRemap(col, table, STRING, null_table=nulls)
+            out.dictionary = d2
+            return out
+        if elem.family == Family.DECIMAL:
+            vals = [int(round(float(p) * 10 ** elem.scale))
+                    if p is not None else 0 for p in picks]
+        elif elem.family == Family.FLOAT:
+            vals = [float(p) if p is not None else 0.0 for p in picks]
+        elif elem.family == Family.BOOL:
+            vals = [bool(p) if p is not None else False for p in picks]
+        else:
+            vals = [int(p) if p is not None else 0 for p in picks]
+        table = np.asarray(vals, dtype=elem.np_dtype)
+        return BDictGather(col, table, elem, null_table=nulls)
+
+    def bind_array_lit(self, e: ast.ArrayLit) -> BExpr:
+        items = [self.bind(i) for i in e.items]
+        if not all(isinstance(b, BConst) for b in items):
+            raise BindError(
+                "ARRAY[...] elements must be constants (arrays built "
+                "from row values are not supported)")
+        fams = {b.type.family for b in items
+                if b.type.family != Family.UNKNOWN}
+        if not fams:
+            elem = INT8
+        elif fams <= {Family.INT}:
+            elem = INT8
+        elif fams <= {Family.INT, Family.FLOAT, Family.DECIMAL}:
+            elem = FLOAT8
+        elif fams == {Family.STRING}:
+            elem = STRING
+        elif fams == {Family.BOOL}:
+            elem = BOOL
+        else:
+            raise BindError(f"mixed array element types {fams}")
+        vals = []
+        for b in items:
+            if b.value is None:
+                vals.append(None)
+            elif b.type.family == Family.DECIMAL:
+                vals.append(b.value / 10 ** b.type.scale)
+            else:
+                vals.append(b.value)
+        return BConst(dtm.canon_array(vals, elem), SQLType.array(elem))
+
+    def _fold_datum_op(self, op: str, l: BConst, r: BConst) -> BConst:
+        if l.value is None or r.value is None:
+            return BConst(None, BOOL if op in ("@>", "?")
+                          else STRING if op == "->>" else l.type)
+        lv = dtm.decode_text(l.value, l.type)
+        rv = self._datum_rhs_value(r, l.type)
+        if op in ("->", "->>"):
+            key = int(r.value) if (isinstance(r.value, int)
+                                   and r.type.family == Family.INT) else rv
+            res = self._json_get(lv, key)
+            if res is Binder._MISSING:
+                return BConst(None, SQLType.json() if op == "->"
+                              else STRING)
+            if op == "->":
+                return BConst(dtm.canon_json(res), SQLType.json())
+            if res is None:
+                return BConst(None, STRING)
+            return BConst(res if isinstance(res, str)
+                          else dtm.canon_json(res), STRING)
+        if op == "@>":
+            if l.type.family == Family.JSON:
+                if isinstance(rv, str):
+                    rv = dtm.parse_json(rv)
+                return BConst(self._json_contains(lv, rv), BOOL)
+            if not isinstance(rv, list):
+                raise BindError("array @> needs an array operand")
+            return BConst(all(y in lv for y in rv), BOOL)
+        if op == "?":
+            if not isinstance(rv, str):
+                raise BindError("? needs a string key")
+            if isinstance(lv, (dict, list)):
+                return BConst(rv in lv, BOOL)
+            return BConst(lv == rv, BOOL)
+        raise BindError(f"unsupported datum operator {op}")
 
     # -- IN / CASE / CAST ------------------------------------------------------
     def bind_in(self, e: ast.InList) -> BExpr:
@@ -768,6 +1155,21 @@ class Binder:
     def bind_cast(self, x: BExpr, to: SQLType) -> BExpr:
         if x.type.family == to.family and x.type == to:
             return x
+        if x.type.family in (Family.JSON, Family.ARRAY) \
+                and to.family == Family.STRING and not isinstance(x, BConst):
+            # datum::TEXT — the stored canonical text IS the result;
+            # identity remap re-types the codes under a string dict
+            from ..storage.columnstore import Dictionary
+            d = self._dict_of(x)
+            if d is None:
+                raise BindError("cast on non-dictionary datum column")
+            d2 = Dictionary()
+            table = np.fromiter((d2.encode(v) for v in d.values),
+                                dtype=np.int32,
+                                count=len(d.values))
+            out = BDictRemap(x, table, STRING)
+            out.dictionary = d2
+            return out
         if isinstance(x, BConst):
             return self._const_to(x, to)
         if to.family == Family.FLOAT:

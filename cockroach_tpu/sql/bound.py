@@ -111,10 +111,15 @@ class BDictLookup(BExpr):
 class BDictRemap(BExpr):
     """remap_table[codes] — translate one string column's dictionary
     codes into another column's code space (for cross-table string
-    equality, e.g. join keys); absent values map to -1 (never match)."""
+    equality, e.g. join keys); absent values map to -1 (never match).
+    ``null_table`` (optional bool[len(dict)], True=non-null) marks
+    entries whose RESULT is SQL NULL — json/array operators like
+    ``j->'missing'`` yield NULL per dictionary entry; it ANDs into the
+    output validity on device."""
     expr: BExpr
     table: object = None  # np.ndarray int32[len(src dictionary)]
     type: SQLType = None
+    null_table: object = None  # np.ndarray bool[len(src dictionary)]
 
 
 @dataclass
@@ -134,10 +139,12 @@ class BDictGather(BExpr):
     (sql/builtins.py); on device it is one typed gather. Generalizes
     BDictLookup (bool tables) to arbitrary result types: length() is an
     int64 table, upper() is a code table into a NEW output dictionary
-    (carried in .dictionary)."""
+    (carried in .dictionary). ``null_table`` as in BDictRemap: entries
+    whose result is SQL NULL (e.g. arr[i] past the end)."""
     expr: BExpr
     table: object = None  # np.ndarray[len(dictionary)] of type's dtype
     type: SQLType = None
+    null_table: object = None  # np.ndarray bool[len(dictionary)]
     # output Dictionary for string results. repr=False: two binds of
     # the same expression build distinct Dictionary objects, and the
     # planner matches group exprs structurally by repr

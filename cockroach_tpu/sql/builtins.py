@@ -71,6 +71,9 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
     """Resolve a builtin call; returns None if unknown (caller errors).
     ``binder`` provides coerce() and dictionary resolution; ``e`` is the
     original ast.FuncCall (for string-literal args)."""
+    if name in _DATUM_FNS and args \
+            and args[0].type.family in (Family.ARRAY, Family.JSON):
+        return _datum_builtin(binder, name, args)
     if name in FLOAT_UNARY:
         if len(args) != 1:
             raise BuiltinError(f"{name} takes one argument")
@@ -441,3 +444,100 @@ def _dict_transform(binder, name, x, fn) -> BExpr:
     g = BDictGather(x, codes, STRING)
     g.dictionary = out
     return g
+
+
+# -- datum builtins (ARRAY / JSONB) ---------------------------------------
+# Same dictionary-LUT strategy as the string builtins above: the
+# function runs once per DICTIONARY ENTRY on the host (values parsed
+# from canonical text, sql/datum.py), and the device op is one typed
+# gather. The reference evaluates these per row through tree.Datum
+# (pkg/sql/sem/builtins/builtins.go json/array sections).
+
+def _jsonb_typeof(v):
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "array"
+    return "object"
+
+
+def _array_position(v, needle):
+    try:
+        return v.index(needle) + 1
+    except ValueError:
+        return None
+
+
+# name -> (fn(parsed, *const_args) -> value|None, result type, n_args,
+#           required argument family) — array builtins bind ONLY on
+# arrays and jsonb builtins only on jsonb, like pg's overload
+# resolution; the wrong family is a bind error, not silent garbage
+_DATUM_FNS = {
+    "array_length": (lambda v, dim: len(v) if dim == 1 and v else None,
+                     INT8, 2, Family.ARRAY),
+    "cardinality": (lambda v: len(v), INT8, 1, Family.ARRAY),
+    "array_position": (_array_position, INT8, 2, Family.ARRAY),
+    "array_to_string": (
+        lambda v, delim: delim.join(str(x) for x in v if x is not None),
+        STRING, 2, Family.ARRAY),
+    "jsonb_typeof": (_jsonb_typeof, STRING, 1, Family.JSON),
+    "json_typeof": (_jsonb_typeof, STRING, 1, Family.JSON),
+    "jsonb_array_length": (
+        lambda v: len(v) if isinstance(v, list) else None, INT8, 1,
+        Family.JSON),
+}
+
+
+def _datum_builtin(binder, name, args) -> BExpr:
+    from . import datum as dtm
+    from .bound import BDictRemap
+    from ..storage.columnstore import Dictionary
+    fn, ty, nargs, fam = _DATUM_FNS[name]
+    if len(args) != nargs:
+        raise BuiltinError(f"{name} takes {nargs} argument(s)")
+    x, consts = args[0], args[1:]
+    if x.type.family != fam:
+        raise BuiltinError(
+            f"{name} does not exist for argument type {x.type}")
+    cvals = []
+    for c in consts:
+        if not isinstance(c, BConst):
+            raise BuiltinError(
+                f"{name}: non-leading arguments must be constants")
+        if c.value is None:
+            return BConst(None, ty)
+        v = c.value
+        if c.type.family in (Family.ARRAY, Family.JSON):
+            v = dtm.decode_text(v, c.type)
+        cvals.append(v)
+    if name == "array_position" and x.type.family == Family.ARRAY \
+            and x.type.elem.family == Family.DECIMAL:
+        raise BuiltinError("array_position on decimal arrays unsupported")
+    if isinstance(x, BConst):
+        if x.value is None:
+            return BConst(None, ty)
+        return BConst(fn(dtm.decode_text(x.value, x.type), *cvals), ty)
+    d = binder._dict_of(x)
+    if d is None:
+        raise BuiltinError(f"{name} on non-dictionary column")
+    parsed = [dtm.decode_text(v, x.type) for v in d.values]
+    results = [fn(pv, *cvals) for pv in parsed]
+    nulls = np.fromiter((r is not None for r in results),
+                        dtype=bool, count=len(results))
+    if ty is STRING:
+        out = Dictionary()
+        table = np.fromiter(
+            (out.encode(r) if r is not None else -1 for r in results),
+            dtype=np.int32, count=len(results))
+        g = BDictRemap(x, table, STRING, null_table=nulls)
+        g.dictionary = out
+        return g
+    table = np.asarray([r if r is not None else 0 for r in results],
+                       dtype=bool if ty is BOOL else np.int64)
+    return BDictGather(x, table, ty, null_table=nulls)

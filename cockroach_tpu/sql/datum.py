@@ -1,0 +1,172 @@
+"""Canonical text codec for datum-backed types (ARRAY, JSONB).
+
+The reference's vectorized engine carries arrays and JSON as
+datum-backed vectors of host objects (``pkg/col/coldata/datum_vec.go``,
+``pkg/util/json``); every operator call crosses into per-element
+tree.Datum code. On a TPU there is no per-element host call — instead
+each distinct value is interned once into the column's dictionary
+under a CANONICAL serialization, so:
+
+- value equality  == code equality (GROUP BY / DISTINCT / joins on
+  arrays and jsonb run as int32 device programs, nothing host-side),
+- per-row operators (``j->>'k'``, ``arr[i]``, ``@>``) precompute one
+  result per DICTIONARY ENTRY on the host and ride the existing
+  BDictLookup/BDictRemap/BDictGather LUT nodes (exec/expr.py) — one
+  gather or one-hot MXU matmul per batch.
+
+Canonical forms:
+- ARRAY: pg array literal text with no spaces — ``{1,2,3}``,
+  ``{a,"b c",NULL}``. Strings are quoted only when needed, matching
+  pg's array_out so the text round-trips through real clients.
+- JSONB: ``json.dumps(..., sort_keys=True, separators=(",", ":"))``.
+  Sorted keys give jsonb's object semantics (key order insensitive,
+  duplicate keys keep the last) a unique text.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .types import Family, SQLType
+
+# characters that force quoting inside a pg array literal element
+_NEEDS_QUOTE = set(',{}"\\ \t\n')
+
+
+class DatumError(ValueError):
+    pass
+
+
+# -- JSONB ----------------------------------------------------------------
+
+def canon_json(value) -> str:
+    """Canonical jsonb text for an already-parsed JSON value."""
+    return json.dumps(value, sort_keys=True, separators=(",", ":"))
+
+
+def parse_json(text: str) -> object:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        raise DatumError(f"invalid JSON: {e}") from None
+
+
+def canon_json_text(text: str) -> str:
+    return canon_json(parse_json(text))
+
+
+# -- ARRAY ----------------------------------------------------------------
+
+def _elem_out(v, elem: SQLType) -> str:
+    if v is None:
+        return "NULL"
+    f = elem.family
+    if f == Family.BOOL:
+        return "t" if v else "f"
+    if f == Family.STRING:
+        s = str(v)
+        if s == "" or s.upper() == "NULL" or any(c in _NEEDS_QUOTE
+                                                 for c in s):
+            return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        return s
+    if f == Family.FLOAT:
+        return repr(float(v))
+    if f == Family.DECIMAL:
+        return f"{v:.{elem.scale}f}" if elem.scale else str(int(v))
+    return str(int(v))
+
+
+def canon_array(values: list, elem: SQLType) -> str:
+    """Canonical pg-style array text from a list of python values."""
+    return "{" + ",".join(_elem_out(v, elem) for v in values) + "}"
+
+
+def _elem_in(tok: Optional[str], quoted: bool, elem: SQLType):
+    if tok is None:
+        return None
+    if not quoted and tok.upper() == "NULL":
+        return None
+    f = elem.family
+    try:
+        if f == Family.BOOL:
+            return tok.lower() in ("t", "true", "1")
+        if f == Family.STRING:
+            return tok
+        if f == Family.FLOAT:
+            return float(tok)
+        if f == Family.DECIMAL:
+            return float(tok)
+        return int(tok)
+    except ValueError:
+        raise DatumError(
+            f"invalid array element {tok!r} for {elem}") from None
+
+
+def parse_array(text: str, elem: SQLType) -> list:
+    """Parse a pg array literal ``{...}`` into python values."""
+    s = text.strip()
+    if not (s.startswith("{") and s.endswith("}")):
+        raise DatumError(f"malformed array literal {text!r}")
+    body = s[1:-1]
+    out: list = []
+    if body == "":
+        return out
+    i, n = 0, len(body)
+    while i <= n:
+        # one element: quoted or bare, ending at , or end
+        if i < n and body[i] == '"':
+            i += 1
+            buf = []
+            while i < n:
+                c = body[i]
+                if c == "\\" and i + 1 < n:
+                    buf.append(body[i + 1])
+                    i += 2
+                    continue
+                if c == '"':
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+            out.append(_elem_in("".join(buf), True, elem))
+            if i < n and body[i] == ",":
+                i += 1
+            elif i >= n:
+                break
+        else:
+            j = body.find(",", i)
+            if j == -1:
+                j = n
+            tok = body[i:j].strip()
+            if tok.startswith("{"):
+                raise DatumError("nested arrays not supported")
+            out.append(_elem_in(tok, False, elem) if tok else None)
+            i = j + 1
+            if j == n:
+                break
+    return out
+
+
+def canon_array_text(text: str, elem: SQLType) -> str:
+    return canon_array(parse_array(text, elem), elem)
+
+
+# -- generic entry points -------------------------------------------------
+
+def canon_text(text: str, ty: SQLType) -> str:
+    """Canonicalize a literal's text for dictionary interning."""
+    if ty.family == Family.JSON:
+        return canon_json_text(text)
+    if ty.family == Family.ARRAY:
+        return canon_array_text(text, ty.elem)
+    raise DatumError(f"{ty} is not a datum type")
+
+
+def decode_text(text: str, ty: SQLType):
+    """Stored canonical text -> python value for result rows."""
+    if ty.family == Family.JSON:
+        return parse_json(text)
+    if ty.family == Family.ARRAY:
+        return parse_array(text, ty.elem)
+    raise DatumError(f"{ty} is not a datum type")

@@ -37,8 +37,10 @@ KEYWORDS = {
     "upsert",
 }
 
-MULTICHAR_OPS = ["<=", ">=", "<>", "!=", "||", "::"]
-SINGLE_OPS = "+-*/%(),.<>=;^"
+# longest first: the scanner takes the first startswith match
+MULTICHAR_OPS = ["->>", "->", "@>", "<@", "?|", "?&",
+                 "<=", ">=", "<>", "!=", "||", "::"]
+SINGLE_OPS = "+-*/%(),.<>=;^[]?"
 
 
 @dataclass

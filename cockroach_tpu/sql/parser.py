@@ -30,7 +30,9 @@ PRECEDENCE = {
     # NOT handled as prefix with bp 25
     "=": 40, "!=": 40, "<>": 40, "<": 40, "<=": 40, ">": 40, ">=": 40,
     "like": 40, "ilike": 40,
+    "@>": 42, "<@": 42, "?": 42,   # json/array containment + key-exists
     "||": 45,
+    "->": 65, "->>": 65,           # json access binds tighter than math
     "+": 50, "-": 50,
     "*": 60, "/": 60, "%": 60,
     "^": 70,  # below unary +/- (pg: -2 ^ 2 = (-2)^2 = 4)
@@ -44,6 +46,7 @@ TYPE_NAMES = {
     "double": FLOAT8, "date": DATE, "timestamp": TIMESTAMP,
     "timestamptz": TIMESTAMP, "interval": INTERVAL, "string": STRING,
     "text": STRING, "varchar": STRING, "char": STRING,
+    "jsonb": SQLType.json(), "json": SQLType.json(),
 }
 
 
@@ -452,6 +455,15 @@ class Parser:
                 else:
                     raise ParseError(f"expected NULL/TRUE/FALSE after IS at {self.peek()}")
                 continue
+            if t.kind == Tok.OP and t.text == "[":
+                # subscript binds tightest of the postfix operators
+                if 85 < min_bp:
+                    break
+                self.next()
+                idx = self.parse_expr()
+                self.expect_op("]")
+                left = ast.Subscript(left, idx)
+                continue
             op = None
             if t.kind == Tok.OP and t.text in PRECEDENCE:
                 op = t.text
@@ -626,6 +638,17 @@ class Parser:
             return ast.Substring(e, start, length)
         if t.kind in (Tok.IDENT, Tok.KEYWORD):
             name = t.text
+            if name.lower() == "array" and self.peek().kind == Tok.OP \
+                    and self.peek().text == "[":
+                self.next()
+                items = []
+                if not (self.peek().kind == Tok.OP
+                        and self.peek().text == "]"):
+                    items.append(self.parse_expr())
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                self.expect_op("]")
+                return ast.ArrayLit(items)
             # parenless special-syntax functions (SQL standard)
             if name in ("current_date", "current_timestamp") and not (
                     self.peek().kind == Tok.OP and self.peek().text == "("):
@@ -708,6 +731,9 @@ class Parser:
             if self.accept_op("("):  # varchar(n) etc. — length ignored
                 self.next()
                 self.expect_op(")")
+            if self.accept_op("["):  # INT[] / TEXT[] array types
+                self.expect_op("]")
+                ty = SQLType.array(ty)
             return ty
         raise ParseError(f"unknown type {name!r}")
 

@@ -695,6 +695,16 @@ class Planner:
                             meta.dictionaries[hname] = d
                 else:
                     raise PlanError("ORDER BY must reference output columns")
+            for kname, _ in keys:
+                if kname in out_names:
+                    kty = out_types[out_names.index(kname)]
+                    if not kty.is_orderable:
+                        # codes rank by dictionary insertion (and text
+                        # rank diverges from pg's elementwise array
+                        # order: text says {9} > {10}) — reject rather
+                        # than silently misorder
+                        raise PlanError(
+                            f"ORDER BY on {kty} is not supported")
             node = plan.Sort(node, keys)
         if sel.limit is not None or sel.offset is not None:
             node = plan.Limit(node, sel.limit, sel.offset or 0)
@@ -703,7 +713,7 @@ class Planner:
         meta.types = out_types
         # attach dictionaries for string outputs
         for name, ty in zip(out_names, out_types):
-            if ty.family == Family.STRING:
+            if ty.uses_dictionary:
                 d = self._find_dict_for_output(name, bound_items, group_exprs,
                                                scope, node)
                 if d is not None:
@@ -784,7 +794,7 @@ class Planner:
         for gname, ge in group_exprs:
             dependent = False
             if isinstance(ge, BCol) and "." in ge.name \
-                    and ge.type.family != Family.STRING:
+                    and not ge.type.uses_dictionary:
                 alias = ge.name.split(".", 1)[0]
                 # (a) a sibling group key is a unique key of this table
                 for kc in key_cols:
@@ -834,7 +844,7 @@ class Planner:
         dims = []
         los = []
         for _, e in group_exprs:
-            if isinstance(e, BCol) and e.type.family == Family.STRING:
+            if isinstance(e, BCol) and e.type.uses_dictionary:
                 d = self._dict_by_batch_name(e.name, scope)
                 if d is None:
                     return 0, [], []
@@ -945,7 +955,7 @@ def _encode_const_string_item(b: BExpr) -> BExpr:
     string builtin like trim(' x ')) compiles to dictionary code 0 +
     an ad-hoc one-entry output dictionary — the same representation
     CASE gives its constant string branches (binder.bind_case)."""
-    if isinstance(b, BConst) and b.type.family == Family.STRING \
+    if isinstance(b, BConst) and b.type.uses_dictionary \
             and isinstance(b.value, str) \
             and getattr(b, "dictionary", None) is None:
         from ..storage.columnstore import Dictionary

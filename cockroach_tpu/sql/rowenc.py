@@ -91,7 +91,9 @@ class RowCodec:
                 payload = struct.pack(">B", 1 if v else 0)
             elif f == Family.FLOAT:
                 payload = struct.pack(">d", float(v))
-            elif f in (Family.STRING, Family.BYTES):
+            elif f in (Family.STRING, Family.BYTES, Family.ARRAY,
+                       Family.JSON):
+                # datum families store their canonical text
                 payload = v.encode("utf-8") if isinstance(v, str) \
                     else bytes(v)
             else:  # INT / DECIMAL / DATE / TIMESTAMP / INTERVAL: int64
@@ -124,7 +126,7 @@ class RowCodec:
                 row[c.name] = bool(payload[0])
             elif f == Family.FLOAT:
                 (row[c.name],) = struct.unpack(">d", payload)
-            elif f == Family.STRING:
+            elif f in (Family.STRING, Family.ARRAY, Family.JSON):
                 row[c.name] = payload.decode("utf-8")
             elif f == Family.BYTES:
                 row[c.name] = payload

@@ -20,6 +20,18 @@ units, so every SQL type here is lowered to a fixed-width numeric
                 (offsets:int32, data:uint8) arena like coldata.Bytes
                 (pkg/col/coldata/bytes.go).
   INTERVAL   -> int64 microseconds
+  ARRAY/JSON -> int32 dictionary code over the value's CANONICAL text
+                serialization (pg array literal text / sorted-key
+                JSON). The reference keeps these as datum-backed
+                vectors even in its vectorized engine
+                (coldata/datum_vec.go) — per-element host objects.
+                Canonical text instead makes value equality equal
+                CODE equality, so GROUP BY/DISTINCT/joins on arrays
+                and jsonb compile to the same int32 device programs
+                as dictionary strings, and per-row operators
+                (j->>'k', arr[i], @>) become host-precomputed LUTs
+                over the small dictionary — one gather (or one-hot
+                MXU matmul) on device instead of per-row host calls.
 
 NULLs are carried as a separate validity bitmap per column (True=valid),
 matching coldata's Nulls (pkg/col/coldata/nulls.go) and Arrow.
@@ -44,6 +56,8 @@ class Family(enum.Enum):
     INTERVAL = "interval"
     STRING = "string"
     BYTES = "bytes"
+    ARRAY = "array"
+    JSON = "json"
     UNKNOWN = "unknown"  # NULL literal before type inference
 
 
@@ -53,6 +67,7 @@ class SQLType:
     width: int = 64  # bits for INT/FLOAT
     precision: int = 0  # DECIMAL precision
     scale: int = 0  # DECIMAL scale (digits after point)
+    elem: Optional["SQLType"] = None  # ARRAY element type
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -92,6 +107,14 @@ class SQLType:
         return SQLType(Family.BYTES)
 
     @staticmethod
+    def array(elem: "SQLType") -> "SQLType":
+        return SQLType(Family.ARRAY, width=32, elem=elem)
+
+    @staticmethod
+    def json() -> "SQLType":
+        return SQLType(Family.JSON, width=32)
+
+    @staticmethod
     def unknown() -> "SQLType":
         return SQLType(Family.UNKNOWN)
 
@@ -113,6 +136,8 @@ class SQLType:
             return np.dtype(np.int64)
         if f == Family.STRING:
             return np.dtype(np.int32)  # dictionary code
+        if f in (Family.ARRAY, Family.JSON):
+            return np.dtype(np.int32)  # canonical-text dictionary code
         if f == Family.BYTES:
             return np.dtype(np.uint8)  # arena bytes
         if f == Family.UNKNOWN:
@@ -125,7 +150,17 @@ class SQLType:
 
     @property
     def is_orderable(self) -> bool:
-        return self.family != Family.BYTES
+        # pg defines elementwise array / jsonb ordering; our codes
+        # order by insertion, so comparisons beyond =/!= are rejected
+        # cleanly at bind time rather than silently misordered
+        return self.family not in (Family.BYTES, Family.ARRAY,
+                                   Family.JSON)
+
+    @property
+    def uses_dictionary(self) -> bool:
+        """Physical column is an int32 code into a host dictionary
+        (STRING: the text itself; ARRAY/JSON: canonical text)."""
+        return self.family in (Family.STRING, Family.ARRAY, Family.JSON)
 
     def __str__(self) -> str:
         f = self.family
@@ -135,6 +170,10 @@ class SQLType:
             return "FLOAT4" if self.width <= 32 else "FLOAT8"
         if f == Family.DECIMAL:
             return f"DECIMAL({self.precision},{self.scale})"
+        if f == Family.ARRAY:
+            return f"{self.elem}[]"
+        if f == Family.JSON:
+            return "JSONB"
         return f.name
 
 
@@ -150,6 +189,7 @@ TIMESTAMP = SQLType.timestamp()
 INTERVAL = SQLType.interval()
 STRING = SQLType.string()
 BYTES = SQLType.bytes_()
+JSONB = SQLType.json()
 UNKNOWN = SQLType.unknown()
 
 

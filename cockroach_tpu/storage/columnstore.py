@@ -171,7 +171,7 @@ class ColumnStore:
                                       schema.table_id + 1)
             td = TableData(schema=schema, chunk_rows=self.chunk_rows)
             for col in schema.columns:
-                if col.type.family == Family.STRING:
+                if col.type.uses_dictionary:
                     td.dictionaries[col.name] = Dictionary()
                 td.open_rows[col.name] = []
             self.tables[schema.name] = td
@@ -220,7 +220,7 @@ class ColumnStore:
                         cols = dict(cols)
                         cols[cn] = np.full(
                             n, dv, dtype=object
-                            if col.type.family == Family.STRING
+                            if col.type.uses_dictionary
                             else None)
                     elif not col.nullable:
                         raise ValueError(f"missing non-null column {cn}")
@@ -229,9 +229,9 @@ class ColumnStore:
                         vmap[cn] = np.zeros(n, dtype=bool)
                         continue
                 raw = cols[cn]
-                if col.type.family == Family.STRING and raw.dtype.kind in ("U", "O", "S"):
+                if col.type.uses_dictionary and raw.dtype.kind in ("U", "O", "S"):
                     arr = td.dictionaries[cn].encode_array(raw)
-                elif (col.type.family == Family.STRING
+                elif (col.type.uses_dictionary
                       and raw.dtype.kind in ("i", "u")):
                     arr = np.asarray(raw, dtype=np.int32)
                     if arr.size and (int(arr.max()) >= len(td.dictionaries[cn])
@@ -291,7 +291,7 @@ class ColumnStore:
         for col in td.schema.columns:
             vals = td.open_rows[col.name]
             v = np.array([x is not None for x in vals], dtype=bool)
-            if col.type.family == Family.STRING:
+            if col.type.uses_dictionary:
                 d = td.dictionaries[col.name]
                 arr = np.fromiter(
                     (d.encode(x) if x is not None else 0 for x in vals),
@@ -346,7 +346,7 @@ class ColumnStore:
             for col in td.schema.columns:
                 vals = [r.get(col.name) for r, _t, _d in versions]
                 v = np.array([x is not None for x in vals], dtype=bool)
-                if col.type.family == Family.STRING:
+                if col.type.uses_dictionary:
                     d = td.dictionaries[col.name]
                     arr = np.fromiter(
                         (d.encode(x) if x is not None else 0
@@ -459,7 +459,7 @@ class ColumnStore:
                 raise ValueError(f"column {col.name!r} already exists")
             col.hidden = hidden
             td.schema.columns.append(col)
-            if col.type.family == Family.STRING:
+            if col.type.uses_dictionary:
                 td.dictionaries.setdefault(col.name, Dictionary())
             td.column_defaults = getattr(td, "column_defaults", {})
             if default is not None:
@@ -487,10 +487,10 @@ class ColumnStore:
             n = chunk.n
             if default is None:
                 chunk.data[colname] = np.zeros(n, dtype=(
-                    np.int32 if col.type.family == Family.STRING
+                    np.int32 if col.type.uses_dictionary
                     else col.type.np_dtype))
                 chunk.valid[colname] = np.zeros(n, dtype=bool)
-            elif col.type.family == Family.STRING:
+            elif col.type.uses_dictionary:
                 code = td.dictionaries[colname].encode(default)
                 chunk.data[colname] = np.full(n, code, dtype=np.int32)
                 chunk.valid[colname] = np.ones(n, dtype=bool)
@@ -559,7 +559,7 @@ class ColumnStore:
             cn = col.name
             if not chunk.valid[cn][ri]:
                 row[cn] = None
-            elif col.type.family == Family.STRING:
+            elif col.type.uses_dictionary:
                 row[cn] = td.dictionaries[cn].values[int(chunk.data[cn][ri])]
             else:
                 row[cn] = chunk.data[cn][ri].item()
@@ -577,7 +577,7 @@ class ColumnStore:
         for cn in codec.pk_cols:
             col = td.schema.column(cn)
             v = chunk.data[cn][ri]
-            if col.type.family == Family.STRING:
+            if col.type.uses_dictionary:
                 pk.append(td.dictionaries[cn].values[int(v)])
             else:
                 pk.append(v.item())
@@ -626,7 +626,7 @@ class ColumnStore:
         cn = codec.pk_cols[0]
         col = td.schema.column(cn)
         fam = col.type.family
-        if fam == Family.STRING:
+        if col.type.uses_dictionary:
             vals = td.dictionaries[cn].decode_array(
                 chunk.data[cn][ris])
             return native.batch_encode_str_keys(prefix, list(vals))
@@ -754,7 +754,7 @@ class ColumnStore:
                 for cn in cols:
                     valid &= chunk.valid[cn]
                     col = td.schema.column(cn)
-                    if col.type.family == Family.STRING:
+                    if col.type.uses_dictionary:
                         arrs.append(td.dictionaries[cn].decode_array(
                             chunk.data[cn]))
                     else:
@@ -789,7 +789,7 @@ class ColumnStore:
                 for cn in cols:
                     valid &= chunk.valid[cn]
                     col = td.schema.column(cn)
-                    if col.type.family == Family.STRING:
+                    if col.type.uses_dictionary:
                         arrs.append(td.dictionaries[cn].decode_array(
                             chunk.data[cn]))
                     else:

@@ -50,9 +50,9 @@ def validate_table(store, name: str) -> None:
                 seen_rowids.add(rid)
     for col in td.schema.columns:
         from ..sql.types import Family
-        if col.type.family == Family.STRING:
+        if col.type.uses_dictionary:
             assert col.name in td.dictionaries, \
-                f"{name}: string column {col.name} has no dictionary"
+                f"{name}: dict-encoded column {col.name} has no dictionary"
 
 
 def validate_replica(rep) -> None:

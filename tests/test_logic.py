@@ -57,6 +57,11 @@ def _fmt(v) -> str:
         return s if s not in ("", "-") else "0"
     if isinstance(v, (datetime.date, datetime.datetime)):
         return v.isoformat()
+    if isinstance(v, (list, dict)):
+        # datum results (arrays/jsonb) render as compact JSON so the
+        # whitespace-delimited expectation format stays unambiguous
+        import json
+        return json.dumps(v, sort_keys=True, separators=(",", ":"))
     return str(v)
 
 
