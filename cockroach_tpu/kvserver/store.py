@@ -463,32 +463,55 @@ class Replica:
                 return True
             return {"ok": True, "wts": _enc_ts(txn.write_ts)}
         if o == "txn_record":
-            # Conditional transaction-record write, the atomic moment of
-            # the push/commit protocol (batcheval/cmd_push_txn.go,
-            # cmd_end_transaction.go). Evaluated below raft so every
+            # Conditional transaction-record state machine, the atomic
+            # moment of the push/commit protocol
+            # (batcheval/cmd_push_txn.go, cmd_end_transaction.go,
+            # cmd_recover_txn.go). Evaluated below raft so every
             # replica decides identically in log order:
-            #   status=committed  -> fails if a pusher already poisoned
-            #                        the record ABORTED
-            #   status=aborted    -> keeps an existing COMMITTED record
-            #                        (pushing a committed txn resolves
-            #                        to its commit ts instead)
+            #   absent   -> any status writes (committed / aborted /
+            #               staging)
+            #   staging  -> may transition to committed (explicit
+            #               commit, or recovery finding every declared
+            #               write present) or aborted (recovery finding
+            #               one missing); idempotent re-stage allowed
+            #   committed/aborted -> terminal; a different status
+            #               reports the existing record instead
             key = op["key"].encode("latin1")
             want = op["status"]
             mv = self.mvcc.get(key, MAX_TIMESTAMP, inconsistent=True)
             if mv is not None:
                 existing = json.loads(mv.value.decode())
-                if existing["status"] != want:
-                    return {"ok": False, "existing": existing["status"],
+                ex = existing["status"]
+                if ex == want:
+                    # idempotent retry: report the applied record's ts
+                    # so a re-committed txn adopts it instead of
+                    # minting a new one
+                    return {"ok": True, "existing": ex,
                             "existing_ts": existing["ts"]}
-                # idempotent retry: report the applied record's ts so a
-                # re-committed txn adopts it instead of minting a new one
-                return {"ok": True, "existing": existing["status"],
+                if ex == "staging" and want in ("committed", "aborted"):
+                    rec = json.dumps({
+                        "status": want, "ts": op["ts"],
+                        "anchor": existing.get("anchor", "")})
+                    # records are control state, not MVCC-versioned
+                    # data: the rewrite always lands strictly above
+                    # the staging version (same-ts would be
+                    # write-too-old at the MVCC layer)
+                    at = max(wts, Timestamp(mv.ts.wall,
+                                            mv.ts.logical + 1))
+                    self.mvcc.put(key, at, rec.encode())
+                    return {"ok": True, "existing": ex,
+                            "existing_ts": existing["ts"]}
+                return {"ok": False, "existing": ex,
                         "existing_ts": existing["ts"]}
             # the anchor key travels in the record so splitTrigger can
-            # keep the record co-located with its anchor's range
-            rec = json.dumps({"status": want, "ts": op["ts"],
-                              "anchor": op.get("anchor", "")})
-            self.mvcc.put(key, wts, rec.encode())
+            # keep the record co-located with its anchor's range; a
+            # STAGING record also declares the txn's write set — the
+            # recovery proof (parallel commits)
+            rec = {"status": want, "ts": op["ts"],
+                   "anchor": op.get("anchor", "")}
+            if "writes" in op:
+                rec["writes"] = op["writes"]
+            self.mvcc.put(key, wts, json.dumps(rec).encode())
             return {"ok": True, "existing": None}
         if o == "resolve":
             key = op["key"].encode("latin1")

@@ -65,7 +65,7 @@ class TestDistTxnCommit:
         # the push left an ABORTED record so the writer can never
         # commit over its removed intent
         rec = read_txn_record(c, t._meta())
-        assert rec is not None and rec[0] == "aborted"
+        assert rec is not None and rec["status"] == "aborted"
 
     def test_push_then_commit_is_retry_error(self):
         """The round-2 lost-write interleaving: T1 writes an intent, T2
@@ -171,7 +171,7 @@ class TestDistTxnFailures:
         c.pump(10)
         # pusher routed by the anchor key must still find COMMITTED
         rec = read_txn_record(c, t1._meta())
-        assert rec is not None and rec[0] == "committed"
+        assert rec is not None and rec["status"] == "committed"
         reader = DistTxn(c)
         assert reader.get(b"apple") == b"1"
 
@@ -184,7 +184,7 @@ class TestDistTxnFailures:
         t1 = DistTxn(c)
         t1.put(b"apple", b"1")
         t1._write_record("committed", c.clock.now())
-        first_ts = read_txn_record(c, t1._meta())[1]
+        first_ts = read_txn_record(c, t1._meta())["ts"]
         # client saw an ambiguous error; state still 'pending' -> retry
         got_ts = t1.commit()
         assert got_ts == first_ts
@@ -245,7 +245,7 @@ class TestDistTxnFailures:
         t.put(b"apple", b"1")
         reader = DistTxn(c)
         reader.get(b"apple")            # poisons t (coordinator "dead")
-        assert read_txn_record(c, t._meta())[0] == "aborted"
+        assert read_txn_record(c, t._meta())["status"] == "aborted"
         assert c.gc_txn_records(ttl_ns=int(3600e9)) == 0  # too young
         assert c.gc_txn_records(ttl_ns=0) == 1
         assert read_txn_record(c, t._meta()) is None
@@ -258,3 +258,127 @@ class TestDistTxnFailures:
             t.commit()
         c.pump(5)
         assert c.get(b"k") == b"4"
+
+
+class TestPipelinedParallelCommit:
+    """Round-3: pipelined writes + parallel commits
+    (txn_interceptor_pipeliner.go / txn_interceptor_committer.go /
+    cmd_recover_txn.go). Writes reach consensus concurrently; commit
+    STAGES a record declaring the write set, is implicitly committed
+    once every declared write and the record applied, then flips
+    explicit. A pusher that finds STAGING runs status recovery."""
+
+    def test_pipelined_commit_visible(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put_pipelined(b"apple", b"1")   # range 1
+        t.put_pipelined(b"pear", b"2")    # range 2
+        t.put_pipelined(b"plum", b"3")
+        ts = t.commit()
+        c.pump(5)
+        assert c.get(b"apple") == b"1"
+        assert c.get(b"pear") == b"2"
+        assert c.get(b"plum") == b"3"
+        assert ts is not None
+
+    def test_pipelined_rollback_leaves_nothing(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put_pipelined(b"apple", b"1")
+        t.put_pipelined(b"pear", b"2")
+        t.rollback()
+        c.pump(5)
+        assert c.get(b"apple") is None
+        assert c.get(b"pear") is None
+
+    def test_record_cleaned_after_parallel_commit(self):
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put_pipelined(b"apple", b"1")
+        t.commit()
+        c.pump(5)
+        assert read_txn_record(c, t._meta()) is None
+
+    def test_recovery_commits_fully_applied_staging(self):
+        """Coordinator dies between implicit and explicit commit: the
+        staging record + applied writes mean COMMITTED; a reader's
+        push recovers the txn and sees the value."""
+        from cockroach_tpu.kv.disttxn import propose_txn_record
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        # stage exactly as _commit_parallel would, then "die"
+        commit_ts = c.clock.now()
+        res = propose_txn_record(
+            c, t.anchor, t.id, "staging", commit_ts,
+            writes=[k.decode("latin1") for k in t.intents])
+        assert res["ok"]
+        c.pump(5)
+        # a reader hits the intent, pushes, recovery commits
+        reader = DistTxn(c)
+        assert reader.get(b"apple") == b"1"
+        rec = read_txn_record(c, t._meta())
+        assert rec is not None and rec["status"] == "committed"
+        assert reader.get(b"pear") == b"2"
+
+    def test_recovery_aborts_incomplete_staging(self):
+        """Coordinator dies with a declared write that never applied:
+        recovery must abort — committing would expose a partial txn."""
+        from cockroach_tpu.kv.disttxn import propose_txn_record
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        commit_ts = c.clock.now()
+        res = propose_txn_record(
+            c, t.anchor, t.id, "staging", commit_ts,
+            writes=["apple", "pear"])   # pear never written
+        assert res["ok"]
+        c.pump(5)
+        reader = DistTxn(c)
+        assert reader.get(b"apple") is None  # push -> recovery -> abort
+        rec = read_txn_record(c, t._meta())
+        assert rec is not None and rec["status"] == "aborted"
+
+    def test_post_recovery_commit_fails_retryably(self):
+        """After recovery aborts an incomplete staging txn, the
+        returning coordinator's explicit commit must fail."""
+        from cockroach_tpu.kv.disttxn import propose_txn_record
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put_pipelined(b"apple", b"1")
+        t.prove_in_flight()
+        # stage with a write that will never exist, then let a reader
+        # recover (abort), then try to finish the commit
+        res = propose_txn_record(
+            c, t.anchor, t.id, "staging", c.clock.now(),
+            writes=["apple", "phantom"])
+        assert res["ok"]
+        c.pump(5)
+        assert DistTxn(c).get(b"apple") is None
+        with pytest.raises((TxnAbortedError, DistTxnError)):
+            t.commit()
+
+    def test_push_poison_before_staging_aborts_parallel_commit(self):
+        """A reader pushes (poisons ABORTED) before the coordinator
+        stages: the parallel commit must fail retryably and leave
+        nothing behind."""
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        assert DistTxn(c).get(b"apple") is None  # push poisons
+        t._in_flight.append((b"apple", {"result": [{"ok": True}]}))
+        with pytest.raises(TxnAbortedError):
+            t.commit()                   # parallel path (in-flight)
+        c.pump(5)
+        assert c.get(b"apple") is None
+
+    def test_staging_record_declares_writes(self):
+        from cockroach_tpu.kv.disttxn import propose_txn_record
+        c = make_cluster()
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        propose_txn_record(c, t.anchor, t.id, "staging", c.clock.now(),
+                           writes=["apple"])
+        rec = read_txn_record(c, t._meta())
+        assert rec["status"] == "staging" and rec["writes"] == ["apple"]
