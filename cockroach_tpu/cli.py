@@ -35,10 +35,27 @@ class PgClient:
     """Minimal pgwire v3 frontend for the simple query protocol."""
 
     def __init__(self, host: str, port: int, user: str = "root",
-                 database: str = "defaultdb", timeout: float = 30.0):
+                 database: str = "defaultdb", timeout: float = 30.0,
+                 password: str | None = None, sslmode: str = "disable"):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.params: dict[str, str] = {}
         self.txn_status = b"I"
+        self.password = password
+        if sslmode != "disable":
+            # SSLRequest -> 'S' -> wrap (libpq's sslmode=require; no
+            # CA verification — the bundled certs are self-signed)
+            import ssl
+            self.sock.sendall(struct.pack("!II", 8, 80877103))
+            resp = self.sock.recv(1)
+            if resp != b"S":
+                if sslmode == "require":
+                    raise PgError({"M": "server does not support TLS"})
+            else:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                self.sock = ctx.wrap_socket(self.sock,
+                                            server_hostname=host)
         params = (f"user\x00{user}\x00database\x00{database}\x00\x00"
                   .encode())
         body = struct.pack("!I", 196608) + params
@@ -83,10 +100,18 @@ class PgClient:
                 return
             if typ == b"E":
                 err = self._err_fields(body)
+                if err.get("S") == "FATAL":
+                    raise PgError(err)  # no ReadyForQuery is coming
             elif typ == b"S":
                 k, v = body.split(b"\x00")[:2]
                 self.params[k.decode()] = v.decode()
-            # R (auth), K (key data), N (notice): nothing to do
+            elif typ == b"R":
+                (code,) = struct.unpack_from("!I", body, 0)
+                if code == 3:  # cleartext password requested
+                    pw = (self.password or "").encode() + b"\x00"
+                    self.sock.sendall(
+                        b"p" + struct.pack("!I", len(pw) + 4) + pw)
+            # K (key data), N (notice): nothing to do
 
     @staticmethod
     def _decode_row_desc(body) -> list[str]:
@@ -141,6 +166,57 @@ class PgClient:
                 if err:
                     raise PgError(err)
                 return names, rows, tags
+
+    # -- COPY (text format) --------------------------------------------------
+    def copy_in(self, sql: str, lines: list[str]) -> str:
+        """COPY ... FROM STDIN: send text-format rows, return the
+        command tag ('COPY n')."""
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4)
+                          + payload)
+        typ, body = self._msg()
+        if typ == b"E":
+            err = self._err_fields(body)
+            self._wait_ready()
+            raise PgError(err)
+        if typ != b"G":
+            raise PgError({"M": f"expected CopyInResponse, got {typ}"})
+        data = ("".join(line + "\n" for line in lines)).encode()
+        self._send(b"d", data)
+        self._send(b"c", b"")
+        tag = None
+        err = None
+        while True:
+            typ, body = self._msg()
+            if typ == b"C":
+                tag = body.rstrip(b"\x00").decode()
+            elif typ == b"E":
+                err = self._err_fields(body)
+            elif typ == b"Z":
+                self.txn_status = body
+                if err:
+                    raise PgError(err)
+                return tag
+
+    def copy_out(self, sql: str) -> list[str]:
+        """COPY ... TO STDOUT: returns the text-format lines."""
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4)
+                          + payload)
+        lines: list[str] = []
+        err = None
+        while True:
+            typ, body = self._msg()
+            if typ == b"d":
+                lines.extend(body.decode().splitlines())
+            elif typ == b"E":
+                err = self._err_fields(body)
+            elif typ == b"Z":
+                self.txn_status = body
+                if err:
+                    raise PgError(err)
+                return lines
+            # H (CopyOutResponse), c (CopyDone), C (tag): skip
 
     # -- extended protocol ---------------------------------------------------
     def _send(self, typ: bytes, payload: bytes):
@@ -351,6 +427,91 @@ def cmd_workload(args) -> int:
     return 0
 
 
+def cmd_cert(args) -> int:
+    """Create a self-signed CA + node certificate pair (the
+    `cockroach cert create-ca` / `create-node` workflow, pkg/cli/cert.go
+    + pkg/security — one subcommand here since the CA exists only to
+    sign the node cert)."""
+    import os
+    import subprocess
+
+    d = args.certs_dir
+    os.makedirs(d, exist_ok=True)
+    ca_key = os.path.join(d, "ca.key")
+    ca_crt = os.path.join(d, "ca.crt")
+    node_key = os.path.join(d, "node.key")
+    node_crt = os.path.join(d, "node.crt")
+    hosts = args.host or ["localhost", "127.0.0.1"]
+    san = ",".join(
+        ("IP:" if h.replace(".", "").isdigit() else "DNS:") + h
+        for h in hosts)
+    run = lambda *cmd: subprocess.run(  # noqa: E731
+        cmd, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", ca_key, "-out", ca_crt, "-days", "3650",
+        "-subj", "/CN=cockroach-tpu CA")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", node_key, "-out", os.path.join(d, "node.csr"),
+        "-subj", "/CN=node")
+    # SAN extension via a temp extfile (openssl x509 -req needs it)
+    ext = os.path.join(d, "san.ext")
+    with open(ext, "w") as f:
+        f.write(f"subjectAltName={san}\n")
+    run("openssl", "x509", "-req", "-in", os.path.join(d, "node.csr"),
+        "-CA", ca_crt, "-CAkey", ca_key, "-CAcreateserial",
+        "-out", node_crt, "-days", "3650", "-extfile", ext)
+    os.remove(os.path.join(d, "node.csr"))
+    os.remove(ext)
+    os.chmod(node_key, 0o600)
+    os.chmod(ca_key, 0o600)
+    print(f"certificates written to {d}: ca.crt node.crt node.key")
+    return 0
+
+
+def _http_json(url_base: str, path: str):
+    import json
+    import urllib.request
+    with urllib.request.urlopen(f"http://{url_base}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def cmd_node(args) -> int:
+    """`node status` — the reference's `cockroach node status`
+    (pkg/cli/node.go) against the status endpoint."""
+    o = _http_json(args.url, "/_status/nodes")
+    print(f"node {o['node_id']}  v{o['version']}  "
+          f"sql={o['sql_addr'][0]}:{o['sql_addr'][1]}  "
+          f"tables={len(o['tables'])}")
+    for pid, p in sorted(o.get("peers", {}).items()):
+        rtt = (f"{p['rtt_ns'] / 1e6:.1f}ms"
+               if p.get("rtt_ns") is not None else "?")
+        off = (f"{p['clock_offset_ns'] / 1e6:+.1f}ms"
+               if p.get("clock_offset_ns") is not None else "?")
+        state = "live" if p["healthy"] else "SUSPECT"
+        print(f"  peer n{pid}: {state}  rtt={rtt}  clock-offset={off}")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """`debug ranges` / `debug tables` — pkg/cli/debug.go's read-only
+    introspection, over the status endpoint instead of a store dir."""
+    if args.what == "ranges":
+        o = _http_json(args.url, "/_debug/ranges")
+        if not o["ranges"]:
+            print("(no ranges: node is not cluster-backed)")
+            return 0
+        for r in o["ranges"]:
+            print(f"r{r['range_id']}: [{r['start']!r}, {r['end']!r}) "
+                  f"replicas={r['replicas']} "
+                  f"leaseholder={r['leaseholder']}")
+        return 0
+    o = _http_json(args.url, "/_status/nodes")
+    for t in o["tables"]:
+        print(t)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="cockroach-tpu",
@@ -378,6 +539,27 @@ def main(argv=None) -> int:
     p_wl.add_argument("name", choices=["bank", "kv", "ycsb", "ssb"])
     p_wl.add_argument("--steps", type=int, default=100)
     p_wl.set_defaults(fn=cmd_workload)
+
+    p_node = sub.add_parser("node", help="node status (fabric health, "
+                                         "clock offsets)")
+    p_node.add_argument("action", choices=["status"])
+    p_node.add_argument("--url", required=True,
+                        help="host:port of a node's HTTP endpoint")
+    p_node.set_defaults(fn=cmd_node)
+
+    p_dbg = sub.add_parser("debug", help="read-only introspection "
+                                         "(ranges, tables)")
+    p_dbg.add_argument("what", choices=["ranges", "tables"])
+    p_dbg.add_argument("--url", required=True,
+                       help="host:port of a node's HTTP endpoint")
+    p_dbg.set_defaults(fn=cmd_debug)
+
+    p_cert = sub.add_parser("cert", help="create self-signed CA + "
+                                         "node TLS certificates")
+    p_cert.add_argument("--certs-dir", default="certs")
+    p_cert.add_argument("--host", action="append",
+                        help="SAN hostnames/IPs (repeatable)")
+    p_cert.set_defaults(fn=cmd_cert)
 
     p_ver = sub.add_parser("version", help="print version")
     p_ver.set_defaults(fn=lambda a: (print(f"cockroach-tpu v{__version__} "

@@ -20,6 +20,7 @@ from the reference tree.
 from __future__ import annotations
 
 import datetime
+import re
 import socket
 import socketserver
 import struct
@@ -39,6 +40,7 @@ OID_FLOAT8 = 701
 OID_TEXT = 25
 OID_DATE = 1082
 OID_TIMESTAMP = 1114
+OID_JSONB = 3802
 
 
 class ProtocolError(Exception):
@@ -76,6 +78,8 @@ def _infer_oid(rows, col: int) -> int:
             return OID_TIMESTAMP
         if isinstance(v, datetime.date):
             return OID_DATE
+        if isinstance(v, dict):
+            return OID_JSONB
         return OID_TEXT
     return OID_TEXT
 
@@ -88,7 +92,87 @@ def _encode_text(v) -> bytes | None:
         return b"t" if v else b"f"
     if isinstance(v, float):
         return repr(v).encode()
+    if isinstance(v, dict):
+        import json
+        return json.dumps(v, sort_keys=True,
+                          separators=(",", ":")).encode()
+    if isinstance(v, list):
+        # pg array_out text via the canonical encoder (quoting rules
+        # for elements containing , { } " \ or spaces)
+        from ..sql import datum as dtm
+        from ..sql.types import BOOL, FLOAT8, INT8, STRING
+        elem = STRING
+        for x in v:
+            if x is None:
+                continue
+            if isinstance(x, bool):
+                elem = BOOL
+            elif isinstance(x, int):
+                elem = INT8
+            elif isinstance(x, float):
+                elem = FLOAT8
+            break
+        return dtm.canon_array(v, elem).encode()
     return str(v).encode()
+
+
+_COPY_RE = re.compile(
+    r"copy\s+(?P<table>[a-zA-Z_][\w.]*)\s*"
+    r"(?:\((?P<cols>[^)]*)\))?\s*"
+    r"(?P<dir>from|to)\s+(?:stdin|stdout)"
+    r"(?:\s+with)?(?:\s*\(?\s*format\s+text\s*\)?)?\s*$",
+    re.IGNORECASE)
+
+
+def _copy_text(v) -> str:
+    """pg COPY text-format output encoding for one value."""
+    if v is None:
+        return "\\N"
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    s = _encode_text(v).decode()
+    return (s.replace("\\", "\\\\").replace("\t", "\\t")
+            .replace("\n", "\\n").replace("\r", "\\r"))
+
+
+_COPY_UNESCAPE = {"t": "\t", "n": "\n", "r": "\r", "\\": "\\"}
+
+
+def _copy_unescape(f: str) -> str:
+    # single-pass: sequential replace() corrupts a literal backslash
+    # followed by t/n/r ('a\\tb' on the wire means backslash + t)
+    if "\\" not in f:
+        return f
+    out = []
+    i, n = 0, len(f)
+    while i < n:
+        c = f[i]
+        if c == "\\" and i + 1 < n:
+            out.append(_COPY_UNESCAPE.get(f[i + 1], f[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _copy_parse_line(line: bytes, ncols: int) -> list:
+    fields = line.decode().split("\t")
+    if len(fields) != ncols:
+        raise ProtocolError(
+            f"COPY row has {len(fields)} columns, expected {ncols}")
+    return [None if f == "\\N" else _copy_unescape(f) for f in fields]
+
+
+def _copy_sql_literal(v, numeric: bool) -> str:
+    """One VALUES literal for a COPY field. Quoting is decided by the
+    TARGET COLUMN's type, not by sniffing the text — 'nan'/'inf'
+    float-parse but are strings when the column says so."""
+    if v is None:
+        return "NULL"
+    if numeric:
+        return v
+    return "'" + v.replace("'", "''") + "'"
 
 
 def split_statements(buf: str) -> list[str]:
@@ -145,6 +229,26 @@ class _Writer:
     # -- concrete messages ---------------------------------------------------
     def auth_ok(self):
         self.msg(b"R", struct.pack("!I", 0))
+
+    def auth_cleartext(self):
+        """AuthenticationCleartextPassword (auth.go's password method;
+        SCRAM is the reference default, cleartext its fallback — and
+        ours, since the wire is already plaintext without TLS)."""
+        self.msg(b"R", struct.pack("!I", 3))
+
+    def copy_in_response(self, ncols: int):
+        self.msg(b"G", struct.pack("!bH", 0, ncols)
+                 + struct.pack(f"!{ncols}H", *([0] * ncols)))
+
+    def copy_out_response(self, ncols: int):
+        self.msg(b"H", struct.pack("!bH", 0, ncols)
+                 + struct.pack(f"!{ncols}H", *([0] * ncols)))
+
+    def copy_data(self, data: bytes):
+        self.msg(b"d", data)
+
+    def copy_done(self):
+        self.msg(b"c")
 
     def parameter_status(self, key: str, val: str):
         self.msg(b"S", key.encode() + b"\x00" + val.encode() + b"\x00")
@@ -380,11 +484,14 @@ class _Conn:
     """One client connection: the serveImpl loop (conn.go:280)."""
 
     def __init__(self, sock: socket.socket, engine: Engine, conn_id: int,
-                 version: str):
+                 version: str, auth: dict | None = None,
+                 tls=None):
         self.sock = sock
         self.engine = engine
         self.conn_id = conn_id
         self.version = version
+        self.auth = auth
+        self.tls = tls  # ssl.SSLContext or None
         self.r = _Reader(sock)
         self.w = _Writer(sock)
         self.session: Session = engine.session()
@@ -452,6 +559,16 @@ class _Conn:
     def handshake(self) -> bool:
         while True:
             code, params = self.r.startup()
+            if code == SSL_REQUEST and self.tls is not None:
+                # TLS upgrade (the reference's maybeUpgradeToSecureConn,
+                # pgwire/server.go): accept, wrap, and continue the
+                # startup over the encrypted stream
+                self.sock.sendall(b"S")
+                self.sock = self.tls.wrap_socket(self.sock,
+                                                 server_side=True)
+                self.r = _Reader(self.sock)
+                self.w = _Writer(self.sock)
+                continue
             if code in (SSL_REQUEST, GSSENC_REQUEST):
                 self.sock.sendall(b"N")  # not supported; retry cleartext
                 continue
@@ -465,6 +582,25 @@ class _Conn:
                 return False
             break
         self.user = params.get("user", "root")
+        if self.auth is not None:
+            # password gate (auth.go): the user must be known and the
+            # cleartext password must match; anything else is a FATAL
+            # 28P01 before any SQL is reachable
+            self.w.auth_cleartext()
+            self.w.flush()
+            typ, body = self.r.message()
+            if typ != b"p":
+                self.w.error("expected password message",
+                             code="08P01", severity="FATAL")
+                self.w.flush()
+                return False
+            pw, _ = _cstr(body, 0)
+            if self.auth.get(self.user) != pw:
+                self.w.error(
+                    f"password authentication failed for user "
+                    f"{self.user!r}", code="28P01", severity="FATAL")
+                self.w.flush()
+                return False
         self.w.auth_ok()
         self.w.parameter_status("server_version", "13.0 cockroach-tpu "
                                 + self.version)
@@ -500,6 +636,14 @@ class _Conn:
 
     def _simple_query(self, body: bytes):
         sql, _ = _cstr(body, 0)
+        m = _COPY_RE.match(sql.strip().rstrip(";"))
+        if m is not None:
+            try:
+                self._copy(m)
+            except Exception as e:
+                self.w.error(str(e), code=_sqlstate(e))
+            self.w.ready_for_query(self._txn_status())
+            return
         stmts = split_statements(sql)
         if not stmts:
             self.w.empty_query()
@@ -513,6 +657,98 @@ class _Conn:
                 break
             self._send_result(res)
         self.w.ready_for_query(self._txn_status())
+
+    # -- COPY (conn.go's processCopy; text format only) ----------------------
+    def _copy_columns(self, table: str, collist: str | None) -> list[str]:
+        schema = self.engine.store.table(table).schema
+        if collist:
+            return [c.strip() for c in collist.split(",")]
+        return [c.name for c in schema.columns]
+
+    def _copy(self, m):
+        table = m.group("table")
+        cols = self._copy_columns(table, m.group("cols"))
+        if m.group("dir").lower() == "to":
+            self._copy_out(table, cols)
+        else:
+            self._copy_in(table, cols)
+
+    def _copy_out(self, table: str, cols: list[str]):
+        res = self._execute(
+            f"SELECT {', '.join(cols)} FROM {table}")
+        self.w.copy_out_response(len(cols))
+        for row in res.rows:
+            line = "\t".join(_copy_text(v) for v in row)
+            self.w.copy_data(line.encode() + b"\n")
+        self.w.copy_done()
+        self.w.command_complete(f"COPY {len(res.rows)}")
+
+    def _copy_in(self, table: str, cols: list[str]):
+        self.w.copy_in_response(len(cols))
+        self.w.flush()
+        buf = b""
+        rows: list[list[str | None]] = []
+        failed = None
+        while True:
+            typ, body = self.r.message()
+            if typ == b"d":
+                buf += body
+                # CopyData chunks can split mid-line: keep the tail
+                while True:
+                    nl = buf.find(b"\n")
+                    if nl < 0:
+                        break
+                    line, buf = buf[:nl], buf[nl + 1:]
+                    if line == b"\\.":
+                        continue
+                    if line:
+                        rows.append(_copy_parse_line(line, len(cols)))
+            elif typ == b"c":        # CopyDone
+                break
+            elif typ == b"f":        # CopyFail
+                failed, _ = _cstr(body, 0)
+                break
+            elif typ in (b"H", b"S"):
+                self.w.flush()
+            else:
+                raise ProtocolError(
+                    f"unexpected message {typ!r} during COPY")
+        if failed is not None:
+            self.w.error(f"COPY failed: {failed}", code="57014")
+            return
+        from ..sql.types import Family
+        schema = self.engine.store.table(table).schema
+        numeric = [schema.column(c).type.family in
+                   (Family.INT, Family.FLOAT, Family.DECIMAL)
+                   for c in cols]
+        inserted = 0
+        # batches through the normal INSERT path (constraints and
+        # indexes apply), wrapped in ONE transaction so a mid-COPY
+        # failure leaves nothing behind — pg's COPY is atomic per
+        # statement
+        BATCH = 1000
+        own_txn = not self.session.in_txn
+        if own_txn:
+            self._execute("BEGIN")
+        try:
+            for lo in range(0, len(rows), BATCH):
+                chunk = rows[lo:lo + BATCH]
+                values = ", ".join(
+                    "(" + ", ".join(
+                        _copy_sql_literal(v, numeric[i])
+                        for i, v in enumerate(r)) + ")"
+                    for r in chunk)
+                self._execute(
+                    f"INSERT INTO {table} ({', '.join(cols)}) "
+                    f"VALUES {values}")
+                inserted += len(chunk)
+            if own_txn:
+                self._execute("COMMIT")
+        except Exception:
+            if own_txn:
+                self._execute("ROLLBACK")
+            raise
+        self.w.command_complete(f"COPY {inserted}")
 
     def _extended(self, typ: bytes, body: bytes):
         # after an error, discard everything until Sync
@@ -600,9 +836,21 @@ class PgServer:
     """
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
-                 port: int = 0, version: str = "0.2.0"):
+                 port: int = 0, version: str = "0.2.0",
+                 auth: dict | None = None,
+                 certs_dir: str | None = None):
         self.engine = engine
         self.version = version
+        self.auth = auth  # user -> cleartext password; None = insecure
+        self.tls = None
+        if certs_dir is not None:
+            import os
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                os.path.join(certs_dir, "node.crt"),
+                os.path.join(certs_dir, "node.key"))
+            self.tls = ctx
         self._next_id = [0]
         outer = self
 
@@ -610,7 +858,8 @@ class PgServer:
             def handle(self):
                 outer._next_id[0] += 1
                 conn = _Conn(self.request, outer.engine,
-                             outer._next_id[0], outer.version)
+                             outer._next_id[0], outer.version,
+                             auth=outer.auth, tls=outer.tls)
                 try:
                     conn.serve()
                 except (ConnectionError, ProtocolError, OSError):

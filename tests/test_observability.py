@@ -122,3 +122,41 @@ class TestStatusEndpoint:
             h, p = n.http_addr
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(f"http://{h}:{p}/nope", timeout=5)
+
+
+class TestStatusAndDebugEndpoints:
+    """/_status/nodes + /_debug/ranges and their CLI frontends
+    (pkg/cli/node.go `node status`, pkg/cli/debug.go)."""
+
+    def test_status_nodes_and_cli(self, capsys):
+        import json
+        import urllib.request
+
+        from cockroach_tpu.cli import main as cli_main
+        from cockroach_tpu.server import Node, NodeConfig
+        with Node(NodeConfig()) as n:
+            host, port = n.http_addr
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/_status/nodes") as r:
+                o = json.loads(r.read())
+            assert o["node_id"] == 1 and o["sql_addr"]
+            assert cli_main(["node", "status",
+                             "--url", f"{host}:{port}"]) == 0
+            out = capsys.readouterr().out
+            assert "node 1" in out
+
+    def test_debug_ranges_cluster_backed(self, capsys):
+        from cockroach_tpu.cli import main as cli_main
+        from cockroach_tpu.kvserver.cluster import Cluster
+        from cockroach_tpu.server import Node, NodeConfig
+        c = Cluster(n_nodes=3)
+        c.create_range(b"a", b"z")
+        c.pump_until(lambda: c.leaseholder(1) is not None)
+        with Node(NodeConfig(cluster=c)) as n:
+            host, port = n.http_addr
+            assert cli_main(["debug", "ranges",
+                             "--url", f"{host}:{port}"]) == 0
+            out = capsys.readouterr().out
+            assert "r1:" in out and "leaseholder=" in out
+            assert cli_main(["debug", "tables",
+                             "--url", f"{host}:{port}"]) == 0
