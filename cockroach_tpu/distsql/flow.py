@@ -60,13 +60,18 @@ class FlowSpec:
     chunk_rows: int = 65536
     read_ts: Optional[int] = None
     window: int = 8              # max unacked chunks in flight
+    # cluster mode: table -> [(start,end)] key spans (latin1 strings)
+    # this node must materialize from the range plane before running
+    # its stage — the PartitionSpans assignment by leaseholder
+    # (distsql_physical_planner.go:1096). None = node-local shards.
+    spans: Optional[dict] = None
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
                 "stage": self.stage, "sql": self.sql,
                 "stream_id": self.stream_id,
                 "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
-                "window": self.window}
+                "window": self.window, "spans": self.spans}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

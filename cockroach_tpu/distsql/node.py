@@ -44,10 +44,13 @@ class DistSQLNode:
     # ahead of its SetupFlow still tombstones the late arrival
     CANCEL_MEMORY = 256
 
-    def __init__(self, node_id: int, engine, transport):
+    def __init__(self, node_id: int, engine, transport, cluster=None):
         self.node_id = node_id
         self.engine = engine
         self.transport = transport
+        # kvserver.Cluster for leaseholder-partitioned scans: flows
+        # carrying spans materialize them from the range plane
+        self.cluster = cluster
         self.registry = FlowRegistry()
         transport.register(node_id, self._handle)
         self.flows_run = 0
@@ -107,6 +110,8 @@ class DistSQLNode:
         self._producing.add((spec.flow_id, spec.stream_id))
         try:
             self.flows_run += 1
+            if spec.spans is not None:
+                self._materialize_spans(spec.spans)
             batch, stage = self._run_local(spec)
             host = {n: np.asarray(d)
                     for n, d in zip(batch.names, batch.data)}
@@ -161,6 +166,25 @@ class DistSQLNode:
                                        outbox.max_outstanding)
             self._producing.discard((spec.flow_id, spec.stream_id))
             self.acks.pop((spec.flow_id, spec.stream_id), None)
+
+    def _materialize_spans(self, spans: dict) -> None:
+        """Refresh this node's scan plane with its leaseholder span
+        assignment: the cFetcher pull (kv/rowfetch.py) from committed
+        range data into the local columnstore, per flow. An empty span
+        list still (re)creates the table so the local stage sees an
+        empty shard, not a missing table."""
+        if self.cluster is None:
+            raise RuntimeError(
+                "flow carries spans but this node has no cluster")
+        from cockroach_tpu.kv.rowfetch import RangeTable
+        from cockroach_tpu.storage.hlc import Timestamp
+        for tname, pieces in spans.items():
+            schema = self.engine.store.table(tname).schema
+            rt = RangeTable(self.cluster, schema)
+            decoded = [(lo.encode("latin1"), hi.encode("latin1"))
+                       for lo, hi in pieces]
+            rt.materialize_into(self.engine, spans=decoded or [],
+                                ts=Timestamp(1, 0))
 
     def _run_local(self, spec: FlowSpec):
         eng = self.engine
@@ -238,7 +262,7 @@ class Gateway:
     def __init__(self, own: DistSQLNode, data_nodes: list[int],
                  replicated_tables: set | None = None,
                  flow_timeout: float = FLOW_TIMEOUT,
-                 monitor=None, window: int = 8):
+                 monitor=None, window: int = 8, cluster=None):
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -246,6 +270,14 @@ class Gateway:
         # join would silently lose cross-node matches
         self.replicated_tables = replicated_tables or set()
         self.flow_timeout = flow_timeout
+        # kvserver.Cluster: scans partition by range LEASEHOLDER (the
+        # PartitionSpans planner input) instead of node-local shard
+        # residency; every table is reachable from the range plane, so
+        # join build sides are implicitly replicated (each node
+        # fetches them in full)
+        self.cluster = cluster
+        if cluster is not None and own.cluster is None:
+            own.cluster = cluster
         # rpc.heartbeat.PeerMonitor (or anything with healthy(node)):
         # lets the gateway fail fast on a breaker-tripped peer instead
         # of waiting out flow_timeout of silence (the reference checks
@@ -253,6 +285,69 @@ class Gateway:
         # distsql_physical_planner.go CheckNodeHealthAndVersion)
         self.monitor = monitor
         self.window = window
+
+    def _partition_by_leaseholder(self, plan_node) -> dict:
+        """node_id -> {table: [(lo, hi) latin1 spans]} — the
+        PartitionSpans decision (distsql_physical_planner.go:1096):
+        the probe-spine scan splits by range leaseholder; join build
+        sides assign their FULL span to every node (the range plane
+        makes every table globally readable, so build replication is
+        a fetch, not a storage, property)."""
+        from cockroach_tpu.kv.rowfetch import RangeTable
+        from cockroach_tpu.sql import plan as P
+
+        build_tables: set[str] = set()
+        spine_tables: set[str] = set()
+
+        def rec(n, build_side):
+            if isinstance(n, P.Scan):
+                if n.table == UNION:
+                    return
+                (build_tables if build_side
+                 else spine_tables).add(n.table)
+            elif isinstance(n, P.HashJoin):
+                rec(n.left, build_side)
+                rec(n.right, True)
+            elif hasattr(n, "child"):
+                rec(n.child, build_side)
+        rec(plan_node, False)
+
+        both = spine_tables & build_tables
+        if both:
+            from cockroach_tpu.distsql.physical import DistUnsupported
+            raise DistUnsupported(
+                f"table(s) {sorted(both)} appear on both probe and "
+                "build sides (self-join): one local materialization "
+                "cannot be partitioned and replicated at once")
+        out: dict[int, dict] = {nid: {} for nid in self.nodes}
+        eng = self.own.engine
+        for tname in spine_tables | build_tables:
+            schema = eng.store.table(tname).schema
+            rt = RangeTable(self.cluster, schema)
+            if tname in build_tables and tname not in spine_tables:
+                full = [tuple(s.decode("latin1") for s in rt.codec.span())]
+                for nid in self.nodes:
+                    out[nid][tname] = full
+                continue
+            parts = rt.partition_spans()
+            for nid in self.nodes:
+                pieces = parts.get(nid, [])
+                out[nid][tname] = [(lo.decode("latin1"),
+                                    hi.decode("latin1"))
+                                   for lo, hi in pieces]
+            orphans = {n: p for n, p in parts.items()
+                       if n not in self.nodes}
+            if orphans:
+                # a leaseholder outside the flow's node set would
+                # silently drop its rows — reassign its pieces to the
+                # first participant (the reference plans the flow ON
+                # the leaseholder set; our node set is fixed up front)
+                first = self.nodes[0]
+                for pieces in orphans.values():
+                    out[first][tname].extend(
+                        (lo.decode("latin1"), hi.decode("latin1"))
+                        for lo, hi in pieces)
+        return out
 
     def _check_join_placement(self, plan_node) -> None:
         from cockroach_tpu.distsql.physical import DistUnsupported
@@ -282,7 +377,11 @@ class Gateway:
             eng.catalog_view(int_ranges=False),
                              use_memo=False).plan_select(
             parser.parse(sql))
-        self._check_join_placement(node)
+        spans_by_node = None
+        if self.cluster is not None:
+            spans_by_node = self._partition_by_leaseholder(node)
+        else:
+            self._check_join_placement(node)
         stage = split(node)
         flow_id = uuid.uuid4().hex[:12]
         read_ts = int(eng.clock.now().to_int())
@@ -303,7 +402,10 @@ class Gateway:
         for i, nid in enumerate(self.nodes):
             spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
                             stream_id=i, chunk_rows=chunk_rows,
-                            read_ts=read_ts, window=self.window)
+                            read_ts=read_ts, window=self.window,
+                            spans=(spans_by_node.get(nid)
+                                   if spans_by_node is not None
+                                   else None))
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))

@@ -80,7 +80,8 @@ class RangeTable:
         return rows
 
     def materialize_into(self, engine, spans=None,
-                         table_name: str | None = None) -> int:
+                         table_name: str | None = None,
+                         ts=None) -> int:
         """Refresh one engine's columnstore scan plane from range data
         (the direct-columnar-scan idea, storage/col_mvcc.go:37-64:
         decode where the data lives, serve columns to the compute).
@@ -96,6 +97,11 @@ class RangeTable:
             from dataclasses import replace
             schema = replace(self.schema, name=table_name)
         store.create_table(schema)
-        store.insert_rows(name, rows, engine.clock.now())
+        # ts: a flow materializing its span assignment mid-statement
+        # must stamp rows AT OR BELOW the statement's read_ts, or the
+        # MVCC mask hides the whole snapshot (the local copy is a
+        # scan-plane snapshot of already-committed range data, so a
+        # floor timestamp is faithful)
+        store.insert_rows(name, rows, ts or engine.clock.now())
         store.seal(name)
         return len(rows)
