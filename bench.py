@@ -66,7 +66,8 @@ def bench_query(eng, sql, rows, pipeline, repeats, lat_probes=3):
     # seconds, so synchronous back-to-back runs measure the same
     # steady state without the pipelining trick
     try:
-        prep.dispatch()
+        jax.block_until_ready(prep.dispatch())  # sync: don't let the
+        # probe's device work bleed into the first timed repeat
         async_ok = True
     except Exception:
         async_ok = False

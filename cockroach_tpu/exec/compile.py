@@ -136,6 +136,13 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
                              expand=jn.expand, direct=jn.direct,
                              pack_payload=jn.pack_payload)
         return run_join
+    if isinstance(node, P.Compact):
+        childf = compile_plan(node.child, params)
+        frac, block = node.frac, node.block
+
+        def run_compact(rc):
+            return compact_batch(childf(rc), frac, block)
+        return run_compact
     if isinstance(node, P.Aggregate):
         return _compile_aggregate(node, params)
     if isinstance(node, P.Window):
@@ -190,6 +197,49 @@ def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
 # ---------------------------------------------------------------------------
 # aggregation
 # ---------------------------------------------------------------------------
+
+def compact_batch(b: ColumnBatch, frac: float,
+                  block: int = 32768) -> ColumnBatch:
+    """Pack selected rows to the front of a batch `frac` the size.
+
+    Blocked: each `block`-row segment keeps its first block*frac
+    selected rows via top_k over (sel ? index : -1) — measured on a
+    v5e, the blocked form costs ~1/3 of the full-width gather it
+    replaces at 8.4M rows, and every downstream per-row op (join
+    probe gathers, CASE math, agg partials) then runs at frac width.
+    A segment with more selected rows than its capacity sets the
+    __compact_overflow sentinel; results would be missing rows, so
+    the engine rechecks it at materialize time and replans without
+    compaction (same pattern as __ht_overflow / __topk_inexact).
+    Relative row order is NOT preserved (top_k emits largest index
+    first) — the engine only compacts under aggregation."""
+    n = int(b.sel.shape[0])
+    if n < 2 * block or n % block:
+        return b
+    nb = n // block
+    kb = max(128, int(block * frac))
+    kb = ((kb + 127) // 128) * 128
+    if kb >= block:
+        return b
+    sel = b.sel
+    score = jnp.where(sel, jax.lax.iota(jnp.int32, n),
+                      jnp.int32(-1)).reshape(nb, block)
+    top, idx = jax.lax.top_k(score, kb)
+    live = (top >= 0).reshape(-1)
+    base = (jnp.arange(nb, dtype=jnp.int32) * block)[:, None]
+    flat = (idx.astype(jnp.int32) + base).reshape(-1)
+    overflow = jnp.any(
+        jnp.sum(sel.reshape(nb, block), axis=1) > kb)
+    cols = {}
+    valid = {}
+    for name in b.names:
+        cols[name] = jnp.take(b.col(name), flat, axis=0)
+        valid[name] = jnp.take(b.col_valid(name), flat, axis=0)
+    out = ColumnBatch.from_dict(cols, valid, sel=live)
+    return out.with_column(
+        "__compact_overflow",
+        jnp.broadcast_to(overflow, (out.n,)))
+
 
 def _agg_output(group_cols, aggs_out, live, itemfs, havingf,
                 num_groups: int, sum_ovf, ht_ovf=None) -> ColumnBatch:
@@ -583,10 +633,18 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
             garange = jnp.arange(num_groups, dtype=jnp.int32)
             live = garange < ng
 
-        return _agg_output(group_cols, aggs_out, live, itemfs, havingf,
-                           num_groups, overflow,
-                           ht_ovf=(None if (not groupfs or dense)
-                                   else ng < 0))
+        out = _agg_output(group_cols, aggs_out, live, itemfs, havingf,
+                          num_groups, overflow,
+                          ht_ovf=(None if (not groupfs or dense)
+                                  else ng < 0))
+        if b.has("__compact_overflow"):
+            # bubble a child Compact's capacity sentinel through the
+            # fresh output batch (aggregation drops child columns)
+            out = out.with_column(
+                "__compact_overflow",
+                jnp.broadcast_to(jnp.any(b.col("__compact_overflow")),
+                                 (out.n,)))
+        return out
     return run_agg
 
 
@@ -598,7 +656,8 @@ def _sort_rank_tables(keys, meta: P.OutputMeta | None) -> dict:
     """String sort keys order by dictionary rank, not code."""
     rank_tables = {}
     if meta is not None:
-        for name, desc in keys:
+        for key in keys:
+            name = key[0]
             d = meta.dictionaries.get(name)
             if d is not None:
                 order = np.argsort(np.asarray(d.values, dtype=object).astype(str),
@@ -611,7 +670,9 @@ def _sort_rank_tables(keys, meta: P.OutputMeta | None) -> dict:
 
 def sort_batch(b: ColumnBatch, keys, rank_tables: dict) -> ColumnBatch:
     sort_keys = []  # lexsort: LAST key is primary
-    for name, desc in reversed(keys):
+    for key in reversed(keys):
+        name, desc = key[0], key[1]
+        nf = key[2] if len(key) > 2 else None
         d = b.col(name)
         v = b.col_valid(name)
         if name in rank_tables:
@@ -622,8 +683,10 @@ def sort_batch(b: ColumnBatch, keys, rank_tables: dict) -> ColumnBatch:
         if desc:
             d = -d.astype(jnp.float64) if jnp.issubdtype(
                 d.dtype, jnp.floating) else -d.astype(jnp.int64)
-        # NULLS LAST for asc, NULLS FIRST for desc (PostgreSQL default)
-        nullkey = v if desc else jnp.logical_not(v)
+        # pg default: NULLS LAST for asc, NULLS FIRST for desc;
+        # explicit NULLS FIRST/LAST overrides
+        null_first = nf if nf is not None else desc
+        nullkey = v if null_first else jnp.logical_not(v)
         sort_keys.append(d)
         sort_keys.append(nullkey.astype(jnp.int8))
     # dead rows always last
@@ -643,7 +706,9 @@ def _primary_rank_word(b: ColumnBatch, keys, rank_tables):
     desc (sort_batch's convention), dead rows strictly last. Ties on
     this word are resolved by the refined full-key sort; the top-k
     cut only needs the word itself plus the tie-count check."""
-    name, desc = keys[0]
+    name, desc = keys[0][0], keys[0][1]
+    nf = keys[0][2] if len(keys[0]) > 2 else None
+    null_first = nf if nf is not None else desc
     d = b.col(name)
     v = b.col_valid(name)
     if name in rank_tables:
@@ -655,13 +720,13 @@ def _primary_rank_word(b: ColumnBatch, keys, rank_tables):
         w = d.astype(jnp.float64)
         if desc:
             w = -w
-        null_w = jnp.float64(-1e308 if desc else 1e308)
+        null_w = jnp.float64(-1e308 if null_first else 1e308)
         dead_w = jnp.float64(np.inf)
     else:
         w = d.astype(jnp.int64)
         if desc:
             w = -w
-        null_w = jnp.int64(-(1 << 62) if desc else (1 << 62))
+        null_w = jnp.int64(-(1 << 62) if null_first else (1 << 62))
         dead_w = jnp.int64((1 << 62) + (1 << 61))
     w = jnp.where(v, w, null_w)
     w = jnp.where(b.sel, w, dead_w)

@@ -331,6 +331,12 @@ class ScanPlaneMixin:
                 raise TopKInexact(
                     "top-k cut crossed a primary-key tie group; "
                     "replanning with the full sort")
+        if out.has("__compact_overflow"):
+            if bool(np.asarray(out.col("__compact_overflow"))[0]):
+                from .session import CompactOverflow
+                raise CompactOverflow(
+                    "selection compaction overflowed a block's "
+                    "capacity; replanning uncompacted")
         host = out.to_host()
         res = Result(names=list(meta.names), types=list(meta.types))
         cols = []

@@ -35,6 +35,12 @@ class TopKInexact(EngineError):
     catches this and replans with the full device sort."""
 
 
+class CompactOverflow(EngineError):
+    """A selection-compaction block held more selected rows than its
+    capacity (compile.py compact_batch) — results would be missing
+    rows. Prepared.run catches this and replans uncompacted."""
+
+
 @dataclass
 class Result:
     """Decoded query result."""
@@ -159,7 +165,12 @@ class Prepared:
         except HashCapacityExceeded:
             # partition-and-recurse (the reference's disk spiller,
             # colexecdisk/disk_spiller.go:75, over HBM re-reads)
-            return self.engine._run_partitioned(self, read_ts)
+            try:
+                return self.engine._run_partitioned(self, read_ts)
+            except CompactOverflow:
+                return self.engine._prepare_select(
+                    self.stmt, self.session, self.sql_text,
+                    no_compact=True).run(read_ts)
         except TopKInexact:
             # primary-key ties crossed the top-k candidate cut:
             # replan with the full (slow-to-compile, always-exact)
@@ -167,5 +178,11 @@ class Prepared:
             return self.engine._prepare_select(
                 self.stmt, self.session, self.sql_text,
                 no_topk=True).run(read_ts)
+        except CompactOverflow:
+            # the stats-estimated selectivity undershot: replan with
+            # the full-width masked pipeline (always exact)
+            return self.engine._prepare_select(
+                self.stmt, self.session, self.sql_text,
+                no_compact=True).run(read_ts)
 
 

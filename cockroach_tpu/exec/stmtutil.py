@@ -38,15 +38,22 @@ def _host_sort(rows: list, meta: P.OutputMeta, keys) -> list:
     Matches device semantics: ascending puts NULLs last, descending
     puts NULLs first; strings compare lexicographically."""
     out = list(rows)
-    for name, desc in reversed(list(keys)):
+    for key in reversed(list(keys)):
+        name, desc = key[0], key[1]
+        nf = key[2] if len(key) > 2 else None
+        null_first = nf if nf is not None else desc
         try:
             i = meta.names.index(name)
         except ValueError:
             raise EngineError(
                 f"cannot host-sort spilled result by {name!r}") from None
+        # pre-reverse null flag: chosen so the PRESENTED order puts
+        # NULLs where null_first says (see sort_batch's device form)
         out = sorted(out,
-                     key=lambda r, i=i: (r[i] is None,
-                                         0 if r[i] is None else r[i]),
+                     key=lambda r, i=i: (
+                         (r[i] is None) if desc == null_first
+                         else (r[i] is not None),
+                         0 if r[i] is None else r[i]),
                      reverse=desc)
     return out
 

@@ -177,6 +177,8 @@ class SelectItem:
 class OrderItem:
     expr: Expr
     desc: bool = False
+    # None = pg default (NULLS LAST asc / NULLS FIRST desc)
+    nulls_first: Optional[bool] = None
 
 
 @dataclass

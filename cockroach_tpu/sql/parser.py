@@ -351,10 +351,15 @@ class Parser:
                     desc = True
                 else:
                     self.accept_kw("asc")
-                if self.accept_kw("nulls"):  # NULLS FIRST|LAST accepted, default order
-                    if not (self.accept_kw("first") or self.accept_kw("last")):
+                nulls_first = None
+                if self.accept_kw("nulls"):
+                    if self.accept_kw("first"):
+                        nulls_first = True
+                    elif self.accept_kw("last"):
+                        nulls_first = False
+                    else:
                         raise ParseError("expected FIRST or LAST")
-                sel.order_by.append(ast.OrderItem(e, desc))
+                sel.order_by.append(ast.OrderItem(e, desc, nulls_first))
                 if not self.accept_op(","):
                     break
         if self.accept_kw("limit"):

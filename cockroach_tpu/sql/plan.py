@@ -63,6 +63,22 @@ class HashJoin(PlanNode):
 
 
 @dataclass
+class Compact(PlanNode):
+    """Pack selected rows into a smaller batch (blocked top_k over the
+    selection mask). Inserted by the engine above low-selectivity
+    scans/filters feeding aggregation: every downstream per-row op —
+    join probe gathers above all — then runs at ``frac`` of the batch
+    instead of full width with masked lanes. The TPU analogue of the
+    reference's selection vectors (coldata.Batch sel), which its
+    operators consume implicitly; XLA needs the compaction to be an
+    explicit op. Per-block capacity overflow raises the
+    __compact_overflow sentinel and the engine replans uncompacted."""
+    child: PlanNode
+    frac: float = 0.125     # per-block capacity fraction
+    block: int = 32768
+
+
+@dataclass
 class Project(PlanNode):
     child: PlanNode
     items: list[tuple[str, BExpr]] = field(default_factory=list)
@@ -212,7 +228,7 @@ def prune_scan_columns(root: PlanNode) -> PlanNode:
                 for o, _ in w.order_by:
                     needed.update(referenced_columns(o))
         elif isinstance(n, Sort):
-            needed.update(name for name, _ in n.keys)
+            needed.update(k[0] for k in n.keys)
         for attr in ("child", "left", "right"):
             c = getattr(n, attr, None)
             if c is not None:

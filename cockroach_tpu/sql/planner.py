@@ -674,17 +674,19 @@ class Planner:
             grouped = has_group or bool(binder.aggs)
             for i, ob in enumerate(sel.order_by):
                 if isinstance(ob.expr, ast.Literal) and isinstance(ob.expr.value, int):
-                    keys.append((out_names[ob.expr.value - 1], ob.desc))
+                    keys.append((out_names[ob.expr.value - 1], ob.desc,
+                                 ob.nulls_first))
                 elif isinstance(ob.expr, ast.ColumnRef) \
                         and ob.expr.name in out_names:
-                    keys.append((ob.expr.name, ob.desc))
+                    keys.append((ob.expr.name, ob.desc,
+                                 ob.nulls_first))
                 elif not grouped and not sel.distinct \
                         and isinstance(node, plan.Project):
                     # hidden sort column (ordering by a non-output expr)
                     b = binder.bind(ob.expr)
                     hname = f"__ord{i}"
                     node.items.append((hname, b))
-                    keys.append((hname, ob.desc))
+                    keys.append((hname, ob.desc, ob.nulls_first))
                     # a hidden dict-encoded string key must still sort
                     # by value rank, not code (sort_batch consults
                     # meta.dictionaries by key name)
@@ -695,7 +697,8 @@ class Planner:
                             meta.dictionaries[hname] = d
                 else:
                     raise PlanError("ORDER BY must reference output columns")
-            for kname, _ in keys:
+            for key in keys:
+                kname = key[0]
                 if kname in out_names:
                     kty = out_types[out_names.index(kname)]
                     if not kty.is_orderable:

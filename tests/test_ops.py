@@ -234,3 +234,124 @@ class TestJoin:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])
+
+
+def _has_compact(n):
+    from cockroach_tpu.sql import plan as P
+    for a in ("child", "left", "right"):
+        c = getattr(n, a, None)
+        if c is not None and (isinstance(c, P.Compact) or _has_compact(c)):
+            return True
+    return isinstance(n, P.Compact)
+
+
+class TestCompaction:
+    """Selection compaction (compile.compact_batch): low-selectivity
+    scans under aggregation pack survivors before join probes / agg
+    partials. Round-3 perf work; correctness pinned here."""
+
+    def _engine_with_skew(self, rows=1 << 17, sorted_=False):
+        import numpy as np
+        from cockroach_tpu.exec.engine import Engine
+        e = Engine()
+        e.execute("CREATE TABLE sk (k INT PRIMARY KEY, d INT, v INT)")
+        rng = np.random.default_rng(0)
+        d = rng.integers(0, 100, rows)
+        if sorted_:
+            d = np.sort(d)  # matching rows cluster into few blocks
+        cols = {"k": np.arange(rows, dtype=np.int64),
+                "d": d.astype(np.int64),
+                "v": rng.integers(0, 1000, rows).astype(np.int64)}
+        e.store.insert_columns("sk", cols, e.clock.now())
+        return e, cols
+
+    def _add_dim(self, e, rows):
+        import numpy as np
+        e.execute("CREATE TABLE skdim (id INT PRIMARY KEY, w INT)")
+        g = np.random.default_rng(7)
+        w = g.integers(0, 9, 100)
+        e.store.insert_columns(
+            "skdim", {"id": np.arange(100, dtype=np.int64),
+                      "w": w.astype(np.int64)}, e.clock.now())
+        return w
+
+    JOINQ = ("SELECT count(*), sum(skdim.w) FROM sk "
+             "JOIN skdim ON skdim.id = sk.d WHERE sk.d < 10")
+
+    def test_compacted_join_aggregate_exact(self):
+        import numpy as np
+        e, cols = self._engine_with_skew()
+        w = self._add_dim(e, len(cols["d"]))
+        got = e.execute(self.JOINQ).rows
+        m = cols["d"] < 10
+        assert got == [(int(m.sum()), int(w[cols["d"][m]].sum()))]
+        # the plan really compacted (selectivity ~0.1 <= 1/8, probe
+        # side of a join under aggregation)
+        from cockroach_tpu.sql import parser
+        node, _ = e._plan(parser.parse(self.JOINQ), e.session())
+        assert _has_compact(e._insert_compaction(node))
+
+    def test_no_join_scan_agg_stays_masked(self):
+        """Q6-shaped scan+filter+agg must NOT compact: the masked
+        pipeline fuses fully; compaction only pays on join probes
+        (measured 1.9B -> 33M rows/s when Q6 was compacted)."""
+        from cockroach_tpu.sql import parser
+        e, cols = self._engine_with_skew()
+        q = "SELECT count(*), sum(v) FROM sk WHERE d < 10"
+        node, _ = e._plan(parser.parse(q), e.session())
+        assert not _has_compact(e._insert_compaction(node))
+        m = cols["d"] < 10
+        assert e.execute(q).rows == [(int(m.sum()),
+                                      int(cols["v"][m].sum()))]
+
+    def test_skewed_blocks_overflow_and_replan(self):
+        """Sorted data clusters every match into a few blocks: the
+        per-block capacity overflows, the sentinel trips, and the
+        engine replans uncompacted — same answer, no missing rows."""
+        import numpy as np
+        e, cols = self._engine_with_skew(sorted_=True)
+        w = self._add_dim(e, len(cols["d"]))
+        got = e.execute(self.JOINQ).rows
+        m = cols["d"] < 10
+        assert got == [(int(m.sum()), int(w[cols["d"][m]].sum()))]
+
+    def test_small_batches_skip_compaction(self):
+        import numpy as np
+        e, cols = self._engine_with_skew(rows=4096)
+        w = self._add_dim(e, 4096)
+        got = e.execute(self.JOINQ).rows
+        m = cols["d"] < 10
+        assert got == [(int(m.sum()), int(w[cols["d"][m]].sum()))]
+
+    def test_compacted_join_probe(self):
+        """Compaction under a join probe: the direct-address gather
+        runs at frac width; result matches the uncompacted path."""
+        import numpy as np
+        from cockroach_tpu.exec.engine import Engine
+        rows = 1 << 17
+        e = Engine()
+        e.execute("CREATE TABLE dim (id INT PRIMARY KEY, w INT)")
+        e.execute("CREATE TABLE fact (k INT PRIMARY KEY, fk INT, "
+                  "d INT)")
+        rng = np.random.default_rng(1)
+        dim_n = 500
+        e.store.insert_columns(
+            "dim", {"id": np.arange(dim_n, dtype=np.int64),
+                    "w": rng.integers(0, 9, dim_n).astype(np.int64)},
+            e.clock.now())
+        d = rng.integers(0, 100, rows)
+        fk = rng.integers(0, dim_n, rows)
+        e.store.insert_columns(
+            "fact", {"k": np.arange(rows, dtype=np.int64),
+                     "fk": fk.astype(np.int64),
+                     "d": d.astype(np.int64)}, e.clock.now())
+        q = ("SELECT sum(dim.w) FROM fact JOIN dim ON dim.id = fact.fk "
+             "WHERE fact.d < 7")
+        got = e.execute(q).rows
+        # numpy oracle from the same generator sequence
+        g = np.random.default_rng(1)
+        wdim = g.integers(0, 9, dim_n)
+        d2 = g.integers(0, 100, rows)
+        fk2 = g.integers(0, dim_n, rows)
+        want = int(wdim[fk2[d2 < 7]].sum())
+        assert got == [(want,)]

@@ -125,3 +125,19 @@ class TestPreparedFallback:
         p2 = e.prepare("SELECT a FROM t UNION SELECT a FROM t "
                        "ORDER BY a")
         assert p2.run().rows == [(1,), (2,), (3,)]
+
+
+def test_setop_order_by_nulls_first():
+    """Round-3 review: the decoded-row sort (set ops, SRFs, OLTP
+    fastpath) must honor explicit NULLS FIRST/LAST like the
+    vectorized sort does."""
+    from cockroach_tpu.exec.engine import Engine
+    e = Engine()
+    e.execute("CREATE TABLE so_nf (k INT PRIMARY KEY, v INT)")
+    e.execute("INSERT INTO so_nf VALUES (1, 10), (2, NULL), (3, 5)")
+    r = e.execute("SELECT v FROM so_nf UNION ALL SELECT v FROM so_nf "
+                  "ORDER BY v NULLS FIRST")
+    assert [x[0] for x in r.rows] == [None, None, 5, 5, 10, 10]
+    r = e.execute("SELECT v FROM so_nf UNION ALL SELECT v FROM so_nf "
+                  "ORDER BY v DESC NULLS LAST")
+    assert [x[0] for x in r.rows] == [10, 10, 5, 5, None, None]
