@@ -1620,16 +1620,41 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         int-family range/equality conjuncts contribute; every other
         conjunct can only shrink the true selectivity further, so the
         estimate stays an UPPER bound — safe for sizing capacity."""
-        from ..sql.bound import BBin, BCol, BConst
+        from ..sql.bound import BBin, BCol, BConst, BDictLookup, BInList
         pred = scan.filter
         if pred is None:
             return None
         cons: dict[str, list] = {}
+        dict_fracs: list[float] = []
+
+        def _dict_len(col: BCol) -> int | None:
+            stored = scan.columns.get(col.name)
+            if stored is None:
+                return None
+            try:
+                d = self.store.table(scan.table).dictionaries.get(stored)
+            except KeyError:
+                return None
+            return len(d.values) if d is not None else None
 
         def walk(e):
             if isinstance(e, BBin) and e.op == "and":
                 walk(e.left)
                 walk(e.right)
+                return
+            if isinstance(e, BDictLookup) and isinstance(e.expr, BCol):
+                # precomputed dictionary predicate (LIKE / ordered
+                # string compare): the bool table's mean IS the
+                # fraction of distinct values matching
+                tbl = np.asarray(e.table)
+                if tbl.size:
+                    dict_fracs.append(float(tbl.mean()))
+                return
+            if isinstance(e, BInList) and isinstance(e.expr, BCol) \
+                    and e.expr.type.uses_dictionary and not e.negated:
+                n = _dict_len(e.expr)
+                if n:
+                    dict_fracs.append(min(1.0, len(e.values) / n))
                 return
             if isinstance(e, BBin) and e.op in ("<", "<=", ">", ">=",
                                                 "="):
@@ -1638,15 +1663,25 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     l, r = r, l
                     op = {"<": ">", "<=": ">=", ">": "<",
                           ">=": "<="}.get(op, op)
-                if isinstance(l, BCol) and isinstance(r, BConst) \
-                        and isinstance(r.value, int) \
-                        and not isinstance(r.value, bool):
-                    cons.setdefault(l.name, []).append((op, r.value))
+                if not (isinstance(l, BCol) and isinstance(r, BConst)
+                        and isinstance(r.value, int)
+                        and not isinstance(r.value, bool)):
+                    return
+                if op == "=" and l.type.uses_dictionary:
+                    # dict-code equality: 1/ndv with the dictionary
+                    # length as the distinct count
+                    n = _dict_len(l)
+                    if n:
+                        dict_fracs.append(1.0 / n)
+                    return
+                cons.setdefault(l.name, []).append((op, r.value))
         walk(pred)
-        if not cons:
+        if not cons and not dict_fracs:
             return None
         est = 1.0
-        got = False
+        for f in dict_fracs:
+            est *= f
+        got = bool(dict_fracs)
         for bname, cs in cons.items():
             stored = scan.columns.get(bname)
             if stored is None:
@@ -1677,45 +1712,83 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             got = True
         return est if got else None
 
+    def _compact_frac(self, est: float) -> float:
+        # 4x headroom over the uniform estimate absorbs moderate
+        # per-block skew; worse skew trips the sentinel and the
+        # engine replans uncompacted
+        return min(0.25, max(est * 4, 1 / 256))
+
     def _insert_compaction(self, node):
-        """Wrap low-selectivity scans that feed a JOIN PROBE under
-        aggregation in a Compact node (compile.compact_batch): the
-        probe gather — the dominant cost of a filtered star join —
-        then runs at a fraction of the batch width. A scan feeding
-        aggregation WITHOUT a join stays masked: the filter+agg fuse
-        into one streaming pass where compaction would only add
-        top_k + gathers (measured: Q6 1.9B -> 33M rows/s when
-        compacted; Q14 108M -> 145M when its probe is). Only
-        probe-side paths compact (compaction reorders rows, which
-        aggregation cannot observe); Project and Window stop the walk
-        (fresh columns would drop the sentinel / order matters)."""
+        """Wrap the DEEPEST point of a probe spine under aggregation
+        where the estimated surviving fraction drops to <= 1/8 in a
+        Compact node (compile.compact_batch): everything above — join
+        probe gathers, CASE math, grouped scatter-adds — then runs at
+        a fraction of the batch width.
+
+        Selectivity accumulates up the spine: a scan's pushed filter
+        (Q14's date range) or an INNER join against a filtered build
+        side (SSB's p_category/s_region dimension predicates, folded
+        into the packed join table) both shrink the selected set, so
+        the wrap point may be a Scan or a mid-spine HashJoin. A scan
+        feeding aggregation with NO join and no scatter stays masked:
+        the fused filter+agg pipeline is already optimal (measured:
+        Q6 1.9B -> 33M rows/s when compacted). Wraps above the last
+        join additionally require a scatter-strategy aggregate (hash,
+        or dense beyond the unrolled small-G path) so there is real
+        work left to shrink. Expanding joins (duplicate build keys)
+        stop the walk — their output length breaks the est bookkeeping.
+        Project and Window stop it too (fresh columns would drop the
+        sentinel / order matters)."""
         from ..sql import plan as P
 
-        def insert(n, under_agg, in_join):
+        def build_sel(jn) -> float:
+            if jn.join_type != "inner":
+                return 1.0
+            if isinstance(jn.right, P.Scan):
+                e = self._estimate_scan_selectivity(jn.right)
+                return e if e is not None else 1.0
+            return 1.0
+
+        # (node, est, wrapped_below, joins_below)
+        def spine(n, joins_above, agg_scatters):
+            if isinstance(n, P.Filter):
+                c, est, wrapped, jb = spine(n.child, joins_above,
+                                            agg_scatters)
+                n.child = c
+                return n, est, wrapped, jb
+            if isinstance(n, P.Scan):
+                est = self._estimate_scan_selectivity(n)
+                est = est if est is not None else 1.0
+                if est <= self.COMPACT_MAX_EST and joins_above > 0:
+                    return (P.Compact(n, frac=self._compact_frac(est)),
+                            est, True, 0)
+                return n, est, False, 0
+            if isinstance(n, P.HashJoin):
+                if n.expand != 1:
+                    return n, 1.0, False, 1
+                c, left_est, wrapped, jb = spine(
+                    n.left, joins_above + 1, agg_scatters)
+                n.left = c
+                est = left_est * build_sel(n)
+                if not wrapped and est <= self.COMPACT_MAX_EST \
+                        and (joins_above > 0 or agg_scatters):
+                    return (P.Compact(n, frac=self._compact_frac(est)),
+                            est, True, jb + 1)
+                return n, est, wrapped, jb + 1
+            return n, 1.0, False, 0
+
+        def walk(n):
             if isinstance(n, P.Aggregate):
-                n.child = insert(n.child, True, in_join)
+                dense = n.max_groups > 0
+                scatters = bool(n.group_by) and \
+                    (not dense or n.max_groups > 64)
+                n.child = spine(n.child, 0, scatters)[0]
                 return n
             if isinstance(n, (P.Sort, P.Limit)):
-                n.child = insert(n.child, under_agg, in_join)
+                n.child = walk(n.child)
                 return n
-            if isinstance(n, P.HashJoin):
-                if under_agg:
-                    n.left = insert(n.left, True, True)
-                return n
-            if isinstance(n, P.Filter):
-                if under_agg:
-                    n.child = insert(n.child, True, in_join)
-                return n
-            if isinstance(n, P.Scan) and under_agg and in_join:
-                est = self._estimate_scan_selectivity(n)
-                if est is not None and est <= self.COMPACT_MAX_EST:
-                    # 4x headroom over the uniform estimate absorbs
-                    # moderate per-block skew; worse skew trips the
-                    # sentinel and replans uncompacted
-                    frac = min(0.25, max(est * 4, 1 / 256))
-                    return P.Compact(n, frac=frac)
             return n
-        return insert(node, False, False)
+        return walk(node)
 
     def _exec_unnest(self, sel: ast.Select, e: ast.FuncCall,
                      binder: Binder):
