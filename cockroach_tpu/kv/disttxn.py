@@ -65,16 +65,27 @@ def _record_key(txn_id: str) -> bytes:
 
 def propose_txn_record(cluster, anchor: bytes, txn_id: str,
                        status: str, ts: Timestamp,
-                       writes: Optional[list] = None) -> dict:
+                       writes: Optional[list] = None,
+                       finalize_staging: bool = False) -> dict:
     """The single wire shape for conditional record writes — used by
     the commit path, the pusher's poison, and parallel-commit staging
     (which declares the txn's write set for the recovery proof) so no
-    two sides can desynchronize below raft."""
+    two sides can desynchronize below raft.
+
+    ``finalize_staging`` marks a proposer with the authority to move a
+    STAGING record to ABORTED: status recovery (which has verified the
+    write set, cmd_recover_txn.go) or the txn's own coordinator. A
+    pusher's blind poison must NOT carry it — a parallel commit whose
+    implicit-commit condition already holds would otherwise be
+    spuriously aborted; the poison instead fails with
+    existing='staging' and the pusher runs recovery."""
     rep = cluster._leaseholder_replica(anchor)
     op = {"op": "txn_record",
           "key": _record_key(txn_id).decode("latin1"),
           "anchor": anchor.decode("latin1"),
           "status": status, "ts": _enc_ts(ts)}
+    if finalize_staging:
+        op["finalize_staging"] = True
     if writes is not None:
         op["writes"] = writes
     out = cluster.propose_and_wait(rep, {"kind": "batch", "ops": [op]})
@@ -266,7 +277,8 @@ class DistTxn:
             # committed — resolve that way instead of erasing some
             # intents of a committed txn (review round 3)
             res = propose_txn_record(c, self.anchor, self.id,
-                                     "aborted", c.clock.now())
+                                     "aborted", c.clock.now(),
+                                     finalize_staging=True)
             if not res.get("ok") and res.get("existing") == "committed":
                 self.status = "committed"
                 cts = _dec_ts(res["existing_ts"])
@@ -285,7 +297,8 @@ class DistTxn:
             # a bumped intent — but honor a COMMITTED verdict anyway
             # rather than resolve committed intents as aborts
             res = propose_txn_record(c, self.anchor, self.id,
-                                     "aborted", c.clock.now())
+                                     "aborted", c.clock.now(),
+                                     finalize_staging=True)
             if not res.get("ok") and res.get("existing") == "committed":
                 self.status = "committed"
                 cts = _dec_ts(res["existing_ts"])
@@ -339,9 +352,11 @@ class DistTxn:
     def _write_record(self, status: str, ts: Timestamp) -> dict:
         """Conditionally write the record through the anchor range's
         raft log; the decision happens at apply time so pushes and
-        commits serialize on the log (see store.py ``txn_record``)."""
+        commits serialize on the log (see store.py ``txn_record``).
+        Coordinator writes to the txn's OWN record carry
+        finalize_staging authority."""
         return propose_txn_record(self.cluster, self.anchor, self.id,
-                                  status, ts)
+                                  status, ts, finalize_staging=True)
 
     def resolve_all(self, commit: bool,
                     commit_ts: Optional[Timestamp]) -> None:
@@ -433,7 +448,8 @@ def recover_staging_txn(cluster, txn_meta: TxnMeta, rec: dict):
             return "committed", ts
         return "aborted", None
     res = propose_txn_record(cluster, txn_meta.key, txn_meta.id,
-                             "aborted", cluster.clock.now())
+                             "aborted", cluster.clock.now(),
+                             finalize_staging=True)
     if not res.get("ok") and res.get("existing") == "committed":
         # the coordinator's explicit commit landed first: the txn is
         # committed after all (our missing intent was a not-yet-applied

@@ -488,7 +488,16 @@ class Replica:
                     # minting a new one
                     return {"ok": True, "existing": ex,
                             "existing_ts": existing["ts"]}
-                if ex == "staging" and want in ("committed", "aborted"):
+                if ex == "staging" and (
+                        want == "committed"
+                        or (want == "aborted"
+                            and op.get("finalize_staging"))):
+                    # staging -> aborted requires finalize authority
+                    # (recovery's write-set proof or the coordinator);
+                    # a pusher's blind poison instead fails below with
+                    # existing='staging' and runs recovery — otherwise
+                    # it could abort a parallel commit whose
+                    # implicit-commit condition already holds
                     rec = json.dumps({
                         "status": want, "ts": op["ts"],
                         "anchor": existing.get("anchor", "")})

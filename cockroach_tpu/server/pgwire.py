@@ -51,6 +51,8 @@ def _sqlstate(exc: Exception) -> str:
     from ..utils.mon import MemoryQuotaError
 
     msg = str(exc)
+    if isinstance(exc, CopyDataError):
+        return "22P02"  # invalid_text_representation
     if "restart transaction" in msg:
         return "40001"  # serialization_failure
     if "transaction is aborted" in msg:
@@ -164,6 +166,37 @@ def _copy_parse_line(line: bytes, ncols: int) -> list:
     return [None if f == "\\N" else _copy_unescape(f) for f in fields]
 
 
+class CopyDataError(Exception):
+    """Bad field content in COPY text data (sqlstate 22P02)."""
+
+
+_COPY_INT_RE = re.compile(r"[+-]?[0-9]+")
+# pg numeric/float text: decimal with optional exponent, or the
+# special values NaN/Infinity (case-insensitive)
+_COPY_SPECIAL_FLOAT_RE = re.compile(r"[+-]?(nan|inf(inity)?)",
+                                    re.IGNORECASE)
+_COPY_FLOAT_RE = re.compile(
+    r"[+-]?([0-9]+(\.[0-9]*)?|\.[0-9]+)([eE][+-]?[0-9]+)?"
+    r"|[+-]?(nan|inf(inity)?)", re.IGNORECASE)
+
+
+def _copy_check_numeric(v: str, is_float: bool, col: str) -> None:
+    """Validate a COPY text field bound for a numeric column host-side.
+
+    pg text format only accepts \\N as NULL — the literal text 'NULL'
+    for an int column is invalid input, never SQL NULL — and a
+    malformed token must fail with invalid-input-syntax, not be
+    interpolated into the INSERT. Explicit regexes, not int()/float():
+    Python accepts '1_000' and Unicode digits, which pg rejects (and
+    which must never reach the interpolated INSERT).
+    """
+    pat = _COPY_FLOAT_RE if is_float else _COPY_INT_RE
+    if not pat.fullmatch(v):
+        kind = "type numeric" if is_float else "type int"
+        raise CopyDataError(
+            f"invalid input syntax for {kind}: {v!r} in column {col}")
+
+
 def _copy_sql_literal(v, numeric: bool) -> str:
     """One VALUES literal for a COPY field. Quoting is decided by the
     TARGET COLUMN's type, not by sniffing the text — 'nan'/'inf'
@@ -171,6 +204,10 @@ def _copy_sql_literal(v, numeric: bool) -> str:
     if v is None:
         return "NULL"
     if numeric:
+        # NaN/Infinity are valid pg float text but not bare SQL
+        # tokens — the engine accepts them as quoted literals
+        if _COPY_SPECIAL_FLOAT_RE.fullmatch(v):
+            return "'" + v + "'"
         return v
     return "'" + v.replace("'", "''") + "'"
 
@@ -684,14 +721,32 @@ class _Conn:
         self.w.command_complete(f"COPY {len(res.rows)}")
 
     def _copy_in(self, table: str, cols: list[str]):
+        # resolve the schema BEFORE CopyInResponse: an unknown column
+        # must error while the client is still in query mode — after
+        # the response the client streams CopyData and any raise that
+        # skips the drain loop desyncs the protocol
+        from ..sql.types import Family
+        schema = self.engine.store.table(table).schema
+        numeric = [schema.column(c).type.family in
+                   (Family.INT, Family.FLOAT, Family.DECIMAL)
+                   for c in cols]
+        is_float = [schema.column(c).type.family in
+                    (Family.FLOAT, Family.DECIMAL) for c in cols]
         self.w.copy_in_response(len(cols))
         self.w.flush()
         buf = b""
         rows: list[list[str | None]] = []
         failed = None
+        # A bad row must NOT abort the receive loop: pg keeps consuming
+        # CopyData until CopyDone/CopyFail, then reports the error —
+        # bailing early desyncs the protocol (the leftover frames would
+        # be read as unknown frontend messages by serve()).
+        parse_err: Exception | None = None
         while True:
             typ, body = self.r.message()
             if typ == b"d":
+                if parse_err is not None:
+                    continue         # drain only; first error wins
                 buf += body
                 # CopyData chunks can split mid-line: keep the tail
                 while True:
@@ -701,8 +756,18 @@ class _Conn:
                     line, buf = buf[:nl], buf[nl + 1:]
                     if line == b"\\.":
                         continue
-                    if line:
-                        rows.append(_copy_parse_line(line, len(cols)))
+                    if not line:
+                        continue
+                    try:
+                        r = _copy_parse_line(line, len(cols))
+                        for i, v in enumerate(r):
+                            if v is not None and numeric[i]:
+                                _copy_check_numeric(
+                                    v, is_float[i], cols[i])
+                        rows.append(r)
+                    except Exception as e:
+                        parse_err = e
+                        break
             elif typ == b"c":        # CopyDone
                 break
             elif typ == b"f":        # CopyFail
@@ -716,11 +781,8 @@ class _Conn:
         if failed is not None:
             self.w.error(f"COPY failed: {failed}", code="57014")
             return
-        from ..sql.types import Family
-        schema = self.engine.store.table(table).schema
-        numeric = [schema.column(c).type.family in
-                   (Family.INT, Family.FLOAT, Family.DECIMAL)
-                   for c in cols]
+        if parse_err is not None:
+            raise parse_err
         inserted = 0
         # batches through the normal INSERT path (constraints and
         # indexes apply), wrapped in ONE transaction so a mid-COPY

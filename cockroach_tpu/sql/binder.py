@@ -888,8 +888,13 @@ class Binder:
             d = self._dict_of(l)
             if d is None:
                 raise BindError("datum compare on non-dictionary column")
-            text = r.value if r.type.family in (Family.JSON, Family.ARRAY) \
-                else dtm.canon_text(str(r.value), l.type)
+            if r.type.family in (Family.JSON, Family.ARRAY):
+                text = r.value
+            else:
+                try:
+                    text = dtm.canon_text(str(r.value), l.type)
+                except dtm.DatumError as err:
+                    raise BindError(str(err)) from None
             code = d.codes.get(text)
             if code is None:
                 return BConst(op == "!=", BOOL)

@@ -684,6 +684,12 @@ class Planner:
                         and isinstance(node, plan.Project):
                     # hidden sort column (ordering by a non-output expr)
                     b = binder.bind(ob.expr)
+                    if not b.type.is_orderable:
+                        # same guard as the visible-key check below: a
+                        # hidden datum key would silently sort by
+                        # dictionary insertion code
+                        raise PlanError(
+                            f"ORDER BY on {b.type} is not supported")
                     hname = f"__ord{i}"
                     node.items.append((hname, b))
                     keys.append((hname, ob.desc, ob.nulls_first))

@@ -255,3 +255,156 @@ class TestTruncateVsOpenTxn:
         # after commit the truncate goes through
         eng.execute("TRUNCATE tt")
         assert eng.execute("SELECT count(*) FROM tt").rows == [(0,)]
+
+
+# ---------------------------------------------------------------------------
+# round 3 ADVICE.md findings
+# ---------------------------------------------------------------------------
+
+class TestCopyProtocolSync:
+    """ADVICE medium: a parse error mid-COPY must drain the client's
+    remaining CopyData/CopyDone frames before erroring, or the serve
+    loop reads them as unknown frontend messages and the connection is
+    desynced."""
+
+    @pytest.fixture(scope="class")
+    def node(self):
+        from cockroach_tpu.server import Node, NodeConfig
+        with Node(NodeConfig()) as n:
+            yield n
+
+    def test_bad_column_count_keeps_connection_usable(self, node):
+        from cockroach_tpu.cli import PgClient, PgError
+        c = PgClient(*node.sql_addr)
+        c.query("CREATE TABLE cps (k INT PRIMARY KEY, v STRING)")
+        with pytest.raises(PgError):
+            # 3 fields into a 2-column COPY, with MORE data after the
+            # bad row — all of it must be drained
+            c.copy_in("COPY cps (k, v) FROM STDIN",
+                      ["1\ta", "2\tb\textra", "3\tc", "4\td"])
+        # the NEXT query must work (previously: 'unknown frontend
+        # message' desync)
+        _, rows, _ = c.query("SELECT 42")
+        assert rows == [("42",)]
+        c.close()
+
+    def test_null_text_for_int_column_rejected(self, node):
+        """ADVICE low: the literal text 'NULL' is invalid input for an
+        int column (pg only accepts \\N), never SQL NULL."""
+        from cockroach_tpu.cli import PgClient, PgError
+        c = PgClient(*node.sql_addr)
+        c.query("CREATE TABLE cpn (k INT PRIMARY KEY, n INT)")
+        with pytest.raises(PgError) as ei:
+            c.copy_in("COPY cpn (k, n) FROM STDIN", ["1\tNULL"])
+        assert ei.value.sqlstate == "22P02"
+        # real NULL via \N still works, connection still usable
+        assert c.copy_in("COPY cpn (k, n) FROM STDIN",
+                         ["1\t\\N"]) == "COPY 1"
+        _, rows, _ = c.query("SELECT k, n FROM cpn")
+        assert rows == [("1", None)]
+        c.close()
+
+    def test_malformed_numeric_rejected(self, node):
+        from cockroach_tpu.cli import PgClient, PgError
+        c = PgClient(*node.sql_addr)
+        c.query("CREATE TABLE cpm (k INT PRIMARY KEY)")
+        with pytest.raises(PgError) as ei:
+            c.copy_in("COPY cpm (k) FROM STDIN", ["1); DROP TABLE x--"])
+        assert ei.value.sqlstate == "22P02"
+        _, rows, _ = c.query("SELECT count(*) FROM cpm")
+        assert rows == [("0",)]
+        c.close()
+
+
+class TestHiddenSortKeyOrderability:
+    """ADVICE medium: a hidden sort key (__ordN) for a datum-typed
+    expression must hit the same orderability check as visible keys —
+    not silently sort by dictionary insertion code."""
+
+    def test_order_by_hidden_array_expr_rejected(self, eng):
+        from cockroach_tpu.sql.planner import PlanError
+        eng.execute("CREATE TABLE arr (k INT PRIMARY KEY, a INT[])")
+        eng.execute("INSERT INTO arr VALUES (1, ARRAY[9]), "
+                    "(2, ARRAY[1,2]), (3, ARRAY[1])")
+        with pytest.raises(PlanError, match="ORDER BY"):
+            eng.execute("SELECT k FROM arr ORDER BY a || ARRAY[1]")
+
+    def test_order_by_visible_int_still_works(self, eng):
+        eng.execute("CREATE TABLE arr2 (k INT PRIMARY KEY, a INT[])")
+        eng.execute("INSERT INTO arr2 VALUES (2, ARRAY[1]), "
+                    "(1, ARRAY[2])")
+        r = eng.execute("SELECT k FROM arr2 ORDER BY k")
+        assert [row[0] for row in r.rows] == [1, 2]
+
+
+class TestDatumCompareBindError:
+    """ADVICE low: WHERE a = 'not-an-array' must surface a BindError
+    (the engine's SQL error taxonomy), not a raw DatumError."""
+
+    def test_invalid_array_text_is_bind_error(self, eng):
+        from cockroach_tpu.sql.binder import BindError
+        eng.execute("CREATE TABLE da (k INT PRIMARY KEY, a INT[])")
+        eng.execute("INSERT INTO da VALUES (1, ARRAY[1])")
+        with pytest.raises(BindError):
+            eng.execute("SELECT k FROM da WHERE a = 'not-an-array'")
+
+    def test_valid_array_text_still_compares(self, eng):
+        eng.execute("CREATE TABLE da2 (k INT PRIMARY KEY, a INT[])")
+        eng.execute("INSERT INTO da2 VALUES (1, ARRAY[1,2]), "
+                    "(2, ARRAY[3])")
+        r = eng.execute("SELECT k FROM da2 WHERE a = '{1,2}'")
+        assert r.rows == [(1,)]
+
+
+class TestStagingPushGuard:
+    """ADVICE low: a pusher's blind poison must not finalize a STAGING
+    record as aborted — only recovery (write-set proof) or the
+    coordinator may; the poison fails with existing='staging' and the
+    pusher runs recovery."""
+
+    def test_plain_abort_cannot_finalize_staging(self):
+        from cockroach_tpu.kv.disttxn import (DistTxn, propose_txn_record,
+                                              read_txn_record)
+        from cockroach_tpu.kvserver.cluster import Cluster
+        c = Cluster(n_nodes=3)
+        c.create_range(b"a", b"n", replicas=[1, 2, 3])
+        c.create_range(b"n", b"z", replicas=[1, 2, 3])
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        res = propose_txn_record(
+            c, t.anchor, t.id, "staging", c.clock.now(),
+            writes=["apple"])
+        assert res["ok"]
+        # a blind poison (no finalize authority) must FAIL
+        res = propose_txn_record(c, t.anchor, t.id, "aborted",
+                                 c.clock.now())
+        assert not res.get("ok") and res.get("existing") == "staging"
+        rec = read_txn_record(c, t._meta())
+        assert rec["status"] == "staging"
+        # recovery (finalize authority) may
+        res = propose_txn_record(c, t.anchor, t.id, "aborted",
+                                 c.clock.now(), finalize_staging=True)
+        assert res["ok"]
+
+    def test_pusher_commits_implicitly_committed_staging(self):
+        """The full path: reader pushes an intent of a txn whose
+        staging record + all declared writes are applied — the verdict
+        must be COMMITTED (recovery), not a spurious abort."""
+        from cockroach_tpu.kv.disttxn import (DistTxn, propose_txn_record,
+                                              read_txn_record)
+        from cockroach_tpu.kvserver.cluster import Cluster
+        c = Cluster(n_nodes=3)
+        c.create_range(b"a", b"n", replicas=[1, 2, 3])
+        c.create_range(b"n", b"z", replicas=[1, 2, 3])
+        t = DistTxn(c)
+        t.put(b"apple", b"1")
+        t.put(b"pear", b"2")
+        res = propose_txn_record(
+            c, t.anchor, t.id, "staging", c.clock.now(),
+            writes=[k.decode("latin1") for k in t.intents])
+        assert res["ok"]
+        c.pump(5)
+        reader = DistTxn(c)
+        assert reader.get(b"apple") == b"1"
+        rec = read_txn_record(c, t._meta())
+        assert rec is not None and rec["status"] == "committed"
