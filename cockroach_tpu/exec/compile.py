@@ -121,7 +121,16 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
                 d, v = f(ctx)
                 cols[name] = d
                 valid[name] = v
-            return ColumnBatch.from_dict(cols, valid, sel=b.sel)
+            out = ColumnBatch.from_dict(cols, valid, sel=b.sel)
+            if b.has("__compact_overflow"):
+                # bubble a child Compact's capacity sentinel through
+                # the fresh output batch (projection drops child
+                # columns; the engine checks it at materialize time)
+                out = out.with_column(
+                    "__compact_overflow",
+                    jnp.broadcast_to(jnp.any(b.col("__compact_overflow")),
+                                     (out.n,)))
+            return out
         return run_project
     if isinstance(node, P.HashJoin):
         leftf = compile_plan(node.left, params)
@@ -264,7 +273,7 @@ def _agg_output(group_cols, aggs_out, live, itemfs, havingf,
     return out
 
 def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
-                  axis_name=None):
+                  axis_name=None, max_group_rows=0, rep_state=None):
     """Compute one aggregate's per-group arrays: (data, valid).
 
     With axis_name set, partials merge across mesh shards with the
@@ -290,6 +299,14 @@ def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
         d = psum(d)
         return d, jnp.ones_like(d, dtype=jnp.bool_), None
     d0, v0 = argf(ctx)
+    if a.func == "any" and grouped and rep_state is not None \
+            and axis_name is None and not a.distinct:
+        # FD-riding keys gather through the SHARED representative
+        # index (one scatter for the whole Aggregate) instead of
+        # paying 2 limb scatter-SETs + a count scatter each
+        rep, nonempty = rep_state
+        d, v = aggops.group_any_via_rep(d0, v0, rep, nonempty)
+        return d, v, None
     mask = jnp.logical_and(batch.sel, v0)
     if a.distinct:
         # DISTINCT x = keep only the first occurrence of each
@@ -317,7 +334,11 @@ def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
     if a.func in ("sum", "sum_int"):
         acc = jnp.float64 if d0.dtype == jnp.float64 else jnp.int64
         if grouped:
-            d = aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc)
+            d = aggops.group_sum(d0, gid, mask, num_groups,
+                                 acc_dtype=acc,
+                                 max_group_rows=max_group_rows,
+                                 arg_max_abs=a.arg_max_abs,
+                                 arg_nonneg=a.arg_nonneg)
         else:
             d = aggops.masked_sum(d0, mask, acc_dtype=acc)[None]
         d = psum(d)
@@ -606,6 +627,7 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 and num_groups <= 64 and b.n % 128 == 0):
             pslots = _pallas_agg_slots([a for a, _ in aggfs])
         overflow = jnp.bool_(False)
+        rep_state = None
         if pslots is not None:
             pgid = (gid if gid is not None
                     else jnp.zeros((b.n,), dtype=jnp.int32))
@@ -613,10 +635,17 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 pslots, aggfs, b, ctx, pgid, num_groups, axis,
                 params.pallas_interpret)
         else:
+            if gid is not None and axis is None and any(
+                    a.func == "any" and not a.distinct
+                    for a, _ in aggfs):
+                rep_state = aggops.group_rep_index(gid, b.sel,
+                                                   num_groups)
             aggs_out = []
             for a, argf in aggfs:
                 d, v, ovf = _agg_partials(a, argf, b, ctx, gid,
-                                          num_groups, axis)
+                                          num_groups, axis,
+                                          node.max_group_rows,
+                                          rep_state)
                 aggs_out.append((d, v))
                 if ovf is not None:
                     overflow = jnp.logical_or(overflow, ovf)
@@ -625,10 +654,15 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
         if not groupfs:
             live = jnp.ones((1,), dtype=jnp.bool_)
         elif dense:
-            cnt = aggops.group_count(gid, b.sel, num_groups)
-            if axis:
-                cnt = jax.lax.psum(cnt, axis)
-            live = cnt > 0
+            if rep_state is not None:
+                # the shared representative scatter already knows
+                # which groups have live rows
+                live = rep_state[1]
+            else:
+                cnt = aggops.group_count(gid, b.sel, num_groups)
+                if axis:
+                    cnt = jax.lax.psum(cnt, axis)
+                live = cnt > 0
         else:
             garange = jnp.arange(num_groups, dtype=jnp.int32)
             live = garange < ng
@@ -848,7 +882,8 @@ def _agg_state_ops(a: BoundAgg) -> tuple:
     raise ExecError(f"aggregate {a.func} cannot stream")
 
 
-def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups) -> tuple:
+def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups,
+                    max_group_rows=0) -> tuple:
     """One page's partial-state arrays for one aggregate (layout must
     match _agg_state_ops)."""
     grouped = gid is not None
@@ -865,7 +900,10 @@ def _agg_page_state(a: BoundAgg, argf, batch, ctx, gid, num_groups) -> tuple:
         return (cnt,)
     if a.func in ("sum", "sum_int"):
         acc = jnp.float64 if _is_float_agg_arg(a) else jnp.int64
-        d = (aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc)
+        d = (aggops.group_sum(d0, gid, mask, num_groups, acc_dtype=acc,
+                              max_group_rows=max_group_rows,
+                              arg_max_abs=a.arg_max_abs,
+                              arg_nonneg=a.arg_nonneg)
              if grouped else aggops.masked_sum(d0, mask, acc_dtype=acc)[None])
         if acc == jnp.int64:
             # same gate as _agg_partials: when this page's rows*max
@@ -1006,7 +1044,8 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
                 gid = gid * (dim + 1) + code
         state = []
         for a, argf in aggfs:
-            state.extend(_agg_page_state(a, argf, b, ctx, gid, num_groups))
+            state.extend(_agg_page_state(a, argf, b, ctx, gid, num_groups,
+                                         agg.max_group_rows))
         # group liveness counter rides last
         live_cnt = (aggops.group_count(gid, b.sel, num_groups) if groupfs
                     else aggops.masked_count(b.sel)[None])
@@ -1125,7 +1164,8 @@ def _compile_hash_dist_aggregate(node: P.Aggregate, params: ExecParams,
 
         flat_state = []
         for a, argf in aggfs:
-            flat_state.extend(_agg_page_state(a, argf, b, ctx, gid, cap))
+            flat_state.extend(_agg_page_state(a, argf, b, ctx, gid, cap,
+                                              node.max_group_rows))
 
         from ..parallel import shuffle as shufmod
 

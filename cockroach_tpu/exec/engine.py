@@ -833,11 +833,27 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         sel = copy.deepcopy(sel)
         temps: list[str] = []
         mapping: dict[str, str] = {}
+        # STABLE temp names: re-executions of the same statement (a
+        # pgwire portal / Prepared re-run) must produce the same temp
+        # table names, or every plan/executable-cache key downstream
+        # misses and the main query pays a full XLA recompile per
+        # execution (~1.5s/exec measured on q9). Session identity
+        # separates concurrent sessions; nesting depth separates a
+        # CTE whose body re-enters this path.
+        depth = getattr(session, "_cte_depth", 0)
+        session._cte_depth = depth + 1
+        prefix = f"__cte_{id(session):x}_d{depth}"
+        seq = [0]
+
+        def _tname(name: str) -> str:
+            seq[0] += 1
+            return f"{prefix}_{seq[0]}_{name}"
+
         try:
             for name, cols, sub in sel.ctes:
                 sub = _propagate_as_of(
                     _rewrite_table_names(sub, mapping), sel)
-                tname = f"__cte{self._temp_seq()}_{name}"
+                tname = _tname(name)
                 self._materialize_temp_select(tname, sub, session,
                                               cols, f"(cte {sub!r})")
                 mapping[name] = tname
@@ -851,7 +867,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     continue
                 sub = _propagate_as_of(
                     _rewrite_table_names(ref.subquery, mapping), sel)
-                tname = f"__cte{self._temp_seq()}_{ref.alias}"
+                tname = _tname(ref.alias)
                 self._materialize_temp_select(
                     tname, sub, session, None, f"(derived {sub!r})")
                 temps.append(tname)
@@ -863,6 +879,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             sel = _rewrite_table_names(sel, mapping)
             return self._exec_select(sel, session, sql_text)
         finally:
+            session._cte_depth = depth
             for t in temps:
                 if t in self.store.tables:
                     self.store.drop_table(t)
@@ -905,9 +922,21 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             runner = getattr(prep, "jfn", None)
             if runner is None or prep.stream is not None:
                 raise EngineError("shape takes the row path")
+            from ..ops.batch import pull_arrays
             out = prep.dispatch()
-            if out.has("__compact_overflow") and bool(
-                    np.asarray(out.col("__compact_overflow"))[0]):
+
+            def _flags(b):
+                """(sel, sentinel flags) in ONE packed transfer —
+                per-array pulls each pay the full tunnel RTT."""
+                sent = [s for s in ("__ht_overflow", "__topk_inexact",
+                                    "__compact_overflow",
+                                    "__sum_overflow") if b.has(s)]
+                pulled = pull_arrays(
+                    [b.sel] + [jnp.any(b.col(s)) for s in sent])
+                return pulled[0], dict(zip(sent, pulled[1:]))
+
+            sel, flags = _flags(out)
+            if flags.get("__compact_overflow"):
                 # retry the COLUMNAR fast path uncompacted rather
                 # than dropping to the ~100x-slower decoded-row
                 # ingest (which would also re-compact and overflow
@@ -915,15 +944,14 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 prep = self._prepare_select(sub, session, sql_text,
                                             no_compact=True)
                 out = prep.dispatch()
+                sel, flags = _flags(out)
             for sentinel, exc in (
                     ("__ht_overflow", HashCapacityExceeded),
                     ("__topk_inexact", TopKInexact),
                     ("__compact_overflow", CompactOverflow)):
-                if out.has(sentinel) and bool(
-                        np.asarray(out.col(sentinel))[0]):
+                if flags.get(sentinel):
                     raise exc(sentinel)
-            if out.has("__sum_overflow") and bool(
-                    np.asarray(out.col("__sum_overflow"))[0]):
+            if flags.get("__sum_overflow"):
                 # a user-facing error, not a row-path retry: the row
                 # path would raise the same thing
                 raise EngineError(
@@ -946,32 +974,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 primary_key=[],
                 table_id=self.store.alloc_table_id())
             self.store.create_table(schema)
-            sel = np.asarray(out.sel)
-            live = np.nonzero(sel)[0]
-            gather_idx = None
-            if len(live) * 2 < len(sel) and len(live):
-                # join-expanded outputs are mostly dead rows: gather
-                # the live ones ON DEVICE so the host transfer moves
-                # only real data (q9's derived table: 134K live of a
-                # multi-million-row padded batch — the full-batch
-                # transfer through the tunnel was ~18s). Padded to a
-                # pow2 so the gather program's compile caches across
-                # executions.
-                padded = max(_next_pow2(len(live)), 1024)
-                idx = np.full(padded, live[-1], dtype=np.int32)
-                idx[:len(live)] = live
-                gather_idx = jax.device_put(idx)
+            # one packed transfer for the live rows of every column
+            # (data + valid): per-column pulls paid ~17 tunnel RTTs
+            # per q9 execution, and the full-batch transfer of a
+            # join-expanded output was ~18s (134K live of a multi-
+            # million-row padded batch)
+            from ..ops.batch import pull_batch_columns
+            pulled, _ = pull_batch_columns(out, list(meta.names),
+                                           sel_np=sel)
             cols: dict[str, np.ndarray] = {}
             valid: dict[str, np.ndarray] = {}
-            for cname, oname, ty in zip(names, meta.names, meta.types):
-                if gather_idx is not None:
-                    arr = np.asarray(jnp.take(out.col(oname),
-                                              gather_idx))[:len(live)]
-                    v = np.asarray(jnp.take(out.col_valid(oname),
-                                            gather_idx))[:len(live)]
-                else:
-                    arr = np.asarray(out.col(oname))[sel]
-                    v = np.asarray(out.col_valid(oname))[sel]
+            for cname, oname, ty in zip(names, meta.names,
+                                        meta.types):
+                arr, v = pulled[oname]
                 if ty.uses_dictionary:
                     d = meta.dictionaries.get(oname)
                     if d is None:
@@ -1099,6 +1114,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             for t in overlay}
         try:
             self._check_join_builds(node, read_ts, overlay_puts)
+            self._bound_agg_group_rows(node, read_ts, overlay_puts)
         except EngineError:
             if meta.memo is not None and not no_memo:
                 # the memo's stats-estimated build order violated the
@@ -1410,6 +1426,123 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             out.sort(key=key, reverse=ob.desc)
         return out
 
+    def _bound_agg_group_rows(self, node, read_ts: Timestamp,
+                              overlay: dict) -> None:
+        """Attach a static rows-per-group upper bound to Aggregate
+        nodes whose group keys trace to stored columns of a probe-
+        spine scan through expand==1 joins (filters/compaction only
+        shrink groups; one-row-per-probe joins never grow them). The
+        bound sizes the i32 limb width of exact int64 group sums
+        (ops/agg.py _group_sum_i64_limbs): with a tight bound a
+        200K-group decimal SUM is 3 fast i32 scatters instead of one
+        software-emulated 64-bit scatter (~5x, the q3/q18 wall named
+        in BENCHMARKS.md). 0 = unknown (the kernel falls back to a
+        width safe for the whole batch)."""
+        from ..sql.bound import BCol
+
+        def spine(n, names):
+            while True:
+                if isinstance(n, (P.Filter, P.Compact)):
+                    n = n.child
+                    continue
+                if isinstance(n, P.Project):
+                    nxt = []
+                    items = dict(n.items)
+                    for nm in names:
+                        e = items.get(nm)
+                        if not isinstance(e, BCol):
+                            return None
+                        nxt.append(e.name)
+                    names = nxt
+                    n = n.child
+                    continue
+                if isinstance(n, P.HashJoin):
+                    if n.join_type not in ("inner", "left") \
+                            or n.expand != 1:
+                        return None
+                    n = n.left
+                    continue
+                if isinstance(n, P.Scan):
+                    stored = []
+                    for nm in names:
+                        s = n.columns.get(nm)
+                        if s is None:
+                            return None
+                        stored.append(s)
+                    return n.table, tuple(stored)
+                return None
+
+        def walk(n):
+            if isinstance(n, P.Aggregate):
+                if n.group_by and n.aggs:
+                    names = []
+                    ok = True
+                    for _, e in n.group_by:
+                        if not isinstance(e, BCol):
+                            ok = False
+                            break
+                        names.append(e.name)
+                    hit = spine(n.child, names) if ok else None
+                    if hit is not None:
+                        table, stored = hit
+                        k = self.store.key_max_multiplicity(
+                            table, stored, read_ts.to_int(),
+                            include_null_group=True)
+                        # txn-buffered rows are invisible to the
+                        # store's measurement; each can add one row
+                        # to some group
+                        k += overlay.get(table, 0)
+                        if k > 0:
+                            n.max_group_rows = k
+                self._bound_agg_value_ranges(n, overlay)
+                walk(n.child)
+                return
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    walk(c)
+
+        walk(node)
+
+    def _bound_agg_value_ranges(self, agg, overlay: dict) -> None:
+        """Attach stored-column value bounds to plain-column int64 SUM
+        aggregates (BoundAgg.arg_max_abs/arg_nonneg): a SUM over a
+        proven-non-negative narrow column (quantities, scaled prices)
+        needs i32 limb coverage for bits(max) only — ONE scatter
+        instead of three (ops/agg.py _group_sum_i64_limbs)."""
+        from ..sql.bound import BCol
+        from ..sql.types import Family
+
+        colmap = {}
+
+        def scans(n):
+            if isinstance(n, P.Scan):
+                for bname, sname in n.columns.items():
+                    colmap[bname] = (n.table, sname)
+                return
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    scans(c)
+
+        scans(agg.child)
+        for a in agg.aggs:
+            if a.func not in ("sum", "sum_int") \
+                    or not isinstance(a.arg, BCol):
+                continue
+            if a.arg.type.family not in (Family.INT, Family.DECIMAL):
+                continue
+            hit = colmap.get(a.arg.name)
+            if hit is None or overlay.get(hit[0], 0):
+                continue
+            rng = self.store.key_int_range(hit[0], hit[1])
+            if rng is None:
+                continue
+            lo, hi, _n = rng
+            if lo >= 0 and hi > 0:
+                a.arg_nonneg = True
+                a.arg_max_abs = int(hi)
+
     def _check_join_builds(self, node, read_ts: Timestamp,
                            overlay: set = frozenset()) -> None:
         """The device hash join gathers ONE build row per probe key
@@ -1656,6 +1789,27 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 if n:
                     dict_fracs.append(min(1.0, len(e.values) / n))
                 return
+            if isinstance(e, BInList) and isinstance(e.expr, BCol) \
+                    and not e.negated \
+                    and e.expr.type.family in (Family.INT,
+                                               Family.DATE):
+                # int IN-list (the inlined result of a decorrelated
+                # subquery, q18's o_orderkey IN (...)): estimate
+                # len(values)/rowcount assuming near-unique values.
+                # NOT a hard upper bound for duplicate-keyed columns
+                # — Compact's overflow sentinel replans if it
+                # undershoots, so an aggressive estimate is safe
+                stored = scan.columns.get(e.expr.name)
+                if stored is not None:
+                    try:
+                        r = self.store.key_int_range(scan.table,
+                                                     stored)
+                    except KeyError:
+                        r = None
+                    if r is not None and r[2] > 0:
+                        dict_fracs.append(
+                            min(1.0, len(e.values) / r[2]))
+                return
             if isinstance(e, BBin) and e.op in ("<", "<=", ">", ">=",
                                                 "="):
                 l, r, op = e.left, e.right, e.op
@@ -1784,11 +1938,87 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     (not dense or n.max_groups > 64)
                 n.child = spine(n.child, 0, scatters)[0]
                 return n
+            if isinstance(n, P.Project):
+                # a projection-rooted spine (CTE/derived bodies, q9's
+                # `profit`): the projection math + payload pull-up +
+                # temp materialization above the compact are the work
+                # being shrunk; compile bubbles the overflow sentinel
+                # through Project
+                n.child = spine(n.child, 0, True)[0]
+                return n
             if isinstance(n, (P.Sort, P.Limit)):
                 n.child = walk(n.child)
                 return n
             return n
-        return walk(node)
+        return self._defer_payloads_past_compact(walk(node))
+
+    def _defer_payloads_past_compact(self, root):
+        """Payload pull-up: for every direct inner join BELOW a
+        Compact, defer payload columns no node between the join and
+        the Compact consumes to a re-probe join ABOVE the Compact:
+
+            join(match [+ used/packed payloads]) -> Compact
+              -> join(deferred payloads)
+
+        Each deferred payload gather then touches ~est*n compacted
+        rows instead of the full probe width (q3: o_orderdate /
+        o_shippriority, q18: three orders payloads — ~7.5ms each at
+        2^20 rows, ~free compacted). The build side compiles twice;
+        its tables are size-length ops over the small build domain,
+        so the duplication is noise. Packed (dict-code/bool)
+        payloads stay below: they already cost one fused gather and
+        upstream Filters consume their bits."""
+        from ..sql.bound import referenced_columns
+
+        def pull_up(compact):
+            used: set[str] = set()
+            deferred: list = []
+
+            def descend(n):
+                if isinstance(n, P.Filter):
+                    used.update(referenced_columns(n.pred))
+                    n.child = descend(n.child)
+                    return n
+                if isinstance(n, P.HashJoin):
+                    used.update(n.left_keys)
+                    used.update(n.right_keys)
+                    if n.join_type == "inner" and n.expand == 1 \
+                            and n.direct is not None:
+                        packed = set(n.pack_payload or ())
+                        defer = [p for p in n.payload
+                                 if p not in packed and p not in used]
+                        if defer:
+                            n.payload = [p for p in n.payload
+                                         if p not in defer]
+                            deferred.append(P.HashJoin(
+                                left=None, right=n.right,
+                                left_keys=list(n.left_keys),
+                                right_keys=list(n.right_keys),
+                                payload=defer, join_type="inner",
+                                expand=1, direct=n.direct,
+                                pack_payload=[]))
+                    used.update(n.payload)
+                    n.left = descend(n.left)
+                    return n
+                return n
+
+            compact.child = descend(compact.child)
+            top = compact
+            for dj in deferred:
+                dj.left = top
+                top = dj
+            return top
+
+        def walk(n):
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    setattr(n, attr, walk(c))
+            if isinstance(n, P.Compact):
+                return pull_up(n)
+            return n
+
+        return walk(root)
 
     def _exec_unnest(self, sel: ast.Select, e: ast.FuncCall,
                      binder: Binder):

@@ -25,9 +25,8 @@ from .compile import ExecParams, RunContext, can_stream, compile_plan
 EPOCH_DATE = datetime.date(1970, 1, 1)
 EPOCH_DT = datetime.datetime(1970, 1, 1)
 
-from .session import (EngineError, HashCapacityExceeded, Prepared,
-                      TopKInexact,
-                      Result, Session)
+from .session import (CompactOverflow, EngineError, HashCapacityExceeded,
+                      Prepared, TopKInexact, Result, Session)
 from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _host_sort, _next_pow2, _pad, _slice_chunks)
 
 
@@ -315,35 +314,61 @@ class ScanPlaneMixin:
         return self._batch_from_chunks(td, chunks)
 
     # -- result materialization ---------------------------------------------
+
+    _SENTINELS = (
+        ("__ht_overflow", lambda: HashCapacityExceeded(
+            "GROUP BY cardinality exceeded hash_group_capacity; "
+            "SET hash_group_capacity to a larger power of two")),
+        ("__sum_overflow", lambda: EngineError(
+            "decimal SUM overflowed int64 accumulation; "
+            "CAST the argument to FLOAT to trade exactness for range")),
+        ("__topk_inexact", lambda: TopKInexact(
+            "top-k cut crossed a primary-key tie group; "
+            "replanning with the full sort")),
+        ("__compact_overflow", lambda: CompactOverflow(
+            "selection compaction overflowed a block's capacity; "
+            "replanning uncompacted")),
+    )
+
     def _materialize(self, out: ColumnBatch, meta: P.OutputMeta) -> Result:
-        if out.has("__ht_overflow"):
-            if bool(np.asarray(out.col("__ht_overflow"))[0]):
-                raise HashCapacityExceeded(
-                    "GROUP BY cardinality exceeded hash_group_capacity; "
-                    "SET hash_group_capacity to a larger power of two")
-        if out.has("__sum_overflow"):
-            if bool(np.asarray(out.col("__sum_overflow"))[0]):
-                raise EngineError(
-                    "decimal SUM overflowed int64 accumulation; "
-                    "CAST the argument to FLOAT to trade exactness for range")
-        if out.has("__topk_inexact"):
-            if bool(np.asarray(out.col("__topk_inexact"))[0]):
-                raise TopKInexact(
-                    "top-k cut crossed a primary-key tie group; "
-                    "replanning with the full sort")
-        if out.has("__compact_overflow"):
-            if bool(np.asarray(out.col("__compact_overflow"))[0]):
-                from .session import CompactOverflow
-                raise CompactOverflow(
-                    "selection compaction overflowed a block's "
-                    "capacity; replanning uncompacted")
-        host = out.to_host()
-        res = Result(names=list(meta.names), types=list(meta.types))
+        """Decode a device result batch into host rows.
+
+        Transfer discipline (the whole game on a remote-attached TPU,
+        ~60-90ms RTT per transfer): sentinel flags reduce to scalars on
+        device and ride the same packed pull as the data — one
+        transfer for small batches; for wide (join-expanded) batches,
+        one pull for (sel + flags), then one pull of the live rows
+        gathered on device."""
+        from ..ops.batch import _SMALL_PULL, pull_arrays, \
+            pull_batch_columns
+        sent = [(n, exc) for n, exc in self._SENTINELS if out.has(n)]
+        flags_dev = [jnp.any(out.col(n)) for n, _ in sent]
+        names = list(meta.names)
+        if out.n <= _SMALL_PULL:
+            pulled, flags = pull_batch_columns(out, names,
+                                               extra=flags_dev)
+            self._raise_sentinels(sent, flags)
+        else:
+            # sentinel flags ride the sel pull so an overflow raises
+            # BEFORE the (possibly garbage-width) live gather runs
+            first = pull_arrays([out.sel] + flags_dev)
+            self._raise_sentinels(sent, first[1:])
+            pulled, _ = pull_batch_columns(out, names,
+                                           sel_np=first[0])
+        host = {c: np.ma.masked_array(d, mask=~v)
+                for c, (d, v) in pulled.items()}
+        res = Result(names=names, types=list(meta.types))
         cols = []
-        for name, ty in zip(meta.names, meta.types):
+        for name, ty in zip(names, meta.types):
             arr = host[name]
             d = meta.dictionaries.get(name)
             cols.append(_decode_column(arr, ty, d))
         res.rows = list(zip(*cols)) if cols else []
         return res
+
+    @staticmethod
+    def _raise_sentinels(sent, flags) -> None:
+        for (name, exc), f in zip(sent, flags):
+            if bool(f):
+                raise exc()
 

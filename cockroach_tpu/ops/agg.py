@@ -81,7 +81,35 @@ def masked_max(data, mask):
 UNROLL_GROUPS = 32
 
 
-def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None):
+def group_rep_index(group_ids, mask, num_groups: int):
+    """(representative masked row index per group, nonempty mask) in
+    ONE i32 scatter-min. The per-group-constant `any` aggregates (FD-
+    reduced group keys) gather their values through this shared index
+    instead of scattering every column — q18's four riding keys cost
+    4 cheap gathers instead of ~12 scatter passes."""
+    n = group_ids.shape[0]
+    rowid = jnp.arange(n, dtype=jnp.int32)
+    gid = jnp.where(mask, group_ids, 0)
+    rep = jnp.full(num_groups, n, jnp.int32).at[gid].min(
+        jnp.where(mask, rowid, n), mode="drop")
+    return jnp.minimum(rep, n - 1), rep < n
+
+
+def group_any_via_rep(data, valid, rep, nonempty):
+    """Per-group `any` value via the shared representative index.
+    Only valid when the value is constant within each group (the FD-
+    reduced keys; NULL-ness is constant too, so the representative
+    row's validity IS the group's). Empty / all-NULL groups take the
+    max identity, matching group_any's scatter formulation."""
+    v = jnp.logical_and(nonempty, jnp.take(valid, rep))
+    ident = _maxident(data.dtype)
+    d = jnp.where(v, jnp.take(data, rep), ident)
+    return d, v
+
+
+def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None,
+              max_group_rows: int = 0, arg_max_abs: int = 0,
+              arg_nonneg: bool = False):
     d = data.astype(acc_dtype) if acc_dtype is not None else data
     if num_groups <= UNROLL_GROUPS:
         z = jnp.zeros_like(d)
@@ -92,7 +120,51 @@ def group_sum(data, group_ids, mask, num_groups: int, acc_dtype=None):
     d = jnp.where(mask, d, jnp.zeros_like(d))
     # Dead rows scatter to group 0 with value 0 — harmless.
     gid = jnp.where(mask, group_ids, 0)
+    if d.dtype == jnp.int64:
+        return _group_sum_i64_limbs(d, gid, num_groups, max_group_rows,
+                                    arg_max_abs if arg_nonneg else 0)
     return jax.ops.segment_sum(d, gid, num_segments=num_groups)
+
+
+def _group_sum_i64_limbs(d, gid, num_groups: int,
+                         max_group_rows: int, max_abs: int = 0):
+    """Exact int64 group sum via limb-decomposed INT32 scatters.
+
+    64-bit scatter-adds are software-emulated on TPU (measured ~250ms
+    marginal at 2M rows vs ~14ms for one i32 scatter). Split each
+    value's two's-complement bit pattern into w-bit limbs (logical
+    shifts), scatter-add each limb in int32 — exact because a group's
+    limb sum is bounded by max_group_rows * (2^w - 1) < 2^31 — and
+    recombine with wrapping shifts/adds, which reproduces int64
+    modular arithmetic bit-for-bit (including negatives). With a
+    tight engine-measured group bound this is 3 i32 scatters
+    (measured 2.4x the emulated scatter end-to-end, ~4.5x marginal);
+    with no bound the width shrinks so the limb sums still cannot
+    overflow, at worst ~7 scatters — still ~2x."""
+    maxg = max(int(max_group_rows), 1) if max_group_rows > 0 \
+        else max(int(d.shape[0]), 1)
+    w = int(np.floor(np.log2((2.0 ** 31 - 1) / maxg + 1)))
+    w = max(1, min(22, w))
+    # engine-proven NON-NEGATIVE values need only bits(max_abs) limb
+    # coverage: a 13-bit quantity column's exact sum is ONE i32
+    # scatter. (Negative values need all 64 bits — their two's-
+    # complement high limbs are non-zero.)
+    bits = 64
+    if max_abs > 0:
+        bits = min(64, max(1, int(max_abs).bit_length()))
+        # a group sum can need up to log2(maxg) carry bits beyond the
+        # value width; the reconstruction below only sees limb sums,
+        # which carry them exactly, so `bits` only bounds which limbs
+        # can be non-zero
+    k = -(-bits // w)
+    m = (1 << w) - 1
+    total = jnp.zeros(num_groups, jnp.int64)
+    for j in range(k):
+        limb = (jax.lax.shift_right_logical(d, j * w) & m) \
+            .astype(jnp.int32)
+        s = jax.ops.segment_sum(limb, gid, num_segments=num_groups)
+        total = total + (s.astype(jnp.int64) << (j * w))
+    return total
 
 
 def group_count(group_ids, mask, num_groups: int):

@@ -104,7 +104,8 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
         in_range = jnp.logical_and(pk0 >= base, pk0 - base < size - 1)
         pidx = jnp.clip(pk0 - base, 0, size - 1).astype(jnp.int32)
         if expand <= 1 and join_type in ("inner", "left", "semi",
-                                         "anti"):
+                                         "anti") \
+                and size <= 4 * probe.n:
             # Payload folding (round-3 VERDICT #5): re-shape the
             # tables so every probe-side gather is addressed by pidx
             # DIRECTLY instead of the two-hop chain (gather owner,
@@ -113,6 +114,11 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
             # domain; the probe side loses its serial dependency and
             # one random int32 read per row — the Q14/SSB star-join
             # gather ceiling BENCHMARKS.md round 2 measured.
+            # Gated on size <= 4x probe width: the fold gathers at
+            # TABLE width, so a sparse packed-composite table (q9's
+            # partsupp at 61M slots over a 1M probe) would pay
+            # table-width gathers per payload (~450ms each measured)
+            # where the two-hop probe path pays probe-width (~8ms).
             owner_slot = jnp.minimum(table, build.n - 1)
             vtab = table < build.n               # slot -> live build?
             # Three-state packing: when a payload column is an int32

@@ -77,11 +77,19 @@ def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
     """Find the cheapest connected left-deep join order.
 
     scan_rows(alias) -> estimated post-filter scan rows.
-    join_info(left_set, alias) -> (selectivity, build_multiplicity)
-    — build_multiplicity is the estimated duplicate rows per join key
-    on the build side `alias` — or None when no equality condition
-    connects `alias` to `left_set` (disconnected extensions are not
-    explored — cartesian products are rejected by the planner anyway).
+    join_info(left_set, alias) -> (selectivity, build_multiplicity
+    [, direct_eligible]) — build_multiplicity is the estimated
+    duplicate rows per join key on the build side `alias` — or None
+    when no equality condition connects `alias` to `left_set`
+    (disconnected extensions are not explored — cartesian products
+    are rejected by the planner anyway). direct_eligible (default
+    True) reports whether the build's key columns admit the
+    direct-address table (dense int span within the engine's slot
+    caps); a unique build that CANNOT direct-address still pays the
+    while-loop hash build, so it is charged HASH_BUILD_W (q9's memo
+    otherwise picks a partsupp spine with a 1M-row hash build of
+    lineitem — measured ~1s/exec in the while loop — over the
+    lineitem spine with packed-direct dimension builds).
 
     Returns None when no fully connected order exists.
     """
@@ -103,10 +111,12 @@ def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
                 info = join_info(rest, last)
                 if info is None:
                     continue
-                sel, build_mult = info
+                sel, build_mult = info[0], info[1]
+                direct_ok = info[2] if len(info) > 2 else True
                 build = max(scan_rows(last), 1.0)
                 out = max(b.rows * build * sel, 1.0)
-                bw = BUILD_W if build_mult <= 1.05 else HASH_BUILD_W
+                bw = (BUILD_W if build_mult <= 1.05 and direct_ok
+                      else HASH_BUILD_W)
                 cost = (b.cost + bw * build
                         + PROBE_W * b.rows + OUT_W * out)
                 if build_mult > MAX_BUILD_MULT:

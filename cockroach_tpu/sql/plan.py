@@ -100,6 +100,11 @@ class Aggregate(PlanNode):
     # per-dim value offsets: code = value - lo (0 for dict/bool dims;
     # nonzero for small-range INT keys proven dense by stats)
     group_lo: list[int] = field(default_factory=list)
+    # static upper bound on rows per group (engine-measured key
+    # multiplicity), 0 = unknown. Sizes the i32 limb width of exact
+    # int64 group sums (ops/agg.py group_sum): a tight bound means 3
+    # fast i32 scatters instead of the software-emulated 64-bit one.
+    max_group_rows: int = 0
 
 
 @dataclass

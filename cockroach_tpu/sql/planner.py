@@ -190,10 +190,64 @@ class Planner:
             if ta is not None and tb is not None:
                 edges.append((ta, na, tb, nb))
 
+        int_range = self.catalog.int_range_fn
+
+        def _direct_eligible(alias: str, key_cols: list) -> bool:
+            """Mirror engine._maybe_direct_join's span caps: can a
+            build on these key columns take the direct-address table?
+            A unique build that can't still pays the while-loop hash
+            path, so the memo must charge it accordingly."""
+            if int_range is None or not key_cols:
+                return False
+            t = alias_table[alias]
+            spans = []
+            n_all = 0
+            for qc in key_cols:
+                col = qc.split(".", 1)[-1]
+                try:
+                    r = int_range(t, col)
+                except (KeyError, TypeError, ValueError):
+                    return False
+                if r is None:
+                    return False
+                lo, hi, n_all = r
+                spans.append(hi - lo + 1)
+            if len(spans) == 1:
+                return (spans[0] <= max(256 * n_all, 4096)
+                        and spans[0] + 1 <= (1 << 22))
+            total = 1
+            for span in spans:
+                total *= span
+                if total > (1 << 27):
+                    return False
+            return total <= max(2048 * n_all, 4096)
+
+        kd_fn = self.catalog.key_distinct_fn
+
+        def _exact_distinct(alias: str, cols: tuple) -> float | None:
+            """EXACT combined-key distinct via the store (generation-
+            cached lexsort). Per-column independence MULTIPLIES
+            distincts for composite keys, wildly overestimating when
+            the columns are correlated (q9: lineitem (l_suppkey,
+            l_partkey) -> 61M 'independent' pairs vs ~800K real; the
+            resulting build_mult=1.0 + selectivity 1/61M made a 1M-row
+            hash build of lineitem look free)."""
+            if kd_fn is None:
+                return None
+            try:
+                d, _nn = kd_fn(alias_table[alias],
+                               tuple(c.split(".", 1)[-1]
+                                     for c in cols))
+            except (KeyError, TypeError):
+                return None
+            return float(d) if d else None
+
         def join_info(left_set, right):
             sel = None
             build_key_distinct = 1.0
             build_known = True
+            build_cols = []
+            probe_sides = []
             for ta, na, tb, nb in edges:
                 if ta in left_set and tb == right:
                     sides = ((ta, na), (tb, nb))
@@ -217,8 +271,27 @@ class Planner:
                     build_key_distinct *= bd
                 else:
                     build_known = False
+                if sides[1][1] is not None:
+                    build_cols.append(sides[1][1])
+                probe_sides.append(sides[0])
             if sel is None:
                 return None
+            if len(build_cols) > 1:
+                # composite key: replace the independence products
+                # with exact combined distincts on both sides
+                bd_exact = _exact_distinct(right, tuple(build_cols))
+                if bd_exact is not None:
+                    build_key_distinct = bd_exact
+                    build_known = True
+                    p_alias = {al for al, _ in probe_sides}
+                    pd_exact = (_exact_distinct(
+                        next(iter(p_alias)),
+                        tuple(cn for _, cn in probe_sides
+                              if cn is not None))
+                        if len(p_alias) == 1
+                        and all(cn is not None
+                                for _, cn in probe_sides) else None)
+                    sel = 1.0 / max(bd_exact, pd_exact or 1.0)
             # duplicate rows per key on the build side: the device
             # join expands these, capped by the engine — estimate
             # from the UNFILTERED base rows (pushdown filters do not
@@ -226,7 +299,7 @@ class Planner:
             base = max(self.catalog.row_count(alias_table[right]), 1.0)
             mult = (base / max(build_key_distinct, 1.0)
                     if build_known else 1.0)
-            return sel, mult
+            return sel, mult, _direct_eligible(right, build_cols)
 
         return memomod.search(aliases, scan_rows, join_info)
 
@@ -759,19 +832,29 @@ class Planner:
             return group_exprs, []
         alias_to_table = dict(tables or [])
 
-        # equi-join pairs from the planned FROM tree
-        pairs = []
+        # directed equi-join derivations from the planned FROM tree:
+        # (mine, other) means "if `other`'s value is fixed per group
+        # and `mine` is unique in its table, `mine`'s whole row is
+        # fixed". Inner joins derive both ways; LEFT joins only pin
+        # the BUILD (right) side — an unmatched probe row carries NULL
+        # build values, so the probe cannot be inferred from them.
+        derivs = []
 
         def _collect(n):
             if isinstance(n, plan.HashJoin):
-                if n.join_type in ("inner", "left"):
-                    pairs.extend(zip(n.left_keys, n.right_keys))
+                if n.join_type == "inner":
+                    for lk, rk in zip(n.left_keys, n.right_keys):
+                        derivs.append((lk, rk))
+                        derivs.append((rk, lk))
+                elif n.join_type == "left":
+                    for lk, rk in zip(n.left_keys, n.right_keys):
+                        derivs.append((rk, lk))
                 _collect(n.left)
                 _collect(n.right)
             elif hasattr(n, "child"):
                 _collect(n.child)
         _collect(node)
-        if not pairs:
+        if not derivs:
             return group_exprs, []
 
         def _is_unique(alias, qual_col):
@@ -796,44 +879,64 @@ class Planner:
             except KeyError:
                 return False
 
-        key_cols = {ge.name for _, ge in group_exprs
-                    if isinstance(ge, BCol) and "." in ge.name}
+        def _alias(q):
+            return q.split(".", 1)[0]
+
+        def _pinned(keys: set) -> set:
+            """Aliases whose row is constant within each group of
+            `keys` — the TRANSITIVE closure of the reference's
+            func_dep derivation (q18: o_orderkey pins orders, orders'
+            o_custkey pins customer through c_custkey, so c_name and
+            c_custkey both drop). A column's value is fixed when it
+            is a group key or any column of a pinned alias."""
+            pinned = set()
+            for kc in keys:
+                if _is_unique(_alias(kc), kc):
+                    pinned.add(_alias(kc))
+            changed = True
+            while changed:
+                changed = False
+                for mine, other in derivs:
+                    al = _alias(mine)
+                    if al in pinned:
+                        continue
+                    if (other in keys or _alias(other) in pinned) \
+                            and _is_unique(al, mine):
+                        pinned.add(al)
+                        changed = True
+            return pinned
+
+        names = [ge.name if isinstance(ge, BCol) and "." in ge.name
+                 else None for _, ge in group_exprs]
+        kept_flag = [True] * len(group_exprs)
+        # try dropping dictionary-coded keys first (they block the
+        # dense strategy hardest), then the rest in order; a key drops
+        # only if the keys REMAINING afterwards still pin its alias
+        order = sorted(range(len(group_exprs)),
+                       key=lambda i: (0 if names[i] is not None and
+                                      group_exprs[i][1].type
+                                      .uses_dictionary else 1, i))
+        for i in order:
+            nm = names[i]
+            if nm is None:
+                continue
+            remaining = {names[j] for j in range(len(group_exprs))
+                         if kept_flag[j] and j != i
+                         and names[j] is not None}
+            if remaining and _alias(nm) in _pinned(remaining):
+                kept_flag[i] = False
         kept = []
         repl = []
-        for gname, ge in group_exprs:
-            dependent = False
-            if isinstance(ge, BCol) and "." in ge.name \
-                    and not ge.type.uses_dictionary:
-                alias = ge.name.split(".", 1)[0]
-                # (a) a sibling group key is a unique key of this table
-                for kc in key_cols:
-                    if kc != ge.name and kc.split(".", 1)[0] == alias \
-                            and _is_unique(alias, kc):
-                        dependent = True
-                        break
-                # (b) a unique key of this table is equi-joined to a
-                # group key outside the table
-                if not dependent:
-                    for a, b in pairs:
-                        mine, other = None, None
-                        if a.split(".", 1)[0] == alias:
-                            mine, other = a, b
-                        elif b.split(".", 1)[0] == alias:
-                            mine, other = b, a
-                        if mine is None or mine == ge.name or \
-                                other.split(".", 1)[0] == alias:
-                            continue
-                        if other in key_cols and _is_unique(alias, mine):
-                            dependent = True
-                            break
-            if dependent:
+        for flag, (gname, ge) in zip(kept_flag, group_exprs):
+            if flag:
+                kept.append((gname, ge))
+            else:
                 # "any": per-group-constant by construction — the
                 # scatter-SET kernel, not the (64-bit-emulated, ~12x
                 # slower) scatter-max (ops/agg.py group_any)
                 binder.aggs.append(BoundAgg("any", ge, type=ge.type))
-                repl.append((ge, BAggRef(len(binder.aggs) - 1, ge.type)))
-            else:
-                kept.append((gname, ge))
+                repl.append((ge, BAggRef(len(binder.aggs) - 1,
+                                         ge.type)))
         if not repl or not kept:
             return group_exprs, []
         return kept, repl

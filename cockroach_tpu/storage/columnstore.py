@@ -858,15 +858,37 @@ class ColumnStore:
                                            nonnull)
             return distinct, nonnull
 
+    def _ts_hi_locked(self, td: TableData) -> int:
+        """Max MVCC event timestamp (insert or delete) in the table,
+        cached per generation. A read at or above it sees exactly the
+        currently-live rows, so snapshot-dependent measurements become
+        generation-cacheable — the steady state of every prepared
+        statement re-executed against unmodified tables."""
+        ck = ("__ts_hi__",)
+        hit = td.key_distinct_cache.get(ck)
+        if hit is not None and hit[0] == td.generation:
+            return hit[1]
+        hi = 0
+        for chunk in td.chunks:
+            if chunk.n:
+                hi = max(hi, int(chunk.mvcc_ts.max()))
+                dels = chunk.mvcc_del[chunk.mvcc_del != MAX_TS_INT]
+                if len(dels):
+                    hi = max(hi, int(dels.max()))
+        td.key_distinct_cache[ck] = (td.generation, hi)
+        return hi
+
     def keys_unique_for_read(self, name: str, cols: tuple,
                              read_ts_int: int) -> bool:
         """Snapshot-aware uniqueness: are the keys unique among the
         rows VISIBLE at read_ts (the rows a scan at that timestamp
-        joins)? Two tiers: if keys are unique across ALL versions
-        (cacheable per generation — every snapshot is a subset, so any
-        snapshot is unique too), accept without looking at the
-        timestamp; otherwise compute at the exact snapshot (tables
-        with updated rows pay this per distinct read_ts)."""
+        joins)? Tiers: (1) unique across ALL versions (cacheable per
+        generation — every snapshot is a subset, so any snapshot is
+        unique too) accepts immediately; (2) read_ts at/above the
+        table's last MVCC event sees exactly the currently-live rows,
+        so that answer caches per generation too; (3) historical
+        read_ts inside the table's write history pays the exact
+        snapshot computation."""
         td = self.table(name)
         with self._lock:
             self._seal_locked(td)
@@ -880,40 +902,77 @@ class ColumnStore:
                 _, d, n = hit
             if d == n:
                 return True
+            if read_ts_int >= self._ts_hi_locked(td):
+                nowkey = ("__livenow_unique__",) + cols
+                hit = td.key_distinct_cache.get(nowkey)
+                if hit is None or hit[0] != td.generation:
+                    d, n = self._distinct_under(
+                        td, cols, lambda c: c.live_mask(read_ts_int))
+                    td.key_distinct_cache[nowkey] = (td.generation,
+                                                     d, n)
+                else:
+                    _, d, n = hit
+                return d == n
             d, n = self._distinct_under(
                 td, cols, lambda c: c.live_mask(read_ts_int))
             return d == n
 
     def key_max_multiplicity(self, name: str, cols: tuple,
-                             read_ts_int: int) -> int:
-        """Max duplicate count of (cols) among rows visible at read_ts
-        (NULL-keyed rows excluded — they never join). Sizes the hash
-        join's expansion factor for duplicate-keyed build sides."""
+                             read_ts_int: int,
+                             include_null_group: bool = False) -> int:
+        """Max duplicate count of (cols) among rows visible at read_ts.
+        Two consumers with different NULL semantics: the hash join's
+        expansion factor excludes NULL-keyed rows (they never join,
+        the default); GROUP BY accumulator sizing sets
+        include_null_group because NULL keys DO form a group. Cached
+        per generation when read_ts sees the table's final state
+        (same reasoning as keys_unique_for_read tier 2)."""
         td = self.table(name)
         with self._lock:
             self._seal_locked(td)
-            parts: list[list[np.ndarray]] = [[] for _ in cols]
-            for chunk in td.chunks:
-                m = chunk.live_mask(read_ts_int)
-                for c in cols:
-                    m = m & chunk.valid[c]
-                for i, c in enumerate(cols):
-                    parts[i].append(chunk.data[c][m])
-            if not parts or not parts[0]:
-                return 0
-            cat = [np.concatenate(p) for p in parts]
-            n = len(cat[0])
-            if n == 0:
-                return 0
-            order = np.lexsort(tuple(reversed(cat)))
-            change = np.zeros(n, dtype=bool)
-            change[0] = True
-            for c in cat:
-                s = c[order]
-                change[1:] |= s[1:] != s[:-1]
-            starts = np.flatnonzero(change)
-            runs = np.diff(np.append(starts, n))
-            return int(runs.max())
+            cacheable = read_ts_int >= self._ts_hi_locked(td)
+            mk = ("__maxmult__", include_null_group) + cols
+            if cacheable:
+                hit = td.key_distinct_cache.get(mk)
+                if hit is not None and hit[0] == td.generation:
+                    return hit[1]
+            k = self._key_max_multiplicity_locked(
+                td, cols, read_ts_int, include_null_group)
+            if cacheable:
+                td.key_distinct_cache[mk] = (td.generation, k)
+            return k
+
+    @staticmethod
+    def _key_max_multiplicity_locked(td: TableData, cols: tuple,
+                                     read_ts_int: int,
+                                     include_null_group: bool = False
+                                     ) -> int:
+        parts: list[list[np.ndarray]] = [[] for _ in cols]
+        null_rows = 0
+        for chunk in td.chunks:
+            live = chunk.live_mask(read_ts_int)
+            m = live.copy()
+            for c in cols:
+                m = m & chunk.valid[c]
+            if include_null_group:
+                null_rows += int((live & ~m).sum())
+            for i, c in enumerate(cols):
+                parts[i].append(chunk.data[c][m])
+        if not parts or not parts[0]:
+            return null_rows
+        cat = [np.concatenate(p) for p in parts]
+        n = len(cat[0])
+        if n == 0:
+            return null_rows
+        order = np.lexsort(tuple(reversed(cat)))
+        change = np.zeros(n, dtype=bool)
+        change[0] = True
+        for c in cat:
+            s = c[order]
+            change[1:] |= s[1:] != s[:-1]
+        starts = np.flatnonzero(change)
+        runs = np.diff(np.append(starts, n))
+        return max(int(runs.max()), null_rows)
 
     def key_int_range(self, name: str, col: str):
         """(min, max, count) of an int-family key column over ALL
