@@ -87,13 +87,13 @@ def bench_query(eng, sql, rows, pipeline, repeats, lat_probes=3):
 
 
 # per-query (pipeline, repeats, latency_probes) overrides: the
-# compile-heavy suite shapes run seconds per execution — a 16-deep
-# pipeline (or even the default 3 single-shot latency probes, for
-# q9's ~140s/exec) would blow the child timeout measuring nothing new.
-# q3's dense-group + memo-ordered joins + fused top-k (round 3) cut
-# its warmup 360s -> 33s and exec 11s -> 0.7s, so it takes a deeper
-# pipeline now
-QUERY_OVERRIDES = {"q3": (8, 3, 2), "q9": (1, 2, 1), "q18": (2, 3, 1)}
+# compile-heavy suite shapes run seconds per execution — a deep
+# pipeline (or even the default 3 single-shot latency probes) would
+# blow the child timeout measuring nothing new. Round 4: q9 rides the
+# composed device-resident CTE pipeline (exec/ctecompose.py, 142K ->
+# ~5M rows/s) and q18/q3 the compaction + FD/limb agg work, so all
+# three now take real pipelines.
+QUERY_OVERRIDES = {"q3": (8, 3, 2), "q9": (4, 3, 2), "q18": (8, 3, 2)}
 
 
 def run(rows_by_query, pipeline, repeats, tag=""):

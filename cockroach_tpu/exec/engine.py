@@ -842,6 +842,10 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         # CTE whose body re-enters this path.
         depth = getattr(session, "_cte_depth", 0)
         session._cte_depth = depth + 1
+        if depth > 0 and self._cte_capture is not None:
+            # nested CTE bodies re-enter here; the composition only
+            # models one level — keep such statements on the slow path
+            self._cte_capture["disabled"] = True
         prefix = f"__cte_{id(session):x}_d{depth}"
         seq = [0]
 
@@ -877,6 +881,9 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 else:
                     obj.table = newref
             sel = _rewrite_table_names(sel, mapping)
+            if self._cte_capture is not None and depth == 0:
+                # the next _prepare_select is the main program
+                self._cte_capture["want_main"] = True
             return self._exec_select(sel, session, sql_text)
         finally:
             session._cte_depth = depth
@@ -892,6 +899,27 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
     def _temp_seq(self) -> int:
         self._temp_counter[0] += 1
         return self._temp_counter[0]
+
+    # -- composed CTE capture (exec/ctecompose.py) -----------------------
+    # While a _RerunPrepared drives a slow-path execution, the engine
+    # records the sub/main Prepared programs + temp shapes here so the
+    # NEXT run can compose them device-resident. None = not capturing.
+    _cte_capture = None
+
+    def _begin_cte_capture(self, stmt, session) -> bool:
+        if not isinstance(stmt, ast.Select) or session.txn is not None \
+                or session.effects:
+            return False
+        if self.mesh is not None and getattr(self.mesh, "size", 1) > 1:
+            return False
+        self._cte_capture = {"temps": [], "preps": [],
+                             "disabled": False, "want_main": False}
+        return True
+
+    def _end_cte_capture(self):
+        cap = self._cte_capture
+        self._cte_capture = None
+        return cap
 
     def _materialize_temp_select(self, tname: str, sub: ast.Select,
                                  session: Session, rename,
@@ -928,9 +956,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             def _flags(b):
                 """(sel, sentinel flags) in ONE packed transfer —
                 per-array pulls each pay the full tunnel RTT."""
-                sent = [s for s in ("__ht_overflow", "__topk_inexact",
-                                    "__compact_overflow",
-                                    "__sum_overflow") if b.has(s)]
+                from .session import SENTINEL_COLUMNS
+                sent = [s for s in SENTINEL_COLUMNS if b.has(s)]
                 pulled = pull_arrays(
                     [b.sel] + [jnp.any(b.col(s)) for s in sent])
                 return pulled[0], dict(zip(sent, pulled[1:]))
@@ -1001,6 +1028,13 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             if len(sel) and sel.any():
                 self.store.insert_columns(tname, cols, Timestamp(1, 0),
                                           valid=valid)
+            cap = self._cte_capture
+            if cap is not None and not cap["disabled"]:
+                nrows = (next(iter(cols.values())).shape[0]
+                         if cols else 0)
+                cap["temps"].append({"tname": tname, "prep": prep,
+                                     "meta": meta, "names": names,
+                                     "rows": nrows})
             return
         except (EngineError, PlanError) as e:
             if tname in self.store.tables:
@@ -1012,6 +1046,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             # fall through: spill recursion / top-k tie fallback /
             # row-path-only shapes; PlanError lets the row path replan
             # with its wider strategy set (fastpath, set ops)
+        if self._cte_capture is not None:
+            self._cte_capture["disabled"] = True  # row-path temp
         res = self._exec_select(sub, session, sql_text)
         self._materialize_temp(tname, res, rename)
 
@@ -1218,11 +1254,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         else:
             jfn, meta = cached
         gens = tuple(sorted(gens))
-        return Prepared(self, session, sel, sql_text, jfn, scans, meta,
-                        gens, stream=stream,
-                        stream_cols=(scan_cols.get(stream[0])
-                                     if stream else None),
-                        as_of=as_of)
+        prepared = Prepared(self, session, sel, sql_text, jfn, scans,
+                            meta, gens, stream=stream,
+                            stream_cols=(scan_cols.get(stream[0])
+                                         if stream else None),
+                            as_of=as_of)
+        # alias -> table map (composed CTE execution patches temp
+        # aliases' scan batches per run, exec/ctecompose.py)
+        prepared.scan_tables = dict(scan_aliases)
+        cap = self._cte_capture
+        if cap is not None and cap.get("want_main") \
+                and not cap["disabled"]:
+            cap["preps"].append(prepared)
+        return prepared
 
     def prepare(self, sql: str, session: Session | None = None) -> "Prepared":
         """Prepare a SELECT for repeated execution (the pgwire

@@ -25,9 +25,30 @@ from .compile import ExecParams, RunContext, can_stream, compile_plan
 EPOCH_DATE = datetime.date(1970, 1, 1)
 EPOCH_DT = datetime.datetime(1970, 1, 1)
 
-from .session import (CompactOverflow, EngineError, HashCapacityExceeded,
-                      Prepared, TopKInexact, Result, Session)
+from .session import (SENTINEL_COLUMNS, CompactOverflow, EngineError,
+                      HashCapacityExceeded, Prepared, TopKInexact,
+                      Result, Session)
 from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _host_sort, _next_pow2, _pad, _slice_chunks)
+
+
+# exception factory per sentinel; names come from the one registry
+# (session.SENTINEL_COLUMNS) so a new sentinel missing its mapping
+# here fails loudly at import time
+_SENTINEL_EXCS = {
+    "__ht_overflow": lambda: HashCapacityExceeded(
+        "GROUP BY cardinality exceeded hash_group_capacity; "
+        "SET hash_group_capacity to a larger power of two"),
+    "__sum_overflow": lambda: EngineError(
+        "decimal SUM overflowed int64 accumulation; "
+        "CAST the argument to FLOAT to trade exactness for range"),
+    "__topk_inexact": lambda: TopKInexact(
+        "top-k cut crossed a primary-key tie group; "
+        "replanning with the full sort"),
+    "__compact_overflow": lambda: CompactOverflow(
+        "selection compaction overflowed a block's capacity; "
+        "replanning uncompacted"),
+}
+_SENTINEL_PAIRS = tuple((n, _SENTINEL_EXCS[n]) for n in SENTINEL_COLUMNS)
 
 
 class ScanPlaneMixin:
@@ -315,20 +336,7 @@ class ScanPlaneMixin:
 
     # -- result materialization ---------------------------------------------
 
-    _SENTINELS = (
-        ("__ht_overflow", lambda: HashCapacityExceeded(
-            "GROUP BY cardinality exceeded hash_group_capacity; "
-            "SET hash_group_capacity to a larger power of two")),
-        ("__sum_overflow", lambda: EngineError(
-            "decimal SUM overflowed int64 accumulation; "
-            "CAST the argument to FLOAT to trade exactness for range")),
-        ("__topk_inexact", lambda: TopKInexact(
-            "top-k cut crossed a primary-key tie group; "
-            "replanning with the full sort")),
-        ("__compact_overflow", lambda: CompactOverflow(
-            "selection compaction overflowed a block's capacity; "
-            "replanning uncompacted")),
-    )
+    _SENTINELS = _SENTINEL_PAIRS
 
     def _materialize(self, out: ColumnBatch, meta: P.OutputMeta) -> Result:
         """Decode a device result batch into host rows.

@@ -41,6 +41,14 @@ class CompactOverflow(EngineError):
     rows. Prepared.run catches this and replans uncompacted."""
 
 
+# The one registry of device error-sentinel column names. Every
+# consumer (result materialization, CTE temp ingest, composed-CTE
+# glue) derives from this so a new sentinel cannot be silently missed
+# by one of them.
+SENTINEL_COLUMNS = ("__ht_overflow", "__sum_overflow",
+                    "__topk_inexact", "__compact_overflow")
+
+
 @dataclass
 class Result:
     """Decoded query result."""
@@ -164,7 +172,13 @@ class Prepared:
                 return self.engine._materialize(out, self.meta)
         except HashCapacityExceeded:
             # partition-and-recurse (the reference's disk spiller,
-            # colexecdisk/disk_spiller.go:75, over HBM re-reads)
+            # colexecdisk/disk_spiller.go:75, over HBM re-reads).
+            # This recovery does NOT re-prepare, so a CTE capture in
+            # progress would compose the overflowing program and pay
+            # a doomed device pipeline on every steady-state re-run —
+            # keep such statements on the slow path
+            if self.engine._cte_capture is not None:
+                self.engine._cte_capture["disabled"] = True
             try:
                 return self.engine._run_partitioned(self, read_ts)
             except CompactOverflow:

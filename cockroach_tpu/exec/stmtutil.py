@@ -132,17 +132,45 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
 class _RerunPrepared:
     """Prepared handle for statements that cannot pin one compiled
     program (CTEs materialize fresh temps per run; set ops merge on
-    the host): each run() re-executes through the engine."""
+    the host). Each run() re-executes through the engine — but a
+    successful CTE/derived execution CAPTURES its sub + main compiled
+    programs, and steady-state re-runs against unchanged base tables
+    compose them device-resident (exec/ctecompose.py): no host
+    round-trips between stages, one result pull, no re-plan. Any
+    drift (generation change, glue overflow, sub sentinel) falls back
+    to the slow path and re-captures."""
     engine: "Engine"
     session: "Session"
     stmt: object
     sql_text: str
+    _composed: object = None
 
     def run(self, read_ts=None) -> "Result":
-        return self.engine._exec_select(self.stmt, self.session,
-                                        self.sql_text)
+        eng = self.engine
+        comp = self._composed
+        if comp is not None:
+            if comp.valid():
+                try:
+                    return comp.run(read_ts)
+                except EngineError:
+                    self._composed = None
+            else:
+                self._composed = None
+        capturing = eng._begin_cte_capture(self.stmt, self.session)
+        try:
+            res = eng._exec_select(self.stmt, self.session,
+                                   self.sql_text)
+        finally:
+            cap = eng._end_cte_capture() if capturing else None
+        if cap is not None:
+            from .ctecompose import build_composition
+            self._composed = build_composition(eng, self.session, cap)
+        return res
 
-    def dispatch(self, *a, **kw):
+    def dispatch(self, read_ts=None):
+        comp = self._composed
+        if comp is not None and comp.valid():
+            return comp.dispatch(read_ts)
         raise EngineError(
             "this statement shape cannot dispatch asynchronously")
 
