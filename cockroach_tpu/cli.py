@@ -316,19 +316,58 @@ def cmd_start(args) -> int:
     from .server import Node, NodeConfig
 
     host, port = _parse_addr(args.listen_addr)
-    node = Node(NodeConfig(listen_host=host, listen_port=port))
+    cluster = None
+    kv_addr = None
+    if getattr(args, "kv_addr", None) or getattr(args, "join", None) \
+            or getattr(args, "bootstrap", False):
+        # socket-replicated data plane (kvserver/netcluster.py): this
+        # process owns one Store; raft/proposals/reads ride TCP.
+        # --bootstrap creates the initial range; --join nid@host:port
+        # dials a seed and gets replicated onto.
+        from .kvserver.netcluster import NetCluster
+        if not args.bootstrap and not args.join:
+            print("error: cluster mode (--kv-addr) requires either "
+                  "--bootstrap (first node) or --join NID@HOST:PORT",
+                  file=sys.stderr)
+            return 1
+        kv_host, kv_port = ("127.0.0.1", 0)
+        if getattr(args, "kv_addr", None):
+            kv_host, kv_port = _parse_addr(args.kv_addr)
+        seeds = {}
+        for j in (args.join or []):
+            nid, addr = j.split("@", 1)
+            seeds[int(nid)] = _parse_addr(addr)
+        cluster = NetCluster(node_id=args.node_id, host=kv_host,
+                             port=kv_port, join=seeds)
+        if args.bootstrap:
+            cluster.bootstrap()
+        else:
+            cluster.join()
+            try:
+                # ask the seed to replicate existing ranges onto us
+                cluster.call(next(iter(seeds)), "replicate_me", {})
+            except RuntimeError:
+                pass
+        kv_addr = cluster.addr
+    node = Node(NodeConfig(listen_host=host, listen_port=port,
+                           node_id=getattr(args, "node_id", 1),
+                           cluster=cluster))
     node.start()
     h, p = node.sql_addr
     print(f"cockroach-tpu node starting\n"
           f"version:     {__version__}\n"
           f"sql:         postgresql://root@{h}:{p}/defaultdb\n"
-          f"status:      serving", flush=True)
+          + (f"kv:          {kv_addr[0]}:{kv_addr[1]}\n"
+             if kv_addr else "")
+          + f"status:      serving", flush=True)
     try:
         import threading
         threading.Event().wait()  # serve until interrupted
     except KeyboardInterrupt:
         print("\ninterrupt: shutting down", flush=True)
     node.stop()
+    if cluster is not None:
+        cluster.stop()
     return 0
 
 
@@ -520,6 +559,16 @@ def main(argv=None) -> int:
 
     p_start = sub.add_parser("start", help="start a node")
     p_start.add_argument("--listen-addr", default=f"127.0.0.1:{DEFAULT_PORT}")
+    p_start.add_argument("--node-id", type=int, default=1)
+    p_start.add_argument("--kv-addr", default=None,
+                         help="host:port for the replicated KV plane "
+                         "(raft over TCP); enables cluster mode")
+    p_start.add_argument("--bootstrap", action="store_true",
+                         help="initialize a new cluster (first node)")
+    p_start.add_argument("--join", action="append", default=None,
+                         metavar="NID@HOST:PORT",
+                         help="join an existing cluster via this "
+                         "seed's kv address (repeatable)")
     p_start.set_defaults(fn=cmd_start)
 
     p_sql = sub.add_parser("sql", help="open a SQL shell")

@@ -188,23 +188,12 @@ class DMLMixin:
                         rep.mvcc)
                        for d, rep in range_iter(start, end)]
         for lo, hi, mvcc in sources:
-            cur = None
-            meta = None
-            for ek, raw in mvcc.engine.scan(EngineKey.meta(lo),
-                                            EngineKey.meta(hi),
-                                            include_tombstones=True):
-                if raw is None:
-                    continue   # engine-level tombstone (GC'd version)
-                if ek.key != cur:
-                    cur = ek.key
-                    meta = None
-                if ek.is_meta:
-                    meta = TxnMeta.from_json(raw)
-                    continue
-                if meta is not None and ek.ts == meta.write_ts:
-                    continue   # provisional (unresolved intent)
-                per_key.setdefault(ek.key, []).append(
-                    (ek.ts.to_int(), _dec_value(raw)))
+            # one shared implementation of the committed-version
+            # extraction (storage/mvcc.py committed_versions) serves
+            # the local plane, cluster-local replicas, and — via the
+            # replica-side RPC — remote leaseholders alike
+            for key, tsi, val in mvcc.committed_versions(lo, hi):
+                per_key.setdefault(key, []).append((tsi, val))
         versions: list[tuple[dict, int, int]] = []
         for key, vers in per_key.items():
             vers.sort()

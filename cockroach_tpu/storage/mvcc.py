@@ -355,6 +355,34 @@ class MVCC:
                         n += 1
         return n
 
+    def committed_versions(self, start: bytes, end: bytes
+                           ) -> list[tuple[bytes, int, Optional[bytes]]]:
+        """All COMMITTED raw versions in [start, end) as
+        (key, ts_int, value|None-for-tombstone), oldest-first per key.
+        Provisional versions (under an unresolved meta record) are
+        skipped. The scan-plane materialization feed (exec/dml.py) and
+        the socket cluster's replica-side version service share this
+        one implementation so the intent-skipping rule cannot
+        diverge."""
+        out: list[tuple[bytes, int, Optional[bytes]]] = []
+        cur: Optional[bytes] = None
+        meta: Optional[TxnMeta] = None
+        for ek, raw in self.engine.scan(EngineKey.meta(start),
+                                        EngineKey.meta(end),
+                                        include_tombstones=True):
+            if raw is None:
+                continue   # engine-level tombstone (GC'd version)
+            if ek.key != cur:
+                cur = ek.key
+                meta = None
+            if ek.is_meta:
+                meta = TxnMeta.from_json(raw)
+                continue
+            if meta is not None and ek.ts == meta.write_ts:
+                continue   # provisional (unresolved intent)
+            out.append((ek.key, ek.ts.to_int(), _dec_value(raw)))
+        return out
+
     def has_writes_between(self, start: bytes, end: bytes,
                            t0: Timestamp, t1: Timestamp,
                            exclude_txn: Optional[str] = None) -> bool:

@@ -65,10 +65,45 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _socket_cluster():
+    """Three NetClusters in this process, joined over real TCP
+    listeners — every KV op of every statement crosses a socket
+    (the socket-backed 3node config, round-4 VERDICT #1)."""
+    import time as _time
+
+    from cockroach_tpu.kvserver.netcluster import NetCluster
+    n1 = NetCluster(1)
+    peers = []
+    try:
+        n1.bootstrap()
+        for nid in (2, 3):
+            p = NetCluster(nid, join={1: n1.addr})
+            p.join()
+            peers.append(p)
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            n1.replicate_queue_scan()
+            if sorted(n1.descriptors[1].replicas) == [1, 2, 3]:
+                break
+            _time.sleep(0.05)
+        assert sorted(n1.descriptors[1].replicas) == [1, 2, 3], \
+            "socket cluster bring-up did not converge"
+    except BaseException:
+        for c in [n1] + peers:
+            c.stop()
+        raise
+    return n1, peers
+
+
 def _run_file(path: str, config: dict) -> None:
+    to_stop = []
     if config["mesh"]:
         from cockroach_tpu.parallel.mesh import make_mesh
         eng = Engine(mesh=make_mesh())
+    elif config.get("socket_cluster"):
+        c, peers = _socket_cluster()
+        to_stop = [c] + peers
+        eng = Engine(cluster=c)
     elif config.get("cluster"):
         from cockroach_tpu.kvserver.cluster import Cluster
         c = Cluster(n_nodes=config["cluster"])
@@ -97,7 +132,11 @@ def _run_file(path: str, config: dict) -> None:
             return "\n".join(lines) if lines else "(empty)"
         raise ValueError(f"{td.pos}: unknown directive {td.cmd!r}")
 
-    run_datadriven(path, handler)
+    try:
+        run_datadriven(path, handler)
+    finally:
+        for c in to_stop:
+            c.stop()
 
 
 FILES = sorted(glob.glob(os.path.join(DIR, "*.td")))
@@ -108,3 +147,25 @@ FILES = sorted(glob.glob(os.path.join(DIR, "*.td")))
     "path", FILES, ids=[os.path.basename(p) for p in FILES])
 def test_logic(path, config):
     _run_file(path, CONFIGS[config])
+
+
+# the socket-backed 3node config: identical semantics to `3node`, but
+# raft/proposals/reads ride real TCP between three NetClusters. The
+# per-file cluster bring-up (~2s) makes the full corpus expensive, so
+# by default a representative smoke subset runs; LOGIC_SOCKET_ALL=1
+# runs every file.
+_SOCKET_SMOKE = ["basic.td", "txn.td", "txn_visibility.td",
+                 "update_upsert.td", "joins_aggs.td",
+                 "sequences_deeper.td", "indexes.td",
+                 "scalar_subq.td"]
+_SOCKET_FILES = (FILES if os.environ.get("LOGIC_SOCKET_ALL")
+                 else [p for p in FILES
+                       if os.path.basename(p) in _SOCKET_SMOKE])
+
+
+@pytest.mark.parametrize(
+    "path", _SOCKET_FILES,
+    ids=[os.path.basename(p) for p in _SOCKET_FILES])
+def test_logic_3node_socket(path):
+    _run_file(path, {"mesh": False, "socket_cluster": True,
+                     "vars": {"distsql": "off"}})
