@@ -96,6 +96,30 @@ def bench_query(eng, sql, rows, pipeline, repeats, lat_probes=3):
 QUERY_OVERRIDES = {"q3": (8, 3, 2), "q9": (4, 3, 2), "q18": (8, 3, 2)}
 
 
+_Q_COLS = {
+    "q6": ("l_shipdate", "l_quantity", "l_discount",
+           "l_extendedprice"),
+    "q1": ("l_shipdate", "l_quantity", "l_extendedprice",
+           "l_discount", "l_tax", "l_returnflag", "l_linestatus"),
+}
+
+
+def _scan_bytes_per_row(eng, table: str, which: str) -> int:
+    narrow = eng.narrow32_cols(table)
+    schema = eng.store.table(table).schema
+    total = 0
+    for cn in _Q_COLS[which]:
+        col = schema.column(cn)
+        if col.type.uses_dictionary:
+            total += 4          # dict codes are int32
+        elif cn in narrow:
+            total += 4
+        else:
+            import numpy as _np
+            total += _np.dtype(col.type.np_dtype).itemsize
+    return total
+
+
 def run(rows_by_query, pipeline, repeats, tag=""):
     from cockroach_tpu.exec.engine import Engine
     from cockroach_tpu.models import tpch
@@ -134,10 +158,20 @@ def run(rows_by_query, pipeline, repeats, tag=""):
                 lat_probes=o_lat)
             results[which] = rps
             rows_used[which] = rows
+            gbps = ""
+            if which in ("q6", "q1"):
+                # effective scan bandwidth: HBM bytes/row the fused
+                # pipeline actually reads at the UPLOADED widths
+                # (stats-narrowed int64 columns ride as int32)
+                bpr = _scan_bytes_per_row(eng, "lineitem", which)
+                results[which + "_gbps"] = rps * bpr / 1e9
+                gbps = (f" effective_GBps={rps * bpr / 1e9:.1f} "
+                        f"(bytes/row={bpr})")
             print(f"# {tag}{which}: rows={rows} pipeline={q_pipe} "
                   f"rows_per_sec={rps:.3e} median_latency_s={lat:.4f} "
                   f"warmup_s={warm_s:.1f} "
-                  f"rates_Mrps={['%.0f' % (r / 1e6) for r in rates]}",
+                  f"rates_Mrps={['%.0f' % (r / 1e6) for r in rates]}"
+                  f"{gbps}",
                   file=sys.stderr)
         print(f"# {tag}datagen_s={gen_s:.1f} rows={rows}", file=sys.stderr)
         del eng
@@ -186,9 +220,15 @@ def run_ycsb_e(records, steps):
           f"records={records}", file=sys.stderr)
     w.run(steps=min(100, steps))  # warm plan/locator caches
     out = w.run(steps=steps)
+    # 16 concurrent drivers: read-only scans share the statement gate
+    # (utils/rwlock.py), inserts take it exclusively — the
+    # concurrency shape of `workload run ycsb --concurrency 16`
+    outc = w.run_concurrent(steps=steps * 4, workers=16)
     print(f"# ycsb-e: ops_per_sec={out['ops_per_sec']:.0f} "
-          f"ops={out['ops']}", file=sys.stderr)
-    return out["ops_per_sec"]
+          f"ops={out['ops']} "
+          f"concurrent16_ops_per_sec={outc['ops_per_sec']:.0f}",
+          file=sys.stderr)
+    return out["ops_per_sec"], outc["ops_per_sec"]
 
 
 def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
@@ -273,12 +313,33 @@ def main():
         }))
         return
     if mode == "ycsb_child":
-        ops = run_ycsb_e(
+        ops, ops16 = run_ycsb_e(
             int(os.environ.get("BENCH_YCSB_RECORDS", 20000)),
             int(os.environ.get("BENCH_YCSB_STEPS", 2000)))
         print(json.dumps({
             "metric": "ycsb_e_ops_per_sec", "value": round(ops),
-            "unit": "ops/s"}))
+            "unit": "ops/s",
+            "ycsb_e_c16_ops_per_sec": round(ops16)}))
+        return
+    if mode == "tpcc_child":
+        from cockroach_tpu.exec.engine import Engine
+        from cockroach_tpu.workload.tpcc import TPCC
+        wh = int(os.environ.get("BENCH_TPCC_WAREHOUSES", 2))
+        steps = int(os.environ.get("BENCH_TPCC_STEPS", 600))
+        eng = Engine()
+        w = TPCC(eng, warehouses=wh)
+        t0 = time.time()
+        w.setup()
+        print(f"# tpcc setup_s={time.time() - t0:.1f} "
+              f"warehouses={wh}", file=sys.stderr)
+        w.run(steps=min(100, steps))  # warm plan caches
+        out = w.run(steps=steps)
+        print(f"# tpcc: tpm_c={out['tpm_c']:.0f} "
+              f"new_orders={out['new_orders']} "
+              f"retries={out.get('retries', 0)}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "tpcc_tpmc", "value": round(out["tpm_c"]),
+            "unit": "tpmC", "warehouses": wh}))
         return
     if mode in ("cpu", "tpu_child"):
         # leaf mode: measure in-process and emit one JSON line
@@ -290,7 +351,11 @@ def main():
             "value": round(results[primary]),
             "unit": "rows/s",
             "rows": rows_used[primary],
-            **{f"{w}_rows_per_sec": round(r) for w, r in results.items()},
+            **{f"{w}_rows_per_sec": round(r)
+               for w, r in results.items()
+               if not w.endswith("_gbps")},
+            **{f"{w[:-5]}_effective_gbps": round(r, 1)
+               for w, r in results.items() if w.endswith("_gbps")},
         }))
         return
 
@@ -354,6 +419,14 @@ def main():
         r = run_child(0, "ycsb_e", 900, mode="ycsb_child")
         if r is not None:
             out["ycsb_e_ops_per_sec"] = r["value"]
+            if "ycsb_e_c16_ops_per_sec" in r:
+                out["ycsb_e_c16_ops_per_sec"] = \
+                    r["ycsb_e_c16_ops_per_sec"]
+    if os.environ.get("BENCH_TPCC", "1") != "0":
+        r = run_child(0, "tpcc", 900, mode="tpcc_child")
+        if r is not None:
+            out["tpcc_tpmc"] = r["value"]
+            out["tpcc_warehouses"] = r.get("warehouses")
     print(json.dumps(out))
 
 

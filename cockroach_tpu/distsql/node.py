@@ -203,7 +203,12 @@ class DistSQLNode:
         eng._check_join_builds(node, rts)
         stage = split(node)
         runf = compile_plan(stage.local, ExecParams())
-        scans = {alias: eng._device_table(tbl)
+        # narrow=False: per-node narrowing decisions would reflect
+        # only the LOCAL shard's value range (non-deterministic across
+        # the fabric) and the worker's plan compiles without the
+        # int64 upcast — wide uploads keep partial dtypes identical
+        # on every node (same reasoning as int_ranges=False above)
+        scans = {alias: eng._device_table(tbl, narrow=False)
                  for alias, tbl in _collect_scans(stage.local).items()}
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())

@@ -176,6 +176,7 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
 def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
     alias = node.alias
     colmap = dict(node.columns)  # batch name -> stored name
+    narrowed = node.narrowed
     predf = compile_expr(node.filter) if node.filter is not None else None
     computedf = [(n, compile_expr(e)) for n, e in node.computed]
 
@@ -189,7 +190,13 @@ def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
         live = jnp.logical_and(ts <= rc.read_ts, rc.read_ts < dl)
         cols, valid = {}, {}
         for bname, sname in colmap.items():
-            cols[bname] = raw.col(sname)
+            d = raw.col(sname)
+            if sname in narrowed:
+                # int32 HBM layout (engine-proven range), int64
+                # program semantics; XLA fuses the convert into the
+                # first consumer
+                d = d.astype(jnp.int64)
+            cols[bname] = d
             valid[bname] = raw.col_valid(sname)
         b = ColumnBatch.from_dict(cols, valid,
                                   sel=jnp.logical_and(raw.sel, live))

@@ -115,12 +115,16 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         # bounded priority queue so overload rejects cleanly instead of
         # stacking unbounded latency behind the statement lock
         from ..utils.admission import AdmissionController
-        self.admission = AdmissionController(slots=4, max_queue=64)
+        # sized to real parallelism now that read-only SELECTs share
+        # the statement gate (round-4: the RW lock replaced the global
+        # RLock; 4 slots gated a one-at-a-time engine)
+        self.admission = AdmissionController(slots=16, max_queue=128)
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
         self._device_tables: dict[tuple, ColumnBatch] = {}
         self._exec_cache: dict[tuple, tuple] = {}
+        self._parse_cache: dict[str, object] = {}
         # per-table secondary-index descriptors, cached off the catalog
         # (invalidated by index DDL; a fresh engine lazily reloads)
         self._index_defs: dict[str, list] = {}
@@ -140,7 +144,15 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         # plus columnstore publish are not safe under concurrent
         # mutation (the reference runs a connExecutor per conn against
         # thread-safe subsystems; finer-grained locking is later work)
-        self._stmt_lock = threading.RLock()
+        from ..utils.rwlock import RWLock
+        # the statement gate: read-only SELECTs share it, everything
+        # that mutates engine-shared state (DML/DDL/txn/CTE temps/
+        # sequences/scan-plane sync) is exclusive. `with _stmt_lock:`
+        # is the write side (utils/rwlock.py).
+        self._stmt_lock = RWLock()
+        # serializes device-cache upload/eviction (concurrent shared-
+        # lock SELECTs race the resident-table map otherwise)
+        self._device_lock = threading.RLock()
         self.metrics = MetricRegistry()
         # device-memory accounting: resident table uploads reserve
         # against the HBM budget BEFORE device_put, so an over-budget
@@ -159,10 +171,41 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         self._open_sessions.add(s)
         return s
 
+    # parse cache: OLTP workloads re-issue hot statement texts
+    # (zipfian keys repeat literals); parsing was ~30% of a YCSB-E op.
+    # Execution paths mutate ASTs (view expansion, decorrelation,
+    # planner rewrites), so hits hand out a DEEP COPY — still ~3x
+    # cheaper than a re-parse. The reference's sql.Statement cache
+    # keys on the text the same way (plan_cache.go).
+    _PARSE_CACHE_MAX = 4096
+
+    def _parse_cached(self, sql: str):
+        import copy
+        hit = self._parse_cache.get(sql)
+        if hit is not None:
+            # plain SELECTs (no CTEs/derived tables) execute without
+            # mutating the AST — view expansion copies before editing,
+            # subquery-free decorrelation is identity, the planner
+            # builds a separate plan tree — so hits share the cached
+            # object (deepcopy cost exceeded the parse it saved).
+            # Shapes whose executors DO rewrite in place (CTE bodies,
+            # DML coercions) hand out a deep copy.
+            if isinstance(hit, ast.Select) and not hit.ctes \
+                    and not self._has_derived(hit):
+                return hit
+            return copy.deepcopy(hit)
+        stmt = parser.parse(sql)
+        if len(self._parse_cache) >= self._PARSE_CACHE_MAX:
+            self._parse_cache.clear()
+        self._parse_cache[sql] = stmt
+        return copy.deepcopy(stmt) if not (
+            isinstance(stmt, ast.Select) and not stmt.ctes
+            and not self._has_derived(stmt)) else stmt
+
     def execute(self, sql: str, session: Session | None = None) -> Result:
         session = session or self.session()
         try:
-            stmt = parser.parse(sql)
+            stmt = self._parse_cached(sql)
         except Exception:
             # a syntax error inside an explicit txn block aborts it,
             # same as any other statement failure (pg semantics)
@@ -178,6 +221,11 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             raise EngineError(
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block")
+        if type(stmt).__name__.startswith(
+                ("Create", "Drop", "Alter", "Truncate", "Rename")):
+            # schema changes invalidate cached parses (a text's view/
+            # table resolution or _plain memo may no longer hold)
+            self._parse_cache.clear()
         if self.cluster is not None:
             # the scan plane is a cache of committed range data: check
             # every referenced table's replicated generation token and
@@ -192,20 +240,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         self.admission.acquire(priority=prio)
         tracing = session.vars.get("tracing", "off") == "on" \
             and not isinstance(stmt, ast.ShowTrace)
+        shared = self._stmt_read_only(stmt, session, sql_text)
         try:
             if tracing:
                 with self.tracer.capture(sql_text or
                                          type(stmt).__name__) as rec:
-                    with self._stmt_lock:
-                        res = self._dispatch_stmt(stmt, session,
-                                                  sql_text)
+                    res = self._dispatch_locked(stmt, session,
+                                                sql_text, shared)
                 session.trace.append(rec)
             else:
                 with self.tracer.span(
                         f"stmt:{type(stmt).__name__.lower()}"):
-                    with self._stmt_lock:
-                        res = self._dispatch_stmt(stmt, session,
-                                                  sql_text)
+                    res = self._dispatch_locked(stmt, session,
+                                                sql_text, shared)
             self.metrics.counter(
                 f"sql.{type(stmt).__name__.lower()}.count",
                 "statements executed, by type").inc()
@@ -233,6 +280,48 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             raise
         finally:
             self.admission.release()
+
+    def _dispatch_locked(self, stmt, session, sql_text: str,
+                         shared: bool) -> Result:
+        if shared:
+            self._stmt_lock.acquire_read()
+            try:
+                return self._dispatch_stmt(stmt, session, sql_text)
+            finally:
+                self._stmt_lock.release_read()
+        with self._stmt_lock:
+            return self._dispatch_stmt(stmt, session, sql_text)
+
+    def _stmt_read_only(self, stmt, session: Session,
+                        sql_text: str) -> bool:
+        """May this statement run under the SHARED side of the
+        statement gate? Read-only plain SELECTs qualify; anything
+        that can mutate engine-shared state — DML/DDL, txn sessions
+        (latch/tscache traffic), CTE/derived temps (columnstore
+        tables), view expansion (may introduce derived temps),
+        sequences, nested subqueries (decorrelation can materialize
+        temps) — stays exclusive. Mutations that remain on the read
+        path (plan/exec caches, device uploads, store stat caches)
+        are individually locked."""
+        if not isinstance(stmt, ast.Select):
+            return False
+        if session.txn is not None or session.effects:
+            return False
+        if stmt.ctes or self._has_derived(stmt):
+            return False
+        low = (sql_text or "").lower()
+        if "nextval" in low or "setval" in low or "currval" in low:
+            return False
+        if low.count("select") != 1:
+            return False      # subqueries can decorrelate into temps
+        views = self._view_map()
+        if views:
+            refs = ([stmt.table] if stmt.table is not None else []) \
+                + [j.table for j in stmt.joins]
+            if any(r.subquery is None and r.name in views
+                   for r in refs):
+                return False
+        return True
 
     def _dispatch_stmt(self, stmt: ast.Statement, session: Session,
                        sql_text: str = "") -> Result:
@@ -1151,6 +1240,8 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         try:
             self._check_join_builds(node, read_ts, overlay_puts)
             self._bound_agg_group_rows(node, read_ts, overlay_puts)
+            self._set_scan_narrowing(
+                node, overlay, stream[0] if stream else None)
         except EngineError:
             if meta.memo is not None and not no_memo:
                 # the memo's stats-estimated build order violated the
@@ -1295,8 +1386,15 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                      sql_text: str) -> Result:
         if isinstance(sel, ast.SetOp):
             return self._exec_setop(sel, session, sql_text)
-        sel = self._expand_views(sel)
-        sel = self._decorrelate(sel)
+        if not getattr(sel, "_plain", False):
+            sel2 = self._decorrelate(self._expand_views(sel))
+            if sel2 is sel:
+                # identity result = no views, no subqueries: memoize
+                # on the (parse-cached, shared) AST so hot OLTP texts
+                # skip both walks on re-execution. DDL invalidates by
+                # clearing the parse cache (execute_stmt).
+                sel._plain = True
+            sel = sel2
         if sel.ctes or self._has_derived(sel):
             return self._exec_with_temps(sel, session, sql_text)
         if sel.table is None:
@@ -1540,6 +1638,27 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                             n.max_group_rows = k
                 self._bound_agg_value_ranges(n, overlay)
                 walk(n.child)
+                return
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    walk(c)
+
+        walk(node)
+
+    def _set_scan_narrowing(self, node, overlay, stream_alias) -> None:
+        """Mark each Scan's int64 columns whose proven value range
+        fits int32 (scanplane.narrow32_cols): the upload moves half
+        the HBM bytes and the compiled scan upcasts, so downstream
+        programs are unchanged. Skipped for txn-overlay scans (their
+        fresh uploads don't consult the generation-cached ranges) and
+        the streamed fact table (pages upload wide)."""
+
+        def walk(n):
+            if isinstance(n, P.Scan):
+                if n.table not in overlay and n.alias != stream_alias:
+                    n.narrowed = self.narrow32_cols(
+                        n.table, frozenset(n.columns.values()))
                 return
             for attr in ("child", "left", "right"):
                 c = getattr(n, attr, None)

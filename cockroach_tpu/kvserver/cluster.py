@@ -692,16 +692,50 @@ class Cluster:
                                "(quorum lost?)")
         return self.stores[lh].replicas[desc.range_id]
 
-    def gc_txn_records(self, ttl_ns: int = int(3600e9)) -> int:
-        """Delete ABORTED txn records older than ttl_ns (the txn-record
-        GC half of the reference's gc queue, gc/gc.go). A pusher racing
-        a fully-resolved commit can leave a bogus ABORTED record for a
-        finished txn (disttxn push_intent); this sweep bounds that
-        leak. SAFETY: ttl_ns must exceed any live txn's possible
-        lifetime (TxnLivenessThreshold) — deleting a LIVE pushee's
-        poison record would let its commit succeed over removed
-        intents."""
+    def _gc_replica_txn_records(self, rep, now: int, ttl_ns: int,
+                                seen: set, propose) -> int:
+        """Shared per-replica sweep of aged ABORTED txn records (the
+        txn-record GC half of the reference's gc queue, gc/gc.go).
+        SAFETY: ttl_ns must exceed any live txn's possible lifetime
+        (TxnLivenessThreshold) — deleting a LIVE pushee's poison
+        record would let its commit succeed over removed intents.
+        Used by both the in-process cluster and NetCluster's
+        local-leaseholder slice (kvserver/netcluster.py)."""
         import json as _json
+
+        from ..storage.hlc import MAX_TIMESTAMP
+        n = 0
+        keys = set()
+        for ek, raw in list(rep.mvcc.engine.scan(
+                EngineKey(b"\x00txn/", -1), include_tombstones=True)):
+            if not ek.key.startswith(b"\x00txn/"):
+                break  # ordered scan left the txn keyspace
+            keys.add(ek.key)
+        for key in keys - seen:
+            seen.add(key)
+            mv = rep.mvcc.get(key, MAX_TIMESTAMP, inconsistent=True)
+            if mv is None:
+                continue
+            try:
+                rec = _json.loads(mv.value.decode())
+            except ValueError:
+                continue
+            if rec.get("status") != "aborted":
+                continue  # committed records are deleted by
+                # resolve_all once every intent resolves
+            if now - mv.ts.wall < ttl_ns:
+                continue
+            propose(rep, {"kind": "batch", "ops": [{
+                "op": "delete", "key": key.decode("latin1"),
+                "ts": _enc_ts(self.clock.now())}]})
+            n += 1
+        return n
+
+    def gc_txn_records(self, ttl_ns: int = int(3600e9)) -> int:
+        """Sweep aged ABORTED txn records on every range's
+        leaseholder (a pusher racing a fully-resolved commit can
+        leave a bogus ABORTED record, disttxn push_intent; this
+        bounds the leak)."""
         n = 0
         now = self.clock.now().wall
         seen: set[bytes] = set()
@@ -712,31 +746,8 @@ class Cluster:
             rep = self.stores[lh].replicas.get(desc.range_id)
             if rep is None:
                 continue
-            keys = set()
-            for ek, raw in list(rep.mvcc.engine.scan(
-                    EngineKey(b"\x00txn/", -1), include_tombstones=True)):
-                if not ek.key.startswith(b"\x00txn/"):
-                    break
-                keys.add(ek.key)
-            for key in keys - seen:
-                seen.add(key)
-                from ..storage.hlc import MAX_TIMESTAMP
-                mv = rep.mvcc.get(key, MAX_TIMESTAMP, inconsistent=True)
-                if mv is None:
-                    continue
-                try:
-                    rec = _json.loads(mv.value.decode())
-                except ValueError:
-                    continue
-                if rec.get("status") != "aborted":
-                    continue  # committed records are deleted by
-                    # resolve_all once every intent resolves
-                if now - mv.ts.wall < ttl_ns:
-                    continue
-                self.propose_and_wait(rep, {"kind": "batch", "ops": [{
-                    "op": "delete", "key": key.decode("latin1"),
-                    "ts": _enc_ts(self.clock.now())}]})
-                n += 1
+            n += self._gc_replica_txn_records(
+                rep, now, ttl_ns, seen, self.propose_and_wait)
         return n
 
     def put(self, key: bytes, value: bytes, max_iter: int = 500) -> None:

@@ -420,21 +420,33 @@ class NetCluster(Cluster):
 
     # -- pump --------------------------------------------------------------
     def _pump_loop(self) -> None:
+        from ..utils import log
         it = 0
         while not self._stop.is_set():
             it += 1
-            with self._mu:
-                self.liveness.tick()
-                self.liveness.heartbeat(self.node_id)
-                self.store.tick()
-                self.store.handle_ready_all()
-            if it % self.HEARTBEAT_EVERY == 0:
-                epoch = self.liveness.epoch_of(self.node_id)
-                self._broadcast({"k": "live", "epoch": epoch,
-                                 "hlc": self.clock.now().to_int()})
-            self.rpc.deliver_all()
-            with self._mu:
-                self.store.handle_ready_all()
+            # a single raised exception must not kill the ONLY thread
+            # driving raft/liveness/delivery — that would wedge the
+            # node silently (alive process, dead replica). Log and
+            # keep pumping; the failed message/tick is retried or
+            # superseded by raft's own retransmission.
+            try:
+                with self._mu:
+                    self.liveness.tick()
+                    self.liveness.heartbeat(self.node_id)
+                    self.store.tick()
+                    self.store.handle_ready_all()
+                if it % self.HEARTBEAT_EVERY == 0:
+                    epoch = self.liveness.epoch_of(self.node_id)
+                    self._broadcast({"k": "live", "epoch": epoch,
+                                     "hlc": self.clock.now().to_int()})
+                self.rpc.deliver_all()
+                with self._mu:
+                    self.store.handle_ready_all()
+            except Exception as exc:
+                log.error(log.OPS,
+                          "netcluster pump iteration failed (n%d): "
+                          "%s: %s", self.node_id,
+                          type(exc).__name__, exc)
             self._stop.wait(self.PUMP_INTERVAL)
 
     def pump(self, iterations: int = 1) -> None:
@@ -806,8 +818,13 @@ class NetCluster(Cluster):
                 if nid is None:
                     break
             if nid == self.node_id:
-                tried.append(nid)
-                nid = None
+                # the lease may have moved HERE mid-retry (failover);
+                # serve locally if our replica now holds it
+                try:
+                    return self._serve_read(args)
+                except NotLeaseholderError as e:
+                    tried.append(nid)
+                    nid = e.hint
                 continue
             try:
                 r = self.call(nid, "read", args)
@@ -922,41 +939,18 @@ class NetCluster(Cluster):
         """Local-leaseholder slice of the txn-record GC sweep: each
         node collects aged ABORTED records for the ranges it leads
         (the distributed form of the gc queue's per-leaseholder
-        processing)."""
-        import json as _json
-
-        from .store import EngineKey
+        processing). The record filtering is the shared base-class
+        sweep; only replica selection and the propose step differ."""
         n = 0
         now = self.clock.now().wall
+        seen: set[bytes] = set()
         with self._mu:
             reps = [r for r in self.store.replicas.values()
                     if r.holds_lease()]
         for rep in reps:
-            with self._mu:
-                keys = []
-                for ek, raw in rep.mvcc.engine.scan(
-                        EngineKey(b"\x00txn/", -1),
-                        include_tombstones=True):
-                    if not ek.key.startswith(b"\x00txn/"):
-                        break  # ordered scan left the txn keyspace
-                    keys.append(ek.key)
-            for key in set(keys):
-                with self._mu:
-                    mv = rep.mvcc.get(key, MAX_TIMESTAMP,
-                                      inconsistent=True)
-                if mv is None or mv.value is None:
-                    continue
-                try:
-                    rec = _json.loads(mv.value.decode())
-                except ValueError:
-                    continue
-                if rec.get("status") != "aborted" \
-                        or now - mv.ts.wall < ttl_ns:
-                    continue
-                self._local_propose(rep, {"kind": "batch", "ops": [{
-                    "op": "delete", "key": key.decode("latin1"),
-                    "ts": _enc_ts(self.clock.now())}]})
-                n += 1
+            n += self._gc_replica_txn_records(
+                rep, now, ttl_ns, seen,
+                lambda r, cmd: self._local_propose(r, cmd))
         return n
 
     # surfaces of the in-process harness that have no meaning here

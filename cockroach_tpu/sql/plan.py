@@ -31,6 +31,12 @@ class Scan(PlanNode):
     filter: Optional[BExpr] = None
     # computed columns added by the planner (e.g. remapped join keys)
     computed: list[tuple[str, BExpr]] = field(default_factory=list)
+    # stored columns uploaded to HBM as int32 (engine-proven value
+    # range): the scan upcasts them back to int64, so programs see
+    # identical semantics while the HBM read moves half the bytes —
+    # int64 is software-emulated on TPU, so narrow uploads also shed
+    # the emulation's limb ops on the first touch
+    narrowed: frozenset = frozenset()
 
 
 @dataclass

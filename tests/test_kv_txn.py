@@ -75,10 +75,29 @@ class TestTimestampCache:
         assert c.get_max(Span(b"a", b"z")) == ts(20)
 
     def test_rotation_folds_low_water(self):
+        # point reads live in the O(1) point table now; a fold into
+        # the low-water mark happens only past POINT_CAP (before the
+        # fold, an unseen key correctly reads the low-water floor)
         c = TimestampCache()
         for i in range(5000):
             c.add(Span(b"k%05d" % i), ts(i + 1))
+        assert c.get_max(Span(b"k00042")) == ts(43)
+        assert c.get_max(Span(b"zzz")) == c.low_water
+        for i in range(c.POINT_CAP + 1):
+            c.add(Span(b"p%06d" % i), ts(10_000 + i))
+        assert c.low_water >= ts(1)       # fold raised the floor
         assert c.get_max(Span(b"zzz")) >= ts(1)
+
+    def test_range_spans_rotate(self):
+        c = TimestampCache()
+        for i in range(c.SPAN_CAP + 10):
+            c.add(Span(b"a%04d" % i, b"b%04d" % i), ts(i + 1))
+        assert len(c._spans) <= c.SPAN_CAP
+        assert c.low_water >= ts(1)
+        # a recent range span still answers exactly
+        last = c.SPAN_CAP + 9
+        assert c.get_max(Span(b"a%04d" % last, b"b%04d" % last)) \
+            == ts(last + 1)
 
 
 class TestTxnBasics:
