@@ -29,8 +29,8 @@ from . import ast
 from . import datum as dtm
 from .bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce, BCol,
                     BConst, BDictGather, BDictLookup, BDictRemap, BExpr,
-                    BExtract, BInList, BIsNull, BoundAgg, BoundWindow,
-                    BUnary, BWinRef)
+                    BExtract, BFunc, BInList, BIsNull, BoundAgg,
+                    BoundWindow, BUnary, BWinRef)
 from .types import (BOOL, DATE, FLOAT8, INT8, INTERVAL, STRING, TIMESTAMP,
                     Family, SQLType, common_numeric_type)
 
@@ -1199,7 +1199,8 @@ class Binder:
                 f"{name}() in a statement with a FROM clause is not "
                 "supported: it would fold to one value per statement "
                 "instead of one per row")
-        if name in AGG_FUNCS:
+        if name in AGG_FUNCS or name in self.STATS_AGGS \
+                or name in self.BOOL_AGGS:
             if not self._collect_aggs:
                 raise BindError(f"aggregate {name} not allowed here")
             return self._bind_agg(e)
@@ -1251,8 +1252,72 @@ class Binder:
             return out
         raise BindError(f"unknown function {name}")
 
+    # statistical aggregates rewritten at bind time into compositions
+    # of sum/count partials (the reference computes them the same way
+    # from local sums, builtins/aggregate_builtins.go): no new device
+    # kernels, and distributed/streaming merges come for free
+    STATS_AGGS = {"stddev", "stddev_samp", "stddev_pop",
+                  "variance", "var_samp", "var_pop"}
+    BOOL_AGGS = {"bool_and": "min", "bool_or": "max", "every": "min"}
+
+    def _reg_agg(self, spec: BoundAgg) -> BExpr:
+        for i, existing in enumerate(self.aggs):
+            if _agg_key(existing) == _agg_key(spec):
+                return BAggRef(i, existing.type)
+        self.aggs.append(spec)
+        return BAggRef(len(self.aggs) - 1, spec.type)
+
+    def _check_no_nested_agg(self, arg: BExpr) -> None:
+        from .bound import walk as _walk
+        for nd in _walk(arg):
+            if isinstance(nd, BAggRef):
+                raise BindError("nested aggregates")
+
+    def _bind_stats_agg(self, name: str, e: ast.FuncCall) -> BExpr:
+        if e.distinct:
+            raise BindError(f"{name}(DISTINCT) not supported")
+        if len(e.args) != 1:
+            raise BindError(f"{name} takes one argument")
+        x = self.coerce(self.bind(e.args[0]), FLOAT8)
+        self._check_no_nested_agg(x)
+        s = self._reg_agg(BoundAgg("sum", x, FLOAT8))
+        ss = self._reg_agg(BoundAgg("sum", BBin("*", x, x, FLOAT8),
+                                    FLOAT8))
+        n = self.coerce(self._reg_agg(BoundAgg("count", x, INT8)),
+                        FLOAT8)
+        # var_pop = (sum(x^2) - sum(x)^2/n) / n; _samp divides by n-1
+        # (NULL when the divisor is zero, pg semantics, via nullif)
+        num = BBin("-", ss, BBin("/", BBin("*", s, s, FLOAT8), n,
+                                 FLOAT8), FLOAT8)
+        pop = name.endswith("_pop")
+        div = n if pop else BBin("-", n, BConst(1.0, FLOAT8), FLOAT8)
+        var = BBin("/", num, BFunc("nullif", [div,
+                                              BConst(0.0, FLOAT8)],
+                                   FLOAT8), FLOAT8)
+        # float error can drive the numerator epsilon-negative; CASE
+        # (not greatest: pg's greatest IGNORES NULLs, which would turn
+        # the empty-set NULL into 0)
+        var = BCase(whens=[(BBin("<", var, BConst(0.0, FLOAT8), BOOL),
+                            BConst(0.0, FLOAT8))],
+                    else_=var, type=FLOAT8)
+        if name.startswith("stddev"):
+            return BFunc("sqrt", [var], FLOAT8)
+        return var
+
     def _bind_agg(self, e: ast.FuncCall) -> BExpr:
         name = e.name
+        if name in self.STATS_AGGS:
+            return self._bind_stats_agg(name, e)
+        if name in self.BOOL_AGGS:
+            if len(e.args) != 1:
+                raise BindError(f"{name} takes one argument")
+            # min/max over the 0/1 encoding (the scatter identities
+            # have no bool lane); the ref casts back to BOOL
+            arg = BCast(self.coerce(self.bind(e.args[0]), BOOL), INT8)
+            self._check_no_nested_agg(arg)
+            ref = self._reg_agg(BoundAgg(self.BOOL_AGGS[name], arg,
+                                         INT8))
+            return BCast(ref, BOOL)
         if name == "count" and e.star:
             spec = BoundAgg("count_rows", None, INT8)
         else:

@@ -25,7 +25,7 @@ import re
 
 import numpy as np
 
-from .bound import BConst, BDictGather, BExpr, BFunc, BUnary
+from .bound import BCase, BConst, BDictGather, BExpr, BFunc, BUnary
 from .types import (BOOL, DATE, FLOAT8, INT8, STRING, TIMESTAMP, Family,
                     SQLType)
 
@@ -44,8 +44,18 @@ FLOAT_UNARY = {
     "cot": lambda x: 1.0 / math.tan(x),
     "asin": math.asin, "acos": math.acos, "atan": math.atan,
     "sinh": math.sinh, "cosh": math.cosh, "tanh": math.tanh,
+    "asinh": math.asinh, "acosh": math.acosh, "atanh": math.atanh,
     "degrees": math.degrees, "radians": math.radians,
     "floor": math.floor, "ceil": math.ceil, "ceiling": math.ceil,
+}
+
+# integer constant-fold-only builtins (no row-wise device kernel;
+# these appear in expressions over literals, pg's immutable int fns):
+# name -> (arity, fn)
+INT_FOLD = {
+    "factorial": (1, lambda n: math.factorial(int(n))),
+    "gcd": (2, lambda a, b: math.gcd(int(a), int(b))),
+    "lcm": (2, lambda a, b: math.lcm(int(a), int(b))),
 }
 
 # 2-arg float elementwise
@@ -86,6 +96,17 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         xs = [binder.coerce(a, FLOAT8) for a in args]
         return _fold(name, xs, FLOAT_BINARY[name], FLOAT8) \
             or BFunc(name, xs, FLOAT8)
+    if name in INT_FOLD:
+        arity, fn = INT_FOLD[name]
+        if len(args) != arity:
+            raise BuiltinError(
+                f"{name} takes {arity} argument"
+                + ("s" if arity != 1 else ""))
+        out = _fold(name, args, fn, INT8)
+        if out is None:
+            raise BuiltinError(
+                f"{name} over columns not supported (constants only)")
+        return out
     if name in ("round", "trunc") and len(args) == 2:
         x = binder.coerce(args[0], FLOAT8)
         nd = args[1]
@@ -198,8 +219,11 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         return BFunc("width_bucket", xs + [BConst(int(n.value), INT8)], INT8)
 
     # ---- date/time --------------------------------------------------------
-    if name in ("now", "current_timestamp", "transaction_timestamp",
-                "statement_timestamp", "clock_timestamp"):
+    if name in ("now", "current_timestamp", "localtimestamp",
+                "transaction_timestamp", "statement_timestamp",
+                "clock_timestamp"):
+        # every statement-timestamp variant folds to the statement's
+        # HLC moment (timestamptz is future work, so local == utc)
         us = binder.now_micros
         if us is None:
             raise BuiltinError(f"{name}() needs a statement timestamp")
@@ -209,6 +233,41 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         if us is None:
             raise BuiltinError("current_date needs a statement timestamp")
         return BConst(int(us // 86_400_000_000), DATE)
+    if name == "to_timestamp":
+        x = binder.coerce(args[0], FLOAT8)
+        out = _fold(name, [x], lambda v: int(v * 1_000_000), TIMESTAMP)
+        if out is None:
+            raise BuiltinError(
+                "to_timestamp over columns not supported "
+                "(constants only)")
+        return out
+    if name == "make_timestamp":
+        xs = [binder.coerce(a, FLOAT8) for a in args]
+        if len(xs) != 6 or not all(isinstance(a, BConst) for a in xs):
+            raise BuiltinError(
+                "make_timestamp(y, mon, d, h, min, sec) constants")
+        if any(a.value is None for a in xs):
+            return BConst(None, TIMESTAMP)  # strict: NULL arg -> NULL
+        y, mo, d, h, mi, s = (a.value for a in xs)
+        try:
+            dt = datetime.datetime(int(y), int(mo), int(d), int(h),
+                                   int(mi)) \
+                - datetime.datetime(1970, 1, 1)
+        except (ValueError, OverflowError) as exc:
+            raise BuiltinError(f"make_timestamp: {exc}") from None
+        return BConst(int(dt.total_seconds() * 1_000_000
+                          + s * 1_000_000), TIMESTAMP)
+    if name == "isfinite":
+        if not args:
+            raise BuiltinError("isfinite takes one argument")
+        x = args[0]
+        if isinstance(x, BConst):
+            # strict: NULL in -> NULL out (pg)
+            return BConst(None if x.value is None else True, BOOL)
+        # all STORED dates/timestamps are finite; NULL rows stay NULL
+        from .bound import BIsNull
+        return BCase(whens=[(BIsNull(x), BConst(None, BOOL))],
+                     else_=BConst(True, BOOL), type=BOOL)
     if name == "date_trunc":
         if len(args) != 2 or not isinstance(args[0], BConst):
             raise BuiltinError("date_trunc('part', expr)")
@@ -316,8 +375,17 @@ _STR_TO_STR = {
     "split_part": lambda s, d, n: _split_part(s, d, n),
     "quote_ident": lambda s: '"' + s.replace('"', '""') + '"',
     "quote_literal": lambda s: "'" + s.replace("'", "''") + "'",
-    "concat": None,  # variadic, handled specially
-    "md5": None,     # needs hashlib, handled specially
+    # pg regexp_replace: first match unless flags contain 'g'
+    "regexp_replace": lambda s, pat, repl, flags="": re.sub(
+        pat, repl, s,
+        count=(0 if "g" in flags else 1),
+        flags=(re.IGNORECASE if "i" in flags else 0)),
+    "concat": None,     # variadic, handled specially
+    "concat_ws": None,  # variadic, handled specially
+    "md5": None,        # needs hashlib, handled specially
+    "sha1": None,
+    "sha256": None,
+    "sha512": None,
 }
 
 # string -> scalar builtins: name -> (fn, SQLType)
@@ -326,12 +394,22 @@ _STR_TO_VAL = {
     "char_length": (len, INT8),
     "character_length": (len, INT8),
     "octet_length": (lambda s: len(s.encode()), INT8),
+    "bit_length": (lambda s: len(s.encode()) * 8, INT8),
     "ascii": (lambda s: ord(s[0]) if s else 0, INT8),
     "strpos": (lambda s, sub: s.find(sub) + 1, INT8),
     "position": (lambda s, sub: s.find(sub) + 1, INT8),
     "starts_with": (lambda s, p: s.startswith(p), BOOL),
     "ends_with": (lambda s, p: s.endswith(p), BOOL),
 }
+
+
+def _intersperse(args: list, sep) -> list:
+    out = []
+    for i, a in enumerate(args):
+        if i:
+            out.append(sep)
+        out.append(a)
+    return out
 
 
 def _pad(s, n, fill, left):
@@ -361,11 +439,31 @@ def _substr(s, start, length=None):
     return s[max(i, 0):max(end, 0)]
 
 
+_HASH_FNS = ("md5", "sha1", "sha256", "sha512")
+
+
 def _bind_string_builtin(binder, name: str, args: list) -> BExpr | None:
     import hashlib
-    if name == "md5":
-        fn = lambda s: hashlib.md5(s.encode()).hexdigest()  # noqa: E731
+    if name in _HASH_FNS:
+        h = getattr(hashlib, name)
+        fn = lambda s: h(s.encode()).hexdigest()  # noqa: E731
         return _dict_transform(binder, name, args[0], fn)
+    if name == "concat_ws":
+        if len(args) < 2 or not isinstance(args[0], BConst):
+            raise BuiltinError(
+                "concat_ws needs a constant separator first")
+        sep = args[0].value
+        if sep is None:
+            return BConst(None, STRING)
+        # pg: NULL arguments are skipped TOGETHER with their
+        # separator (constant NULLs here; a NULL column VALUE still
+        # nulls the row, a known narrowing of pg's per-row skip)
+        live = [a for a in args[1:]
+                if not (isinstance(a, BConst) and a.value is None)]
+        if not live:
+            return BConst("", STRING)
+        return _bind_string_builtin(binder, "concat", _intersperse(
+            live, BConst(str(sep), STRING)))
     if name == "concat":
         # variadic; exactly one dictionary column allowed, rest constants
         col_i = None
@@ -432,15 +530,24 @@ def _dict_transform(binder, name, x, fn) -> BExpr:
     if isinstance(x, BConst):
         if x.value is None:
             return BConst(None, STRING)
-        return BConst(fn(str(x.value)), STRING)
+        try:
+            return BConst(fn(str(x.value)), STRING)
+        except re.error as exc:
+            raise BuiltinError(f"{name}: invalid pattern: {exc}") \
+                from None
     if x.type.family != Family.STRING:
         raise BuiltinError(f"{name} needs a string argument")
     d = binder._dict_of(x)
     if d is None:
         raise BuiltinError(f"{name} on non-dictionary column")
     out = Dictionary()
-    codes = np.fromiter((out.encode(fn(v)) for v in d.values),
-                        dtype=np.int64, count=len(d.values))
+    try:
+        codes = np.fromiter((out.encode(fn(v)) for v in d.values),
+                            dtype=np.int64, count=len(d.values))
+    except re.error as exc:
+        # user-supplied malformed regexp (regexp_replace): a clean
+        # bind error, not a traceback mid-dictionary-map
+        raise BuiltinError(f"{name}: invalid pattern: {exc}") from None
     g = BDictGather(x, codes, STRING)
     g.dictionary = out
     return g
