@@ -44,6 +44,13 @@ CONFIGS = {
     # catalog, sequences and jobs all replicate (round-3 VERDICT #1;
     # the reference's 3node logictest configs)
     "3node": {"mesh": False, "cluster": 3, "vars": {"distsql": "off"}},
+    # the north-star composition (round-3 VERDICT Weak #4): SQL over
+    # REPLICATED ranges with DISTRIBUTED device execution — every
+    # statement's data lives on a 3-node raft cluster, scans
+    # re-materialize from committed range data, and eligible plans
+    # shard over the 8-device mesh with ICI collective merges
+    "3node-mesh": {"mesh": True, "cluster": 3,
+                   "vars": {"distsql": "auto"}},
 }
 
 
@@ -97,21 +104,20 @@ def _socket_cluster():
 
 def _run_file(path: str, config: dict) -> None:
     to_stop = []
-    if config["mesh"]:
-        from cockroach_tpu.parallel.mesh import make_mesh
-        eng = Engine(mesh=make_mesh())
-    elif config.get("socket_cluster"):
-        c, peers = _socket_cluster()
-        to_stop = [c] + peers
-        eng = Engine(cluster=c)
+    cluster = None
+    if config.get("socket_cluster"):
+        cluster, peers = _socket_cluster()
+        to_stop = [cluster] + peers
     elif config.get("cluster"):
         from cockroach_tpu.kvserver.cluster import Cluster
-        c = Cluster(n_nodes=config["cluster"])
-        c.create_range(b"\x00", b"\xff")
-        c.pump_until(lambda: c.leaseholder(1) is not None)
-        eng = Engine(cluster=c)
+        cluster = Cluster(n_nodes=config["cluster"])
+        cluster.create_range(b"\x00", b"\xff")
+        cluster.pump_until(lambda: cluster.leaseholder(1) is not None)
+    if config["mesh"]:
+        from cockroach_tpu.parallel.mesh import make_mesh
+        eng = Engine(cluster=cluster, mesh=make_mesh())
     else:
-        eng = Engine()
+        eng = Engine(cluster=cluster)
     session = eng.session()
     for k, v in config["vars"].items():
         session.vars.set(k, v)
