@@ -54,9 +54,9 @@ class FlowCancelled(Exception):
 class FlowSpec:
     flow_id: str
     gateway: int                 # node id consuming the results
-    stage: str                   # "rows" | "partial_agg"
+    stage: str                   # "rows" | "partial_agg" | "graph"
     sql: str
-    stream_id: int               # output stream on the gateway
+    stream_id: object            # gather stream id (int, or "g:p<n>")
     chunk_rows: int = 65536
     read_ts: Optional[int] = None
     window: int = 8              # max unacked chunks in flight
@@ -65,13 +65,19 @@ class FlowSpec:
     # its stage — the PartitionSpans assignment by leaseholder
     # (distsql_physical_planner.go:1096). None = node-local shards.
     spans: Optional[dict] = None
+    # multi-stage shuffle flows (distsql/shuffle.py): the graph kind
+    # each node re-derives deterministically from the SQL, and the
+    # ordered data-node set exchange buckets route over
+    graph: Optional[str] = None
+    data_nodes: Optional[list] = None
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
                 "stage": self.stage, "sql": self.sql,
                 "stream_id": self.stream_id,
                 "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
-                "window": self.window, "spans": self.spans}
+                "window": self.window, "spans": self.spans,
+                "graph": self.graph, "data_nodes": self.data_nodes}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

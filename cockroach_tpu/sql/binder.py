@@ -169,8 +169,16 @@ def _leap(y: int) -> bool:
 class Binder:
     def __init__(self, scope: Scope, subquery_eval=None,
                  now_micros: Optional[int] = None,
-                 sequence_ops=None, volatile_fold_ok: bool = True):
+                 sequence_ops=None, volatile_fold_ok: bool = True,
+                 dict_folds: bool = True):
         self.scope = scope
+        # dict_folds=False: a string literal absent from the column's
+        # dictionary binds to an impossible code (-1) compare instead
+        # of folding to a constant. Folding is dictionary-CONTENT
+        # dependent, so plans bound on different shards diverge
+        # structurally — the host-level shuffle (distsql/shuffle.py)
+        # needs every node to derive an identical stage graph.
+        self.dict_folds = dict_folds
         # populated by bind_with_aggs
         self.aggs: list[BoundAgg] = []
         self._collect_aggs = False
@@ -688,11 +696,15 @@ class Binder:
         if op == "=":
             code = d.codes.get(lit)
             if code is None:
+                if not self.dict_folds:
+                    return BBin("=", col, BConst(-1, col.type), BOOL)
                 return BConst(False, BOOL)  # value absent from data
             return BBin("=", col, BConst(code, col.type), BOOL)
         if op == "!=":
             code = d.codes.get(lit)
             if code is None:
+                if not self.dict_folds:
+                    return BBin("!=", col, BConst(-1, col.type), BOOL)
                 return BConst(True, BOOL)
             return BBin("!=", col, BConst(code, col.type), BOOL)
         # ordered compare: evaluate against dictionary -> lookup table
@@ -897,6 +909,8 @@ class Binder:
                     raise BindError(str(err)) from None
             code = d.codes.get(text)
             if code is None:
+                if not self.dict_folds:
+                    return BBin(op, l, BConst(-1, l.type), BOOL)
                 return BConst(op == "!=", BOOL)
             return BBin(op, l, BConst(code, l.type), BOOL)
         # col-col: same dictionary -> direct code compare; else remap
@@ -1094,6 +1108,8 @@ class Binder:
                 code = d.codes.get(b.value)
                 if code is not None:
                     vals.append(code)
+                elif not self.dict_folds:
+                    vals.append(-1)   # impossible code: never matches
             if not vals:
                 return BConst(e.negated, BOOL)
             return BInList(x, vals, e.negated, BOOL)
@@ -1274,6 +1290,16 @@ class Binder:
                 raise BindError("nested aggregates")
 
     def _bind_stats_agg(self, name: str, e: ast.FuncCall) -> BExpr:
+        """stddev/variance via single-pass sum-of-squares partials in
+        float64. PRECISION CAVEAT (round-4 advisor): for large-mean,
+        low-variance data (mean ~1e8, var ~1) the ``sum(x²)-sum(x)²/n``
+        form cancels catastrophically where Postgres' Youngs-Cramer
+        recurrence stays accurate; the clamp-to-0 CASE below bounds the
+        failure at 0, not at a wrong positive value. The single-pass
+        form is what splits across DistSQL partials (SUM/SUM/COUNT
+        merge; a per-group mean-centering pre-pass would need a second
+        scan). Tests pin the well-conditioned cases; document, don't
+        hide, the ill-conditioned one."""
         if e.distinct:
             raise BindError(f"{name}(DISTINCT) not supported")
         if len(e.args) != 1:

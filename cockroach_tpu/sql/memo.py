@@ -73,10 +73,16 @@ class MemoResult:
     considered: int       # candidate plans costed
 
 
-def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
+def search(aliases: list[str], scan_rows, join_info,
+           scan_cost=None) -> MemoResult | None:
     """Find the cheapest connected left-deep join order.
 
     scan_rows(alias) -> estimated post-filter scan rows.
+    scan_cost(alias) -> access-path-aware cost of producing those rows
+    (planner._choose_access_paths: an index point/prefix lookup costs
+    its matched rows, a full scan its post-filter rows) — this is
+    where index selection is costed INSIDE the memo instead of beside
+    it. Defaults to scan_rows.
     join_info(left_set, alias) -> (selectivity, build_multiplicity
     [, direct_eligible]) — build_multiplicity is the estimated
     duplicate rows per join key on the build side `alias` — or None
@@ -98,7 +104,8 @@ def search(aliases: list[str], scan_rows, join_info) -> MemoResult | None:
     considered = 0
     for a in aliases:
         r = max(scan_rows(a), 1.0)
-        best[frozenset([a])] = GroupPlan(cost=r, rows=r, root=a)
+        c = max(scan_cost(a), 1.0) if scan_cost is not None else r
+        best[frozenset([a])] = GroupPlan(cost=c, rows=r, root=a)
     for size in range(2, n + 1):
         for combo in itertools.combinations(aliases, size):
             s = frozenset(combo)

@@ -144,6 +144,12 @@ class OutputMeta:
     # set when the memoized join-order search ran (sql/memo.py):
     # EXPLAIN surfaces the exploration summary
     memo: object = None
+    # normalization rule firings (sql/rules.RuleTrace) — EXPLAIN
+    # renders them like the reference's opttester rule output
+    rule_trace: object = None
+    # alias -> access-path description chosen by the memo's scan
+    # costing ("primary eq(l_orderkey) rows≈3" / "full rows≈6001215")
+    access_paths: dict = field(default_factory=dict)
 
 
 def plan_tree_repr(node: PlanNode, indent: int = 0,
@@ -191,6 +197,18 @@ def plan_tree_repr(node: PlanNode, indent: int = 0,
 
 
 def prune_scan_columns(root: PlanNode) -> PlanNode:
+    root, _ = _prune_impl(root)
+    return root
+
+
+def prune_scan_columns_traced(root: PlanNode):
+    """prune_scan_columns, returning [(alias, n_dropped)] for the
+    rule trace (sql/rules.py)."""
+    _, dropped = _prune_impl(root)
+    return dropped
+
+
+def _prune_impl(root: PlanNode):
     """Projection pruning: shrink every Scan's column map to the batch
     columns the rest of the plan actually references. The engine
     uploads only these to HBM (the reference fetches only needed
@@ -247,6 +265,8 @@ def prune_scan_columns(root: PlanNode) -> PlanNode:
 
     collect(root)
 
+    dropped: list[tuple[str, int]] = []
+
     def prune(n: PlanNode):
         if isinstance(n, Scan):
             kept = {bn: sn for bn, sn in n.columns.items()
@@ -256,6 +276,8 @@ def prune_scan_columns(root: PlanNode) -> PlanNode:
                 # needs one to carry its shape
                 bn = next(iter(n.columns))
                 kept = {bn: n.columns[bn]}
+            if len(kept) < len(n.columns):
+                dropped.append((n.alias, len(n.columns) - len(kept)))
             n.columns = kept
         for attr in ("child", "left", "right"):
             c = getattr(n, attr, None)
@@ -263,4 +285,4 @@ def prune_scan_columns(root: PlanNode) -> PlanNode:
                 prune(c)
 
     prune(root)
-    return root
+    return root, dropped

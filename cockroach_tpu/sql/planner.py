@@ -39,10 +39,14 @@ class CatalogView:
 
     def __init__(self, schemas, dictionaries, stats=None,
                  key_distinct_fn=None, int_range_fn=None,
-                 keys_unique_fn=None):
+                 keys_unique_fn=None, indexes=None):
         self.schemas = schemas
         self.dictionaries = dictionaries
         self.stats = stats or {}
+        # table -> [(index_name, (cols...), unique)] of PUBLIC
+        # secondary indexes: access-path candidates for the memo's
+        # scan costing (planner._choose_access_paths)
+        self.indexes = indexes or {}
         self.key_distinct_fn = key_distinct_fn
         # keys_unique_fn(table, cols) -> bool: SNAPSHOT-AWARE
         # uniqueness at the statement's read timestamp — required for
@@ -89,8 +93,22 @@ class Planner:
 
     def __init__(self, catalog: CatalogView, subquery_eval=None,
                  now_micros=None, sequence_ops=None,
-                 use_memo: bool = True, volatile_fold_ok: bool = True):
+                 use_memo: bool = True, volatile_fold_ok: bool = True,
+                 dict_folds: bool = True, rules: bool = True,
+                 trace=None):
         self.catalog = catalog
+        # False: dictionary-content-dependent constant folds disabled
+        # so plan structure is shard-independent (distsql/shuffle.py)
+        self.dict_folds = dict_folds
+        # the normalization rule plane (sql/rules.py); the engine maps
+        # SET optimizer_rules = 'off' here
+        self.rules_on = rules
+        # caller-provided RuleTrace so AST-layer firings (view
+        # expansion, decorrelation — recorded by the engine) and
+        # plan-layer firings land in one report
+        self._trace = trace
+        # alias -> chosen access path line (memo scan costing)
+        self.access_paths: dict = {}
         # engine-supplied hooks: subquery execution + statement
         # timestamp for now()/current_date + sequence builtins
         # (binder.py)
@@ -138,6 +156,54 @@ class Planner:
             return False
         distinct, nonnull = fn(cand_table, tuple(stored))
         return distinct == nonnull
+
+    def _choose_access_paths(self, tables, conjuncts,
+                             tables_of) -> None:
+        """Cost every table's access paths — full scan vs each index
+        whose columns are fully bound by constant-equality conjuncts —
+        and record the winner (idxconstraint + the memo's scan costing
+        in one place; surfaced by EXPLAIN as 'access:' lines, fed to
+        memo.search as scan_cost)."""
+        from .bound import BConst
+        for alias, tname in tables:
+            rc = max(self.catalog.row_count(tname), 1.0)
+            st = self.catalog.stats.get(tname)
+            eq_cols: set[str] = set()
+            for c in conjuncts:
+                if isinstance(c, BBin) and c.op == "=" \
+                        and tables_of(c) == {alias}:
+                    for a, b in ((c.left, c.right),
+                                 (c.right, c.left)):
+                        if isinstance(a, BCol) and \
+                                isinstance(b, BConst):
+                            eq_cols.add(a.name.split(".", 1)[-1])
+            cands = []
+            try:
+                pk = tuple(self.catalog.schema(tname).primary_key)
+                if pk:
+                    cands.append(("primary", pk, True))
+            except PlanError:
+                pass
+            for nm, cols, uniq in self.catalog.indexes.get(tname, []):
+                cands.append((nm, tuple(cols), uniq))
+            best = ("full", rc, rc)
+            for label, cols, uniq in cands:
+                if not cols or not all(cn in eq_cols for cn in cols):
+                    continue
+                if uniq:
+                    est = 1.0
+                else:
+                    est = rc
+                    for cn in cols:
+                        d = (st.distinct.get(cn)
+                             if st is not None and st.distinct
+                             else None)
+                        est /= max(float(d) if d else rc ** 0.5, 1.0)
+                    est = max(est, 1.0)
+                cost = est + 2.0   # probe overhead
+                if cost < best[2]:
+                    best = (f"{label} eq({','.join(cols)})", est, cost)
+            self.access_paths[alias] = best
 
     def _memo_order(self, tables, ordered, conjuncts, alias_table,
                     tables_of, _key_side):
@@ -301,7 +367,17 @@ class Planner:
                     if build_known else 1.0)
             return sel, mult, _direct_eligible(right, build_cols)
 
-        return memomod.search(aliases, scan_rows, join_info)
+        def scan_cost(alias: str) -> float:
+            # access-path-aware: an index lookup costs its matched
+            # rows; otherwise the post-filter scan estimate
+            ap = self.access_paths.get(alias)
+            rows = scan_rows(alias)
+            if ap is not None and not ap[0].startswith("full"):
+                return min(rows, ap[2])
+            return rows
+
+        return memomod.search(aliases, scan_rows, join_info,
+                              scan_cost=scan_cost)
 
     def plan_select(self, sel: ast.Select) -> tuple[plan.PlanNode, plan.OutputMeta]:
         if sel.table is None:
@@ -346,7 +422,8 @@ class Planner:
         binder = Binder(scope, subquery_eval=self.subquery_eval,
                         now_micros=self.now_micros,
                         sequence_ops=self.sequence_ops,
-                        volatile_fold_ok=self.volatile_fold_ok)
+                        volatile_fold_ok=self.volatile_fold_ok,
+                        dict_folds=self.dict_folds)
 
         # ---- gather predicates ---------------------------------------------
         conjuncts: list[BExpr] = []
@@ -375,6 +452,7 @@ class Planner:
         node: plan.PlanNode = scans[tables[0][0]]
         probe_root = tables[0][0]  # updated if the build-side swap fires
         remaining_conjuncts = list(conjuncts)
+        self._choose_access_paths(tables, conjuncts, tables_of)
 
         jk_counter = [0]
 
@@ -800,9 +878,19 @@ class Planner:
                                                scope, node)
                 if d is not None:
                     meta.dictionaries[name] = d
-        from .pushdown import push_build_exprs
-        push_build_exprs(node)
-        plan.prune_scan_columns(node)
+        from .rules import RuleTrace
+        from .rules import normalize as normalize_rules
+        trace = self._trace if self._trace is not None else RuleTrace()
+        if self.rules_on:
+            node = normalize_rules(node, trace)
+        else:
+            # rule plane off (SET optimizer_rules = 'off'): the two
+            # load-bearing passes still run, untraced
+            from .pushdown import push_build_exprs
+            push_build_exprs(node)
+            plan.prune_scan_columns(node)
+        meta.rule_trace = trace
+        meta.access_paths = dict(self.access_paths)
         meta.memo = self.last_memo
         return node, meta
 

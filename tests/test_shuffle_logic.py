@@ -1,0 +1,235 @@
+"""Logic corpus over the host-level shuffle: the `fakedist-shuffle`
+config.
+
+Every file of the logic-test corpus runs against the single-node
+oracle (golden outputs verified as usual), and every SELECT whose plan
+is shuffle-decomposable ALSO runs through a 3-data-node Gateway with
+both tables row-sharded (nothing replicated) and hash exchanges
+between the nodes — results must match the oracle's. The data nodes
+re-shard from the oracle's committed state whenever a table's
+generation moves, so DML/DDL in the corpus flows through.
+
+The reference analogue: logictest's `fakedist` configs re-run the same
+corpus over simulated multi-node planning (fake_span_resolver.go:31);
+here the distribution is real (flows, exchanges, credit windows) and
+only the process boundary is elided — tests/test_shuffle_flows.py
+covers the TCP fabric.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.distsql import shuffle as shfl
+from cockroach_tpu.distsql.node import DistSQLNode, Gateway
+from cockroach_tpu.distsql.physical import DistUnsupported
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.kvserver.transport import LocalTransport
+from cockroach_tpu.sql import parser
+from cockroach_tpu.sql.planner import Planner
+from tests.datadriven import run_datadriven
+
+DIR = os.path.join(os.path.dirname(__file__), "testdata", "logic_test")
+FILES = sorted(glob.glob(os.path.join(DIR, "*.td")))
+
+N_DATA_NODES = 3
+
+
+def _visible_columns(store, name, ts):
+    """Decode a table's MVCC-visible rows to (cols, valid) column
+    dicts (strings as raw values, ready for insert_columns)."""
+    store.seal(name)
+    td = store.table(name)
+    parts_d, parts_v = [], []
+    for ch in td.chunks:
+        m = ch.live_mask(ts)
+        if not m.any():
+            continue
+        d, v = {}, {}
+        for col in td.schema.columns:
+            cn = col.name
+            arr = ch.data[cn][m]
+            va = ch.valid[cn][m].copy()
+            if col.type.uses_dictionary:
+                dic = td.dictionaries.get(cn)
+                dec = np.full(len(arr), "", dtype=object)
+                if dic is not None and len(dic):
+                    safe = np.clip(arr, 0, len(dic) - 1)
+                    dec = dic.decode_array(safe)
+                arr = np.where(va, dec, "")
+            d[cn] = arr
+            v[cn] = va
+        parts_d.append(d)
+        parts_v.append(v)
+    if not parts_d:
+        return None, None
+    names = [c.name for c in td.schema.columns]
+    cols = {n: np.concatenate([p[n] for p in parts_d]) for n in names}
+    valid = {n: np.concatenate([p[n] for p in parts_v]) for n in names}
+    return cols, valid
+
+
+class _ShuffleMirror:
+    """Keeps 3 sharded data-node engines + a gateway in sync with the
+    oracle engine's committed state."""
+
+    def __init__(self, oracle: Engine):
+        self.oracle = oracle
+        self.transport = LocalTransport()
+        self.engines = [Engine() for _ in range(N_DATA_NODES + 1)]
+        self.nodes = [DistSQLNode(i, e, self.transport)
+                      for i, e in enumerate(self.engines)]
+        self.gw = Gateway(self.nodes[0], list(range(1, N_DATA_NODES + 1)),
+                          prefer_shuffle=True)
+        self.synced: dict[str, int] = {}
+        self.ran = 0
+        self.skipped = 0
+
+    def _sync(self):
+        ostore = self.oracle.store
+        ts = self.oracle.clock.now().to_int()
+        live = set(ostore.tables)
+        for name in list(self.synced):
+            if name not in live:
+                del self.synced[name]
+                for eng in self.engines:
+                    if name in eng.store.tables:
+                        eng.store.drop_table(name)
+        for name, td in ostore.tables.items():
+            ostore.seal(name)
+            gen = td.generation
+            if self.synced.get(name) == gen:
+                continue
+            self.synced[name] = gen
+            cols, valid = _visible_columns(ostore, name, ts)
+            for i, eng in enumerate(self.engines):
+                if name in eng.store.tables:
+                    eng.store.drop_table(name)
+                eng.store.create_table(td.schema)
+                if i == 0 or cols is None:
+                    continue       # gateway holds schema only
+                n = len(next(iter(cols.values())))
+                mask = (np.arange(n) % N_DATA_NODES) == (i - 1)
+                if mask.any():
+                    eng.store.insert_columns(
+                        name, {k: v[mask] for k, v in cols.items()},
+                        eng.clock.now(),
+                        valid={k: v[mask] for k, v in valid.items()})
+
+    def check(self, sql: str, oracle_res) -> None:
+        """Run `sql` through the shuffle gateway if decomposable and
+        compare with the oracle's result."""
+        gweng = self.engines[0]
+        self._sync()
+        try:
+            plan, _ = Planner(
+                gweng.catalog_view(int_ranges=False, stats=False),
+                use_memo=False,
+                dict_folds=False).plan_select(parser.parse(sql))
+            kind = shfl.graph_kind(plan)
+        except Exception:
+            self.skipped += 1
+            return
+        if kind is None:
+            self.skipped += 1
+            return
+        low = sql.lower()
+        if "limit" in low and "order by" not in low:
+            self.skipped += 1   # nondeterministic row subset
+            return
+        try:
+            got = self.gw.run(sql)
+        except DistUnsupported:
+            self.skipped += 1
+            return
+        self.ran += 1
+        _assert_same_rows(got, oracle_res,
+                          ordered="order by" in low, sql=sql)
+
+
+def _norm(v):
+    if isinstance(v, float):
+        return round(v, 9)
+    return v
+
+
+def _assert_same_rows(got, want, ordered: bool, sql: str) -> None:
+    g = [tuple(_norm(v) for v in row) for row in got.rows]
+    w = [tuple(_norm(v) for v in row) for row in want.rows]
+    if not ordered:
+        g = sorted(g, key=repr)
+        w = sorted(w, key=repr)
+    assert g == w, (f"shuffle result diverged from oracle for:\n{sql}\n"
+                    f"got {g[:5]}...\nwant {w[:5]}...")
+
+
+@pytest.mark.parametrize(
+    "path", FILES, ids=[os.path.basename(p) for p in FILES])
+def test_logic_fakedist_shuffle(path):
+    oracle = Engine()
+    session = oracle.session()
+    mirror = _ShuffleMirror(oracle)
+
+    def handler(td):
+        if td.cmd == "statement":
+            oracle.execute(td.input, session)
+            return "ok"
+        if td.cmd == "query":
+            res = oracle.execute(td.input, session)
+            if session.txn is None and not session.txn_aborted:
+                mirror.check(td.input, res)
+            import datetime
+            lines = []
+            if td.has("colnames"):
+                lines.append(" ".join(res.names))
+
+            def fmt(v):
+                if v is None:
+                    return "NULL"
+                if isinstance(v, bool):
+                    return "true" if v else "false"
+                if isinstance(v, float):
+                    s = f"{v:.6f}".rstrip("0").rstrip(".")
+                    return s if s not in ("", "-") else "0"
+                if isinstance(v, (datetime.date, datetime.datetime)):
+                    return v.isoformat()
+                if isinstance(v, (list, dict)):
+                    import json
+                    return json.dumps(v, sort_keys=True,
+                                      separators=(",", ":"))
+                return str(v)
+            body = [" ".join(fmt(v) for v in row) for row in res.rows]
+            if td.has("rowsort"):
+                body.sort()
+            lines += body
+            return "\n".join(lines) if lines else "(empty)"
+        raise ValueError(f"{td.pos}: unknown directive {td.cmd!r}")
+
+    run_datadriven(path, handler)
+
+
+def test_corpus_exercises_shuffle():
+    """The config is only meaningful if a healthy share of corpus
+    queries actually ride the shuffle path — prove it on the join
+    corpus file."""
+    path = os.path.join(DIR, "joins_aggs.td")
+    oracle = Engine()
+    session = oracle.session()
+    mirror = _ShuffleMirror(oracle)
+
+    def handler(td):
+        if td.cmd == "statement":
+            oracle.execute(td.input, session)
+            return "ok"
+        res = oracle.execute(td.input, session)
+        if session.txn is None:
+            mirror.check(td.input, res)
+        return "-"
+
+    from tests.datadriven import _parse_file
+    for td in _parse_file(path):
+        handler(td)
+    assert mirror.ran >= 3, \
+        f"only {mirror.ran} queries took the shuffle path"
