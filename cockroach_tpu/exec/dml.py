@@ -605,6 +605,9 @@ class DMLMixin:
                 assigned[cname] = ("const", code)
             elif isinstance(b, BConst):
                 phys = binder._const_to(b, col.type).value if b.value is not None else None
+                if phys is None and not col.nullable:
+                    raise EngineError(
+                        f"null in non-null column {cname}")
                 assigned[cname] = ("const", phys)
             else:
                 b2 = binder.coerce(b, col.type) if b.type.family != col.type.family else b
@@ -638,6 +641,9 @@ class DMLMixin:
                         with _he():
                             dd, vv = v(ctx)
                             dd, vv = np.asarray(dd), np.asarray(vv)
+                        if not c.nullable and not vv[idx].all():
+                            raise EngineError(
+                                f"null in non-null column {cn}")
                         data[cn] = dd[idx].astype(c.type.np_dtype)
                         valid[cn] = vv[idx]
                 else:

@@ -68,11 +68,12 @@ from .ddl import DDLMixin  # noqa: E402
 from .dml import DMLMixin  # noqa: E402
 from .fastpath import FastpathMixin  # noqa: E402
 from .maintenance import MaintenanceMixin  # noqa: E402
+from .oltplane import OltpLaneMixin  # noqa: E402
 from .scanplane import ScanPlaneMixin  # noqa: E402
 
 
-class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
-             MaintenanceMixin, DMLMixin):
+class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
+             ConstraintMixin, MaintenanceMixin, DMLMixin):
     def __init__(self, store: ColumnStore | None = None,
                  clock: Clock | None = None,
                  settings: Settings | None = None,
@@ -125,6 +126,11 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         self._device_tables: dict[tuple, ColumnBatch] = {}
         self._exec_cache: dict[tuple, tuple] = {}
         self._parse_cache: dict[str, object] = {}
+        # SELECT texts proven view-free/subquery-free: the "_plain"
+        # memo keyed by TEXT instead of mutating the shared cached AST
+        # (round-4 advisor, low: an in-place annotation on a shared
+        # node is a latent cross-thread race under the read gate)
+        self._plain_memo: set[str] = set()
         # per-table secondary-index descriptors, cached off the catalog
         # (invalidated by index DDL; a fresh engine lazily reloads)
         self._index_defs: dict[str, list] = {}
@@ -164,6 +170,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             on_change=lambda used: self.metrics.gauge(
                 "sql.mem.device.current",
                 "bytes of HBM reserved by resident tables").set(used))
+        self._lane_init()
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:
@@ -197,12 +204,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
         stmt = parser.parse(sql)
         if len(self._parse_cache) >= self._PARSE_CACHE_MAX:
             self._parse_cache.clear()
+            self._plain_memo.clear()
         self._parse_cache[sql] = stmt
         return copy.deepcopy(stmt) if not (
             isinstance(stmt, ast.Select) and not stmt.ctes
             and not self._has_derived(stmt)) else stmt
 
     def execute(self, sql: str, session: Session | None = None) -> Result:
+        # OLTP fast lane (exec/oltplane.py): literal-normalized shape
+        # cache + native row plane; returns None for anything it
+        # doesn't serve bit-for-bit
+        res = self.lane_execute(sql, session)
+        if res is not None:
+            return res
         session = session or self.session()
         try:
             stmt = self._parse_cached(sql)
@@ -221,11 +235,38 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             raise EngineError(
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block")
+        # full-path statements see the columnstore: publish any lane
+        # writes still queued in the mirror first, and suspend lane
+        # writes while this statement runs (its snapshot must not have
+        # unflushed lane commits beneath it — exec/oltplane.py)
+        with self._lane_sync:
+            # atomic with lane commits: after this block, any lane
+            # write either already sits in _lane_pending (flushed
+            # below) or will observe _nonlane_active and take the
+            # full path (exec/oltplane.py)
+            self._nonlane_active += 1
+            pending = bool(self._lane_pending)
+        try:
+            if pending or self._lane_pending:
+                with self._stmt_lock:
+                    self.lane_flush()
+            return self._execute_stmt_inner(stmt, session, sql_text)
+        finally:
+            self._nonlane_active -= 1
+
+    def _execute_stmt_inner(self, stmt: ast.Statement, session: Session,
+                            sql_text: str = "") -> Result:
         if type(stmt).__name__.startswith(
                 ("Create", "Drop", "Alter", "Truncate", "Rename")):
             # schema changes invalidate cached parses (a text's view/
-            # table resolution or _plain memo may no longer hold)
+            # table resolution or _plain memo may no longer hold) and
+            # every lane plan (eligibility may have flipped: a new
+            # index/FK/changefeed must push writes back onto the full
+            # path, exec/oltplane.py)
             self._parse_cache.clear()
+            self._plain_memo.clear()
+            self._lane_shapes.clear()
+            self._lane_mirrors.clear()
         if self.cluster is not None:
             # the scan plane is a cache of committed range data: check
             # every referenced table's replicated generation token and
@@ -492,8 +533,13 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 return self._explain_analyze(stmt.stmt, session,
                                              sql_text)
             target = stmt.stmt
+            from ..sql.rules import RuleTrace
+            rtrace = RuleTrace()
             if isinstance(target, ast.Select):
-                target = self._expand_views(target)
+                expanded = self._expand_views(target)
+                if expanded is not target:
+                    rtrace.fire("expand_views")
+                target = expanded
             if isinstance(target, ast.Select) and (
                     target.ctes or self._has_derived(target)):
                 # composite shapes (CTEs / derived / views): explain
@@ -505,10 +551,20 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                           self._explain_composite(target, session)],
                     tag="EXPLAIN")
             node, emeta = self._plan(target, session,
-                                     for_explain=True)
+                                     for_explain=True, trace=rtrace)
             costs = estimate(node, self.catalog_view().stats)
             tree = P.plan_tree_repr(node, costs=costs)
             rows = []
+            tr = emeta.rule_trace
+            if tr is not None and tr.firings:
+                rows.append(
+                    ("rules: " + "; ".join(tr.summary()),))
+            for alias, ap in sorted(emeta.access_paths.items()):
+                label, est, cost = ap
+                if not label.startswith("full"):
+                    rows.append((f"access: {alias} via {label} "
+                                 f"rows≈{est:.0f} "
+                                 f"cost≈{cost:.0f}",))
             if emeta.memo is not None:
                 m_ = emeta.memo
                 rows.append((
@@ -692,7 +748,14 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
 
     # -- catalog -------------------------------------------------------------
     def catalog_view(self, int_ranges: bool = True,
-                     read_ts: Timestamp | None = None) -> CatalogView:
+                     read_ts: Timestamp | None = None,
+                     stats: bool = True) -> CatalogView:
+        """``stats=False`` hides every data-dependent signal (row
+        counts, distinct/uniqueness probes, int ranges) so the plan
+        SHAPE is a pure function of schema + statement — required by
+        distsql/shuffle.py, where every node must re-derive an
+        identical stage graph from the SQL despite holding a
+        different shard."""
         from ..sql.stats import TableStats
         # planners see the PUBLIC schema: columns mid-add (WRITE_ONLY
         # descriptor state, schemachange.py) are physically present but
@@ -711,7 +774,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                 schemas[n] = td.schema
         dicts = {n: dict(td.dictionaries)
                  for n, td in self.store.tables.items()}
-        stats = {}
+        indexes = {}
+        for n in self.store.tables:
+            try:
+                defs = self._table_indexes(n)
+            except Exception:
+                defs = []
+            pub = [(i.name, tuple(i.columns), i.unique)
+                   for i in defs if i.state == "public"]
+            if pub:
+                indexes[n] = pub
+        if not stats:
+            return CatalogView(schemas, dicts, {}, indexes=indexes)
+        stats_map = {}
         for n, td in self.store.tables.items():
             if td.stats is not None:
                 # stale ANALYZE output (mutations since) still informs
@@ -723,18 +798,19 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                     analyzed=td.stats_generation == td.generation)
             else:
                 st = TableStats(row_count=td.row_count)
-            stats[n] = st
+            stats_map[n] = st
         unique_fn = None
         if read_ts is not None:
             rti = read_ts.to_int()
 
             def unique_fn(t, cols, _rti=rti):
                 return self.store.keys_unique_for_read(t, cols, _rti)
-        return CatalogView(schemas, dicts, stats,
+        return CatalogView(schemas, dicts, stats_map,
                            key_distinct_fn=self.store.key_distinct,
                            int_range_fn=(self.store.key_int_range
                                          if int_ranges else None),
-                           keys_unique_fn=unique_fn)
+                           keys_unique_fn=unique_fn,
+                           indexes=indexes)
 
     def _read_ts(self, session: Session) -> Timestamp:
         return session.txn_read_ts or self.clock.now()
@@ -782,7 +858,7 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
 
     # -- SELECT --------------------------------------------------------------
     def _plan(self, stmt, session, for_explain: bool = False,
-              no_memo: bool = False):
+              no_memo: bool = False, trace=None):
         if not isinstance(stmt, ast.Select):
             raise EngineError("can only EXPLAIN SELECT")
         # AS OF pins the whole statement: now() and plan-time
@@ -808,7 +884,10 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
             use_memo=(not no_memo
                       and session.vars.get("optimizer", "on")
                       != "off"),
-            volatile_fold_ok=for_explain)
+            volatile_fold_ok=for_explain,
+            rules=(session.vars.get("optimizer_rules", "on")
+                   != "off"),
+            trace=trace)
         return planner.plan_select(stmt)
 
     # -- sequences ------------------------------------------------------------
@@ -1386,14 +1465,14 @@ class Engine(FastpathMixin, ScanPlaneMixin, DDLMixin, ConstraintMixin,
                      sql_text: str) -> Result:
         if isinstance(sel, ast.SetOp):
             return self._exec_setop(sel, session, sql_text)
-        if not getattr(sel, "_plain", False):
+        if sql_text not in self._plain_memo:
             sel2 = self._decorrelate(self._expand_views(sel))
-            if sel2 is sel:
+            if sel2 is sel and sql_text:
                 # identity result = no views, no subqueries: memoize
-                # on the (parse-cached, shared) AST so hot OLTP texts
-                # skip both walks on re-execution. DDL invalidates by
-                # clearing the parse cache (execute_stmt).
-                sel._plain = True
+                # BY TEXT so hot OLTP statements skip both walks on
+                # re-execution without annotating the shared cached
+                # AST in place. DDL invalidates with the parse cache.
+                self._plain_memo.add(sql_text)
             sel = sel2
         if sel.ctes or self._has_derived(sel):
             return self._exec_with_temps(sel, session, sql_text)

@@ -133,10 +133,15 @@ class TimestampCache:
                                          items[half - 1][1][0])
                     self._points = dict(items[half:])
                 return
-            self._spans.append((span.start, end, ts, txn_id))
+            # _spans stays sorted by ts ascending: get_max scans from
+            # the newest end and stops at the first entry at-or-below
+            # its running floor — O(1) for the hot OLTP shape where
+            # the newest scan span covers the write
+            import bisect
+            bisect.insort(self._spans, (span.start, end, ts, txn_id),
+                          key=lambda e: e[2])
             if len(self._spans) > self.SPAN_CAP:
                 # rotate: fold oldest half into the low-water mark
-                self._spans.sort(key=lambda e: e[2])
                 half = len(self._spans) // 2
                 self.low_water = max(self.low_water, self._spans[half - 1][2])
                 self._spans = self._spans[half:]
@@ -156,10 +161,12 @@ class TimestampCache:
                     if span.start <= k < end and t > hi and \
                             (exclude is None or rid != exclude):
                         hi = t
-            for s, e, t, rid in self._spans:
+            for s, e, t, rid in reversed(self._spans):
+                if t <= hi:
+                    break          # sorted by ts: nothing newer left
                 if exclude is not None and rid == exclude:
                     continue
-                if s < end and span.start < e and t > hi:
+                if s < end and span.start < e:
                     hi = t
             return hi
 

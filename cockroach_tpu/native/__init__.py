@@ -25,20 +25,24 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
+def _compile(src: str, so: str) -> bool:
     try:
-        if (os.path.exists(_SO)
-                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        if (os.path.exists(so)
+                and os.path.getmtime(so) >= os.path.getmtime(src)):
             return True
-        tmp = _SO + ".tmp"
+        tmp = so + f".tmp{os.getpid()}"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, src],
             check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
         return True
     except (OSError, subprocess.SubprocessError):
         return False
+
+
+def _build() -> bool:
+    return _compile(_SRC, _SO)
 
 
 def get_lib():
@@ -68,6 +72,54 @@ def get_lib():
         lib.keyenc_bytes.restype = ctypes.c_int64
         _lib = lib
         return _lib
+
+
+_OLTP_SRC = os.path.join(_HERE, "oltp.cpp")
+_OLTP_SO = os.path.join(_HERE, "_oltp.so")
+_oltp_lib = None
+_oltp_tried = False
+
+
+def get_oltp():
+    """The native OLTP row plane (oltp.cpp), or None (callers fall
+    back to the Python fastpath)."""
+    global _oltp_lib, _oltp_tried
+    with _lock:
+        if _oltp_tried:
+            return _oltp_lib
+        _oltp_tried = True
+        if not _compile(_OLTP_SRC, _OLTP_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_OLTP_SO)
+        except OSError:
+            return None
+        i64 = ctypes.c_int64
+        i64p = ctypes.POINTER(i64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        vp = ctypes.c_void_p
+        lib.oltp_create.argtypes = [i64]
+        lib.oltp_create.restype = vp
+        lib.oltp_destroy.argtypes = [vp]
+        lib.oltp_destroy.restype = None
+        lib.oltp_nversions.argtypes = [vp]
+        lib.oltp_nversions.restype = i64
+        lib.oltp_bulk.argtypes = [vp, i64, i64p, i64p, i64p, i64p, u8p]
+        lib.oltp_bulk.restype = None
+        lib.oltp_put.argtypes = [vp, i64, i64, i64p, u8p]
+        lib.oltp_put.restype = ctypes.c_int
+        lib.oltp_del.argtypes = [vp, i64, i64]
+        lib.oltp_del.restype = ctypes.c_int
+        lib.oltp_live.argtypes = [vp, i64, i64]
+        lib.oltp_live.restype = ctypes.c_int
+        lib.oltp_read.argtypes = [vp, i64, i64, i64p, u8p]
+        lib.oltp_read.restype = ctypes.c_int
+        lib.oltp_scan.argtypes = [vp, i64, ctypes.c_int, ctypes.c_int,
+                                  i64, ctypes.c_int, ctypes.c_int,
+                                  i64, i64, i64p, i64p, u8p]
+        lib.oltp_scan.restype = i64
+        _oltp_lib = lib
+        return _oltp_lib
 
 
 def batch_encode_int_keys(prefix: bytes, vals) -> list[bytes]:

@@ -645,35 +645,55 @@ class ColumnStore:
         values (see extract_row). Mirrors how the reference's scan
         plane only ever sees resolved, committed versions (intents are
         filtered by pebbleMVCCScanner before SQL decodes them)."""
+        self.apply_committed_batch(name, [(ops, ts.to_int())])
+
+    def apply_committed_batch(self, name: str, batches: list) -> None:
+        """Publish MANY committed txns' effects in ONE sealed chunk.
+
+        batches: [(ops, tsi)] in ascending commit-timestamp order (the
+        OLTP lane's deferred-publish queue, exec/oltplane.py). A row
+        superseded by a LATER batch still publishes — with its
+        [ts, del_ts) visibility window — so historical reads over the
+        flushed chunk see exactly what the mirror served. Batching is
+        also what keeps single-row OLTP statements from growing one
+        chunk per statement (the memtable batching of an LSM ingest)."""
         td = self.table(name)
         from ..sql.rowenc import ROWID
         with self._lock:
             idx = self.ensure_pk_index(name)
-            tsi = ts.to_int()
-            new_rows: list[tuple[bytes, dict]] = []
-            new_keys: dict[bytes, int] = {}  # key -> position in new_rows
-            for op in ops:
-                kind, key = op[0], op[1]
-                pos = idx.pop(key, None)
-                if pos is not None:
-                    ci, ri = pos
-                    td.chunks[ci].mvcc_del[ri] = tsi
-                npos = new_keys.pop(key, None)
-                if npos is not None:
-                    new_rows[npos] = (key, None)  # superseded in-txn
-                if kind == "put":
-                    row = dict(op[2])
-                    if td.codec.synthetic_pk and ROWID not in row:
-                        row[ROWID] = td.next_rowid
-                        td.next_rowid += 1
-                    new_keys[key] = len(new_rows)
-                    new_rows.append((key, row))
-            live = [(k, r) for k, r in new_rows if r is not None]
-            if live:
-                base_ci = len(td.chunks)
-                rows = [r for _, r in live]
+            # key -> position of its newest pending row in new_rows
+            new_rows: list = []  # [key, row|None, tsi, del_tsi]
+            new_keys: dict[bytes, int] = {}
+            for ops, tsi in batches:
+                for op in ops:
+                    kind, key = op[0], op[1]
+                    pos = idx.pop(key, None)
+                    if pos is not None:
+                        ci, ri = pos
+                        td.chunks[ci].mvcc_del[ri] = tsi
+                    npos = new_keys.pop(key, None)
+                    if npos is not None:
+                        if new_rows[npos][2] == tsi:
+                            # superseded within one txn: never visible
+                            new_rows[npos][1] = None
+                        else:
+                            # superseded by a later txn: close its
+                            # visibility window
+                            new_rows[npos][3] = tsi
+                    if kind == "put":
+                        row = dict(op[2])
+                        if td.codec.synthetic_pk and ROWID not in row:
+                            row[ROWID] = td.next_rowid
+                            td.next_rowid += 1
+                        new_keys[key] = len(new_rows)
+                        new_rows.append([key, row, tsi, MAX_TS_INT])
+            emit = [e for e in new_rows if e[1] is not None]
+            live = emit  # warm indexes cover all published versions
+            base_ci = len(td.chunks)
+            if emit:
+                rows = [r for _, r, _, _ in emit]
                 defaults = getattr(td, "column_defaults", {})
-                for row in rows:
+                for _key, row, tsi, _dts in emit:
                     for col in td.schema.columns:
                         td.open_rows[col.name].append(
                             row.get(col.name, defaults.get(col.name)))
@@ -681,8 +701,12 @@ class ColumnStore:
                     td.open_rowids.append(int(row.get(ROWID, 0)) or
                                           self._next_rowid_locked(td))
                 self._seal_locked(td)
-                for i, (k, _) in enumerate(live):
-                    idx[k] = (base_ci, i)
+                chunk = td.chunks[base_ci]
+                for i, (k, _row, _tsi, dts) in enumerate(emit):
+                    if dts != MAX_TS_INT:
+                        chunk.mvcc_del[i] = dts
+                    else:
+                        idx[k] = (base_ci, i)
             # keep warm secondary-index locators valid across the
             # publish instead of forcing an O(table) rebuild per DML
             # statement (the scan-plane analogue of the reference's
@@ -696,7 +720,7 @@ class ColumnStore:
                         del td.sec_index_cache[cols]
                         continue
                     if live:
-                        for i, (_k, row) in enumerate(live):
+                        for i, (_k, row, _tsi, _dts) in enumerate(live):
                             vals = tuple(row.get(cn, defaults.get(cn))
                                          for cn in cols)
                             if any(v is None for v in vals):
@@ -716,7 +740,7 @@ class ColumnStore:
                         # list (range fastpath holds it outside the
                         # lock); a published list is never mutated
                         entries = list(entries)
-                        for i, (_k, row) in enumerate(live):
+                        for i, (_k, row, _tsi, _dts) in enumerate(live):
                             vals = tuple(row.get(cn, defaults.get(cn))
                                          for cn in cols)
                             if any(v is None for v in vals):

@@ -47,7 +47,12 @@ class StatsRegistry:
 
     def record(self, sql: str, latency_s: float, rows: int,
                failed: bool = False) -> None:
-        fp = fingerprint(sql)
+        self.record_fp(fingerprint(sql), latency_s, rows, failed)
+
+    def record_fp(self, fp: str, latency_s: float, rows: int,
+                  failed: bool = False) -> None:
+        """Record against a caller-computed fingerprint (the OLTP lane
+        already normalized the literals out of its shape key)."""
         with self._mu:
             st = self._stats.get(fp)
             if st is None:

@@ -1,0 +1,282 @@
+"""OLTP fast lane (exec/oltplane.py + native/oltp.cpp): the
+statement-shape cache and native MVCC row plane must be bit-for-bit
+equivalent to the full path — same results, same errors, same
+transactional semantics — just faster.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.exec.oltplane import normalize
+from cockroach_tpu.exec.session import EngineError
+from cockroach_tpu.native import get_oltp
+
+
+pytestmark = pytest.mark.skipif(get_oltp() is None,
+                                reason="native toolchain unavailable")
+
+
+def _mk(records=100):
+    e = Engine()
+    e.execute("CREATE TABLE t (k INT8 NOT NULL PRIMARY KEY, "
+              "a INT8, b INT8)")
+    vals = ", ".join(f"({i}, {i * 3}, {i * 5})" for i in range(records))
+    e.execute(f"INSERT INTO t VALUES {vals}")
+    return e
+
+
+class TestNormalize:
+    def test_ints_and_strings(self):
+        shape, lits = normalize(
+            "SELECT a FROM t WHERE k = 42 AND s = 'x''y' LIMIT 10")
+        assert shape == "SELECT a FROM t WHERE k = ? AND s = ? LIMIT ?"
+        assert lits == [42, "x'y", 10]
+
+    def test_identifiers_with_digits_survive(self):
+        shape, lits = normalize("SELECT field0 FROM usertable "
+                                "WHERE ycsb_key = 7")
+        assert "field0" in shape and "usertable" in shape
+        assert lits == [7]
+
+    def test_floats_stay_in_shape(self):
+        shape, lits = normalize("SELECT a FROM t WHERE f = 1.5")
+        assert "1.5" in shape
+        assert lits == []
+
+
+class TestLaneReads:
+    def test_point_read_matches_full_path(self):
+        e = _mk()
+        q = "SELECT a, b FROM t WHERE k = 7"
+        first = e.execute(q).rows          # builds the shape
+        assert e._lane_shapes              # plan cached
+        again = e.execute(q).rows          # lane hit
+        assert first == again == [(21, 35)]
+        assert e.lane_hits >= 1
+
+    def test_point_read_missing_key(self):
+        e = _mk()
+        assert e.execute("SELECT a FROM t WHERE k = 10000").rows == []
+
+    def test_range_scan_ordered_limit(self):
+        e = _mk()
+        q = ("SELECT k, a FROM t WHERE k >= 10 ORDER BY k LIMIT 5")
+        assert e.execute(q).rows == [(i, i * 3) for i in range(10, 15)]
+        # different literals, same shape -> lane
+        q2 = ("SELECT k, a FROM t WHERE k >= 90 ORDER BY k LIMIT 5")
+        assert e.execute(q2).rows == [(i, i * 3) for i in range(90, 95)]
+        assert e.lane_hits >= 1
+
+    def test_range_scan_upper_bound(self):
+        e = _mk()
+        q = "SELECT k FROM t WHERE k >= 5 AND k < 8 ORDER BY k"
+        assert e.execute(q).rows == [(5,), (6,), (7,)]
+
+    def test_projection_aliases_and_star(self):
+        e = _mk()
+        assert e.execute("SELECT b AS bb, a FROM t WHERE k = 2"
+                         ).rows == [(10, 6)]
+        res = e.execute("SELECT * FROM t WHERE k = 2")
+        assert res.names == ["k", "a", "b"]
+        assert res.rows == [(2, 6, 10)]
+
+    def test_null_columns_roundtrip(self):
+        e = Engine()
+        e.execute("CREATE TABLE n (k INT PRIMARY KEY, v INT)")
+        e.execute("INSERT INTO n VALUES (1, NULL)")
+        e.execute("INSERT INTO n VALUES (2, 5)")
+        for _ in range(2):   # second pass rides the lane
+            assert e.execute("SELECT v FROM n WHERE k = 1"
+                             ).rows == [(None,)]
+            assert e.execute("SELECT v FROM n WHERE k = 2"
+                             ).rows == [(5,)]
+
+
+class TestLaneWrites:
+    def test_update_visible_everywhere(self):
+        e = _mk()
+        e.execute("UPDATE t SET a = 777 WHERE k = 3")
+        # lane read
+        assert e.execute("SELECT a FROM t WHERE k = 3").rows == [(777,)]
+        # full path (forces flush): aggregation sees the write
+        assert e.execute("SELECT sum(a) FROM t WHERE k = 3"
+                         ).rows == [(777,)]
+
+    def test_update_missing_row(self):
+        e = _mk()
+        r = e.execute("UPDATE t SET a = 1 WHERE k = 99999")
+        assert r.row_count == 0
+
+    def test_insert_then_everything_sees_it(self):
+        e = _mk(10)
+        e.execute("INSERT INTO t VALUES (500, 1, 2)")
+        assert e.execute("SELECT a, b FROM t WHERE k = 500"
+                         ).rows == [(1, 2)]
+        assert e.execute("SELECT count(*) FROM t").rows == [(11,)]
+
+    def test_duplicate_pk_rejected(self):
+        e = _mk(10)
+        e.execute("INSERT INTO t VALUES (100, 0, 0)")
+        with pytest.raises(EngineError, match="duplicate key"):
+            e.execute("INSERT INTO t VALUES (100, 0, 0)")
+
+    def test_delete_then_reinsert(self):
+        e = _mk(10)
+        e.execute("DELETE FROM t WHERE k = 5")
+        assert e.execute("SELECT a FROM t WHERE k = 5").rows == []
+        e.execute("INSERT INTO t VALUES (5, 42, 43)")
+        assert e.execute("SELECT a FROM t WHERE k = 5").rows == [(42,)]
+        assert e.execute("SELECT count(*) FROM t").rows == [(10,)]
+
+    def test_not_null_enforced(self):
+        e = Engine()
+        e.execute("CREATE TABLE nn (k INT PRIMARY KEY, "
+                  "v INT NOT NULL)")
+        e.execute("INSERT INTO nn VALUES (1, 1)")  # builds shape
+        with pytest.raises(EngineError, match="non-null"):
+            e.execute("UPDATE nn SET v = NULL WHERE k = 1")
+
+    def test_many_single_row_inserts_batch_into_few_chunks(self):
+        """Deferred publish: 200 lane inserts then one flush must not
+        produce 200 chunks (the memtable batching)."""
+        e = Engine()
+        e.execute("CREATE TABLE m (k INT PRIMARY KEY, v INT)")
+        e.execute("INSERT INTO m VALUES (0, 0)")
+        before = len(e.store.table("m").chunks)
+        for i in range(1, 201):
+            e.execute(f"INSERT INTO m VALUES ({i}, {i})")
+        assert e.execute("SELECT count(*) FROM m").rows == [(201,)]
+        after = len(e.store.table("m").chunks)
+        assert after - before <= 3
+
+
+class TestTransactionalInterplay:
+    def test_lane_bypassed_inside_txn(self):
+        """Explicit transactions take the full path: snapshot reads
+        must not see later lane writes."""
+        e = _mk(10)
+        s1 = e.session()
+        e.execute("BEGIN", s1)
+        assert e.execute("SELECT a FROM t WHERE k = 1", s1
+                         ).rows == [(3,)]
+        # another connection updates via the lane
+        e.execute("UPDATE t SET a = 999 WHERE k = 1")
+        # txn still sees its snapshot
+        assert e.execute("SELECT a FROM t WHERE k = 1", s1
+                         ).rows == [(3,)]
+        e.execute("COMMIT", s1)
+        assert e.execute("SELECT a FROM t WHERE k = 1").rows == [(999,)]
+
+    def test_as_of_reads_see_history_across_flush(self):
+        import time
+        e = _mk(10)
+        ts0 = e.clock.now().to_int()
+        time.sleep(0.01)
+        e.execute("UPDATE t SET a = 12345 WHERE k = 1")
+        assert e.execute("SELECT a FROM t WHERE k = 1"
+                         ).rows == [(12345,)]
+        got = e.execute(
+            f"SELECT a FROM t AS OF SYSTEM TIME {ts0} WHERE k = 1")
+        assert got.rows == [(3,)]
+
+    def test_write_write_conflict_last_wins(self):
+        e = _mk(10)
+        e.execute("UPDATE t SET a = 1 WHERE k = 2")
+        e.execute("UPDATE t SET a = 2 WHERE k = 2")
+        assert e.execute("SELECT a FROM t WHERE k = 2").rows == [(2,)]
+
+
+class TestDDLInvalidation:
+    def test_create_index_pushes_writes_off_lane(self):
+        e = _mk(10)
+        e.execute("UPDATE t SET a = 5 WHERE k = 1")  # lane shape built
+        e.execute("CREATE INDEX ta ON t (a)")
+        # lane plans cleared; index-maintaining path used now
+        e.execute("UPDATE t SET a = 77 WHERE k = 1")
+        assert e.execute("SELECT a FROM t WHERE k = 1").rows == [(77,)]
+        # the secondary index finds the new value
+        assert e.execute("SELECT k FROM t WHERE a = 77").rows == [(1,)]
+
+    def test_drop_and_recreate_table(self):
+        e = _mk(10)
+        e.execute("SELECT a FROM t WHERE k = 1")
+        e.execute("DROP TABLE t")
+        e.execute("CREATE TABLE t (k INT PRIMARY KEY, a INT, b INT)")
+        e.execute("INSERT INTO t VALUES (1, 111, 0)")
+        assert e.execute("SELECT a FROM t WHERE k = 1").rows == [(111,)]
+
+
+class TestConcurrentLane:
+    def test_concurrent_readers_writers_vs_oracle(self):
+        """8 threads of mixed point reads/updates/inserts; the final
+        state must match a sequential oracle of the same per-key last
+        writes."""
+        e = _mk(50)
+        errs = []
+        n_workers = 8
+
+        def work(w):
+            try:
+                for i in range(60):
+                    k = (i * 7 + w) % 50
+                    if i % 3 == 0:
+                        e.execute(f"UPDATE t SET a = {w * 1000 + i} "
+                                  f"WHERE k = {k}")
+                    elif i % 3 == 1:
+                        e.execute(f"SELECT a, b FROM t WHERE k = {k}")
+                    else:
+                        e.execute(f"SELECT k, a FROM t WHERE k >= {k} "
+                                  f"ORDER BY k LIMIT 5")
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        ts = [threading.Thread(target=work, args=(w,))
+              for w in range(n_workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        # full-path count agrees after flush
+        assert e.execute("SELECT count(*) FROM t").rows == [(50,)]
+        # every row readable both ways with equal values
+        for k in range(50):
+            lane = e.execute(f"SELECT a FROM t WHERE k = {k}").rows
+            full = e.execute(
+                f"SELECT sum(a) FROM t WHERE k = {k}").rows
+            assert lane[0][0] == full[0][0]
+
+    def test_concurrent_disjoint_inserts(self):
+        e = _mk(10)
+        errs = []
+
+        def ins(w):
+            try:
+                for i in range(40):
+                    k = 1000 + w * 1000 + i
+                    e.execute(f"INSERT INTO t VALUES ({k}, {w}, {i})")
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        ts = [threading.Thread(target=ins, args=(w,)) for w in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert e.execute("SELECT count(*) FROM t").rows == [(250,)]
+
+
+class TestMirrorRebuild:
+    def test_nonlane_write_invalidates_mirror(self):
+        """A multi-row UPDATE takes the full path and bumps the
+        generation; the next lane read must rebuild and see it."""
+        e = _mk(20)
+        assert e.execute("SELECT a FROM t WHERE k = 1").rows == [(3,)]
+        e.execute("UPDATE t SET a = a + 1000 WHERE k < 5")  # full path
+        assert e.execute("SELECT a FROM t WHERE k = 1"
+                         ).rows == [(1003,)]
+        assert e.execute("SELECT a FROM t WHERE k = 10").rows == [(30,)]
