@@ -179,7 +179,18 @@ class DistSender:
                 if lh is None:
                     last_err = NotLeaseholderError()
                     continue
-                rep = self.cluster.stores[lh].replicas[desc.range_id]
+                lh_store = self.cluster.stores.get(lh)
+                if lh_store is None:
+                    # NetCluster: only the local store is in the map —
+                    # route through the fabric stub instead of
+                    # KeyError'ing (round-4 advisor, medium)
+                    try:
+                        rep = self.cluster._leaseholder_replica(key)
+                    except (KeyError, RuntimeError) as e:
+                        last_err = e
+                        continue
+                else:
+                    rep = lh_store.replicas[desc.range_id]
             entry.leaseholder = rep.store.node_id
             return self._execute(rep, op, ts)
         raise last_err

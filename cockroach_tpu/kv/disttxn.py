@@ -396,14 +396,17 @@ class DistTxn:
 
 def read_txn_record(cluster, txn_meta: TxnMeta):
     """The full record dict from the txn's anchor range, or None.
-    Keys: status, ts (decoded), writes (staging only)."""
-    desc = cluster.range_for_key(txn_meta.key)
-    if desc is None:
+    Keys: status, ts (decoded), writes (staging only).
+
+    Routed through ``_leaseholder_replica`` (NOT ``cluster.stores``):
+    a NetCluster's stores map holds only the LOCAL node's store, so
+    indexing by a remote leaseholder id raised KeyError and every
+    cross-process intent push failed instead of resolving (round-4
+    advisor, medium)."""
+    try:
+        rep = cluster._leaseholder_replica(txn_meta.key)
+    except (KeyError, RuntimeError):
         return None
-    lh = cluster.ensure_lease(desc.range_id)
-    if lh is None:
-        return None
-    rep = cluster.stores[lh].replicas[desc.range_id]
     mv = rep.mvcc.get(_record_key(txn_meta.id),
                       MAX_TIMESTAMP, inconsistent=True)
     if mv is None:

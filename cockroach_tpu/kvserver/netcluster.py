@@ -13,14 +13,20 @@ Reference shape being rebuilt: the raft transport as a first-class RPC
 service (pkg/kv/kvserver/raft_transport.go:152,183), node bootstrap /
 join (pkg/server/node.go:303, server/init.go:517), and the DistSender
 routing loop's NotLeaseholder retry (kv/kvclient/kvcoord/
-dist_sender.go:795). Design differences, stated honestly:
+dist_sender.go:795). Liveness is LINEARIZED (round 5): every node
+proposes its ``{epoch, expiration}`` record onto the system range
+holding ``LIVENESS_KEY`` (the reference stores the same records in a
+system range, liveness.go:185), so lease validity is judged against a
+raft-replicated record, not a per-observer gossip view. A partitioned
+leaseholder cannot renew through quorum; its record expires on every
+copy — including its own — and it fails CLOSED
+(tests/test_netcluster_partition.py proves exactly one valid
+leaseholder across a split). Gossip heartbeats remain as a bring-up /
+freshness hint, and the liveness range itself stays on the gossip
+check (its renewals would otherwise need the very lease being
+validated — the reference breaks the same cycle with expiration
+leases there). Remaining design differences, stated honestly:
 
-- Liveness records gossip over the fabric instead of living in a
-  replicated system range: each node broadcasts its (epoch, heartbeat)
-  and every peer expires it locally. Epoch fencing is therefore a
-  per-observer judgment that converges via broadcast, not a linearized
-  record — the same simplification the in-process harness made for
-  time, moved to space.
 - Range descriptors propagate via generation-versioned broadcasts
   (higher generation wins) + the join snapshot, standing in for the
   meta ranges.
@@ -44,6 +50,8 @@ from ..rpc.context import SocketTransport
 from ..storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from ..storage.mvcc import TxnMeta, WriteIntentError, WriteTooOldError
 from .cluster import (AmbiguousResultError, Cluster, NotLeaseholderError)
+from .clusterversion import (BINARY_VERSION, ClusterVersion,
+                             IncompatibleVersionError, Version)
 from .liveness import NodeLiveness
 from .raft import Entry, Message, MsgType, Snapshot
 from .store import RangeDescriptor, Store, _dec_ts, _enc_ts
@@ -234,6 +242,16 @@ class NetCluster(Cluster):
     PUMP_INTERVAL = 0.005
     HEARTBEAT_EVERY = 4       # pump iterations between live broadcasts
     CALL_TIMEOUT = 15.0
+    # replicated liveness (round-5: linearized control plane): each
+    # node proposes {epoch, expiration} onto the system range holding
+    # LIVENESS_KEY instead of trusting per-observer gossip expiry
+    # (liveness.go:185 keeps the same record in a system range). A
+    # partitioned leaseholder cannot renew through quorum, so its
+    # record expires on every copy — including its own — and it FAILS
+    # CLOSED (serving checks compare the replicated record).
+    LIVENESS_KEY = b"\x00"
+    LIVE_TTL_NS = 2_000_000_000          # 2s
+    LIVE_HB_EVERY = 16                   # pump iterations (~80ms)
 
     def __init__(self, node_id: int, host: str = "127.0.0.1",
                  port: int = 0, join: dict | None = None,
@@ -266,6 +284,10 @@ class NetCluster(Cluster):
                                        thread_name_prefix=f"nc{node_id}")
         self.rpc.register(node_id, self._dispatch)
         self._join_seeds = dict(join or {})
+        self._hb_inflight = threading.Event()
+        # mixed-version gating (kvserver/clusterversion.py): `binary`
+        # overridable in tests to simulate an old/new binary
+        self.version = ClusterVersion()
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -276,6 +298,8 @@ class NetCluster(Cluster):
                   end: bytes = b"\xff") -> None:
         """First node: create the initial keyspace range with this
         node as its only replica (server/init.go bootstrap)."""
+        # a fresh cluster starts at the bootstrapping binary's version
+        self.version.active = self.version.binary
         with self._mu:
             desc = RangeDescriptor(self._next_range_id, start, end,
                                    [self.node_id])
@@ -305,10 +329,20 @@ class NetCluster(Cluster):
             try:
                 r = self.call(int(nid), "join",
                               {"node_id": self.node_id,
-                               "addr": list(self.addr)})
+                               "addr": list(self.addr),
+                               "binary_version":
+                                   str(self.version.binary)})
+            except IncompatibleVersionError:
+                raise
             except RuntimeError as e:
                 last = e
                 continue
+            # joiner-side version check: refuse clusters running
+            # features this binary does not have
+            cv = r.get("cluster_version")
+            if cv is not None:
+                self.version.check_cluster(Version.parse(cv))
+                self.version.active = Version.parse(cv)
             with self._mu:
                 for pd in r["peers"]:
                     pid, paddr = pd["id"], tuple(pd["addr"])
@@ -382,6 +416,15 @@ class NetCluster(Cluster):
                 self._next_range_id = max(self._next_range_id,
                                           msg.get("next_range_id", 0))
             return
+        if k == "cv":
+            try:
+                v = Version.parse(msg["v"])
+                if v <= self.version.binary and \
+                        v > self.version.active:
+                    self.version.active = v
+            except (ValueError, KeyError):
+                pass
+            return
         if k == "peer":
             pid, paddr = msg["id"], tuple(msg["addr"])
             if pid != self.node_id and pid not in self._peers:
@@ -439,6 +482,15 @@ class NetCluster(Cluster):
                     epoch = self.liveness.epoch_of(self.node_id)
                     self._broadcast({"k": "live", "epoch": epoch,
                                      "hlc": self.clock.now().to_int()})
+                if it % self.LIVE_HB_EVERY == 0 and \
+                        self.version.is_active(
+                            "replicated_liveness") and \
+                        not self._hb_inflight.is_set():
+                    # replicated heartbeat: proposed off-thread (the
+                    # propose blocks on raft commit, and THIS thread
+                    # must keep pumping for that commit to happen)
+                    self._hb_inflight.set()
+                    self._svc.submit(self._liveness_heartbeat)
                 self.rpc.deliver_all()
                 with self._mu:
                     self.store.handle_ready_all()
@@ -496,6 +548,8 @@ class NetCluster(Cluster):
             return AmbiguousResultError(e.get("msg", ""))
         if t == "key":
             return KeyError(e.get("msg", ""))
+        if t == "version":
+            return IncompatibleVersionError(e.get("msg", ""))
         return RuntimeError(e.get("msg", "remote error"))
 
     @staticmethod
@@ -513,6 +567,8 @@ class NetCluster(Cluster):
             return {"type": "ambiguous", "msg": str(exc)}
         if isinstance(exc, KeyError):
             return {"type": "key", "msg": str(exc)}
+        if isinstance(exc, IncompatibleVersionError):
+            return {"type": "version", "msg": str(exc)}
         return {"type": "runtime",
                 "msg": f"{type(exc).__name__}: {exc}"}
 
@@ -552,6 +608,15 @@ class NetCluster(Cluster):
 
     def _serve_join(self, args: dict):
         nid, addr = int(args["node_id"]), tuple(args["addr"])
+        # dial the joiner FIRST: the refusal below must be deliverable
+        # (a connection is not membership — the peer broadcast that
+        # admits the node into the gossip mesh only happens on accept)
+        self.rpc.connect(nid, addr)
+        bv = args.get("binary_version")
+        if bv is not None:
+            # seed-side admission: binaries older than the minimum
+            # supported version cannot apply this cluster's commands
+            self.version.check_join(Version.parse(bv))
         with self._mu:
             self.rpc.connect(nid, addr)
             self._peers[nid] = addr
@@ -565,7 +630,8 @@ class NetCluster(Cluster):
             nri = self._next_range_id
         self._broadcast({"k": "peer", "id": nid, "addr": list(addr),
                          "hlc": self.clock.now().to_int()})
-        return {"peers": peers, "descs": descs, "next_range_id": nri}
+        return {"peers": peers, "descs": descs, "next_range_id": nri,
+                "cluster_version": str(self.version.active)}
 
     def _serve_propose(self, args: dict):
         rid = args["range_id"]
@@ -576,7 +642,7 @@ class NetCluster(Cluster):
         if rep is None:
             raise NotLeaseholderError(
                 rid, desc.replicas[0] if desc else None)
-        if not rep.holds_lease():
+        if not self._lease_valid(rep):
             lh = self._try_local_lease(rid)
             if lh != self.node_id:
                 raise NotLeaseholderError(rid, lh or rep.lease.holder)
@@ -586,7 +652,7 @@ class NetCluster(Cluster):
         rid = args["range_id"]
         with self._mu:
             rep = self.store.replicas.get(rid)
-        if rep is None or not rep.holds_lease():
+        if rep is None or not self._lease_valid(rep):
             hint = rep.lease.holder if rep is not None else None
             raise NotLeaseholderError(rid, hint)
         txn = (TxnMeta.from_json(args["txn"].encode())
@@ -635,14 +701,97 @@ class NetCluster(Cluster):
                     exclude_txn=args.get("exclude_txn"))
         raise RuntimeError(f"unknown read op {op!r}")
 
+    def finalize_version(self, v: "Version" = None) -> None:
+        """Ratchet the cluster's active version and broadcast it (the
+        SET CLUSTER SETTING version finalization; pkg/upgrade runs
+        migrations here — our feature gates flip behavior instead)."""
+        v = v or self.version.binary
+        self.version.activate(v)
+        self._broadcast({"k": "cv", "v": str(v),
+                         "hlc": self.clock.now().to_int()})
+
+    # -- replicated liveness ------------------------------------------
+    def _liveness_heartbeat(self) -> None:
+        """Propose this node's {epoch, expiration} onto the system
+        range (runs on the service executor; see pump loop)."""
+        try:
+            now = self.clock.now().to_int()
+            with self._mu:
+                cur = self.store.repl_liveness.get(self.node_id)
+            if cur is None:
+                ep = max(1, self.liveness.epoch_of(self.node_id))
+            elif cur[1] < now:
+                # our record lapsed (partition/stall): rejoin at a NEW
+                # epoch so leases taken under the old one stay fenced
+                ep = cur[0] + 1
+            else:
+                ep = cur[0]
+            self._propose_liveness({"kind": "live_hb",
+                                    "node": self.node_id, "epoch": ep,
+                                    "exp": now + self.LIVE_TTL_NS})
+        except Exception:
+            pass                 # retried on the next beat
+        finally:
+            self._hb_inflight.clear()
+
+    def _propose_liveness(self, cmd: dict):
+        desc = None
+        with self._mu:
+            for d in self.descriptors.values():
+                if d.start_key <= self.LIVENESS_KEY < d.end_key:
+                    desc = d
+                    break
+        if desc is None:
+            return None
+        with self._mu:
+            rep = self.store.replicas.get(desc.range_id)
+        # the gossip-level lease check on purpose: a live_hb proposal
+        # must not require a replicated-liveness-valid lease (that is
+        # the record it renews — the reference breaks the same cycle
+        # by keeping the liveness range itself on expiration leases)
+        if rep is not None and rep.holds_lease():
+            return self._local_propose(rep, cmd, timeout=3.0)
+        return self._route_propose(desc, dict(cmd), timeout=1.0)
+
+    def _holder_live(self, holder: int, lease_epoch: int) -> bool:
+        """Is `holder`'s lease at `lease_epoch` backed by a current
+        liveness record? The REPLICATED record is authoritative once
+        present; gossip covers bring-up."""
+        rec = self.store.repl_liveness.get(holder)
+        if rec is not None:
+            ep, exp = rec
+            return ep == lease_epoch and \
+                exp >= self.clock.now().to_int()
+        return self.liveness.is_live(holder) and \
+            self.liveness.epoch_of(holder) == lease_epoch
+
+    def _lease_valid(self, rep) -> bool:
+        """Serving-side check: beyond holds_lease()'s gossip view, the
+        holder's replicated record must be unexpired at this node's
+        clock — a partitioned ex-leaseholder cannot renew it through
+        quorum, so it fails closed here after the TTL. The range
+        holding the liveness records themselves is exempt (renewals
+        ride it; the reference keeps that range on expiration leases
+        for the same circularity)."""
+        if not rep.holds_lease():
+            return False
+        d = rep.desc
+        if d.start_key <= self.LIVENESS_KEY < d.end_key:
+            return True
+        rec = self.store.repl_liveness.get(self.node_id)
+        if rec is None:
+            return True          # replicated plane not active yet
+        ep, exp = rec
+        return ep == rep.lease.epoch and \
+            exp >= self.clock.now().to_int()
+
     # -- lease + routing ---------------------------------------------------
     def leaseholder(self, range_id: int) -> Optional[int]:
         with self._mu:
             rep = self.store.replicas.get(range_id)
             if rep is not None and rep.lease.holder:
                 h = rep.lease.holder
-                if self.liveness.is_live(h) and \
-                        self.liveness.epoch_of(h) == rep.lease.epoch:
+                if self._holder_live(h, rep.lease.epoch):
                     return h
                 return None
         return self._lease_cache.get(range_id)
@@ -654,14 +803,13 @@ class NetCluster(Cluster):
             rep = self.store.replicas.get(range_id)
         if rep is None:
             return None
-        if rep.holds_lease():
+        if self._lease_valid(rep):
             return self.node_id
         with self._mu:
             holder = rep.lease.holder
             holder_ok = (holder and holder != self.node_id
-                         and self.liveness.is_live(holder)
-                         and self.liveness.epoch_of(holder)
-                         == rep.lease.epoch)
+                         and self._holder_live(holder,
+                                               rep.lease.epoch))
         if holder_ok:
             return holder
         if self.acquire_lease(range_id, self.node_id, max_iter=300):
@@ -680,12 +828,25 @@ class NetCluster(Cluster):
             "NetCluster acquires leases only for its own store"
         with self._mu:
             rep = self.store.replicas.get(range_id)
+            rec = self.store.repl_liveness.get(node_id)
         if rep is None:
             return False
+        is_live_range = (rep.desc.start_key <= self.LIVENESS_KEY
+                         < rep.desc.end_key)
+        if not is_live_range and rec is not None \
+                and rec[1] < self.clock.now().to_int():
+            # our replicated record lapsed: a lease under the stale
+            # epoch would be born fenced — renew (and epoch-bump)
+            # first, synchronously. (Not for the liveness range
+            # itself: the renewal NEEDS that lease.)
+            self._liveness_heartbeat()
+            with self._mu:
+                rec = self.store.repl_liveness.get(node_id)
+        epoch = rec[0] if rec is not None \
+            else self.liveness.epoch_of(node_id)
         try:
             self._local_propose(rep, {
-                "kind": "lease", "holder": node_id,
-                "epoch": self.liveness.epoch_of(node_id)},
+                "kind": "lease", "holder": node_id, "epoch": epoch},
                 timeout=max(max_iter * self.PUMP_INTERVAL, 3.0))
         except (RuntimeError, AmbiguousResultError):
             return False
@@ -754,7 +915,8 @@ class NetCluster(Cluster):
                 "proposal handed to raft but not observed to commit")
         raise RuntimeError("proposal did not commit (quorum lost?)")
 
-    def _route_propose(self, desc, cmd: dict, first: int = None):
+    def _route_propose(self, desc, cmd: dict, first: int = None,
+                       timeout: float = None):
         """DistSender's NotLeaseholder retry loop over the fabric.
 
         The dedup id is assigned CLIENT-side before the first ship:
@@ -788,7 +950,8 @@ class NetCluster(Cluster):
                 continue
             try:
                 r = self.call(nid, "propose",
-                              {"range_id": desc.range_id, "cmd": cmd})
+                              {"range_id": desc.range_id, "cmd": cmd},
+                              timeout=timeout)
                 self._lease_cache[desc.range_id] = nid
                 return r
             except NotLeaseholderError as e:

@@ -342,6 +342,39 @@ class Replica:
             self.lease = Lease(cmd["holder"], cmd["epoch"],
                                self.lease.sequence + 1)
             return self.lease
+        if kind == "live_hb":
+            # heartbeat of the replicated liveness record: epochs only
+            # ratchet forward, expirations only extend (deterministic:
+            # a pure function of cmd + current record)
+            node, ep, exp = cmd["node"], cmd["epoch"], cmd["exp"]
+            cur = self.store.repl_liveness.get(node)
+            if cur is None or ep > cur[0]:
+                self.store.repl_liveness[node] = (ep, exp)
+            elif ep == cur[0] and exp > cur[1]:
+                self.store.repl_liveness[node] = (ep, exp)
+            # mirror the authoritative epoch into the gossip-plane
+            # view so Replica.holds_lease (which compares the local
+            # NodeLiveness epoch) agrees with leases taken under the
+            # replicated record
+            lv = self.store.liveness
+            if lv is not None:
+                rec = lv.records.get(node)
+                if rec is None:
+                    rec = lv.heartbeat(node)
+                if rec.epoch < self.store.repl_liveness[node][0]:
+                    rec.epoch = self.store.repl_liveness[node][0]
+            return self.store.repl_liveness[node]
+        if kind == "live_bump":
+            # IncrementEpoch: CPut semantics — fence a node's leases
+            # iff its record still has the expected epoch AND had
+            # already expired at the proposer's observed now
+            node, expect = cmd["node"], cmd["expect_epoch"]
+            cur = self.store.repl_liveness.get(node)
+            if cur is None or cur[0] != expect or cur[1] >= cmd["now"]:
+                return {"ok": False,
+                        "epoch": cur[0] if cur else 0}
+            self.store.repl_liveness[node] = (cur[0] + 1, cur[1])
+            return {"ok": True, "epoch": cur[0] + 1}
         if kind == "split":
             return self._apply_split(cmd)
         if kind == "merge":
@@ -602,6 +635,12 @@ class Store:
         # how far behind now the leaseholder closes (the reference's
         # kv.closed_timestamp.target_duration, default 3s)
         self.closedts_target_ns = closedts_target_ns
+        # replicated liveness records: node_id -> (epoch, exp_hlc_int),
+        # written ONLY by raft apply of live_hb/live_bump commands on
+        # the system range (netcluster's linearized liveness plane;
+        # liveness.go:185 stores the same records in a system range).
+        # Empty on clusters that keep the gossip/tick NodeLiveness.
+        self.repl_liveness: dict[int, tuple[int, int]] = {}
         self.replicas: dict[int, Replica] = {}
         self._seed = seed
         transport.register(node_id, self._handle_raft_message)

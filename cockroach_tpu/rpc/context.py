@@ -89,6 +89,11 @@ class SocketTransport:
         self._peers: dict[int, tuple[str, int]] = {}
         self._conns: dict[int, socket.socket] = {}
         self._clock = threading.Lock()
+        # fault injection: peers this node is partitioned from —
+        # frames to AND from them are dropped (the SocketTransport
+        # face of LocalTransport.partition; netcluster partition
+        # tests use it to split real fabrics)
+        self._parted: set[int] = set()
         self.sent = 0
         self.delivered = 0
         outer = self
@@ -134,8 +139,19 @@ class SocketTransport:
     def register(self, node_id: int, handler: Callable) -> None:
         self._handlers[node_id] = handler
 
+    def partition(self, *peers: int) -> None:
+        self._parted.update(peers)
+
+    def heal(self, *peers: int) -> None:
+        if peers:
+            self._parted.difference_update(peers)
+        else:
+            self._parted.clear()
+
     def send(self, frm: int, to: int, msg) -> None:
         self.sent += 1
+        if to in self._parted:
+            return                     # partitioned: dropped
         if to in self._handlers:       # local delivery
             with self._qlock:
                 self._queue.append((frm, to, msg))
@@ -162,6 +178,8 @@ class SocketTransport:
             self._queue.clear()
         n = 0
         for frm, to, msg in batch:
+            if frm in self._parted:
+                continue               # partitioned: dropped
             h = self._handlers.get(to)
             if h is not None:
                 h(frm, msg)

@@ -408,3 +408,77 @@ class TestStagingPushGuard:
         assert reader.get(b"apple") == b"1"
         rec = read_txn_record(c, t._meta())
         assert rec is not None and rec["status"] == "committed"
+
+
+class TestCrossGatewayTxnPush:
+    """Round-4 advisor (high + medium): a gateway pushing an UNKNOWN
+    foreign txn id must consult the REPLICATED anchor-range record —
+    never map a live txn to ABORTED — and the record read must route
+    over the fabric (NetCluster's stores map holds only the local
+    store; indexing a remote leaseholder id raised KeyError)."""
+
+    def _two_netclusters(self):
+        import time
+
+        from cockroach_tpu.kvserver.netcluster import NetCluster
+        n1 = NetCluster(1)
+        n1.bootstrap()
+        n2 = NetCluster(2, join={1: n1.addr})
+        n2.join()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            n1.replicate_queue_scan()
+            if sorted(n1.descriptors[1].replicas)[:2] == [1, 2]:
+                break
+            time.sleep(0.05)
+        return n1, n2
+
+    def test_live_foreign_txn_not_aborted(self):
+        from cockroach_tpu.kv.concurrency import (TxnRetryError,
+                                                  TxnStatus)
+        from cockroach_tpu.kv.rangekv import ClusterKVStore
+        from cockroach_tpu.kv.txn import Txn
+        n1, n2 = self._two_netclusters()
+        try:
+            store_a = ClusterKVStore(n1)
+            store_b = ClusterKVStore(n2)
+            ta = Txn(store_a)
+            ta.put(b"\x01conflict", b"va")      # live intent, no record
+            tb = Txn(store_b)
+            # the push must see PENDING (recent foreign intent), not
+            # silently abort the live txn
+            rec = store_b.txns.push(ta.meta, push_abort=True)
+            assert rec.status == TxnStatus.PENDING
+            with pytest.raises(TxnRetryError):
+                tb.put(b"\x01conflict", b"vb")
+            tb.rollback()
+            # the live txn commits untouched
+            ta.commit()
+            tc = Txn(store_b)
+            assert tc.get(b"\x01conflict") == b"va"
+            tc.commit()
+        finally:
+            n1.stop()
+            n2.stop()
+
+    def test_committed_foreign_record_honored(self):
+        """A staging/committed replicated record finalizes the push
+        via the recovery protocol instead of guessing."""
+        from cockroach_tpu.kv.concurrency import TxnStatus
+        from cockroach_tpu.kv.disttxn import propose_txn_record
+        from cockroach_tpu.kv.rangekv import ClusterKVStore
+        from cockroach_tpu.kv.txn import Txn
+        n1, n2 = self._two_netclusters()
+        try:
+            store_a = ClusterKVStore(n1)
+            store_b = ClusterKVStore(n2)
+            ta = Txn(store_a)
+            ta.put(b"\x01rec", b"va")
+            res = propose_txn_record(n1, b"\x01rec", ta.meta.id,
+                                     "committed", n1.clock.now())
+            assert res["ok"]
+            rec = store_b.txns.push(ta.meta, push_abort=True)
+            assert rec.status == TxnStatus.COMMITTED
+        finally:
+            n1.stop()
+            n2.stop()
