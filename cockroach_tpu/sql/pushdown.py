@@ -66,8 +66,10 @@ def _rebuild(e, f):
     return dataclasses.replace(e, **changes) if changes else e
 
 
-def push_build_exprs(root: plan.PlanNode) -> None:
-    """In-place pass over a plan spine (see module doc)."""
+def push_build_exprs(root: plan.PlanNode) -> list:
+    """In-place pass over a plan spine (see module doc). Returns the
+    names of the pushed computed columns (rule-trace fodder,
+    sql/rules.py)."""
     joins: list = []
 
     def collect(n):
@@ -88,7 +90,7 @@ def push_build_exprs(root: plan.PlanNode) -> None:
 
     collect(root)
     if not joins:
-        return
+        return []
     by_alias = {}
     for j in joins:
         cols = set(j.payload) | set(j.right.columns) | \
@@ -159,9 +161,9 @@ def push_build_exprs(root: plan.PlanNode) -> None:
 
     apply(root)
     if not created:
-        return
+        return []
     if has_window:
-        return   # window specs not rewritten: keep payloads untouched
+        return []  # window specs not rewritten: keep payloads untouched
 
     # drop payload columns no STRICT ancestor references anymore
     # (their probe gathers disappear with them). A join's own keys
@@ -203,3 +205,4 @@ def push_build_exprs(root: plan.PlanNode) -> None:
             n.pack_payload = [p for p in n.pack_payload
                               if p in n.payload]
         above |= node_refs(n)
+    return sorted(created.values())

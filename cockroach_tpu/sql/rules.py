@@ -1,0 +1,322 @@
+"""Normalization rule plane: match/apply rewrites with a trace.
+
+The analogue of the reference's optgen-generated normalization rules
+(pkg/sql/opt/norm/rules/*.opt, applied by the norm factory during
+memo construction) — asked for in rounds 3 AND 4. The frame:
+
+- a ``Rule`` matches one plan-node shape and returns a replacement
+  (or None); the engine runs all rules bottom-up to a fixpoint;
+- ``GlobalRule`` hosts the whole-tree passes that already earned
+  their keep (build-side expression pushdown, scan column pruning)
+  so every rewrite — local or global — lands in ONE trace;
+- every firing is recorded as (rule, detail) and surfaced by
+  EXPLAIN (``rules: ...`` lines), the way the reference's
+  opttester shows norm rule applications.
+
+Constant folding happens at BIND time (builtins._fold and the
+binder's arithmetic folds — the reference folds in norm the same
+way); the binder counts its folds and the planner reports them into
+this trace so the whole normalization story reads in one place.
+Decorrelation likewise runs at the AST layer (sql/decorrelate.py)
+and reports its firings here via the engine.
+
+Exploration (join orders, index-aware scan costs) stays in
+sql/memo.py — the reference splits norm/xform the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import plan as P
+from .bound import BBin, BConst
+from .types import BOOL
+
+
+@dataclass
+class Firing:
+    rule: str
+    detail: str
+
+
+@dataclass
+class RuleTrace:
+    firings: list = field(default_factory=list)
+
+    def fire(self, rule: str, detail: str = "") -> None:
+        self.firings.append(Firing(rule, detail))
+
+    def summary(self) -> list[str]:
+        """One line per rule: 'rule ×N (first detail)'."""
+        by: dict[str, list] = {}
+        for f in self.firings:
+            by.setdefault(f.rule, []).append(f.detail)
+        out = []
+        for rule, details in by.items():
+            d = next((x for x in details if x), "")
+            n = f" ×{len(details)}" if len(details) > 1 else ""
+            out.append(f"{rule}{n}" + (f" ({d})" if d else ""))
+        return out
+
+
+class Rule:
+    """One local rewrite: apply(node) -> replacement | None."""
+
+    name = "?"
+
+    def apply(self, node: P.PlanNode, trace: RuleTrace):
+        raise NotImplementedError
+
+
+class MergeFilters(Rule):
+    """Filter(Filter(x, p1), p2) => Filter(x, p1 AND p2) — one
+    selection-mask pass instead of two (the reference's
+    MergeSelects)."""
+
+    name = "merge_filters"
+
+    def apply(self, node, trace):
+        if isinstance(node, P.Filter) and \
+                isinstance(node.child, P.Filter):
+            inner = node.child
+            trace.fire(self.name)
+            return P.Filter(inner.child,
+                            BBin("and", inner.pred, node.pred, BOOL))
+        return None
+
+
+class DropTrueFilter(Rule):
+    """Filter(x, TRUE) => x (EliminateSelect)."""
+
+    name = "drop_true_filter"
+
+    def apply(self, node, trace):
+        if isinstance(node, P.Filter) and \
+                isinstance(node.pred, BConst) and \
+                node.pred.value is True:
+            trace.fire(self.name)
+            return node.child
+        return None
+
+
+class PushFilterIntoScan(Rule):
+    """Filter(Scan) => Scan[filter AND pred] — the selection fuses
+    into the MVCC visibility mask instead of running as a separate
+    batch pass (PushSelectIntoScan; on TPU this keeps the whole
+    predicate inside the one fused scan kernel)."""
+
+    name = "push_filter_into_scan"
+
+    def apply(self, node, trace):
+        if isinstance(node, P.Filter) and \
+                isinstance(node.child, P.Scan):
+            sc = node.child
+            trace.fire(self.name, sc.alias)
+            merged = node.pred if sc.filter is None else \
+                BBin("and", sc.filter, node.pred, BOOL)
+            return P.Scan(sc.table, sc.alias, dict(sc.columns),
+                          merged, list(sc.computed), sc.narrowed)
+        return None
+
+
+class CollapseProjects(Rule):
+    """Project(Project(x)) => Project(x) with inner expressions
+    substituted into the outer items (MergeProjects). Outer items
+    that are plain column refs of inner items inline fully; anything
+    else substitutes per-reference."""
+
+    name = "collapse_projects"
+
+    def apply(self, node, trace):
+        if not (isinstance(node, P.Project)
+                and isinstance(node.child, P.Project)):
+            return None
+        from .bound import BCol
+        inner = {n: e for n, e in node.child.items}
+
+        def subst(e):
+            import copy
+
+            from .bound import (BBetween, BCase, BCast, BCoalesce,
+                                BDictGather, BDictLookup, BDictRemap,
+                                BExtract, BFunc, BInList, BIsNull,
+                                BUnary)
+            if e is None:
+                return None
+            if isinstance(e, BCol):
+                return inner.get(e.name, e)
+            e2 = copy.copy(e)
+            if isinstance(e2, BBin):
+                e2.left = subst(e2.left)
+                e2.right = subst(e2.right)
+            elif isinstance(e2, BUnary):
+                e2.operand = subst(e2.operand)
+            elif isinstance(e2, BBetween):
+                e2.expr = subst(e2.expr)
+                e2.lo = subst(e2.lo)
+                e2.hi = subst(e2.hi)
+            elif isinstance(e2, (BInList, BIsNull, BDictLookup,
+                                 BDictRemap, BDictGather, BCast,
+                                 BExtract)):
+                e2.expr = subst(e2.expr)
+            elif isinstance(e2, (BFunc, BCoalesce)):
+                e2.args = [subst(a) for a in e2.args]
+            elif isinstance(e2, BCase):
+                e2.whens = [(subst(c), subst(v)) for c, v in e2.whens]
+                if e2.else_ is not None:
+                    e2.else_ = subst(e2.else_)
+            return e2
+
+        # aggregate/window refs cannot cross a project boundary here
+        from .bound import BAggRef, BWinRef, walk
+        for _, e in node.items:
+            for x in walk(e):
+                if isinstance(x, (BAggRef, BWinRef)):
+                    return None
+        trace.fire(self.name)
+        return P.Project(node.child.child,
+                         [(n, subst(e)) for n, e in node.items])
+
+
+def _split_disjuncts(e):
+    if isinstance(e, BBin) and e.op == "or":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _split_conjuncts(e):
+    if isinstance(e, BBin) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _or_all(parts):
+    out = parts[0]
+    for p in parts[1:]:
+        out = BBin("or", out, p, BOOL)
+    return out
+
+
+def _and_all(parts):
+    out = parts[0]
+    for p in parts[1:]:
+        out = BBin("and", out, p, BOOL)
+    return out
+
+
+class DeriveOrSideFilters(Rule):
+    """A disjunction of conjunctions above a join implies a per-table
+    filter: ``(S1∧R1) ∨ (S2∧R2) ⇒ (S1∨S2)`` on the table S's
+    conjuncts reference — sound whenever every branch contributes a
+    conjunct for that table. TPC-H q19's three-way OR of
+    brand/container/quantity groups is the canonical case: the
+    derived part-side OR prunes the build before the join and the
+    derived lineitem-side quantity OR shrinks the probe, instead of
+    evaluating the whole disjunction at post-join width (the
+    reference derives the same constraints in
+    opt/idxconstraint + norm's SimplifySelectFilters).
+
+    Inner joins only: under an outer join a pushed build filter
+    null-extends rows whose actual values an IS NULL branch would
+    then misjudge."""
+
+    name = "derive_or_side_filters"
+
+    def apply(self, node, trace):
+        if not isinstance(node, P.Filter) or \
+                getattr(node, "_or_derived", False):
+            return None
+        if not isinstance(node.child, P.HashJoin):
+            return None
+        # all joins in the subtree must be inner, and scans are
+        # collected by alias
+        scans: dict[str, P.Scan] = {}
+        ok = [True]
+
+        def rec(n):
+            if isinstance(n, P.Scan):
+                scans[n.alias] = n
+            elif isinstance(n, P.HashJoin):
+                if n.join_type != "inner":
+                    ok[0] = False
+                rec(n.left)
+                rec(n.right)
+            elif getattr(n, "child", None) is not None:
+                rec(n.child)
+        rec(node.child)
+        if not ok[0] or not scans:
+            return None
+        branches = _split_disjuncts(node.pred)
+        if len(branches) < 2:
+            return None
+        from .bound import referenced_columns
+
+        def alias_of(name):
+            return name.split(".", 1)[0] if "." in name else None
+
+        fired = False
+        for alias, sc in scans.items():
+            per_branch = []
+            for b in branches:
+                mine = [c for c in _split_conjuncts(b)
+                        if referenced_columns(c)
+                        and {alias_of(r)
+                             for r in referenced_columns(c)}
+                        == {alias}]
+                if not mine:
+                    per_branch = None
+                    break
+                per_branch.append(_and_all(mine))
+            if not per_branch:
+                continue
+            derived = _or_all(per_branch)
+            sc.filter = derived if sc.filter is None else \
+                BBin("and", sc.filter, derived, BOOL)
+            trace.fire(self.name, alias)
+            fired = True
+        if not fired:
+            return None
+        node._or_derived = True
+        return node
+
+
+LOCAL_RULES = [MergeFilters(), DropTrueFilter(), PushFilterIntoScan(),
+               CollapseProjects(), DeriveOrSideFilters()]
+
+
+def _children(n):
+    if isinstance(n, P.HashJoin):
+        return [("left", n.left), ("right", n.right)]
+    c = getattr(n, "child", None)
+    return [("child", c)] if c is not None else []
+
+
+def normalize(root: P.PlanNode, trace: RuleTrace,
+              max_passes: int = 8) -> P.PlanNode:
+    """Bottom-up fixpoint over LOCAL_RULES, then the global passes
+    (build-expression pushdown, column pruning) with their rewrites
+    recorded in the same trace."""
+
+    def rec(n):
+        for attr, c in _children(n):
+            setattr(n, attr, rec(c))
+        for rule in LOCAL_RULES:
+            r = rule.apply(n, trace)
+            if r is not None:
+                return rec(r)
+        return n
+
+    for _ in range(max_passes):
+        before = len(trace.firings)
+        root = rec(root)
+        if len(trace.firings) == before:
+            break
+
+    from .pushdown import push_build_exprs
+    pushed = push_build_exprs(root)
+    for name in pushed or []:
+        trace.fire("push_build_expr", name)
+    dropped = P.prune_scan_columns_traced(root)
+    for alias, ncols in dropped:
+        trace.fire("prune_columns", f"{alias}: -{ncols}")
+    return root
