@@ -1,0 +1,123 @@
+"""SCRAM-SHA-256 + binary result encoding + the vendored driver
+(round-3/4 ask #6): the MiniClient (cockroach_tpu/server/miniclient.py,
+an independent client of the public v3 protocol) connects over TLS
+with SCRAM, runs parameterized DML, and decodes BINARY result
+formats."""
+
+import pytest
+
+from cockroach_tpu.cli import main as cli_main
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.server.miniclient import MiniClient, PgError
+from cockroach_tpu.server.pgwire import PgServer, scram_verifier
+
+
+@pytest.fixture(scope="module")
+def certs_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("certs"))
+    assert cli_main(["cert", "--certs-dir", d,
+                     "--host", "127.0.0.1"]) == 0
+    return d
+
+
+@pytest.fixture()
+def scram_server(certs_dir):
+    e = Engine()
+    e.execute("CREATE TABLE t (k INT PRIMARY KEY, v INT, s STRING, "
+              "f FLOAT, b BOOL)")
+    srv = PgServer(e, auth={"root": "hunter2", "alice": "wonder"},
+                   auth_method="scram-sha-256", certs_dir=certs_dir)
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+class TestScramAuth:
+    def test_scram_over_tls_roundtrip(self, scram_server):
+        c = MiniClient(*scram_server.addr, user="root",
+                       password="hunter2", tls=True)
+        try:
+            names, rows, tag = c.query(
+                "INSERT INTO t VALUES (1, 10, 'x', 1.5, true)")
+            assert tag.startswith("INSERT")
+            names, rows, _ = c.query("SELECT k, v, s FROM t")
+            assert names == ["k", "v", "s"]
+            assert rows == [(1, 10, "x")]
+        finally:
+            c.close()
+
+    def test_scram_plain_tcp(self, scram_server):
+        c = MiniClient(*scram_server.addr, user="alice",
+                       password="wonder")
+        try:
+            assert c.query("SELECT 1 + 1 AS two")[1] == [(2,)]
+        finally:
+            c.close()
+
+    def test_wrong_password_rejected(self, scram_server):
+        with pytest.raises(PgError) as ei:
+            MiniClient(*scram_server.addr, user="root",
+                       password="wrong")
+        assert ei.value.sqlstate == "28P01"
+
+    def test_unknown_user_rejected_without_enumeration(
+            self, scram_server):
+        """An unknown user runs the full exchange (no early error
+        that leaks existence) and fails with the same 28P01."""
+        with pytest.raises(PgError) as ei:
+            MiniClient(*scram_server.addr, user="mallory",
+                       password="whatever")
+        assert ei.value.sqlstate == "28P01"
+
+    def test_server_signature_verified(self, scram_server):
+        """The client checks v= (mutual auth): a successful login
+        implies the server proved knowledge of the verifier."""
+        c = MiniClient(*scram_server.addr, user="root",
+                       password="hunter2")
+        c.close()
+
+    def test_verifier_is_not_the_password(self):
+        v = scram_verifier("sekrit")
+        blob = b"".join([v["salt"], v["stored_key"], v["server_key"]])
+        assert b"sekrit" not in blob
+
+
+class TestBinaryResults:
+    def test_binary_int_float_bool_text(self, scram_server):
+        c = MiniClient(*scram_server.addr, user="root",
+                       password="hunter2", tls=True)
+        try:
+            c.query("INSERT INTO t VALUES (2, -7, 'bin''ary', "
+                    "2.25, false)")
+            names, rows, _ = c.query_binary(
+                "SELECT k, v, s, f, b FROM t WHERE k = $1", [2])
+            assert names == ["k", "v", "s", "f", "b"]
+            assert rows == [(2, -7, "bin'ary", 2.25, False)]
+        finally:
+            c.close()
+
+    def test_binary_null_and_aggregate(self, scram_server):
+        c = MiniClient(*scram_server.addr, user="root",
+                       password="hunter2")
+        try:
+            c.query("INSERT INTO t (k) VALUES (3)")
+            _, rows, _ = c.query_binary(
+                "SELECT v, count(*) FROM t WHERE k = $1 GROUP BY v",
+                [3])
+            assert rows == [(None, 1)]
+        finally:
+            c.close()
+
+    def test_text_format_unchanged(self, scram_server):
+        """Result format 0 still round-trips (regression guard for
+        the format-code plumbing)."""
+        c = MiniClient(*scram_server.addr, user="root",
+                       password="hunter2")
+        try:
+            c.query("INSERT INTO t VALUES (4, 44, 'tx', 0.5, true)")
+            _, rows, _ = c.query("SELECT v, s, b FROM t WHERE k = 4")
+            assert rows == [(44, "tx", True)]
+        finally:
+            c.close()
