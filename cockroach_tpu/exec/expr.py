@@ -302,6 +302,15 @@ _UNARY_KERNELS = {
     "degrees": jnp.degrees, "radians": jnp.radians,
     "floor": jnp.floor, "ceil": jnp.ceil, "ceiling": jnp.ceil,
     "trunc": jnp.trunc, "sign": jnp.sign,
+    "erf": jax.scipy.special.erf,
+    "erfc": jax.scipy.special.erfc,
+    "sind": lambda x: jnp.sin(jnp.radians(x)),
+    "cosd": lambda x: jnp.cos(jnp.radians(x)),
+    "tand": lambda x: jnp.tan(jnp.radians(x)),
+    "cotd": lambda x: 1.0 / jnp.tan(jnp.radians(x)),
+    "asind": lambda x: jnp.degrees(jnp.arcsin(x)),
+    "acosd": lambda x: jnp.degrees(jnp.arccos(x)),
+    "atand": lambda x: jnp.degrees(jnp.arctan(x)),
 }
 
 _BINARY_KERNELS = {
@@ -374,21 +383,29 @@ def _compile_func(e: BFunc) -> CompiledExpr:
             eq = jnp.logical_and(a == b, jnp.logical_and(va, vb))
             return a, jnp.logical_and(va, jnp.logical_not(eq))
         return f_nullif
+    if name == "isfinite":
+        def f_isfinite(ctx):
+            d, v = fs[0](ctx)
+            return jnp.isfinite(d), v
+        return f_isfinite
+    if name == "width_bucket":
+        def f_wb(ctx):
+            (x, vx), (lo, vl), (hi, vh), (n, vn) = [f(ctx)
+                                                    for f in fs]
+            nb = n.astype(jnp.int64)
+            frac = (x - lo) / jnp.where(hi != lo, hi - lo, 1.0)
+            inner = jnp.floor(frac * nb).astype(jnp.int64) + 1
+            d = jnp.where(x < lo, 0,
+                          jnp.where(x >= hi, nb + 1, inner))
+            ok = jnp.logical_and(jnp.logical_and(vx, vl),
+                                 jnp.logical_and(vh, vn))
+            return d, jnp.logical_and(ok, hi != lo)
+        return f_wb
     if name == "isnan":
         def f_isnan(ctx):
             d, v = fs[0](ctx)
             return jnp.isnan(d), v
         return f_isnan
-    if name == "width_bucket":
-        n = e.args[3].value
-
-        def f_wb(ctx):
-            (x, vx), (lo, vl), (hi, vh) = (f(ctx) for f in fs[:3])
-            frac = (x - lo) / (hi - lo)
-            b = jnp.floor(frac * n).astype(jnp.int64) + 1
-            b = jnp.where(x < lo, 0, jnp.where(x >= hi, n + 1, b))
-            return b, jnp.logical_and(vx, jnp.logical_and(vl, vh))
-        return f_wb
     if name in ("date_trunc_date", "date_trunc_ts"):
         part = e.args[0].value
         kern = (K.date_trunc_days if name == "date_trunc_date"

@@ -34,6 +34,23 @@ class BuiltinError(Exception):
     pass
 
 
+
+
+# no-arg informational builtins: name -> (value, type). Session
+# identity stays static (single-tenant engine); the point is driver/
+# ORM compatibility (pg_catalog-adjacent probes).
+_INFO_FNS = {
+    "current_database": ("defaultdb", STRING),
+    "current_schema": ("public", STRING),
+    "current_user": ("root", STRING),
+    "session_user": ("root", STRING),
+    "pg_backend_pid": (0, INT8),
+    "pg_is_in_recovery": (False, BOOL),
+    "txid_current": (0, INT8),
+    "inet_server_port": (26257, INT8),
+}
+
+
 # 1-arg float elementwise builtins: name -> python fn (for constant
 # folding); the device kernel table lives in exec/expr.py:_FUNC_KERNELS
 FLOAT_UNARY = {
@@ -47,6 +64,15 @@ FLOAT_UNARY = {
     "asinh": math.asinh, "acosh": math.acosh, "atanh": math.atanh,
     "degrees": math.degrees, "radians": math.radians,
     "floor": math.floor, "ceil": math.ceil, "ceiling": math.ceil,
+    "erf": math.erf, "erfc": math.erfc,
+    # pg's degree-argument trigonometry family
+    "sind": lambda x: math.sin(math.radians(x)),
+    "cosd": lambda x: math.cos(math.radians(x)),
+    "tand": lambda x: math.tan(math.radians(x)),
+    "cotd": lambda x: 1.0 / math.tan(math.radians(x)),
+    "asind": lambda x: math.degrees(math.asin(x)),
+    "acosd": lambda x: math.degrees(math.acos(x)),
+    "atand": lambda x: math.degrees(math.atan(x)),
 }
 
 # integer constant-fold-only builtins (no row-wise device kernel;
@@ -197,15 +223,46 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
         vals = []
         for a in args[1:]:
             v = a.value
-            if v is None:
-                v = ""  # pg renders NULL args as empty via %s
-            elif a.type.family == Family.DECIMAL:
+            if v is not None and a.type.family == Family.DECIMAL:
                 v = v / 10 ** a.type.scale
             vals.append(v)
-        try:
-            return BConst(tmpl % tuple(vals), STRING)
-        except (TypeError, ValueError) as err:
-            raise BuiltinError(f"format: {err}")
+        # pg format(): %s plain, %I quoted identifier, %L quoted
+        # literal (NULL -> the keyword), %% literal percent
+        out = []
+        i = 0
+        vi = 0
+        n = len(tmpl)
+        while i < n:
+            ch = tmpl[i]
+            if ch != "%":
+                out.append(ch)
+                i += 1
+                continue
+            spec = tmpl[i + 1:i + 2]
+            i += 2
+            if spec == "%":
+                out.append("%")
+                continue
+            if spec not in ("s", "I", "L"):
+                raise BuiltinError(
+                    f"unrecognized format() type specifier "
+                    f"%{spec or ''}")
+            if vi >= len(vals):
+                raise BuiltinError("too few arguments for format()")
+            v = vals[vi]
+            vi += 1
+            if spec == "s":
+                out.append("" if v is None else str(v))
+            elif spec == "I":
+                if v is None:
+                    raise BuiltinError(
+                        "format: NULL cannot be a %I identifier")
+                out.append('"' + str(v).replace('"', '""') + '"')
+            else:
+                out.append("NULL" if v is None
+                           else "'" + str(v).replace("'", "''")
+                           + "'")
+        return BConst("".join(out), STRING)
     if name == "isnan":
         x = binder.coerce(args[0], FLOAT8)
         return BFunc("isnan", [x], BOOL)
@@ -344,6 +401,67 @@ def bind_builtin(binder, name: str, args: list, e) -> BExpr | None:
                .replace("MI", "%M").replace("SS", "%S"))
         return BConst(dt.strftime(fmt), STRING)
 
+    if name in _INFO_FNS:
+        if args:
+            raise BuiltinError(f"{name} takes no arguments")
+        v, ty = _INFO_FNS[name]
+        return BConst(v, ty)
+    if name in ("justify_hours", "justify_days",
+                "justify_interval"):
+        # intervals are stored as total microseconds, so pg's
+        # days/months re-bucketing is an output-formatting identity
+        # here — the VALUE is unchanged by construction
+        if len(args) != 1:
+            raise BuiltinError(f"{name} takes one argument")
+        return args[0]
+    if name == "timeofday":
+        us = binder.now_micros
+        if us is None:
+            raise BuiltinError("timeofday() needs a statement "
+                               "timestamp")
+        dt = datetime.datetime(1970, 1, 1) + \
+            datetime.timedelta(microseconds=int(us))
+        return BConst(dt.strftime("%a %b %d %H:%M:%S.%f")
+                      + f" {dt.year} UTC", STRING)
+    if name == "pg_typeof":
+        if len(args) != 1:
+            raise BuiltinError("pg_typeof takes one argument")
+        return BConst(str(args[0].type).lower(), STRING)
+    if name in ("obj_description", "col_description",
+                "shobj_description"):
+        return BConst(None, STRING)   # no comments stored
+    if name == "pg_get_userbyid":
+        return BConst("root", STRING)
+    if name in ("has_table_privilege", "has_schema_privilege",
+                "has_database_privilege", "pg_table_is_visible",
+                "pg_function_is_visible"):
+        return BConst(True, BOOL)     # single-role engine
+    if name == "pg_encoding_to_char":
+        return BConst("UTF8", STRING)
+    if name == "uuid_generate_v4":
+        return bind_builtin(binder, "gen_random_uuid", args, e)
+    if name == "date_bin":
+        # date_bin(stride, ts, origin): origin-aligned truncation —
+        # pure int64 micros arithmetic, so it runs over COLUMNS and
+        # fuses on device
+        if len(args) != 3:
+            raise BuiltinError("date_bin(stride, ts, origin)")
+        from .bound import BBin
+        stride, ts, origin = args
+        if not isinstance(stride, BConst):
+            raise BuiltinError("date_bin stride must be constant")
+        sv = int(stride.value)
+        if sv <= 0:
+            raise BuiltinError("date_bin stride must be positive")
+        if not isinstance(origin, BConst):
+            raise BuiltinError("date_bin origin must be constant")
+        ov = int(origin.value)
+        # origin + ((ts - origin) / stride) * stride, integer division
+        delta = BBin("-", ts, BConst(ov, TIMESTAMP), INT8)
+        q = BFunc("div", [delta, BConst(sv, INT8)], INT8)
+        return BBin("+", BConst(ov, TIMESTAMP),
+                    BBin("*", q, BConst(sv, INT8), INT8), TIMESTAMP)
+
     # ---- strings over dictionaries ---------------------------------------
     out = _bind_string_builtin(binder, name, args)
     if out is not None:
@@ -373,8 +491,14 @@ _STR_TO_STR = {
     "substr": lambda s, start, length=None: _substr(s, start, length),
     "substring": lambda s, start, length=None: _substr(s, start, length),
     "split_part": lambda s, d, n: _split_part(s, d, n),
+    "overlay": lambda s, repl, start, ln=None: (
+        s[:start - 1] + repl
+        + s[start - 1 + (len(repl) if ln is None else ln):]),
     "quote_ident": lambda s: '"' + s.replace('"', '""') + '"',
     "quote_literal": lambda s: "'" + s.replace("'", "''") + "'",
+    "quote_nullable": lambda s: "'" + s.replace("'", "''") + "'",
+    "encode": lambda s, fmt: _encode_blob(s, fmt),
+    "decode": lambda s, fmt: _decode_blob(s, fmt),
     # pg regexp_replace: first match unless flags contain 'g'
     "regexp_replace": lambda s, pat, repl, flags="": re.sub(
         pat, repl, s,
@@ -400,6 +524,29 @@ _STR_TO_VAL = {
     "position": (lambda s, sub: s.find(sub) + 1, INT8),
     "starts_with": (lambda s, p: s.startswith(p), BOOL),
     "ends_with": (lambda s, p: s.endswith(p), BOOL),
+    # CRDB string hash family (pkg/sql/sem/builtins: fnv/crc over the
+    # value bytes) + fuzzystrmatch's levenshtein
+    "fnv32": (lambda s: _fnv(s.encode(), 0x811c9dc5,
+                             0x01000193, 1 << 32), INT8),
+    "fnv32a": (lambda s: _fnva(s.encode(), 0x811c9dc5,
+                               0x01000193, 1 << 32), INT8),
+    "fnv64": (lambda s: _fnv(s.encode(), 0xcbf29ce484222325,
+                             0x100000001b3, 1 << 64), INT8),
+    "fnv64a": (lambda s: _fnva(s.encode(), 0xcbf29ce484222325,
+                               0x100000001b3, 1 << 64), INT8),
+    "crc32ieee": (lambda s: __import__("binascii").crc32(s.encode()),
+                  INT8),
+    "levenshtein": (lambda s, t: _levenshtein(s, t), INT8),
+    "to_date": (lambda s, fmt: _to_date_days(s, fmt), DATE),
+    # pg 15 regexp family (pattern/flags must be constants; the
+    # predicate evaluates once per dictionary entry, sql/binder.py)
+    "regexp_like": (lambda s, pat, flags="": bool(re.search(
+        pat, s, re.IGNORECASE if "i" in flags else 0)), BOOL),
+    "regexp_count": (lambda s, pat, flags="": len(re.findall(
+        pat, s, re.IGNORECASE if "i" in flags else 0)), INT8),
+    "regexp_instr": (lambda s, pat, flags="": (
+        (lambda m: m.start() + 1 if m else 0)(re.search(
+            pat, s, re.IGNORECASE if "i" in flags else 0))), INT8),
 }
 
 
@@ -439,7 +586,70 @@ def _substr(s, start, length=None):
     return s[max(i, 0):max(end, 0)]
 
 
-_HASH_FNS = ("md5", "sha1", "sha256", "sha512")
+_HASH_FNS = ("md5", "sha1", "sha224", "sha256", "sha384", "sha512")
+
+
+def _encode_blob(s: str, fmt: str) -> str:
+    import base64 as _b64
+    if fmt == "hex":
+        return s.encode().hex()
+    if fmt == "base64":
+        return _b64.b64encode(s.encode()).decode()
+    if fmt == "escape":
+        return "".join(c if 32 <= ord(c) < 127 and c != "\\"
+                       else f"\\{ord(c):03o}" for c in s)
+    raise BuiltinError(f"unknown encode format {fmt!r}")
+
+
+def _decode_blob(s: str, fmt: str) -> str:
+    import base64 as _b64
+    try:
+        if fmt == "hex":
+            return bytes.fromhex(s).decode()
+        if fmt == "base64":
+            return _b64.b64decode(s).decode()
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise BuiltinError(f"decode: {exc}") from None
+    raise BuiltinError(f"unknown decode format {fmt!r}")
+
+
+def _fnv(data: bytes, basis: int, prime: int, mod: int) -> int:
+    h = basis
+    for b in data:
+        h = (h * prime) % mod
+        h ^= b
+    return h if h < (1 << 63) else h - (1 << 64)
+
+
+def _fnva(data: bytes, basis: int, prime: int, mod: int) -> int:
+    h = basis
+    for b in data:
+        h ^= b
+        h = (h * prime) % mod
+    return h if h < (1 << 63) else h - (1 << 64)
+
+
+def _levenshtein(s: str, t: str) -> int:
+    if len(s) < len(t):
+        s, t = t, s
+    prev = list(range(len(t) + 1))
+    for i, cs in enumerate(s, 1):
+        cur = [i]
+        for j, ct in enumerate(t, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (cs != ct)))
+        prev = cur
+    return prev[-1]
+
+
+def _to_date_days(s: str, fmt: str) -> int:
+    pat = (fmt.replace("YYYY", "%Y").replace("MM", "%m")
+           .replace("DD", "%d"))
+    try:
+        d = datetime.datetime.strptime(s.strip(), pat).date()
+    except ValueError as exc:
+        raise BuiltinError(f"to_date: {exc}") from None
+    return (d - datetime.date(1970, 1, 1)).days
 
 
 def _bind_string_builtin(binder, name: str, args: list) -> BExpr | None:
@@ -598,6 +808,11 @@ _DATUM_FNS = {
     "jsonb_array_length": (
         lambda v: len(v) if isinstance(v, list) else None, INT8, 1,
         Family.JSON),
+    "jsonb_exists": (
+        lambda v, key: (key in v if isinstance(v, dict)
+                        else str(key) in [str(x) for x in v]
+                        if isinstance(v, list) else False),
+        BOOL, 2, Family.JSON),
 }
 
 
