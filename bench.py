@@ -374,11 +374,17 @@ def main():
         "BENCH_CHILD_TIMEOUT", max(900, rows >> 17)))
     results = {}
     rows_used = {}
+    gbps_keys = {}
     for q in queries:  # q6 first: the primary metric lands early
         r = run_child(rows_by_query[q], q, child_timeout)
         if r is not None:
             results[q] = r["value"]
             rows_used[q] = r["rows"]
+            # round-4 weak #5: the child computed effective_GBps but
+            # the parent dropped it, so the roofline metric never
+            # reached the persisted BENCH record — forward it
+            gbps_keys.update({k: v for k, v in r.items()
+                              if k.endswith("_effective_gbps")})
     if not results:
         print(json.dumps({"metric": "tpch_q6_rows_per_sec", "value": 0,
                           "unit": "rows/s", "vs_baseline": 0,
@@ -398,6 +404,7 @@ def main():
     for which, rps in results.items():
         out[f"{which}_rows_per_sec"] = round(rps)
         out[f"{which}_rows"] = rows_used[which]
+    out.update(gbps_keys)
 
     if cpu is not None:
         out[f"cpu_{cpu_query}_rows_per_sec"] = cpu["value"]
@@ -427,7 +434,53 @@ def main():
         if r is not None:
             out["tpcc_tpmc"] = r["value"]
             out["tpcc_warehouses"] = r.get("warehouses")
+    regression_report(out)
     print(json.dumps(out))
+
+
+# metrics where a value change is configuration, not performance
+_NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
+                  "cpu_rows", "ssb_rows", "tpcc_warehouses"}
+
+
+def regression_report(out: dict) -> None:
+    """Compare this run against the newest BENCH_r{N}.json and print a
+    per-metric delta report; any >10% drop gets a loud REGRESSION line
+    and lands in out["regressions"]. Round-4 lesson: Q14 silently lost
+    25% for a whole round because nothing compared BENCH_rN against
+    BENCH_rN-1 (the reference regression-tests exact perf counts,
+    pkg/bench/rttanalysis)."""
+    import glob as _glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    prevs = sorted(_glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not prevs:
+        return
+    try:
+        with open(prevs[-1]) as f:
+            prev = json.load(f).get("parsed") or {}
+    except (OSError, ValueError):
+        return
+    name = os.path.basename(prevs[-1])
+    regs = []
+    for k in sorted(set(prev) & set(out)):
+        pv, cv = prev[k], out[k]
+        if k in _NON_PERF_KEYS or k.endswith("_rows") or \
+                isinstance(pv, bool) or isinstance(cv, bool) or \
+                not isinstance(pv, (int, float)) or \
+                not isinstance(cv, (int, float)) or not pv:
+            continue
+        delta = (cv - pv) / pv
+        if delta < -0.10:
+            regs.append(k)
+            print(f"# REGRESSION {k}: {pv:.6g} -> {cv:.6g} "
+                  f"({delta:+.1%}) vs {name}", file=sys.stderr)
+        else:
+            print(f"# delta {k}: {pv:.6g} -> {cv:.6g} ({delta:+.1%})",
+                  file=sys.stderr)
+    if regs:
+        print(f"# REGRESSION SUMMARY: {len(regs)} metric(s) dropped "
+              f">10% vs {name}: {', '.join(regs)}", file=sys.stderr)
+        out["regressions"] = regs
 
 
 if __name__ == "__main__":
