@@ -324,7 +324,7 @@ def main():
     if mode == "tpcc_child":
         from cockroach_tpu.exec.engine import Engine
         from cockroach_tpu.workload.tpcc import TPCC
-        wh = int(os.environ.get("BENCH_TPCC_WAREHOUSES", 2))
+        wh = int(os.environ.get("BENCH_TPCC_WAREHOUSES", 10))
         steps = int(os.environ.get("BENCH_TPCC_STEPS", 600))
         eng = Engine()
         w = TPCC(eng, warehouses=wh)

@@ -617,7 +617,8 @@ class Gateway:
         self.monitor = monitor
         self.window = window
 
-    def _partition_by_leaseholder(self, plan_node) -> dict:
+    def _partition_by_leaseholder(self, plan_node,
+                                  nodes: list | None = None) -> dict:
         """node_id -> {table: [(lo, hi) latin1 spans]} — the
         PartitionSpans decision (distsql_physical_planner.go:1096):
         the probe-spine scan splits by range leaseholder; join build
@@ -650,30 +651,31 @@ class Gateway:
                 f"table(s) {sorted(both)} appear on both probe and "
                 "build sides (self-join): one local materialization "
                 "cannot be partitioned and replicated at once")
-        out: dict[int, dict] = {nid: {} for nid in self.nodes}
+        nodes = nodes if nodes is not None else list(self.nodes)
+        out: dict[int, dict] = {nid: {} for nid in nodes}
         eng = self.own.engine
         for tname in spine_tables | build_tables:
             schema = eng.store.table(tname).schema
             rt = RangeTable(self.cluster, schema)
             if tname in build_tables and tname not in spine_tables:
                 full = [tuple(s.decode("latin1") for s in rt.codec.span())]
-                for nid in self.nodes:
+                for nid in nodes:
                     out[nid][tname] = full
                 continue
             parts = rt.partition_spans()
-            for nid in self.nodes:
+            for nid in nodes:
                 pieces = parts.get(nid, [])
                 out[nid][tname] = [(lo.decode("latin1"),
                                     hi.decode("latin1"))
                                    for lo, hi in pieces]
             orphans = {n: p for n, p in parts.items()
-                       if n not in self.nodes}
+                       if n not in nodes}
             if orphans:
                 # a leaseholder outside the flow's node set would
                 # silently drop its rows — reassign its pieces to the
                 # first participant (the reference plans the flow ON
                 # the leaseholder set; our node set is fixed up front)
-                first = self.nodes[0]
+                first = nodes[0]
                 for pieces in orphans.values():
                     out[first][tname].extend(
                         (lo.decode("latin1"), hi.decode("latin1"))
@@ -730,6 +732,44 @@ class Gateway:
         return bool(found)
 
     def run(self, sql: str, chunk_rows: int = 65536):
+        """Plan and run, replanning once over the surviving nodes if a
+        data node dies mid-flow (read-only statements are safely
+        retryable; the reference re-plans around dead nodes,
+        distsql_running.go:375). Cluster mode only: span partitioning
+        can reassign the dead node's ranges to surviving leaseholders,
+        whereas node-local shards die with their node."""
+        def live() -> list:
+            if self.cluster is None or self.monitor is None:
+                return list(self.nodes)
+            # plan on the currently-live set up front: a known-dead
+            # node costs nothing (the reference plans on the live
+            # leaseholder set, not the static node list)
+            out = [n for n in self.nodes
+                   if n == self.own.node_id or self.monitor.healthy(n)]
+            return out or list(self.nodes)
+
+        first = live()
+        try:
+            return self._run_once(sql, chunk_rows, first)
+        except FlowError:
+            if self.cluster is None or self.monitor is None:
+                raise
+            healthy = [n for n in first
+                       if n == self.own.node_id
+                       or self.monitor.healthy(n)]
+            if not healthy or healthy == first:
+                raise               # nothing to shrink onto
+            from ..utils import log
+            log.info(log.OPS,
+                     "flow replan: shrinking %s -> %s after failure",
+                     first, healthy)
+            return self._run_once(sql, chunk_rows, healthy)
+
+    def _run_once(self, sql: str, chunk_rows: int = 65536,
+                  nodes: list | None = None):
+        # the node set is a PARAMETER (not mutated shared state): a
+        # concurrent statement's replan must never tear another's view
+        nodes = list(nodes) if nodes is not None else list(self.nodes)
         eng = self.own.engine
         transport = self.own.transport
         try:
@@ -751,13 +791,13 @@ class Gateway:
             kind = shfl.graph_kind(node)
             if kind is None:
                 raise
-            return self._run_graph(sql, kind, chunk_rows)
+            return self._run_graph(sql, kind, chunk_rows, nodes)
         kind = self._pick_graph(node)
         if kind is not None:
-            return self._run_graph(sql, kind, chunk_rows)
+            return self._run_graph(sql, kind, chunk_rows, nodes)
         spans_by_node = None
         if self.cluster is not None:
-            spans_by_node = self._partition_by_leaseholder(node)
+            spans_by_node = self._partition_by_leaseholder(node, nodes)
         else:
             self._check_join_placement(node)
         stage = split(node)
@@ -767,7 +807,7 @@ class Gateway:
         # fail fast on breaker-tripped peers: scheduling a flow onto a
         # dead node would only discover it after flow_timeout of silence
         if self.monitor is not None:
-            sick = [n for n in self.nodes if n != self.own.node_id
+            sick = [n for n in nodes if n != self.own.node_id
                     and not self.monitor.healthy(n)]
             if sick:
                 raise FlowError(
@@ -777,7 +817,7 @@ class Gateway:
         # SetupFlow to each participant; stream i <- node i
         registry = self.own.registry
         inboxes = []
-        for i, nid in enumerate(self.nodes):
+        for i, nid in enumerate(nodes):
             spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
                             stream_id=i, chunk_rows=chunk_rows,
                             read_ts=read_ts, window=self.window,
@@ -788,7 +828,8 @@ class Gateway:
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
         union, merged_dicts = self._pump_and_union(
-            flow_id, inboxes, stage.union_columns, stage.string_cols)
+            flow_id, inboxes, stage.union_columns, stage.string_cols,
+            nodes)
 
         # output dictionaries come from the merged wire strings, not the
         # gateway's (possibly empty) local shard
@@ -799,7 +840,8 @@ class Gateway:
         out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
         return eng._materialize(out, meta)
 
-    def _run_graph(self, sql: str, kind: str, chunk_rows: int):
+    def _run_graph(self, sql: str, kind: str, chunk_rows: int,
+                   nodes: list | None = None):
         """Run one multi-stage shuffle flow (distsql/shuffle.py): every
         data node scans its shard, hash-exchanges rows with its peers,
         and gathers finished results to the gateway."""
@@ -811,14 +853,15 @@ class Gateway:
             eng.catalog_view(int_ranges=False, stats=False),
             use_memo=False,
             dict_folds=False).plan_select(parser.parse(sql))
+        nodes = list(nodes) if nodes is not None else list(self.nodes)
         graph = shfl.decompose(kind, node)
         spans_by_node = None
         if self.cluster is not None:
-            spans_by_node = self._partition_tables(graph.tables)
+            spans_by_node = self._partition_tables(graph.tables, nodes)
         flow_id = uuid.uuid4().hex[:12]
         read_ts = int(eng.clock.now().to_int())
         if self.monitor is not None:
-            sick = [n for n in self.nodes if n != self.own.node_id
+            sick = [n for n in nodes if n != self.own.node_id
                     and not self.monitor.healthy(n)]
             if sick:
                 raise FlowError(
@@ -826,7 +869,7 @@ class Gateway:
                     "not scheduling flow")
         registry = self.own.registry
         inboxes = []
-        for nid in self.nodes:
+        for nid in nodes:
             sid = f"g:p{nid}"
             spec = FlowSpec(flow_id, self.own.node_id, "graph", sql,
                             stream_id=sid, chunk_rows=chunk_rows,
@@ -834,12 +877,13 @@ class Gateway:
                             spans=(spans_by_node.get(nid)
                                    if spans_by_node is not None
                                    else None),
-                            graph=kind, data_nodes=list(self.nodes))
+                            graph=kind, data_nodes=list(nodes))
             inboxes.append(registry.inbox(flow_id, sid))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
         union, merged_dicts = self._pump_and_union(
-            flow_id, inboxes, graph.union_columns, graph.string_cols)
+            flow_id, inboxes, graph.union_columns, graph.string_cols,
+            nodes)
         for out_name, union_col in graph.dict_outputs.items():
             if union_col in merged_dicts:
                 meta.dictionaries[out_name] = merged_dicts[union_col]
@@ -847,25 +891,27 @@ class Gateway:
         out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
         return eng._materialize(out, meta)
 
-    def _partition_tables(self, tables: dict) -> dict:
+    def _partition_tables(self, tables: dict,
+                          nodes: list | None = None) -> dict:
         """Shuffle-mode PartitionSpans: EVERY table partitions by range
         leaseholder — no build-side replication (the exchange, not a
         full fetch, co-locates join rows)."""
         from cockroach_tpu.kv.rowfetch import RangeTable
+        nodes = nodes if nodes is not None else list(self.nodes)
         eng = self.own.engine
-        out: dict[int, dict] = {nid: {} for nid in self.nodes}
+        out: dict[int, dict] = {nid: {} for nid in nodes}
         for tname in sorted(set(tables.values())):
             schema = eng.store.table(tname).schema
             rt = RangeTable(self.cluster, schema)
             parts = rt.partition_spans()
-            for nid in self.nodes:
+            for nid in nodes:
                 out[nid][tname] = [(lo.decode("latin1"),
                                     hi.decode("latin1"))
                                    for lo, hi in parts.get(nid, [])]
             orphans = {n: p for n, p in parts.items()
-                       if n not in self.nodes}
+                       if n not in nodes}
             if orphans:
-                first = self.nodes[0]
+                first = nodes[0]
                 for pieces in orphans.values():
                     out[first][tname].extend(
                         (lo.decode("latin1"), hi.decode("latin1"))
@@ -873,7 +919,8 @@ class Gateway:
         return out
 
     def _pump_and_union(self, flow_id, inboxes, union_columns,
-                        string_cols):
+                        string_cols, nodes: list | None = None):
+        nodes = nodes if nodes is not None else list(self.nodes)
         transport = self.own.transport
         registry = self.own.registry
         # drive the network until all streams finish. In-process
@@ -892,9 +939,9 @@ class Gateway:
             if self.monitor is not None and spin % 256 == 255:
                 # a peer that trips mid-flow will never send EOF;
                 # stop waiting for it the moment the breaker says so
-                waiting = [self.nodes[i] for i, ib in enumerate(inboxes)
+                waiting = [nodes[i] for i, ib in enumerate(inboxes)
                            if not ib.eof and
-                           self.nodes[i] != self.own.node_id]
+                           nodes[i] != self.own.node_id]
                 sick = [n for n in waiting
                         if not self.monitor.healthy(n)]
                 if sick:
@@ -926,7 +973,7 @@ class Gateway:
             # errored flow leaves remote stages running and pushing
             # chunks at a gateway that has already given up
             # (flowinfra's ctx cancellation)
-            for nid in self.nodes:
+            for nid in nodes:
                 transport.send(self.own.node_id, nid,
                                ("cancel_flow", flow_id))
             raise

@@ -1319,7 +1319,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         try:
             self._check_join_builds(node, read_ts, overlay_puts)
             self._bound_agg_group_rows(node, read_ts, overlay_puts)
-            self._set_scan_narrowing(
+            narrow_by_alias = self._set_scan_narrowing(
                 node, overlay, stream[0] if stream else None)
         except EngineError:
             if meta.memo is not None and not no_memo:
@@ -1339,6 +1339,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         for alias, tname in scan_aliases.items():
             self._register_table_read(session.txn, tname, read_ts)
             cols = scan_cols.get(alias)
+            # default WIDE: an alias missing from the walk must never
+            # be served an int32 upload its compiled scan won't upcast
+            do_narrow = narrow_by_alias.get(alias, False)
             if stream is not None and alias == stream[0]:
                 # the streamed fact table never uploads whole; its
                 # shape contribution is the (static) page size — but
@@ -1356,10 +1359,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             elif decision is not None:
                 sharded = alias in decision.sharded
                 b = self._device_table(tname, "sharded" if sharded
-                                       else "replicated", cols)
+                                       else "replicated", cols,
+                                       narrow=do_narrow)
                 gens.append((tname, self.store.table(tname).generation))
             else:
-                b = self._device_table(tname, cols=cols)
+                b = self._device_table(tname, cols=cols,
+                                       narrow=do_narrow)
                 gens.append((tname, self.store.table(tname).generation))
             scans[alias] = b
             dictlens = tuple(
@@ -1467,11 +1472,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             return self._exec_setop(sel, session, sql_text)
         if sql_text not in self._plain_memo:
             sel2 = self._decorrelate(self._expand_views(sel))
-            if sel2 is sel and sql_text:
-                # identity result = no views, no subqueries: memoize
-                # BY TEXT so hot OLTP statements skip both walks on
-                # re-execution without annotating the shared cached
-                # AST in place. DDL invalidates with the parse cache.
+            if sel2 is sel and sql_text and \
+                    sql_text.lower().count("select") == 1:
+                # memoize BY TEXT so hot OLTP statements skip both
+                # walks on re-execution without annotating the shared
+                # cached AST (round-4 advisor). Only SUBQUERY-FREE
+                # texts qualify: decorrelation rewrites nested
+                # subqueries IN PLACE while returning the same object,
+                # so `is sel` alone cannot prove it was a no-op — a
+                # memo hit on a fresh parse copy would then skip a
+                # rewrite the planner needs (the q2 regression this
+                # guard fixes). DDL invalidates with the parse cache.
                 self._plain_memo.add(sql_text)
             sel = sel2
         if sel.ctes or self._has_derived(sel):
@@ -1725,19 +1736,55 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
 
         walk(node)
 
-    def _set_scan_narrowing(self, node, overlay, stream_alias) -> None:
+    def _set_scan_narrowing(self, node, overlay,
+                            stream_alias) -> dict:
         """Mark each Scan's int64 columns whose proven value range
         fits int32 (scanplane.narrow32_cols): the upload moves half
         the HBM bytes and the compiled scan upcasts, so downstream
         programs are unchanged. Skipped for txn-overlay scans (their
-        fresh uploads don't consult the generation-cached ranges) and
-        the streamed fact table (pages upload wide)."""
+        fresh uploads don't consult the generation-cached ranges), the
+        streamed fact table (pages upload wide), and any scan feeding
+        a JOIN: in probe pipelines XLA materializes the upcast as a
+        full-width int64 copy instead of fusing it into the gathers —
+        measured 147M -> 111M rows/s on Q14 at 2^23, the round-4
+        silent regression. Scan->aggregate shapes (Q6/Q1) keep the
+        ~2x upload win; probe spines read wide."""
+
+        joins = []
+
+        def find_joins(n):
+            if isinstance(n, P.HashJoin):
+                joins.append(n)
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    find_joins(c)
+
+        find_joins(node)
+        under_join: set[int] = set()
+
+        def mark(n):
+            if isinstance(n, P.Scan):
+                under_join.add(id(n))
+                return
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    mark(c)
+
+        for j in joins:
+            mark(j.left)
+            mark(j.right)
+
+        narrow_by_alias: dict[str, bool] = {}
 
         def walk(n):
             if isinstance(n, P.Scan):
-                if n.table not in overlay and n.alias != stream_alias:
+                if n.table not in overlay and n.alias != stream_alias \
+                        and id(n) not in under_join:
                     n.narrowed = self.narrow32_cols(
                         n.table, frozenset(n.columns.values()))
+                narrow_by_alias[n.alias] = bool(n.narrowed)
                 return
             for attr in ("child", "left", "right"):
                 c = getattr(n, attr, None)
@@ -1745,6 +1792,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                     walk(c)
 
         walk(node)
+        # alias -> whether the upload may narrow: consumed by the
+        # prepare loop so the device upload dtype matches the scan
+        return narrow_by_alias
 
     def _bound_agg_value_ranges(self, agg, overlay: dict) -> None:
         """Attach stored-column value bounds to plain-column int64 SUM

@@ -336,3 +336,124 @@ def _rows_of(engine, table):
         for ri in range(chunk.n):
             rows.append(store.extract_row(td, chunk, ri))
     return rows
+
+
+class TestFlowReplanOnFailure:
+    """Round-4 VERDICT #9: a read-only flow that loses a data node
+    mid-flow replans over the surviving nodes instead of erroring
+    (the reference re-plans around dead nodes,
+    distsql_running.go:375)."""
+
+    def test_node_death_replans_on_survivors(self):
+        from cockroach_tpu.kv.rowfetch import RangeTable
+        from cockroach_tpu.kvserver.cluster import Cluster
+
+        oracle = Engine()
+        tpch.load(oracle, sf=0.01, rows=600)
+        c = Cluster(n_nodes=3)
+        transport = LocalTransport()
+        nodes = []
+        for i in range(4):
+            e = Engine()
+            e.execute(tpch.DDL["lineitem"])
+            nodes.append(DistSQLNode(i, e, transport, cluster=c))
+        schema = nodes[0].engine.store.table("lineitem").schema
+        rt = RangeTable(c, schema)
+        lo, hi = rt.codec.span()
+        c.create_range(lo, hi, replicas=[1, 2, 3])
+        c.pump_until(lambda: c.ensure_lease(1) is not None)
+        rows = []
+        store = oracle.store
+        td = store.table("lineitem")
+        for chunk in td.chunks:
+            for ri in range(chunk.n):
+                rows.append(store.extract_row(td, chunk, ri))
+        rt.insert_rows(rows)
+        s0, _ = rt.codec.span()
+        for frac in (b"\x40", b"\x80"):
+            c.split_range(s0 + frac)
+        c.pump(10)
+
+        sick: set = set()
+
+        class Monitor:
+            def healthy(self, n):
+                return n not in sick
+
+        gw = Gateway(nodes[0], [1, 2, 3], cluster=c,
+                     monitor=Monitor())
+        q = "SELECT count(*), sum(l_quantity) FROM lineitem"
+        want = oracle.execute(q)
+        assert gw.run(q).rows[0][0] == want.rows[0][0]
+
+        # node 3 dies: transport partitioned, breaker trips, leases
+        # move to survivors
+        sick.add(3)
+        transport.stop_node(3)
+        for rid, desc in list(c.descriptors.items()):
+            if c.leaseholder(rid) == 3:
+                c.transfer_lease(rid, 1)
+        c.pump(10)
+
+        got = gw.run(q)
+        assert got.rows[0][0] == want.rows[0][0]
+        assert got.rows[0][1] == pytest.approx(want.rows[0][1])
+
+    def test_mid_flow_death_replans(self):
+        """The node passes the scheduling health check, then dies
+        while its flow runs: the gateway's mid-flow breaker poll
+        fails the flow and the replan answers from survivors."""
+        from cockroach_tpu.kv.rowfetch import RangeTable
+        from cockroach_tpu.kvserver.cluster import Cluster
+
+        oracle = Engine()
+        tpch.load(oracle, sf=0.01, rows=600)
+        c = Cluster(n_nodes=3)
+        transport = LocalTransport()
+        nodes = []
+        for i in range(4):
+            e = Engine()
+            e.execute(tpch.DDL["lineitem"])
+            nodes.append(DistSQLNode(i, e, transport, cluster=c))
+        schema = nodes[0].engine.store.table("lineitem").schema
+        rt = RangeTable(c, schema)
+        lo, hi = rt.codec.span()
+        c.create_range(lo, hi, replicas=[1, 2, 3])
+        c.pump_until(lambda: c.ensure_lease(1) is not None)
+        rows = []
+        store = oracle.store
+        td = store.table("lineitem")
+        for chunk in td.chunks:
+            for ri in range(chunk.n):
+                rows.append(store.extract_row(td, chunk, ri))
+        rt.insert_rows(rows)
+        s0, _ = rt.codec.span()
+        for frac in (b"\x40", b"\x80"):
+            c.split_range(s0 + frac)
+        c.pump(10)
+
+        # node 3's transport is already dead, but the breaker only
+        # notices after the scheduling check: its SetupFlow is sent
+        # into the void, the flow stalls, and the MID-FLOW poll
+        # (spin % 256) discovers the sickness -> fail fast -> replan
+        transport.stop_node(3)
+        for rid in list(c.descriptors):
+            if c.leaseholder(rid) == 3:
+                c.transfer_lease(rid, 1)
+        c.pump(10)
+        state = {"calls": 0}
+
+        class FlakyMonitor:
+            def healthy(self, n):
+                state["calls"] += 1
+                if n != 3:
+                    return True
+                return state["calls"] <= 3   # healthy at scheduling
+
+        gw = Gateway(nodes[0], [1, 2, 3], cluster=c,
+                     monitor=FlakyMonitor(), flow_timeout=10.0)
+        q = "SELECT count(*) FROM lineitem"
+        want = oracle.execute(q)
+        got = gw.run(q)
+        assert got.rows[0][0] == want.rows[0][0]
+        assert state["calls"] > 3   # the mid-flow poll actually ran
