@@ -105,6 +105,11 @@ class TableFeed:
         self.events: deque[FeedEvent] = deque()
         self._mu = threading.Lock()
         with engine._stmt_lock:
+            # committed OLTP-lane writes may still sit in the deferred
+            # publish queue; the catch-up scan reads the columnstore,
+            # so they must land first (exec/oltplane.py)
+            if getattr(engine, "_lane_pending", None):
+                engine.lane_flush()
             engine.cdc_feeds.append(self)
             self._catch_up(since_int)
 

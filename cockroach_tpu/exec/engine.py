@@ -105,7 +105,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                    now_ns=lambda: self.clock.now().wall)
         # changefeed event taps (cdc/changefeed.py TableFeed)
         self.cdc_feeds: list = []
-        self._cdc_threads: dict[int, threading.Thread] = {}
+        self._cdc_threads: dict[int, tuple] = {}  # id -> (thread, table)
         # observability: span tracing (util/tracing) + per-statement
         # fingerprint stats (pkg/sql/sqlstats)
         from ..utils.sqlstats import StatsRegistry

@@ -124,7 +124,9 @@ class MaintenanceMixin:
             "resolved_every_s": resolved_every_s})
         th = threading.Thread(target=self._run_changefeed,
                               args=(job_id,), daemon=True)
-        self._cdc_threads[job_id] = th
+        # (thread, table): the OLTP lane gates its deferred publishes
+        # per fed table and ignores dead threads (exec/oltplane.py)
+        self._cdc_threads[job_id] = (th, table)
         th.start()
         return job_id
 

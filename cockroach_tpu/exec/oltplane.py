@@ -701,6 +701,16 @@ class OltpLaneMixin:
                 # BEHIND it — take the full path instead (re-checked
                 # under _lane_sync at commit time)
                 raise ShapeIneligible("nonlane active")
+            if any(f.table == plan.table for f in self.cdc_feeds) \
+                    or any(th.is_alive() and tb == plan.table
+                           for th, tb in self._cdc_threads.values()):
+                # a changefeed on THIS table consumes commits from the
+                # publish path; a deferred lane publish would starve
+                # it. Re-checked HERE (not just at plan build): feeds
+                # register asynchronously after CREATE CHANGEFEED
+                # returns. Scoped per table, and dead feed threads
+                # (failed/finished jobs) do not gate anything.
+                raise ShapeIneligible("changefeed active")
             m = self._lane_mirror(plan.table)
             td = self.store.table(plan.table)
             schema = td.schema

@@ -448,7 +448,10 @@ class TestFlowReplanOnFailure:
                 state["calls"] += 1
                 if n != 3:
                     return True
-                return state["calls"] <= 3   # healthy at scheduling
+                # calls 1-3: Gateway.run's live() probe; 4-6: the
+                # scheduling-time check. Staying healthy through both
+                # forces the failure onto the MID-FLOW poll.
+                return state["calls"] <= 6
 
         gw = Gateway(nodes[0], [1, 2, 3], cluster=c,
                      monitor=FlakyMonitor(), flow_timeout=10.0)
@@ -456,4 +459,4 @@ class TestFlowReplanOnFailure:
         want = oracle.execute(q)
         got = gw.run(q)
         assert got.rows[0][0] == want.rows[0][0]
-        assert state["calls"] > 3   # the mid-flow poll actually ran
+        assert state["calls"] > 6   # the mid-flow poll actually ran
