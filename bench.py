@@ -246,6 +246,17 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
         env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_REPEATS"] = "3"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # bypass the TPU relay
+    if mode == "tpcc_child":
+        # TPC-C is a HOST path (txn machinery, index fastpaths);
+        # statements that do fall to a compiled scan should compile
+        # for the host CPU, not pay a ~60-90ms tunnel round trip per
+        # dispatch on the remote chip. (The round-5 regression gate
+        # caught exactly this: 10-warehouse tpmC read 34 under the
+        # tunnel platform vs ~125-136 on the host.) YCSB stays on the
+        # default platform: the OLTP lane never dispatches to the
+        # device, and measured faster there.
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     for attempt in range(attempts):
         try:
             out = subprocess.run(
