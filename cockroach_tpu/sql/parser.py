@@ -457,8 +457,37 @@ class Parser:
                     cmp = ast.BinOp("and", ast.IsNull(left, negated=True),
                                     ast.UnaryOp("not", left))
                     left = ast.UnaryOp("not", cmp) if neg else cmp
+                elif self.accept_kw("distinct"):
+                    # IS [NOT] DISTINCT FROM: null-safe comparison,
+                    # desugared to a three-valued-logic-exact form that
+                    # never yields NULL:
+                    #   NOT DISTINCT = (a NULL AND b NULL)
+                    #               OR (a NOT NULL AND b NOT NULL
+                    #                   AND a = b)
+                    if not self.accept_kw("from"):
+                        raise ParseError(
+                            f"expected FROM after IS DISTINCT at "
+                            f"{self.peek()}")
+                    rhs = self.parse_expr(36)
+                    both_null = ast.BinOp(
+                        "and", ast.IsNull(left),
+                        ast.IsNull(rhs))
+                    both_set_eq = ast.BinOp(
+                        "and",
+                        ast.BinOp("and",
+                                  ast.IsNull(left, negated=True),
+                                  ast.IsNull(rhs, negated=True)),
+                        ast.BinOp("=", left, rhs))
+                    not_distinct = ast.BinOp("or", both_null,
+                                             both_set_eq)
+                    # note the polarity: IS DISTINCT (neg=False)
+                    # negates NOT-DISTINCT
+                    left = not_distinct if neg \
+                        else ast.UnaryOp("not", not_distinct)
                 else:
-                    raise ParseError(f"expected NULL/TRUE/FALSE after IS at {self.peek()}")
+                    raise ParseError(f"expected NULL/TRUE/FALSE/"
+                                     f"DISTINCT FROM after IS at "
+                                     f"{self.peek()}")
                 continue
             if t.kind == Tok.OP and t.text == "[":
                 # subscript binds tightest of the postfix operators
