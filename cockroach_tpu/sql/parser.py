@@ -379,6 +379,33 @@ class Parser:
             alias = self.expect_ident()
             return ast.TableRef(alias, alias, subquery=sub)
         name = self.expect_ident()
+        if self.peek().kind == Tok.OP and self.peek().text == "(":
+            # set-returning function in FROM position:
+            #   FROM generate_series(a, b) [AS] g[(col)]
+            # desugars to the supported derived-table shape
+            #   (SELECT fn(...) AS col) AS g
+            self.next()
+            args = []
+            if not (self.peek().kind == Tok.OP
+                    and self.peek().text == ")"):
+                args.append(self.parse_expr(0))
+                while self.accept_op(","):
+                    args.append(self.parse_expr(0))
+            self.expect_op(")")
+            self.accept_kw("as")
+            alias = name
+            if self.peek().kind == Tok.IDENT:
+                alias = self.next().text
+            col = alias
+            if self.peek().kind == Tok.OP and self.peek().text == "(":
+                self.next()
+                col = self.expect_ident()
+                self.expect_op(")")
+            sub = ast.Select(
+                items=[ast.SelectItem(
+                    ast.FuncCall(name, args), alias=col)],
+                table=None)
+            return ast.TableRef(alias, alias, subquery=sub)
         alias = None
         if self.peek().is_kw("as") and not (
                 self.peek(1).kind == Tok.IDENT
