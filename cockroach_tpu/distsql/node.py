@@ -40,6 +40,14 @@ class FlowError(Exception):
     pass
 
 
+class FlowUnavailable(FlowError):
+    """The flow failed because a participant is gone (breaker tripped,
+    streams stalled, node died mid-flow) — NOT because the statement
+    itself errored. Only this flavor is safe to replan or degrade to
+    gateway-local execution; a remote execution error must propagate
+    (re-running it elsewhere would just hide the bug)."""
+
+
 def _xstream(edge: int, producer: int, consumer: int) -> str:
     """Stream id of one exchange-edge producer→consumer pair (unique
     so per-stream credit accounting stays exact)."""
@@ -732,12 +740,23 @@ class Gateway:
         return bool(found)
 
     def run(self, sql: str, chunk_rows: int = 65536):
-        """Plan and run, replanning once over the surviving nodes if a
-        data node dies mid-flow (read-only statements are safely
-        retryable; the reference re-plans around dead nodes,
-        distsql_running.go:375). Cluster mode only: span partitioning
-        can reassign the dead node's ranges to surviving leaseholders,
-        whereas node-local shards die with their node."""
+        """Plan and run, degrading gracefully when a data node dies
+        mid-flow (read-only statements are safely retryable; the
+        reference re-plans around dead nodes, distsql_running.go:375).
+        Cluster mode only — span partitioning can reassign the dead
+        node's ranges to surviving leaseholders, whereas node-local
+        shards die with their node. Two rungs down:
+
+        1. replan: shrink the node set to the survivors and re-run the
+           whole statement (lost partial-aggregate fragments recompute
+           on the new span assignment);
+        2. gateway-local fallback: materialize every referenced
+           table's FULL span from the range plane into the gateway's
+           own engine and execute there — the answer a 1-node cluster
+           would give, correct by construction.
+
+        Only FlowUnavailable (node death) degrades; a remote execution
+        error propagates unchanged."""
         def live() -> list:
             if self.cluster is None or self.monitor is None:
                 return list(self.nodes)
@@ -748,9 +767,39 @@ class Gateway:
                    if n == self.own.node_id or self.monitor.healthy(n)]
             return out or list(self.nodes)
 
+        from ..utils import log
         first = live()
         try:
             return self._run_once(sql, chunk_rows, first)
+        except FlowUnavailable as err:
+            if self.cluster is None:
+                raise
+            if not self._replannable(sql):
+                # partial fragments not mergeable across a replan:
+                # skip straight to the gateway-local rung
+                log.info(log.OPS,
+                         "flow fallback: %s; partials not replannable,"
+                         " running gateway-local", err)
+                return self._run_local_fallback(sql)
+            healthy = ([n for n in first
+                        if n == self.own.node_id
+                        or self.monitor.healthy(n)]
+                       if self.monitor is not None else [])
+            if healthy and healthy != first:
+                log.info(log.OPS,
+                         "flow replan: shrinking %s -> %s after "
+                         "failure (%s)", first, healthy, err)
+                try:
+                    return self._run_once(sql, chunk_rows, healthy)
+                except FlowUnavailable as err2:
+                    log.info(log.OPS,
+                             "flow fallback: replan failed too (%s); "
+                             "running gateway-local", err2)
+                    return self._run_local_fallback(sql)
+            log.info(log.OPS,
+                     "flow fallback: %s; no surviving subset to "
+                     "replan onto, running gateway-local", err)
+            return self._run_local_fallback(sql)
         except FlowError:
             if self.cluster is None or self.monitor is None:
                 raise
@@ -759,11 +808,41 @@ class Gateway:
                        or self.monitor.healthy(n)]
             if not healthy or healthy == first:
                 raise               # nothing to shrink onto
-            from ..utils import log
             log.info(log.OPS,
                      "flow replan: shrinking %s -> %s after failure",
                      first, healthy)
             return self._run_once(sql, chunk_rows, healthy)
+
+    def _replannable(self, sql: str) -> bool:
+        """Gate the distributed-replan rung: lost partial-aggregate
+        fragments may only be recomputed on a shrunken node set when
+        the partials merge associatively (parallel/distagg.py knows
+        which shapes those are). Planning errors don't block the
+        fallback ladder."""
+        from ..parallel.distagg import partials_replannable
+        try:
+            node, _ = Planner(
+                self.own.engine.catalog_view(int_ranges=False),
+                use_memo=False).plan_select(parser.parse(sql))
+        except Exception:       # noqa: BLE001 — fall through the ladder
+            return True
+        return partials_replannable(node)
+
+    def _run_local_fallback(self, sql: str):
+        """The bottom rung: pull every referenced table IN FULL from
+        the range plane into the gateway's engine and execute the
+        statement locally (the distributed GROUP BY under a crashed
+        producer returns the same rows a healthy cluster would,
+        instead of hanging — ISSUE: flow-level graceful degradation)."""
+        from cockroach_tpu.kv.rowfetch import RangeTable
+        eng = self.own.engine
+        node, _ = Planner(eng.catalog_view(int_ranges=False),
+                          use_memo=False).plan_select(parser.parse(sql))
+        for tname in sorted(set(_collect_scans(node).values())):
+            schema = eng.store.table(tname).schema
+            rt = RangeTable(self.cluster, schema)
+            rt.materialize_into(eng)       # spans=None: the full span
+        return eng.execute(sql)
 
     def _run_once(self, sql: str, chunk_rows: int = 65536,
                   nodes: list | None = None):
@@ -810,7 +889,7 @@ class Gateway:
             sick = [n for n in nodes if n != self.own.node_id
                     and not self.monitor.healthy(n)]
             if sick:
-                raise FlowError(
+                raise FlowUnavailable(
                     f"node(s) {sick} unhealthy (rpc breaker tripped); "
                     "not scheduling flow")
 
@@ -864,7 +943,7 @@ class Gateway:
             sick = [n for n in nodes if n != self.own.node_id
                     and not self.monitor.healthy(n)]
             if sick:
-                raise FlowError(
+                raise FlowUnavailable(
                     f"node(s) {sick} unhealthy (rpc breaker tripped); "
                     "not scheduling flow")
         registry = self.own.registry
@@ -945,7 +1024,7 @@ class Gateway:
                 sick = [n for n in waiting
                         if not self.monitor.healthy(n)]
                 if sick:
-                    fail_fast = FlowError(
+                    fail_fast = FlowUnavailable(
                         f"node(s) {sick} became unhealthy mid-flow")
                     break
             if transport.deliver_all() == 0 and \
@@ -964,7 +1043,7 @@ class Gateway:
             if errs:
                 raise FlowError("; ".join(errs))
             if not all(ib.eof for ib in inboxes):
-                raise FlowError("flow streams stalled")
+                raise FlowUnavailable("flow streams stalled")
             union, merged_dicts = self._union_batch(
                 [c for ib in inboxes for c in ib.drain_arrays()],
                 union_columns, string_cols)

@@ -119,9 +119,18 @@ def _hash_col(v: np.ndarray, ok: np.ndarray) -> np.ndarray:
             hv = np.zeros(n, dtype=np.uint64)
         else:
             m = np.frombuffer(b.tobytes(), dtype=np.uint8).reshape(n, w)
+            # fold only each row's REAL bytes: the fixed-width S dtype
+            # NUL-pads to the batch's longest string, and that width
+            # varies per producer batch — folding the padding would
+            # hash equal strings to different buckets on different
+            # nodes (co-partitioned joins silently dropping rows)
+            rowlen = np.char.str_len(b).astype(np.int64)
             hv = np.full(n, np.uint64(2166136261), dtype=np.uint64)
             for j in range(w):
-                hv = (hv ^ m[:, j].astype(np.uint64)) * _FNV
+                live = j < rowlen
+                hv = np.where(live,
+                              (hv ^ m[:, j].astype(np.uint64)) * _FNV,
+                              hv)
     else:
         if v.dtype.kind == "f":
             # normalize -0.0 == 0.0 before bit-hashing

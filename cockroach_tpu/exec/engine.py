@@ -30,7 +30,7 @@ from ..kv.txn import KVStore, Txn
 from ..ops.batch import ColumnBatch
 from ..parallel import mesh as meshmod
 from ..parallel.distagg import analyze as dist_analyze
-from ..parallel.distagg import make_distributed_fn
+from ..parallel.distagg import locked_collective_call, make_distributed_fn
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import ast, parser
 from ..sql import plan as P
@@ -1417,8 +1417,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                  jax.jit(splan.final_fn))
             elif decision is not None:
                 runf = compile_plan(node, params, meta)
-                jfn = jax.jit(make_distributed_fn(
-                    runf, self.mesh, scan_aliases, decision))
+                jfn = locked_collective_call(jax.jit(make_distributed_fn(
+                    runf, self.mesh, scan_aliases, decision)))
             else:
                 runf = compile_plan(node, params, meta)
 

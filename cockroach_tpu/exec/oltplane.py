@@ -38,6 +38,7 @@ from __future__ import annotations
 import ctypes
 import re
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -226,7 +227,8 @@ class LanePlan:
                  "out_decode", "out_pairs", "pk_lit", "lo_lit",
                  "lo_strict", "hi_lit", "hi_strict", "limit_lit",
                  "limit_const", "set_cols", "set_lits", "ins_cols",
-                 "ins_lits", "nlits", "order_desc", "td", "codec")
+                 "ins_lits", "nlits", "lit_kinds", "order_desc", "td",
+                 "codec")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -343,6 +345,15 @@ class OltpLaneMixin:
             return None
         if len(lits) != plan.nlits:
             return None
+        if plan.lit_kinds is not None and \
+                plan.lit_kinds != [isinstance(v, str) for v in lits]:
+            # literal-kind mismatch vs the cached classification
+            # (e.g. WHERE k = 'abc' hitting a shape built for
+            # WHERE k = 42): the full path binds it properly and
+            # raises a real SQL type error instead of a bare
+            # ValueError out of int()
+            return None
+        t0 = time.perf_counter()
         try:
             if plan.kind in ("point", "scan"):
                 res = self._lane_read(plan, lits, session)
@@ -352,7 +363,7 @@ class OltpLaneMixin:
             return None
         if res is not None:
             self.lane_hits += 1
-            self.sqlstats.record_fp(shape, 0.0,
+            self.sqlstats.record_fp(shape, time.perf_counter() - t0,
                                     max(len(res.rows), res.row_count))
         return res
 
@@ -363,6 +374,12 @@ class OltpLaneMixin:
             plan = self._lane_classify(shape, lits)
         except Exception:
             plan = None
+        if plan is not None:
+            # the plan was classified against THESE literal kinds (the
+            # sentinel SQL bakes int-vs-string into the parse); a later
+            # statement with the same shape but a different kind in
+            # some slot must take the full path, not int() a string
+            plan.lit_kinds = [isinstance(v, str) for v in lits]
         if len(self._lane_shapes) > 4096:
             self._lane_shapes.clear()
         self._lane_shapes[shape] = plan

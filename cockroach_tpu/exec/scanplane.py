@@ -16,7 +16,7 @@ import numpy as np
 from ..ops.batch import ColumnBatch
 from ..parallel import mesh as meshmod
 from ..parallel.distagg import analyze as dist_analyze
-from ..parallel.distagg import make_distributed_fn
+from ..parallel.distagg import locked_collective_call, make_distributed_fn
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import plan as P
 from ..storage.hlc import Timestamp
@@ -107,8 +107,10 @@ class ScanPlaneMixin:
                           if decision is not None else 1))
             runf = compile_plan(node, params, meta)
             if decision is not None:
-                jfn = jax.jit(make_distributed_fn(
-                    runf, self.mesh, _collect_scans(node), decision))
+                jfn = locked_collective_call(jax.jit(
+                    make_distributed_fn(
+                        runf, self.mesh, _collect_scans(node),
+                        decision)))
             else:
                 def fn(scans_in, ts_in, np_, pid_):
                     return runf(RunContext(scans_in, ts_in, np_, pid_))

@@ -16,14 +16,27 @@ The transport is an in-process call into the target store (the gRPC
 from __future__ import annotations
 
 import copy
-from dataclasses import dataclass, field
+import random
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from cockroach_tpu.kv.rangecache import RangeCache
 from cockroach_tpu.kvserver.cluster import Cluster, NotLeaseholderError
 from cockroach_tpu.kvserver.store import (RangeBoundsError, _enc_ts,
                                           raise_op_error)
+from cockroach_tpu.rpc.retry import (DeadlineExceeded, Retrier,
+                                     RetryPolicy)
 from cockroach_tpu.storage.hlc import Timestamp
+from cockroach_tpu.utils.circuit import Breaker, BreakerTrippedError
+
+# the pump-driven cluster has no wall clock: backoff seconds convert
+# to pump iterations at the NetCluster pump cadence (5ms/iteration)
+_PUMP_SECONDS = 0.005
+
+# one retry policy for every DistSender request (replaces the old
+# per-call `attempts=8` constants; see rpc/retry.py + ROBUSTNESS.md)
+DEFAULT_POLICY = RetryPolicy(max_attempts=8, base_backoff=0.005,
+                             max_backoff=0.16, deadline=30.0)
 
 
 class RangeKeyMismatchError(Exception):
@@ -56,11 +69,33 @@ class BatchRequest:
 
 
 class DistSender:
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster,
+                 policy: RetryPolicy = DEFAULT_POLICY,
+                 seed: int = 0):
         self.cluster = cluster
         self.cache = RangeCache()
+        self.policy = policy
+        self.rng = random.Random(seed)   # seeded jitter: deterministic
+        # per-node breakers (dist_sender's moral copy of the reference
+        # per-replica breakers): a down node trips; the probe heals it
+        # the moment the authority stops listing it as down
+        self.node_breakers: dict[int, Breaker] = {}
         self.retries = 0
         self.rpcs = 0
+
+    def _node_breaker(self, nid: int) -> Breaker:
+        b = self.node_breakers.get(nid)
+        if b is None:
+            b = Breaker(f"distsender->n{nid}", threshold=1,
+                        probe=lambda n=nid: n not in self.cluster.down)
+            self.node_breakers[nid] = b
+        return b
+
+    def _pause(self, attempt: int) -> None:
+        """Backoff between attempts, in pump iterations (the
+        deterministic clusters have no wall clock to sleep on)."""
+        b = self.policy.backoff(attempt, self.rng)
+        self.cluster.pump(max(2, int(b / _PUMP_SECONDS)))
 
     # ------------------------------------------------------------------
     # meta lookup (the meta-range scan of the reference)
@@ -98,9 +133,13 @@ class DistSender:
                 results[i] = self._send_point(op, ts)
         return results
 
-    def _send_point(self, op: dict, ts: Timestamp, attempts: int = 8):
+    def _send_point(self, op: dict, ts: Timestamp,
+                    attempts: Optional[int] = None):
         key = op["key"]
-        for _ in range(attempts):
+        pol = self.policy if attempts is None else \
+            replace(self.policy, max_attempts=attempts)
+        r = Retrier(pol, self.rng)
+        for attempt in r:
             entry = self._entry_for(key)
             desc = entry.desc
             try:
@@ -114,7 +153,11 @@ class DistSender:
                     self.cache.update_leaseholder(key, e.hint)
                 else:
                     self.cache.evict(key)
-                self.cluster.pump(2)
+                self._pause(attempt + 1)
+        if r.expired():
+            raise DeadlineExceeded(
+                f"batch op to {key!r} exceeded its "
+                f"{pol.deadline}s deadline")
         raise RuntimeError(f"batch op to {key!r} exhausted retries")
 
     def _send_scan(self, op: dict, ts: Timestamp) -> list:
@@ -124,10 +167,15 @@ class DistSender:
         cur, end = op["start"], op["end"]
         limit = op.get("limit", 0)
         failures = 0
+        r = Retrier(self.policy, self.rng)
         while cur < end:
-            if failures > 8:
+            if failures >= self.policy.max_attempts:
                 raise RuntimeError(f"scan piece at {cur!r} exhausted "
                                    "retries (range unavailable?)")
+            if failures and r.expired():
+                raise DeadlineExceeded(
+                    f"scan piece at {cur!r} exceeded its "
+                    f"{self.policy.deadline}s deadline")
             entry = self._entry_for(cur)
             desc = entry.desc
             piece = dict(op)
@@ -146,7 +194,7 @@ class DistSender:
                 self.retries += 1
                 failures += 1
                 self.cache.evict(cur)
-                self.cluster.pump(2)
+                self._pause(failures)
                 continue
             failures = 0
             cur = desc.end_key
@@ -159,7 +207,14 @@ class DistSender:
         order += [n for n in desc.replicas if n not in order]
         last_err: Exception = NotLeaseholderError()
         for nid in order:
+            b = self._node_breaker(nid)
             if nid in self.cluster.down:
+                b.report_failure()   # trips: later attempts fail fast
+                continue
+            try:
+                b.check()            # probe heals once it leaves down
+            except BreakerTrippedError:
+                last_err = NotLeaseholderError()
                 continue
             store = self.cluster.stores.get(nid)
             rep = store.replicas.get(desc.range_id) if store else None
@@ -191,7 +246,10 @@ class DistSender:
                         continue
                 else:
                     rep = lh_store.replicas[desc.range_id]
-            entry.leaseholder = rep.store.node_id
+            b.report_success()
+            entry.leaseholder = (rep.node_id
+                                 if not hasattr(rep, "store")
+                                 else rep.store.node_id)
             return self._execute(rep, op, ts)
         raise last_err
 

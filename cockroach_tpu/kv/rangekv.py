@@ -240,18 +240,32 @@ class ClusterTxnRegistry(TxnRegistry):
             existing = res.get("existing")
             if existing == "committed":
                 rec2 = read_txn_record(self.cluster, pushee)
+                if rec2 is None:
+                    # the CPut proved a committed record exists, but
+                    # the re-read could not reach the anchor range —
+                    # reporting COMMITTED without its commit_ts (or
+                    # worse, falling through to ABORTED) would let the
+                    # pusher resolve intents wrongly. Reachability !=
+                    # absence: PENDING, retry later.
+                    return TxnRecord(meta=pushee,
+                                     status=TxnStatus.PENDING)
                 return TxnRecord(
                     meta=pushee, status=TxnStatus.COMMITTED,
-                    commit_ts=rec2["ts"] if rec2 else None)
+                    commit_ts=rec2["ts"])
             if existing == "staging":
                 rec2 = read_txn_record(self.cluster, pushee)
-                if rec2 is not None:
-                    outcome, cts = recover_staging_txn(
-                        self.cluster, pushee, rec2)
-                    if outcome == "committed":
-                        return TxnRecord(meta=pushee,
-                                         status=TxnStatus.COMMITTED,
-                                         commit_ts=cts)
+                if rec2 is None:
+                    # same transient-unreachability case as above: a
+                    # staging record may have committed; ABORTED here
+                    # would remove intents of a commit in progress
+                    return TxnRecord(meta=pushee,
+                                     status=TxnStatus.PENDING)
+                outcome, cts = recover_staging_txn(
+                    self.cluster, pushee, rec2)
+                if outcome == "committed":
+                    return TxnRecord(meta=pushee,
+                                     status=TxnStatus.COMMITTED,
+                                     commit_ts=cts)
         return TxnRecord(meta=pushee, status=TxnStatus.ABORTED)
 
 

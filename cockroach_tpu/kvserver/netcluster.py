@@ -40,13 +40,16 @@ way the reference's raft scheduler goroutines do.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from ..rpc.context import SocketTransport
+from ..rpc.context import FaultInjector, SocketTransport
+from ..rpc.retry import RetryPolicy
+from ..utils.circuit import Breaker, BreakerTrippedError
 from ..storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from ..storage.mvcc import TxnMeta, WriteIntentError, WriteTooOldError
 from .cluster import (AmbiguousResultError, Cluster, NotLeaseholderError)
@@ -242,6 +245,15 @@ class NetCluster(Cluster):
     PUMP_INTERVAL = 0.005
     HEARTBEAT_EVERY = 4       # pump iterations between live broadcasts
     CALL_TIMEOUT = 15.0
+    # per-ATTEMPT timeouts for routed requests: short enough that one
+    # dead peer costs a couple of seconds, not CALL_TIMEOUT; the
+    # per-peer breaker then fails subsequent attempts fast (see
+    # ROBUSTNESS.md). Proposes get longer — raft commit is real work.
+    READ_ATTEMPT_TIMEOUT = 2.0
+    PROPOSE_ATTEMPT_TIMEOUT = 5.0
+    PEER_BREAKER_COOLDOWN = 2.0
+    ROUTE_POLICY = RetryPolicy(max_attempts=8, base_backoff=0.01,
+                               max_backoff=0.25, deadline=None)
     # replicated liveness (round-5: linearized control plane): each
     # node proposes {epoch, expiration} onto the system range holding
     # LIVENESS_KEY instead of trusting per-observer gossip expiry
@@ -255,7 +267,8 @@ class NetCluster(Cluster):
 
     def __init__(self, node_id: int, host: str = "127.0.0.1",
                  port: int = 0, join: dict | None = None,
-                 clock: Clock | None = None, liveness_ttl: int = 40):
+                 clock: Clock | None = None, liveness_ttl: int = 40,
+                 injector: FaultInjector | None = None):
         # deliberately NOT calling Cluster.__init__ (it builds N local
         # stores); replicate the attributes it sets
         self.node_id = node_id
@@ -264,9 +277,17 @@ class NetCluster(Cluster):
         self.descriptors = {}
         self.down = set()
         self.breakers = {}
+        # per-PEER breakers (the reference's per-replica breakers,
+        # replica_circuit_breaker.go): a peer that times out trips its
+        # breaker, and routed requests fail fast to the NEXT replica
+        # instead of eating a full timeout serially. Inbound traffic
+        # from the peer heals it (plus a cooldown half-open trial).
+        self.peer_breakers: dict[int, Breaker] = {}
         self.range_load = {}
         self._next_range_id = 1
-        self.rpc = SocketTransport(node_id, host, port)
+        self._retry_rng = random.Random(0xC0C0 ^ node_id)
+        self.rpc = SocketTransport(node_id, host, port,
+                                   injector=injector)
         self.wire = _RaftWire(self)
         self.stores = {node_id: Store(node_id, self.wire,
                                       clock=self.clock,
@@ -392,6 +413,11 @@ class NetCluster(Cluster):
         """Runs on the pump thread (rpc.deliver_all)."""
         if not isinstance(msg, dict):
             return
+        # any traffic from a peer proves it is reachable again: heal
+        # its breaker so routing stops failing fast to other replicas
+        b = self.peer_breakers.get(frm)
+        if b is not None and b.tripped:
+            b.reset()
         hlc = msg.get("hlc")
         if hlc:
             self.clock.update(Timestamp.from_int(hlc))
@@ -517,8 +543,18 @@ class NetCluster(Cluster):
             return cond()
 
     # -- request/response --------------------------------------------------
+    def peer_breaker(self, nid: int) -> Breaker:
+        b = self.peer_breakers.get(nid)
+        if b is None:
+            b = Breaker(f"n{self.node_id}->n{nid}", threshold=1,
+                        cooldown=self.PEER_BREAKER_COOLDOWN)
+            self.peer_breakers[nid] = b
+        return b
+
     def call(self, to: int, method: str, args: dict,
              timeout: float = None):
+        b = self.peer_breaker(to)
+        b.check()                 # BreakerTrippedError: fail fast
         rid = uuid.uuid4().hex[:16]
         slot = {"ev": threading.Event()}
         self._calls[rid] = slot
@@ -526,7 +562,9 @@ class NetCluster(Cluster):
                         "hlc": self.clock.now().to_int()})
         if not slot["ev"].wait(timeout or self.CALL_TIMEOUT):
             self._calls.pop(rid, None)
+            b.report_failure()
             raise _TimeoutError(f"rpc {method} to n{to} timed out")
+        b.report_success()
         resp = slot["resp"]
         if resp.get("ok"):
             return resp.get("result")
@@ -929,6 +967,7 @@ class NetCluster(Cluster):
             cmd["_id"] = f"{self.node_id}.{uuid.uuid4().hex[:16]}"
         timed_out = False
         tried = []
+        attempt = 0
         nid = first if first is not None else \
             (self._lease_cache.get(desc.range_id)
              or desc.replicas[0])
@@ -951,16 +990,26 @@ class NetCluster(Cluster):
             try:
                 r = self.call(nid, "propose",
                               {"range_id": desc.range_id, "cmd": cmd},
-                              timeout=timeout)
+                              timeout=(timeout or
+                                       self.PROPOSE_ATTEMPT_TIMEOUT))
                 self._lease_cache[desc.range_id] = nid
                 return r
             except NotLeaseholderError as e:
                 tried.append(nid)
                 nid = e.hint
+            except BreakerTrippedError:
+                # peer known-dead: fail fast to the next replica,
+                # no wait at all (the point of the breaker)
+                tried.append(nid)
+                nid = None
+                continue
             except _TimeoutError:
                 timed_out = True
                 tried.append(nid)
                 nid = None
+            attempt += 1
+            time.sleep(self.ROUTE_POLICY.backoff(attempt,
+                                                 self._retry_rng))
         if timed_out:
             # some attempt reached a peer and may still commit
             raise AmbiguousResultError(
@@ -972,6 +1021,7 @@ class NetCluster(Cluster):
 
     def _route_read(self, desc, args: dict, first: int = None):
         tried = []
+        attempt = 0
         nid = first if first is not None else \
             self._lease_cache.get(desc.range_id, desc.replicas[0])
         for _ in range(2 * len(desc.replicas) + 2):
@@ -990,15 +1040,23 @@ class NetCluster(Cluster):
                     nid = e.hint
                 continue
             try:
-                r = self.call(nid, "read", args)
+                r = self.call(nid, "read", args,
+                              timeout=self.READ_ATTEMPT_TIMEOUT)
                 self._lease_cache[desc.range_id] = nid
                 return r
             except NotLeaseholderError as e:
                 tried.append(nid)
                 nid = e.hint
+            except BreakerTrippedError:
+                tried.append(nid)   # fail fast to the next replica
+                nid = None
+                continue
             except _TimeoutError:
                 tried.append(nid)
                 nid = None
+            attempt += 1
+            time.sleep(self.ROUTE_POLICY.backoff(attempt,
+                                                 self._retry_rng))
         raise RuntimeError(
             f"r{desc.range_id}: no reachable leaseholder for read")
 

@@ -30,10 +30,19 @@ Sort/Limit/HAVING above the Aggregate run identically on every shard.
 
 from __future__ import annotations
 
+import functools
+import threading
 from dataclasses import dataclass
 
 import jax
-from jax import shard_map
+
+try:                                     # jax >= 0.5 exports it top-level
+    from jax import shard_map
+    _SM_CHECK_KW = "check_vma"
+except ImportError:                      # older jax: experimental path,
+    # where the replication-check kwarg is still called check_rep
+    from jax.experimental.shard_map import shard_map
+    _SM_CHECK_KW = "check_rep"
 
 from ..sql import plan as P
 from . import mesh as meshmod
@@ -89,6 +98,48 @@ def analyze(node: P.PlanNode) -> DistDecision:
     return DistDecision(True, sharded, replicated)
 
 
+def partials_replannable(node: P.PlanNode) -> bool:
+    """May a flow that lost a producer re-run this statement's partial
+    fragments on a shrunken node set (distsql/node.py Gateway.run)?
+
+    Yes when the partial aggregates merge associatively — sum/count/
+    min/max partials recomputed under a different span assignment
+    still combine to the same final answer. DISTINCT aggregates are
+    the exception (their partials are sets, and our partial stage
+    doesn't ship them); those degrade straight to gateway-local
+    execution. Non-aggregate reads carry no partial state at all and
+    are trivially replannable."""
+    n = node
+    if isinstance(n, P.Limit):
+        n = n.child
+    if isinstance(n, P.Sort):
+        n = n.child
+    if not isinstance(n, P.Aggregate):
+        return True
+    return not any(a.distinct for a in n.aggs)
+
+
+# XLA's host-platform collectives rendezvous by participant count:
+# when two 8-participant AllReduce executions interleave from
+# different threads, each grabs some of the device slots and both
+# wait forever (collective_ops_utils.h "may be stuck"). Concurrent
+# SQL sessions therefore serialize their DISTRIBUTED executions on
+# one process-wide lock; single-device plans are unaffected.
+_COLLECTIVE_CALL_LOCK = threading.Lock()
+
+
+def locked_collective_call(jfn):
+    """Wrap a jitted multi-device callable so concurrent sessions
+    cannot interleave collective rendezvous (deadlock otherwise —
+    this must wrap the CALL: a lock inside the traced function would
+    only run at trace time)."""
+    @functools.wraps(jfn)
+    def call(*args, **kwargs):
+        with _COLLECTIVE_CALL_LOCK:
+            return jfn(*args, **kwargs)
+    return call
+
+
 def make_distributed_fn(runf, mesh, scan_aliases: dict, decision: DistDecision):
     """Wrap a compiled plan function in shard_map over `mesh`.
 
@@ -116,5 +167,6 @@ def make_distributed_fn(runf, mesh, scan_aliases: dict, decision: DistDecision):
         in_specs = (spec_for_scans(scans), repl_leaf, repl_leaf, repl_leaf)
         return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=repl_leaf,
-                         check_vma=False)(scans, read_ts, nparts, pid)
+                         **{_SM_CHECK_KW: False})(scans, read_ts,
+                                                  nparts, pid)
     return wrapped

@@ -1,7 +1,10 @@
 """Cluster fabric: socket RPC transport + gossip (reference: pkg/rpc,
 pkg/gossip)."""
 
-from .context import SocketTransport, encode_msg, decode_msg
+from .context import (FaultInjector, SocketTransport, encode_msg,
+                      decode_msg)
 from .gossip import Gossip
+from .retry import DeadlineExceeded, Retrier, RetryPolicy
 
-__all__ = ["SocketTransport", "Gossip", "encode_msg", "decode_msg"]
+__all__ = ["FaultInjector", "SocketTransport", "Gossip", "encode_msg",
+           "decode_msg", "RetryPolicy", "Retrier", "DeadlineExceeded"]

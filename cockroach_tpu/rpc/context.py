@@ -21,14 +21,98 @@ data, never code).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import deque
 from typing import Callable, Optional
 
 _BYTES_MARK = "__b__"  # JSON placeholder: {"__b__": [offset, length]}
+
+
+class FaultInjector:
+    """Seeded per-peer-pair fault schedule for the socket fabric.
+
+    The SocketTransport face of the in-process ChaosTransport
+    (kvserver/transport.py): one injector instance is shared by every
+    transport of a test cluster, so ``test_netcluster``-style clusters
+    run the same nemesis schedules the raft harness does — drop,
+    delay, duplicate, and partition framed messages per (frm, to)
+    pair, deterministically from one seed.
+
+    Rules are consulted at SEND time (outbound faults — the moral
+    equivalent of the reference's TestingKnobs raft-message filters);
+    partitions are additionally enforced at delivery time so frames
+    already queued when the partition lands are dropped too.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # (frm, to) -> {"drop": p, "dup": p, "delay": p, "delay_s": s}
+        self._rules: dict[tuple[int, int], dict] = {}
+        self._parted: set[frozenset] = set()
+        self.dropped = 0
+        self.duplicated = 0
+        self.delayed = 0
+
+    # -- schedule configuration -----------------------------------------
+    def set_rule(self, frm: int, to: int, drop: float = 0.0,
+                 dup: float = 0.0, delay: float = 0.0,
+                 delay_s: float = 0.05,
+                 symmetric: bool = False) -> None:
+        rule = {"drop": drop, "dup": dup, "delay": delay,
+                "delay_s": delay_s}
+        with self._lock:
+            self._rules[(frm, to)] = rule
+            if symmetric:
+                self._rules[(to, frm)] = dict(rule)
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def partition(self, a: int, b: int) -> None:
+        with self._lock:
+            self._parted.add(frozenset((a, b)))
+
+    def heal(self, a: Optional[int] = None,
+             b: Optional[int] = None) -> None:
+        with self._lock:
+            if a is None:
+                self._parted.clear()
+            else:
+                self._parted.discard(frozenset((a, b)))
+
+    def partitioned(self, a: int, b: int) -> bool:
+        return frozenset((a, b)) in self._parted
+
+    # -- the per-frame decision ------------------------------------------
+    def plan(self, frm: int, to: int) -> list[float]:
+        """Delivery schedule for one frame: a list of delays in
+        seconds — ``[]`` drop, ``[0.0]`` deliver now, ``[0.0, 0.0]``
+        duplicate, ``[delay_s]`` delay."""
+        if self.partitioned(frm, to):
+            self.dropped += 1
+            return []
+        with self._lock:
+            rule = self._rules.get((frm, to))
+            if rule is None:
+                return [0.0]
+            r = self._rng.random()
+        if r < rule["drop"]:
+            self.dropped += 1
+            return []
+        if r < rule["drop"] + rule["delay"]:
+            self.delayed += 1
+            return [rule["delay_s"]]
+        if r < rule["drop"] + rule["delay"] + rule["dup"]:
+            self.duplicated += 1
+            return [0.0, 0.0]
+        return [0.0]
 
 
 def encode_msg(msg) -> bytes:
@@ -81,7 +165,8 @@ class SocketTransport:
     is_async = True  # consumers poll with a deadline, not spin-once
 
     def __init__(self, node_id: int, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 injector: Optional[FaultInjector] = None):
         self.node_id = node_id
         self._handlers: dict[int, Callable] = {}
         self._queue: deque = deque()
@@ -94,6 +179,10 @@ class SocketTransport:
         # face of LocalTransport.partition; netcluster partition
         # tests use it to split real fabrics)
         self._parted: set[int] = set()
+        # seeded nemesis schedule shared by every transport of a test
+        # cluster: drop/delay/duplicate/partition per peer-pair
+        self.injector = injector
+        self._delayed: list = []     # (due_monotonic, to, msg)
         self.sent = 0
         self.delivered = 0
         outer = self
@@ -152,6 +241,19 @@ class SocketTransport:
         self.sent += 1
         if to in self._parted:
             return                     # partitioned: dropped
+        if self.injector is not None:
+            for d in self.injector.plan(frm, to):
+                if d <= 0:
+                    self._ship(frm, to, msg)
+                else:
+                    with self._qlock:
+                        self._delayed.append(
+                            (time.monotonic() + d, frm, to, msg))
+            return
+        self._ship(frm, to, msg)
+
+    def _ship(self, frm: int, to: int, msg) -> None:
+        """Deliver locally or frame onto the peer's socket."""
         if to in self._handlers:       # local delivery
             with self._qlock:
                 self._queue.append((frm, to, msg))
@@ -172,7 +274,18 @@ class SocketTransport:
                 self._conns.pop(to, None)  # peer down: drop (retry on
                 # the next send, like gRPC connection re-dial)
 
+    def _flush_delayed(self) -> None:
+        if not self._delayed:
+            return
+        now = time.monotonic()
+        with self._qlock:
+            due = [d for d in self._delayed if d[0] <= now]
+            self._delayed = [d for d in self._delayed if d[0] > now]
+        for _, frm, to, msg in due:
+            self._ship(frm, to, msg)
+
     def deliver_all(self) -> int:
+        self._flush_delayed()
         with self._qlock:
             batch = list(self._queue)
             self._queue.clear()
@@ -180,6 +293,10 @@ class SocketTransport:
         for frm, to, msg in batch:
             if frm in self._parted:
                 continue               # partitioned: dropped
+            if self.injector is not None and \
+                    self.injector.partitioned(frm, self.node_id):
+                continue               # frames in flight when the
+                # partition landed are dropped on delivery too
             h = self._handlers.get(to)
             if h is not None:
                 h(frm, msg)
@@ -188,7 +305,7 @@ class SocketTransport:
         return n
 
     def pending(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._delayed)
 
     def close(self) -> None:
         self._server.shutdown()

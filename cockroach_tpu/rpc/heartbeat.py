@@ -21,6 +21,22 @@ PING = "rpc_ping"
 PONG = "rpc_pong"
 
 
+class LivenessMonitor:
+    """Adapter: expose a kvserver liveness view through the
+    ``healthy(node)`` surface the DistSQL gateway consumes (its
+    ``monitor`` slot), so flow scheduling and the mid-flow fail-fast
+    poll judge producers by the same records lease validity uses
+    (kvserver/liveness.py) instead of needing a second heartbeat
+    plane. Accepts anything with ``is_live(node_id)`` — a
+    NodeLiveness, or a Cluster via its ``.liveness``."""
+
+    def __init__(self, liveness):
+        self.liveness = getattr(liveness, "liveness", liveness)
+
+    def healthy(self, peer: int) -> bool:
+        return bool(self.liveness.is_live(peer))
+
+
 class PeerMonitor:
     """Heartbeats for one node's view of its peers.
 

@@ -34,6 +34,9 @@ class NodeConfig:
     rpc_port: int | None = None
     join: dict | None = None
     gossip_interval: float = 0.2
+    # tests: a shared rpc.FaultInjector (seeded nemesis schedule for
+    # the socket fabric); None = faults off
+    fault_injector: object = None
     # background maintenance loop: orphaned-job adoption + MVCC GC
     # passes (the store queues / job registry adoption loops of the
     # reference); None disables
@@ -208,7 +211,8 @@ class Node:
 
         cfg = self.config
         self.rpc = SocketTransport(cfg.node_id, cfg.listen_host,
-                                   cfg.rpc_port)
+                                   cfg.rpc_port,
+                                   injector=cfg.fault_injector)
         peers = [cfg.node_id]
         for nid, addr in (cfg.join or {}).items():
             self.rpc.connect(nid, tuple(addr))

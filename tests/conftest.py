@@ -28,3 +28,10 @@ import jax  # noqa: E402
 # through jax.config so tests always see the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so the
+    # deselection is declared, not a typo (PytestUnknownMarkWarning)
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")

@@ -148,7 +148,14 @@ def _run_file(path: str, config: dict) -> None:
 FILES = sorted(glob.glob(os.path.join(DIR, "*.td")))
 
 
-@pytest.mark.parametrize("config", sorted(CONFIGS))
+# cluster-backed configs pay a per-file raft bring-up, which puts the
+# full corpus x {3node, 3node-mesh} outside the tier-1 time budget;
+# they still run under `-m slow` (and in any unfiltered run)
+@pytest.mark.parametrize(
+    "config",
+    [pytest.param(c, marks=([pytest.mark.slow]
+                            if CONFIGS[c].get("cluster") else []))
+     for c in sorted(CONFIGS)])
 @pytest.mark.parametrize(
     "path", FILES, ids=[os.path.basename(p) for p in FILES])
 def test_logic(path, config):
@@ -169,6 +176,7 @@ _SOCKET_FILES = (FILES if os.environ.get("LOGIC_SOCKET_ALL")
                        if os.path.basename(p) in _SOCKET_SMOKE])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "path", _SOCKET_FILES,
     ids=[os.path.basename(p) for p in _SOCKET_FILES])

@@ -20,6 +20,9 @@ from cockroach_tpu.exec.engine import Engine
 import os
 
 N_QUERIES = int(os.environ.get("FUZZ_QUERIES", 120))
+
+# differential fuzzing is a soak lane, not a tier-1 gate
+pytestmark = pytest.mark.slow
 SEED = int(os.environ.get("FUZZ_SEED", 20260730))
 
 

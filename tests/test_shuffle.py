@@ -6,9 +6,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 
+from cockroach_tpu.parallel.distagg import _SM_CHECK_KW
+from cockroach_tpu.parallel.distagg import shard_map as _sm
 from cockroach_tpu.parallel import shuffle
+
+
+def shard_map(*a, **kw):        # version shim (parallel/distagg.py)
+    kw[_SM_CHECK_KW] = kw.pop("check_vma", False)
+    return _sm(*a, **kw)
 from cockroach_tpu.parallel.mesh import (SHARD_AXIS, make_mesh,
                                          replicated_spec, shard_spec)
 

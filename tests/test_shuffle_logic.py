@@ -31,6 +31,12 @@ from cockroach_tpu.sql import parser
 from cockroach_tpu.sql.planner import Planner
 from tests.datadriven import run_datadriven
 
+# the full corpus re-runs every logic file through the 3-node shuffle
+# mirror (~2.5 min on CPU) — differential depth that belongs in the
+# slow lane; tier-1 keeps test_shuffle / test_shuffle_flows /
+# test_fault_injection for the shuffle paths
+pytestmark = pytest.mark.slow
+
 DIR = os.path.join(os.path.dirname(__file__), "testdata", "logic_test")
 FILES = sorted(glob.glob(os.path.join(DIR, "*.td")))
 
