@@ -47,6 +47,27 @@ import time
 BASELINE_ROWS_PER_SEC = 1.25e8  # assumed colexec-equivalent Q6 throughput
 
 
+def metric_deltas(before: dict, after: dict) -> dict:
+    """Registry-snapshot delta across one benchmarked query: counter/
+    gauge movement plus histogram count growth. Gives each BENCH
+    record the engine's own accounting of what the run did (device
+    uploads, collective dispatches, plan-cache traffic) next to the
+    throughput number it produced."""
+    out = {}
+    for k, av in after.items():
+        bv = before.get(k, 0)
+        if isinstance(av, dict):  # histogram: compare observation counts
+            d = av.get("count", 0) - (bv.get("count", 0)
+                                      if isinstance(bv, dict) else 0)
+            if d:
+                out[k + ".count"] = d
+        elif isinstance(av, (int, float)) and not isinstance(av, bool):
+            d = av - (bv if isinstance(bv, (int, float)) else 0)
+            if d:
+                out[k] = round(d, 6) if isinstance(d, float) else d
+    return out
+
+
 def bench_query(eng, sql, rows, pipeline, repeats, lat_probes=3):
     import jax
 
@@ -128,6 +149,7 @@ def run(rows_by_query, pipeline, repeats, tag=""):
 
     results = {}
     rows_used = {}
+    deltas = {}
     # group queries sharing a row count onto one engine/dataset
     by_rows: dict[int, list] = {}
     for which, rows in rows_by_query.items():
@@ -155,9 +177,11 @@ def run(rows_by_query, pipeline, repeats, tag=""):
                 which, (pipeline, repeats, 3))
             q_pipe = min(pipeline, o_pipe)
             q_reps = min(repeats, o_reps)
+            snap0 = eng.metrics.snapshot()
             rps, lat, warm_s, rates = bench_query(
                 eng, tpch.QUERIES[which], rows, q_pipe, q_reps,
                 lat_probes=o_lat)
+            deltas[which] = metric_deltas(snap0, eng.metrics.snapshot())
             results[which] = rps
             rows_used[which] = rows
             gbps = ""
@@ -175,9 +199,16 @@ def run(rows_by_query, pipeline, repeats, tag=""):
                   f"rates_Mrps={['%.0f' % (r / 1e6) for r in rates]}"
                   f"{gbps}",
                   file=sys.stderr)
+            interesting = {k: v for k, v in deltas[which].items()
+                           if k.startswith(("exec.", "sql.device",
+                                            "sql.plan"))}
+            if interesting:
+                print(f"# {tag}{which} metric deltas: "
+                      f"{json.dumps(interesting, sort_keys=True)}",
+                      file=sys.stderr)
         print(f"# {tag}datagen_s={gen_s:.1f} rows={rows}", file=sys.stderr)
         del eng
-    return results, rows_used
+    return results, rows_used, deltas
 
 
 def run_ssb(rows, pipeline, repeats):
@@ -357,7 +388,8 @@ def main():
     if mode in ("cpu", "tpu_child"):
         # leaf mode: measure in-process and emit one JSON line
         tag = "cpu " if mode == "cpu" else ""
-        results, rows_used = run(rows_by_query, pipeline, repeats, tag=tag)
+        results, rows_used, deltas = run(rows_by_query, pipeline,
+                                         repeats, tag=tag)
         primary = queries[0]
         print(json.dumps({
             "metric": f"tpch_{primary}_rows_per_sec",
@@ -369,6 +401,7 @@ def main():
                if not w.endswith("_gbps")},
             **{f"{w[:-5]}_effective_gbps": round(r, 1)
                for w, r in results.items() if w.endswith("_gbps")},
+            "metric_deltas": deltas,
         }))
         return
 
@@ -388,11 +421,13 @@ def main():
     results = {}
     rows_used = {}
     gbps_keys = {}
+    all_deltas = {}
     for q in queries:  # q6 first: the primary metric lands early
         r = run_child(rows_by_query[q], q, child_timeout)
         if r is not None:
             results[q] = r["value"]
             rows_used[q] = r["rows"]
+            all_deltas.update(r.get("metric_deltas") or {})
             # round-4 weak #5: the child computed effective_GBps but
             # the parent dropped it, so the roofline metric never
             # reached the persisted BENCH record — forward it
@@ -418,6 +453,10 @@ def main():
         out[f"{which}_rows_per_sec"] = round(rps)
         out[f"{which}_rows"] = rows_used[which]
     out.update(gbps_keys)
+    if all_deltas:
+        # per-query registry movement (uploads, collective dispatches,
+        # plan-cache traffic) recorded next to the rates they explain
+        out["metric_deltas"] = all_deltas
 
     if cpu is not None:
         out[f"cpu_{cpu_query}_rows_per_sec"] = cpu["value"]

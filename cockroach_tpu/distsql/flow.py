@@ -70,6 +70,10 @@ class FlowSpec:
     # ordered data-node set exchange buckets route over
     graph: Optional[str] = None
     data_nodes: Optional[list] = None
+    # distributed tracing: when the gateway's statement is recording,
+    # remote nodes run their stage under a local capture and ship the
+    # finished span subtree back ahead of EOF (a "flow_span" frame)
+    trace: bool = False
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -77,7 +81,8 @@ class FlowSpec:
                 "stream_id": self.stream_id,
                 "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
                 "window": self.window, "spans": self.spans,
-                "graph": self.graph, "data_nodes": self.data_nodes}
+                "graph": self.graph, "data_nodes": self.data_nodes,
+                "trace": self.trace}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":
@@ -93,11 +98,14 @@ class Inbox:
         self.chunks: deque[bytes] = deque()
         self.eof = False
         self.error: Optional[str] = None
+        self.spans: list[dict] = []   # remote span subtrees (wire)
+        self.bytes_received = 0
 
     def push(self, chunk: Optional[bytes], eof: bool,
              error: Optional[str] = None) -> None:
         if chunk is not None:
             self.chunks.append(chunk)
+            self.bytes_received += len(chunk)
         if error is not None:
             self.error = error
             self.eof = True
@@ -152,7 +160,13 @@ class Outbox:
         self.node = node
         self.window = window
         self.chunks_sent = 0
+        self.bytes_sent = 0
         self.max_outstanding = 0
+        reg = getattr(node, "metrics", None) if node is not None \
+            else None
+        self._m_bytes = None if reg is None else reg.counter(
+            "shuffle.bytes.sent",
+            "serialized chunk bytes shipped to flow consumers")
 
     def _send(self, chunk: Optional[bytes], eof: bool,
               error: Optional[str] = None) -> None:
@@ -201,6 +215,9 @@ class Outbox:
         self._await_credit()
         self._send(chunk, False)
         self.chunks_sent += 1
+        self.bytes_sent += len(chunk)
+        if self._m_bytes is not None:
+            self._m_bytes.inc(len(chunk))
         self.max_outstanding = max(self.max_outstanding,
                                    self._outstanding())
 

@@ -34,6 +34,7 @@ from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
 from cockroach_tpu.ops.batch import ColumnBatch
 from cockroach_tpu.sql import parser
 from cockroach_tpu.sql.planner import Planner, PlanError
+from cockroach_tpu.utils import tracing
 
 
 class FlowError(Exception):
@@ -65,6 +66,7 @@ class _GraphFlowState:
         self.started: set[int] = set()
         self.done: set[int] = set()
         self.running = False
+        self.spans: list[dict] = []   # per-stage recordings (wire)
 
 
 def _arrays_to_batch(chunks, columns, string_cols, shared_dict):
@@ -124,6 +126,9 @@ class DistSQLNode:
         # carrying spans materialize them from the range plane
         self.cluster = cluster
         self.registry = FlowRegistry()
+        # the engine's registry: flow/shuffle metrics land next to the
+        # SQL metrics so one /_status/vars scrape covers the node
+        self.metrics = getattr(engine, "metrics", None)
         transport.register(node_id, self._handle)
         self.flows_run = 0
         self.flows_cancelled = 0
@@ -155,6 +160,11 @@ class DistSQLNode:
                 return
             self.registry.inbox(flow_id, stream_id).push(chunk, eof, error)
             if chunk is not None:
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "shuffle.bytes.received",
+                        "serialized chunk bytes received from flow "
+                        "producers").inc(len(chunk))
                 # consumer side of the credit loop: one ack per data
                 # chunk, returned to the producer that sent it
                 self.transport.send(self.node_id, frm,
@@ -163,6 +173,13 @@ class DistSQLNode:
                 # an exchange stream finished: some stage may now be
                 # runnable
                 self._graph_try_run(flow_id)
+        elif kind == "flow_span":
+            # a producer's finished recording (shipped ahead of its
+            # EOF so the gateway sees it before the pump loop exits)
+            _, flow_id, stream_id, wire = payload
+            if flow_id not in self.cancelled_flows:
+                self.registry.inbox(flow_id, stream_id).spans.append(
+                    wire)
         elif kind == "flow_ack":
             _, flow_id, stream_id, n = payload
             key = (flow_id, stream_id)
@@ -193,12 +210,23 @@ class DistSQLNode:
         self._producing.add((spec.flow_id, spec.stream_id))
         try:
             self.flows_run += 1
-            if spec.spans is not None:
-                self._materialize_spans(spec.spans)
-            batch, stage = self._run_local(spec)
-            n, cols, valid = self._host_output(batch, stage.local,
-                                               stage.string_cols)
-            outbox.send_arrays(n, cols, valid, spec.chunk_rows)
+
+            def body():
+                if spec.spans is not None:
+                    self._materialize_spans(spec.spans)
+                batch, stage = self._run_local(spec)
+                n, cols, valid = self._host_output(batch, stage.local,
+                                                   stage.string_cols)
+                outbox.send_arrays(n, cols, valid, spec.chunk_rows)
+            if spec.trace:
+                # record this stage locally and ship the subtree back
+                # BEFORE EOF (the gateway's pump loop exits on EOF)
+                with tracing.capture("flow", node=self.node_id,
+                                     stage=spec.stage) as rec:
+                    body()
+                self._send_flow_span(spec, tracing.span_to_wire(rec))
+            else:
+                body()
             outbox.close()
         except FlowCancelled:
             # the gateway told us to stop: abort quietly, nothing to
@@ -211,6 +239,11 @@ class DistSQLNode:
                                        outbox.max_outstanding)
             self._producing.discard((spec.flow_id, spec.stream_id))
             self.acks.pop((spec.flow_id, spec.stream_id), None)
+
+    def _send_flow_span(self, spec: FlowSpec, wire: dict) -> None:
+        self.transport.send(self.node_id, spec.gateway,
+                            ("flow_span", spec.flow_id,
+                             spec.stream_id, wire))
 
     def _materialize_spans(self, spans: dict) -> None:
         """Refresh this node's scan plane with its leaseholder span
@@ -495,11 +528,9 @@ class DistSQLNode:
                 rec(n.child)
         rec(plan)
 
-    def _run_stage(self, st: _GraphFlowState, stage) -> None:
-        from cockroach_tpu.storage.columnstore import Dictionary
+    def _stage_batch(self, st: _GraphFlowState, stage, shared):
         spec = st.spec
         eng = self.engine
-        shared = Dictionary()
         scans = {}
         # real-table scans upload wide (same reasoning as _run_local:
         # narrowing decisions must not depend on the local shard)
@@ -514,7 +545,19 @@ class DistSQLNode:
         runf = compile_plan(stage.plan, ExecParams())
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
-        batch = runf(RunContext(scans, read_ts))
+        return runf(RunContext(scans, read_ts))
+
+    def _run_stage(self, st: _GraphFlowState, stage) -> None:
+        from cockroach_tpu.storage.columnstore import Dictionary
+        spec = st.spec
+        shared = Dictionary()
+        if spec.trace:
+            with tracing.capture("flow-stage", node=self.node_id,
+                                 stage=stage.sid) as rec:
+                batch = self._stage_batch(st, stage, shared)
+            st.spans.append(tracing.span_to_wire(rec))
+        else:
+            batch = self._stage_batch(st, stage, shared)
         if stage.output is None:
             n, cols, valid = self._host_output(
                 batch, stage.plan, st.graph.string_cols, shared)
@@ -525,6 +568,11 @@ class DistSQLNode:
                          window=spec.window)
             try:
                 out.send_arrays(n, cols, valid, spec.chunk_rows)
+                if spec.trace:
+                    # every stage that ran on this node rides home on
+                    # the gather stream, ahead of its EOF
+                    for w in st.spans:
+                        self._send_flow_span(spec, w)
                 out.close()
             finally:
                 self._producing.discard(key)
@@ -624,6 +672,13 @@ class Gateway:
         # distsql_physical_planner.go CheckNodeHealthAndVersion)
         self.monitor = monitor
         self.window = window
+        # DistSQL planner/ladder metrics ride the gateway engine's
+        # registry (one scrape per node covers SQL + flows)
+        self.metrics = getattr(own.engine, "metrics", None)
+
+    def _count(self, name: str, help_: str = "") -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name, help_).inc()
 
     def _partition_by_leaseholder(self, plan_node,
                                   nodes: list | None = None) -> dict:
@@ -768,6 +823,10 @@ class Gateway:
             return out or list(self.nodes)
 
         from ..utils import log
+        stripped = sql.lstrip()
+        if stripped[:15].upper() == "EXPLAIN ANALYZE":
+            return self.explain_analyze(stripped[15:].lstrip(),
+                                        chunk_rows)
         first = live()
         try:
             return self._run_once(sql, chunk_rows, first)
@@ -789,6 +848,9 @@ class Gateway:
                 log.info(log.OPS,
                          "flow replan: shrinking %s -> %s after "
                          "failure (%s)", first, healthy, err)
+                self._count("distsql.degrade.replan",
+                            "degradation ladder: replans on a "
+                            "shrunken node set")
                 try:
                     return self._run_once(sql, chunk_rows, healthy)
                 except FlowUnavailable as err2:
@@ -811,7 +873,30 @@ class Gateway:
             log.info(log.OPS,
                      "flow replan: shrinking %s -> %s after failure",
                      first, healthy)
+            self._count("distsql.degrade.replan",
+                        "degradation ladder: replans on a shrunken "
+                        "node set")
             return self._run_once(sql, chunk_rows, healthy)
+
+    def explain_analyze(self, sql: str, chunk_rows: int = 65536):
+        """EXPLAIN ANALYZE over the fabric: run the statement under a
+        recording; remote nodes ship their stage recordings back on
+        the flow streams and the result renders the stitched,
+        node-tagged span tree (the reference's distributed statement
+        diagnostics)."""
+        from cockroach_tpu.exec.engine import Result
+        import time as __time
+        with tracing.capture("explain-analyze",
+                             gateway=self.own.node_id) as rec:
+            t0 = __time.monotonic()
+            res = self.run(sql, chunk_rows)
+            total_ms = (__time.monotonic() - t0) * 1e3
+        lines = [f"total: {total_ms:.2f}ms, "
+                 f"rows returned: {len(res.rows)}",
+                 "trace:"]
+        lines.extend("  " + ln for ln in rec.tree_lines())
+        return Result(names=["info"], rows=[(ln,) for ln in lines],
+                      tag="EXPLAIN ANALYZE")
 
     def _replannable(self, sql: str) -> bool:
         """Gate the distributed-replan rung: lost partial-aggregate
@@ -835,6 +920,8 @@ class Gateway:
         producer returns the same rows a healthy cluster would,
         instead of hanging — ISSUE: flow-level graceful degradation)."""
         from cockroach_tpu.kv.rowfetch import RangeTable
+        self._count("distsql.degrade.local",
+                    "degradation ladder: gateway-local fallbacks")
         eng = self.own.engine
         node, _ = Planner(eng.catalog_view(int_ranges=False),
                           use_memo=False).plan_select(parser.parse(sql))
@@ -894,6 +981,9 @@ class Gateway:
                     "not scheduling flow")
 
         # SetupFlow to each participant; stream i <- node i
+        self._count("distsql.flows.launched",
+                    "distributed flows fanned out by this gateway")
+        trace = tracing.current_span() is not None
         registry = self.own.registry
         inboxes = []
         for i, nid in enumerate(nodes):
@@ -902,7 +992,8 @@ class Gateway:
                             read_ts=read_ts, window=self.window,
                             spans=(spans_by_node.get(nid)
                                    if spans_by_node is not None
-                                   else None))
+                                   else None),
+                            trace=trace)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
@@ -946,6 +1037,9 @@ class Gateway:
                 raise FlowUnavailable(
                     f"node(s) {sick} unhealthy (rpc breaker tripped); "
                     "not scheduling flow")
+        self._count("distsql.flows.launched",
+                    "distributed flows fanned out by this gateway")
+        trace = tracing.current_span() is not None
         registry = self.own.registry
         inboxes = []
         for nid in nodes:
@@ -956,7 +1050,8 @@ class Gateway:
                             spans=(spans_by_node.get(nid)
                                    if spans_by_node is not None
                                    else None),
-                            graph=kind, data_nodes=list(nodes))
+                            graph=kind, data_nodes=list(nodes),
+                            trace=trace)
             inboxes.append(registry.inbox(flow_id, sid))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
@@ -1044,6 +1139,11 @@ class Gateway:
                 raise FlowError("; ".join(errs))
             if not all(ib.eof for ib in inboxes):
                 raise FlowUnavailable("flow streams stalled")
+            # stitch the remote recordings that rode the streams into
+            # the statement's active span (no-op unless recording)
+            for ib in inboxes:
+                for w in ib.spans:
+                    tracing.attach_remote(w)
             union, merged_dicts = self._union_batch(
                 [c for ib in inboxes for c in ib.drain_arrays()],
                 union_columns, string_cols)

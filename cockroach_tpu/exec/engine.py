@@ -170,6 +170,18 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             on_change=lambda used: self.metrics.gauge(
                 "sql.mem.device.current",
                 "bytes of HBM reserved by resident tables").set(used))
+        # TPU-plane visibility: Pallas kernel builds are a trace-time
+        # module tally (ops/pallas/groupagg.py); read live at scrape
+        from ..ops.pallas import groupagg as _ga
+        self.metrics.func_counter(
+            "exec.pallas.kernel.builds",
+            lambda: _ga.KERNEL_BUILDS,
+            "Pallas group-aggregate kernel traces/builds (executions "
+            "run inside jitted programs and are not host-countable)")
+        # /debug/tracez ring buffer: recordings of statements slower
+        # than sql.trace.slow_statement.threshold (0 disables)
+        from collections import deque as _deque
+        self.slow_traces: _deque = _deque(maxlen=32)
         self._lane_init()
 
     # -- public API ----------------------------------------------------------
@@ -281,14 +293,28 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         self.admission.acquire(priority=prio)
         tracing = session.vars.get("tracing", "off") == "on" \
             and not isinstance(stmt, ast.ShowTrace)
+        try:
+            slow_thresh = float(self.settings.get(
+                "sql.trace.slow_statement.threshold"))
+        except Exception:
+            slow_thresh = 0.0
+        from ..utils import tracing as _trc
+        # slow-statement sampling records even untraced statements —
+        # but never nested ones (an active span means some outer
+        # statement already owns the recording on this thread)
+        capture = tracing or (slow_thresh > 0
+                              and _trc.current_span() is None
+                              and not isinstance(stmt, ast.ShowTrace))
         shared = self._stmt_read_only(stmt, session, sql_text)
         try:
-            if tracing:
+            rec = None
+            if capture:
                 with self.tracer.capture(sql_text or
                                          type(stmt).__name__) as rec:
                     res = self._dispatch_locked(stmt, session,
                                                 sql_text, shared)
-                session.trace.append(rec)
+                if tracing:
+                    session.trace.append(rec)
             else:
                 with self.tracer.span(
                         f"stmt:{type(stmt).__name__.lower()}"):
@@ -304,6 +330,15 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             if sql_text:
                 self.sqlstats.record(sql_text, dt,
                                      max(len(res.rows), res.row_count))
+            if rec is not None and slow_thresh > 0 \
+                    and dt >= slow_thresh:
+                from ..utils.sqlstats import fingerprint
+                self.slow_traces.append({
+                    "sql": sql_text or type(stmt).__name__,
+                    "fingerprint": fingerprint(sql_text) if sql_text
+                    else type(stmt).__name__,
+                    "duration_s": dt,
+                    "span": _trc.span_to_wire(rec)})
             return res
         except Exception:
             # any error inside an explicit txn block aborts it until
@@ -743,6 +778,25 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         lines.append("plan:")
         lines.extend("  " + ln for ln in P.plan_tree_repr(
             node, costs=costs).rstrip().split("\n"))
+
+        # stitched remote recordings (trace propagation): subtrees
+        # tagged with the serving node id render per-node, the
+        # reference's distributed statement diagnostics
+        def remote_roots(s):
+            out = []
+            for c in s.children:
+                if c.tags.get("node") is not None and (
+                        c.name in ("flow", "flow-stage")
+                        or c.name.startswith("rpc:")):
+                    out.append(c)
+                else:
+                    out.extend(remote_roots(c))
+            return out
+        rr = remote_roots(rec)
+        if rr:
+            lines.append("distributed:")
+            for s in rr:
+                lines.extend("  " + ln for ln in s.tree_lines())
         return Result(names=["info"], rows=[(ln,) for ln in lines],
                       tag="EXPLAIN ANALYZE")
 
@@ -1398,6 +1452,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                stream, cap, pallas, plan_fp, no_topk, no_compact)
         cached = self._exec_cache.get(key)
         self.tracer.tag(plan_cache="hit" if cached else "miss")
+        self.metrics.counter(
+            "sql.plan.cache.hit" if cached else "sql.plan.cache.miss",
+            "compiled-plan cache lookups, by outcome").inc()
         if cached is None:
             params = ExecParams(
                 hash_group_capacity=cap,
@@ -1417,8 +1474,10 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                  jax.jit(splan.final_fn))
             elif decision is not None:
                 runf = compile_plan(node, params, meta)
-                jfn = locked_collective_call(jax.jit(make_distributed_fn(
-                    runf, self.mesh, scan_aliases, decision)))
+                jfn = locked_collective_call(
+                    jax.jit(make_distributed_fn(
+                        runf, self.mesh, scan_aliases, decision)),
+                    metrics=self.metrics)
             else:
                 runf = compile_plan(node, params, meta)
 

@@ -305,6 +305,9 @@ class ScanPlaneMixin:
         self._device_tables[key] = b
         self.metrics.counter("sql.device.table_uploads",
                              "resident table uploads to HBM").inc()
+        self.metrics.counter(
+            "sql.device.upload.bytes",
+            "host->device bytes moved by table uploads").inc(nbytes)
         return b
 
     def narrow32_cols(self, name: str,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import copy
 import random
+import time
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
@@ -27,6 +28,7 @@ from cockroach_tpu.kvserver.store import (RangeBoundsError, _enc_ts,
 from cockroach_tpu.rpc.retry import (DeadlineExceeded, Retrier,
                                      RetryPolicy)
 from cockroach_tpu.storage.hlc import Timestamp
+from cockroach_tpu.utils import tracing
 from cockroach_tpu.utils.circuit import Breaker, BreakerTrippedError
 
 # the pump-driven cluster has no wall clock: backoff seconds convert
@@ -71,7 +73,7 @@ class BatchRequest:
 class DistSender:
     def __init__(self, cluster: Cluster,
                  policy: RetryPolicy = DEFAULT_POLICY,
-                 seed: int = 0):
+                 seed: int = 0, metrics=None):
         self.cluster = cluster
         self.cache = RangeCache()
         self.policy = policy
@@ -82,6 +84,33 @@ class DistSender:
         self.node_breakers: dict[int, Breaker] = {}
         self.retries = 0
         self.rpcs = 0
+        self.evictions = 0
+        self._m_attempt = None
+        if metrics is not None:
+            self.attach_metrics(metrics)
+
+    def attach_metrics(self, reg) -> None:
+        """distsender.* in a MetricRegistry: func-counters over the
+        plain ints plus a per-attempt latency histogram."""
+        reg.func_counter("distsender.retries", lambda: self.retries,
+                         "batch pieces retried after routing errors")
+        reg.func_counter("distsender.rpcs", lambda: self.rpcs,
+                         "Internal.Batch RPC attempts issued")
+        reg.func_counter("distsender.rangecache.evictions",
+                         lambda: self.evictions,
+                         "range-cache entries evicted as stale")
+        reg.func_gauge("distsender.breakers.tripped",
+                       lambda: sum(1 for b in self.node_breakers
+                                   .values() if b.tripped),
+                       "per-node breakers currently open")
+        self._m_attempt = reg.histogram(
+            "distsender.attempt.latency",
+            "seconds per Internal.Batch attempt")
+
+    def _evict(self, key: bytes) -> None:
+        self.evictions += 1
+        tracing.event("rangecache-evict")
+        self.cache.evict(key)
 
     def _node_breaker(self, nid: int) -> Breaker:
         b = self.node_breakers.get(nid)
@@ -143,16 +172,17 @@ class DistSender:
             entry = self._entry_for(key)
             desc = entry.desc
             try:
-                return self._rpc(desc, entry, op, ts, key)
+                with tracing.span("rpc-attempt", attempt=attempt):
+                    return self._rpc(desc, entry, op, ts, key)
             except (RangeKeyMismatchError, RangeBoundsError, KeyError):
                 self.retries += 1
-                self.cache.evict(key)
+                self._evict(key)
             except NotLeaseholderError as e:
                 self.retries += 1
                 if e.hint:
                     self.cache.update_leaseholder(key, e.hint)
                 else:
-                    self.cache.evict(key)
+                    self._evict(key)
                 self._pause(attempt + 1)
         if r.expired():
             raise DeadlineExceeded(
@@ -188,12 +218,13 @@ class DistSender:
                     break
                 piece["limit"] = remaining
             try:
-                out.extend(self._rpc(desc, entry, piece, ts, cur))
+                with tracing.span("rpc-attempt", attempt=failures):
+                    out.extend(self._rpc(desc, entry, piece, ts, cur))
             except (RangeKeyMismatchError, RangeBoundsError, KeyError,
                     NotLeaseholderError):
                 self.retries += 1
                 failures += 1
-                self.cache.evict(cur)
+                self._evict(cur)
                 self._pause(failures)
                 continue
             failures = 0
@@ -203,6 +234,15 @@ class DistSender:
     def _rpc(self, desc, entry, op: dict, ts: Timestamp, key: bytes):
         """One Internal.Batch 'RPC' against a replica of desc."""
         self.rpcs += 1
+        t0 = time.monotonic()
+        try:
+            return self._rpc_inner(desc, entry, op, ts, key)
+        finally:
+            if self._m_attempt is not None:
+                self._m_attempt.observe(time.monotonic() - t0)
+
+    def _rpc_inner(self, desc, entry, op: dict, ts: Timestamp,
+                   key: bytes):
         order = [entry.leaseholder] if entry.leaseholder else []
         order += [n for n in desc.replicas if n not in order]
         last_err: Exception = NotLeaseholderError()
@@ -214,6 +254,7 @@ class DistSender:
             try:
                 b.check()            # probe heals once it leaves down
             except BreakerTrippedError:
+                tracing.event("breaker-skip", node=nid)
                 last_err = NotLeaseholderError()
                 continue
             store = self.cluster.stores.get(nid)

@@ -49,6 +49,7 @@ from typing import Optional
 
 from ..rpc.context import FaultInjector, SocketTransport
 from ..rpc.retry import RetryPolicy
+from ..utils import tracing
 from ..utils.circuit import Breaker, BreakerTrippedError
 from ..storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from ..storage.mvcc import TxnMeta, WriteIntentError, WriteTooOldError
@@ -551,6 +552,39 @@ class NetCluster(Cluster):
             self.peer_breakers[nid] = b
         return b
 
+    def attach_metrics(self, reg) -> None:
+        """Surface this node's fabric + breaker state in a
+        MetricRegistry (closes the ROADMAP 'breaker metrics'
+        follow-up): transport frame counters, aggregate breaker
+        counters, and a per-peer gauge family refreshed by a
+        collector (peers appear dynamically as the cluster grows)."""
+        self.rpc.attach_metrics(reg)
+        reg.func_counter(
+            "breaker.peer.trips",
+            lambda: sum(b.trip_count
+                        for b in self.peer_breakers.values()),
+            "total peer-breaker trips on this node")
+        reg.func_gauge(
+            "breaker.peer.tripped.current",
+            lambda: sum(1 for b in self.peer_breakers.values()
+                        if b.tripped),
+            "peer breakers currently open")
+        reg.func_gauge(
+            "breaker.peer.failures",
+            lambda: sum(b.failures
+                        for b in self.peer_breakers.values()),
+            "consecutive failures across peer breakers")
+
+        def _per_peer():
+            for nid, b in list(self.peer_breakers.items()):
+                reg.gauge(f"breaker.peer.n{nid}.tripped",
+                          "1 while this peer's breaker is open").set(
+                    1.0 if b.tripped else 0.0)
+                reg.gauge(f"breaker.peer.n{nid}.trips",
+                          "trips of this peer's breaker").set(
+                    b.trip_count)
+        reg.add_collector(_per_peer)
+
     def call(self, to: int, method: str, args: dict,
              timeout: float = None):
         b = self.peer_breaker(to)
@@ -558,14 +592,22 @@ class NetCluster(Cluster):
         rid = uuid.uuid4().hex[:16]
         slot = {"ev": threading.Event()}
         self._calls[rid] = slot
-        self._send(to, {"k": "req", "id": rid, "m": method, "a": args,
-                        "hlc": self.clock.now().to_int()})
+        req = {"k": "req", "id": rid, "m": method, "a": args,
+               "hlc": self.clock.now().to_int()}
+        # piggyback the active trace context so the remote node can
+        # record its handler under our trace and ship the subtree back
+        tc = tracing.trace_context()
+        if tc is not None:
+            req["tc"] = tc
+        self._send(to, req)
         if not slot["ev"].wait(timeout or self.CALL_TIMEOUT):
             self._calls.pop(rid, None)
             b.report_failure()
             raise _TimeoutError(f"rpc {method} to n{to} timed out")
         b.report_success()
         resp = slot["resp"]
+        if resp.get("sp"):
+            tracing.attach_remote(resp["sp"])
         if resp.get("ok"):
             return resp.get("result")
         raise self._decode_err(resp["err"])
@@ -611,8 +653,18 @@ class NetCluster(Cluster):
                 "msg": f"{type(exc).__name__}: {exc}"}
 
     def _serve_req(self, frm: int, msg: dict) -> None:
+        # when the caller sent a trace context, serve under a local
+        # recording and ship the finished subtree back on the response
+        # (the reference piggybacks recordings on BatchResponse)
+        tc = msg.get("tc")
+        rec = None
         try:
-            result = self._serve(frm, msg["m"], msg["a"])
+            if tc:
+                with tracing.capture(f"rpc:{msg['m']}", remote_ctx=tc,
+                                     node=self.node_id) as rec:
+                    result = self._serve(frm, msg["m"], msg["a"])
+            else:
+                result = self._serve(frm, msg["m"], msg["a"])
             out = {"k": "resp", "id": msg["id"], "ok": True,
                    "result": result,
                    "hlc": self.clock.now().to_int()}
@@ -620,6 +672,8 @@ class NetCluster(Cluster):
             out = {"k": "resp", "id": msg["id"], "ok": False,
                    "err": self._encode_err(exc),
                    "hlc": self.clock.now().to_int()}
+        if rec is not None:
+            out["sp"] = tracing.span_to_wire(rec)
         self._send(frm, out)
 
     # -- the service (server side of the stubs) ----------------------------
@@ -988,10 +1042,13 @@ class NetCluster(Cluster):
                 nid = None
                 continue
             try:
-                r = self.call(nid, "propose",
-                              {"range_id": desc.range_id, "cmd": cmd},
-                              timeout=(timeout or
-                                       self.PROPOSE_ATTEMPT_TIMEOUT))
+                with tracing.span("rpc-attempt", node=nid,
+                                  attempt=attempt, method="propose"):
+                    r = self.call(nid, "propose",
+                                  {"range_id": desc.range_id,
+                                   "cmd": cmd},
+                                  timeout=(timeout or
+                                           self.PROPOSE_ATTEMPT_TIMEOUT))
                 self._lease_cache[desc.range_id] = nid
                 return r
             except NotLeaseholderError as e:
@@ -1000,6 +1057,8 @@ class NetCluster(Cluster):
             except BreakerTrippedError:
                 # peer known-dead: fail fast to the next replica,
                 # no wait at all (the point of the breaker)
+                tracing.event("breaker-skip", node=nid,
+                              method="propose")
                 tried.append(nid)
                 nid = None
                 continue
@@ -1040,14 +1099,17 @@ class NetCluster(Cluster):
                     nid = e.hint
                 continue
             try:
-                r = self.call(nid, "read", args,
-                              timeout=self.READ_ATTEMPT_TIMEOUT)
+                with tracing.span("rpc-attempt", node=nid,
+                                  attempt=attempt, method="read"):
+                    r = self.call(nid, "read", args,
+                                  timeout=self.READ_ATTEMPT_TIMEOUT)
                 self._lease_cache[desc.range_id] = nid
                 return r
             except NotLeaseholderError as e:
                 tried.append(nid)
                 nid = e.hint
             except BreakerTrippedError:
+                tracing.event("breaker-skip", node=nid, method="read")
                 tried.append(nid)   # fail fast to the next replica
                 nid = None
                 continue

@@ -92,6 +92,11 @@ def _kernel(gid_ref, sel_ref, *refs, acc_ref, cnt_ref, num_groups: int,
                 acc_ref[g, a] = jnp.maximum(acc_ref[g, a], part)
 
 
+# Pallas kernel trace/build tally (see the note inside
+# dense_group_aggregate); read via engine func-metrics.
+KERNEL_BUILDS = 0
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups", "ops",
                                              "block_rows", "interpret"))
 def dense_group_aggregate(gid, sel, values: tuple, masks: tuple,
@@ -108,6 +113,13 @@ def dense_group_aggregate(gid, sel, values: tuple, masks: tuple,
     counts) — each slot's result lives in the array its op writes.
     n must be a multiple of 128 (the engine pads tables to pow2 >= 128).
     """
+    # trace-time side effect: this body runs once per (shape, static
+    # args) jit-cache entry, so the tally counts kernel BUILDS, the
+    # honest metric for a jitted kernel (executions happen inside XLA
+    # where host counters can't see them). exec.pallas.* func-metrics
+    # in the engine read it.
+    global KERNEL_BUILDS
+    KERNEL_BUILDS += 1
     n = gid.shape[0]
     assert n % LANES == 0, "row count must be a multiple of 128"
     rows = n // LANES

@@ -128,15 +128,36 @@ def partials_replannable(node: P.PlanNode) -> bool:
 _COLLECTIVE_CALL_LOCK = threading.Lock()
 
 
-def locked_collective_call(jfn):
+def locked_collective_call(jfn, metrics=None):
     """Wrap a jitted multi-device callable so concurrent sessions
     cannot interleave collective rendezvous (deadlock otherwise —
     this must wrap the CALL: a lock inside the traced function would
-    only run at trace time)."""
+    only run at trace time).
+
+    With a MetricRegistry, each call counts as one collective
+    dispatch and its wall time (lock wait + device execution) feeds
+    the allreduce latency histogram — the data-movement accounting a
+    distributed accelerator engine tunes against."""
+    import time as _time
+    m_calls = m_secs = None
+    if metrics is not None:
+        m_calls = metrics.counter(
+            "exec.allreduce.calls",
+            "distributed (collective) plan dispatches")
+        m_secs = metrics.histogram(
+            "exec.allreduce.seconds",
+            "wall seconds per collective dispatch (incl. lock wait)")
+
     @functools.wraps(jfn)
     def call(*args, **kwargs):
-        with _COLLECTIVE_CALL_LOCK:
-            return jfn(*args, **kwargs)
+        t0 = _time.monotonic()
+        try:
+            with _COLLECTIVE_CALL_LOCK:
+                return jfn(*args, **kwargs)
+        finally:
+            if m_calls is not None:
+                m_calls.inc()
+                m_secs.observe(_time.monotonic() - t0)
     return call
 
 

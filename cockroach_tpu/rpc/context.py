@@ -221,6 +221,30 @@ class SocketTransport:
     def addr(self) -> tuple[str, int]:
         return self._server.server_address[:2]
 
+    def attach_metrics(self, reg) -> None:
+        """Surface this transport's frame accounting (and its
+        injector's nemesis tallies) through a MetricRegistry as
+        func-metrics — the hot path keeps its plain ints."""
+        reg.func_counter("rpc.frames.sent", lambda: self.sent,
+                         "fabric frames submitted for delivery")
+        reg.func_counter("rpc.frames.delivered",
+                         lambda: self.delivered,
+                         "inbound fabric frames dispatched")
+        reg.func_gauge("rpc.frames.pending", lambda: self.pending(),
+                       "inbound frames queued, not yet dispatched")
+        reg.func_counter(
+            "rpc.frames.dropped",
+            lambda: self.injector.dropped if self.injector else 0,
+            "frames dropped by the fault injector")
+        reg.func_counter(
+            "rpc.frames.delayed",
+            lambda: self.injector.delayed if self.injector else 0,
+            "frames delayed by the fault injector")
+        reg.func_counter(
+            "rpc.frames.duplicated",
+            lambda: self.injector.duplicated if self.injector else 0,
+            "frames duplicated by the fault injector")
+
     def connect(self, node_id: int, addr: tuple[str, int]) -> None:
         self._peers[node_id] = addr
 

@@ -61,6 +61,20 @@ class PeerMonitor:
         self.offset_ns: dict[int, int] = {}
         self._awaiting: dict[int, int] = {}   # peer -> ping send time
         self.skewed: set[int] = set()
+        self._m_rtt = None                    # attach_metrics installs
+
+    def attach_metrics(self, reg) -> None:
+        """rpc.heartbeat.* in a MetricRegistry: an RTT histogram fed
+        per PONG plus live gauges over the peer state maps."""
+        self._m_rtt = reg.histogram(
+            "rpc.heartbeat.rtt.seconds",
+            "heartbeat round-trip time per PONG")
+        reg.func_gauge("rpc.heartbeat.unhealthy.peers",
+                       lambda: len(self.tripped_peers()),
+                       "peers past the miss limit or skewed")
+        reg.func_gauge("rpc.heartbeat.skewed.peers",
+                       lambda: len(self.skewed),
+                       "peers with clock offset beyond the bound")
 
     # -- health --------------------------------------------------------------
     def healthy(self, peer: int) -> bool:
@@ -106,6 +120,8 @@ class PeerMonitor:
             now = self.now_ns()
             rtt = now - int(msg["t_mono"])
             self.rtt_ns[frm] = rtt
+            if self._m_rtt is not None:
+                self._m_rtt.observe(rtt / 1e9)
             # midpoint clock-offset estimate (clock_offset.go): the
             # remote read happened ~rtt/2 after our send
             est = int(msg["my_wall"]) - (int(msg["their_wall"])

@@ -66,12 +66,19 @@ class Retrier:
 
     def __init__(self, policy: RetryPolicy,
                  rng: Optional[random.Random] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, metrics=None):
         self.policy = policy
         self.rng = rng
         self.clock = clock
         self.attempt = 0
         self.start = clock()
+        self._m_attempts = self._m_backoff = None
+        if metrics is not None:
+            self._m_attempts = metrics.counter(
+                "retry.attempts", "retry loop attempts started")
+            self._m_backoff = metrics.gauge(
+                "retry.backoff.seconds",
+                "cumulative seconds spent in retry backoff")
 
     def expired(self) -> bool:
         return (self.policy.deadline is not None
@@ -88,6 +95,8 @@ class Retrier:
         while self.attempt < self.policy.max_attempts:
             if self.attempt > 0 and self.expired():
                 return
+            if self._m_attempts is not None:
+                self._m_attempts.inc()
             yield self.attempt
             self.attempt += 1
 
@@ -101,5 +110,7 @@ class Retrier:
 
     def wait(self) -> None:
         b = self.next_backoff()
+        if self._m_backoff is not None and b > 0:
+            self._m_backoff.inc(b)
         if b > 0:
             time.sleep(b)

@@ -163,6 +163,26 @@ class Node:
                         "peers": peers,
                     }).encode()
                     ctype = "application/json"
+                elif self.path == "/_status/statements":
+                    # per-fingerprint statement stats (pkg/server
+                    # /statements.go Statements endpoint)
+                    body = json.dumps({"statements": [{
+                        "fingerprint": s.fingerprint,
+                        "count": s.count,
+                        "mean_latency_s": s.mean_latency_s,
+                        "max_latency_s": s.max_latency_s,
+                        "total_rows": s.total_rows,
+                        "failures": s.failures,
+                    } for s in node.engine.sqlstats.all()]}).encode()
+                    ctype = "application/json"
+                elif self.path == "/debug/tracez":
+                    # ring buffer of recent slow-statement trace
+                    # recordings (threshold via the cluster setting
+                    # sql.trace.slow_statement.threshold; the tracez
+                    # snapshot page of the reference)
+                    body = json.dumps({"traces": list(
+                        node.engine.slow_traces)}).encode()
+                    ctype = "application/json"
                 elif self.path == "/_debug/ranges":
                     # `cockroach debug` analogue: range descriptors +
                     # leaseholders when this node serves a cluster

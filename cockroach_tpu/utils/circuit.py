@@ -85,6 +85,20 @@ class Breaker:
     def report_success(self) -> None:
         self.reset()
 
+    def register_metrics(self, reg, prefix: str) -> None:
+        """Expose this breaker's state under `prefix` in a
+        MetricRegistry (trips/failures counters + tripped gauge);
+        values are read live at scrape time, no hot-path cost."""
+        reg.func_counter(f"{prefix}.trips",
+                         lambda: self.trip_count,
+                         "total breaker trips")
+        reg.func_gauge(f"{prefix}.failures",
+                       lambda: self.failures,
+                       "consecutive failures reported")
+        reg.func_gauge(f"{prefix}.tripped",
+                       lambda: 1.0 if self.tripped else 0.0,
+                       "1 while the breaker is open")
+
     def reset(self) -> None:
         self.failures = 0
         self.tripped = False

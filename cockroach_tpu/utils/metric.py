@@ -4,13 +4,20 @@ The analogue of the reference's metric registry (pkg/util/metric/
 registry.go:31) and its Prometheus exporter (prometheus_exporter.go).
 Every subsystem registers named metrics here; the Node's status
 endpoint serves the text exposition format.
+
+Func metrics (FuncCounter/FuncGauge) read their value from a callback
+at scrape time — that lets hot paths keep their existing plain-int
+counters (SocketTransport.sent, DistSender.retries, ...) and still
+surface through /_status/vars without adding a lock acquisition per
+frame. Registered collectors run before every snapshot/export to
+refresh dynamic families (per-peer breaker gauges).
 """
 
 from __future__ import annotations
 
 import math
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 
 class Counter:
@@ -50,6 +57,26 @@ class Gauge:
         return self._v
 
 
+class FuncCounter:
+    """Counter whose value is read from a callback at scrape time."""
+
+    def __init__(self, name: str, fn: Callable[[], float],
+                 help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+
+    def value(self):
+        try:
+            return self._fn()
+        except Exception:
+            return 0
+
+
+class FuncGauge(FuncCounter):
+    pass
+
+
 class Histogram:
     """Log-bucketed latency/size histogram (the reference uses HDR-ish
     histograms; log2 buckets keep it dependency-free)."""
@@ -73,6 +100,15 @@ class Histogram:
     def value(self) -> dict:
         return {"count": self._count, "sum": self._sum}
 
+    def bucket_bounds(self) -> list[float]:
+        """Upper bound (inclusive, seconds/units) of each bucket."""
+        return [(2.0 ** (i - 1)) / 1e6
+                for i in range(len(self._buckets))]
+
+    def buckets(self) -> list[int]:
+        with self._lock:
+            return list(self._buckets)
+
     def quantile(self, q: float) -> float:
         with self._lock:
             if self._count == 0:
@@ -91,6 +127,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._metrics: dict[str, object] = {}
+        self._collectors: list[Callable[[], None]] = []
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str = "") -> Counter:
@@ -101,6 +138,29 @@ class MetricRegistry:
 
     def histogram(self, name: str, help_: str = "") -> Histogram:
         return self._get_or_add(name, lambda: Histogram(name, help_))
+
+    def func_counter(self, name: str, fn: Callable[[], float],
+                     help_: str = "") -> FuncCounter:
+        return self._get_or_add(name,
+                                lambda: FuncCounter(name, fn, help_))
+
+    def func_gauge(self, name: str, fn: Callable[[], float],
+                   help_: str = "") -> FuncGauge:
+        return self._get_or_add(name,
+                                lambda: FuncGauge(name, fn, help_))
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """Run `fn` before every snapshot/export; collectors refresh
+        dynamic metric families (per-peer gauges) in place."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception:
+                pass
 
     def _get_or_add(self, name: str, mk):
         with self._lock:
@@ -114,26 +174,37 @@ class MetricRegistry:
         return self._metrics.get(name)
 
     def snapshot(self) -> dict:
+        self._collect()
         return {name: m.value() for name, m in sorted(self._metrics.items())}
 
     def to_prometheus(self) -> str:
         """Text exposition format (prometheus_exporter.go)."""
+        self._collect()
         out = []
         for name, m in sorted(self._metrics.items()):
             pname = name.replace(".", "_").replace("-", "_")
             if m.help:
-                out.append(f"# HELP {pname} {m.help}")
-            if isinstance(m, Counter):
+                help_ = m.help.replace("\\", "\\\\").replace("\n", "\\n")
+                out.append(f"# HELP {pname} {help_}")
+            if isinstance(m, (Counter, FuncCounter)) and \
+                    not isinstance(m, (Gauge, FuncGauge)):
                 out.append(f"# TYPE {pname} counter")
                 out.append(f"{pname} {m.value()}")
-            elif isinstance(m, Gauge):
+            elif isinstance(m, (Gauge, FuncGauge)):
                 out.append(f"# TYPE {pname} gauge")
                 out.append(f"{pname} {m.value()}")
             elif isinstance(m, Histogram):
+                # Real cumulative histogram exposition: each
+                # `le`-labelled bucket counts observations <= its
+                # upper bound, finishing at +Inf == _count.
                 v = m.value()
-                out.append(f"# TYPE {pname} summary")
-                out.append(f'{pname}{{quantile="0.5"}} {m.quantile(0.5)}')
-                out.append(f'{pname}{{quantile="0.99"}} {m.quantile(0.99)}')
+                out.append(f"# TYPE {pname} histogram")
+                acc = 0
+                for bound, c in zip(m.bucket_bounds(), m.buckets()):
+                    acc += c
+                    out.append(
+                        f'{pname}_bucket{{le="{bound:.6g}"}} {acc}')
+                out.append(f'{pname}_bucket{{le="+Inf"}} {v["count"]}')
                 out.append(f"{pname}_sum {v['sum']}")
                 out.append(f"{pname}_count {v['count']}")
         return "\n".join(out) + "\n"

@@ -107,6 +107,10 @@ def _register_builtins(s: Settings):
                "device-memory budget for resident table uploads; "
                "aggregate scans over bigger tables stream in pages "
                "(the HBM analogue of --max-sql-memory / workmem)")
+    s.register("sql.trace.slow_statement.threshold", 0.0, float,
+               "statements slower than this many seconds keep their "
+               "trace recording in the /debug/tracez ring buffer "
+               "(0 disables; sql.trace.txn.enable_threshold analogue)")
 
 
 def _meta_page_rows() -> int:

@@ -7,15 +7,31 @@ instead; a thread-local matches this engine's one-statement-per-thread
 execution model). A capture() scope collects the finished span tree —
 that recording is what EXPLAIN ANALYZE renders, like the reference's
 WithRecording(trace) statement diagnostics.
+
+Distributed recordings: the active-span stack lives in MODULE-level
+thread-local state shared by every Tracer instance, so spans opened
+by the RPC fabric, DistSender, or DistSQL nodes nest into whatever
+recording the statement opened — no tracer needs plumbing through the
+stack. `trace_context()` exports the active (trace_id, span_id) pair
+for an RPC frame; the serving side runs its handler under its own
+`capture()` and ships the finished subtree back with
+`span_to_wire()`; the caller grafts it with `attach_remote()`. This
+mirrors CockroachDB's span "recording" payloads piggybacked on
+BatchResponse / SetupFlow (pkg/util/tracing/crdbspan.go).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
+
+# One process-wide active-span stack per thread (see module doc).
+_tls = threading.local()
+_ids = itertools.count(1)
 
 
 @dataclass
@@ -25,6 +41,8 @@ class Span:
     end_ns: int = 0
     tags: dict = field(default_factory=dict)
     children: list["Span"] = field(default_factory=list)
+    span_id: int = 0
+    trace_id: int = 0
 
     @property
     def duration_ms(self) -> float:
@@ -47,42 +65,145 @@ class Span:
                 return hit
         return None
 
+    def find_all(self, name: str) -> list["Span"]:
+        out = [self] if self.name == name else []
+        for c in self.children:
+            out.extend(c.find_all(name))
+        return out
+
+
+def current_span() -> Optional[Span]:
+    return getattr(_tls, "span", None)
+
+
+def trace_context() -> Optional[dict]:
+    """The active trace context as a JSON-safe dict for an RPC frame
+    (`{"tid": trace_id, "sid": span_id}`), or None when nothing is
+    recording on this thread."""
+    s = current_span()
+    if s is None:
+        return None
+    return {"tid": s.trace_id, "sid": s.span_id}
+
+
+def _jsonable(v):
+    return v if isinstance(v, (str, int, float, bool, type(None))) \
+        else str(v)
+
+
+def span_to_wire(s: Span) -> dict:
+    """Encode a finished span subtree as JSON-safe primitives (the
+    trace-frame wire format documented in OBSERVABILITY.md)."""
+    return {
+        "n": s.name,
+        "b": s.start_ns,
+        "e": s.end_ns,
+        "t": {str(k): _jsonable(v) for k, v in s.tags.items()},
+        "c": [span_to_wire(c) for c in s.children],
+        "sid": s.span_id,
+        "tid": s.trace_id,
+    }
+
+
+def span_from_wire(d: dict) -> Span:
+    return Span(
+        name=d.get("n", "?"),
+        start_ns=int(d.get("b", 0)),
+        end_ns=int(d.get("e", 0)),
+        tags=dict(d.get("t", {})),
+        children=[span_from_wire(c) for c in d.get("c", [])],
+        span_id=int(d.get("sid", 0)),
+        trace_id=int(d.get("tid", 0)),
+    )
+
+
+def attach_remote(wire: dict) -> Optional[Span]:
+    """Graft a remote recording (wire dict from span_to_wire) under
+    the active span. No-op when nothing is recording here."""
+    parent = current_span()
+    if parent is None or not wire:
+        return None
+    s = span_from_wire(wire)
+    parent.children.append(s)
+    return s
+
+
+@contextmanager
+def span(name: str, **tags):
+    """Module-level child span on the shared stack (open a child of
+    whatever is recording; cheap no-op nesting otherwise)."""
+    parent = current_span()
+    s = Span(name, time.monotonic_ns(), tags=dict(tags),
+             span_id=next(_ids),
+             trace_id=parent.trace_id if parent is not None else 0)
+    if parent is not None:
+        parent.children.append(s)
+    _tls.span = s
+    try:
+        yield s
+    finally:
+        s.end_ns = time.monotonic_ns()
+        _tls.span = parent
+
+
+def event(name: str, **tags) -> Optional[Span]:
+    """Zero-duration marker under the active span (breaker-skip,
+    cache-evict, ...). Returns None when nothing is recording."""
+    parent = current_span()
+    if parent is None:
+        return None
+    now = time.monotonic_ns()
+    s = Span(name, now, now, tags=dict(tags), span_id=next(_ids),
+             trace_id=parent.trace_id)
+    parent.children.append(s)
+    return s
+
+
+@contextmanager
+def capture(name: str = "trace", remote_ctx: Optional[dict] = None,
+            **tags):
+    """Collect a full recording rooted at `name` on this thread.
+
+    `remote_ctx` is the {"tid","sid"} dict from an inbound RPC frame:
+    the new root adopts the caller's trace_id and tags the parent
+    span id, so stitched recordings stay correlated across nodes."""
+    prev = current_span()
+    root = Span(name, time.monotonic_ns(), tags=dict(tags),
+                span_id=next(_ids))
+    if remote_ctx:
+        root.trace_id = int(remote_ctx.get("tid", 0))
+        psid = int(remote_ctx.get("sid", 0))
+        if psid:
+            root.tags.setdefault("parent_sid", psid)
+    else:
+        root.trace_id = next(_ids)
+    _tls.span = root
+    try:
+        yield root
+    finally:
+        root.end_ns = time.monotonic_ns()
+        _tls.span = prev
+
+
+def tag(**tags) -> None:
+    s = current_span()
+    if s is not None:
+        s.tags.update(tags)
+
 
 class Tracer:
-    def __init__(self):
-        self._tls = threading.local()
+    """Back-compat facade over the module-level span stack: every
+    Tracer shares the same per-thread recording, which is what lets
+    fabric/KV/DistSQL spans land inside the engine's capture."""
 
     def _cur(self) -> Optional[Span]:
-        return getattr(self._tls, "span", None)
+        return current_span()
 
-    @contextmanager
     def span(self, name: str, **tags):
-        """Open a child of the active span (no-op-cheap when nothing
-        is capturing: spans still nest, they just aren't retained)."""
-        parent = self._cur()
-        s = Span(name, time.monotonic_ns(), tags=dict(tags))
-        if parent is not None:
-            parent.children.append(s)
-        self._tls.span = s
-        try:
-            yield s
-        finally:
-            s.end_ns = time.monotonic_ns()
-            self._tls.span = parent
+        return span(name, **tags)
 
-    @contextmanager
-    def capture(self, name: str = "trace"):
-        """Collect a full recording rooted at `name` on this thread."""
-        prev = self._cur()
-        root = Span(name, time.monotonic_ns())
-        self._tls.span = root
-        try:
-            yield root
-        finally:
-            root.end_ns = time.monotonic_ns()
-            self._tls.span = prev
+    def capture(self, name: str = "trace", **tags):
+        return capture(name, **tags)
 
     def tag(self, **tags) -> None:
-        s = self._cur()
-        if s is not None:
-            s.tags.update(tags)
+        tag(**tags)

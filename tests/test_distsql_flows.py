@@ -460,3 +460,40 @@ class TestFlowReplanOnFailure:
         got = gw.run(q)
         assert got.rows[0][0] == want.rows[0][0]
         assert state["calls"] > 6   # the mid-flow poll actually ran
+
+
+class TestFlowTracing:
+    """PR 2: remote flow recordings ship back to the gateway and
+    stitch into the live statement capture (the SetupFlow recording
+    piggyback of the reference)."""
+
+    def test_flow_spans_stitched_under_capture(self, fakedist):
+        from cockroach_tpu.utils import tracing
+        gw, oracle = fakedist
+        with tracing.capture("stmt") as rec:
+            got = gw.run(tpch.Q1)
+        assert_rows_close(got.rows, oracle.execute(tpch.Q1).rows)
+        flows = rec.find_all("flow")
+        assert {s.tags["node"] for s in flows} == {1, 2, 3}
+        # each remote recording kept its own ids through the codec
+        assert all(s.span_id for s in flows)
+
+    def test_no_capture_runs_untraced(self, fakedist):
+        from cockroach_tpu.utils import tracing
+        gw, oracle = fakedist
+        assert tracing.current_span() is None
+        got = gw.run(tpch.Q6)          # trace=False on every FlowSpec
+        assert got.rows[0][0] == pytest.approx(
+            oracle.execute(tpch.Q6).rows[0][0], rel=1e-9)
+
+    def test_explain_analyze_through_gateway(self, fakedist):
+        gw, oracle = fakedist
+        res = gw.run("EXPLAIN ANALYZE " + tpch.Q1)
+        assert res.tag == "EXPLAIN ANALYZE"
+        text = "\n".join(r[0] for r in res.rows)
+        want = oracle.execute(tpch.Q1)
+        assert f"rows returned: {len(want.rows)}" in text
+        assert "explain-analyze" in text
+        # node-tagged remote spans rendered in the tree
+        for nid in (1, 2, 3):
+            assert f"node={nid}" in text

@@ -444,3 +444,98 @@ class TestShuffleStringHashWidths:
         b = partition_buckets({"k": keys}, {"k": ok}, ["k"], 8)
         # a sane hash uses every bucket over 500 distinct keys
         assert len(np.unique(b)) == 8
+
+
+class TestRetryTracing:
+    """Observability of the retry machinery (PR 2): routing failures
+    leave retry-attempt spans and range-cache evict events in the
+    active recording, and the same counts surface as distsender.*
+    metrics — a trace and a dashboard telling the same story."""
+
+    def _cluster(self, liveness_ttl=30):
+        from cockroach_tpu.kvserver.cluster import Cluster
+        c = Cluster(n_nodes=3, liveness_ttl=liveness_ttl)
+        c.create_range(b"a", b"z", replicas=[1, 2, 3])
+        return c
+
+    def test_dead_leaseholder_leaves_retry_attempt_spans(self):
+        from cockroach_tpu.kv.distsender import (BatchRequest,
+                                                 DistSender)
+        from cockroach_tpu.utils import tracing
+        c = self._cluster(liveness_ttl=5)
+        c.put(b"k1", b"v1")
+        ds = DistSender(c)
+        ds.send(BatchRequest().get(b"k1"))   # cache the leaseholder
+        c.stop_node(c.leaseholder(1))
+        with tracing.capture("stmt") as rec:
+            assert ds.send(BatchRequest().get(b"k1")) == [b"v1"]
+        attempts = rec.find_all("rpc-attempt")
+        assert len(attempts) >= 2, rec.tree_lines()
+        # ordinals rendered on the spans, starting at the first try
+        assert [s.tags["attempt"] for s in attempts] == \
+            list(range(len(attempts)))
+        assert rec.find("rangecache-evict") is not None
+        assert ds.retries >= 1 and ds.evictions >= 1
+
+    def test_stale_cache_retry_spans(self):
+        from cockroach_tpu.kv.distsender import (BatchRequest,
+                                                 DistSender)
+        from cockroach_tpu.utils import tracing
+        c = self._cluster()
+        c.put(b"b1", b"x")
+        c.put(b"m1", b"y")
+        ds = DistSender(c)
+        ds.send(BatchRequest().get(b"b1"))   # cache pre-split bounds
+        c.split_range(b"m")
+        with tracing.capture("stmt") as rec:
+            assert ds.send(BatchRequest().get(b"m1")) == [b"y"]
+        assert len(rec.find_all("rpc-attempt")) >= 2
+
+    def test_retry_metrics_attach(self):
+        """The same run feeds distsender.* func-metrics when a
+        registry is attached at construction."""
+        from cockroach_tpu.kv.distsender import (BatchRequest,
+                                                 DistSender)
+        from cockroach_tpu.utils.metric import MetricRegistry
+        reg = MetricRegistry()
+        c = self._cluster()
+        c.put(b"b1", b"x")
+        ds = DistSender(c, metrics=reg)
+        ds.send(BatchRequest().get(b"b1"))
+        c.split_range(b"m")
+        ds.send(BatchRequest().get(b"b1"))
+        snap = reg.snapshot()
+        assert snap["distsender.rpcs"] >= 2
+        assert snap["distsender.attempt.latency"]["count"] >= 2
+        assert "distsender.breakers.tripped" in snap
+
+    def test_replan_trace_shows_survivor_flows(self):
+        """Degraded flows still ship their recordings: with node 3
+        dead, the stitched statement trace shows remote flow spans
+        from the surviving nodes and none from the dead producer
+        (whether the gateway replanned mid-query or scheduled the
+        survivors up front depends on detection timing; the trace
+        contract is the same either way)."""
+        from cockroach_tpu.distsql.node import Gateway
+        from cockroach_tpu.utils import tracing
+        fab = TestFlowDegradation()
+        oracle, c, transport, nodes = fab._fabric()
+        transport.stop_node(3)
+        for rid in list(c.descriptors):
+            if c.leaseholder(rid) == 3:
+                c.transfer_lease(rid, 1)
+        c.pump(10)
+
+        class Monitor:
+            def healthy(self, n):
+                return n != 3
+
+        gw = Gateway(nodes[0], [1, 2, 3], cluster=c,
+                     monitor=Monitor(), flow_timeout=5.0)
+        with tracing.capture("stmt") as rec:
+            got = gw.run(fab.Q_GROUPBY)
+        fab._assert_same(got, oracle.execute(fab.Q_GROUPBY))
+        flow_nodes = {s.tags.get("node")
+                      for s in rec.find_all("flow")}
+        assert {1, 2} <= flow_nodes, rec.tree_lines()
+        assert 3 not in flow_nodes

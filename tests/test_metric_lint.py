@@ -1,0 +1,134 @@
+"""Metric hygiene lints (PR 2 satellites).
+
+1. Static scan of every registration site in cockroach_tpu/: metric
+   names must be lowercase dotted ([a-z0-9._]), and one name must not
+   be registered under two different metric kinds (a counter in one
+   file and a gauge in another renders a nonsense /_status/vars).
+   The reference enforces the same invariants through its metadata
+   registry (pkg/util/metric/registry.go checks for reuse).
+2. Exposition-format checks on a synthetic registry exercising every
+   metric kind, including the cumulative-histogram encoding
+   (`_bucket{le=...}` monotone, +Inf == _count) and HELP escaping.
+"""
+
+import pathlib
+import re
+
+from cockroach_tpu.utils.metric import MetricRegistry
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# .counter("name") / .func_gauge(f"name.{x}") ... across line breaks
+_REG_RE = re.compile(
+    r"\.(counter|gauge|histogram|func_counter|func_gauge)"
+    r"\(\s*(f?)[\"']([^\"']+)[\"']")
+
+
+def _registrations():
+    """(file, kind-family, name) for every literal registration;
+    f-string placeholders collapse to '0' so dynamic per-peer names
+    lint like their static shape."""
+    out = []
+    for p in sorted((REPO / "cockroach_tpu").rglob("*.py")):
+        for m in _REG_RE.finditer(p.read_text()):
+            kind, isf, name = m.group(1), m.group(2), m.group(3)
+            if isf:
+                name = re.sub(r"\{[^}]*\}", "0", name)
+            family = ("counter" if "counter" in kind
+                      else "gauge" if "gauge" in kind
+                      else "histogram")
+            out.append((str(p.relative_to(REPO)), family, name))
+    return out
+
+
+class TestStaticNameLint:
+    def test_scan_finds_the_registry(self):
+        regs = _registrations()
+        names = {n for _, _, n in regs}
+        # the scan must keep seeing the core families — an empty scan
+        # would vacuously pass everything below
+        assert len(names) >= 20
+        for expect in ("rpc.frames.sent", "distsender.rpcs",
+                       "breaker.peer.trips", "shuffle.bytes.sent",
+                       "sql.exec.latency"):
+            assert expect in names, f"scan lost {expect}"
+
+    def test_names_are_lowercase_dotted(self):
+        bad = [(f, n) for f, _, n in _registrations()
+               if not re.fullmatch(r"[a-z0-9._]+", n)]
+        assert not bad, f"invalid metric names: {bad}"
+
+    def test_no_name_registered_under_two_kinds(self):
+        kinds: dict = {}
+        for f, family, name in _registrations():
+            kinds.setdefault(name, {})[family] = f
+        dups = {n: k for n, k in kinds.items() if len(k) > 1}
+        assert not dups, f"metric kind collisions: {dups}"
+
+
+class TestExpositionFormat:
+    def _registry(self):
+        reg = MetricRegistry()
+        reg.counter("lint.ops", "ops so far").inc(5)
+        reg.gauge("lint.level", "current level").set(2.5)
+        reg.func_counter("lint.fc", lambda: 7, "derived counter")
+        reg.func_gauge("lint.fg", lambda: 1.5, "derived gauge")
+        h = reg.histogram("lint.lat.seconds",
+                          "latency\nwith newline \\ backslash")
+        for v in (1e-6, 1e-3, 0.1, 0.1, 30.0):
+            h.observe(v)
+        return reg
+
+    def test_type_lines_per_kind(self):
+        text = self._registry().to_prometheus()
+        assert "# TYPE lint_ops counter" in text
+        assert "# TYPE lint_level gauge" in text
+        assert "# TYPE lint_fc counter" in text
+        assert "# TYPE lint_fg gauge" in text
+        assert "# TYPE lint_lat_seconds histogram" in text
+        assert "lint_fc 7" in text and "lint_fg 1.5" in text
+
+    def test_help_newlines_escaped(self):
+        text = self._registry().to_prometheus()
+        for ln in text.splitlines():
+            if ln.startswith("# HELP lint_lat_seconds"):
+                assert "\\n" in ln and "\\\\" in ln
+                break
+        else:
+            raise AssertionError("HELP line missing")
+
+    def test_histogram_cumulative_buckets(self):
+        text = self._registry().to_prometheus()
+        buckets = []
+        inf = count = None
+        for ln in text.splitlines():
+            m = re.match(
+                r'lint_lat_seconds_bucket\{le="([^"]+)"\} (\d+)', ln)
+            if m:
+                if m.group(1) == "+Inf":
+                    inf = int(m.group(2))
+                else:
+                    buckets.append((float(m.group(1)),
+                                    int(m.group(2))))
+            elif ln.startswith("lint_lat_seconds_count "):
+                count = int(ln.split()[-1])
+        assert count == 5 and inf == 5
+        # bounds ascending, counts cumulative (monotone nondecreasing)
+        assert [b for b, _ in buckets] == \
+            sorted(b for b, _ in buckets)
+        cs = [c for _, c in buckets]
+        assert cs == sorted(cs) and cs[-1] <= 5
+        # the two 0.1s observations land in a bucket whose bound
+        # covers 0.1, so some cumulative step jumps by >= 2
+        steps = [b - a for a, b in zip([0] + cs, cs + [5])]
+        assert max(steps) >= 2
+
+    def test_every_sample_line_well_formed(self):
+        text = self._registry().to_prometheus()
+        sample = re.compile(
+            r'^[a-z_][a-z0-9_]*(\{le="[^"]+"\})? '
+            r'(-?[0-9.eE+]+|-?inf|nan)$')
+        for ln in text.splitlines():
+            if ln.startswith("#") or not ln.strip():
+                continue
+            assert sample.match(ln), f"malformed: {ln!r}"

@@ -7,6 +7,7 @@ aggregation)."""
 import pytest
 
 from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.utils import tracing
 from cockroach_tpu.utils.sqlstats import StatsRegistry, fingerprint
 from cockroach_tpu.utils.tracing import Tracer
 
@@ -47,6 +48,117 @@ class TestTracer:
             th.join()
         assert rec.find("inner") is None  # other thread's span
         assert seen[0].find("inner") is not None
+
+
+class TestTraceWire:
+    """The trace-frame wire format (OBSERVABILITY.md): context export,
+    span codec, remote grafting."""
+
+    def test_trace_context_none_outside_capture(self):
+        assert tracing.trace_context() is None
+        assert tracing.event("nobody-listening") is None
+        assert tracing.attach_remote({"n": "x"}) is None
+
+    def test_trace_context_carries_ids(self):
+        with tracing.capture("root") as rec:
+            tc = tracing.trace_context()
+            assert tc == {"tid": rec.trace_id, "sid": rec.span_id}
+            with tracing.span("child") as s:
+                tc2 = tracing.trace_context()
+                assert tc2 == {"tid": rec.trace_id,
+                               "sid": s.span_id}
+                assert tc2["sid"] != tc["sid"]
+
+    def test_wire_roundtrip(self):
+        with tracing.capture("root", q="sel") as rec:
+            with tracing.span("inner", rows=7):
+                tracing.event("mark", hit=True)
+        w = tracing.span_to_wire(rec)
+        back = tracing.span_from_wire(w)
+        assert back.name == "root" and back.tags["q"] == "sel"
+        assert back.find("inner").tags == {"rows": 7}
+        assert back.find("mark").tags == {"hit": True}
+        assert back.trace_id == rec.trace_id
+        assert back.find("inner").duration_ms >= 0
+
+    def test_wire_tags_are_json_safe(self):
+        with tracing.capture("r", blob=b"\x01", obj=object()) as rec:
+            pass
+        t = tracing.span_to_wire(rec)["t"]
+        for v in t.values():
+            assert isinstance(v, (str, int, float, bool, type(None)))
+
+    def test_attach_remote_grafts_under_active_span(self):
+        remote_wire = {"n": "rpc:read", "b": 0, "e": 1000000,
+                       "t": {"node": 2}, "c": [], "sid": 9, "tid": 4}
+        with tracing.capture("stmt") as rec:
+            with tracing.span("rpc-attempt", attempt=0):
+                tracing.attach_remote(remote_wire)
+        got = rec.find("rpc:read")
+        assert got is not None and got.tags["node"] == 2
+        assert rec.children[0].name == "rpc-attempt"
+        assert rec.children[0].children[0] is got
+
+    def test_capture_with_remote_ctx_adopts_trace_id(self):
+        with tracing.capture("serve", remote_ctx={"tid": 42,
+                                                  "sid": 17}) as rec:
+            pass
+        assert rec.trace_id == 42
+        assert rec.tags["parent_sid"] == 17
+
+    def test_find_all_counts_repeats(self):
+        with tracing.capture("r") as rec:
+            for i in range(3):
+                with tracing.span("rpc-attempt", attempt=i):
+                    pass
+        attempts = rec.find_all("rpc-attempt")
+        assert [s.tags["attempt"] for s in attempts] == [0, 1, 2]
+
+    def test_module_stack_shared_across_tracers(self):
+        """Two Tracer instances share one recording stack — the
+        property that lets fabric spans nest under engine captures."""
+        with Tracer().capture("root") as rec:
+            with Tracer().span("from-another-tracer"):
+                tracing.tag(seen=1)
+        assert rec.find("from-another-tracer").tags == {"seen": 1}
+
+
+class TestSlowTraceRing:
+    """sql.trace.slow_statement.threshold feeds engine.slow_traces
+    (served at /debug/tracez)."""
+
+    def test_threshold_zero_keeps_ring_empty(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT)")
+        e.execute("INSERT INTO t VALUES (1)")
+        e.execute("SELECT a FROM t")
+        assert len(e.slow_traces) == 0
+
+    def test_slow_statements_recorded(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT)")
+        e.settings.set("sql.trace.slow_statement.threshold", 1e-9)
+        e.execute("INSERT INTO t VALUES (1),(2)")
+        e.execute("SELECT count(*) FROM t")
+        assert len(e.slow_traces) >= 2
+        last = e.slow_traces[-1]
+        assert last["sql"] == "SELECT count(*) FROM t"
+        assert last["fingerprint"] == "SELECT count(*) FROM t"
+        assert last["duration_s"] > 0
+        # the span is wire-format (JSON-safe) with real structure
+        span = tracing.span_from_wire(last["span"])
+        assert span.find("dispatch") is not None
+
+    def test_session_tracing_unaffected_by_threshold(self):
+        e = Engine()
+        e.execute("CREATE TABLE t (a INT)")
+        e.settings.set("sql.trace.slow_statement.threshold", 1e-9)
+        s = e.session()
+        e.execute("SET tracing = on", session=s)
+        e.execute("SELECT a FROM t", session=s)
+        e.execute("SET tracing = off", session=s)
+        rows = e.execute("SHOW TRACE FOR SESSION", session=s).rows
+        assert any("SELECT a FROM t" in r[0] for r in rows)
 
 
 class TestFingerprint:
