@@ -34,7 +34,9 @@ finishes in minutes on a tunnel-attached chip; 2^22 on CPU —
 BENCH_ROWS=$((1<<27)) reproduces the headline run in BENCHMARKS.md),
 BENCH_QUERY (q6|q1|q14|all; default all), BENCH_PIPELINE (default 16),
 BENCH_REPEATS (default 5), BENCH_CPU=0 to skip the CPU-baseline
-subprocess, BENCH_CPU_ROWS (default 2^22).
+subprocess, BENCH_CPU_ROWS (default 2^22), BENCH_STREAM=0 /
+BENCH_DISPATCHQ=0 to skip the PR 3 data-plane benches (streamed-scan
+pipeline A/B and concurrent distributed dispatch).
 """
 
 import json
@@ -264,6 +266,103 @@ def run_ycsb_e(records, steps):
     return out["ops_per_sec"], outc["ops_per_sec"]
 
 
+def run_stream(rows, repeats):
+    """Streamed-scan A/B (PR 3 tentpole): Q6 over a lineitem bigger
+    than the HBM budget, paged through the data plane with the
+    background prefetch pipeline on vs off (`SET streaming_pipeline`).
+    The on/off ratio is the overlap win: worker-thread page assembly
+    + upload hidden behind device compute. NOTE: on the XLA-CPU
+    backend "device" compute shares the host cores with the prefetch
+    worker, so there is no free capacity to overlap into and the
+    ratio can dip below 1; the win is real when compute runs on the
+    accelerator."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    eng = Engine(mesh=None)
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem",), encoded=True)
+    print(f"# stream datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    # budget far below the table at any bench size: the scan MUST
+    # stream
+    eng.settings.set("sql.exec.hbm_budget_bytes", 1 << 20)
+    page_rows = min(1 << 18, rows // 8)
+    rates = {}
+    for pipeline in ("on", "off"):
+        s = eng.session()
+        s.vars.set("distsql", "off")
+        s.vars.set("streaming_page_rows", page_rows)
+        s.vars.set("streaming_pipeline", pipeline)
+        eng.execute(tpch.QUERIES["q6"], s)  # warmup: compile page fns
+        snap0 = eng.metrics.snapshot()
+        per = []
+        for _ in range(repeats):
+            t0 = time.time()
+            eng.execute(tpch.QUERIES["q6"], s)
+            per.append(rows / (time.time() - t0))
+        rates[pipeline] = statistics.median(per)
+        d = metric_deltas(snap0, eng.metrics.snapshot())
+        print(f"# stream pipeline={pipeline} "
+              f"rows_per_sec={rates[pipeline]:.3e} "
+              f"pages={d.get('exec.stream.pages', 0)} "
+              f"stalls={d.get('exec.stream.prefetch_stall_seconds.count', 0)}",
+              file=sys.stderr)
+    return rates["on"], rates["off"]
+
+
+def run_dispatchq(rows, workers=2, iters=6):
+    """Concurrent distributed dispatch (PR 3 tentpole): N sessions
+    issue distributed GROUP BYs at once through the per-mesh FIFO
+    dispatcher (the old process-wide collective lock serialized whole
+    executions; the queue only serializes dispatch, so query i+1's
+    dispatch overlaps query i's device work)."""
+    import threading as _th
+
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.parallel.mesh import make_mesh
+
+    eng = Engine(mesh=make_mesh())
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem",), encoded=True)
+    print(f"# dispatchq datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    sql = ("SELECT l_returnflag, count(*) AS n, sum(l_quantity) AS q "
+           "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag")
+    eng.execute(sql)  # warmup: compile + upload
+
+    t0 = time.time()
+    for _ in range(workers * iters):
+        eng.execute(sql)
+    serial_qps = workers * iters / (time.time() - t0)
+
+    errors = []
+
+    def worker():
+        try:
+            s = eng.session()
+            for _ in range(iters):
+                eng.execute(sql, s)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [_th.Thread(target=worker) for _ in range(workers)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conc_qps = workers * iters / (time.time() - t0)
+    if errors:
+        raise errors[0]
+    print(f"# dispatchq serial_qps={serial_qps:.2f} "
+          f"concurrent{workers}_qps={conc_qps:.2f}", file=sys.stderr)
+    return serial_qps, conc_qps
+
+
 def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
               mode: str = "tpu_child"):
     """One query/measurement in its own subprocess: a fresh backend
@@ -385,6 +484,25 @@ def main():
             "metric": "tpcc_tpmc", "value": round(out["tpm_c"]),
             "unit": "tpmC", "warehouses": wh}))
         return
+    if mode == "stream_child":
+        on, off = run_stream(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "stream_scan_rows_per_sec", "value": round(on),
+            "unit": "rows/s", "rows": rows,
+            "stream_scan_off_rows_per_sec": round(off),
+            "stream_pipeline_speedup": round(on / off, 3) if off else 0,
+        }))
+        return
+    if mode == "dispatchq_child":
+        serial, conc = run_dispatchq(rows)
+        print(json.dumps({
+            "metric": "dispatch_concurrent2_qps",
+            "value": round(conc, 2), "unit": "queries/s", "rows": rows,
+            "dispatch_serial_qps": round(serial, 2),
+            "dispatch_concurrency_speedup":
+                round(conc / serial, 3) if serial else 0,
+        }))
+        return
     if mode in ("cpu", "tpu_child"):
         # leaf mode: measure in-process and emit one JSON line
         tag = "cpu " if mode == "cpu" else ""
@@ -481,6 +599,26 @@ def main():
             if "ycsb_e_c16_ops_per_sec" in r:
                 out["ycsb_e_c16_ops_per_sec"] = \
                     r["ycsb_e_c16_ops_per_sec"]
+    # PR 3 data-plane benches: streamed-scan pipeline A/B + concurrent
+    # distributed dispatch through the per-mesh queue
+    if os.environ.get("BENCH_STREAM", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_STREAM_ROWS", 1 << 22)),
+                      "stream", child_timeout, mode="stream_child")
+        if r is not None:
+            out["stream_scan_rows_per_sec"] = r["value"]
+            out["stream_scan_off_rows_per_sec"] = \
+                r["stream_scan_off_rows_per_sec"]
+            out["stream_pipeline_speedup"] = r["stream_pipeline_speedup"]
+            out["stream_rows"] = r["rows"]
+    if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
+                                         1 << 20)),
+                      "dispatchq", child_timeout, mode="dispatchq_child")
+        if r is not None:
+            out["dispatch_concurrent2_qps"] = r["value"]
+            out["dispatch_serial_qps"] = r["dispatch_serial_qps"]
+            out["dispatch_concurrency_speedup"] = \
+                r["dispatch_concurrency_speedup"]
     if os.environ.get("BENCH_TPCC", "1") != "0":
         r = run_child(0, "tpcc", 900, mode="tpcc_child")
         if r is not None:

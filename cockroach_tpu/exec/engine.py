@@ -30,7 +30,7 @@ from ..kv.txn import KVStore, Txn
 from ..ops.batch import ColumnBatch
 from ..parallel import mesh as meshmod
 from ..parallel.distagg import analyze as dist_analyze
-from ..parallel.distagg import locked_collective_call, make_distributed_fn
+from ..parallel.distagg import make_distributed_fn, queued_collective_call
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import ast, parser
 from ..sql import plan as P
@@ -48,6 +48,7 @@ from ..utils.settings import SessionVars, Settings
 from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
 from .expr import ExprContext, compile_expr
+from .stream import extract_zone_preds
 from .session import (CompactOverflow, EngineError, HashCapacityExceeded,
                       Prepared, Result, Session)
 from .stmtutil import (_StreamFns, _RerunPrepared, _host_sort, _count_aggs,
@@ -1474,10 +1475,10 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                  jax.jit(splan.final_fn))
             elif decision is not None:
                 runf = compile_plan(node, params, meta)
-                jfn = locked_collective_call(
+                jfn = queued_collective_call(
                     jax.jit(make_distributed_fn(
                         runf, self.mesh, scan_aliases, decision)),
-                    metrics=self.metrics)
+                    metrics=self.metrics, mesh=self.mesh)
             else:
                 runf = compile_plan(node, params, meta)
 
@@ -1488,10 +1489,16 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         else:
             jfn, meta = cached
         gens = tuple(sorted(gens))
+        # zone-map checks for the streamed scan's pushed-down
+        # predicates: compiled from THIS prepare's plan (constants are
+        # inlined), so they track the statement's current bindings
+        stream_zone = (extract_zone_preds(node, stream[0])
+                       if stream is not None else ())
         prepared = Prepared(self, session, sel, sql_text, jfn, scans,
                             meta, gens, stream=stream,
                             stream_cols=(scan_cols.get(stream[0])
                                          if stream else None),
+                            stream_zone=stream_zone,
                             as_of=as_of)
         # alias -> table map (composed CTE execution patches temp
         # aliases' scan batches per run, exec/ctecompose.py)

@@ -16,7 +16,7 @@ import numpy as np
 from ..ops.batch import ColumnBatch
 from ..parallel import mesh as meshmod
 from ..parallel.distagg import analyze as dist_analyze
-from ..parallel.distagg import locked_collective_call, make_distributed_fn
+from ..parallel.distagg import make_distributed_fn, queued_collective_call
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import plan as P
 from ..storage.hlc import Timestamp
@@ -28,7 +28,9 @@ EPOCH_DT = datetime.datetime(1970, 1, 1)
 from .session import (SENTINEL_COLUMNS, CompactOverflow, EngineError,
                       HashCapacityExceeded, Prepared, TopKInexact,
                       Result, Session)
-from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _host_sort, _next_pow2, _pad, _slice_chunks)
+from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _has_join, _host_sort, _next_pow2, _pad)
+from .stream import PageSource
+from .stream import prefetch as stream_prefetch
 
 
 # exception factory per sentinel; names come from the one registry
@@ -107,10 +109,11 @@ class ScanPlaneMixin:
                           if decision is not None else 1))
             runf = compile_plan(node, params, meta)
             if decision is not None:
-                jfn = locked_collective_call(jax.jit(
+                jfn = queued_collective_call(jax.jit(
                     make_distributed_fn(
                         runf, self.mesh, _collect_scans(node),
-                        decision)))
+                        decision)),
+                    metrics=self.metrics, mesh=self.mesh)
             else:
                 def fn(scans_in, ts_in, np_, pid_):
                     return runf(RunContext(scans_in, ts_in, np_, pid_))
@@ -181,7 +184,15 @@ class ScanPlaneMixin:
         n_aggs = _count_aggs(node)
         padded = max(_next_pow2(max(td.row_count, 1)), 1024)
         temp_bytes = 16 * n_aggs * padded
-        if (self._table_device_bytes(td, scan_cols.get(alias))
+        # the resident upload this decision weighs would narrow its
+        # int32-provable columns UNLESS the scan feeds a join
+        # (_set_scan_narrowing keeps probe spines wide) — charging
+        # int64 width for narrowed columns inflates the estimate ~2x
+        # and streams tables that actually fit
+        cols = scan_cols.get(alias)
+        narrow = (frozenset() if _has_join(node)
+                  else self.narrow32_cols(tname, cols))
+        if (self._table_device_bytes(td, cols, narrow=narrow)
                 + temp_bytes <= budget):
             return None
         # Build-side tables still upload whole: streaming the probe is
@@ -192,48 +203,49 @@ class ScanPlaneMixin:
                                              1 << 21)))
         return (alias, tname, page_rows)
 
-    def _table_device_bytes(self, td, cols) -> int:
-        """Device bytes a pruned upload of this table would take."""
+    def _table_device_bytes(self, td, cols,
+                            narrow: frozenset = frozenset()) -> int:
+        """Device bytes a pruned upload of this table would take.
+        Columns in ``narrow`` upload as int32 (narrow32_cols), so they
+        charge 4+1 bytes per row, not the stored 8+1."""
         n = td.row_count
         padded = max(_next_pow2(max(n, 1)), 1024)
         total = 16 * padded  # the two MVCC int64 columns
         for col in td.schema.columns:
             if cols is not None and col.name not in cols:
                 continue
-            total += (np.dtype(col.type.np_dtype).itemsize + 1) * padded
+            w = (4 if col.name in narrow
+                 else np.dtype(col.type.np_dtype).itemsize)
+            total += (w + 1) * padded
         return total
 
-    def _iter_pages(self, tname: str, cols, page_rows: int):
-        """Yield fixed-shape device pages of a table's chunks. Each
-        page is padded to page_rows with never-visible rows so one XLA
-        program serves every page."""
+    def _page_source(self, tname: str, cols, page_rows: int,
+                     zone_preds=()) -> PageSource:
+        """One-time per-execution setup for streamed paging: seal open
+        rows ONCE here (not per page), snapshot the chunk list, and
+        hand the prefix-offset assembler its zone predicates."""
         td = self.store.table(tname)
         if td.open_ts:
             self.store.seal(tname)
-        chunks = list(td.chunks)
-        total = sum(c.n for c in chunks)
-        names = [c.name for c in td.schema.columns
-                 if cols is None or c.name in cols]
-        start = 0
-        while start < total:
-            end = min(start + page_rows, total)
-            data = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.data[cn],
-                                      start, end)
-                    for cn in names}
-            valid = {cn: _slice_chunks(chunks, lambda c, cn=cn: c.valid[cn],
-                                       start, end)
-                     for cn in names}
-            mts = _slice_chunks(chunks, lambda c: c.mvcc_ts, start, end)
-            mdl = _slice_chunks(chunks, lambda c: c.mvcc_del, start, end)
-            page = {cn: _pad(a, page_rows) for cn, a in data.items()}
-            page["_mvcc_ts"] = _pad(mts, page_rows, fill=np.int64(2**62))
-            page["_mvcc_del"] = _pad(mdl, page_rows, fill=np.int64(0))
-            vmap = {cn: _pad(v, page_rows) for cn, v in valid.items()
-                    if not v.all()}
-            yield ColumnBatch.from_dict(
-                {k: jnp.asarray(v) for k, v in page.items()},
-                {k: jnp.asarray(v) for k, v in vmap.items()})
-            start = end
+        return PageSource(td, cols, page_rows, zone_preds=zone_preds,
+                          metrics=self.metrics)
+
+    def _stream_pages(self, tname: str, cols, page_rows: int,
+                      zone_preds=(), pipeline: bool = True):
+        """Iterator of fixed-shape device pages of a table's chunks,
+        padded to page_rows with never-visible rows so one XLA program
+        serves every page. With ``pipeline``, a bounded background
+        worker assembles+uploads page i+1 while the caller's device
+        work on page i runs; zone-pruned pages never leave the host."""
+        src = self._page_source(tname, cols, page_rows, zone_preds)
+        if not pipeline:
+            return src.pages()
+        return stream_prefetch(
+            src.pages(),
+            stall_hist=self.metrics.histogram(
+                "exec.stream.prefetch_stall_seconds",
+                "consumer wait per streamed page (0 when the "
+                "prefetch pipeline is ahead of the device)"))
 
     # -- device table cache --------------------------------------------------
     def _evict_device(self, key) -> None:
@@ -279,16 +291,18 @@ class ScanPlaneMixin:
         if td.open_ts:
             self.store.seal(name)
         key = (name, td.generation, placement, cols, narrow)
-        # account BEFORE upload; replication costs a copy per device
-        nbytes = self._table_device_bytes(td, cols)
+        # account BEFORE upload; replication costs a copy per device.
+        # The reservation uses the same narrow set the upload will,
+        # so narrowed tables no longer reserve ~2x their real bytes
+        narrow_set = (self.narrow32_cols(name, cols) if narrow
+                      else frozenset())
+        nbytes = self._table_device_bytes(td, cols, narrow=narrow_set)
         if placement == "replicated" and self.mesh is not None:
             nbytes *= self.mesh.size
         self.hbm.reserve(key, nbytes)
         try:
-            b = self._batch_from_chunks(
-                td, td.chunks, cols,
-                narrow=(self.narrow32_cols(name, cols) if narrow
-                        else frozenset()))
+            b = self._batch_from_chunks(td, td.chunks, cols,
+                                        narrow=narrow_set)
             if placement == "sharded":
                 b = jax.device_put(b, meshmod.row_sharding(self.mesh))
             elif placement == "replicated":

@@ -120,6 +120,10 @@ class Prepared:
     # beyond-HBM paging: (alias, page_rows) of the streamed fact table
     stream: Optional[tuple] = None
     stream_cols: Optional[frozenset] = None
+    # zone-map checks compiled from the streamed scan's pushed-down
+    # predicates (exec/stream.extract_zone_preds): pages whose chunk
+    # summaries cannot satisfy them never upload
+    stream_zone: tuple = ()
     # AS OF SYSTEM TIME: fixed historical read timestamp
     as_of: Optional[Timestamp] = None
 
@@ -138,6 +142,7 @@ class Prepared:
             self.jfn, self.scans, self.meta, self.gens = \
                 p.jfn, p.scans, p.meta, p.gens
             self.stream, self.stream_cols = p.stream, p.stream_cols
+            self.stream_zone = p.stream_zone
             self.as_of = p.as_of  # keep guard + execution timestamps
             # consistent (interval forms re-resolve on refresh)
         ts = read_ts or self.as_of or \
@@ -148,19 +153,38 @@ class Prepared:
         if self.stream is None:
             return self.jfn(self.scans, tsv, np.int32(nparts),
                             np.int32(pid))
-        # paged execution: every page's upload+compute dispatches
-        # asynchronously, so page i+1's host-side assembly overlaps
-        # page i's device work (the double-buffering of the
-        # reference's byte-limited KV paging, kv_batch_fetcher.go:191)
+        # paged execution through the prefetch pipeline: a bounded
+        # background worker assembles+uploads page i+1 while the
+        # device computes page i, and zone-pruned pages never move
+        # (the double-buffering of the reference's byte-limited KV
+        # paging, kv_batch_fetcher.go:191, plus its zone-map-style
+        # span pruning). `streaming_pipeline = off` keeps the same
+        # iterator synchronous (bench A/B + debugging).
         _alias, tname, page_rows = self.stream
         fns: _StreamFns = self.jfn
         state = None
         scans = dict(self.scans)
-        for page in self.engine._iter_pages(tname, self.stream_cols,
-                                            page_rows):
-            scans[_alias] = page
-            s = fns.page(scans, tsv)
-            state = s if state is None else fns.combine(state, s)
+        pipeline = self.session.vars.get("streaming_pipeline",
+                                         "on") != "off"
+        pages = self.engine._stream_pages(
+            tname, self.stream_cols, page_rows,
+            zone_preds=self.stream_zone, pipeline=pipeline)
+        try:
+            for page in pages:
+                scans[_alias] = page
+                s = fns.page(scans, tsv)
+                state = s if state is None else fns.combine(state, s)
+        finally:
+            close = getattr(pages, "close", None)
+            if close is not None:
+                close()  # join the prefetch worker on any exit
+        if state is None:
+            # zone maps pruned EVERY page: run one never-visible
+            # padding page so the aggregate still yields its empty
+            # state (COUNT 0, NULL sums) instead of a shape error
+            scans[_alias] = self.engine._page_source(
+                tname, self.stream_cols, page_rows).empty_page()
+            state = fns.page(scans, tsv)
         return fns.final(state)
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":

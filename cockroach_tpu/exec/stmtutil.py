@@ -102,6 +102,19 @@ def _slice_chunks(chunks: list, getter, start: int, end: int) -> np.ndarray:
     return np.concatenate(parts) if parts else np.zeros(0)
 
 
+def _has_join(node: P.PlanNode) -> bool:
+    """Does any HashJoin appear in the plan? (Scans under joins keep
+    wide uploads — see engine._set_scan_narrowing — so the streaming
+    fit estimate must not assume narrowing for them.)"""
+    if isinstance(node, P.HashJoin):
+        return True
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if c is not None and _has_join(c):
+            return True
+    return False
+
+
 def _collect_scans(node: P.PlanNode) -> dict[str, str]:
     out = {}
     if isinstance(node, P.Scan):

@@ -122,38 +122,121 @@ def partials_replannable(node: P.PlanNode) -> bool:
 # XLA's host-platform collectives rendezvous by participant count:
 # when two 8-participant AllReduce executions interleave from
 # different threads, each grabs some of the device slots and both
-# wait forever (collective_ops_utils.h "may be stuck"). Concurrent
-# SQL sessions therefore serialize their DISTRIBUTED executions on
-# one process-wide lock; single-device plans are unaffected.
-_COLLECTIVE_CALL_LOCK = threading.Lock()
+# wait forever (collective_ops_utils.h "may be stuck"). Earlier
+# rounds serialized every distributed execution on one process-wide
+# lock — safe, but a session held the lock for the whole device
+# execution, so concurrent distributed plans ran strictly one at a
+# time. The fix below keeps the ordering invariant (one thread issues
+# every execution for a device set, so rendezvous never interleave)
+# while dropping the hold time to just the DISPATCH: jitted calls
+# return as soon as XLA enqueues the work, so the dispatcher can
+# issue query i+1 while the devices still execute query i.
+
+class _MeshDispatcher:
+    """Single-thread FIFO executor for one device set.
+
+    Sessions enqueue collective calls and block on futures; the one
+    dispatcher thread issues XLA executions back-to-back in program
+    order. Keyed by the mesh's device-id tuple, NOT mesh identity:
+    two equal meshes built by two engines over the same devices share
+    one rendezvous domain and MUST share one dispatcher."""
+
+    def __init__(self, name: str):
+        import queue
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"mesh-dispatch-{name}",
+            daemon=True)
+        self._thread.start()
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def submit(self, fn, args, kwargs, on_start=None):
+        import concurrent.futures
+        import time as _time
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._q.put((fn, args, kwargs, fut, _time.monotonic(),
+                     on_start))
+        return fut
+
+    def _loop(self):
+        import time as _time
+        while True:
+            fn, args, kwargs, fut, t_enq, on_start = self._q.get()
+            if on_start is not None:
+                try:
+                    on_start(_time.monotonic() - t_enq)
+                except Exception:
+                    pass
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:
+                fut.set_exception(e)
 
 
-def locked_collective_call(jfn, metrics=None):
+_DISPATCHERS: dict = {}
+_DISPATCHERS_LOCK = threading.Lock()
+
+
+def _dispatcher_for(mesh) -> _MeshDispatcher:
+    if mesh is None:
+        key: tuple = ("process",)
+    else:
+        key = tuple(int(d.id) for d in mesh.devices.flat)
+    with _DISPATCHERS_LOCK:
+        d = _DISPATCHERS.get(key)
+        if d is None:
+            d = _MeshDispatcher("-".join(str(k) for k in key))
+            _DISPATCHERS[key] = d
+        return d
+
+
+def queued_collective_call(jfn, metrics=None, mesh=None):
     """Wrap a jitted multi-device callable so concurrent sessions
     cannot interleave collective rendezvous (deadlock otherwise —
     this must wrap the CALL: a lock inside the traced function would
-    only run at trace time).
+    only run at trace time). Calls route through the per-mesh FIFO
+    dispatcher above; the caller blocks on a future, so semantics
+    match the old locked call, minus the serialization of device
+    execution time.
 
     With a MetricRegistry, each call counts as one collective
-    dispatch and its wall time (lock wait + device execution) feeds
-    the allreduce latency histogram — the data-movement accounting a
-    distributed accelerator engine tunes against."""
+    dispatch, its wall time feeds the allreduce latency histogram,
+    and the queue depth / enqueue-to-dispatch wait surface as
+    exec.queue.* — the data-movement accounting a distributed
+    accelerator engine tunes against."""
     import time as _time
-    m_calls = m_secs = None
+    m_calls = m_secs = m_depth = m_wait = None
     if metrics is not None:
         m_calls = metrics.counter(
             "exec.allreduce.calls",
             "distributed (collective) plan dispatches")
         m_secs = metrics.histogram(
             "exec.allreduce.seconds",
-            "wall seconds per collective dispatch (incl. lock wait)")
+            "wall seconds per collective dispatch (incl. queue wait)")
+        m_depth = metrics.gauge(
+            "exec.queue.depth",
+            "per-mesh collective dispatch-queue depth at enqueue")
+        m_wait = metrics.histogram(
+            "exec.queue.wait_seconds",
+            "enqueue-to-dispatch wait per collective call")
+    disp = _dispatcher_for(mesh)
+
+    def on_start(wait: float):
+        if m_wait is not None:
+            m_wait.observe(wait)
 
     @functools.wraps(jfn)
     def call(*args, **kwargs):
         t0 = _time.monotonic()
+        if m_depth is not None:
+            m_depth.set(disp.depth() + 1)
+        fut = disp.submit(jfn, args, kwargs, on_start)
         try:
-            with _COLLECTIVE_CALL_LOCK:
-                return jfn(*args, **kwargs)
+            return fut.result()
         finally:
             if m_calls is not None:
                 m_calls.inc()

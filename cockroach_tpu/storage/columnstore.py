@@ -86,9 +86,42 @@ class Chunk:
     # declared primary key (the reference synthesizes a rowid column
     # the same way, pkg/sql/catalog/tabledesc)
     rowid: Optional[np.ndarray] = None
+    # lazy per-column zone maps (sstable block-property collectors /
+    # the reference's crdb_internal_mvcc-free span stats): column
+    # data is immutable once the chunk is sealed, so a computed
+    # summary stays valid for the chunk's lifetime. mvcc_del IS
+    # mutable (tombstones), but zones summarize data columns only —
+    # a deleted row's value still bounds the zone, which keeps
+    # skipping conservative under any read timestamp.
+    _zones: dict = field(default_factory=dict, repr=False, compare=False)
 
     def live_mask(self, ts: int) -> np.ndarray:
         return (self.mvcc_ts <= ts) & (ts < self.mvcc_del)
+
+    def zone(self, col: str):
+        """(lo, hi, null_count, valid_count) over this chunk's valid
+        lanes of `col`; (None, None, ...) when bounds are unknown
+        (object dtype, NaNs, or an all-null chunk). Bounds cover ALL
+        row versions, so predicate checks against them are
+        visibility-independent and only ever under-skip."""
+        z = self._zones.get(col)
+        if z is None:
+            d = self.data[col]
+            v = self.valid[col]
+            nvalid = int(v.sum())
+            if nvalid == 0 or d.dtype.kind not in "biuf":
+                z = (None, None, self.n - nvalid, nvalid)
+            else:
+                vals = d if nvalid == self.n else d[v]
+                lo, hi = vals.min(), vals.max()
+                if d.dtype.kind == "f" and (np.isnan(lo) or np.isnan(hi)):
+                    z = (None, None, self.n - nvalid, nvalid)
+                elif d.dtype.kind == "f":
+                    z = (float(lo), float(hi), self.n - nvalid, nvalid)
+                else:
+                    z = (int(lo), int(hi), self.n - nvalid, nvalid)
+            self._zones[col] = z
+        return z
 
 
 @dataclass

@@ -126,6 +126,9 @@ class SessionVars:
         "distsql": "auto",           # auto | on | off | always
         "streaming": "auto",         # auto | off (beyond-HBM paging)
         "streaming_page_rows": _meta_page_rows(),
+        # on | off: background page-prefetch pipeline for streamed
+        # scans (off = assemble each page synchronously; A/B lever)
+        "streaming_pipeline": "on",
         "direct_columnar_scans_enabled": True,
         "hash_group_capacity": 1 << 17,
         # opt-in one-pass Pallas kernel for dense float GROUP BY

@@ -1,0 +1,103 @@
+"""Per-mesh dispatch queue (PR 3 tentpole): concurrent distributed
+plans progress through one FIFO dispatcher per device set — no global
+collective lock, no interleaved-rendezvous deadlock."""
+
+import threading
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.models import tpch
+from cockroach_tpu.parallel import distagg
+from cockroach_tpu.parallel.mesh import make_mesh
+
+ROWS = 8_000
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine(mesh=make_mesh())
+    tpch.load(e, sf=0.01, rows=ROWS)
+    return e
+
+
+def test_global_lock_is_gone():
+    assert not hasattr(distagg, "_COLLECTIVE_CALL_LOCK")
+    assert not hasattr(distagg, "locked_collective_call")
+
+
+class TestDispatcherUnit:
+    def test_fifo_order(self):
+        d = distagg._MeshDispatcher("test-fifo")
+        order = []
+        futs = [d.submit(lambda i=i: order.append(i) or i, (), {})
+                for i in range(20)]
+        assert [f.result(timeout=10) for f in futs] == list(range(20))
+        assert order == list(range(20))
+
+    def test_exception_propagates_to_caller(self):
+        def boom():
+            raise ValueError("inside dispatcher")
+
+        call = distagg.queued_collective_call(boom, mesh=None)
+        with pytest.raises(ValueError, match="inside dispatcher"):
+            call()
+
+    def test_shared_dispatcher_per_device_set(self, eng):
+        # two equal meshes over the same devices MUST share one
+        # dispatcher (same rendezvous domain)
+        a = distagg._dispatcher_for(eng.mesh)
+        b = distagg._dispatcher_for(make_mesh())
+        assert a is b
+
+    def test_queue_metrics_flow(self):
+        from cockroach_tpu.utils.metric import MetricRegistry
+        reg = MetricRegistry()
+        call = distagg.queued_collective_call(lambda x: x + 1,
+                                              metrics=reg, mesh=None)
+        assert call(41) == 42
+        assert reg.get("exec.allreduce.calls").value() == 1
+        assert reg.get("exec.queue.wait_seconds").value()["count"] == 1
+        assert reg.get("exec.queue.depth") is not None
+
+
+class TestConcurrentDistributedPlans:
+    def test_two_group_bys_no_deadlock(self, eng):
+        """Two sessions dispatch distributed GROUP BYs concurrently;
+        with interleaved rendezvous this deadlocks (the reason for
+        the old process-wide lock) — through the per-mesh queue both
+        must finish and agree with serial execution."""
+        sql_a = ("SELECT l_returnflag, count(*) AS n, "
+                 "sum(l_quantity) AS q FROM lineitem "
+                 "GROUP BY l_returnflag ORDER BY l_returnflag")
+        sql_b = ("SELECT min(l_shipdate) AS lo, max(l_shipdate) AS hi "
+                 "FROM lineitem WHERE l_quantity > 5")
+        expect_a = eng.execute(sql_a).rows
+        expect_b = eng.execute(sql_b).rows
+
+        results: dict = {}
+        errors: list = []
+
+        def run(name, sql, n=6):
+            try:
+                s = eng.session()
+                for _ in range(n):
+                    results[name] = eng.execute(sql, s).rows
+            except BaseException as e:  # surfaced below
+                errors.append((name, e))
+
+        ta = threading.Thread(target=run, args=("a", sql_a))
+        tb = threading.Thread(target=run, args=("b", sql_b))
+        ta.start()
+        tb.start()
+        ta.join(timeout=120)
+        tb.join(timeout=120)
+        assert not ta.is_alive() and not tb.is_alive(), \
+            "concurrent distributed plans deadlocked"
+        assert not errors, errors
+        assert results["a"] == expect_a
+        assert results["b"] == expect_b
+
+    def test_queue_wait_metric_observed(self, eng):
+        h = eng.metrics.get("exec.queue.wait_seconds")
+        assert h is not None and h.value()["count"] > 0
