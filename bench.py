@@ -36,7 +36,9 @@ BENCH_QUERY (q6|q1|q14|all; default all), BENCH_PIPELINE (default 16),
 BENCH_REPEATS (default 5), BENCH_CPU=0 to skip the CPU-baseline
 subprocess, BENCH_CPU_ROWS (default 2^22), BENCH_STREAM=0 /
 BENCH_DISPATCHQ=0 to skip the PR 3 data-plane benches (streamed-scan
-pipeline A/B and concurrent distributed dispatch).
+pipeline A/B and concurrent distributed dispatch), BENCH_PALLAS=0 to
+skip the round-6 grouped-aggregation kernel A/B (auto vs off over
+q1/q3/q18; BENCH_PALLAS_ROWS, default 2^18).
 """
 
 import json
@@ -312,6 +314,64 @@ def run_stream(rows, repeats):
     return rates["on"], rates["off"]
 
 
+def run_pallas_ab(rows, repeats):
+    """Pallas grouped-aggregation A/B (round 6 tentpole): the GROUP BY
+    ladder queries (q1 dense small-G, q3/q18 hash-strategy large-G)
+    with `SET pallas_groupagg` auto vs off. The auto arm rides the
+    one-pass large-G kernel (one-hot MXU matmuls into VMEM tiles, no
+    scatters); the off arm is the XLA segment path with its
+    per-aggregate scatter tail. Both arms always record, so a CPU run
+    (where the kernel executes in interpret mode and the ratio is
+    meaningless) still proves the plumbing and gives the off-arm
+    baseline; the ratio is the tentpole win on the real chip."""
+    import jax
+
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.ops.pallas import groupagg as _pg
+
+    if jax.default_backend() != "tpu" and rows > (1 << 15):
+        # off-TPU the kernel executes in interpret mode and auto's
+        # cost model refuses large grids (compile.AUTO_INTERPRET_STEPS)
+        # — clamp so the auto arm still routes and the A/B stays an
+        # A/B rather than off-vs-off
+        print(f"# pallas: non-TPU backend, clamping rows {rows} -> "
+              f"{1 << 15} so auto still routes interpreted kernels",
+              file=sys.stderr)
+        rows = 1 << 15
+    eng = Engine()
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem", "orders", "customer"), encoded=True)
+    print(f"# pallas datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    out = {}
+    for which in ("q1", "q3", "q18"):
+        eng.drop_device_cache()
+        for arm in ("auto", "off"):
+            s = eng.session()
+            s.vars.set("pallas_groupagg", arm)
+            b0, f0 = _pg.BUILDS.value("large"), _pg.FALLBACKS.value()
+            eng.execute(tpch.QUERIES[which], s)  # warmup: compile
+            per = []
+            for _ in range(repeats):
+                t0 = time.time()
+                eng.execute(tpch.QUERIES[which], s)
+                per.append(rows / (time.time() - t0))
+            rps = statistics.median(per)
+            out[f"pallas_{which}_{arm}_rows_per_sec"] = round(rps)
+            print(f"# pallas {which} arm={arm} rows_per_sec={rps:.3e} "
+                  f"large_builds={_pg.BUILDS.value('large') - b0} "
+                  f"fallbacks={_pg.FALLBACKS.value() - f0}",
+                  file=sys.stderr)
+        auto = out[f"pallas_{which}_auto_rows_per_sec"]
+        off = out[f"pallas_{which}_off_rows_per_sec"]
+        out[f"pallas_{which}_speedup"] = \
+            round(auto / off, 3) if off else 0
+    out["pallas_rows"] = rows  # post-clamp: the measured size
+    return out
+
+
 def run_dispatchq(rows, workers=2, iters=6):
     """Concurrent distributed dispatch (PR 3 tentpole): N sessions
     issue distributed GROUP BYs at once through the per-mesh FIFO
@@ -493,6 +553,15 @@ def main():
             "stream_pipeline_speedup": round(on / off, 3) if off else 0,
         }))
         return
+    if mode == "pallas_child":
+        per = run_pallas_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "pallas_q1_auto_rows_per_sec",
+            "value": per.get("pallas_q1_auto_rows_per_sec", 0),
+            "unit": "rows/s", "rows": per.get("pallas_rows", rows),
+            **per,
+        }))
+        return
     if mode == "dispatchq_child":
         serial, conc = run_dispatchq(rows)
         print(json.dumps({
@@ -610,6 +679,15 @@ def main():
                 r["stream_scan_off_rows_per_sec"]
             out["stream_pipeline_speedup"] = r["stream_pipeline_speedup"]
             out["stream_rows"] = r["rows"]
+    # round 6 tentpole A/B: one-pass Pallas grouped aggregation
+    # (auto) vs the XLA segment/scatter path (off), both arms recorded
+    if os.environ.get("BENCH_PALLAS", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_PALLAS_ROWS", 1 << 18)),
+                      "pallas", child_timeout, mode="pallas_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("pallas_")})
+            out.setdefault("pallas_rows", r["rows"])
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),

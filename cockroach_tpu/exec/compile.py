@@ -51,14 +51,21 @@ class ExecParams:
     # mesh size along axis_name (static: the shuffle's send-buffer
     # shapes depend on it)
     n_shards: int = 1
-    # Opt-in (session var pallas_groupagg): route eligible dense GROUP
-    # BYs through the one-pass Pallas kernel (ops/pallas/groupagg.py)
-    # instead of per-aggregate XLA segment reductions. Eligible = all
-    # aggregates are count/count_rows, or sum/avg/min/max over FLOAT
-    # args (f32 accumulation — approximate; DECIMAL stays on the
-    # int64-exact XLA path). pallas_interpret runs the kernel in
-    # interpret mode off-TPU (the engine sets it from the backend).
-    pallas_groupagg: bool = False
+    # Session var pallas_groupagg ("auto" | "on" | "off"): route
+    # eligible GROUP BYs through the one-pass Pallas kernels instead
+    # of per-aggregate XLA segment reductions.
+    #   auto (default): per-plan eligibility, exact results only —
+    #     dense large-G plans whose aggregates are counts, `any`
+    #     (rep gather), or int64-limb sums/avgs over INT/DECIMAL ride
+    #     ops/pallas/groupagg_large.py (bit-identical to the XLA
+    #     path); tiny inputs (< AUTO_MIN_ROWS) stay on XLA.
+    #   on: additionally offers the small-G f32 kernel
+    #     (ops/pallas/groupagg.py; approximate float accumulation)
+    #     and admits f32 float sum/avg/min/max into the large kernel.
+    #   off: never — the escape hatch and the bench A/B lever.
+    # pallas_interpret runs the kernels in interpret mode off-TPU
+    # (the engine sets it from the backend).
+    pallas_groupagg: str = "off"
     pallas_interpret: bool = False
     # Sort+Limit fusion: XLA's variadic sort costs ~20s of compile PER
     # OPERAND beyond 64K rows (measured on v5e; a 5-operand lexsort at
@@ -490,6 +497,240 @@ def _pallas_dense_partials(slots, aggfs, b, ctx, gid, num_groups: int,
     return aggs_out
 
 
+# Large-G kernel envelope: the one-hot matmul does O(n * num_groups)
+# MACs, so cap the group domain where the MXU still wins over the
+# scatter ladder (q18's bench-scale o_orderkey span ~262K sits under
+# this; beyond it the XLA segment path remains).
+LARGE_G_MAX = 1 << 19
+# Under `auto`, inputs smaller than this stay on XLA: kernel launch +
+# padding overhead beats nothing at toy sizes, and the logic-test
+# corpus stays byte-for-byte on its established path.
+AUTO_MIN_ROWS = 4096
+# Under `auto` with interpret-mode execution (any non-TPU backend),
+# the kernel grid loops in PYTHON on every execution — a parity
+# vehicle, not a fast path. Cap the grid the auto cost model will
+# accept there: row_blocks * group_tiles steps beyond this budget
+# would turn a CPU test/oracle run into minutes (measured: a
+# 300K-row / 100K-group GROUP BY costs ~8 minutes interpreted vs
+# seconds on XLA), while the q1/q3/q18 tier-1 shapes stay well
+# under it. Explicit `on` bypasses the cap (forced opt-in), and the
+# real chip never consults it.
+AUTO_INTERPRET_STEPS = 1024
+
+
+def _large_interpret_over_budget(interpret: bool, n: int,
+                                 num_groups: int) -> bool:
+    """auto-mode cost check: would the large-G kernel's grid exceed
+    the interpret-execution step budget on this backend?"""
+    if not interpret:
+        return False
+    from ..ops.pallas import groupagg_large as pgl
+    blk = pgl.row_block(n)
+    gtiles = -(-num_groups // pgl.GROUP_TILE)
+    return gtiles * (n // blk) > AUTO_INTERPRET_STEPS
+
+
+def _pallas_large_ok(aggs, mode: str) -> bool:
+    """Static (SQL-type) envelope check for the large-G kernel
+    (ops/pallas/groupagg_large.py).
+
+    `auto` admits only aggregates whose kernel results are exact —
+    counts, `any` (representative-row gather), and int64-limb
+    sums/avgs over INT/DECIMAL args — so default routing cannot
+    perturb results. `on` additionally admits f32-accumulated float
+    sum/avg/min/max (approximate vs the XLA f64 path, same contract
+    as the small kernel)."""
+    for a in aggs:
+        if a.distinct:
+            return False  # dedup mask is an XLA-path construct
+        if a.func in ("count_rows", "count", "any"):
+            continue
+        fam = a.arg.type.family if a.arg is not None else None
+        if a.func in ("sum", "sum_int", "avg"):
+            if fam in (Family.INT, Family.DECIMAL):
+                continue
+            if mode == "on" and fam == Family.FLOAT:
+                continue
+            return False
+        if a.func in ("min", "max") and mode == "on" \
+                and fam == Family.FLOAT:
+            continue
+        return False
+    return True
+
+
+def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
+                           max_group_rows: int, axis_name,
+                           interpret: bool):
+    """Compute every aggregate's per-group (data, valid) in ONE
+    large-G kernel pass — no scatters anywhere (the round-5 join-tail
+    fix: q3/q18's ~6 input-width scatter passes become one-hot MXU
+    matmuls). Returns (aggs_out, live, overflow), or None when a
+    traced dtype falls outside the envelope (caller falls back to the
+    XLA segment path).
+
+    With axis_name set (SPMD dense plans), per-shard kernel partials
+    merge with ICI collectives: i32 limb/count rows psum EXACTLY
+    (limb_width bounds them by the GLOBAL max_group_rows, so summed
+    shard partials cannot wrap), MIN/MAX rows pmin/pmax, and `any`
+    merges each shard's rep-gathered value with a pmax over an
+    identity fill (the FD guarantees every shard that has the group
+    agrees on the value)."""
+    from ..ops.pallas import groupagg as pg
+    from ..ops.pallas import groupagg_large as pgl
+    n = b.n
+    sel = b.sel
+    argdata = {i: argf(ctx) for i, (a, argf) in enumerate(aggfs)
+               if argf is not None}
+    for i, (a, _) in enumerate(aggfs):
+        if a.func in ("sum", "sum_int", "avg") and a.arg is not None \
+                and a.arg.type.family in (Family.INT, Family.DECIMAL):
+            # the static check ran on SQL types; re-check the traced
+            # dtype (a cast upstream could hand us floats)
+            if argdata[i][0].dtype not in (jnp.int64, jnp.int32):
+                return None
+    f_cols, f_tags = [], []     # f32-accumulated matmul columns
+    i_cols, i_tags = [], []     # i32-accumulated (limb/count) columns
+    mm_cols, mm_ops_l, mm_tags = [], [], []
+    want_rep = False
+    exact = {}  # agg index -> (limb width w, limb count k)
+    for i, (a, _) in enumerate(aggfs):
+        if a.func == "count_rows":
+            i_cols.append(sel.astype(jnp.float32))
+            i_tags.append(("cnt", i))
+            continue
+        if a.func == "any":
+            want_rep = True  # rides the REPMIN slot + a host gather
+            continue
+        d0, v0 = argdata[i]
+        m = jnp.logical_and(sel, v0)
+        i_cols.append(m.astype(jnp.float32))  # validity + avg divisor
+        i_tags.append(("cnt", i))
+        if a.func == "count":
+            continue
+        if a.func in ("min", "max"):
+            ident = np.float32(np.inf if a.func == "min" else -np.inf)
+            mm_cols.append(jnp.where(m, d0.astype(jnp.float32), ident))
+            mm_ops_l.append(pg.MIN if a.func == "min" else pg.MAX)
+            mm_tags.append(("mm", i))
+            continue
+        if a.arg.type.family == Family.FLOAT:
+            f_cols.append(jnp.where(m, d0, 0).astype(jnp.float32))
+            f_tags.append(("fsum", i))
+            continue
+        # exact int64 sum as w-bit i32 limbs, split OUTSIDE the
+        # kernel (no 64-bit lanes in Mosaic) and recombined below —
+        # the same decomposition as agg._group_sum_i64_limbs
+        w = pgl.limb_width(n, max_group_rows)
+        bits = 64
+        if a.arg_nonneg and a.arg_max_abs:
+            bits = max(1, int(a.arg_max_abs).bit_length())
+        k = -(-bits // w)
+        exact[i] = (w, k)
+        d64 = d0.astype(jnp.int64)
+        dz = jnp.where(m, d64, jnp.zeros_like(d64))
+        lmask = jnp.int64((1 << w) - 1)
+        for jl in range(k):
+            limb = jax.lax.shift_right_logical(
+                dz, jnp.int64(jl * w)) & lmask
+            i_cols.append(limb.astype(jnp.int32).astype(jnp.float32))
+            i_tags.append(("limb", i, jl))
+        # f32 shadow sum feeds the overflow sentinel
+        f_cols.append(jnp.where(m, d64, 0).astype(jnp.float32))
+        f_tags.append(("shadow", i))
+    i_cols.append(sel.astype(jnp.float32))  # group liveness
+    i_tags.append(("live",))
+
+    mat = tuple(f_cols) + tuple(i_cols)
+    mat_int = (False,) * len(f_cols) + (True,) * len(i_cols)
+    acc_f, acc_i = pgl.large_group_aggregate(
+        gid, sel, mat, tuple(mm_cols), num_groups=num_groups,
+        mat_int=mat_int, mm_ops=tuple(mm_ops_l), want_rep=want_rep,
+        interpret=interpret)
+
+    def ps(x):
+        return jax.lax.psum(x, axis_name) if axis_name else x
+
+    frow = {t: r for r, t in enumerate(f_tags)}
+    irow = {t: r for r, t in enumerate(i_tags)}
+    mmrow = {t: len(f_cols) + r for r, t in enumerate(mm_tags)}
+    live = ps(acc_i[irow[("live",)], :]) > 0
+    rep = rep_live = None
+    if want_rep:
+        racc = acc_i[len(i_cols), :]  # REPMIN row (n = empty group)
+        rep_live = racc < n           # shard-LOCAL: rep ids are local
+        rep = jnp.minimum(racc, n - 1)
+
+    overflow = jnp.bool_(False)
+    aggs_out = []
+    for i, (a, _) in enumerate(aggfs):
+        if a.func in ("count_rows", "count"):
+            d = ps(acc_i[irow[("cnt", i)], :]).astype(jnp.int64)
+            aggs_out.append((d, jnp.ones_like(d, dtype=jnp.bool_)))
+            continue
+        if a.func == "any":
+            d0, v0 = argdata[i]
+            d, v = aggops.group_any_via_rep(d0, v0, rep, rep_live)
+            if axis_name:
+                # shards that saw the group agree on the value (FD);
+                # empty shards contribute the max-identity (the
+                # smallest value), so pmax picks any real one
+                d = jax.lax.pmax(
+                    jnp.where(v, d, aggops._maxident(d.dtype)),
+                    axis_name)
+                v = jax.lax.psum(v.astype(jnp.int32), axis_name) > 0
+            aggs_out.append((d, v))
+            continue
+        cnt = ps(acc_i[irow[("cnt", i)], :])
+        nonempty = cnt > 0
+        if a.func in ("min", "max"):
+            d = acc_f[mmrow[("mm", i)], :]
+            if axis_name:
+                d = (jax.lax.pmin if a.func == "min"
+                     else jax.lax.pmax)(d, axis_name)
+            aggs_out.append((d.astype(jnp.float64), nonempty))
+            continue
+        if i not in exact:  # float sum/avg (mode "on")
+            d = ps(acc_f[frow[("fsum", i)], :]).astype(jnp.float64)
+            if a.func == "avg":
+                d = d / jnp.maximum(cnt, 1).astype(jnp.float64)
+            aggs_out.append((d, nonempty))
+            continue
+        w, k = exact[i]
+        total = jnp.zeros(cnt.shape, jnp.int64)
+        for jl in range(k):
+            s = ps(acc_i[irow[("limb", i, jl)], :])
+            # wrapping IS int64 modular arithmetic — bit-identical to
+            # _group_sum_i64_limbs' recombination
+            total = total + (s.astype(jnp.int64) << jnp.int64(jl * w))
+        # overflow sentinel, same shape as the XLA path's: a cheap
+        # global bound proves most scans cannot wrap, else compare
+        # the f32 shadow. Tolerance 1e-2 (vs the f64 shadow's 1e-3)
+        # absorbs block-sequential f32 accumulation noise; a real
+        # int64 wrap is ~2^64 off, far beyond either.
+        d0, v0 = argdata[i]
+        m = jnp.logical_and(sel, v0)
+        dz64 = jnp.where(m, d0, jnp.zeros_like(d0)).astype(jnp.float64)
+        # psum makes the bound global: every shard agrees
+        cannot = ps(jnp.float64(n) * jnp.max(jnp.abs(dz64))) \
+            < jnp.float64(2 ** 62)
+        sh = ps(acc_f[frow[("shadow", i)], :]).astype(jnp.float64)
+        err = jnp.abs(total.astype(jnp.float64) - sh)
+        tol = jnp.maximum(jnp.abs(sh) * 1e-2, 1e12)
+        overflow = jnp.logical_or(
+            overflow,
+            jnp.logical_and(jnp.logical_not(cannot), jnp.any(err > tol)))
+        if a.func == "avg":
+            scale = (10.0 ** a.arg.type.scale
+                     if a.arg.type.family == Family.DECIMAL else 1.0)
+            d = total.astype(jnp.float64) / scale \
+                / jnp.maximum(cnt, 1).astype(jnp.float64)
+            aggs_out.append((d, nonempty))
+        else:
+            aggs_out.append((total, nonempty))
+    return aggs_out, live, overflow
+
+
 def _compile_window(node: P.Window, params: ExecParams) -> CompiledNode:
     """Window functions: one lexsort + cumulative scans per spec
     (ops/window.py), materialized as __win{i} columns. Not
@@ -566,6 +807,10 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
     los = list(node.group_lo) or [0] * len(dims)
     axis = params.axis_name
     if axis and node.group_by and not dense:
+        if params.pallas_groupagg != "off":
+            # hash-strategy plans are outside every kernel envelope
+            from ..ops.pallas import groupagg as _pg
+            _pg.FALLBACKS.bump("agg")
         # hash-strategy group ids are shard-local; merge via
         # all_gather of per-slot partial state + re-group (the ICI
         # form of the HashRouter shuffle, colflow/routers.go:425)
@@ -627,21 +872,54 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 d, v = gf(ctx)
                 group_cols[name] = (d[rep], v[rep])
 
+        mode = params.pallas_groupagg
         pslots = None
-        # the one-pass kernel serves dense GROUP BY and UNGROUPED
-        # aggregation alike (Q6 is the num_groups == 1 case)
-        if (params.pallas_groupagg and (dense or not groupfs)
+        large = False
+        # the one-pass small-G kernel serves dense GROUP BY and
+        # UNGROUPED aggregation alike (Q6 is the num_groups == 1
+        # case); explicit `on` only — its f32 accumulation is
+        # approximate, so `auto` never picks it
+        if (mode == "on" and (dense or not groupfs)
                 and num_groups <= 64 and b.n % 128 == 0):
             pslots = _pallas_agg_slots([a for a, _ in aggfs])
+        # the large-G kernel: dense grouped plans with an engine-known
+        # group bound and an all-exact aggregate envelope under
+        # `auto`; distributed dense plans merge the kernel partials
+        # with collectives inside _pallas_large_partials
+        if (pslots is None and mode in ("on", "auto") and dense
+                and groupfs and b.n % 128 == 0
+                and num_groups <= LARGE_G_MAX
+                and not (mode == "auto" and b.n < AUTO_MIN_ROWS)
+                and not (mode == "auto"
+                         and _large_interpret_over_budget(
+                             params.pallas_interpret, b.n, num_groups))
+                and _pallas_large_ok([a for a, _ in aggfs], mode)):
+            large = True
         overflow = jnp.bool_(False)
         rep_state = None
+        large_live = None
         if pslots is not None:
             pgid = (gid if gid is not None
                     else jnp.zeros((b.n,), dtype=jnp.int32))
             aggs_out = _pallas_dense_partials(
                 pslots, aggfs, b, ctx, pgid, num_groups, axis,
                 params.pallas_interpret)
-        else:
+        elif large:
+            res = _pallas_large_partials(
+                aggfs, b, ctx, gid, num_groups, node.max_group_rows,
+                axis, params.pallas_interpret)
+            if res is not None:
+                aggs_out, large_live, overflow = res
+            else:
+                large = False
+        if pslots is None and not large:
+            if mode != "off":
+                # an aggregation compiled on the XLA segment path
+                # while the kernels were enabled (outside both
+                # envelopes, or hash-strategy) — trace-time tally,
+                # like BUILDS (exec.pallas.kernel.fallbacks)
+                from ..ops.pallas import groupagg as _pg
+                _pg.FALLBACKS.bump("agg")
             if gid is not None and axis is None and any(
                     a.func == "any" and not a.distinct
                     for a, _ in aggfs):
@@ -661,7 +939,11 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
         if not groupfs:
             live = jnp.ones((1,), dtype=jnp.bool_)
         elif dense:
-            if rep_state is not None:
+            if large_live is not None:
+                # the kernel's always-on live column (count of
+                # selected rows per group)
+                live = large_live
+            elif rep_state is not None:
                 # the shared representative scatter already knows
                 # which groups have live rows
                 live = rep_state[1]

@@ -171,14 +171,33 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             on_change=lambda used: self.metrics.gauge(
                 "sql.mem.device.current",
                 "bytes of HBM reserved by resident tables").set(used))
-        # TPU-plane visibility: Pallas kernel builds are a trace-time
-        # module tally (ops/pallas/groupagg.py); read live at scrape
+        # TPU-plane visibility: Pallas kernel tallies are trace-time
+        # module counters (ops/pallas/groupagg.py); read live at
+        # scrape. All of them count at TRACE time — executions run
+        # inside jitted programs and are not host-countable.
         from ..ops.pallas import groupagg as _ga
         self.metrics.func_counter(
             "exec.pallas.kernel.builds",
-            lambda: _ga.KERNEL_BUILDS,
-            "Pallas group-aggregate kernel traces/builds (executions "
-            "run inside jitted programs and are not host-countable)")
+            lambda: _ga.BUILDS.value(),
+            "Pallas group-aggregate kernel traces/builds, all kernels")
+        self.metrics.func_counter(
+            "exec.pallas.kernel.builds.small",
+            lambda: _ga.BUILDS.value("small"),
+            "small-G (unrolled f32) group-aggregate kernel builds")
+        self.metrics.func_counter(
+            "exec.pallas.kernel.builds.large",
+            lambda: _ga.BUILDS.value("large"),
+            "large-G (one-hot matmul) group-aggregate kernel builds")
+        self.metrics.func_counter(
+            "exec.pallas.kernel.fallbacks",
+            lambda: _ga.FALLBACKS.value(),
+            "aggregations compiled on the XLA segment path while "
+            "pallas_groupagg was enabled (outside a kernel envelope)")
+        self.metrics.func_counter(
+            "exec.pallas.rows",
+            lambda: _ga.ROWS.value(),
+            "rows offered to Pallas group-aggregate kernels at trace "
+            "time (per-build input height, not per-execution)")
         # /debug/tracez ring buffer: recordings of statements slower
         # than sql.trace.slow_statement.threshold (0 disables)
         from collections import deque as _deque
@@ -1428,7 +1447,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             shapes.append((tname, b.n, dictlens))
 
         cap = int(session.vars.get("hash_group_capacity", 1 << 17))
-        pallas = session.vars.get("pallas_groupagg", "off") == "on"
+        # auto | on | off; legacy bool spellings normalize (True was
+        # the old opt-in), anything unrecognized means off
+        pallas = session.vars.get("pallas_groupagg", "auto")
+        if isinstance(pallas, bool):
+            pallas = "on" if pallas else "off"
+        pallas = str(pallas).lower()
+        if pallas not in ("auto", "on", "off"):
+            pallas = "off"
         # keyed by shape (padded row-count bucket) + dictionary sizes,
         # NOT data generation: the compiled XLA program depends only on
         # shapes and on literal dictionary codes (append-only, so any

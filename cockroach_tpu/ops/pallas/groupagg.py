@@ -32,10 +32,12 @@ kernels: colexecagg's sum/min/max/count x ordered/hash .eg.go files.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import enable_x64 as _enable_x64
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -83,7 +85,10 @@ def _kernel(gid_ref, sel_ref, *refs, acc_ref, cnt_ref, num_groups: int,
                 part = jnp.sum(m.astype(jnp.float32))
                 cnt_ref[g, a] += part.astype(jnp.int32)
             elif op == SUM:
-                acc_ref[g, a] += jnp.sum(jnp.where(m, v, 0.0))
+                # explicit f32 zero: a weak Python-float literal here
+                # round-trips through the interpret-mode lowering as
+                # f64 when the enclosing program traces under x64
+                acc_ref[g, a] += jnp.sum(jnp.where(m, v, _INIT[SUM]))
             elif op == MIN:
                 part = jnp.min(jnp.where(m, v, np.float32(np.inf)))
                 acc_ref[g, a] = jnp.minimum(acc_ref[g, a], part)
@@ -92,9 +97,38 @@ def _kernel(gid_ref, sel_ref, *refs, acc_ref, cnt_ref, num_groups: int,
                 acc_ref[g, a] = jnp.maximum(acc_ref[g, a], part)
 
 
-# Pallas kernel trace/build tally (see the note inside
+class _KernelTally:
+    """Thread-safe per-kernel counter.
+
+    The trace-time tallies are bumped inside jit-traced bodies; since
+    the pipelined data plane, per-mesh dispatcher threads and
+    concurrent pgwire sessions can trace simultaneously, so a bare
+    ``global x; x += 1`` read-modify-write races. One lock per tally,
+    keyed by kernel kind (``small`` / ``large`` / ...) so the engine
+    can expose both per-kind and total func-metrics.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def bump(self, kind: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + delta
+
+    def value(self, kind: str | None = None) -> int:
+        with self._lock:
+            if kind is None:
+                return sum(self._counts.values())
+            return self._counts.get(kind, 0)
+
+
+# Pallas kernel trace/build tallies (see the note inside
 # dense_group_aggregate); read via engine func-metrics.
-KERNEL_BUILDS = 0
+BUILDS = _KernelTally()      # kernel (re)builds, per kernel kind
+ROWS = _KernelTally()        # rows offered to a kernel at trace time
+FALLBACKS = _KernelTally()   # aggregations that wanted a kernel but
+                             # compiled on the XLA segment path
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "ops",
@@ -118,14 +152,18 @@ def dense_group_aggregate(gid, sel, values: tuple, masks: tuple,
     # honest metric for a jitted kernel (executions happen inside XLA
     # where host counters can't see them). exec.pallas.* func-metrics
     # in the engine read it.
-    global KERNEL_BUILDS
-    KERNEL_BUILDS += 1
     n = gid.shape[0]
+    BUILDS.bump("small")
+    ROWS.bump("small", n)
     assert n % LANES == 0, "row count must be a multiple of 128"
     rows = n // LANES
-    blk = min(block_rows // LANES, rows)
-    while rows % blk:  # largest divisor <= blk (rows is a power of two
-        blk -= 1       # in the engine, so this rarely iterates)
+    # largest power-of-two divisor of rows (rows & -rows), capped by
+    # the block budget: any pow2 <= that divisor also divides rows, so
+    # this replaces the old O(rows) linear search. The engine pads
+    # tables to a power of two, but compaction can hand us
+    # pow2-page-multiples (2^k * odd), which this handles too.
+    blk = min(block_rows // LANES, rows & -rows)
+    assert blk >= 1 and rows % blk == 0
     n_vals = len(values)
     grid = (rows // blk,)
     # the second index-map coordinate must be i32: under the engine's
@@ -147,8 +185,10 @@ def dense_group_aggregate(gid, sel, values: tuple, masks: tuple,
     GA = (num_groups, len(ops))
     # the engine runs with jax_enable_x64; Mosaic requires i32 index
     # maps and block indices, so trace the kernel in an x64-off scope
-    # (all operands already carry explicit 32-bit dtypes)
-    with jax.enable_x64(False):
+    # (all operands already carry explicit 32-bit dtypes). NB
+    # jax.enable_x64 was removed in 0.4.x; the experimental context
+    # manager takes the same bool.
+    with _enable_x64(False):
         acc, cnt = pl.pallas_call(
             kernel,
             out_shape=(jax.ShapeDtypeStruct(GA, jnp.float32),

@@ -131,9 +131,12 @@ class SessionVars:
         "streaming_pipeline": "on",
         "direct_columnar_scans_enabled": True,
         "hash_group_capacity": 1 << 17,
-        # opt-in one-pass Pallas kernel for dense float GROUP BY
-        # (f32 accumulation: approximate vs the XLA path's f64)
-        "pallas_groupagg": "off",    # on | off
+        # one-pass Pallas GROUP BY kernels. auto (default): per-plan
+        # eligibility, exact-result envelope only (large-G limb-sum
+        # kernel); on: also the small-G f32 kernel + float aggs
+        # (approximate vs the XLA path's f64); off: escape hatch /
+        # bench A/B lever
+        "pallas_groupagg": "auto",   # auto | on | off
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,

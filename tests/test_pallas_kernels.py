@@ -83,12 +83,18 @@ class TestEnginePallasGroupBy:
         from cockroach_tpu.exec import compile as C
         from cockroach_tpu.exec.engine import Engine
         calls = []
+        large_calls = []
         orig = C._pallas_dense_partials
         monkeypatch.setattr(
             C, "_pallas_dense_partials",
             lambda *a, **k: (calls.append(1), orig(*a, **k))[1])
+        orig_l = C._pallas_large_partials
+        monkeypatch.setattr(
+            C, "_pallas_large_partials",
+            lambda *a, **k: (large_calls.append(1), orig_l(*a, **k))[1])
         e = Engine()
         e._pallas_calls = calls  # test-only visibility
+        e._pallas_large_calls = large_calls
         e.execute("CREATE TABLE px (g STRING, f FLOAT, d DECIMAL(10,2))")
         rng = np.random.default_rng(3)
         rows = ", ".join(
@@ -106,7 +112,9 @@ class TestEnginePallasGroupBy:
     def test_matches_xla_path(self, eng):
         s = eng.session()
         want = eng.execute(self.SQL, session=s).rows
-        assert not eng._pallas_calls  # default off
+        # default auto: float aggs are outside the exact envelope and
+        # the table is tiny, so no kernel routed
+        assert not eng._pallas_calls and not eng._pallas_large_calls
         s.vars.set("pallas_groupagg", "on")
         got = eng.execute(self.SQL, session=s).rows
         assert eng._pallas_calls, "kernel gate never fired"
@@ -116,15 +124,18 @@ class TestEnginePallasGroupBy:
             for a, b in zip(rw[2:], rg[2:]):
                 assert float(a) == pytest.approx(float(b), rel=1e-4)
 
-    def test_decimal_stays_on_xla_path(self, eng):
-        # DECIMAL sums are outside the kernel envelope: the gate must
-        # fall back to the exact XLA path, not approximate
+    def test_decimal_rides_large_kernel_exactly(self, eng):
+        # DECIMAL sums are outside the SMALL kernel's f32 envelope but
+        # inside the large kernel's int64-limb one: under `on` the
+        # gate must route them there and the results must stay EXACT
+        # (bit-identical int64 fixed-point sums, not f32 approximate)
         s = eng.session()
         sql = "SELECT g, sum(d) AS s FROM px GROUP BY g ORDER BY g"
         want = eng.execute(sql, session=s).rows
         s.vars.set("pallas_groupagg", "on")
         got = eng.execute(sql, session=s).rows
-        assert not eng._pallas_calls  # ineligible: never routed
+        assert not eng._pallas_calls  # small kernel ineligible
+        assert eng._pallas_large_calls, "large kernel never routed"
         assert got == want  # exact equality: same int64 fixed-point sums
 
 

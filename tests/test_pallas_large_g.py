@@ -1,0 +1,340 @@
+"""Large-G Pallas grouped-aggregation tests (interpret mode on CPU).
+
+Three layers:
+
+1. kernel-level fuzzed parity of ``large_group_aggregate`` against a
+   numpy oracle — exact for counts and recombined int64 limb sums
+   (negative values / high limbs included), identity-filled for empty
+   groups, tolerance-checked for f32 sums;
+2. unit tests for the helpers (``row_block``, ``limb_width``) and the
+   thread-safe ``_KernelTally``;
+3. engine-level eligibility + parity: q18's inner GROUP BY rides the
+   large kernel under the default ``auto`` mode, a sparse packed
+   composite key does NOT (hash strategy -> fallback tally), the
+   ``auto`` arm is bit-exact vs ``off``, and the compiled HLO of the
+   auto arm carries no aggregation scatters.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.ops.pallas import groupagg as pg
+from cockroach_tpu.ops.pallas.groupagg import MAX, MIN, _KernelTally
+from cockroach_tpu.ops.pallas.groupagg_large import (
+    BLOCK_ROWS, GROUP_TILE, large_group_aggregate, limb_width, row_block)
+
+
+# ---------------------------------------------------------------- helpers
+
+def _limb_cols(vals: np.ndarray, mask: np.ndarray, w: int):
+    """Split int64 values into w-bit unsigned limbs (logical shifts,
+    exactly the compile.py column build) and pre-mask them to 0 —
+    the kernel contract folds sel/mask into the matmul columns."""
+    k = -(-64 // w)
+    u = vals.view(np.uint64)
+    cols = []
+    for j in range(k):
+        limb = (u >> np.uint64(j * w)) & np.uint64((1 << w) - 1)
+        cols.append(np.where(mask, limb, 0).astype(np.float32))
+    return cols
+
+
+def _recombine(acc_rows: np.ndarray, w: int) -> np.ndarray:
+    """sum_j limbs[j] << (j*w) in mod-2^64 arithmetic (int64 wrap),
+    matching both the XLA `_group_sum_i64_limbs` path and the engine's
+    kernel-partial reconstruction."""
+    total = np.zeros(acc_rows.shape[1], np.uint64)
+    for j in range(acc_rows.shape[0]):
+        total += acc_rows[j].astype(np.uint64) << np.uint64(j * w)
+    return total.view(np.int64)
+
+
+def _oracle(gid, sel, vals, mask, num_groups):
+    """Per-group exact sums/counts/min/max/rep with numpy."""
+    eff = sel & mask
+    sums = np.zeros(num_groups, np.int64)
+    cnts = np.zeros(num_groups, np.int64)
+    mins = np.full(num_groups, np.inf, np.float32)
+    maxs = np.full(num_groups, -np.inf, np.float32)
+    reps = np.full(num_groups, len(gid), np.int64)
+    for g in range(num_groups):
+        gm = eff & (gid == g)
+        cnts[g] = gm.sum()
+        if gm.any():
+            sums[g] = vals[gm].sum(dtype=np.int64)
+            f = vals[gm].astype(np.float32)
+            mins[g], maxs[g] = f.min(), f.max()
+        sm = sel & (gid == g)
+        if sm.any():
+            reps[g] = np.flatnonzero(sm)[0]
+    return sums, cnts, mins, maxs, reps
+
+
+# ---------------------------------------------------------------- helpers'
+# own unit tests
+
+class TestRowBlock:
+    def test_pow2_capped(self):
+        assert row_block(1 << 16) == BLOCK_ROWS
+        assert row_block(4096, block_rows=512) == 512
+
+    def test_odd_multiple_of_128(self):
+        # 384 = 128 * 3: largest pow2 divisor is 128
+        assert row_block(384) == 128
+        assert row_block(2048 * 3) == 1024  # capped before the odd part
+
+    def test_rejects_unaligned(self):
+        with pytest.raises(AssertionError):
+            row_block(100)
+
+
+class TestLimbWidth:
+    @pytest.mark.parametrize("n,maxg,blk", [
+        (4096, 1, 1024), (4096, 4096, 1024), (1 << 16, 1 << 16, 1024),
+        (128, 128, 128), (1 << 20, 1000, 1024), (8192, 0, 256),
+    ])
+    def test_both_exactness_bounds(self, n, maxg, blk):
+        w = limb_width(n, maxg, block_rows=blk)
+        assert 1 <= w <= 22
+        eff_blk = row_block(n, blk)
+        eff_maxg = maxg if 0 < maxg <= n else n
+        # f32 matmul block partial stays in f32's exact-integer range
+        assert eff_blk * (2 ** w - 1) < 2 ** 24
+        # i32 per-group running sum cannot wrap
+        assert eff_maxg * (2 ** w - 1) < 2 ** 31
+
+    def test_known_value(self):
+        # blk=1024 -> w capped at 24-10=14 regardless of tiny maxg
+        assert limb_width(4096, 1, block_rows=1024) == 14
+
+
+class TestKernelTally:
+    def test_per_kind_and_total(self):
+        t = _KernelTally()
+        t.bump("a")
+        t.bump("b", 5)
+        assert t.value("a") == 1 and t.value("b") == 5
+        assert t.value() == 6 and t.value("missing") == 0
+
+    def test_thread_safety(self):
+        t = _KernelTally()
+        n_threads, per = 8, 10_000
+
+        def work(k):
+            for _ in range(per):
+                t.bump(k)
+
+        ts = [threading.Thread(target=work, args=("small" if i % 2 else
+                                                  "large",))
+              for i in range(n_threads)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        assert t.value() == n_threads * per
+        assert t.value("small") + t.value("large") == n_threads * per
+
+
+# ---------------------------------------------------------------- kernel
+# fuzzed parity vs numpy
+
+CASES = [
+    # (n, num_groups, sel_frac, mask_frac, seed) — G at/above/below the
+    # (test-sized) tile boundary, empty groups via sparse occupancy
+    (1024, 96, 0.8, 0.9, 0),
+    (1024, 128, 0.9, 0.8, 1),    # G exactly at the tile boundary
+    (2048, 129, 0.7, 0.95, 2),   # G one past a tile -> ragged last tile
+    (4096, 700, 0.85, 0.9, 3),   # multi-tile, many empty groups
+    (384, 40, 1.0, 1.0, 4),      # odd 128-multiple row count
+]
+
+
+class TestLargeKernelParity:
+    @pytest.mark.parametrize("n,G,sf,mf,seed", CASES)
+    def test_int64_limb_sums_exact(self, n, G, sf, mf, seed):
+        rng = np.random.default_rng(seed)
+        gid = rng.integers(0, G, size=n).astype(np.int32)
+        sel = rng.random(n) < sf
+        mask = rng.random(n) < mf
+        # negative values with populated high limbs: |v| up to 2^40
+        vals = rng.integers(-(1 << 40), 1 << 40, size=n, dtype=np.int64)
+        eff = sel & mask
+        w = limb_width(n, max_group_rows=n, block_rows=256)
+        limbs = _limb_cols(vals, eff, w)
+        cnt_col = eff.astype(np.float32)
+        mm = np.where(eff, vals, np.inf).astype(np.float32)
+        mx = np.where(eff, vals, -np.inf).astype(np.float32)
+        fshadow = np.where(eff, vals, 0).astype(np.float32)
+        mat = (fshadow, *limbs, cnt_col)
+        mat_int = (False,) + (True,) * (len(limbs) + 1)
+        acc_f, acc_i = large_group_aggregate(
+            gid, sel, mat, (mm, mx), G, mat_int, mm_ops=(MIN, MAX),
+            want_rep=True, group_tile=128, block_rows=256,
+            interpret=True)
+        acc_f, acc_i = np.asarray(acc_f), np.asarray(acc_i)
+        sums, cnts, mins, maxs, reps = _oracle(gid, sel, vals, mask, G)
+        got_sums = _recombine(acc_i[:len(limbs)], w)
+        np.testing.assert_array_equal(got_sums, sums)  # bit-exact
+        np.testing.assert_array_equal(acc_i[len(limbs)], cnts)
+        # MIN/MAX: identity fill survives for empty groups
+        np.testing.assert_array_equal(acc_f[1], mins)
+        np.testing.assert_array_equal(acc_f[2], maxs)
+        # f32 shadow within block-accumulation tolerance
+        tol = np.maximum(np.abs(sums).astype(np.float64) * 1e-2, 1e6)
+        assert np.all(np.abs(acc_f[0].astype(np.float64) - sums) <= tol)
+        # rep: min selected row id per group, n when none
+        want_rep = np.full(G, n, np.int64)
+        for g in range(G):
+            sm = sel & (gid == g)
+            if sm.any():
+                want_rep[g] = np.flatnonzero(sm)[0]
+        np.testing.assert_array_equal(acc_i[len(limbs) + 1], want_rep)
+
+    def test_all_rows_masked(self):
+        # empty state: every accumulator keeps its identity
+        n, G = 1024, 200
+        rng = np.random.default_rng(9)
+        gid = rng.integers(0, G, size=n).astype(np.int32)
+        sel = np.zeros(n, bool)
+        zero = np.zeros(n, np.float32)
+        inf = np.full(n, np.inf, np.float32)
+        acc_f, acc_i = large_group_aggregate(
+            gid, sel, (zero, zero), (inf, -inf), G,
+            (False, True), mm_ops=(MIN, MAX), want_rep=True,
+            group_tile=128, block_rows=256, interpret=True)
+        acc_f, acc_i = np.asarray(acc_f), np.asarray(acc_i)
+        assert np.all(acc_f[0] == 0.0)
+        assert np.all(acc_f[1] == np.inf) and np.all(acc_f[2] == -np.inf)
+        assert np.all(acc_i[0] == 0) and np.all(acc_i[1] == n)
+
+    def test_counts_for_giant_group(self):
+        # one group takes every row: the i32 count path at its densest
+        n = 4096
+        gid = np.zeros(n, np.int32)
+        sel = np.ones(n, bool)
+        cnt = np.ones(n, np.float32)
+        _, acc_i = large_group_aggregate(
+            gid, sel, (cnt,), (), 1, (True,), group_tile=128,
+            block_rows=512, interpret=True)
+        assert int(np.asarray(acc_i)[0, 0]) == n
+
+    def test_default_tile_constants_sane(self):
+        assert GROUP_TILE % 128 == 0 and BLOCK_ROWS % 128 == 0
+
+
+# ---------------------------------------------------------------- engine
+# eligibility + parity
+
+SF = 0.005
+N_ROWS = 8192
+
+
+@pytest.fixture(scope="module")
+def teng():
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    e = Engine()
+    tpch.load(e, SF, rows=N_ROWS,
+              tables=("lineitem", "orders", "customer"))
+    return e
+
+
+def _local_session(eng):
+    s = eng.session()
+    s.vars.set("distsql", "off")
+    return s
+
+
+PARITY_SQL = ("SELECT l_orderkey, count(*) AS c, sum(l_quantity) AS q "
+              "FROM lineitem GROUP BY l_orderkey")
+
+
+class TestEngineEligibility:
+    def test_q18_selects_large_kernel(self, teng):
+        from cockroach_tpu.models import tpch
+        s = _local_session(teng)
+        before = pg.BUILDS.value("large")
+        res = teng.execute(tpch.Q18_TEMPLATE.format(threshold=50),
+                           session=s)
+        assert pg.BUILDS.value("large") > before, \
+            "q18's inner GROUP BY l_orderkey did not ride the kernel"
+        # sanity vs the host-side reference implementation
+        want = tpch.ref_q18(tpch.gen_lineitem(SF, rows=N_ROWS),
+                            tpch.gen_orders(SF), tpch.gen_customer(SF),
+                            threshold=50)
+        assert len(res.rows) == len(want)
+
+    def test_sparse_composite_stays_on_xla(self, teng):
+        # packed composite keys (two wide-span INTs) force the hash
+        # strategy: outside every kernel envelope -> fallback tally
+        s = _local_session(teng)
+        teng.execute("CREATE TABLE spk (a INT, b INT, v FLOAT)")
+        rng = np.random.default_rng(11)
+        rows = ", ".join(
+            f"({int(a)}, {int(b)}, {float(v):.4f})"
+            for a, b, v in zip(rng.integers(0, 10 ** 9, 300),
+                               rng.integers(0, 10 ** 9, 300),
+                               rng.random(300)))
+        teng.execute(f"INSERT INTO spk VALUES {rows}")
+        b_large = pg.BUILDS.value("large")
+        fb = pg.FALLBACKS.value()
+        teng.execute("SELECT a, b, count(*) FROM spk GROUP BY a, b",
+                     session=s)
+        assert pg.BUILDS.value("large") == b_large, \
+            "sparse composite key must not route to the kernel"
+        assert pg.FALLBACKS.value() > fb, \
+            "XLA-path aggregation under auto must tally a fallback"
+
+    def test_auto_matches_off_exactly(self, teng):
+        s = _local_session(teng)
+        s.vars.set("pallas_groupagg", "off")
+        want = sorted(teng.execute(PARITY_SQL, session=s).rows)
+        s.vars.set("pallas_groupagg", "auto")
+        got = sorted(teng.execute(PARITY_SQL, session=s).rows)
+        # counts and DECIMAL sums are exact in both arms -> identical
+        assert got == want
+
+    def test_auto_interpret_step_budget(self):
+        # the cost guard that keeps CPU (interpret-mode) runs off
+        # giant grids: a 300K-row/100K-group shape must NOT route
+        # under auto off-TPU (it costs minutes interpreted), while
+        # the tier-1 q3/q18 shapes and any on-chip shape pass
+        from cockroach_tpu.exec import compile as C
+        assert C._large_interpret_over_budget(True, 1 << 19, 100_000)
+        assert not C._large_interpret_over_budget(True, 8192, 15_000)
+        assert not C._large_interpret_over_budget(True, 4096, 15_000)
+        assert not C._large_interpret_over_budget(False, 1 << 19,
+                                                  100_000)
+
+    def test_metrics_exported(self, teng):
+        snap = teng.metrics.snapshot()
+        for want in ("exec.pallas.kernel.builds",
+                     "exec.pallas.kernel.builds.small",
+                     "exec.pallas.kernel.builds.large",
+                     "exec.pallas.kernel.fallbacks",
+                     "exec.pallas.rows"):
+            assert want in snap
+
+
+class TestNoScatterHLO:
+    """The acceptance bar: under auto the compiled program for an
+    eligible GROUP BY contains no input-width aggregation scatters;
+    the off arm (XLA segment path) does."""
+
+    def _lowered_text(self, eng, mode):
+        s = _local_session(eng)
+        s.vars.set("pallas_groupagg", mode)
+        p = eng.prepare(PARITY_SQL, session=s)
+        tsv = np.int64(eng._read_ts(s).to_int())
+        return p.jfn.lower(p.scans, tsv, np.int32(1),
+                           np.int32(0)).as_text()
+
+    def test_off_arm_scatters_auto_arm_does_not(self, teng):
+        off = self._lowered_text(teng, "off")
+        auto = self._lowered_text(teng, "auto")
+        assert "scatter" in off, \
+            "oracle arm: the XLA segment path should lower scatters"
+        assert "scatter" not in auto, \
+            "auto arm still lowers aggregation scatters"
