@@ -372,6 +372,66 @@ def run_pallas_ab(rows, repeats):
     return out
 
 
+def run_sort_ab(rows, repeats):
+    """Normalized-sort-key A/B (round 7 tentpole): an ORDER BY-heavy
+    query (3 keys incl. DESC, LIMIT past TOPK_MAX so the full sort
+    runs but only the head materializes) and a window query (partition
+    + 2-key order) with `SET sort_normalized` auto vs off. The auto
+    arm packs the whole key list into uint64 lanes and runs one stable
+    2-operand sort per lane; the off arm restores the variadic lexsort
+    (2K+1 operands, ~20s XLA compile per operand past 64K rows on the
+    real chip). Warmup (compile) seconds are recorded per arm — the
+    compile-wall delta is the headline off-CPU; on CPU the runtime
+    ratio mostly proves the plumbing."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.ops import sortkey as _sk
+
+    eng = Engine()
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem",), encoded=True)
+    print(f"# sort datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    qs = {
+        "order3": ("SELECT l_orderkey, l_quantity FROM lineitem "
+                   "ORDER BY l_returnflag DESC, l_linestatus, "
+                   "l_quantity DESC LIMIT 2048"),
+        "window": ("SELECT l_orderkey, row_number() OVER "
+                   "(PARTITION BY l_returnflag ORDER BY "
+                   "l_quantity DESC, l_orderkey) AS rn "
+                   "FROM lineitem ORDER BY rn LIMIT 2048"),
+    }
+    out = {}
+    for which, sql in qs.items():
+        for arm in ("auto", "off"):
+            s = eng.session()
+            s.vars.set("sort_normalized", arm)
+            n0, f0 = _sk.NORMALIZED.value(), _sk.FALLBACKS.value()
+            t0 = time.time()
+            eng.execute(sql, s)  # warmup: compile
+            warm = time.time() - t0
+            per = []
+            for _ in range(repeats):
+                t0 = time.time()
+                eng.execute(sql, s)
+                per.append(rows / (time.time() - t0))
+            rps = statistics.median(per)
+            out[f"sort_{which}_{arm}_rows_per_sec"] = round(rps)
+            out[f"sort_{which}_{arm}_compile_s"] = round(warm, 2)
+            print(f"# sort {which} arm={arm} rows_per_sec={rps:.3e} "
+                  f"compile_s={warm:.2f} "
+                  f"normalized={_sk.NORMALIZED.value() - n0} "
+                  f"fallbacks={_sk.FALLBACKS.value() - f0}",
+                  file=sys.stderr)
+        auto = out[f"sort_{which}_auto_rows_per_sec"]
+        off = out[f"sort_{which}_off_rows_per_sec"]
+        out[f"sort_{which}_speedup"] = \
+            round(auto / off, 3) if off else 0
+    out["sort_rows"] = rows
+    return out
+
+
 def run_dispatchq(rows, workers=2, iters=6):
     """Concurrent distributed dispatch (PR 3 tentpole): N sessions
     issue distributed GROUP BYs at once through the per-mesh FIFO
@@ -562,6 +622,15 @@ def main():
             **per,
         }))
         return
+    if mode == "sort_child":
+        per = run_sort_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "sort_order3_auto_rows_per_sec",
+            "value": per.get("sort_order3_auto_rows_per_sec", 0),
+            "unit": "rows/s", "rows": per.get("sort_rows", rows),
+            **per,
+        }))
+        return
     if mode == "dispatchq_child":
         serial, conc = run_dispatchq(rows)
         print(json.dumps({
@@ -688,6 +757,14 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("pallas_")})
             out.setdefault("pallas_rows", r["rows"])
+    # round 7 tentpole A/B: normalized sort keys (auto, one 2-operand
+    # sort per uint64 lane) vs the variadic lexsort (off)
+    if os.environ.get("BENCH_SORT", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_SORT_ROWS", 1 << 18)),
+                      "sort", child_timeout, mode="sort_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("sort_")})
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),

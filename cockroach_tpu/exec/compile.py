@@ -27,6 +27,7 @@ import numpy as np
 
 from ..ops import agg as aggops
 from ..ops import hashtable
+from ..ops import sortkey
 from ..ops.batch import ColumnBatch
 from ..ops.join import hash_join
 from ..sql import plan as P
@@ -74,6 +75,15 @@ class ExecParams:
     # exactness flag and a host fallback to the full sort when primary-
     # key ties cross the candidate cut (__topk_inexact sentinel).
     topk_sort: bool = True
+    # Session var sort_normalized ("auto" | "on" | "off"): encode the
+    # whole sort-key list into packed uint64 lanes (ops/sortkey.py)
+    # and sort with ONE stable single-key argsort per lane, instead of
+    # the 2K+1-operand variadic lexsort whose compile cost grows ~20s
+    # per operand beyond 64K rows. auto/on use the normalized plane
+    # whenever every key is encodable (ints/floats/bools/dict strings
+    # — in practice everything on device) and fall back to lexsort
+    # otherwise, tallied; off is the escape hatch / bench A/B lever.
+    sort_normalized: str = "auto"
 
 
 class RunContext:
@@ -150,7 +160,8 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
             return hash_join(lb, rb, jn.left_keys, jn.right_keys,
                              jn.payload, jn.join_type,
                              expand=jn.expand, direct=jn.direct,
-                             pack_payload=jn.pack_payload)
+                             pack_payload=jn.pack_payload,
+                             sort_normalized=params.sort_normalized)
         return run_join
     if isinstance(node, P.Compact):
         childf = compile_plan(node.child, params)
@@ -287,7 +298,8 @@ def _agg_output(group_cols, aggs_out, live, itemfs, havingf,
     return out
 
 def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
-                  axis_name=None, max_group_rows=0, rep_state=None):
+                  axis_name=None, max_group_rows=0, rep_state=None,
+                  sort_mode="off"):
     """Compute one aggregate's per-group arrays: (data, valid).
 
     With axis_name set, partials merge across mesh shards with the
@@ -329,7 +341,8 @@ def _agg_partials(a: BoundAgg, argf, batch, ctx, gid, num_groups,
             else jnp.zeros(d0.shape, dtype=jnp.int32)
         mask = jnp.logical_and(
             mask, aggops.distinct_first_mask(
-                d0, mask, gid_d, num_groups if gid is not None else 1))
+                d0, mask, gid_d, num_groups if gid is not None else 1,
+                sort_mode))
     if a.func == "count":
         if grouped:
             d = aggops.group_count(gid, mask, num_groups)
@@ -758,7 +771,7 @@ def _compile_window(node: P.Window, params: ExecParams) -> CompiledNode:
                 od, ov = of(ctx)
                 orders.append((od, ov, desc))
             order, seg_start, peer_start, sel_s = W.order_and_segments(
-                parts, orders, b.sel)
+                parts, orders, b.sel, params.sort_normalized)
             framed = bool(orders)
             if w.func == "row_number":
                 d, v = W.row_number(order, seg_start, sel_s)
@@ -930,7 +943,8 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 d, v, ovf = _agg_partials(a, argf, b, ctx, gid,
                                           num_groups, axis,
                                           node.max_group_rows,
-                                          rep_state)
+                                          rep_state,
+                                          params.sort_normalized)
                 aggs_out.append((d, v))
                 if ovf is not None:
                     overflow = jnp.logical_or(overflow, ovf)
@@ -975,6 +989,26 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
 # sort
 # ---------------------------------------------------------------------------
 
+def _dict_rank(d) -> np.ndarray:
+    """code -> sort rank for one string dictionary, cached on the
+    dictionary object keyed by its (append-only) length: the
+    object-dtype np.argsort is O(size log size) Python-level string
+    compares and used to rerun on EVERY compile of every sorted
+    string column."""
+    cached = getattr(d, "_sort_rank_cache", None)
+    if cached is not None and cached[0] == len(d.values):
+        return cached[1]
+    order = np.argsort(np.asarray(d.values, dtype=object).astype(str),
+                       kind="stable")
+    rank = np.empty(len(order), dtype=np.int32)
+    rank[order] = np.arange(len(order), dtype=np.int32)
+    try:
+        d._sort_rank_cache = (len(d.values), rank)
+    except AttributeError:
+        pass  # slotted/foreign dictionary objects just recompute
+    return rank
+
+
 def _sort_rank_tables(keys, meta: P.OutputMeta | None) -> dict:
     """String sort keys order by dictionary rank, not code."""
     rank_tables = {}
@@ -983,38 +1017,68 @@ def _sort_rank_tables(keys, meta: P.OutputMeta | None) -> dict:
             name = key[0]
             d = meta.dictionaries.get(name)
             if d is not None:
-                order = np.argsort(np.asarray(d.values, dtype=object).astype(str),
-                                   kind="stable")
-                rank = np.empty(len(order), dtype=np.int32)
-                rank[order] = np.arange(len(order), dtype=np.int32)
-                rank_tables[name] = rank
+                rank_tables[name] = _dict_rank(d)
     return rank_tables
 
 
-def sort_batch(b: ColumnBatch, keys, rank_tables: dict) -> ColumnBatch:
-    sort_keys = []  # lexsort: LAST key is primary
-    for key in reversed(keys):
+def _key_specs(b: ColumnBatch, keys, rank_tables: dict):
+    """sort_batch's key list as ops/sortkey encode specs (pg default:
+    NULLS LAST for asc, NULLS FIRST for desc; explicit override)."""
+    specs = []
+    for key in keys:
         name, desc = key[0], key[1]
         nf = key[2] if len(key) > 2 else None
-        d = b.col(name)
-        v = b.col_valid(name)
-        if name in rank_tables:
-            lut = jnp.asarray(rank_tables[name])
-            d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
-        if d.dtype == jnp.bool_:
-            d = d.astype(jnp.int32)
-        if desc:
-            d = -d.astype(jnp.float64) if jnp.issubdtype(
-                d.dtype, jnp.floating) else -d.astype(jnp.int64)
-        # pg default: NULLS LAST for asc, NULLS FIRST for desc;
-        # explicit NULLS FIRST/LAST overrides
         null_first = nf if nf is not None else desc
-        nullkey = v if null_first else jnp.logical_not(v)
-        sort_keys.append(d)
-        sort_keys.append(nullkey.astype(jnp.int8))
-    # dead rows always last
-    sort_keys.append(jnp.logical_not(b.sel).astype(jnp.int8))
-    perm = jnp.lexsort(tuple(sort_keys))
+        specs.append((b.col(name), b.col_valid(name), desc, null_first,
+                      rank_tables.get(name), None))
+    return specs
+
+
+def _normalized_lanes(b: ColumnBatch, keys, rank_tables: dict,
+                      kind: str):
+    """Packed sort-key lanes for the batch, or None (-> lexsort) when
+    some key dtype is unencodable. Tallies the fallback."""
+    fields = sortkey.encode_keys(_key_specs(b, keys, rank_tables))
+    if fields is None:
+        sortkey.FALLBACKS.bump(kind)
+        return None
+    return sortkey.mask_dead(sortkey.pack_lanes(fields, b.n), b.sel)
+
+
+def sort_batch(b: ColumnBatch, keys, rank_tables: dict,
+               mode: str = "off") -> ColumnBatch:
+    perm = None
+    if mode in ("auto", "on") and keys:
+        lanes = _normalized_lanes(b, keys, rank_tables, "sort")
+        if lanes is not None:
+            perm = sortkey.sort_perm(lanes, kind="sort")
+    if perm is None:
+        sort_keys = []  # lexsort: LAST key is primary
+        for key in reversed(keys):
+            name, desc = key[0], key[1]
+            nf = key[2] if len(key) > 2 else None
+            d = b.col(name)
+            v = b.col_valid(name)
+            if name in rank_tables:
+                lut = jnp.asarray(rank_tables[name])
+                d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+            if desc:
+                # ints reverse via bitwise NOT: arithmetic negation
+                # wraps at INT64_MIN (maps to itself, breaking DESC
+                # at the extreme)
+                d = -d.astype(jnp.float64) if jnp.issubdtype(
+                    d.dtype, jnp.floating) else ~d.astype(jnp.int64)
+            # pg default: NULLS LAST for asc, NULLS FIRST for desc;
+            # explicit NULLS FIRST/LAST overrides
+            null_first = nf if nf is not None else desc
+            nullkey = v if null_first else jnp.logical_not(v)
+            sort_keys.append(d)
+            sort_keys.append(nullkey.astype(jnp.int8))
+        # dead rows always last
+        sort_keys.append(jnp.logical_not(b.sel).astype(jnp.int8))
+        perm = jnp.lexsort(tuple(sort_keys))
     data = tuple(d[perm] for d in b.data)
     valid = tuple(v[perm] for v in b.valid)
     return ColumnBatch(data, valid, b.sel[perm], b.names)
@@ -1023,12 +1087,31 @@ def sort_batch(b: ColumnBatch, keys, rank_tables: dict) -> ColumnBatch:
 TOPK_MAX = 1024
 
 
-def _primary_rank_word(b: ColumnBatch, keys, rank_tables):
-    """One ascending-sorts-first rank word for the FIRST sort key:
-    value order (desc via negation), NULLS LAST for asc / FIRST for
-    desc (sort_batch's convention), dead rows strictly last. Ties on
-    this word are resolved by the refined full-key sort; the top-k
-    cut only needs the word itself plus the tie-count check."""
+def _primary_rank_word(b: ColumnBatch, keys, rank_tables,
+                       mode: str = "off"):
+    """One ascending-sorts-first rank word for the top-k cut.
+
+    Normalized (auto/on): lane 0 of the FULL packed key word
+    (ops/sortkey.py) as an order-preserving int64 image — when the
+    key list fits one lane (dict strings, narrow ints) the word
+    breaks ALL comparator ties, so primary-key ties no longer trip
+    the __topk_inexact host fallback; with overflow lanes the word is
+    a comparator-order prefix and the tie-count check below stays
+    conservative. Legacy (off): the FIRST key only — value order
+    (desc via bitwise NOT: negation wraps at INT64_MIN), NULLS LAST
+    for asc / FIRST for desc (sort_batch's convention), dead rows
+    strictly last, with real values clipped to +-(2^62-1) so they can
+    never collide with the 2^62-family NULL/dead sentinels (clip ties
+    are handled conservatively by the exactness count). Ties on the
+    word are resolved by the refined full-key sort; the cut only
+    needs the word plus the tie-count check."""
+    if mode in ("auto", "on"):
+        lanes = _normalized_lanes(b, keys, rank_tables, "topk")
+        if lanes is not None:
+            sortkey.NORMALIZED.bump("topk")
+            sortkey.LANES.bump("topk")
+            return jax.lax.bitcast_convert_type(
+                lanes[0] ^ jnp.uint64(1 << 63), jnp.int64)
     name, desc = keys[0][0], keys[0][1]
     nf = keys[0][2] if len(keys[0]) > 2 else None
     null_first = nf if nf is not None else desc
@@ -1048,7 +1131,9 @@ def _primary_rank_word(b: ColumnBatch, keys, rank_tables):
     else:
         w = d.astype(jnp.int64)
         if desc:
-            w = -w
+            w = ~w
+        lim = jnp.int64((1 << 62) - 1)
+        w = jnp.clip(w, -lim, lim)
         null_w = jnp.int64(-(1 << 62) if null_first else (1 << 62))
         dead_w = jnp.int64((1 << 62) + (1 << 61))
     w = jnp.where(v, w, null_w)
@@ -1057,7 +1142,8 @@ def _primary_rank_word(b: ColumnBatch, keys, rank_tables):
 
 
 def topk_sort_limit_batch(b: ColumnBatch, keys, rank_tables,
-                          limit: int, offset: int) -> ColumnBatch:
+                          limit: int, offset: int,
+                          mode: str = "off") -> ColumnBatch:
     """ORDER BY ... LIMIT fused as top_k + refine. XLA's variadic
     sort compiles in ~20s PER OPERAND beyond 64K rows (measured v5e),
     so the full lexsort runs only over the m candidate rows; the
@@ -1070,14 +1156,17 @@ def topk_sort_limit_batch(b: ColumnBatch, keys, rank_tables,
     n = int(b.sel.shape[0])
     k_eff = limit + offset
     m = min(n, max(4 * k_eff, 128))
-    w = _primary_rank_word(b, keys, rank_tables)
-    _, idx = jax.lax.top_k(-w, m)
+    w = _primary_rank_word(b, keys, rank_tables, mode)
+    # smallest-word-first selection; ints reverse via bitwise NOT
+    # (negation would wrap: the normalized word spans all of int64)
+    _, idx = jax.lax.top_k(
+        -w if jnp.issubdtype(w.dtype, jnp.floating) else ~w, m)
     data = tuple(d[idx] for d in b.data)
     valid = tuple(v[idx] for v in b.valid)
     bm = ColumnBatch(data + (w[idx],),
                      valid + (jnp.ones(m, dtype=bool),),
                      b.sel[idx], list(b.names) + ["__topk_w"])
-    bs = sort_batch(bm, keys, rank_tables)
+    bs = sort_batch(bm, keys, rank_tables, mode)
     # exactness: every row whose rank word could place at or before
     # the k-th selected row must be a candidate
     kth = min(k_eff, m) - 1
@@ -1100,10 +1189,11 @@ def _compile_topk_sort_limit(node: P.Limit, params: ExecParams,
     rank_tables = _sort_rank_tables(sortnode.keys, meta)
     keys = list(sortnode.keys)
     lim, off = node.limit, node.offset
+    mode = params.sort_normalized
 
     def run_topk(rc: RunContext) -> ColumnBatch:
         return topk_sort_limit_batch(childf(rc), keys, rank_tables,
-                                     lim, off)
+                                     lim, off, mode)
     return run_topk
 
 
@@ -1122,9 +1212,10 @@ def _compile_sort(node: P.Sort, params: ExecParams,
     childf = compile_plan(node.child, params, meta)
     rank_tables = _sort_rank_tables(node.keys, meta)
     keys = list(node.keys)
+    mode = params.sort_normalized
 
     def run_sort(rc: RunContext) -> ColumnBatch:
-        return sort_batch(childf(rc), keys, rank_tables)
+        return sort_batch(childf(rc), keys, rank_tables, mode)
     return run_sort
 
 
@@ -1379,7 +1470,8 @@ def compile_streaming(node: P.PlanNode, params: ExecParams,
         out = _agg_output(group_cols, aggs_out, live, itemfs, havingf,
                           num_groups, overflow)
         if sort_node is not None:
-            out = sort_batch(out, list(sort_node.keys), rank_tables)
+            out = sort_batch(out, list(sort_node.keys), rank_tables,
+                             params.sort_normalized)
         if limit_node is not None:
             out = limit_batch(out, limit_node.limit, limit_node.offset)
         return out

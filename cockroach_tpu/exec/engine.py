@@ -198,6 +198,26 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             lambda: _ga.ROWS.value(),
             "rows offered to Pallas group-aggregate kernels at trace "
             "time (per-build input height, not per-execution)")
+        # normalized-sort tallies (ops/sortkey.py) — trace-time, like
+        # the Pallas counters above
+        from ..ops import sortkey as _sk
+        self.metrics.func_counter(
+            "exec.sort.normalized",
+            lambda: _sk.NORMALIZED.value(),
+            "sorts traced through the normalized-key plane (packed "
+            "uint64 lanes, one stable single-key argsort per lane) "
+            "across ORDER BY / top-k / window / join-chain / "
+            "DISTINCT sites")
+        self.metrics.func_counter(
+            "exec.sort.lexsort_fallback",
+            lambda: _sk.FALLBACKS.value(),
+            "sorts that wanted key normalization but compiled on the "
+            "variadic lexsort (some key dtype not encodable)")
+        self.metrics.func_counter(
+            "exec.sort.lanes",
+            lambda: _sk.LANES.value(),
+            "uint64 lanes sorted by normalized-key sorts at trace "
+            "time (lanes per sort ~ packed key-list width / 64)")
         # /debug/tracez ring buffer: recordings of statements slower
         # than sql.trace.slow_statement.threshold (0 disables)
         from collections import deque as _deque
@@ -1455,6 +1475,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         pallas = str(pallas).lower()
         if pallas not in ("auto", "on", "off"):
             pallas = "off"
+        # same normalization discipline for the sort-key plane
+        sortn = session.vars.get("sort_normalized", "auto")
+        if isinstance(sortn, bool):
+            sortn = "on" if sortn else "off"
+        sortn = str(sortn).lower()
+        if sortn not in ("auto", "on", "off"):
+            sortn = "off"
         # keyed by shape (padded row-count bucket) + dictionary sizes,
         # NOT data generation: the compiled XLA program depends only on
         # shapes and on literal dictionary codes (append-only, so any
@@ -1476,7 +1503,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # sql_text alone would hand back a stale compiled constant
         plan_fp = hash(repr(node))
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
-               stream, cap, pallas, plan_fp, no_topk, no_compact)
+               stream, cap, pallas, sortn, plan_fp, no_topk, no_compact)
         cached = self._exec_cache.get(key)
         self.tracer.tag(plan_cache="hit" if cached else "miss")
         self.metrics.counter(
@@ -1490,7 +1517,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                           if decision is not None else 1),
                 pallas_groupagg=pallas,
                 pallas_interpret=jax.default_backend() != "tpu",
-                topk_sort=not no_topk)
+                topk_sort=not no_topk,
+                sort_normalized=sortn)
             if stream is not None:
                 splan = compile_streaming(node, params, meta)
 

@@ -126,11 +126,9 @@ def _collect_scans(node: P.PlanNode) -> dict[str, str]:
     return out
 
 
-def _next_pow2(x: int) -> int:
-    n = 1
-    while n < x:
-        n <<= 1
-    return n
+# shared impl in utils/num.py; the alias keeps importers of
+# stmtutil._next_pow2 (exec/scanplane.py, exec/engine.py) working
+from ..utils.num import next_pow2 as _next_pow2  # noqa: E402
 
 
 def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:

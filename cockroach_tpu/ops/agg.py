@@ -247,18 +247,34 @@ def group_any(data, group_ids, mask, num_groups: int):
         data, mode="drop")
 
 
-def distinct_first_mask(data, mask, group_ids, num_groups: int):
+def distinct_first_mask(data, mask, group_ids, num_groups: int,
+                        sort_normalized: str = "off"):
     """True at the FIRST masked occurrence of each (group, value) pair.
 
     DISTINCT aggregates become ordinary aggregates with this extra
     mask: sort rows by (group, value), flag group/value changes,
-    scatter the flags back — one lexsort, no per-group work (the
+    scatter the flags back — one sort, no per-group work (the
     reference dedups inside its hash aggregator per-bucket instead,
-    colexec/distinct.eg.go)."""
+    colexec/distinct.eg.go). sort_normalized auto/on packs the
+    (group, value) pair into uint64 lanes (the group field sized to
+    bit_length(num_groups): the masked-out sentinel rides as code
+    num_groups) and argsorts per lane instead of the lexsort."""
+    from . import sortkey
     n = data.shape[0]
     sentinel = jnp.int64(num_groups)
     g = jnp.where(mask, group_ids.astype(jnp.int64), sentinel)
-    order = jnp.lexsort((data, g))
+    order = None
+    if sort_normalized in ("auto", "on"):
+        enc = sortkey.encode_value(data)
+        if enc is not None:
+            gw = max(1, int(num_groups).bit_length())
+            fields = [(g.astype(jnp.uint64), gw), enc]
+            order = sortkey.sort_perm(
+                sortkey.pack_lanes(fields, n), kind="distinct")
+        else:
+            sortkey.FALLBACKS.bump("distinct")
+    if order is None:
+        order = jnp.lexsort((data, g))
     gs, ds = g[order], data[order]
     first = jnp.concatenate([
         jnp.ones((1,), jnp.bool_),

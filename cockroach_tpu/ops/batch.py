@@ -238,11 +238,10 @@ def pull_arrays(arrs: list) -> list[np.ndarray]:
 _SMALL_PULL = 1 << 17
 
 
-def _pow2(x: int) -> int:
-    n = 1
-    while n < x:
-        n <<= 1
-    return n
+# shared helper (one impl for the three former copies here /
+# ops/join.py / exec/stmtutil.py); the alias keeps importers of
+# batch._pow2 (exec/ctecompose.py) working
+from ..utils.num import next_pow2 as _pow2  # noqa: E402
 
 
 def pull_batch_columns(batch: ColumnBatch, names: list,

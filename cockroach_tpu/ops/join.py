@@ -25,22 +25,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from . import hashtable
+from ..utils.num import next_pow2 as _next_pow2
+from . import hashtable, sortkey
 from .batch import ColumnBatch
-
-
-def _next_pow2(x: int) -> int:
-    n = 1
-    while n < x:
-        n <<= 1
-    return n
 
 
 def hash_join(probe: ColumnBatch, build: ColumnBatch,
               probe_keys: list[str], build_keys: list[str],
               build_payload: list[str], join_type: str = "inner",
               suffix: str = "", expand: int = 1,
-              direct=None, pack_payload=()) -> ColumnBatch:
+              direct=None, pack_payload=(),
+              sort_normalized: str = "off") -> ColumnBatch:
     """Join `probe` against `build` and return the probe batch extended
     with `build_payload` columns gathered from matches.
 
@@ -220,17 +215,33 @@ def hash_join(probe: ColumnBatch, build: ColumnBatch,
         return out.and_sel(matched) if join_type == "inner" else out
 
     return _expand_join(probe, build, bkeys, bmask, matched, build_row,
-                        build_payload, join_type, suffix, expand)
+                        build_payload, join_type, suffix, expand,
+                        sort_normalized)
 
 
-def _dup_chain(bkeys: tuple, bmask, n: int):
+def _dup_chain(bkeys: tuple, bmask, n: int, mode: str = "off"):
     """next_dup[i] = the next live build row with row i's key (or n).
-    One stable lexsort: equal live keys become adjacent runs in
+    One stable sort: equal live keys become adjacent runs in
     ascending row order, so chaining is a shifted compare. The chain
     start (min rowid per key) is exactly the row hashtable.build's
-    claim resolves to."""
-    dead = jnp.logical_not(bmask).astype(jnp.int32)
-    order = jnp.lexsort(tuple(reversed(bkeys)) + (dead,))
+    claim resolves to. mode auto/on replaces the variadic lexsort
+    with packed-lane argsorts (ops/sortkey.py); adjacency-run
+    equality below still compares the RAW key values, so the chains
+    are identical either way."""
+    order = None
+    if mode in ("auto", "on"):
+        live = jnp.ones((n,), jnp.bool_)
+        specs = [(k, live, False, False, None, None) for k in bkeys]
+        fields = sortkey.encode_keys(specs)
+        if fields is not None:
+            lanes = sortkey.mask_dead(sortkey.pack_lanes(fields, n),
+                                      bmask)
+            order = sortkey.sort_perm(lanes, kind="join")
+        else:
+            sortkey.FALLBACKS.bump("join")
+    if order is None:
+        dead = jnp.logical_not(bmask).astype(jnp.int32)
+        order = jnp.lexsort(tuple(reversed(bkeys)) + (dead,))
     same = jnp.ones((n - 1,), dtype=jnp.bool_) if n > 1 else \
         jnp.zeros((0,), dtype=jnp.bool_)
     for k in bkeys:
@@ -244,9 +255,10 @@ def _dup_chain(bkeys: tuple, bmask, n: int):
 
 
 def _expand_join(probe, build, bkeys, bmask, matched, build_row,
-                 build_payload, join_type, suffix, K: int):
+                 build_payload, join_type, suffix, K: int,
+                 sort_normalized: str = "off"):
     n_b = build.n
-    next_dup = _dup_chain(bkeys, bmask, n_b)
+    next_dup = _dup_chain(bkeys, bmask, n_b, sort_normalized)
     # walk the chain K-1 hops: rows_j / has_j per output copy
     rows = [build_row]
     has = [matched]

@@ -21,13 +21,21 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import sortkey
 
-def order_and_segments(part_keys: list, order_keys: list, sel):
+
+def order_and_segments(part_keys: list, order_keys: list, sel,
+                       mode: str = "off"):
     """Sort the rows and describe partitions/peer groups.
 
     part_keys: list of (data, valid); order_keys: list of
     (data, valid, desc). Unselected rows sort to the end and form
     their own "partition" (excluded by callers via in_part).
+
+    mode (sort_normalized): auto/on pack (partition keys, order keys)
+    into uint64 lanes and run one stable argsort per lane
+    (ops/sortkey.py) instead of the 2K+1-operand lexsort whose XLA
+    compile cost grows per operand.
 
     Returns (order, seg_start, peer_start, in_part) — all in the
     sorted domain except `order` which indexes original rows:
@@ -37,21 +45,40 @@ def order_and_segments(part_keys: list, order_keys: list, sel):
       in_part[i]   sorted row i belongs to a real (selected) partition
     """
     n = sel.shape[0]
-    unsel = jnp.logical_not(sel).astype(jnp.int32)
-    # jnp.lexsort: LAST key is primary. Build minor->major.
-    keys = []
-    for d, v, desc in reversed(order_keys):
-        kd = _sortable(d, desc)
-        keys.append(kd)
-        # NULLS LAST for asc, FIRST for desc (pg default)
-        keys.append(v.astype(jnp.int32) if desc
-                    else jnp.logical_not(v).astype(jnp.int32))
-    for d, v in reversed(part_keys):
-        # partitions group NULLs together: validity is part of the key
-        keys.append(_sortable(d, False))
-        keys.append(jnp.logical_not(v).astype(jnp.int32))
-    keys.append(unsel)  # primary: selected rows first
-    order = jnp.lexsort(tuple(keys))
+    order = None
+    if mode in ("auto", "on"):
+        specs = []
+        for d, v in part_keys:
+            # partitions group NULLs together, after live values
+            # (the lexsort's logical_not(v) key)
+            specs.append((d, v, False, False, None, None))
+        for d, v, desc in order_keys:
+            # NULLS LAST for asc, FIRST for desc (pg default)
+            specs.append((d, v, desc, desc, None, None))
+        fields = sortkey.encode_keys(specs)
+        if fields is not None:
+            lanes = sortkey.mask_dead(sortkey.pack_lanes(fields, n),
+                                      sel)
+            order = sortkey.sort_perm(lanes, kind="window")
+        else:
+            sortkey.FALLBACKS.bump("window")
+    if order is None:
+        unsel = jnp.logical_not(sel).astype(jnp.int32)
+        # jnp.lexsort: LAST key is primary. Build minor->major.
+        keys = []
+        for d, v, desc in reversed(order_keys):
+            kd = _sortable(d, desc)
+            keys.append(kd)
+            # NULLS LAST for asc, FIRST for desc (pg default)
+            keys.append(v.astype(jnp.int32) if desc
+                        else jnp.logical_not(v).astype(jnp.int32))
+        for d, v in reversed(part_keys):
+            # partitions group NULLs together: validity is part of
+            # the key
+            keys.append(_sortable(d, False))
+            keys.append(jnp.logical_not(v).astype(jnp.int32))
+        keys.append(unsel)  # primary: selected rows first
+        order = jnp.lexsort(tuple(keys))
 
     def sorted_eq(pairs):
         """Row i equals row i-1 on every (data, valid) pair."""
@@ -82,8 +109,14 @@ def order_and_segments(part_keys: list, order_keys: list, sel):
 
 
 def _sortable(d, desc: bool):
-    d = d.astype(jnp.float64) if d.dtype.kind == "f" else d
-    return -d if desc else d
+    if d.dtype.kind == "f":
+        d = d.astype(jnp.float64)
+        return -d if desc else d
+    if not desc:
+        return d
+    # bitwise NOT reverses int order with no wraparound (negation
+    # maps INT64_MIN to itself)
+    return ~d.astype(jnp.int64)
 
 
 def _peer_end(peer_start, n):

@@ -1,0 +1,11 @@
+"""Small numeric helpers shared across layers."""
+
+from __future__ import annotations
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (1 for x <= 1)."""
+    n = 1
+    while n < x:
+        n <<= 1
+    return n

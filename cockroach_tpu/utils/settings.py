@@ -137,6 +137,14 @@ class SessionVars:
         # (approximate vs the XLA path's f64); off: escape hatch /
         # bench A/B lever
         "pallas_groupagg": "auto",   # auto | on | off
+        # normalized sort keys (ops/sortkey.py): pack the whole
+        # ORDER BY / window / distinct key list into uint64 lanes and
+        # sort with one stable argsort per lane instead of the
+        # variadic lexsort (XLA compiles ~20s per sort operand beyond
+        # 64K rows). auto (default): whenever every key is encodable,
+        # lexsort fallback otherwise (tallied); off: escape hatch /
+        # bench A/B lever
+        "sort_normalized": "auto",   # auto | on | off
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,
