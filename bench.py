@@ -38,7 +38,10 @@ subprocess, BENCH_CPU_ROWS (default 2^22), BENCH_STREAM=0 /
 BENCH_DISPATCHQ=0 to skip the PR 3 data-plane benches (streamed-scan
 pipeline A/B and concurrent distributed dispatch), BENCH_PALLAS=0 to
 skip the round-6 grouped-aggregation kernel A/B (auto vs off over
-q1/q3/q18; BENCH_PALLAS_ROWS, default 2^18).
+q1/q3/q18; BENCH_PALLAS_ROWS, default 2^18), BENCH_SPILL=0 to skip
+the round-8 out-of-core A/B (spill=auto vs off at a forced-small HBM
+budget; BENCH_SPILL_ROWS default 2^19, BENCH_SPILL_BUDGET default
+2^21 bytes).
 """
 
 import json
@@ -432,6 +435,101 @@ def run_sort_ab(rows, repeats):
     return out
 
 
+def run_spill_ab(rows, repeats):
+    """Out-of-core spill-tier A/B (round 8 tentpole): a q3-class join
+    (lineitem probe x orders build, small dense group key) and a
+    q9-class ORDER BY ... LIMIT, each run three ways:
+
+      resident  spill=off at an ample budget — the correctness
+                baseline every other arm must match row-for-row
+      off       spill=off at BENCH_SPILL_BUDGET — the pre-round-8
+                engine: the build/sort upload blows the quota monitor
+                and the query DIES (recorded as an error, value 0)
+      auto      spill=auto at the same small budget — the partitioned
+                external hash join / external merge sort complete the
+                query; metric deltas record exec.spill.bytes moved
+                and the prefetch-overlap seconds
+
+    The headline is not a speed ratio: the off arm at the small
+    budget cannot finish at all, so the auto arm's completion +
+    bit-parity against the resident baseline IS the win. NOTE: on the
+    XLA-CPU backend partition/page assembly shares host cores with
+    "device" compute, so overlap seconds understate the real chip."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    eng = Engine(mesh=None)
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem", "orders"), encoded=True)
+    print(f"# spill datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    budget = int(os.environ.get("BENCH_SPILL_BUDGET", 1 << 21))
+    ample = 12 << 30
+    qs = {
+        "join": ("SELECT o_orderpriority, count(*) AS n, "
+                 "sum(l_quantity) AS q FROM lineitem JOIN orders "
+                 "ON l_orderkey = o_orderkey "
+                 "GROUP BY o_orderpriority ORDER BY o_orderpriority"),
+        "sort": ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+                 "ORDER BY l_extendedprice DESC, l_orderkey "
+                 "LIMIT 1000"),
+    }
+    out = {"spill_budget_bytes": budget}
+    for which, sql in qs.items():
+        base = None
+        for arm, arm_budget, spill in (("resident", ample, "off"),
+                                       ("off", budget, "off"),
+                                       ("auto", budget, "auto")):
+            eng.drop_device_cache()
+            eng.settings.set("sql.exec.hbm_budget_bytes", arm_budget)
+            s = eng.session()
+            s.vars.set("distsql", "off")
+            s.vars.set("streaming_page_rows", 8192)
+            s.vars.set("spill", spill)
+            verdict = eng.stream_verdict(qs[which], s)
+            snap0 = eng.metrics.snapshot()
+            try:
+                res = eng.execute(sql, s)  # warmup: compile + upload
+                per = []
+                for _ in range(repeats):
+                    t0 = time.time()
+                    res = eng.execute(sql, s)
+                    per.append(rows / (time.time() - t0))
+                rps = statistics.median(per)
+            except Exception as e:
+                # the expected off-arm outcome at the small budget:
+                # the whole-build/whole-table upload trips the quota
+                # monitor before any execution
+                out[f"spill_{which}_{arm}_rows_per_sec"] = 0
+                out[f"spill_{which}_{arm}_error"] = type(e).__name__
+                print(f"# spill {which} arm={arm} verdict={verdict} "
+                      f"error={type(e).__name__}: {str(e)[:100]}",
+                      file=sys.stderr)
+                continue
+            d = metric_deltas(snap0, eng.metrics.snapshot())
+            out[f"spill_{which}_{arm}_rows_per_sec"] = round(rps)
+            if arm == "resident":
+                base = res.rows
+            else:
+                out[f"spill_{which}_{arm}_parity"] = res.rows == base
+            if arm == "auto":
+                out[f"spill_{which}_partitions"] = \
+                    d.get("exec.spill.partitions", 0)
+                out[f"spill_{which}_bytes"] = \
+                    d.get("exec.spill.bytes", 0)
+                out[f"spill_{which}_overlap_s"] = round(
+                    d.get("exec.spill.upload_overlap_seconds", 0), 4)
+            print(f"# spill {which} arm={arm} verdict={verdict} "
+                  f"rows_per_sec={rps:.3e} "
+                  f"spill_bytes={d.get('exec.spill.bytes', 0)} "
+                  f"partitions={d.get('exec.spill.partitions', 0)} "
+                  f"overlap_s="
+                  f"{d.get('exec.spill.upload_overlap_seconds', 0):.4f}",
+                  file=sys.stderr)
+    return out
+
+
 def run_dispatchq(rows, workers=2, iters=6):
     """Concurrent distributed dispatch (PR 3 tentpole): N sessions
     issue distributed GROUP BYs at once through the per-mesh FIFO
@@ -631,6 +729,15 @@ def main():
             **per,
         }))
         return
+    if mode == "spill_child":
+        per = run_spill_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "spill_join_auto_rows_per_sec",
+            "value": per.get("spill_join_auto_rows_per_sec", 0),
+            "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
     if mode == "dispatchq_child":
         serial, conc = run_dispatchq(rows)
         print(json.dumps({
@@ -765,6 +872,15 @@ def main():
         if r is not None:
             out.update({k: v for k, v in r.items()
                         if k.startswith("sort_")})
+    # round 8 tentpole A/B: out-of-core spill tier (spill=auto) vs
+    # the quota-bound engine (spill=off) at a forced-small HBM budget
+    if os.environ.get("BENCH_SPILL", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_SPILL_ROWS", 1 << 19)),
+                      "spill", child_timeout, mode="spill_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("spill_")})
+            out.setdefault("spill_rows", r["rows"])
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),
@@ -785,7 +901,8 @@ def main():
 
 # metrics where a value change is configuration, not performance
 _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
-                  "cpu_rows", "ssb_rows", "tpcc_warehouses"}
+                  "cpu_rows", "ssb_rows", "tpcc_warehouses",
+                  "spill_budget_bytes"}
 
 
 def regression_report(out: dict) -> None:

@@ -1374,6 +1374,23 @@ def can_stream(node: P.PlanNode) -> bool:
     return not any(a.distinct for a in n.aggs)
 
 
+def can_spill_sort(node: P.PlanNode) -> bool:
+    """Mirror of exec/spill.compile_spill_sort's shape eligibility:
+    Limit?/Sort over a join-free single-scan spine. Aggregate-rooted
+    plans take the streaming/spill-join paths instead (their Sort runs
+    over the small finalized group batch), and joins would need the
+    partitioned tier, not run merging."""
+    n = node
+    if isinstance(n, P.Limit):
+        n = n.child
+    if not isinstance(n, P.Sort) or not n.keys:
+        return False
+    n = n.child
+    while isinstance(n, (P.Filter, P.Project, P.Compact)):
+        n = n.child
+    return isinstance(n, P.Scan)
+
+
 def compile_streaming(node: P.PlanNode, params: ExecParams,
                       meta: P.OutputMeta | None = None) -> StreamingPlan:
     """Compile Limit?/Sort?/Aggregate(dense|ungrouped) for paging.

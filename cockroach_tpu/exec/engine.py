@@ -1396,7 +1396,16 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             touched = {tb for tb, _ in session.effects}
             overlay = touched & set(scan_aliases.values())
         decision = None if overlay else self._dist_decision(node, session)
-        stream = (None if (overlay or decision is not None)
+        # four-way placement verdict: distributed > spill > stream-scan
+        # > resident. Spill outranks stream-scan because it covers the
+        # shapes streaming can't rescue: over-budget join builds (the
+        # stream path uploads builds whole and dies at hbm.reserve) and
+        # Sort/Limit plans with no aggregate to page into partials.
+        spill = (None if (overlay or decision is not None)
+                 else self._spill_decision(node, scan_aliases, scan_cols,
+                                           session, meta))
+        stream = (None if (overlay or decision is not None
+                           or spill is not None)
                   else self._stream_decision(node, scan_aliases, scan_cols,
                                              session))
         read_ts = self._read_ts(session)
@@ -1413,8 +1422,15 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         try:
             self._check_join_builds(node, read_ts, overlay_puts)
             self._bound_agg_group_rows(node, read_ts, overlay_puts)
+            wide = set()
+            if stream is not None:
+                wide.add(stream[0])
+            if spill is not None:
+                wide.add(spill.alias)
+                if spill.build_alias:
+                    wide.add(spill.build_alias)
             narrow_by_alias = self._set_scan_narrowing(
-                node, overlay, stream[0] if stream else None)
+                node, overlay, frozenset(wide))
         except EngineError:
             if meta.memo is not None and not no_memo:
                 # the memo's stats-estimated build order violated the
@@ -1446,6 +1462,19 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                     sorted((cn, len(d)) for cn, d in
                            self.store.table(tname).dictionaries.items()))
                 shapes.append((tname, stream[2], dictlens))
+                continue
+            if spill is not None and alias in (spill.alias,
+                                               spill.build_alias):
+                # spilled probe/build never upload whole either; their
+                # execution-time shapes (page size / the shared build
+                # partition pad) don't fingerprint the plan — the
+                # SpillPlan in the cache key covers the placement, and
+                # jit retraces per gathered shape anyway
+                gens.append((tname, self.store.table(tname).generation))
+                dictlens = tuple(
+                    sorted((cn, len(d)) for cn, d in
+                           self.store.table(tname).dictionaries.items()))
+                shapes.append((tname, 0, dictlens))
                 continue
             if tname in overlay:
                 b = self._overlay_batch(tname, session.effects, read_ts)
@@ -1489,7 +1518,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # of the reference (sql/plan_opt.go), adapted to XLA's
         # shape-specialized compilation model
         if not no_compact and stream is None and decision is None \
-                and not overlay:
+                and spill is None and not overlay:
             # selection compaction: low-selectivity scans feeding
             # aggregation pack their survivors before join probes /
             # agg partials run (see compile.compact_batch). Gated off
@@ -1503,7 +1532,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # sql_text alone would hand back a stale compiled constant
         plan_fp = hash(repr(node))
         key = (sql_text, tuple(sorted(shapes)), decision is not None,
-               stream, cap, pallas, sortn, plan_fp, no_topk, no_compact)
+               stream, spill, cap, pallas, sortn, plan_fp, no_topk,
+               no_compact)
         cached = self._exec_cache.get(key)
         self.tracer.tag(plan_cache="hit" if cached else "miss")
         self.metrics.counter(
@@ -1519,7 +1549,28 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 pallas_interpret=jax.default_backend() != "tpu",
                 topk_sort=not no_topk,
                 sort_normalized=sortn)
-            if stream is not None:
+            if spill is not None and spill.kind == "join":
+                # the spill-join probes with the UNCHANGED streaming
+                # page program: each probe row lands in exactly one
+                # (partition, page) and matches only inside its
+                # partition, so the per-page partial combine algebra
+                # is exact over the partition sweep (and the partials
+                # stay mergeable across DistSQL for the same reason)
+                splan = compile_streaming(node, params, meta)
+
+                def spage_fn(scans_in, ts_in, _f=splan.page_fn):
+                    return _f(RunContext(scans_in, ts_in))
+                jfn = _StreamFns(jax.jit(spage_fn),
+                                 jax.jit(splan.combine),
+                                 jax.jit(splan.final_fn))
+            elif spill is not None:
+                from .spill import compile_spill_sort
+                runf = compile_spill_sort(node, params, meta)
+
+                def sort_fn(scans_in, ts_in, _f=runf):
+                    return _f(RunContext(scans_in, ts_in))
+                jfn = jax.jit(sort_fn)
+            elif stream is not None:
                 splan = compile_streaming(node, params, meta)
 
                 def page_fn(scans_in, ts_in, _f=splan.page_fn):
@@ -1546,20 +1597,32 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # zone-map checks for the streamed scan's pushed-down
         # predicates: compiled from THIS prepare's plan (constants are
         # inlined), so they track the statement's current bindings
-        stream_zone = (extract_zone_preds(node, stream[0])
-                       if stream is not None else ())
+        if stream is not None:
+            stream_zone = extract_zone_preds(node, stream[0])
+        elif spill is not None and spill.kind == "sort":
+            stream_zone = extract_zone_preds(node, spill.alias)
+        else:
+            # spill-join probes with no zone pruning: every probe row
+            # belongs to exactly one partition regardless of predicate
+            # outcome, and the partitioner indexes rows globally
+            stream_zone = ()
+        paged = spill.alias if spill is not None else (
+            stream[0] if stream is not None else None)
         prepared = Prepared(self, session, sel, sql_text, jfn, scans,
                             meta, gens, stream=stream,
-                            stream_cols=(scan_cols.get(stream[0])
-                                         if stream else None),
+                            stream_cols=(scan_cols.get(paged)
+                                         if paged is not None else None),
                             stream_zone=stream_zone,
-                            as_of=as_of)
+                            as_of=as_of, spill=spill,
+                            spill_cols=(scan_cols.get(spill.build_alias)
+                                        if spill is not None
+                                        and spill.build_alias else None))
         # alias -> table map (composed CTE execution patches temp
         # aliases' scan batches per run, exec/ctecompose.py)
         prepared.scan_tables = dict(scan_aliases)
         cap = self._cte_capture
         if cap is not None and cap.get("want_main") \
-                and not cap["disabled"]:
+                and not cap["disabled"] and prepared.spill is None:
             cap["preps"].append(prepared)
         return prepared
 
@@ -1857,13 +1920,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         walk(node)
 
     def _set_scan_narrowing(self, node, overlay,
-                            stream_alias) -> dict:
+                            wide_aliases: frozenset) -> dict:
         """Mark each Scan's int64 columns whose proven value range
         fits int32 (scanplane.narrow32_cols): the upload moves half
         the HBM bytes and the compiled scan upcasts, so downstream
         programs are unchanged. Skipped for txn-overlay scans (their
         fresh uploads don't consult the generation-cached ranges), the
-        streamed fact table (pages upload wide), and any scan feeding
+        streamed/spilled scans (pages and gathered partitions upload
+        wide — ``wide_aliases``), and any scan feeding
         a JOIN: in probe pipelines XLA materializes the upcast as a
         full-width int64 copy instead of fusing it into the gathers —
         measured 147M -> 111M rows/s on Q14 at 2^23, the round-4
@@ -1900,7 +1964,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
 
         def walk(n):
             if isinstance(n, P.Scan):
-                if n.table not in overlay and n.alias != stream_alias \
+                if n.table not in overlay \
+                        and n.alias not in wide_aliases \
                         and id(n) not in under_join:
                     n.narrowed = self.narrow32_cols(
                         n.table, frozenset(n.columns.values()))

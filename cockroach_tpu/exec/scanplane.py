@@ -20,7 +20,8 @@ from ..parallel.distagg import make_distributed_fn, queued_collective_call
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import plan as P
 from ..storage.hlc import Timestamp
-from .compile import ExecParams, RunContext, can_stream, compile_plan
+from .compile import (ExecParams, RunContext, can_spill_sort,
+                      can_stream, compile_plan)
 
 EPOCH_DATE = datetime.date(1970, 1, 1)
 EPOCH_DT = datetime.datetime(1970, 1, 1)
@@ -198,10 +199,244 @@ class ScanPlaneMixin:
         # Build-side tables still upload whole: streaming the probe is
         # strictly better than not, and an over-budget build fails
         # upstream with a clean quota error rather than silently here.
-        page_rows = max(1024,
-                        int(session.vars.get("streaming_page_rows",
-                                             1 << 21)))
-        return (alias, tname, page_rows)
+        return (alias, tname, self._page_rows(session))
+
+    @staticmethod
+    def _page_rows(session: Session) -> int:
+        """Session page size rounded UP to a power of two: page shapes
+        feed the same _next_pow2-padded programs as resident uploads,
+        so a non-pow2 SET streaming_page_rows would give the tail page
+        a shape no other page shares and recompile per page."""
+        return max(1024, _next_pow2(
+            int(session.vars.get("streaming_page_rows", 1 << 21))))
+
+    # -- out-of-core spill tier (exec/spill.py) -----------------------------
+    def _spill_decision(self, node, scan_aliases: dict, scan_cols: dict,
+                        session: Session, meta):
+        """Third verdict of the four-way plan placement (resident |
+        stream-scan | spill-join | spill-sort): hand the plan to the
+        out-of-core tier when the working set cannot fit the device
+        budget any other way. ``SET spill = auto|on|off`` gates it:
+        auto spills only when the resident/stream paths would blow
+        ``sql.exec.hbm_budget_bytes``, on forces every eligible shape
+        (tests/bench), off disables (the A/B lever). Returns a
+        spill.SpillPlan or None."""
+        mode = session.vars.get("spill", "auto")
+        if mode == "off":
+            return None
+        budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
+        if budget <= 0:
+            return None
+        page_rows = self._page_rows(session)
+        sp = self._spill_join_decision(node, scan_aliases, scan_cols,
+                                       mode, budget, page_rows)
+        if sp is not None:
+            return sp
+        return self._spill_sort_decision(node, scan_aliases, scan_cols,
+                                         meta, mode, budget, page_rows)
+
+    def _spill_join_decision(self, node, scan_aliases: dict,
+                             scan_cols: dict, mode: str, budget: int,
+                             page_rows: int):
+        """Partitioned-external-hash-join eligibility + trigger.
+
+        Shape: a streamable aggregate over a join spine (the same
+        can_stream + single-sharded-alias contract the stream-scan
+        path uses — the probe pages through the device either way),
+        where some build side is a plain Scan joined on raw stored
+        int-family keys on BOTH sides. STRING keys are out: their
+        stored values are per-table dictionary codes, so one side
+        compares through a code remap and raw-code partitioning would
+        split equal keys. Int-family keys are safe regardless of
+        width: the device compares values (int32 uploads upcast), and
+        equal values cast to equal int64 bits, so both sides of an
+        equal pair hash to the same partition. Inner/left only — a
+        build row unmatched in ITS partition is genuinely unmatched.
+
+        Trigger (auto): the stream-scan path uploads every build
+        whole, so its runtime floor is sum(build uploads) + two
+        in-flight probe pages + per-page aggregation temps (the
+        streamed compile aggregates page-at-a-time, so temps scale
+        with the page, not the table); spill when that floor exceeds
+        the budget (the resident path needs strictly more). The
+        LARGEST eligible build spills; the partition count doubles
+        until one resident partition fits what the budget leaves."""
+        from .spill import SpillPlan
+        if not _has_join(node) or not can_stream(node):
+            return None
+        d = dist_analyze(node)
+        if not d.ok or len(d.sharded) != 1:
+            return None
+        alias = next(iter(d.sharded))
+        tname = scan_aliases[alias]
+        ptd = self.store.table(tname)
+        if ptd.row_count == 0:
+            return None
+        probe_scan = None
+        cands = []  # (build_bytes, join, build_scan, pkeys, bkeys)
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, P.Scan) and n.alias == alias:
+                probe_scan = n
+            if (isinstance(n, P.HashJoin)
+                    and n.join_type in ("inner", "left")
+                    and isinstance(n.right, P.Scan)
+                    and alias in _collect_scans(n.left)):
+                cands.append(n)
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    stack.append(c)
+        if probe_scan is None:
+            return None
+        joins = []
+        for j in cands:
+            b = j.right
+            pkeys = tuple(probe_scan.columns.get(k) for k in j.left_keys)
+            bkeys = tuple(b.columns.get(k) for k in j.right_keys)
+            if None in pkeys or None in bkeys:
+                continue  # a computed/remapped key: raw partitioning
+                # would not match the device's comparison space
+            if not all(self._raw_partitionable(t, ks) for t, ks in
+                       ((tname, pkeys), (b.table, bkeys))):
+                continue
+            btd = self.store.table(b.table)
+            if btd.row_count == 0:
+                continue
+            bb = self._table_device_bytes(btd,
+                                          scan_cols.get(b.alias))
+            joins.append((bb, j, b, pkeys, bkeys))
+        if not joins:
+            return None
+        n_aggs = _count_aggs(node)
+        page_padded = max(_next_pow2(max(page_rows, 1)), 1024)
+        temp_bytes = 2 * 16 * n_aggs * page_padded
+        page_bytes = 2 * self._page_device_bytes(
+            ptd, scan_cols.get(alias), page_rows)  # depth-2 prefetch
+        build_total = sum(
+            self._table_device_bytes(self.store.table(t),
+                                     scan_cols.get(a))
+            for a, t in scan_aliases.items() if a != alias)
+        if (mode == "auto"
+                and build_total + temp_bytes + page_bytes <= budget):
+            return None
+        des_bytes, j, b, pkeys, bkeys = max(joins, key=lambda x: x[0])
+        avail = max(budget - (build_total - des_bytes)
+                    - temp_bytes - page_bytes, 1)
+        nparts = 2
+        while (nparts < self.MAX_SPILL_PARTITIONS
+               and des_bytes // nparts > avail):
+            nparts *= 2
+        return SpillPlan(kind="join", alias=alias, table=tname,
+                         page_rows=page_rows, build_alias=b.alias,
+                         build_table=b.table, probe_keys=pkeys,
+                         build_keys=bkeys, nparts=nparts)
+
+    def _raw_partitionable(self, tname: str, stored_keys) -> bool:
+        """May the spill partitioner hash these stored columns raw?
+        Int-family only (incl. bool); STRING dictionary codes and
+        FLOAT (-0.0 == 0.0 with different bits) partition wrong."""
+        from ..sql.types import Family
+        td = self.store.table(tname)
+        by_name = {c.name: c for c in td.schema.columns}
+        for k in stored_keys:
+            col = by_name.get(k)
+            if col is None or col.type.family == Family.STRING:
+                return False
+            if np.dtype(col.type.np_dtype).kind not in "iub":
+                return False
+        return True
+
+    def _spill_sort_decision(self, node, scan_aliases: dict,
+                             scan_cols: dict, meta, mode: str,
+                             budget: int, page_rows: int):
+        """External-merge-sort eligibility + trigger: Limit?/Sort over
+        a join-free single-scan spine (can_spill_sort) whose every
+        key is normalized-encodable — the uint64 lanes double as the
+        device run keys AND the host merge keys, so the merged order
+        is byte-for-byte the device's. Auto triggers when the pruned
+        resident upload + sort temporaries (perm + lane per row)
+        would blow the budget."""
+        from .spill import SpillPlan
+        if not can_spill_sort(node) or len(scan_aliases) != 1:
+            return None
+        from ..sql.types import Family
+        alias, tname = next(iter(scan_aliases.items()))
+        td = self.store.table(tname)
+        if td.row_count == 0:
+            return None
+        limit_node = None
+        n = node
+        if isinstance(n, P.Limit):
+            limit_node, n = n, n.child
+        sort_node = n
+        names = list(meta.names)
+        for key in sort_node.keys:
+            kn = key[0]
+            if kn not in names:
+                return None  # hidden key: type unknowable here
+            fam = meta.types[names.index(kn)].family
+            if fam == Family.STRING:
+                if meta.dictionaries.get(kn) is None:
+                    return None  # no rank table -> unencodable
+            elif fam not in (Family.INT, Family.DECIMAL, Family.DATE,
+                             Family.TIMESTAMP, Family.BOOL,
+                             Family.FLOAT):
+                return None
+        cols = scan_cols.get(alias)
+        if mode == "auto":
+            padded = max(_next_pow2(max(td.row_count, 1)), 1024)
+            fits = (self._table_device_bytes(
+                td, cols, narrow=self.narrow32_cols(tname, cols))
+                + 24 * padded <= budget)
+            if fits:
+                return None
+        return SpillPlan(
+            kind="sort", alias=alias, table=tname, page_rows=page_rows,
+            sort_keys=tuple(
+                (k[0], bool(k[1]), (k[2] if len(k) > 2 else None))
+                for k in sort_node.keys),
+            limit=(limit_node.limit
+                   if limit_node is not None
+                   and limit_node.limit is not None else -1),
+            offset=((limit_node.offset or 0)
+                    if limit_node is not None else 0))
+
+    def _page_device_bytes(self, td, cols, page_rows: int) -> int:
+        """Device bytes of one streamed page of this table's pruned
+        column set (PageSource.page_bytes, computed pre-source)."""
+        total = 16 * page_rows
+        for col in td.schema.columns:
+            if cols is not None and col.name not in cols:
+                continue
+            w = np.dtype(col.type.np_dtype).itemsize
+            total += (w + 1) * page_rows
+        return total
+
+    def stream_verdict(self, sql: str, session: Session | None = None
+                       ) -> str:
+        """Which placement tier would this SELECT execute on?
+        "distributed" | "spill-join" | "spill-sort" | "stream-scan" |
+        "resident" — the planner's four-way verdict plus the mesh
+        plane, exposed for eligibility tests and EXPLAIN-style
+        introspection (no execution, no uploads)."""
+        session = session or self.session()
+        stmt = self._parse_cached(sql)
+        node, meta = self._plan(stmt, session)
+        from .stmtutil import _collect_scan_columns
+        scan_aliases = _collect_scans(node)
+        scan_cols = _collect_scan_columns(node)
+        if self._dist_decision(node, session) is not None:
+            return "distributed"
+        sp = self._spill_decision(node, scan_aliases, scan_cols,
+                                  session, meta)
+        if sp is not None:
+            return f"spill-{sp.kind}"
+        if self._stream_decision(node, scan_aliases, scan_cols,
+                                 session) is not None:
+            return "stream-scan"
+        return "resident"
 
     def _table_device_bytes(self, td, cols,
                             narrow: frozenset = frozenset()) -> int:

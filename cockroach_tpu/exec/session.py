@@ -126,6 +126,12 @@ class Prepared:
     stream_zone: tuple = ()
     # AS OF SYSTEM TIME: fixed historical read timestamp
     as_of: Optional[Timestamp] = None
+    # out-of-core tier (exec/spill.py): the planner's SpillPlan when
+    # this statement executes as a partitioned external hash join or
+    # an external merge sort; spill_cols is the build side's pruned
+    # column set (the probe's rides stream_cols)
+    spill: Optional[object] = None
+    spill_cols: Optional[frozenset] = None
 
     def _refresh(self) -> "Prepared":
         cur = tuple((t, self.engine.store.table(t).generation)
@@ -135,21 +141,34 @@ class Prepared:
         return self.engine._prepare_select(self.stmt, self.session,
                                            self.sql_text)
 
+    def _adopt(self, p: "Prepared") -> None:
+        """Copy a re-prepared statement's execution state into this
+        handle (generation-refresh keeps the caller's object)."""
+        self.jfn, self.scans, self.meta, self.gens = \
+            p.jfn, p.scans, p.meta, p.gens
+        self.stream, self.stream_cols = p.stream, p.stream_cols
+        self.stream_zone = p.stream_zone
+        self.spill, self.spill_cols = p.spill, p.spill_cols
+        self.as_of = p.as_of  # keep guard + execution timestamps
+        # consistent (interval forms re-resolve on refresh)
+
     def dispatch(self, read_ts: Optional[Timestamp] = None,
                  nparts: int = 1, pid: int = 0) -> ColumnBatch:
         p = self._refresh()
         if p is not self:
-            self.jfn, self.scans, self.meta, self.gens = \
-                p.jfn, p.scans, p.meta, p.gens
-            self.stream, self.stream_cols = p.stream, p.stream_cols
-            self.stream_zone = p.stream_zone
-            self.as_of = p.as_of  # keep guard + execution timestamps
-            # consistent (interval forms re-resolve on refresh)
+            self._adopt(p)
         ts = read_ts or self.as_of or \
             self.engine._read_ts(self.session)
         # np scalar: a jnp.int64() upload would cost a blocking
         # host->device round trip before the query even dispatches.
         tsv = np.int64(ts.to_int())
+        if self.spill is not None:
+            if self.spill.kind != "join":
+                raise EngineError(
+                    "spill-sort statements materialize host-side; "
+                    "use Prepared.run()")
+            from .spill import run_spill_join
+            return run_spill_join(self.engine, self, tsv)
         if self.stream is None:
             return self.jfn(self.scans, tsv, np.int32(nparts),
                             np.int32(pid))
@@ -189,11 +208,37 @@ class Prepared:
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
         tracer = self.engine.tracer
+        p = self._refresh()
+        if p is not self:
+            self._adopt(p)
+        if self.spill is not None and self.spill.kind == "sort":
+            # the external merge sort's tail runs on the host (run
+            # merge + decode in one pass), so there is no device
+            # batch to materialize separately
+            from .spill import run_spill_sort
+            ts = read_ts or self.as_of or \
+                self.engine._read_ts(self.session)
+            with tracer.span("dispatch"):
+                return run_spill_sort(self.engine, self,
+                                      np.int64(ts.to_int()))
+        from ..parallel.distagg import CollectiveFault
         try:
             with tracer.span("dispatch"):
                 out = self.dispatch(read_ts)
             with tracer.span("materialize"):
                 return self.engine._materialize(out, self.meta)
+        except CollectiveFault:
+            # an injected ICI fault lost this plan's collective
+            # dispatch: retry gateway-local, the reference's DistSQL
+            # fallback when remote flow setup fails (distsql_running)
+            prev = self.session.vars.get("distsql", "auto")
+            self.session.vars.set("distsql", "off")
+            try:
+                return self.engine._prepare_select(
+                    self.stmt, self.session,
+                    self.sql_text).run(read_ts)
+            finally:
+                self.session.vars.set("distsql", prev)
         except HashCapacityExceeded:
             # partition-and-recurse (the reference's disk spiller,
             # colexecdisk/disk_spiller.go:75, over HBM re-reads).

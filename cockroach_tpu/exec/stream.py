@@ -382,6 +382,75 @@ class PageSource:
             self._m_bytes.inc(self.page_bytes)
         return batch
 
+    # -- spill-tier gather assembly (exec/spill.py) ---------------------
+
+    def _gather_into(self, bufs, idx: np.ndarray, n_pad: int) -> dict:
+        """Fill ``bufs[:len(idx)]`` with the rows at ASCENDING global
+        row indices ``idx`` and pad the tail never-visible. Ascending
+        order makes chunk ids nondecreasing, so the gather is one
+        fancy-index per (column, chunk-run) — the same cost shape as
+        _assemble's contiguous fills. Returns the validity map."""
+        n = len(idx)
+        if n:
+            ci = np.searchsorted(self.offs, idx, side="right") - 1
+            bounds = np.flatnonzero(np.diff(ci)) + 1
+            starts = np.concatenate(([0], bounds))
+            ends = np.concatenate((bounds, [n]))
+            runs = [(self.chunks[ci[s]],
+                     idx[s:e] - self.offs[ci[s]], s, e)
+                    for s, e in zip(starts, ends)]
+        else:
+            runs = []
+        vmap: dict[str, np.ndarray] = {}
+        for cn in self.names:
+            buf = bufs[cn]
+            vbuf = None
+            for c, loc, s, e in runs:
+                buf[s:e] = c.data[cn][loc]
+                v = c.valid[cn][loc]
+                if not v.all():
+                    if vbuf is None:
+                        vbuf = np.ones(n_pad, dtype=bool)
+                    vbuf[s:e] = v
+            buf[n:n_pad] = 0
+            if vbuf is not None:
+                vbuf[n:n_pad] = False
+                vmap[cn] = vbuf
+        mts, mdl = bufs["_mvcc_ts"], bufs["_mvcc_del"]
+        for c, loc, s, e in runs:
+            mts[s:e] = c.mvcc_ts[loc]
+            mdl[s:e] = c.mvcc_del[loc]
+        mts[n:n_pad] = NEVER_TS
+        mdl[n:n_pad] = 0
+        return vmap
+
+    def gather_batch(self, idx: np.ndarray, n_pad: int):
+        """One device batch of exactly ``n_pad`` rows holding the rows
+        at ascending global indices ``idx`` (a spill-join build
+        partition: every partition pads to ONE shared pow2 shape so a
+        single XLA program serves the whole partition sweep)."""
+        bufs = {cn: np.empty(n_pad, dtype=dt)
+                for cn, dt in self.dtypes.items()}
+        bufs["_mvcc_ts"] = np.empty(n_pad, dtype=np.int64)
+        bufs["_mvcc_del"] = np.empty(n_pad, dtype=np.int64)
+        vmap = self._gather_into(bufs, idx, n_pad)
+        return ColumnBatch.from_dict(
+            {cn: jnp.array(bufs[cn])  # copy=True: see __init__
+             for cn in (*self.names, "_mvcc_ts", "_mvcc_del")},
+            {cn: jnp.asarray(v) for cn, v in vmap.items()})
+
+    def gather_pages(self, idx: np.ndarray):
+        """Yield page_rows-shaped device pages of the rows at ascending
+        global indices ``idx`` (a spill-join probe partition), reusing
+        the preallocated buffer set like pages()."""
+        for start in range(0, len(idx), self.page_rows):
+            sl = idx[start:start + self.page_rows]
+            vmap = self._gather_into(self._bufs, sl, self.page_rows)
+            yield ColumnBatch.from_dict(
+                {cn: jnp.array(self._bufs[cn])
+                 for cn in (*self.names, "_mvcc_ts", "_mvcc_del")},
+                {cn: jnp.asarray(v) for cn, v in vmap.items()})
+
     def empty_page(self):
         """A page of only never-visible padding rows: runs the page
         program to its identity state when zone maps pruned every

@@ -24,10 +24,42 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils.num import next_pow2 as _next_pow2
 from . import hashtable, sortkey
 from .batch import ColumnBatch
+
+# Fibonacci-multiplicative mix for the host-side spill partitioner
+# (same constant family as ops/hashtable's device hash; the two need
+# NOT agree — partitioning only requires equal keys -> equal bucket)
+_SPILL_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def radix_partition_ids(cols, valids, nparts: int) -> np.ndarray:
+    """Host-side partition id per row for the spill-partitioned hash
+    join (exec/spill.py).
+
+    ``cols``/``valids`` are the stored int-family key columns of ONE
+    side; both join sides partition with this same function over their
+    own key columns, so any probe/build pair that hash_join could
+    match (equal key values on every column) lands in the same
+    partition — the invariant that makes per-partition hash_join
+    results combine exactly. NULL keys hash as 0: they never match
+    anything on device (validity masks), so any bucket is correct.
+    ``nparts`` must be a power of two; ids use the high bits of the
+    mixed word (the multiplicative mix concentrates entropy there)."""
+    h = np.zeros(len(cols[0]), dtype=np.uint64)
+    for d, v in zip(cols, valids):
+        # int64 view keeps negative keys deterministic across the
+        # int32/int64 stored widths the two sides may disagree on
+        k = d.astype(np.int64, copy=False).view(np.uint64)
+        k = np.where(v, k, np.uint64(0))
+        h = (h ^ k) * _SPILL_MULT
+    if nparts <= 1:
+        return np.zeros(len(h), dtype=np.int64)
+    shift = np.uint64(64 - (nparts - 1).bit_length())
+    return (h >> shift).astype(np.int64)
 
 
 def hash_join(probe: ColumnBatch, build: ColumnBatch,

@@ -68,8 +68,8 @@ class _Tally:
             return self._counts.get(kind, 0)
 
 
-# per consumer site ("sort" / "topk" / "window" / "join" / "distinct");
-# read via the engine's exec.sort.* func-metrics
+# per consumer site ("sort" / "topk" / "window" / "join" / "distinct"
+# / "spill"); read via the engine's exec.sort.* func-metrics
 NORMALIZED = _Tally()   # sorts traced through the normalized plane
 FALLBACKS = _Tally()    # wanted normalization, compiled on lexsort
 LANES = _Tally()        # uint64 lanes sorted by normalized sorts
@@ -194,6 +194,24 @@ def mask_dead(lanes, sel):
     on every lane (live lane-0 flags are <= 2, so no collision), tied
     with each other so the stable sort keeps them in row order."""
     return [jnp.where(sel, lane, _ALL_ONES) for lane in lanes]
+
+
+def merge_lanes_host(runs):
+    """Host-side external-merge tail of the spill sort (exec/spill.py).
+
+    ``runs`` is a list of numpy uint64 lane stacks, one ``[L, k_i]``
+    array per device-sorted run, all with the SAME lane count and
+    packed by the same key specs (lanes compare across pages of one
+    table: dictionaries are shared). Returns the stable ascending
+    permutation over the run concatenation. Each run is already
+    sorted and runs concatenate in original row order, so the stable
+    lexsort reproduces byte-for-byte the permutation one device
+    sort_perm over all rows would have produced."""
+    import numpy as np  # host-only tail; keep the module jax-first
+    lanes = [np.concatenate([r[i] for r in runs])
+             for i in range(runs[0].shape[0])]
+    # np.lexsort treats its LAST key as primary; lanes are major-first
+    return np.lexsort(tuple(reversed(lanes)))
 
 
 def sort_perm(lanes, *, kind: str | None = None):

@@ -181,6 +181,33 @@ _DISPATCHERS: dict = {}
 _DISPATCHERS_LOCK = threading.Lock()
 
 
+class CollectiveFault(RuntimeError):
+    """An injected ICI fault lost a collective dispatch. Raised from
+    queued_collective_call when a fault rule drops the call; the
+    session layer falls back to gateway-local execution (Prepared.run
+    re-prepares with distsql off)."""
+
+
+# seeded rpc.context.FaultInjector aimed at the ICI dispatch path, or
+# None (the default: no fault evaluation, zero overhead). Unlike the
+# RPC plane's per-link rules, collectives have one logical "link" —
+# the (frm, to) pair install_ici_faults registered its rules under.
+_ICI_FAULTS = None
+
+
+def install_ici_faults(injector, frm="ici", to="ici") -> None:
+    """Point the collective dispatch path at a FaultInjector (tests/
+    chaos drills). Every queued_collective_call consults
+    ``injector.plan(frm, to)`` before touching the dispatcher:
+    drop -> CollectiveFault (no dispatch), delay -> sleep before
+    dispatch, dup -> dispatch twice and keep the last result (the
+    collectives are read-only reductions, so a duplicate dispatch is
+    idempotent — what at-least-once delivery would do). Pass None to
+    heal."""
+    global _ICI_FAULTS
+    _ICI_FAULTS = (injector, frm, to) if injector is not None else None
+
+
 def _dispatcher_for(mesh) -> _MeshDispatcher:
     if mesh is None:
         key: tuple = ("process",)
@@ -232,11 +259,28 @@ def queued_collective_call(jfn, metrics=None, mesh=None):
     @functools.wraps(jfn)
     def call(*args, **kwargs):
         t0 = _time.monotonic()
-        if m_depth is not None:
-            m_depth.set(disp.depth() + 1)
-        fut = disp.submit(jfn, args, kwargs, on_start)
         try:
-            return fut.result()
+            # ICI-path fault hook (install_ici_faults): evaluated
+            # per dispatch so chaos tests exercise the same queue +
+            # fallback machinery production hits on a flaky link
+            faults = _ICI_FAULTS
+            deliveries = [0.0]
+            if faults is not None:
+                inj, frm, to = faults
+                deliveries = inj.plan(frm, to)
+                if not deliveries:
+                    raise CollectiveFault(
+                        "fault injection dropped a collective "
+                        "dispatch")
+            out = None
+            for d in deliveries:
+                if d:
+                    _time.sleep(d)
+                if m_depth is not None:
+                    m_depth.set(disp.depth() + 1)
+                fut = disp.submit(jfn, args, kwargs, on_start)
+                out = fut.result()
+            return out
         finally:
             if m_calls is not None:
                 m_calls.inc()

@@ -145,6 +145,13 @@ class SessionVars:
         # lexsort fallback otherwise (tallied); off: escape hatch /
         # bench A/B lever
         "sort_normalized": "auto",   # auto | on | off
+        # out-of-core spill tier (exec/spill.py): partitioned external
+        # hash join and external merge sort when the working set
+        # exceeds sql.exec.hbm_budget_bytes. auto (default): spill
+        # only when the resident/stream-scan paths would blow the
+        # budget; on: force spill whenever the plan shape is eligible;
+        # off: escape hatch / bench A/B lever
+        "spill": "auto",             # auto | on | off
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,

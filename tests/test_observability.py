@@ -82,6 +82,11 @@ class TestBytesMonitor:
         eng.settings.set("sql.exec.hbm_budget_bytes", 1 << 10)
         s = eng.session()
         s.vars.set("distsql", "off")
+        # spill=off: the round-8 out-of-core tier would otherwise
+        # rescue this shape (external merge sort) — this test pins the
+        # quota-error path itself, which must stay clean and name the
+        # knob for every shape the spill tier does NOT take
+        s.vars.set("spill", "off")
         # ORDER BY root is not aggregate-streamable -> resident upload
         with pytest.raises(MemoryQuotaError, match="budget"):
             eng.execute("SELECT a FROM big ORDER BY a LIMIT 5", s)
