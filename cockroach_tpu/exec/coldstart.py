@@ -1,0 +1,361 @@
+"""Cold-start elimination: persistent compile cache + shape bucketing.
+
+A restarted node used to recompile every plan from scratch: the
+executable cache (`Engine._exec_cache`) is in-process, and XLA keeps
+its compiled programs in memory only. This module wires three pieces
+of cross-process warm-start state (ROADMAP item 5, the Tailwind-style
+accelerator-management frame in PAPERS.md):
+
+1. **Persistent XLA compile cache** — `init_compile_cache` points
+   `jax.experimental.compilation_cache` at an on-disk directory
+   (cluster setting `sql.exec.compile_cache.dir`), under a
+   per-backend / per-jax-version / per-schema subdirectory so stale
+   artifacts from another backend or an upgraded toolchain can never
+   be loaded — the invalidation story is "a new subdir", never a
+   cache flush. Hit/miss/compile-seconds counters come from JAX's
+   monitoring events and surface as `exec.compile.*` metrics.
+
+2. **Shape bucket ladder** — `ShapeLadder` generalizes the historical
+   "pad row counts to the next power of two" rule into an explicit
+   closed bucket set shared by resident uploads, streamed pages and
+   spill partitions. `steps_per_octave = 1` IS the historical pow2
+   ladder (bit-identical bucket choices); larger values insert
+   evenly-spaced intermediate buckets per octave, trading a bounded
+   number of extra executables for less padding waste. Every bucket
+   stays a multiple of 128 so Pallas kernel eligibility
+   (`n % 128 == 0`) is ladder-invariant.
+
+3. **Shapes journal** — statements that miss the executable cache
+   append their text to a journal next to the compile cache;
+   `Engine.prewarm` replays the top-K texts from the previous run so
+   a restarted node compiles (from the persistent cache: deserializes)
+   its hot executables before the first query arrives.
+
+Per-statement attribution: XLA backend compilation runs synchronously
+on the thread that traced the jitted call, so a thread-local tally of
+`/jax/core/compile/backend_compile_duration` events gives each
+statement its own compile-seconds split (`thread_compile_seconds`
+deltas around dispatch), surfaced in `/_status/statements` and as a
+`compile_s` trace tag.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+
+# Bump when the on-disk layout (cache subdir contract, journal or
+# autotune-table format) changes incompatibly: old state is simply
+# never looked at again.
+SCHEMA_VERSION = 1
+
+_JOURNAL_NAME = "shapes_journal.jsonl"
+_JOURNAL_MAX_BYTES = 8 << 20  # stop appending past this; bounded state
+
+_LOCK = threading.Lock()
+_ACTIVE_DIR: str | None = None
+_LISTENERS = False
+
+# process-wide tallies, bumped by the JAX monitoring listeners
+_HITS = 0
+_MISSES = 0
+_SECONDS = 0.0
+PREWARMED = 0  # statements re-prepared by Engine.prewarm
+
+_TLS = threading.local()
+
+
+def cache_hits() -> int:
+    return _HITS
+
+
+def cache_misses() -> int:
+    return _MISSES
+
+
+def compile_seconds() -> float:
+    return _SECONDS
+
+
+def _cell() -> list:
+    c = getattr(_TLS, "cell", None)
+    if c is None:
+        c = _TLS.cell = [0.0]
+    return c
+
+
+def thread_compile_seconds() -> float:
+    """Cumulative XLA backend-compile seconds billed to THIS thread.
+    Statement dispatch takes a delta around execution: compilation
+    happens synchronously on the tracing thread — and when a plan is
+    traced on a mesh-dispatcher thread instead, the dispatcher adopts
+    the submitting thread's attribution cell (attribution_cell /
+    set_attribution_cell), so the delta is still the statement's own
+    compile bill."""
+    return _cell()[0]
+
+
+def attribution_cell() -> list:
+    """The mutable cell compile seconds are billed to on this thread.
+    Cross-thread executors (parallel/distagg._MeshDispatcher) capture
+    it at submit time and adopt it on the worker around the call."""
+    return _cell()
+
+
+def set_attribution_cell(cell):
+    """Point this thread's compile billing at `cell`; returns the
+    previously active cell so callers can restore it."""
+    prev = _cell()
+    _TLS.cell = cell if cell is not None else [0.0]
+    return prev
+
+
+def _on_event(event: str, **kw) -> None:
+    global _HITS, _MISSES
+    if event == "/jax/compilation_cache/cache_hits":
+        with _LOCK:
+            _HITS += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        with _LOCK:
+            _MISSES += 1
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    global _SECONDS
+    if event == "/jax/core/compile/backend_compile_duration":
+        with _LOCK:
+            _SECONDS += duration
+        _cell()[0] += duration
+
+
+def _install_listeners() -> None:
+    global _LISTENERS
+    with _LOCK:
+        if _LISTENERS:
+            return
+        _LISTENERS = True
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        # older/newer jax without the monitoring module: the cache
+        # still works, only the counters stay at zero
+        pass
+
+
+def default_cache_root() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "cockroach_tpu")
+
+
+def resolve_cache_root(settings=None) -> str | None:
+    """Setting > environment > user default; "off" disables."""
+    configured = ""
+    if settings is not None:
+        try:
+            configured = str(settings.get("sql.exec.compile_cache.dir"))
+        except Exception:
+            configured = ""
+    if configured.lower() in ("off", "none", "disabled"):
+        return None
+    if configured:
+        return configured
+    env = os.environ.get("COCKROACH_TPU_COMPILE_CACHE_DIR", "")
+    if env.lower() in ("off", "none", "disabled"):
+        return None
+    return env or default_cache_root()
+
+
+def cache_dir(root: str) -> str:
+    """Per-backend / per-jax-version / per-schema subdirectory: XLA
+    serialized executables are not portable across backends or
+    compiler versions, so stale artifacts are isolated by path instead
+    of trusted-then-validated."""
+    import jax
+    backend = jax.default_backend()
+    return os.path.join(root, f"{backend}-jax{jax.__version__}"
+                              f"-v{SCHEMA_VERSION}")
+
+
+def init_compile_cache(settings=None) -> str | None:
+    """Point the JAX persistent compilation cache at the configured
+    directory (idempotent; re-targets on a changed setting). Returns
+    the active per-backend cache dir, or None when disabled/broken —
+    the engine runs fine either way, just cold."""
+    global _ACTIVE_DIR
+    root = resolve_cache_root(settings)
+    if root is None:
+        return None
+    try:
+        import jax
+        d = cache_dir(root)
+        with _LOCK:
+            changed = d != _ACTIVE_DIR
+        if changed:
+            os.makedirs(d, exist_ok=True)
+            # every trace is worth persisting for an interactive
+            # engine: the default 1s/min-size gates exist for training
+            # jobs whose tiny programs aren't worth the disk
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+            from jax.experimental import compilation_cache as cc
+            # drop the in-memory handle to any previously-targeted
+            # dir so the new path takes effect immediately
+            cc.compilation_cache.reset_cache()
+            with _LOCK:
+                _ACTIVE_DIR = d
+        _install_listeners()
+        with _LOCK:
+            return _ACTIVE_DIR
+    except Exception:
+        return None
+
+
+def register_metrics(metrics) -> None:
+    """exec.compile.* counters (idempotent per registry: func_counter
+    re-registration under the same name returns the existing one)."""
+    metrics.func_counter(
+        "exec.compile.cache_hit", cache_hits,
+        "XLA executables served from the persistent compile cache "
+        "(process-wide; >0 on a warm restart is the cross-process "
+        "reuse proof)")
+    metrics.func_counter(
+        "exec.compile.cache_miss", cache_misses,
+        "XLA compilations that went to the backend compiler because "
+        "the persistent cache had no entry")
+    metrics.func_counter(
+        "exec.compile.seconds", compile_seconds,
+        "cumulative seconds inside XLA backend compilation "
+        "(process-wide; near zero on a warm restart)")
+    metrics.func_counter(
+        "exec.compile.prewarmed", lambda: PREWARMED,
+        "statements re-prepared by Engine.prewarm from the shapes "
+        "journal at startup")
+
+
+# -- shape bucket ladder -----------------------------------------------------
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(int(n), 1) - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class ShapeLadder:
+    """The closed set of padded row counts every executable is
+    compiled for. `bucket(n)` maps a row count to its ladder rung;
+    `budget(max_n)` is the executable count a row sweep up to max_n
+    can possibly compile — the number the bucket-parity test gates.
+
+    steps_per_octave = 1 reproduces the historical pow2 padding
+    exactly; s > 1 inserts s evenly-spaced rungs per octave
+    (e.g. s=2: 1024, 1536, 2048, 3072, 4096, ...). min_rows and
+    steps_per_octave must be powers of two with
+    min_rows/steps_per_octave >= 128, so every rung is a multiple of
+    128 (Pallas kernel eligibility is ladder-invariant)."""
+
+    min_rows: int = 1024
+    steps_per_octave: int = 1
+
+    def __post_init__(self):
+        mr, s = self.min_rows, self.steps_per_octave
+        if mr < 128 or mr & (mr - 1):
+            raise ValueError("min_rows must be a power of two >= 128")
+        if not (1 <= s <= 8) or s & (s - 1):
+            raise ValueError(
+                "steps_per_octave must be a power of two in [1, 8]")
+        if mr // s < 128:
+            raise ValueError("min_rows/steps_per_octave must be >= 128")
+
+    def bucket(self, n: int) -> int:
+        n = max(int(n), 1)
+        if n <= self.min_rows:
+            return self.min_rows
+        p = _next_pow2(n)
+        if self.steps_per_octave == 1:
+            return p
+        half = p // 2
+        step = half // self.steps_per_octave
+        # smallest rung in (half, p] that covers n
+        return half + step * (-(-(n - half) // step))
+
+    def budget(self, max_n: int, min_n: int = 1) -> int:
+        """Distinct rungs a sweep over [min_n, max_n] can touch."""
+        lo, hi = self.bucket(min_n), self.bucket(max_n)
+        count, b = 1, lo
+        while b < hi:
+            b = self.bucket(b + 1)
+            count += 1
+        return count
+
+    def rungs(self, max_n: int, min_n: int = 1) -> list[int]:
+        out, b = [self.bucket(min_n)], self.bucket(min_n)
+        hi = self.bucket(max_n)
+        while b < hi:
+            b = self.bucket(b + 1)
+            out.append(b)
+        return out
+
+
+def ladder_from_settings(settings) -> ShapeLadder:
+    try:
+        return ShapeLadder(
+            int(settings.get("sql.exec.shape_bucket.min_rows")),
+            int(settings.get("sql.exec.shape_bucket.steps_per_octave")))
+    except Exception:
+        return ShapeLadder()
+
+
+# -- shapes journal ----------------------------------------------------------
+
+def journal_path(cache_d: str) -> str:
+    return os.path.join(cache_d, _JOURNAL_NAME)
+
+
+def journal_record(cache_d: str | None, sql_text: str,
+                   bucket: int = 0) -> None:
+    """Append an executable-cache miss to the shapes journal. Best
+    effort: journal loss only costs pre-warm coverage."""
+    if not cache_d or not sql_text:
+        return
+    try:
+        p = journal_path(cache_d)
+        try:
+            if os.path.getsize(p) > _JOURNAL_MAX_BYTES:
+                return
+        except OSError:
+            pass
+        with _LOCK:
+            with open(p, "a", encoding="utf-8") as f:
+                f.write(json.dumps({"sql": sql_text, "n": int(bucket)})
+                        + "\n")
+    except Exception:
+        pass
+
+
+def journal_top(cache_d: str | None, k: int) -> list[str]:
+    """The k statement texts with the most recorded compile misses,
+    hottest first. Corrupt lines are skipped, a missing journal is an
+    empty plan."""
+    if not cache_d or k <= 0:
+        return []
+    from collections import Counter
+    counts: Counter = Counter()
+    try:
+        with open(journal_path(cache_d), encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                    sql = rec.get("sql")
+                    if isinstance(sql, str) and sql:
+                        counts[sql] += 1
+                except Exception:
+                    continue
+    except OSError:
+        return []
+    return [sql for sql, _ in counts.most_common(k)]

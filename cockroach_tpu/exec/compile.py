@@ -68,6 +68,15 @@ class ExecParams:
     # (the engine sets it from the backend).
     pallas_groupagg: str = "off"
     pallas_interpret: bool = False
+    # Large-G kernel tile point, normally the shipped
+    # groupagg_large.py constants or the per-backend autotuned winner
+    # (ops/pallas/autotune.py). Any valid point is bit-identical —
+    # limb widths are recomputed from block_rows via the exactness
+    # bound — so these are perf-only and deliberately NOT part of the
+    # engine's executable-cache key.
+    pallas_group_tile: int = 512
+    pallas_block_rows: int = 1024
+    pallas_limb_cap: int = 22
     # Sort+Limit fusion: XLA's variadic sort costs ~20s of compile PER
     # OPERAND beyond 64K rows (measured on v5e; a 5-operand lexsort at
     # 262K compiles ~300s), so ORDER BY ... LIMIT k plans take a
@@ -532,14 +541,17 @@ AUTO_INTERPRET_STEPS = 1024
 
 
 def _large_interpret_over_budget(interpret: bool, n: int,
-                                 num_groups: int) -> bool:
+                                 num_groups: int,
+                                 group_tile: int | None = None,
+                                 block_rows: int | None = None) -> bool:
     """auto-mode cost check: would the large-G kernel's grid exceed
-    the interpret-execution step budget on this backend?"""
+    the interpret-execution step budget on this backend? Counts the
+    grid at the plan's actual (possibly autotuned) tile point."""
     if not interpret:
         return False
     from ..ops.pallas import groupagg_large as pgl
-    blk = pgl.row_block(n)
-    gtiles = -(-num_groups // pgl.GROUP_TILE)
+    blk = pgl.row_block(n, block_rows or pgl.BLOCK_ROWS)
+    gtiles = -(-num_groups // (group_tile or pgl.GROUP_TILE))
     return gtiles * (n // blk) > AUTO_INTERPRET_STEPS
 
 
@@ -574,7 +586,7 @@ def _pallas_large_ok(aggs, mode: str) -> bool:
 
 def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
                            max_group_rows: int, axis_name,
-                           interpret: bool):
+                           params: "ExecParams"):
     """Compute every aggregate's per-group (data, valid) in ONE
     large-G kernel pass — no scatters anywhere (the round-5 join-tail
     fix: q3/q18's ~6 input-width scatter passes become one-hot MXU
@@ -633,8 +645,12 @@ def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
             continue
         # exact int64 sum as w-bit i32 limbs, split OUTSIDE the
         # kernel (no 64-bit lanes in Mosaic) and recombined below —
-        # the same decomposition as agg._group_sum_i64_limbs
-        w = pgl.limb_width(n, max_group_rows)
+        # the same decomposition as agg._group_sum_i64_limbs. The
+        # width tracks the plan's (possibly autotuned) block_rows so
+        # the f32 block-partial exactness bound holds at that block
+        w = pgl.limb_width(n, max_group_rows,
+                           block_rows=params.pallas_block_rows,
+                           cap=params.pallas_limb_cap)
         bits = 64
         if a.arg_nonneg and a.arg_max_abs:
             bits = max(1, int(a.arg_max_abs).bit_length())
@@ -659,7 +675,9 @@ def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
     acc_f, acc_i = pgl.large_group_aggregate(
         gid, sel, mat, tuple(mm_cols), num_groups=num_groups,
         mat_int=mat_int, mm_ops=tuple(mm_ops_l), want_rep=want_rep,
-        interpret=interpret)
+        group_tile=params.pallas_group_tile,
+        block_rows=params.pallas_block_rows,
+        interpret=params.pallas_interpret)
 
     def ps(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
@@ -905,7 +923,9 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                 and not (mode == "auto" and b.n < AUTO_MIN_ROWS)
                 and not (mode == "auto"
                          and _large_interpret_over_budget(
-                             params.pallas_interpret, b.n, num_groups))
+                             params.pallas_interpret, b.n, num_groups,
+                             params.pallas_group_tile,
+                             params.pallas_block_rows))
                 and _pallas_large_ok([a for a, _ in aggfs], mode)):
             large = True
         overflow = jnp.bool_(False)
@@ -920,7 +940,7 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
         elif large:
             res = _pallas_large_partials(
                 aggfs, b, ctx, gid, num_groups, node.max_group_rows,
-                axis, params.pallas_interpret)
+                axis, params)
             if res is not None:
                 aggs_out, large_live, overflow = res
             else:

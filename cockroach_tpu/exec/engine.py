@@ -8,9 +8,13 @@ XLA program (cached) -> device run -> host decode.
 Executable caching: keyed by (sql, table generations) — the reference
 caches optimized memos per query fingerprint similarly (plan cache).
 Table data is uploaded to device HBM once per (table, generation) and
-reused across queries (the HBM analogue of the block cache); chunks are
-padded to power-of-two row counts so XLA recompiles only on bucket
-growth, not every ingest.
+reused across queries (the HBM analogue of the block cache); row
+counts are padded to a closed shape-bucket ladder
+(exec/coldstart.ShapeLadder, classic pow2 by default) so XLA
+recompiles only on bucket growth, not every ingest. XLA executables
+additionally persist across processes through the on-disk compile
+cache wired by exec/coldstart.init_compile_cache, so a restarted node
+serves its first query warm.
 """
 
 from __future__ import annotations
@@ -45,6 +49,7 @@ from ..storage.hlc import Clock, Timestamp
 from ..utils.metric import MetricRegistry
 from ..utils.mon import BytesMonitor, MemoryQuotaError
 from ..utils.settings import SessionVars, Settings
+from . import coldstart
 from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
 from .expr import ExprContext, compile_expr
@@ -161,6 +166,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # lock SELECTs race the resident-table map otherwise)
         self._device_lock = threading.RLock()
         self.metrics = MetricRegistry()
+        # cold-start elimination (exec/coldstart.py): persistent XLA
+        # compile cache so a restarted process deserializes instead of
+        # recompiling; None when disabled or the backend/dir refuses
+        self._compile_cache_dir = coldstart.init_compile_cache(
+            self.settings)
+        coldstart.register_metrics(self.metrics)
+        from ..ops.pallas import autotune as _tune
+        _tune.register_metrics(self.metrics)
         # device-memory accounting: resident table uploads reserve
         # against the HBM budget BEFORE device_put, so an over-budget
         # upload fails with a quota error naming the knob instead of
@@ -255,12 +268,84 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             return copy.deepcopy(hit)
         stmt = parser.parse(sql)
         if len(self._parse_cache) >= self._PARSE_CACHE_MAX:
-            self._parse_cache.clear()
-            self._plain_memo.clear()
+            # evict the oldest half (dict preserves insertion order)
+            # instead of clearing: a full clear made every hot
+            # statement reparse at once — a stampede exactly when the
+            # cache was earning its keep
+            for k in list(self._parse_cache)[
+                    :self._PARSE_CACHE_MAX // 2]:
+                del self._parse_cache[k]
+                self._plain_memo.discard(k)
         self._parse_cache[sql] = stmt
         return copy.deepcopy(stmt) if not (
             isinstance(stmt, ast.Select) and not stmt.ctes
             and not self._has_derived(stmt)) else stmt
+
+    # executable cache: same bounded-growth policy as the parse cache
+    # (long-lived multi-tenant sessions must not grow it without
+    # bound — each entry pins a compiled XLA program)
+    _EXEC_CACHE_MAX = 512
+
+    def _exec_cache_put(self, key, val) -> None:
+        if len(self._exec_cache) >= self._EXEC_CACHE_MAX:
+            for k in list(self._exec_cache)[:self._EXEC_CACHE_MAX // 2]:
+                del self._exec_cache[k]
+        self._exec_cache[key] = val
+
+    def shape_ladder(self) -> coldstart.ShapeLadder:
+        """The shape-bucket ladder every padded row count comes from:
+        resident uploads, streamed pages and spill partitions all
+        bucket through it, so a row sweep compiles at most
+        ladder.budget(max_n) executables per program shape."""
+        return coldstart.ladder_from_settings(self.settings)
+
+    def _row_bucket(self, n: int) -> int:
+        return self.shape_ladder().bucket(n)
+
+    def _autotune_mode(self, session) -> str:
+        """Pallas tile-autotune mode: session var `pallas_autotune`
+        overrides the cluster setting (ops/pallas/autotune.py)."""
+        mode = session.vars.get("pallas_autotune", None)
+        if mode is None:
+            try:
+                mode = self.settings.get("sql.exec.pallas.autotune")
+            except Exception:
+                mode = "auto"
+        mode = str(mode).lower()
+        return mode if mode in ("auto", "on", "off") else "auto"
+
+    def prewarm(self, top_k: int | None = None) -> int:
+        """Re-prepare the top-K statement texts from the shapes
+        journal of a previous run (exec/coldstart.py), so their
+        executables load from the persistent compile cache before the
+        first real query. Call after the catalog/data are loaded —
+        texts whose tables no longer exist are skipped. Returns the
+        number of statements warmed."""
+        if top_k is None:
+            try:
+                top_k = int(self.settings.get(
+                    "sql.exec.compile_cache.prewarm"))
+            except Exception:
+                top_k = 0
+        if not top_k or not self._compile_cache_dir:
+            return 0
+        warmed = 0
+        for sql in coldstart.journal_top(self._compile_cache_dir,
+                                         top_k):
+            try:
+                prep = self.prepare(sql)
+                # jax.jit compiles at first CALL, not at prepare:
+                # dispatch once (resident plans only — paged/spill
+                # dispatches run whole pipelines) so the executable
+                # is loaded now, not under the first user query
+                if prep.stream is None and prep.spill is None \
+                        and not isinstance(prep, _RerunPrepared):
+                    jax.block_until_ready(prep.dispatch())
+                warmed += 1
+                coldstart.PREWARMED += 1
+            except Exception:
+                continue
+        return warmed
 
     def execute(self, sql: str, session: Session | None = None) -> Result:
         # OLTP fast lane (exec/oltplane.py): literal-normalized shape
@@ -346,20 +431,36 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                               and _trc.current_span() is None
                               and not isinstance(stmt, ast.ShowTrace))
         shared = self._stmt_read_only(stmt, session, sql_text)
+        # per-statement compile-vs-execute split: XLA backend
+        # compilation runs synchronously on this thread, so the
+        # thread-local compile-seconds delta across dispatch is THIS
+        # statement's compile bill (exec/coldstart.py; ~0 on plan-
+        # cache hits and on warm restarts via the persistent cache)
+        c0 = coldstart.thread_compile_seconds()
+        compile_s = 0.0
+
+        def _run():
+            nonlocal compile_s
+            r = self._dispatch_locked(stmt, session, sql_text, shared)
+            compile_s = coldstart.thread_compile_seconds() - c0
+            if compile_s > 0:
+                # tagged while the statement span is still open, so
+                # EXPLAIN ANALYZE / tracez distinguish "slow because
+                # compiling" from "slow because executing"
+                self.tracer.tag(compile_s=round(compile_s, 6))
+            return r
         try:
             rec = None
             if capture:
                 with self.tracer.capture(sql_text or
                                          type(stmt).__name__) as rec:
-                    res = self._dispatch_locked(stmt, session,
-                                                sql_text, shared)
+                    res = _run()
                 if tracing:
                     session.trace.append(rec)
             else:
                 with self.tracer.span(
                         f"stmt:{type(stmt).__name__.lower()}"):
-                    res = self._dispatch_locked(stmt, session,
-                                                sql_text, shared)
+                    res = _run()
             self.metrics.counter(
                 f"sql.{type(stmt).__name__.lower()}.count",
                 "statements executed, by type").inc()
@@ -369,7 +470,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 "statement execution latency (s)").observe(dt)
             if sql_text:
                 self.sqlstats.record(sql_text, dt,
-                                     max(len(res.rows), res.row_count))
+                                     max(len(res.rows), res.row_count),
+                                     compile_s=compile_s)
             if rec is not None and slow_thresh > 0 \
                     and dt >= slow_thresh:
                 from ..utils.sqlstats import fingerprint
@@ -387,9 +489,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             self.metrics.counter("sql.failure.count",
                                  "statements that errored").inc()
             if sql_text:
-                self.sqlstats.record(sql_text,
-                                     _time.monotonic() - t0, 0,
-                                     failed=True)
+                self.sqlstats.record(
+                    sql_text, _time.monotonic() - t0, 0, failed=True,
+                    compile_s=coldstart.thread_compile_seconds() - c0)
             if session.txn is not None and not isinstance(
                     stmt, ast.BeginTxn):
                 session.txn_aborted = True
@@ -799,10 +901,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if not isinstance(sel, ast.Select):
             raise EngineError("can only EXPLAIN ANALYZE SELECT")
         import time as _time
+        c0 = coldstart.thread_compile_seconds()
         with self.tracer.capture("explain-analyze") as rec:
             t0 = _time.monotonic()
             res = self._exec_select(sel, session, sql_text)
             total_ms = (_time.monotonic() - t0) * 1e3
+        xla_ms = (coldstart.thread_compile_seconds() - c0) * 1e3
         node, _ = self._plan(sel, session)
         from ..sql.stats import estimate
         costs = estimate(node, self.catalog_view().stats)
@@ -813,6 +917,11 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             if s is not None:
                 tag_s = "".join(f" {k}={v}" for k, v in s.tags.items())
                 lines.append(f"  {name}: {s.duration_ms:.2f}ms{tag_s}")
+        if xla_ms > 0:
+            # "slow because compiling" vs "slow because executing":
+            # XLA backend-compile time inside this statement (~0 on
+            # plan-cache hits and warm persistent-cache restarts)
+            lines.append(f"  xla compile: {xla_ms:.2f}ms")
         lines.append(f"  total: {total_ms:.2f}ms, "
                      f"rows returned: {len(res.rows)}")
         lines.append("plan:")
@@ -1540,57 +1649,76 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             "sql.plan.cache.hit" if cached else "sql.plan.cache.miss",
             "compiled-plan cache lookups, by outcome").inc()
         if cached is None:
-            params = ExecParams(
-                hash_group_capacity=cap,
-                axis_name=SHARD_AXIS if decision is not None else None,
-                n_shards=(self.mesh.devices.size
-                          if decision is not None else 1),
-                pallas_groupagg=pallas,
-                pallas_interpret=jax.default_backend() != "tpu",
-                topk_sort=not no_topk,
-                sort_normalized=sortn)
-            if spill is not None and spill.kind == "join":
-                # the spill-join probes with the UNCHANGED streaming
-                # page program: each probe row lands in exactly one
-                # (partition, page) and matches only inside its
-                # partition, so the per-page partial combine algebra
-                # is exact over the partition sweep (and the partials
-                # stay mergeable across DistSQL for the same reason)
-                splan = compile_streaming(node, params, meta)
+            # feed the startup pre-warm: texts that missed here are
+            # what a restarted process should compile first
+            coldstart.journal_record(self._compile_cache_dir, sql_text)
+            # large-G kernel tile point: the per-backend tuning table
+            # (or shipped constants); perf-only, bit-identical either
+            # way, so deliberately NOT in the cache key above
+            from ..ops.pallas import autotune as _tune
+            interp = jax.default_backend() != "tpu"
+            gt, br, limb_cap = _tune.params_for(
+                jax.default_backend(), self._compile_cache_dir,
+                mode=self._autotune_mode(session), interpret=interp) \
+                if pallas != "off" else _tune.DEFAULT
+            with self.tracer.span("compile"):
+                params = ExecParams(
+                    hash_group_capacity=cap,
+                    axis_name=(SHARD_AXIS if decision is not None
+                               else None),
+                    n_shards=(self.mesh.devices.size
+                              if decision is not None else 1),
+                    pallas_groupagg=pallas,
+                    pallas_interpret=interp,
+                    pallas_group_tile=gt,
+                    pallas_block_rows=br,
+                    pallas_limb_cap=limb_cap,
+                    topk_sort=not no_topk,
+                    sort_normalized=sortn)
+                if spill is not None and spill.kind == "join":
+                    # the spill-join probes with the UNCHANGED
+                    # streaming page program: each probe row lands in
+                    # exactly one (partition, page) and matches only
+                    # inside its partition, so the per-page partial
+                    # combine algebra is exact over the partition
+                    # sweep (and the partials stay mergeable across
+                    # DistSQL for the same reason)
+                    splan = compile_streaming(node, params, meta)
 
-                def spage_fn(scans_in, ts_in, _f=splan.page_fn):
-                    return _f(RunContext(scans_in, ts_in))
-                jfn = _StreamFns(jax.jit(spage_fn),
-                                 jax.jit(splan.combine),
-                                 jax.jit(splan.final_fn))
-            elif spill is not None:
-                from .spill import compile_spill_sort
-                runf = compile_spill_sort(node, params, meta)
+                    def spage_fn(scans_in, ts_in, _f=splan.page_fn):
+                        return _f(RunContext(scans_in, ts_in))
+                    jfn = _StreamFns(jax.jit(spage_fn),
+                                     jax.jit(splan.combine),
+                                     jax.jit(splan.final_fn))
+                elif spill is not None:
+                    from .spill import compile_spill_sort
+                    runf = compile_spill_sort(node, params, meta)
 
-                def sort_fn(scans_in, ts_in, _f=runf):
-                    return _f(RunContext(scans_in, ts_in))
-                jfn = jax.jit(sort_fn)
-            elif stream is not None:
-                splan = compile_streaming(node, params, meta)
+                    def sort_fn(scans_in, ts_in, _f=runf):
+                        return _f(RunContext(scans_in, ts_in))
+                    jfn = jax.jit(sort_fn)
+                elif stream is not None:
+                    splan = compile_streaming(node, params, meta)
 
-                def page_fn(scans_in, ts_in, _f=splan.page_fn):
-                    return _f(RunContext(scans_in, ts_in))
-                jfn = _StreamFns(jax.jit(page_fn),
-                                 jax.jit(splan.combine),
-                                 jax.jit(splan.final_fn))
-            elif decision is not None:
-                runf = compile_plan(node, params, meta)
-                jfn = queued_collective_call(
-                    jax.jit(make_distributed_fn(
-                        runf, self.mesh, scan_aliases, decision)),
-                    metrics=self.metrics, mesh=self.mesh)
-            else:
-                runf = compile_plan(node, params, meta)
+                    def page_fn(scans_in, ts_in, _f=splan.page_fn):
+                        return _f(RunContext(scans_in, ts_in))
+                    jfn = _StreamFns(jax.jit(page_fn),
+                                     jax.jit(splan.combine),
+                                     jax.jit(splan.final_fn))
+                elif decision is not None:
+                    runf = compile_plan(node, params, meta)
+                    jfn = queued_collective_call(
+                        jax.jit(make_distributed_fn(
+                            runf, self.mesh, scan_aliases, decision)),
+                        metrics=self.metrics, mesh=self.mesh)
+                else:
+                    runf = compile_plan(node, params, meta)
 
-                def fn(scans_in, ts_in, nparts, pid):
-                    return runf(RunContext(scans_in, ts_in, nparts, pid))
-                jfn = jax.jit(fn)
-            self._exec_cache[key] = (jfn, meta)
+                    def fn(scans_in, ts_in, nparts, pid):
+                        return runf(
+                            RunContext(scans_in, ts_in, nparts, pid))
+                    jfn = jax.jit(fn)
+            self._exec_cache_put(key, (jfn, meta))
         else:
             jfn, meta = cached
         gens = tuple(sorted(gens))

@@ -29,7 +29,7 @@ EPOCH_DT = datetime.datetime(1970, 1, 1)
 from .session import (SENTINEL_COLUMNS, CompactOverflow, EngineError,
                       HashCapacityExceeded, Prepared, TopKInexact,
                       Result, Session)
-from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _has_join, _host_sort, _next_pow2, _pad)
+from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _has_join, _host_sort, _pad)
 from .stream import PageSource
 from .stream import prefetch as stream_prefetch
 
@@ -119,7 +119,7 @@ class ScanPlaneMixin:
                 def fn(scans_in, ts_in, np_, pid_):
                     return runf(RunContext(scans_in, ts_in, np_, pid_))
                 jfn = jax.jit(fn)
-            self._exec_cache[key] = (jfn, meta)
+            self._exec_cache_put(key, (jfn, meta))
         else:
             jfn, meta = cached
 
@@ -183,7 +183,7 @@ class ScanPlaneMixin:
         # compiles to ~12GB of HLO temps), so a table that "fits" can
         # still OOM at compile time without this term.
         n_aggs = _count_aggs(node)
-        padded = max(_next_pow2(max(td.row_count, 1)), 1024)
+        padded = self._row_bucket(td.row_count)
         temp_bytes = 16 * n_aggs * padded
         # the resident upload this decision weighs would narrow its
         # int32-provable columns UNLESS the scan feeds a join
@@ -201,14 +201,14 @@ class ScanPlaneMixin:
         # upstream with a clean quota error rather than silently here.
         return (alias, tname, self._page_rows(session))
 
-    @staticmethod
-    def _page_rows(session: Session) -> int:
-        """Session page size rounded UP to a power of two: page shapes
-        feed the same _next_pow2-padded programs as resident uploads,
-        so a non-pow2 SET streaming_page_rows would give the tail page
+    def _page_rows(self, session: Session) -> int:
+        """Session page size rounded UP to a shape-ladder bucket: page
+        shapes feed the same bucket-padded programs as resident
+        uploads and spill partitions (exec/coldstart.ShapeLadder), so
+        an off-ladder SET streaming_page_rows would give the tail page
         a shape no other page shares and recompile per page."""
-        return max(1024, _next_pow2(
-            int(session.vars.get("streaming_page_rows", 1 << 21))))
+        return self._row_bucket(
+            int(session.vars.get("streaming_page_rows", 1 << 21)))
 
     # -- out-of-core spill tier (exec/spill.py) -----------------------------
     def _spill_decision(self, node, scan_aliases: dict, scan_cols: dict,
@@ -310,7 +310,7 @@ class ScanPlaneMixin:
         if not joins:
             return None
         n_aggs = _count_aggs(node)
-        page_padded = max(_next_pow2(max(page_rows, 1)), 1024)
+        page_padded = self._row_bucket(page_rows)
         temp_bytes = 2 * 16 * n_aggs * page_padded
         page_bytes = 2 * self._page_device_bytes(
             ptd, scan_cols.get(alias), page_rows)  # depth-2 prefetch
@@ -386,7 +386,7 @@ class ScanPlaneMixin:
                 return None
         cols = scan_cols.get(alias)
         if mode == "auto":
-            padded = max(_next_pow2(max(td.row_count, 1)), 1024)
+            padded = self._row_bucket(td.row_count)
             fits = (self._table_device_bytes(
                 td, cols, narrow=self.narrow32_cols(tname, cols))
                 + 24 * padded <= budget)
@@ -444,7 +444,7 @@ class ScanPlaneMixin:
         Columns in ``narrow`` upload as int32 (narrow32_cols), so they
         charge 4+1 bytes per row, not the stored 8+1."""
         n = td.row_count
-        padded = max(_next_pow2(max(n, 1)), 1024)
+        padded = self._row_bucket(n)
         total = 16 * padded  # the two MVCC int64 columns
         for col in td.schema.columns:
             if cols is not None and col.name not in cols:
@@ -605,7 +605,7 @@ class ScanPlaneMixin:
         cols: dict[str, np.ndarray] = {}
         valid: dict[str, np.ndarray] = {}
         n = sum(c.n for c in chunks)
-        padded = max(_next_pow2(max(n, 1)), 1024)
+        padded = self._row_bucket(n)
         for col in td.schema.columns:
             cn = col.name
             if prune is not None and cn not in prune:

@@ -60,7 +60,7 @@ from ..ops.join import radix_partition_ids
 from ..sql import plan as P
 from .compile import (ExecError, RunContext, _normalized_lanes,
                       _sort_rank_tables, compile_plan)
-from .stmtutil import _decode_column, _next_pow2
+from .stmtutil import _decode_column
 from .stream import prefetch as stream_prefetch
 
 # scanplane._stream_pages registers this histogram with the same help
@@ -190,10 +190,13 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
         *_host_key_cols(bsrc, sp.build_keys), sp.nparts)
     pidx = _partition_indices(ppids, sp.nparts)
     bidx = _partition_indices(bpids, sp.nparts)
-    # ONE shared pow2 shape for every build partition: jit retraces
-    # per input shape, so a shared pad means one XLA program serves
-    # the whole sweep (and steady-state re-runs reuse it)
-    bpad = max(1024, _next_pow2(max(max(len(ix) for ix in bidx), 1)))
+    # ONE shared shape-ladder bucket for every build partition: jit
+    # retraces per input shape, so a shared pad means one XLA program
+    # serves the whole sweep (and steady-state re-runs reuse it); the
+    # bucket comes from the same ladder as resident uploads and
+    # streamed pages (exec/coldstart.ShapeLadder), so spill programs
+    # share executables with them across processes too
+    bpad = engine._row_bucket(max(len(ix) for ix in bidx))
     bbytes = _batch_bytes(bsrc, bpad)
 
     busy = [0.0]

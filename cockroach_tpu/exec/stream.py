@@ -427,8 +427,10 @@ class PageSource:
     def gather_batch(self, idx: np.ndarray, n_pad: int):
         """One device batch of exactly ``n_pad`` rows holding the rows
         at ascending global indices ``idx`` (a spill-join build
-        partition: every partition pads to ONE shared pow2 shape so a
-        single XLA program serves the whole partition sweep)."""
+        partition: every partition pads to ONE shared shape-ladder
+        bucket — exec/coldstart.ShapeLadder, the same ladder resident
+        uploads and streamed pages use — so a single XLA program
+        serves the whole partition sweep)."""
         bufs = {cn: np.empty(n_pad, dtype=dt)
                 for cn, dt in self.dtypes.items()}
         bufs["_mvcc_ts"] = np.empty(n_pad, dtype=np.int64)

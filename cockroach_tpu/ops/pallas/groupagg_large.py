@@ -68,16 +68,20 @@ def row_block(n: int, block_rows: int = BLOCK_ROWS) -> int:
 
 
 def limb_width(n: int, max_group_rows: int,
-               block_rows: int = BLOCK_ROWS) -> int:
+               block_rows: int = BLOCK_ROWS, cap: int = 22) -> int:
     """The widest limb w such that BOTH accumulations stay exact:
     the f32 matmul block partial (blk*(2^w-1) < 2^24) and the
     per-group i32 running sum (maxg*(2^w-1) < 2^31). Mirrors
-    agg._group_sum_i64_limbs' bound, tightened by the block term."""
+    agg._group_sum_i64_limbs' bound, tightened by the block term.
+    `cap` (autotuned, ops/pallas/autotune.py) may only narrow the
+    width below the exactness bound — results stay bit-identical for
+    any cap in [1, 22], a narrower cap just trades more limb columns
+    for a denser matmul."""
     blk = row_block(n, block_rows)
     maxg = max_group_rows if max_group_rows and 0 < max_group_rows <= n else n
     maxg = max(1, maxg)
     w = int(math.floor(math.log2((2 ** 31 - 1) / maxg + 1)))
-    w = min(w, 24 - int(math.log2(blk)), 22)
+    w = min(w, 24 - int(math.log2(blk)), 22, cap)
     return max(1, w)
 
 

@@ -155,15 +155,22 @@ class _MeshDispatcher:
     def submit(self, fn, args, kwargs, on_start=None):
         import concurrent.futures
         import time as _time
+        from ..exec import coldstart
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        # carry the submitting statement's compile-attribution cell:
+        # tracing (and hence XLA backend compilation) happens on the
+        # dispatcher thread, but the compile bill belongs to the
+        # statement that enqueued the call (exec/coldstart.py)
         self._q.put((fn, args, kwargs, fut, _time.monotonic(),
-                     on_start))
+                     on_start, coldstart.attribution_cell()))
         return fut
 
     def _loop(self):
         import time as _time
+        from ..exec import coldstart
         while True:
-            fn, args, kwargs, fut, t_enq, on_start = self._q.get()
+            fn, args, kwargs, fut, t_enq, on_start, cell = \
+                self._q.get()
             if on_start is not None:
                 try:
                     on_start(_time.monotonic() - t_enq)
@@ -171,10 +178,13 @@ class _MeshDispatcher:
                     pass
             if not fut.set_running_or_notify_cancel():
                 continue
+            prev = coldstart.set_attribution_cell(cell)
             try:
                 fut.set_result(fn(*args, **kwargs))
             except BaseException as e:
                 fut.set_exception(e)
+            finally:
+                coldstart.set_attribution_cell(prev)
 
 
 _DISPATCHERS: dict = {}

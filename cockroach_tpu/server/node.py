@@ -171,6 +171,13 @@ class Node:
                         "count": s.count,
                         "mean_latency_s": s.mean_latency_s,
                         "max_latency_s": s.max_latency_s,
+                        # compile-vs-execute split (exec/coldstart.py
+                        # per-thread XLA compile attribution): high
+                        # mean_compile_s with low mean_exec_s means
+                        # the fix is cache/prewarm, not the plan
+                        "total_compile_s": s.total_compile_s,
+                        "mean_compile_s": s.mean_compile_s,
+                        "mean_exec_s": s.mean_exec_s,
                         "total_rows": s.total_rows,
                         "failures": s.failures,
                     } for s in node.engine.sqlstats.all()]}).encode()

@@ -111,6 +111,31 @@ def _register_builtins(s: Settings):
                "statements slower than this many seconds keep their "
                "trace recording in the /debug/tracez ring buffer "
                "(0 disables; sql.trace.txn.enable_threshold analogue)")
+    # cold-start elimination (exec/coldstart.py): persistent XLA
+    # compile cache + shape bucket ladder + Pallas tile autotune
+    s.register("sql.exec.compile_cache.dir", "", str,
+               "root of the persistent XLA compile cache ('' = "
+               "$COCKROACH_TPU_COMPILE_CACHE_DIR or "
+               "~/.cache/cockroach_tpu; 'off' disables). Artifacts "
+               "live in a per-backend/per-jax-version subdir, so "
+               "upgrades invalidate by path, never by flush")
+    s.register("sql.exec.compile_cache.prewarm", 0, int,
+               "top-K statement texts from the previous run's shapes "
+               "journal that Engine.prewarm() re-prepares at startup "
+               "(0 disables)")
+    s.register("sql.exec.shape_bucket.min_rows", 1024, int,
+               "smallest row bucket executables are compiled for",
+               _pow2)
+    s.register("sql.exec.shape_bucket.steps_per_octave", 1, int,
+               "row buckets per doubling of the shape ladder "
+               "(1 = classic pow2 padding; 2/4/8 insert intermediate "
+               "buckets: less padding waste, more executables)",
+               _pow2)
+    s.register("sql.exec.pallas.autotune", "auto", str,
+               "Pallas tile autotune mode: auto = consult the "
+               "persisted tuning table, tune on first use on real "
+               "TPU; on = force tuning even off-TPU (test hook); "
+               "off = shipped constants")
 
 
 def _meta_page_rows() -> int:
@@ -137,6 +162,13 @@ class SessionVars:
         # (approximate vs the XLA path's f64); off: escape hatch /
         # bench A/B lever
         "pallas_groupagg": "auto",   # auto | on | off
+        # Pallas tile autotune (ops/pallas/autotune.py). None defers
+        # to the cluster setting sql.exec.pallas.autotune; auto: use
+        # the persisted per-backend tuning table when present (shipped
+        # constants otherwise); on: run a timed candidate sweep on
+        # first use; off: pin the shipped constants. Tile points are
+        # perf-only — results are bit-identical across the grid.
+        "pallas_autotune": None,     # None | auto | on | off
         # normalized sort keys (ops/sortkey.py): pack the whole
         # ORDER BY / window / distinct key list into uint64 lanes and
         # sort with one stable argsort per lane instead of the

@@ -34,10 +34,24 @@ class StmtStats:
     max_latency_s: float = 0.0
     total_rows: int = 0
     failures: int = 0
+    # seconds of XLA backend compilation attributed to this
+    # fingerprint's executions (exec/coldstart.py thread-local
+    # accounting): the compile-vs-execute split that tells "slow
+    # because compiling" from "slow because executing"
+    total_compile_s: float = 0.0
 
     @property
     def mean_latency_s(self) -> float:
         return self.total_latency_s / self.count if self.count else 0.0
+
+    @property
+    def mean_compile_s(self) -> float:
+        return self.total_compile_s / self.count if self.count else 0.0
+
+    @property
+    def mean_exec_s(self) -> float:
+        """Mean latency net of compilation — steady-state cost."""
+        return max(0.0, self.mean_latency_s - self.mean_compile_s)
 
 
 class StatsRegistry:
@@ -46,11 +60,12 @@ class StatsRegistry:
         self._stats: dict[str, StmtStats] = {}
 
     def record(self, sql: str, latency_s: float, rows: int,
-               failed: bool = False) -> None:
-        self.record_fp(fingerprint(sql), latency_s, rows, failed)
+               failed: bool = False, compile_s: float = 0.0) -> None:
+        self.record_fp(fingerprint(sql), latency_s, rows, failed,
+                       compile_s)
 
     def record_fp(self, fp: str, latency_s: float, rows: int,
-                  failed: bool = False) -> None:
+                  failed: bool = False, compile_s: float = 0.0) -> None:
         """Record against a caller-computed fingerprint (the OLTP lane
         already normalized the literals out of its shape key)."""
         with self._mu:
@@ -61,6 +76,7 @@ class StatsRegistry:
             st.total_latency_s += latency_s
             st.max_latency_s = max(st.max_latency_s, latency_s)
             st.total_rows += rows
+            st.total_compile_s += compile_s
             if failed:
                 st.failures += 1
 

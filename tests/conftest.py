@@ -21,6 +21,17 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+import tempfile
+
+# Hermetic cold-start state: engines wire the persistent XLA compile
+# cache + autotune table to this root (exec/coldstart.py). Default to
+# a throwaway session dir BEFORE jax/engine imports so even engines
+# built at collection time never touch the user's real cache root;
+# the autouse fixture below re-points each test at its own tmpdir.
+_SESSION_CACHE = tempfile.mkdtemp(prefix="cockroach-tpu-test-cache-")
+os.environ.setdefault("COCKROACH_TPU_COMPILE_CACHE_DIR",
+                      _SESSION_CACHE)
+
 import jax  # noqa: E402
 
 # The axon TPU plugin (sitecustomize) force-sets jax_platforms to
@@ -29,9 +40,27 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import pytest  # noqa: E402
+
 
 def pytest_configure(config):
     # tier-1 runs with -m 'not slow'; register the marker so the
     # deselection is declared, not a typo (PytestUnknownMarkWarning)
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_coldstart(tmp_path, monkeypatch):
+    """Route each test's compile cache + tuning table + shapes journal
+    into its own tmpdir, and assert nothing leaked into the user's
+    default cache root (the on-disk state must be opt-in for tests)."""
+    from cockroach_tpu.exec import coldstart
+    monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR",
+                       str(tmp_path / "coldstate"))
+    default_root = coldstart.default_cache_root()
+    existed_before = os.path.exists(default_root)
+    yield
+    assert existed_before or not os.path.exists(default_root), (
+        "persistent compile cache escaped the test tmpdir into "
+        + default_root)

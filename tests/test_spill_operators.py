@@ -254,11 +254,11 @@ class TestPageRowsPow2:
         eng, sess = ejs
         s = eng.session()
         s.vars.set("streaming_page_rows", 3000)
-        assert Engine._page_rows(s) == 4096
+        assert eng._page_rows(s) == 4096
         s.vars.set("streaming_page_rows", 4096)
-        assert Engine._page_rows(s) == 4096
+        assert eng._page_rows(s) == 4096
         s.vars.set("streaming_page_rows", 100)
-        assert Engine._page_rows(s) == 1024
+        assert eng._page_rows(s) == 1024
 
 
 class TestIciFaultHooks:
