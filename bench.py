@@ -72,6 +72,18 @@ def metric_deltas(before: dict, after: dict) -> dict:
             d = av - (bv if isinstance(bv, (int, float)) else 0)
             if d:
                 out[k] = round(d, 6) if isinstance(d, float) else d
+    # device-pressure columns (utils/devstats.py), always present so
+    # rounds can attribute a regression to device time / HBM pressure
+    # without a profiler: the query's device-execute seconds (delta)
+    # and the process HBM high-water mark (absolute, a ratchet — the
+    # delta would usually be 0)
+    du = after.get("exec.device.util.seconds")
+    if isinstance(du, (int, float)):
+        out["device_time_s"] = round(
+            du - (before.get("exec.device.util.seconds", 0) or 0), 6)
+    wm = after.get("exec.device.hbm.watermark")
+    if isinstance(wm, (int, float)):
+        out["hbm_watermark_bytes"] = int(wm)
     return out
 
 

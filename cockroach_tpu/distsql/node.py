@@ -794,10 +794,17 @@ class Gateway:
         rec(plan_node, False)
         return bool(found)
 
-    def run(self, sql: str, chunk_rows: int = 65536):
+    def run(self, sql: str, chunk_rows: int = 65536, session=None):
         """Plan and run, degrading gracefully when a data node dies
         mid-flow (read-only statements are safely retryable; the
         reference re-plans around dead nodes, distsql_running.go:375).
+
+        With a `session` whose `SET tracing` mode is on|cluster, the
+        statement runs under a capture appended to `session.trace`
+        (rendered by SHOW TRACE FOR SESSION); mode "cluster" sets the
+        recording-request bit so remote flows and every RPC they
+        touch record and ship node-tagged spans back.
+
         Cluster mode only — span partitioning can reassign the dead
         node's ranges to surviving leaseholders, whereas node-local
         shards die with their node. Two rungs down:
@@ -823,6 +830,15 @@ class Gateway:
             return out or list(self.nodes)
 
         from ..utils import log
+        if session is not None:
+            tmode = str(session.vars.get("tracing", "off")).lower()
+            if tmode in ("on", "cluster"):
+                with tracing.capture(
+                        sql, gateway=self.own.node_id,
+                        record_request=tmode == "cluster") as rec:
+                    res = self.run(sql, chunk_rows)
+                session.trace.append(rec)
+                return res
         stripped = sql.lstrip()
         if stripped[:15].upper() == "EXPLAIN ANALYZE":
             return self.explain_analyze(stripped[15:].lstrip(),
@@ -983,7 +999,10 @@ class Gateway:
         # SetupFlow to each participant; stream i <- node i
         self._count("distsql.flows.launched",
                     "distributed flows fanned out by this gateway")
-        trace = tracing.current_span() is not None
+        # remote flows record only when the statement's capture asked
+        # for remote recordings (SET tracing = cluster / EXPLAIN
+        # ANALYZE); a gateway-local recording keeps them dark
+        trace = tracing.recording_requested()
         registry = self.own.registry
         inboxes = []
         for i, nid in enumerate(nodes):
@@ -1039,7 +1058,7 @@ class Gateway:
                     "not scheduling flow")
         self._count("distsql.flows.launched",
                     "distributed flows fanned out by this gateway")
-        trace = tracing.current_span() is not None
+        trace = tracing.recording_requested()
         registry = self.own.registry
         inboxes = []
         for nid in nodes:

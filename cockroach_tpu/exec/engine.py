@@ -231,6 +231,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             lambda: _sk.LANES.value(),
             "uint64 lanes sorted by normalized-key sorts at trace "
             "time (lanes per sort ~ packed key-list width / 64)")
+        # device-utilization plane (utils/devstats.py): actual HBM in
+        # use + watermark, per-statement device-execute seconds, and
+        # dispatcher queue pressure as exec.device.* — the maintenance
+        # loop snapshots these into server/ts.py for /ts/query history
+        from ..utils.devstats import DeviceStats
+        self.devstats = DeviceStats(hbm=self.hbm).register(self.metrics)
         # /debug/tracez ring buffer: recordings of statements slower
         # than sql.trace.slow_statement.threshold (0 disables)
         from collections import deque as _deque
@@ -416,7 +422,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         t0 = _time.monotonic()
         prio = session.vars.get("admission_priority", "normal")
         self.admission.acquire(priority=prio)
-        tracing = session.vars.get("tracing", "off") == "on" \
+        # SET tracing = on|cluster (pgwire trace control): "on"
+        # records gateway-local; "cluster" additionally sets the
+        # recording-request bit so every RPC / DistSQL flow the
+        # statement touches records remotely and ships spans back
+        tmode = str(session.vars.get("tracing", "off")).lower()
+        tracing = tmode in ("on", "cluster") \
             and not isinstance(stmt, ast.ShowTrace)
         try:
             slow_thresh = float(self.settings.get(
@@ -452,8 +463,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         try:
             rec = None
             if capture:
-                with self.tracer.capture(sql_text or
-                                         type(stmt).__name__) as rec:
+                # session tracing "on" keeps the recording gateway-
+                # local (remote nodes stay dark); "cluster" and the
+                # implicit captures (slow sampling) request remote
+                # recordings too
+                rec_req = tmode == "cluster" if tracing else True
+                with self.tracer.capture(
+                        sql_text or type(stmt).__name__,
+                        record_request=rec_req) as rec:
                     res = _run()
                 if tracing:
                     session.trace.append(rec)
@@ -472,6 +489,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 self.sqlstats.record(sql_text, dt,
                                      max(len(res.rows), res.row_count),
                                      compile_s=compile_s)
+            # device-execute seconds: the statement's wall time net of
+            # its XLA compile bill (utils/devstats.py)
+            self.devstats.note_execute(max(0.0, dt - compile_s))
             if rec is not None and slow_thresh > 0 \
                     and dt >= slow_thresh:
                 from ..utils.sqlstats import fingerprint

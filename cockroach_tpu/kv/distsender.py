@@ -268,6 +268,8 @@ class DistSender:
                 last_err = RangeKeyMismatchError()
                 continue
             if not rep.holds_lease():
+                tracing.event("lease-check", range_id=desc.range_id,
+                              node=nid, ok=False)
                 lh = self.cluster.ensure_lease(desc.range_id)
                 if lh is not None and lh != nid:
                     last_err = NotLeaseholderError(hint=lh)
@@ -291,6 +293,8 @@ class DistSender:
             entry.leaseholder = (rep.node_id
                                  if not hasattr(rep, "store")
                                  else rep.store.node_id)
+            tracing.event("lease-check", range_id=desc.range_id,
+                          node=entry.leaseholder, ok=True)
             return self._execute(rep, op, ts)
         raise last_err
 

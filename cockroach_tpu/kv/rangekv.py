@@ -38,6 +38,7 @@ from typing import Optional
 from ..kvserver.store import _dec_ts, _enc_ts, raise_op_error
 from ..storage.hlc import Timestamp
 from ..storage.mvcc import MVCCValue, TxnMeta, TxnStatus
+from ..utils import tracing
 from .concurrency import (SpanLatchManager, TimestampCache, TxnRecord,
                           TxnRegistry)
 from .txn import KVStore
@@ -158,6 +159,8 @@ class RangeMVCC:
               "commit": status == TxnStatus.COMMITTED}
         if commit_ts is not None:
             op["commit_ts"] = _enc_ts(commit_ts)
+        tracing.event("resolve-intent",
+                      committed=status == TxnStatus.COMMITTED)
         try:
             self._propose(key, op)
         except (KeyError, RuntimeError):

@@ -307,6 +307,10 @@ class NetCluster(Cluster):
         self.rpc.register(node_id, self._dispatch)
         self._join_seeds = dict(join or {})
         self._hb_inflight = threading.Event()
+        # cluster-wide status fan-out (server/node.py): named payload
+        # providers served to peers over the "status" RPC method
+        # (register_status_sources); empty dict = nothing to serve
+        self.status_handlers: dict[str, object] = {}
         # mixed-version gating (kvserver/clusterversion.py): `binary`
         # overridable in tests to simulate an old/new binary
         self.version = ClusterVersion()
@@ -659,7 +663,10 @@ class NetCluster(Cluster):
         tc = msg.get("tc")
         rec = None
         try:
-            if tc:
+            # record only when the caller set the per-statement
+            # recording-request bit (SET tracing = cluster / EXPLAIN
+            # ANALYZE); a bare context correlates but stays dark here
+            if tc and tc.get("rec"):
                 with tracing.capture(f"rpc:{msg['m']}", remote_ctx=tc,
                                      node=self.node_id) as rec:
                     result = self._serve(frm, msg["m"], msg["a"])
@@ -696,6 +703,13 @@ class NetCluster(Cluster):
             return True
         if method == "replicate_me":
             return self.replicate_queue_scan()
+        if method == "status":
+            h = self.status_handlers.get(args.get("what"))
+            if h is None:
+                raise RuntimeError(
+                    f"no status source {args.get('what')!r} on "
+                    f"n{self.node_id}")
+            return h()
         raise RuntimeError(f"unknown method {method!r}")
 
     def _serve_join(self, args: dict):
@@ -737,7 +751,11 @@ class NetCluster(Cluster):
         if not self._lease_valid(rep):
             lh = self._try_local_lease(rid)
             if lh != self.node_id:
+                tracing.event("lease-check", range_id=rid, ok=False,
+                              holder=lh or rep.lease.holder)
                 raise NotLeaseholderError(rid, lh or rep.lease.holder)
+        tracing.event("lease-check", range_id=rid, ok=True,
+                      holder=self.node_id)
         return self._local_propose(rep, cmd)
 
     def _serve_read(self, args: dict):
@@ -746,7 +764,11 @@ class NetCluster(Cluster):
             rep = self.store.replicas.get(rid)
         if rep is None or not self._lease_valid(rep):
             hint = rep.lease.holder if rep is not None else None
+            tracing.event("lease-check", range_id=rid, ok=False,
+                          holder=hint)
             raise NotLeaseholderError(rid, hint)
+        tracing.event("lease-check", range_id=rid, ok=True,
+                      holder=self.node_id)
         txn = (TxnMeta.from_json(args["txn"].encode())
                if args.get("txn") else None)
         op = args["op"]
@@ -856,6 +878,26 @@ class NetCluster(Cluster):
                 exp >= self.clock.now().to_int()
         return self.liveness.is_live(holder) and \
             self.liveness.epoch_of(holder) == lease_epoch
+
+    def live_peers(self) -> list[int]:
+        """Peers worth an RPC right now: every connected peer whose
+        replicated liveness record is unexpired at this clock (gossip
+        liveness covers bring-up, before the replicated plane runs).
+        Gates the status fan-out so a scrape never waits a timeout on
+        a node the cluster already believes dead."""
+        now = self.clock.now().to_int()
+        out = []
+        with self._mu:
+            peers = list(self._peers)
+            recs = dict(self.store.repl_liveness)
+        for nid in peers:
+            rec = recs.get(nid)
+            if rec is not None:
+                if rec[1] >= now:
+                    out.append(nid)
+            elif self.liveness.is_live(nid):
+                out.append(nid)
+        return out
 
     def _lease_valid(self, rep) -> bool:
         """Serving-side check: beyond holds_lease()'s gossip view, the
@@ -987,6 +1029,12 @@ class NetCluster(Cluster):
             out["result"] = result
             ev.set()
 
+        # raft lifecycle span events, proposer-side (apply itself
+        # runs on the pump thread, so the commit is observed here —
+        # the waiter callback fires at apply time)
+        tracing.event("raft-propose", range_id=rep.desc.range_id,
+                      kind=str(cmd.get("kind", "batch")),
+                      node=self.node_id)
         reached = False
         deadline = time.time() + timeout
         while time.time() < deadline:
@@ -996,6 +1044,9 @@ class NetCluster(Cluster):
                 reached = True
                 if ev.wait(min(3.0, max(deadline - time.time(),
                                         0.05))):
+                    tracing.event("raft-apply",
+                                  range_id=rep.desc.range_id,
+                                  node=self.node_id)
                     return out["result"]
             else:
                 time.sleep(self.PUMP_INTERVAL * 4)

@@ -28,6 +28,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional
 
+from cockroach_tpu.utils import tracing
+
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
@@ -216,7 +218,15 @@ class RaftNode:
         idx = self.log.last_index() + 1
         self.log.append([Entry(self.term, idx, data)])
         self.match_index[self.id] = idx
+        # no-op unless the proposing thread holds a recording (SET
+        # tracing = cluster / EXPLAIN ANALYZE of a DML)
+        tracing.event("raft-log-append", index=idx, term=self.term)
+        pre = self.commit
         self._maybe_commit()
+        if self.commit > pre:
+            # single-replica groups commit on append
+            tracing.event("raft-commit", index=self.commit,
+                          term=self.term)
         self._broadcast_append()
         return idx
 

@@ -32,6 +32,7 @@ from cockroach_tpu.kvserver.raft import RaftNode, Snapshot
 from cockroach_tpu.storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from cockroach_tpu.storage.keys import EngineKey
 from cockroach_tpu.storage.mvcc import MVCC, TxnMeta, _dec_value
+from cockroach_tpu.utils import tracing
 
 
 @dataclass
@@ -245,11 +246,19 @@ class Replica:
                 cmd["closed"] = _enc_ts(target)
         if done is not None:
             self._waiters[cmd["_id"]] = done
+        # span events fire on the PROPOSER's thread (the one holding
+        # the recording); apply runs on the raft pump thread, so the
+        # proposer-side waiter observes commit (netcluster
+        # _local_propose emits raft-apply there)
         if self.raft.is_leader():
+            tracing.event("raft-append", range_id=self.desc.range_id,
+                          leader=self.store.node_id)
             self.raft.propose(json.dumps(cmd).encode())
             return True
         leader = self.raft.leader_id
         if leader is not None and leader != self.store.node_id:
+            tracing.event("raft-forward", range_id=self.desc.range_id,
+                          leader=leader)
             self.store.transport.send(
                 self.store.node_id, leader,
                 (self.desc.range_id, ("prop", cmd)))

@@ -55,6 +55,134 @@ class NodeConfig:
     certs_dir: str | None = None
 
 
+# -- cluster-wide status fan-out ----------------------------------------
+# The payload builders are module-level so ANY NetCluster participant
+# can serve them over the fabric's "status" RPC — including engines
+# embedded in tests or tools that never construct a Node. A Node wires
+# its own engine in via enable_cluster_status() below.
+
+def _tracez_payload(engine) -> dict:
+    """The /debug/tracez body: the slow-statement ring (engine
+    docstring; span in wire format)."""
+    return {"traces": list(engine.slow_traces)}
+
+
+def _statements_payload(engine) -> dict:
+    """The /_status/statements body. Carries the raw totals and the
+    log2 latency-bucket array alongside the derived means/quantiles,
+    so a fan-out merge can recombine fingerprints exactly instead of
+    averaging averages."""
+    return {"statements": [{
+        "fingerprint": s.fingerprint,
+        "count": s.count,
+        "total_latency_s": s.total_latency_s,
+        "mean_latency_s": s.mean_latency_s,
+        "max_latency_s": s.max_latency_s,
+        # p50/p95/p99 from the log2-bucketed latency distribution
+        # (utils/sqlstats.py; same observations as the means)
+        "p50_latency_s": s.p50_latency_s,
+        "p95_latency_s": s.p95_latency_s,
+        "p99_latency_s": s.p99_latency_s,
+        "latency_buckets": list(s.latency_buckets),
+        # compile-vs-execute split (exec/coldstart.py per-thread XLA
+        # compile attribution): high mean_compile_s with low
+        # mean_exec_s means the fix is cache/prewarm, not the plan
+        "total_compile_s": s.total_compile_s,
+        "mean_compile_s": s.mean_compile_s,
+        "mean_exec_s": s.mean_exec_s,
+        "total_rows": s.total_rows,
+        "failures": s.failures,
+    } for s in engine.sqlstats.all()]}
+
+
+def register_status_sources(cluster, engine) -> None:
+    """Expose this engine's tracez/statements payloads to peers over
+    the NetCluster "status" RPC (the server side of ?cluster=1)."""
+    cluster.status_handlers["tracez"] = \
+        lambda: _tracez_payload(engine)
+    cluster.status_handlers["statements"] = \
+        lambda: _statements_payload(engine)
+
+
+def _fanout_status(cluster, what: str,
+                   timeout: float) -> tuple[dict, bool]:
+    """Collect `what` payloads from every live peer. Liveness-gated
+    (a node the cluster already believes dead costs nothing), each
+    peer bounded by `timeout`; any skipped/failed peer marks the
+    result partial instead of failing the scrape."""
+    results: dict[int, dict] = {}
+    partial = False
+    live = set(cluster.live_peers())
+    with cluster._mu:
+        known = sorted(cluster._peers)
+    for nid in known:
+        if nid == cluster.node_id:
+            continue
+        if nid not in live:
+            partial = True
+            continue
+        try:
+            results[nid] = cluster.call(nid, "status",
+                                        {"what": what},
+                                        timeout=timeout)
+        except Exception:
+            partial = True
+    return results, partial
+
+
+def _merge_tracez(own_id: int, local: dict, remote: dict,
+                  partial: bool) -> dict:
+    traces = [dict(t, node=own_id) for t in local["traces"]]
+    for nid, payload in sorted(remote.items()):
+        traces.extend(dict(t, node=nid)
+                      for t in payload.get("traces", []))
+    return {"traces": traces, "cluster": True, "partial": partial,
+            "nodes": sorted([own_id, *remote])}
+
+
+def _merge_statements(own_id: int, local: dict, remote: dict,
+                      partial: bool) -> dict:
+    """Per-fingerprint exact merge: sum the totals and bucket arrays,
+    take the max of maxes, then re-derive means and quantiles from
+    the combined values."""
+    from ..utils.metric import buckets_quantile
+    merged: dict[str, dict] = {}
+
+    def fold(payload):
+        for s in payload.get("statements", []):
+            m = merged.get(s["fingerprint"])
+            if m is None:
+                merged[s["fingerprint"]] = dict(s)
+                continue
+            m["count"] += s["count"]
+            m["total_latency_s"] += s["total_latency_s"]
+            m["total_compile_s"] += s["total_compile_s"]
+            m["total_rows"] += s["total_rows"]
+            m["failures"] += s["failures"]
+            m["max_latency_s"] = max(m["max_latency_s"],
+                                     s["max_latency_s"])
+            m["latency_buckets"] = [
+                a + b for a, b in zip(m["latency_buckets"],
+                                      s["latency_buckets"])]
+
+    fold(local)
+    for _, payload in sorted(remote.items()):
+        fold(payload)
+    for m in merged.values():
+        n = m["count"] or 1
+        m["mean_latency_s"] = m["total_latency_s"] / n
+        m["mean_compile_s"] = m["total_compile_s"] / n
+        m["mean_exec_s"] = max(0.0, m["mean_latency_s"]
+                               - m["mean_compile_s"])
+        for q, k in ((0.50, "p50_latency_s"), (0.95, "p95_latency_s"),
+                     (0.99, "p99_latency_s")):
+            m[k] = buckets_quantile(m["latency_buckets"], q)
+    stmts = sorted(merged.values(),
+                   key=lambda m: -m["total_latency_s"])
+    return {"statements": stmts, "cluster": True, "partial": partial,
+            "nodes": sorted([own_id, *remote])}
+
+
 class Node:
     def __init__(self, config: NodeConfig | None = None):
         self.config = config or NodeConfig()
@@ -84,6 +212,9 @@ class Node:
         # by the maintenance loop (pkg/ts analogue, server/ts.py)
         from .ts import TimeSeriesDB
         self.tsdb = TimeSeriesDB(self.engine.kv, self.engine.metrics)
+        # cluster-wide status fan-out: the NetCluster serving this
+        # node's tracez/statements to peers (enable_cluster_status)
+        self._status_cluster = None
 
     @property
     def sql_addr(self) -> tuple[str, int]:
@@ -109,12 +240,14 @@ class Node:
                 pass
 
             def do_GET(self):
-                if self.path in ("/metrics", "/_status/vars"):
+                from urllib.parse import parse_qs, urlparse
+                path = urlparse(self.path).path
+                qs = parse_qs(urlparse(self.path).query)
+                if path in ("/metrics", "/_status/vars"):
                     body = node.engine.metrics.to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/ts/query"):
-                    from urllib.parse import parse_qs, urlparse
-                    q = parse_qs(urlparse(self.path).query)
+                    q = qs
                     try:
                         pts = node.tsdb.query(
                             q["name"][0],
@@ -131,11 +264,11 @@ class Node:
                         self.wfile.write(str(ex).encode())
                         return
                     ctype = "application/json"
-                elif self.path == "/ts/metrics":
+                elif path == "/ts/metrics":
                     body = json.dumps(
                         node.tsdb.list_metrics()).encode()
                     ctype = "application/json"
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     body = json.dumps({
                         "status": "ok",
                         "version": __version__,
@@ -143,7 +276,7 @@ class Node:
                         "hbm_used_bytes": node.engine.hbm.used,
                     }).encode()
                     ctype = "application/json"
-                elif self.path == "/_status/nodes":
+                elif path == "/_status/nodes":
                     # `cockroach node status` backing (pkg/server/
                     # status.go Nodes): this node + its fabric view
                     mon = getattr(node, "peer_monitor", None)
@@ -163,34 +296,41 @@ class Node:
                         "peers": peers,
                     }).encode()
                     ctype = "application/json"
-                elif self.path == "/_status/statements":
+                elif path == "/_status/statements":
                     # per-fingerprint statement stats (pkg/server
-                    # /statements.go Statements endpoint)
-                    body = json.dumps({"statements": [{
-                        "fingerprint": s.fingerprint,
-                        "count": s.count,
-                        "mean_latency_s": s.mean_latency_s,
-                        "max_latency_s": s.max_latency_s,
-                        # compile-vs-execute split (exec/coldstart.py
-                        # per-thread XLA compile attribution): high
-                        # mean_compile_s with low mean_exec_s means
-                        # the fix is cache/prewarm, not the plan
-                        "total_compile_s": s.total_compile_s,
-                        "mean_compile_s": s.mean_compile_s,
-                        "mean_exec_s": s.mean_exec_s,
-                        "total_rows": s.total_rows,
-                        "failures": s.failures,
-                    } for s in node.engine.sqlstats.all()]}).encode()
+                    # /statements.go Statements endpoint); ?cluster=1
+                    # fans out to live peers and merges fingerprints
+                    payload = _statements_payload(node.engine)
+                    c = node._status_cluster
+                    if qs.get("cluster", ["0"])[0] == "1" \
+                            and c is not None:
+                        timeout = float(
+                            qs.get("timeout", ["2.0"])[0])
+                        remote, part = _fanout_status(
+                            c, "statements", timeout)
+                        payload = _merge_statements(
+                            c.node_id, payload, remote, part)
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
-                elif self.path == "/debug/tracez":
+                elif path == "/debug/tracez":
                     # ring buffer of recent slow-statement trace
                     # recordings (threshold via the cluster setting
                     # sql.trace.slow_statement.threshold; the tracez
-                    # snapshot page of the reference)
-                    body = json.dumps({"traces": list(
-                        node.engine.slow_traces)}).encode()
+                    # snapshot page of the reference); ?cluster=1
+                    # concatenates every live peer's ring, node-tagged
+                    payload = _tracez_payload(node.engine)
+                    c = node._status_cluster
+                    if qs.get("cluster", ["0"])[0] == "1" \
+                            and c is not None:
+                        timeout = float(
+                            qs.get("timeout", ["2.0"])[0])
+                        remote, part = _fanout_status(
+                            c, "tracez", timeout)
+                        payload = _merge_tracez(
+                            c.node_id, payload, remote, part)
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
-                elif self.path == "/_debug/ranges":
+                elif path == "/_debug/ranges":
                     # `cockroach debug` analogue: range descriptors +
                     # leaseholders when this node serves a cluster
                     c = node.config.cluster
@@ -293,9 +433,23 @@ class Node:
         if node_id not in self.gossip.peers:
             self.gossip.peers.append(node_id)
 
+    def enable_cluster_status(self, cluster=None) -> "Node":
+        """Join the cluster-wide status plane: serve this node's
+        tracez/statements to peers over `cluster`'s fabric and honor
+        ?cluster=1 on the HTTP endpoints by fanning out over it.
+        Default: the NodeConfig's cluster (auto-called by start()
+        when that is a NetCluster)."""
+        c = cluster if cluster is not None else self.config.cluster
+        if c is None or not hasattr(c, "status_handlers"):
+            return self
+        register_status_sources(c, self.engine)
+        self._status_cluster = c
+        return self
+
     def start(self) -> "Node":
         if self._started:
             return self
+        self.enable_cluster_status()
         if self.config.load_tpch_sf is not None:
             from ..models import tpch
             tpch.load(self.engine, sf=self.config.load_tpch_sf)

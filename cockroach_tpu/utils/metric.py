@@ -20,6 +20,38 @@ import threading
 from typing import Callable, Optional
 
 
+NUM_BUCKETS = 40
+
+
+def log2_bucket_index(v: float, num_buckets: int = NUM_BUCKETS) -> int:
+    """Bucket index for one observation in the shared log2 layout
+    (used by Histogram below and utils/sqlstats latency buckets, so
+    their quantiles agree)."""
+    if v <= 0:
+        return 0
+    return min(num_buckets - 1, max(0, int(math.log2(v * 1e6) + 1)))
+
+
+def log2_bucket_bound(i: int) -> float:
+    """Upper bound (inclusive, seconds/units) of bucket `i`."""
+    return (2.0 ** (i - 1)) / 1e6
+
+
+def buckets_quantile(buckets: list, q: float) -> float:
+    """Quantile estimate over log2 bucket counts: the upper bound of
+    the bucket holding the q-th observation."""
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, c in enumerate(buckets):
+        acc += c
+        if acc >= target:
+            return log2_bucket_bound(i)
+    return log2_bucket_bound(len(buckets) - 1)
+
+
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
@@ -81,7 +113,8 @@ class Histogram:
     """Log-bucketed latency/size histogram (the reference uses HDR-ish
     histograms; log2 buckets keep it dependency-free)."""
 
-    def __init__(self, name: str, help_: str = "", num_buckets: int = 40):
+    def __init__(self, name: str, help_: str = "",
+                 num_buckets: int = NUM_BUCKETS):
         self.name = name
         self.help = help_
         self._buckets = [0] * num_buckets
@@ -90,8 +123,7 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
-        b = 0 if v <= 0 else min(len(self._buckets) - 1,
-                                 max(0, int(math.log2(v * 1e6) + 1)))
+        b = log2_bucket_index(v, len(self._buckets))
         with self._lock:
             self._buckets[b] += 1
             self._sum += v
@@ -102,7 +134,7 @@ class Histogram:
 
     def bucket_bounds(self) -> list[float]:
         """Upper bound (inclusive, seconds/units) of each bucket."""
-        return [(2.0 ** (i - 1)) / 1e6
+        return [log2_bucket_bound(i)
                 for i in range(len(self._buckets))]
 
     def buckets(self) -> list[int]:
@@ -111,15 +143,7 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         with self._lock:
-            if self._count == 0:
-                return 0.0
-            target = q * self._count
-            acc = 0
-            for i, c in enumerate(self._buckets):
-                acc += c
-                if acc >= target:
-                    return (2.0 ** (i - 1)) / 1e6
-            return (2.0 ** (len(self._buckets) - 1)) / 1e6
+            return buckets_quantile(self._buckets, q)
 
 
 class MetricRegistry:

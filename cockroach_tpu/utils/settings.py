@@ -184,6 +184,11 @@ class SessionVars:
         # budget; on: force spill whenever the plan shape is eligible;
         # off: escape hatch / bench A/B lever
         "spill": "auto",             # auto | on | off
+        # SET tracing = off | on | cluster (exec/engine.py): on
+        # records each statement gateway-locally for SHOW TRACE FOR
+        # SESSION; cluster additionally requests remote recordings
+        # from every RPC / DistSQL flow the statement touches
+        "tracing": "off",            # off | on | cluster
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,

@@ -12,6 +12,8 @@ import re
 import threading
 from dataclasses import dataclass, field
 
+from .metric import (NUM_BUCKETS, buckets_quantile, log2_bucket_index)
+
 
 _NUM = re.compile(r"\b\d+(\.\d+)?([eE][-+]?\d+)?\b")
 _STR = re.compile(r"'(?:[^']|'')*'")
@@ -39,10 +41,32 @@ class StmtStats:
     # accounting): the compile-vs-execute split that tells "slow
     # because compiling" from "slow because executing"
     total_compile_s: float = 0.0
+    # latency distribution in the metric plane's shared log2 bucket
+    # layout (utils/metric.py) — the recording path is unchanged;
+    # quantiles derive from the same observations as the means, and
+    # bucket arrays merge element-wise across nodes (the cluster
+    # statements fan-out)
+    latency_buckets: list = field(
+        default_factory=lambda: [0] * NUM_BUCKETS)
 
     @property
     def mean_latency_s(self) -> float:
         return self.total_latency_s / self.count if self.count else 0.0
+
+    def latency_quantile(self, q: float) -> float:
+        return buckets_quantile(self.latency_buckets, q)
+
+    @property
+    def p50_latency_s(self) -> float:
+        return self.latency_quantile(0.50)
+
+    @property
+    def p95_latency_s(self) -> float:
+        return self.latency_quantile(0.95)
+
+    @property
+    def p99_latency_s(self) -> float:
+        return self.latency_quantile(0.99)
 
     @property
     def mean_compile_s(self) -> float:
@@ -75,6 +99,7 @@ class StatsRegistry:
             st.count += 1
             st.total_latency_s += latency_s
             st.max_latency_s = max(st.max_latency_s, latency_s)
+            st.latency_buckets[log2_bucket_index(latency_s)] += 1
             st.total_rows += rows
             st.total_compile_s += compile_s
             if failed:

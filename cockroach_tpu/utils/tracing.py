@@ -76,14 +76,28 @@ def current_span() -> Optional[Span]:
     return getattr(_tls, "span", None)
 
 
+def recording_requested() -> bool:
+    """True when the active capture asked remote participants to
+    record too (SET tracing = cluster, EXPLAIN ANALYZE, slow-statement
+    sampling). False when nothing records here, or when the capture
+    was opened with record_request=False (SET tracing = on: gateway-
+    local recording, remote nodes stay dark)."""
+    return current_span() is not None and \
+        bool(getattr(_tls, "rec_req", True))
+
+
 def trace_context() -> Optional[dict]:
     """The active trace context as a JSON-safe dict for an RPC frame
-    (`{"tid": trace_id, "sid": span_id}`), or None when nothing is
+    (`{"tid": trace_id, "sid": span_id}` plus `"rec": 1` when the
+    capture requests remote recording), or None when nothing is
     recording on this thread."""
     s = current_span()
     if s is None:
         return None
-    return {"tid": s.trace_id, "sid": s.span_id}
+    tc = {"tid": s.trace_id, "sid": s.span_id}
+    if getattr(_tls, "rec_req", True):
+        tc["rec"] = 1
+    return tc
 
 
 def _jsonable(v):
@@ -161,13 +175,23 @@ def event(name: str, **tags) -> Optional[Span]:
 
 @contextmanager
 def capture(name: str = "trace", remote_ctx: Optional[dict] = None,
-            **tags):
+            record_request: Optional[bool] = None, **tags):
     """Collect a full recording rooted at `name` on this thread.
 
-    `remote_ctx` is the {"tid","sid"} dict from an inbound RPC frame:
-    the new root adopts the caller's trace_id and tags the parent
-    span id, so stitched recordings stay correlated across nodes."""
+    `remote_ctx` is the {"tid","sid","rec"?} dict from an inbound RPC
+    frame: the new root adopts the caller's trace_id and tags the
+    parent span id, so stitched recordings stay correlated across
+    nodes.
+
+    `record_request` is the per-statement remote-recording bit (the
+    pgwire `SET tracing` analogue): True asks every RPC/flow this
+    capture touches to record remotely and ship spans back; False
+    keeps the recording gateway-local. Default: inherit the inbound
+    frame's bit when remote_ctx is given, else True (every existing
+    capture — EXPLAIN ANALYZE, slow sampling, tests — wants the
+    stitched tree)."""
     prev = current_span()
+    prev_req = getattr(_tls, "rec_req", True)
     root = Span(name, time.monotonic_ns(), tags=dict(tags),
                 span_id=next(_ids))
     if remote_ctx:
@@ -175,14 +199,18 @@ def capture(name: str = "trace", remote_ctx: Optional[dict] = None,
         psid = int(remote_ctx.get("sid", 0))
         if psid:
             root.tags.setdefault("parent_sid", psid)
+        if record_request is None:
+            record_request = bool(remote_ctx.get("rec"))
     else:
         root.trace_id = next(_ids)
     _tls.span = root
+    _tls.rec_req = True if record_request is None else bool(record_request)
     try:
         yield root
     finally:
         root.end_ns = time.monotonic_ns()
         _tls.span = prev
+        _tls.rec_req = prev_req
 
 
 def tag(**tags) -> None:
@@ -202,8 +230,9 @@ class Tracer:
     def span(self, name: str, **tags):
         return span(name, **tags)
 
-    def capture(self, name: str = "trace", **tags):
-        return capture(name, **tags)
+    def capture(self, name: str = "trace",
+                record_request: Optional[bool] = None, **tags):
+        return capture(name, record_request=record_request, **tags)
 
     def tag(self, **tags) -> None:
         tag(**tags)

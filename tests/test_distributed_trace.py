@@ -33,7 +33,8 @@ from cockroach_tpu.kv.rowfetch import RangeTable
 from cockroach_tpu.kvserver.netcluster import NetCluster, _TimeoutError
 from cockroach_tpu.models import tpch
 from cockroach_tpu.rpc.context import FaultInjector, SocketTransport
-from cockroach_tpu.server.node import Node, NodeConfig
+from cockroach_tpu.server.node import (Node, NodeConfig,
+                                       register_status_sources)
 
 ROWS = 360
 Q = ("SELECT l_returnflag, count(*), sum(l_quantity) FROM lineitem "
@@ -75,6 +76,12 @@ def obs():
     reg = node.engine.metrics
     n1.attach_metrics(reg)
     node.engine.execute(tpch.DDL["lineitem"])
+    # cluster-wide status plane: the gateway node answers for n1;
+    # a second HTTP node rides n2 (so ?cluster=1 can be scraped from
+    # a NON-gateway node); n3's engine joins the plane directly
+    node.enable_cluster_status(n1)
+    node2 = Node(NodeConfig(listen_port=0, http_port=0)).start()
+    node2.enable_cluster_status(n2)
 
     # DistSQL plane: its own socket mesh (ids 0..3), one pump thread
     # per data node, each data node scoped to ITS NetCluster view
@@ -91,6 +98,7 @@ def obs():
         e.execute(tpch.DDL["lineitem"])
         engines.append(e)
         dnodes.append(DistSQLNode(i, e, txs[i], cluster=ncs[i]))
+    register_status_sources(n3, engines[2])
     for i in range(1, 4):
         def pump(t=txs[i]):
             while not stop.is_set():
@@ -135,6 +143,7 @@ def obs():
                 timeout=0.5)
     inj.heal()
     assert n1.peer_breaker(3).trip_count >= 1
+    n1.peer_breaker(3).reset()  # clean slate for the status fan-out
 
     # the distributed GROUP BY, plain and under EXPLAIN ANALYZE
     gw = Gateway(dnodes[0], [1, 2, 3], cluster=n1)
@@ -149,8 +158,9 @@ def obs():
     node.engine.execute("SELECT count(*) FROM lineitem")
 
     out = {
-        "node": node, "reg": reg, "ea": ea,
+        "node": node, "node2": node2, "reg": reg, "ea": ea,
         "got": got.rows, "want": want.rows,
+        "gw": gw, "n1": n1, "n2": n2, "inj": inj,
         "vars": _http_get(node, "/_status/vars"),
         "tracez": json.loads(_http_get(node, "/debug/tracez")),
         "stmts": json.loads(_http_get(node, "/_status/statements")),
@@ -160,6 +170,7 @@ def obs():
     for t in txs:
         t.close()
     node.stop()
+    node2.stop()
     for n in ncs.values():
         n.stop()
 
@@ -254,3 +265,126 @@ class TestDistributedTrace:
         fps = [s["fingerprint"] for s in obs["stmts"]["statements"]]
         assert any("lineitem" in fp for fp in fps)
         assert all(s["count"] >= 1 for s in obs["stmts"]["statements"])
+
+    def test_statements_carry_latency_quantiles(self, obs):
+        """p50/p95/p99 derive from the log2 latency buckets — same
+        observations as the means, no extra recording path."""
+        for s in obs["stmts"]["statements"]:
+            assert sum(s["latency_buckets"]) == s["count"]
+            p50, p95, p99 = (s["p50_latency_s"], s["p95_latency_s"],
+                             s["p99_latency_s"])
+            assert 0 < p50 <= p95 <= p99
+            # each quantile is a bucket upper bound covering max
+            assert p99 >= s["max_latency_s"] / 2
+
+
+class TestClusterFanout:
+    def test_cluster_tracez_from_non_gateway_node(self, obs):
+        """ISSUE acceptance: /debug/tracez?cluster=1 scraped from a
+        node that is NOT the gateway returns the gateway's
+        slow-statement entry, node-tagged."""
+        body = json.loads(_http_get(obs["node2"],
+                                    "/debug/tracez?cluster=1"))
+        assert body["cluster"] is True
+        assert body["partial"] is False
+        assert sorted(body["nodes"]) == [1, 2, 3]
+        mine = [t for t in body["traces"]
+                if t["node"] == 1 and "lineitem" in t["sql"]]
+        assert mine, "gateway's slow entry missing from the fan-out"
+        assert mine[-1]["span"]["n"]
+
+    def test_cluster_statements_merge_exactly(self, obs):
+        """Fingerprints merge by summing raw totals and bucket
+        arrays; quantiles/means re-derive from the merged values."""
+        local = json.loads(_http_get(obs["node"],
+                                     "/_status/statements"))
+        merged = json.loads(_http_get(
+            obs["node"], "/_status/statements?cluster=1"))
+        assert merged["cluster"] is True and merged["partial"] is False
+        by_fp = {s["fingerprint"]: s for s in merged["statements"]}
+        for s in local["statements"]:
+            m = by_fp[s["fingerprint"]]
+            # this fixture's statements ran on the gateway engine
+            # only, so the merged row equals the local row
+            assert m["count"] >= s["count"]
+            assert m["total_latency_s"] >= s["total_latency_s"] - 1e-9
+            assert sum(m["latency_buckets"]) == m["count"]
+            assert abs(m["mean_latency_s"] * m["count"]
+                       - m["total_latency_s"]) < 1e-6
+
+
+class TestSessionTraceControl:
+    def test_set_tracing_cluster_stitches_raft_and_flow(self, obs):
+        """ISSUE acceptance: SET tracing = cluster, a replicated
+        INSERT and a distributed GROUP BY on ONE session; SHOW TRACE
+        FOR SESSION renders node-tagged remote flow spans AND raft
+        propose/apply events."""
+        from cockroach_tpu.exec.session import Session
+        eng = Engine(cluster=obs["n1"])
+        s = Session()
+        # the fixture bulk-wrote lineitem KV pairs under the FIRST
+        # user-table prefix (RangeTable bypasses this catalog); burn
+        # that id on an empty spacer so trc_t's keys are its own
+        eng.execute("CREATE TABLE trc_spacer (x INT)", session=s)
+        eng.execute("CREATE TABLE trc_t (a INT PRIMARY KEY, b INT)",
+                    session=s)
+        eng.execute("SET tracing = cluster", session=s)
+        eng.execute("INSERT INTO trc_t VALUES (1, 10), (2, 20)",
+                    session=s)
+        obs["gw"].run(Q, session=s)
+        eng.execute("SET tracing = off", session=s)
+        res = eng.execute("SHOW TRACE FOR SESSION", session=s)
+        text = "\n".join(r[0] for r in res.rows)
+        # raft events from the replicated write path
+        assert "raft-propose" in text, text
+        assert "raft-apply" in text, text
+        # node-tagged remote flow spans from the distributed read
+        remote = {int(m) for m in re.findall(r"flow.*node=(\d+)",
+                                             text)}
+        assert len(remote - {0}) >= 2, text
+        # SET tracing = off stops recording: no new spans after
+        n_rows = len(res.rows)
+        eng.execute("SELECT count(*) FROM trc_t", session=s)
+        res2 = eng.execute("SHOW TRACE FOR SESSION", session=s)
+        assert len(res2.rows) == n_rows
+
+    def test_tracing_on_stays_gateway_local(self, obs):
+        """SET tracing = on records, but remote nodes stay dark: the
+        trace context ships without the record-request bit, so flows
+        come back without remote recordings."""
+        from cockroach_tpu.exec.session import Session
+        s = Session()
+        s.vars.set("tracing", "on")
+        obs["gw"].run(Q, session=s)
+        assert s.trace, "gateway-local recording missing"
+        text = "\n".join(ln for rec in s.trace
+                         for ln in rec.tree_lines())
+        remote = {int(m) for m in re.findall(r"flow.*node=(\d+)",
+                                             text)}
+        assert not (remote - {0}), \
+            f"remote flows recorded under tracing=on: {text}"
+
+
+class TestClusterFanoutPartial:
+    """LAST in the file: partitions the fabric. The fixture's other
+    consumers have all scraped by now."""
+
+    def test_partitioned_peer_marks_partial_within_timeout(self, obs):
+        inj, n2 = obs["inj"], obs["n2"]
+        inj.partition(2, 3)
+        try:
+            t0 = time.monotonic()
+            body = json.loads(_http_get(
+                obs["node2"], "/debug/tracez?cluster=1&timeout=0.5"))
+            elapsed = time.monotonic() - t0
+            assert body["partial"] is True
+            assert 3 not in body["nodes"]
+            assert 1 in body["nodes"]  # the healthy peer still merged
+            # one partitioned peer costs at most ~one per-peer timeout
+            assert elapsed < 5.0, elapsed
+            # the gateway's entry still arrives despite the partition
+            assert any(t["node"] == 1 and "lineitem" in t["sql"]
+                       for t in body["traces"])
+        finally:
+            inj.heal()
+            n2.peer_breaker(3).reset()

@@ -9,6 +9,9 @@
 2. Exposition-format checks on a synthetic registry exercising every
    metric kind, including the cumulative-histogram encoding
    (`_bucket{le=...}` monotone, +Inf == _count) and HELP escaping.
+3. Doc-drift lints against OBSERVABILITY.md: every registered metric
+   family must appear in its metric-families table, and every HTTP
+   path served by server/node.py must appear in its endpoint table.
 """
 
 import pathlib
@@ -17,6 +20,7 @@ import re
 from cockroach_tpu.utils.metric import MetricRegistry
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+OBSERVABILITY = (REPO / "OBSERVABILITY.md").read_text()
 
 # .counter("name") / .func_gauge(f"name.{x}") ... across line breaks
 _REG_RE = re.compile(
@@ -64,6 +68,76 @@ class TestStaticNameLint:
             kinds.setdefault(name, {})[family] = f
         dups = {n: k for n, k in kinds.items() if len(k) > 1}
         assert not dups, f"metric kind collisions: {dups}"
+
+
+_CODE_SPAN = re.compile(r"`([^`]+)`")
+
+
+def _expand_brace_alts(s: str) -> list[str]:
+    """`a.{x,y}.b` -> [a.x.b, a.y.b] (recursively, so multiple brace
+    groups expand as a cartesian product)."""
+    m = re.search(r"\{([^{}]*,[^{}]*)\}", s)
+    if not m:
+        return [s]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_brace_alts(
+            s[:m.start()] + alt.strip() + s[m.end():]))
+    return out
+
+
+def _documented_families():
+    """(exact names, prefix wildcards) from OBSERVABILITY.md code
+    spans, normalized the same way _registrations normalizes f-string
+    registrations: `{a,b}` alternation expands, any leftover `{x}`
+    placeholder collapses to '0', and `fam.*` is a prefix wildcard."""
+    exact, prefixes = set(), []
+    for span in _CODE_SPAN.findall(OBSERVABILITY):
+        span = span.strip()
+        if not re.fullmatch(r"[a-z0-9._{},* ]+", span):
+            continue
+        for name in _expand_brace_alts(span):
+            name = re.sub(r"\{[^}]*\}", "0", name).strip()
+            if name.endswith(".*"):
+                prefixes.append(name[:-1])      # keep the dot
+            elif re.fullmatch(r"[a-z0-9._]+", name):
+                exact.add(name)
+    return exact, prefixes
+
+
+class TestDocDrift:
+    def test_doc_scan_finds_the_tables(self):
+        exact, prefixes = _documented_families()
+        # an empty parse would vacuously pass the drift checks below
+        assert len(exact) >= 20
+        assert "sql." in prefixes
+        for expect in ("rpc.frames.sent", "exec.device.hbm.bytes",
+                       "exec.queue.depth"):
+            assert expect in exact, f"doc parse lost {expect}"
+
+    def test_registered_metrics_documented(self):
+        exact, prefixes = _documented_families()
+        missing = sorted({
+            n for _, _, n in _registrations()
+            if n not in exact
+            and not any(n.startswith(p) for p in prefixes)})
+        assert not missing, (
+            "metric families registered in code but missing from the "
+            f"OBSERVABILITY.md table: {missing}")
+
+    def test_served_endpoints_documented(self):
+        node_py = (REPO / "cockroach_tpu" / "server"
+                   / "node.py").read_text()
+        served = {m.group(1) for m in re.finditer(
+            r"[\"'](/[a-zA-Z_][a-zA-Z0-9_/]*)[\"']", node_py)}
+        assert "/debug/tracez" in served, "endpoint scan lost tracez"
+        documented = {s.split("?")[0] for s in
+                      _CODE_SPAN.findall(OBSERVABILITY)
+                      if s.startswith("/")}
+        missing = sorted(served - documented)
+        assert not missing, (
+            "HTTP endpoints served by server/node.py but missing "
+            f"from the OBSERVABILITY.md endpoint table: {missing}")
 
 
 class TestExpositionFormat:

@@ -62,12 +62,32 @@ class TestTraceWire:
     def test_trace_context_carries_ids(self):
         with tracing.capture("root") as rec:
             tc = tracing.trace_context()
-            assert tc == {"tid": rec.trace_id, "sid": rec.span_id}
+            # implicit captures request remote recordings ("rec");
+            # SET tracing = on omits it and remote nodes stay dark
+            assert tc == {"tid": rec.trace_id, "sid": rec.span_id,
+                          "rec": 1}
             with tracing.span("child") as s:
                 tc2 = tracing.trace_context()
                 assert tc2 == {"tid": rec.trace_id,
-                               "sid": s.span_id}
+                               "sid": s.span_id, "rec": 1}
                 assert tc2["sid"] != tc["sid"]
+
+    def test_trace_context_record_request_bit(self):
+        with tracing.capture("local", record_request=False):
+            tc = tracing.trace_context()
+            assert "rec" not in tc
+            assert not tracing.recording_requested()
+        with tracing.capture("clustered", record_request=True):
+            assert tracing.trace_context()["rec"] == 1
+            assert tracing.recording_requested()
+            # a server-side capture inherits the caller's bit
+            with tracing.capture("remote",
+                                 remote_ctx={"tid": 1, "sid": 2}):
+                assert not tracing.recording_requested()
+            with tracing.capture(
+                    "remote2",
+                    remote_ctx={"tid": 1, "sid": 2, "rec": 1}):
+                assert tracing.recording_requested()
 
     def test_wire_roundtrip(self):
         with tracing.capture("root", q="sel") as rec:

@@ -6,6 +6,7 @@ query/downsample path that backs the console graphs.
 """
 
 import json
+import urllib.error
 import urllib.request
 
 from cockroach_tpu.exec.engine import Engine
@@ -119,6 +120,72 @@ class TestMaintenance:
         assert ts.query("m.n", t0, t0 + SLAB_S) == []
 
 
+class TestMaintenanceIdempotent:
+    def test_second_maintain_is_a_noop(self):
+        """maintain() twice at the same clock: the second pass finds
+        nothing to roll up or prune, and queries are unchanged."""
+        e, ts, clock = make_tsdb()
+        g = e.metrics.gauge("m.idem", "x")
+        t0 = clock.t
+        for i in range(SLAB_S // FINE_RES_S):
+            g.set(float(i))
+            ts.record()
+            clock.t += FINE_RES_S
+        clock.t += 7 * 3600
+        first = ts.maintain(retention_fine_s=6 * 3600)
+        assert first["rolled_up"] >= 1
+        before = ts.query("m.idem", t0, t0 + SLAB_S,
+                          downsample_s=COARSE_RES_S)
+        second = ts.maintain(retention_fine_s=6 * 3600)
+        assert second == {"rolled_up": 0, "pruned": 0}
+        after = ts.query("m.idem", t0, t0 + SLAB_S,
+                         downsample_s=COARSE_RES_S)
+        assert after == before
+
+    def test_rollup_preserves_query_continuity(self):
+        """A window straddling the rollup horizon answers from coarse
+        and fine slabs as one series (fine wins where both exist)."""
+        e, ts, clock = make_tsdb()
+        g = e.metrics.gauge("m.cont", "x")
+        t0 = clock.t
+        # two hours of samples; only the first ages past retention
+        for i in range(2 * SLAB_S // FINE_RES_S):
+            g.set(float(i))
+            ts.record()
+            clock.t += FINE_RES_S
+        clock.t = t0 + SLAB_S + 6 * 3600 + FINE_RES_S
+        ts.maintain(retention_fine_s=6 * 3600)
+        pts = ts.query("m.cont", t0, t0 + 2 * SLAB_S,
+                       downsample_s=COARSE_RES_S)
+        assert len(pts) == 2 * SLAB_S // COARSE_RES_S
+        # values keep ascending across the coarse/fine seam
+        vals = [v for _, v in pts]
+        assert vals == sorted(vals)
+
+
+class TestDeviceUtilizationSeries:
+    def test_device_family_recorded_and_queryable(self):
+        """The exec.device.* func-metrics are scalars, so record()
+        keeps them and /ts/query-style reads graph a history — the
+        device-utilization plane's storage path."""
+        e, ts, clock = make_tsdb()
+        t0 = clock.t
+        for _ in range(4):
+            e.devstats.note_execute(0.5)
+            ts.record()
+            clock.t += FINE_RES_S
+        names = ts.list_metrics()
+        for fam in ("exec.device.hbm.bytes", "exec.device.hbm.watermark",
+                    "exec.device.util.seconds", "exec.device.queue.depth"):
+            assert fam in names, f"{fam} not recorded"
+        pts = ts.query("exec.device.util.seconds", t0, clock.t)
+        assert [v for _, v in pts] == [0.5, 1.0, 1.5, 2.0]
+        # as a rate: 0.5s of device time per 10s wall = 0.05 util
+        rate = ts.query("exec.device.util.seconds", t0, clock.t,
+                        rate=True)
+        assert all(abs(v - 0.05) < 1e-9 for _, v in rate)
+
+
 class TestNodeIntegration:
     def test_http_endpoints(self):
         from cockroach_tpu.server.node import Node, NodeConfig
@@ -139,5 +206,39 @@ class TestNodeIntegration:
                 f"http://{host}:{port}/ts/query?name={name}"
                 f"&start=0&end=4000000000", timeout=5).read())
             assert isinstance(pts, list) and pts
+        finally:
+            n.stop()
+
+    def test_http_server_side_downsample(self):
+        """/ts/query applies downsample/agg/rate on the server; a
+        missing name is a 400, not a stack trace."""
+        from cockroach_tpu.server.node import Node, NodeConfig
+        n = Node(NodeConfig(http_port=0, listen_port=0))
+        n.start()
+        try:
+            g = n.engine.metrics.gauge("http.ds", "x")
+            clock = FakeClock()
+            n.tsdb.now_s = clock
+            t0 = clock.t
+            for i in range(12):
+                g.set(float(i))
+                n.tsdb.record()
+                clock.t += FINE_RES_S
+            host, port = n.http_addr
+            base = (f"http://{host}:{port}/ts/query?name=http.ds"
+                    f"&start={t0}&end={clock.t}")
+            ds = json.loads(urllib.request.urlopen(
+                base + "&downsample=60&agg=max", timeout=5).read())
+            assert [v for _, v in ds] == [5.0, 11.0]
+            rate = json.loads(urllib.request.urlopen(
+                base + "&rate=1", timeout=5).read())
+            assert all(abs(v - 0.1) < 1e-9 for _, v in rate)
+            try:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/ts/query?start=0",
+                    timeout=5)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as ex:
+                assert ex.code == 400
         finally:
             n.stop()
