@@ -542,6 +542,109 @@ def run_spill_ab(rows, repeats):
     return out
 
 
+def run_joinskip_ab(rows, repeats):
+    """Join-induced data skipping A/B (round 10 tentpole): semi-join
+    filters derived from the hash-join build side at dispatch time,
+    fed into the probe scan's zone predicates.
+
+    Two ladders, each off (join_filter=off) vs auto, both checked
+    row-for-row against a resident ample-budget baseline:
+
+      q3-class  streamed lineitem probe x orders build restricted to
+                a 5% o_orderkey prefix. l_orderkey is clustered, so
+                the derived [lo, hi] + key summary skips the pages
+                whose whole key range misses the build — the metric
+                deltas record exec.skip.joinfilter.pages/bytes.
+      q9-class  spill-join lineitem probe x part build restricted to
+                a small p_partkey prefix. l_partkey is NOT clustered
+                (no page can skip) — the win is host-side row pruning
+                before partition gather/upload, recorded as
+                exec.skip.joinfilter.rows.
+
+    The skipped pages/rows never assemble or upload, so the auto arm
+    does strictly less host->device work for identical rows."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    eng = Engine(mesh=None)
+    t0 = time.time()
+    sf = rows / tpch.LINEITEM_PER_SF
+    # chunked ingest (the shape real writes produce): per-chunk
+    # write-time zones over l_orderkey are what make q3-class probe
+    # pages skippable; one monolithic chunk would span every key
+    tpch.load(eng, sf=sf, rows=rows,
+              tables=("lineitem", "orders", "part"), encoded=True,
+              chunk_rows=1 << 14)
+    print(f"# joinskip datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    budget = int(os.environ.get("BENCH_JOINSKIP_BUDGET", 1 << 25))
+    ample = 12 << 30
+    okey_cap = int(tpch.ORDERS_PER_SF * max(sf, 0.01) * 0.05)
+    qs = {
+        "q3": ("SELECT o_orderpriority, count(*) AS n, "
+               "sum(l_quantity) AS q, sum(l_extendedprice) AS v, "
+               "sum(l_discount) AS dc FROM lineitem JOIN orders "
+               "ON l_orderkey = o_orderkey "
+               f"WHERE o_orderkey <= {okey_cap} "
+               "GROUP BY o_orderpriority ORDER BY o_orderpriority",
+               "off"),
+        "q9": ("SELECT count(*) AS n, sum(l_extendedprice) AS v, "
+               "sum(l_quantity) AS q, sum(l_discount) AS dc "
+               "FROM lineitem JOIN part ON l_partkey = p_partkey "
+               "WHERE p_partkey <= 100",
+               "on"),
+    }
+    out = {"joinskip_budget_bytes": budget,
+           "joinskip_okey_cap": okey_cap}
+    for which, (sql, spill) in qs.items():
+        base = None
+        for arm, jf in (("resident", "off"), ("off", "off"),
+                        ("auto", "auto")):
+            eng.drop_device_cache()
+            eng.settings.set(
+                "sql.exec.hbm_budget_bytes",
+                ample if arm == "resident" else budget)
+            s = eng.session()
+            s.vars.set("distsql", "off")
+            s.vars.set("streaming_page_rows", 8192)
+            s.vars.set("spill", "off" if arm == "resident" else spill)
+            s.vars.set("join_filter", jf)
+            snap0 = eng.metrics.snapshot()
+            res = eng.execute(sql, s)  # warmup: compile + upload
+            per = []
+            for _ in range(repeats):
+                t0 = time.time()
+                res = eng.execute(sql, s)
+                per.append(rows / (time.time() - t0))
+            rps = statistics.median(per)
+            d = metric_deltas(snap0, eng.metrics.snapshot())
+            out[f"joinskip_{which}_{arm}_rows_per_sec"] = round(rps)
+            if arm == "resident":
+                base = res.rows
+            else:
+                out[f"joinskip_{which}_{arm}_parity"] = \
+                    res.rows == base
+                out[f"joinskip_{which}_{arm}_pages_skipped"] = \
+                    d.get("exec.stream.pages_skipped", 0)
+                out[f"joinskip_{which}_{arm}_bytes_skipped"] = \
+                    d.get("exec.stream.bytes_skipped", 0)
+            if arm == "auto":
+                out[f"joinskip_{which}_jf_pages"] = \
+                    d.get("exec.skip.joinfilter.pages", 0)
+                out[f"joinskip_{which}_jf_bytes"] = \
+                    d.get("exec.skip.joinfilter.bytes", 0)
+                out[f"joinskip_{which}_jf_rows"] = \
+                    d.get("exec.skip.joinfilter.rows", 0)
+            print(f"# joinskip {which} arm={arm} "
+                  f"rows_per_sec={rps:.3e} "
+                  f"jf_pages={d.get('exec.skip.joinfilter.pages', 0)} "
+                  f"jf_rows={d.get('exec.skip.joinfilter.rows', 0)} "
+                  f"pages_skipped="
+                  f"{d.get('exec.stream.pages_skipped', 0)}",
+                  file=sys.stderr)
+    return out
+
+
 def run_dispatchq(rows, workers=2, iters=6):
     """Concurrent distributed dispatch (PR 3 tentpole): N sessions
     issue distributed GROUP BYs at once through the per-mesh FIFO
@@ -814,6 +917,15 @@ def main():
             **per,
         }))
         return
+    if mode == "joinskip_child":
+        per = run_joinskip_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "joinskip_q3_auto_rows_per_sec",
+            "value": per.get("joinskip_q3_auto_rows_per_sec", 0),
+            "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
     if mode == "dispatchq_child":
         serial, conc = run_dispatchq(rows)
         print(json.dumps({
@@ -967,6 +1079,17 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("spill_")})
             out.setdefault("spill_rows", r["rows"])
+    # round 10 tentpole A/B: join-induced data skipping
+    # (join_filter=auto) vs the unfiltered probe scan (off) on q3/q9
+    # -class ladders at a forced-small HBM budget
+    if os.environ.get("BENCH_JOINSKIP", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_JOINSKIP_ROWS",
+                                         1 << 20)),
+                      "joinskip", child_timeout, mode="joinskip_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("joinskip_")})
+            out.setdefault("joinskip_rows", r["rows"])
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),
@@ -1021,7 +1144,8 @@ def main():
 # metrics where a value change is configuration, not performance
 _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "cpu_rows", "ssb_rows", "tpcc_warehouses",
-                  "spill_budget_bytes", "coldstart_rows"}
+                  "spill_budget_bytes", "coldstart_rows",
+                  "joinskip_budget_bytes", "joinskip_okey_cap"}
 
 
 def regression_report(out: dict) -> None:

@@ -74,6 +74,13 @@ class FlowSpec:
     # remote nodes run their stage under a local capture and ship the
     # finished span subtree back ahead of EOF (a "flow_span" frame)
     trace: bool = False
+    # join-induced data skipping: compact wire frames (JoinFilter
+    # .to_wire() dicts) derived by the gateway from replicated build
+    # sides; remote nodes apply them to their probe-side shard scans
+    # so non-matching chunks never upload (they can only SHRINK the
+    # scanned set, never change visible rows — safe to drop on any
+    # node that cannot apply them)
+    joinfilter: Optional[list] = None
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -82,7 +89,7 @@ class FlowSpec:
                 "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
                 "window": self.window, "spans": self.spans,
                 "graph": self.graph, "data_nodes": self.data_nodes,
-                "trace": self.trace}
+                "trace": self.trace, "joinfilter": self.joinfilter}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

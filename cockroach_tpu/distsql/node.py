@@ -139,6 +139,12 @@ class DistSQLNode:
         self._producing: set[tuple[str, int]] = set()
         self.cancelled_flows: set[str] = set()
         self._cancel_order: deque = deque()
+        # SetupFlow idempotence under at-least-once delivery: a
+        # duplicated frame must not run the stage (and push its
+        # chunks) twice — the gateway would union the rows twice.
+        # Bounded the same way cancel memory is.
+        self._flows_seen: set[tuple] = set()
+        self._seen_order: deque = deque()
         # multi-stage shuffle flows in progress on this node
         self._graphs: dict[str, _GraphFlowState] = {}
 
@@ -207,6 +213,13 @@ class DistSQLNode:
             # cancel raced ahead of the SetupFlow: drop it unexecuted
             self.flows_cancelled += 1
             return
+        key = (spec.flow_id, spec.stream_id)
+        if key in self._flows_seen:
+            return          # duplicate SetupFlow: already ran/running
+        self._flows_seen.add(key)
+        self._seen_order.append(key)
+        while len(self._seen_order) > self.CANCEL_MEMORY:
+            self._flows_seen.discard(self._seen_order.popleft())
         self._producing.add((spec.flow_id, spec.stream_id))
         try:
             self.flows_run += 1
@@ -286,8 +299,31 @@ class DistSQLNode:
         # the fabric) and the worker's plan compiles without the
         # int64 upcast — wide uploads keep partial dtypes identical
         # on every node (same reasoning as int_ranges=False above)
-        scans = {alias: eng._device_table(tbl, narrow=False)
-                 for alias, tbl in _collect_scans(stage.local).items()}
+        local_scans = _collect_scans(stage.local)
+        scans = {}
+        # join-induced data skipping: the gateway's wire frames prune
+        # this node's probe-side shard chunks host-side before upload.
+        # _filtered_scan_batch returns None when nothing drops (keep
+        # the cached _device_table path) and the frames can only
+        # SHRINK the scanned set — any failure falls back to the full
+        # scan, never to wrong rows.
+        jf_by_table: dict = {}
+        if spec.joinfilter:
+            from cockroach_tpu.exec.joinfilter import JoinFilter
+            for d in spec.joinfilter:
+                f = JoinFilter.from_wire(d)
+                jf_by_table.setdefault(f.table, []).append(f)
+        for alias, tbl in local_scans.items():
+            fl = jf_by_table.get(tbl)
+            b = None
+            if fl:
+                try:
+                    b = eng._filtered_scan_batch(
+                        tbl, fl, spec.read_ts)
+                except Exception:
+                    b = None
+            scans[alias] = (b if b is not None
+                            else eng._device_table(tbl, narrow=False))
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
         return runf(RunContext(scans, read_ts)), stage
@@ -763,6 +799,39 @@ class Gateway:
                 rec(n.child, build_side)
         rec(plan_node, False)
 
+    def _derive_join_frames(self, plan_node, read_ts):
+        """Join-induced data skipping across the fabric: wire frames
+        (JoinFilter.to_wire dicts) derived at the GATEWAY from join
+        build sides, applied by every data node to its probe-side
+        shard scan so non-matching chunks skip host-side before
+        anything crosses the transport.
+
+        Node-local mode only: _check_join_placement has already
+        proven every build side replicated, so the gateway's local
+        copy of each build table is COMPLETE and a filter derived
+        from it is valid on every node. In cluster/leaseholder mode
+        the gateway's local shard may be partial — deriving there
+        would falsely reject matching probe rows; skipping the
+        optimization is the conservative (and correct) choice."""
+        if self.cluster is not None:
+            return None
+        from cockroach_tpu.exec import joinfilter as jf
+        eng = self.own.engine
+        frames = []
+        for alias, tbl in _collect_scans(plan_node).items():
+            if tbl == UNION or tbl in self.replicated_tables:
+                continue  # probe spines only: sharded scans
+            for spec in jf.find_specs(plan_node, alias, eng.store):
+                if spec.build_table not in self.replicated_tables:
+                    continue
+                try:
+                    f = jf.derive(eng, spec, int(read_ts))
+                except Exception:
+                    f = None
+                if f is not None:
+                    frames.append(f.to_wire())
+        return frames or None
+
     def _pick_graph(self, node):
         """Choose a multi-stage shuffle decomposition: mandatory for a
         sharded⋈sharded join (no single-stage plan exists — this was
@@ -985,6 +1054,7 @@ class Gateway:
         stage = split(node)
         flow_id = uuid.uuid4().hex[:12]
         read_ts = int(eng.clock.now().to_int())
+        jf_frames = self._derive_join_frames(node, read_ts)
 
         # fail fast on breaker-tripped peers: scheduling a flow onto a
         # dead node would only discover it after flow_timeout of silence
@@ -1012,7 +1082,7 @@ class Gateway:
                             spans=(spans_by_node.get(nid)
                                    if spans_by_node is not None
                                    else None),
-                            trace=trace)
+                            trace=trace, joinfilter=jf_frames)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))

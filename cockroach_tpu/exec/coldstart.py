@@ -338,14 +338,19 @@ def journal_record(cache_d: str | None, sql_text: str,
         pass
 
 
-def journal_top(cache_d: str | None, k: int) -> list[str]:
-    """The k statement texts with the most recorded compile misses,
-    hottest first. Corrupt lines are skipped, a missing journal is an
-    empty plan."""
+def journal_entries(cache_d: str | None, k: int) -> list[tuple]:
+    """The k hottest statement texts from the journal, each paired
+    with its dominant recorded shape bucket (0 when the statement
+    never journaled one — resident plans). The bucket is what
+    Engine.prewarm compiles streamed-page and spill-partition
+    executables at, so a restarted process warms the page shapes the
+    previous one actually ran, not just the statement texts. Corrupt
+    lines are skipped, a missing journal is an empty plan."""
     if not cache_d or k <= 0:
         return []
     from collections import Counter
     counts: Counter = Counter()
+    buckets: dict[str, Counter] = {}
     try:
         with open(journal_path(cache_d), encoding="utf-8") as f:
             for line in f:
@@ -354,8 +359,19 @@ def journal_top(cache_d: str | None, k: int) -> list[str]:
                     sql = rec.get("sql")
                     if isinstance(sql, str) and sql:
                         counts[sql] += 1
+                        b = int(rec.get("n") or 0)
+                        if b > 0:
+                            buckets.setdefault(sql, Counter())[b] += 1
                 except Exception:
                     continue
     except OSError:
         return []
-    return [sql for sql, _ in counts.most_common(k)]
+    return [(sql, (buckets[sql].most_common(1)[0][0]
+                   if sql in buckets else 0))
+            for sql, _ in counts.most_common(k)]
+
+
+def journal_top(cache_d: str | None, k: int) -> list[str]:
+    """The k statement texts with the most recorded compile misses,
+    hottest first (journal_entries without the shape buckets)."""
+    return [sql for sql, _ in journal_entries(cache_d, k)]

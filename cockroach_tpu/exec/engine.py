@@ -336,16 +336,31 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if not top_k or not self._compile_cache_dir:
             return 0
         warmed = 0
-        for sql in coldstart.journal_top(self._compile_cache_dir,
-                                         top_k):
+        for sql, bucket in coldstart.journal_entries(
+                self._compile_cache_dir, top_k):
             try:
-                prep = self.prepare(sql)
+                session = None
+                if bucket:
+                    # a journaled page bucket means the statement ran
+                    # on a paged plane (streamed or spill); re-derive
+                    # that shape rather than the resident/distributed
+                    # plan a fresh default session might pick
+                    session = self.session()
+                    session.vars.set("distsql", "off")
+                    session.vars.set("streaming_page_rows", bucket)
+                prep = self.prepare(sql, session)
                 # jax.jit compiles at first CALL, not at prepare:
-                # dispatch once (resident plans only — paged/spill
-                # dispatches run whole pipelines) so the executable
-                # is loaded now, not under the first user query
-                if prep.stream is None and prep.spill is None \
-                        and not isinstance(prep, _RerunPrepared):
+                # dispatch once so the executable is loaded now, not
+                # under the first user query. Paged/spill dispatches
+                # run whole data pipelines, so those warm their
+                # page/partition executables from never-visible
+                # padding batches at the journaled shape bucket
+                # instead (Prepared.warm)
+                if isinstance(prep, _RerunPrepared):
+                    pass
+                elif prep.stream is not None or prep.spill is not None:
+                    prep.warm(bucket)
+                else:
                     jax.block_until_ready(prep.dispatch())
                 warmed += 1
                 coldstart.PREWARMED += 1
@@ -1670,8 +1685,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             "compiled-plan cache lookups, by outcome").inc()
         if cached is None:
             # feed the startup pre-warm: texts that missed here are
-            # what a restarted process should compile first
-            coldstart.journal_record(self._compile_cache_dir, sql_text)
+            # what a restarted process should compile first, at the
+            # shape bucket their paged executables specialize on
+            coldstart.journal_record(
+                self._compile_cache_dir, sql_text,
+                bucket=(stream[2] if stream is not None
+                        else spill.page_rows if spill is not None
+                        else 0))
             # large-G kernel tile point: the per-backend tuning table
             # (or shipped constants); perf-only, bit-identical either
             # way, so deliberately NOT in the cache key above
@@ -1756,6 +1776,15 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             stream_zone = ()
         paged = spill.alias if spill is not None else (
             stream[0] if stream is not None else None)
+        # join-induced skipping (exec/joinfilter.py): specs detected
+        # over THIS prepare's plan; key summaries derive per dispatch
+        from .joinfilter import find_specs
+        if stream is not None:
+            jf_specs = find_specs(node, stream[0], self.store)
+        elif spill is not None and spill.kind == "join":
+            jf_specs = find_specs(node, spill.alias, self.store)
+        else:
+            jf_specs = ()
         prepared = Prepared(self, session, sel, sql_text, jfn, scans,
                             meta, gens, stream=stream,
                             stream_cols=(scan_cols.get(paged)
@@ -1764,7 +1793,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                             as_of=as_of, spill=spill,
                             spill_cols=(scan_cols.get(spill.build_alias)
                                         if spill is not None
-                                        and spill.build_alias else None))
+                                        and spill.build_alias else None),
+                            joinfilter=jf_specs)
         # alias -> table map (composed CTE execution patches temp
         # aliases' scan batches per run, exec/ctecompose.py)
         prepared.scan_tables = dict(scan_aliases)

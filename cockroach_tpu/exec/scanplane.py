@@ -455,24 +455,27 @@ class ScanPlaneMixin:
         return total
 
     def _page_source(self, tname: str, cols, page_rows: int,
-                     zone_preds=()) -> PageSource:
+                     zone_preds=(), read_ts=None) -> PageSource:
         """One-time per-execution setup for streamed paging: seal open
         rows ONCE here (not per page), snapshot the chunk list, and
-        hand the prefix-offset assembler its zone predicates."""
+        hand the prefix-offset assembler its zone predicates plus the
+        read timestamp (chunk MVCC-window skipping)."""
         td = self.store.table(tname)
         if td.open_ts:
             self.store.seal(tname)
         return PageSource(td, cols, page_rows, zone_preds=zone_preds,
-                          metrics=self.metrics)
+                          metrics=self.metrics, read_ts=read_ts)
 
     def _stream_pages(self, tname: str, cols, page_rows: int,
-                      zone_preds=(), pipeline: bool = True):
+                      zone_preds=(), pipeline: bool = True,
+                      read_ts=None):
         """Iterator of fixed-shape device pages of a table's chunks,
         padded to page_rows with never-visible rows so one XLA program
         serves every page. With ``pipeline``, a bounded background
         worker assembles+uploads page i+1 while the caller's device
         work on page i runs; zone-pruned pages never leave the host."""
-        src = self._page_source(tname, cols, page_rows, zone_preds)
+        src = self._page_source(tname, cols, page_rows, zone_preds,
+                                read_ts=read_ts)
         if not pipeline:
             return src.pages()
         return stream_prefetch(
@@ -481,6 +484,46 @@ class ScanPlaneMixin:
                 "exec.stream.prefetch_stall_seconds",
                 "consumer wait per streamed page (0 when the "
                 "prefetch pipeline is ahead of the device)"))
+
+    def _filtered_scan_batch(self, tname: str, filters, read_ts):
+        """Remote-side application of gateway-shipped join-filter
+        frames (distsql/node.py): drop whole chunks whose key set
+        cannot match before anything serializes or uploads. Returns
+        None when nothing prunes (the caller keeps its cached
+        device-table path); otherwise an UNCACHED wide upload of the
+        surviving chunks — correctness is untouched because a dropped
+        chunk's rows would have been dropped by the inner/semi join
+        (or by MVCC) on device anyway."""
+        td = self.store.table(tname)
+        if td.open_ts:
+            self.store.seal(tname)
+        row_w = 16 + sum(
+            np.dtype(c.type.np_dtype).itemsize + 1
+            for c in td.schema.columns)
+        keep, dropped, dropped_bytes = [], 0, 0
+        for c in td.chunks:
+            ok = True
+            if read_ts is not None:
+                ts_min, del_max = c.mvcc_window()
+                ok = ts_min <= read_ts < del_max
+            if ok:
+                ok = all(f.chunk_ok(c, f.col) for f in filters)
+            if ok:
+                keep.append(c)
+            else:
+                dropped += 1
+                dropped_bytes += row_w * c.n
+        if dropped == 0:
+            return None
+        self.metrics.counter(
+            "exec.skip.joinfilter.chunks",
+            "remote scan chunks pruned host-side by a gateway-shipped "
+            "join-filter frame (DistSQL)").inc(dropped)
+        self.metrics.counter(
+            "exec.skip.joinfilter.bytes",
+            "host->device bytes avoided by join-induced skipping"
+        ).inc(dropped_bytes)
+        return self._batch_from_chunks(td, keep)
 
     # -- device table cache --------------------------------------------------
     def _evict_device(self, key) -> None:

@@ -132,6 +132,11 @@ class Prepared:
     # column set (the probe's rides stream_cols)
     spill: Optional[object] = None
     spill_cols: Optional[frozenset] = None
+    # join-induced skipping (exec/joinfilter.py): JoinFilterSpecs
+    # detected at prepare over the streamed/spilled probe alias; each
+    # dispatch derives the build-side key summary at its read
+    # timestamp and feeds it into the probe's zone predicates
+    joinfilter: tuple = ()
 
     def _refresh(self) -> "Prepared":
         cur = tuple((t, self.engine.store.table(t).generation)
@@ -149,8 +154,30 @@ class Prepared:
         self.stream, self.stream_cols = p.stream, p.stream_cols
         self.stream_zone = p.stream_zone
         self.spill, self.spill_cols = p.spill, p.spill_cols
+        self.joinfilter = p.joinfilter
         self.as_of = p.as_of  # keep guard + execution timestamps
         # consistent (interval forms re-resolve on refresh)
+
+    def _join_filters(self, tsv) -> tuple:
+        """Derive this dispatch's semi-join filters (join-induced
+        data skipping, exec/joinfilter.py). ``SET join_filter =
+        auto|on|off``: off is the bench A/B arm, on lifts auto's
+        build-size cap."""
+        if not self.joinfilter:
+            return ()
+        mode = self.session.vars.get("join_filter", "auto")
+        if isinstance(mode, bool):
+            mode = "on" if mode else "off"
+        mode = str(mode).lower()
+        if mode not in ("auto", "on"):
+            return ()
+        from . import joinfilter as jf
+        out = []
+        for spec in self.joinfilter:
+            f = jf.derive(self.engine, spec, int(tsv), mode)
+            if f is not None:
+                out.append(f)
+        return tuple(out)
 
     def dispatch(self, read_ts: Optional[Timestamp] = None,
                  nparts: int = 1, pid: int = 0) -> ColumnBatch:
@@ -185,9 +212,14 @@ class Prepared:
         scans = dict(self.scans)
         pipeline = self.session.vars.get("streaming_pipeline",
                                          "on") != "off"
+        zpreds = self.stream_zone
+        filters = self._join_filters(tsv)
+        if filters:
+            from .joinfilter import zone_pred
+            zpreds = zpreds + tuple(zone_pred(f) for f in filters)
         pages = self.engine._stream_pages(
             tname, self.stream_cols, page_rows,
-            zone_preds=self.stream_zone, pipeline=pipeline)
+            zone_preds=zpreds, pipeline=pipeline, read_ts=int(tsv))
         try:
             for page in pages:
                 scans[_alias] = page
@@ -205,6 +237,49 @@ class Prepared:
                 tname, self.stream_cols, page_rows).empty_page()
             state = fns.page(scans, tsv)
         return fns.final(state)
+
+    def warm(self, bucket: int = 0) -> None:
+        """Compile this statement's streamed-page / spill-partition
+        executables without touching real data (Engine.prewarm): run
+        one never-visible padding batch at the journaled shape
+        ``bucket`` through the page/combine/final pipeline — the
+        empty-page path every all-pages-skipped execution already
+        exercises, so the traced program is exactly the one real
+        dispatches reuse."""
+        import jax
+        tsv = np.int64(self.engine._read_ts(self.session).to_int())
+        scans = dict(self.scans)
+        if self.spill is not None and self.spill.kind == "join":
+            sp = self.spill
+            psrc = self.engine._page_source(
+                sp.table, self.stream_cols, sp.page_rows)
+            bsrc = self.engine._page_source(
+                sp.build_table, self.spill_cols, 1024)
+            bpad = bucket or self.engine._row_bucket(1)
+            scans[sp.build_alias] = bsrc.gather_batch(
+                np.zeros(0, dtype=np.int64), bpad)
+            scans[sp.alias] = psrc.empty_page()
+            s = self.jfn.page(scans, tsv)
+            s = self.jfn.combine(s, s)
+            jax.block_until_ready(self.jfn.final(s))
+            return
+        if self.spill is not None:  # spill-sort: one per-run program
+            sp = self.spill
+            src = self.engine._page_source(
+                sp.table, self.stream_cols, sp.page_rows)
+            scans[sp.alias] = src.empty_page()
+            jax.block_until_ready(self.jfn(scans, tsv))
+            return
+        if self.stream is not None:
+            _alias, tname, page_rows = self.stream
+            src = self.engine._page_source(
+                tname, self.stream_cols, bucket or page_rows)
+            scans[_alias] = src.empty_page()
+            s = self.jfn.page(scans, tsv)
+            s = self.jfn.combine(s, s)
+            jax.block_until_ready(self.jfn.final(s))
+            return
+        jax.block_until_ready(self.dispatch())
 
     def run(self, read_ts: Optional[Timestamp] = None) -> "Result":
         tracer = self.engine.tracer

@@ -190,6 +190,27 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
         *_host_key_cols(bsrc, sp.build_keys), sp.nparts)
     pidx = _partition_indices(ppids, sp.nparts)
     bidx = _partition_indices(bpids, sp.nparts)
+
+    # join-induced skipping at row grain: the partitioner already
+    # materialized the probe's stored key columns, so a derived
+    # semi-join filter prunes non-matching rows from the partition
+    # index arrays before any gather/upload (inner/semi only — those
+    # rows would be dropped by the join on device anyway)
+    filters = prep._join_filters(tsv)
+    if filters:
+        keep = None
+        for f in filters:
+            cols, valids = _host_key_cols(psrc, (f.col,))
+            k = f.rows_ok(cols[0], valids[0])
+            keep = k if keep is None else (keep & k)
+        if keep is not None and not keep.all():
+            n_dropped = int(len(keep) - keep.sum())
+            engine.metrics.counter(
+                "exec.skip.joinfilter.rows",
+                "spill-join probe rows pruned host-side by a "
+                "semi-join filter (never gathered or uploaded)"
+            ).inc(n_dropped)
+            pidx = [ix[keep[ix]] for ix in pidx]
     # ONE shared shape-ladder bucket for every build partition: jit
     # retraces per input shape, so a shared pad means one XLA program
     # serves the whole sweep (and steady-state re-runs reuse it); the
@@ -198,6 +219,12 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
     # share executables with them across processes too
     bpad = engine._row_bucket(max(len(ix) for ix in bidx))
     bbytes = _batch_bytes(bsrc, bpad)
+    # journal the build-partition bucket so Engine.prewarm can compile
+    # the partition-sweep executable at the right shape next process
+    # (exec/coldstart.journal_entries)
+    from . import coldstart
+    coldstart.journal_record(engine._compile_cache_dir, prep.sql_text,
+                             bucket=bpad)
 
     busy = [0.0]
 
@@ -324,7 +351,8 @@ def run_spill_sort(engine, prep, tsv):
 
     src = engine._page_source(sp.table, prep.stream_cols,
                               sp.page_rows,
-                              zone_preds=prep.stream_zone)
+                              zone_preds=prep.stream_zone,
+                              read_ts=int(tsv))
     busy = [0.0]
 
     def feed():

@@ -64,9 +64,19 @@ class ZonePred:
     means the whole page is skippable. ``lo``/``hi`` may be None
     (unknown bounds — checks must return True unless nvalid rules the
     page out on its own). ``col`` is None for row-independent
-    conjuncts (a constant-folded FALSE filter skips every page)."""
+    conjuncts (a constant-folded FALSE filter skips every page).
+
+    ``member`` optionally refines the range verdict per chunk: an
+    object with ``chunk_ok(chunk, col) -> bool`` (a semi-join filter,
+    exec/joinfilter.JoinFilter) consulted only when the range check
+    passes — False means no key of that chunk can match. ``joinfilter``
+    marks predicates derived from a join build side so skips they
+    cause are attributed to exec.skip.joinfilter.* instead of the
+    plain scan-predicate family."""
     col: object   # stored column name, or None (row-independent)
     check: object
+    member: object = None
+    joinfilter: bool = False
 
 
 def _cmp_check(op: str, v):
@@ -248,9 +258,14 @@ class PageSource:
     double allocation, no per-page chunk-list rescan."""
 
     def __init__(self, td, cols, page_rows: int, zone_preds=(),
-                 metrics=None):
+                 metrics=None, read_ts=None):
         self.chunks = list(td.chunks)
         self.page_rows = page_rows
+        # MVCC window skipping (AS OF SYSTEM TIME / TTL / CDC): a
+        # chunk whose seal-time [ts_min, del_max) window excludes
+        # read_ts holds no visible version at all (storage/chunkstats
+        # docstring has the no-invalidation argument)
+        self.read_ts = None if read_ts is None else int(read_ts)
         self.offs = np.zeros(len(self.chunks) + 1, dtype=np.int64)
         if self.chunks:
             np.cumsum([c.n for c in self.chunks], out=self.offs[1:])
@@ -265,6 +280,8 @@ class PageSource:
             16 + sum(d.itemsize + 1 for d in self.dtypes.values()))
         self._m_pages = self._m_skipped = None
         self._m_bytes = self._m_bytes_skipped = None
+        self._m_jf_pages = self._m_jf_bytes = None
+        self._m_mv_pages = self._m_mv_bytes = None
         if metrics is not None:
             self._m_pages = metrics.counter(
                 "exec.stream.pages", "streamed pages uploaded to HBM")
@@ -277,6 +294,20 @@ class PageSource:
             self._m_bytes_skipped = metrics.counter(
                 "exec.stream.bytes_skipped",
                 "host->device bytes avoided by zone-map page skipping")
+            self._m_jf_pages = metrics.counter(
+                "exec.skip.joinfilter.pages",
+                "streamed pages pruned by a semi-join filter derived "
+                "from a hash-join build side")
+            self._m_jf_bytes = metrics.counter(
+                "exec.skip.joinfilter.bytes",
+                "host->device bytes avoided by join-induced skipping")
+            self._m_mv_pages = metrics.counter(
+                "exec.skip.mvcc.pages",
+                "streamed pages pruned by the chunk MVCC window "
+                "(every version outside the read timestamp)")
+            self._m_mv_bytes = metrics.counter(
+                "exec.skip.mvcc.bytes",
+                "host->device bytes avoided by MVCC window skipping")
         # one preallocated buffer set, reused for every page: the
         # upload goes through jnp.array (copy=True), which owns its
         # copy before returning, so refilling the host buffers can
@@ -293,22 +324,42 @@ class PageSource:
         return bufs
 
     def _page_zone_ok(self, i0: int, i1: int) -> bool:
-        """May rows [chunks i0..i1) satisfy every pushed-down
-        conjunct? Chunk zones are supersets of any partial overlap,
-        so combining them stays conservative."""
+        ok, _ = self._page_verdict(i0, i1)
+        return ok
+
+    def _page_mvcc_ok(self, i0: int, i1: int) -> bool:
+        """May any chunk in [i0..i1) hold a version visible at
+        read_ts? Seal-time windows only: ts_min is exact forever and
+        del_max only shrinks after seal, so the stored bound stays a
+        valid upper bound (storage/chunkstats)."""
+        rts = self.read_ts
+        for ci in range(i0, i1):
+            ts_min, del_max = self.chunks[ci].mvcc_window()
+            if ts_min <= rts < del_max:
+                return True
+        return False
+
+    def _page_verdict(self, i0: int, i1: int):
+        """(may_match, by_joinfilter) for rows [chunks i0..i1) against
+        every pushed-down conjunct. Chunk zones are supersets of any
+        partial overlap, so combining them stays conservative; a
+        pred's ``member`` refines the range verdict chunk by chunk
+        (the page survives if ANY chunk's key set may match)."""
         for p in self.zone_preds:
             if p.col is None:  # row-independent (constant FALSE)
                 if not p.check(None, None, 0, 0):
-                    return False
+                    return False, p.joinfilter
                 continue
             lo = hi = None
             nulls = nvalid = 0
             unknown = False
+            absent = False
             for ci in range(i0, i1):
                 try:
                     zlo, zhi, zn, zv = self.chunks[ci].zone(p.col)
                 except KeyError:
-                    return True  # column absent (shouldn't happen)
+                    absent = True  # column absent (shouldn't happen)
+                    break
                 nulls += zn
                 nvalid += zv
                 if zv > 0:
@@ -317,25 +368,51 @@ class PageSource:
                     else:
                         lo = zlo if lo is None else min(lo, zlo)
                         hi = zhi if hi is None else max(hi, zhi)
+            if absent:
+                continue
             if unknown:
                 lo = hi = None
             if not p.check(lo, hi, nulls, nvalid):
-                return False
-        return True
+                return False, p.joinfilter
+            if p.member is not None and not unknown:
+                try:
+                    if not any(p.member.chunk_ok(self.chunks[ci], p.col)
+                               for ci in range(i0, i1)):
+                        return False, p.joinfilter
+                except Exception:
+                    pass  # membership is an optimization: keep the page
+        return True, False
+
+    def _skip_page(self, by_joinfilter: bool, mvcc: bool = False):
+        if self._m_skipped is not None:
+            self._m_skipped.inc()
+            self._m_bytes_skipped.inc(self.page_bytes)
+            if mvcc:
+                self._m_mv_pages.inc()
+                self._m_mv_bytes.inc(self.page_bytes)
+            elif by_joinfilter:
+                self._m_jf_pages.inc()
+                self._m_jf_bytes.inc(self.page_bytes)
 
     def pages(self):
-        """Yield device ColumnBatch pages, skipping zone-pruned ones."""
+        """Yield device ColumnBatch pages, skipping zone-pruned and
+        MVCC-window-excluded ones."""
         start = 0
         while start < self.total:
             end = min(start + self.page_rows, self.total)
             i0 = int(np.searchsorted(self.offs, start, side="right")) - 1
             i1 = int(np.searchsorted(self.offs, end, side="left"))
-            if self.zone_preds and not self._page_zone_ok(i0, i1):
-                if self._m_skipped is not None:
-                    self._m_skipped.inc()
-                    self._m_bytes_skipped.inc(self.page_bytes)
+            if self.read_ts is not None \
+                    and not self._page_mvcc_ok(i0, i1):
+                self._skip_page(False, mvcc=True)
                 start = end
                 continue
+            if self.zone_preds:
+                ok, jf = self._page_verdict(i0, i1)
+                if not ok:
+                    self._skip_page(jf)
+                    start = end
+                    continue
             yield self._assemble(start, end, i0, i1)
             start = end
 

@@ -352,7 +352,8 @@ def gen_region() -> dict:
 
 
 def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
-         rows: int | None = None, encoded: bool = False) -> None:
+         rows: int | None = None, encoded: bool = False,
+         chunk_rows: int | None = None) -> None:
     """Create + bulk-ingest TPC-H tables into an Engine.
 
     ``rows`` caps the *lineitem* row count only (CI-speed slices);
@@ -360,7 +361,11 @@ def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
     key spaces stay consistent with gen_lineitem's foreign keys.
     ``encoded`` uses the pre-encoded string fast path (same numeric
     data and returnflag/linestatus values as the object path for a
-    given seed, so the numpy oracles still agree)."""
+    given seed, so the numpy oracles still agree).
+    ``chunk_rows`` splits each table across multiple ingest batches of
+    that many rows instead of one monolithic chunk — the shape a real
+    write path produces, and the one that gives write-time zone maps
+    per-chunk key ranges narrow enough to skip on."""
     ts = engine.clock.now()
     gens = {
         "part": lambda: gen_part(sf),
@@ -380,7 +385,14 @@ def load(engine, sf: float, seed: int = 0, tables=("lineitem", "part"),
             cols = gen_lineitem(sf, seed=seed, rows=rows, encoded=encoded)
         else:
             cols = gens[t]()
-        engine.store.insert_columns(t, cols, ts)
+        if chunk_rows:
+            n = len(next(iter(cols.values())))
+            for lo in range(0, n, chunk_rows):
+                engine.store.insert_columns(
+                    t, {k: v[lo:lo + chunk_rows]
+                        for k, v in cols.items()}, ts)
+        else:
+            engine.store.insert_columns(t, cols, ts)
         # column stats unlock the memo's cost-based join ordering
         # (sql/memo.py engages only with distinct counts; the
         # reference's workloads rely on auto-stats the same way)

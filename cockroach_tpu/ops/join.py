@@ -62,6 +62,24 @@ def radix_partition_ids(cols, valids, nparts: int) -> np.ndarray:
     return (h >> shift).astype(np.int64)
 
 
+def summarize_build_keys(keys: np.ndarray, key_cap: int):
+    """Semi-join filter summary of one build side's visible key set
+    (exec/joinfilter.py): ``(lo, hi, sorted_unique_keys | None,
+    bloom | None)``. Small key sets stay exact (never a false
+    positive); above ``key_cap`` a blocked bloom stands in — still
+    never false-NEGATIVE, which is the property join-induced skipping
+    rests on: a page/chunk is only dropped when NO build key can
+    match it."""
+    from ..storage.chunkstats import BlockedBloom
+    keys = np.unique(keys.astype(np.int64, copy=False))
+    lo, hi = int(keys[0]), int(keys[-1])
+    if len(keys) <= key_cap:
+        return lo, hi, keys, None
+    bl = BlockedBloom(len(keys))
+    bl.add(keys)
+    return lo, hi, None, bl
+
+
 def hash_join(probe: ColumnBatch, build: ColumnBatch,
               probe_keys: list[str], build_keys: list[str],
               build_payload: list[str], join_type: str = "inner",

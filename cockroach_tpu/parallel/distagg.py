@@ -282,6 +282,18 @@ def queued_collective_call(jfn, metrics=None, mesh=None):
                     raise CollectiveFault(
                         "fault injection dropped a collective "
                         "dispatch")
+            # per-link shuffle rules (parallel/shuffle.py): the
+            # exchange's D*(D-1) directed links each carry their own
+            # drop/dup/delay rule, aggregated host-side at dispatch
+            from .shuffle import link_fault_plan
+            lp = link_fault_plan()
+            if lp is not None:
+                if not lp:
+                    raise CollectiveFault(
+                        "fault injection dropped a shuffle link")
+                merged = max(len(deliveries), len(lp))
+                dly = max(deliveries[0], lp[0])
+                deliveries = [dly] + [0.0] * (merged - 1)
             out = None
             for d in deliveries:
                 if d:

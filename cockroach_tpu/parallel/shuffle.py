@@ -36,6 +36,54 @@ import jax.numpy as jnp
 from ..ops.hashtable import _hash_columns
 from .mesh import SHARD_AXIS
 
+# ---------------------------------------------------------------------------
+# per-link fault injection
+# ---------------------------------------------------------------------------
+# The all_to_all is one fused collective, but physically it is D*(D-1)
+# directed ICI links; a chaos drill wants rules per link ("shard 0 ->
+# shard 2 drops"), not one blanket rule. The exchange itself runs
+# inside a jitted SPMD program, so faults cannot fire mid-collective —
+# they are evaluated host-side at dispatch time (distagg
+# queued_collective_call) and aggregated: a dropped link loses that
+# block of the exchange, which makes the WHOLE collective result wrong,
+# so any dropped link faults the dispatch (CollectiveFault -> the
+# session's distsql-off recovery ladder); dup and delay degrade to
+# a duplicate dispatch / the worst link's delay.
+
+_LINK_FAULTS = None  # (rpc.context.FaultInjector, n_shards) or None
+
+
+def install_link_faults(injector, n_shards: int) -> None:
+    """Register per-link fault rules for the shuffle exchange. Rules
+    are keyed ``("shard:<s>", "shard:<d>")`` in the injector; pass
+    None to heal."""
+    global _LINK_FAULTS
+    _LINK_FAULTS = ((injector, int(n_shards))
+                    if injector is not None else None)
+
+
+def link_fault_plan():
+    """Aggregate every directed shard-pair's fault rule into one
+    dispatch plan (FaultInjector.plan semantics: [] drop, [0.0]
+    deliver, [0.0, 0.0] dup, [s] delay). None when no injector is
+    installed — the zero-overhead default."""
+    lf = _LINK_FAULTS
+    if lf is None:
+        return None
+    inj, n = lf
+    delay = 0.0
+    dup = False
+    for s in range(n):
+        for d in range(n):
+            if s == d:
+                continue  # self-block never leaves the chip
+            plan = inj.plan(f"shard:{s}", f"shard:{d}")
+            if not plan:
+                return []  # one lost link corrupts the exchange
+            delay = max(delay, plan[0])
+            dup = dup or len(plan) > 1
+    return [delay, 0.0] if dup else [delay]
+
 
 def dest_of(key_cols: tuple, n_shards: int) -> jnp.ndarray:
     """Destination shard per row: hash(keys) % n_shards, decorrelated

@@ -34,6 +34,7 @@ from typing import Optional
 import numpy as np
 
 from ..sql.types import ColumnSchema, Family, TableSchema
+from . import chunkstats
 from .hlc import MAX_TIMESTAMP, Timestamp
 
 MAX_TS_INT = MAX_TIMESTAMP.to_int()
@@ -86,17 +87,61 @@ class Chunk:
     # declared primary key (the reference synthesizes a rowid column
     # the same way, pkg/sql/catalog/tabledesc)
     rowid: Optional[np.ndarray] = None
-    # lazy per-column zone maps (sstable block-property collectors /
-    # the reference's crdb_internal_mvcc-free span stats): column
-    # data is immutable once the chunk is sealed, so a computed
-    # summary stays valid for the chunk's lifetime. mvcc_del IS
-    # mutable (tombstones), but zones summarize data columns only —
-    # a deleted row's value still bounds the zone, which keeps
-    # skipping conservative under any read timestamp.
+    # per-column zone maps (sstable block-property collectors / the
+    # reference's crdb_internal_mvcc-free span stats): column data is
+    # immutable once the chunk is sealed, so a computed summary stays
+    # valid for the chunk's lifetime. mvcc_del IS mutable
+    # (tombstones), but zones summarize data columns only — a deleted
+    # row's value still bounds the zone, which keeps skipping
+    # conservative under any read timestamp. Populated at SEAL time
+    # by finalize_stats (storage/chunkstats.py) on every creation
+    # path; the in-method computation below survives only as a
+    # fallback for directly-constructed chunks (tests).
     _zones: dict = field(default_factory=dict, repr=False, compare=False)
+    # seal-time ChunkStats (blooms, distinct sketches, MVCC window);
+    # None only for chunks that never went through a store path
+    _stats: Optional[object] = field(default=None, repr=False,
+                                     compare=False)
 
     def live_mask(self, ts: int) -> np.ndarray:
         return (self.mvcc_ts <= ts) & (ts < self.mvcc_del)
+
+    def finalize_stats(self) -> None:
+        """Build the write-time summaries (zones + blooms + distinct
+        sketches + MVCC window) for this chunk. Called by every store
+        path that creates or rebuilds a chunk, so the scan plane never
+        has to compute a zone on demand."""
+        st = chunkstats.compute(self.data, self.valid,
+                                self.mvcc_ts, self.mvcc_del)
+        self._stats = st
+        self._zones.update(st.zones)
+
+    def stats_ready(self) -> bool:
+        return self._stats is not None
+
+    def key_bloom(self, col: str):
+        """Seal-time blocked bloom over `col`'s valid values (int
+        family / dict codes only), or None."""
+        st = self._stats
+        return st.blooms.get(col) if st is not None else None
+
+    def distinct_sketch(self, col: str):
+        st = self._stats
+        return st.distinct.get(col) if st is not None else None
+
+    def mvcc_window(self) -> tuple[int, int]:
+        """(ts_min, del_max): nothing in this chunk is visible at
+        read_ts when ts_min > read_ts or del_max <= read_ts. ts_min
+        is exact forever (mvcc_ts is sealed-immutable); del_max is the
+        seal-time max and stays a valid UPPER bound because tombstones
+        only ever lower mvcc_del — so no invalidation is needed when
+        later deletes land on this chunk."""
+        st = self._stats
+        if st is not None:
+            return st.ts_min, st.del_max
+        if self.n == 0:
+            return 0, 0
+        return int(self.mvcc_ts.min()), int(self.mvcc_del.max())
 
     def zone(self, col: str):
         """(lo, hi, null_count, valid_count) over this chunk's valid
@@ -287,6 +332,7 @@ class ColumnStore:
                           mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64),
                           n=n,
                           rowid=np.arange(rid0, rid0 + n, dtype=np.int64))
+            chunk.finalize_stats()
             td.chunks.append(chunk)
             td.pk_index = None  # rebuilt lazily if DML touches this table
             td.generation += 1
@@ -350,11 +396,13 @@ class ColumnStore:
             # caller that bypassed insert_rows: allocate fresh ids
             td.open_rowids = list(range(td.next_rowid, td.next_rowid + n))
             td.next_rowid += n
-        td.chunks.append(Chunk(
+        chunk = Chunk(
             data=data, valid=vmap,
             mvcc_ts=np.asarray(td.open_ts, dtype=np.int64),
             mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
-            rowid=np.asarray(td.open_rowids, dtype=np.int64)))
+            rowid=np.asarray(td.open_rowids, dtype=np.int64))
+        chunk.finalize_stats()
+        td.chunks.append(chunk)
         td.open_ts = []
         td.open_rowids = []
 
@@ -407,13 +455,15 @@ class ColumnStore:
             # synthetic-pk rowids came from the decoded keys: future
             # inserts must allocate past them or keys collide
             td.next_rowid = max(td.next_rowid, max(rowids) + 1)
-            td.chunks.append(Chunk(
+            chunk = Chunk(
                 data=data, valid=vmap,
                 mvcc_ts=np.asarray([t for _r, t, _d in versions],
                                    dtype=np.int64),
                 mvcc_del=np.asarray([d for _r, _t, d in versions],
                                     dtype=np.int64), n=n,
-                rowid=np.asarray(rowids, dtype=np.int64)))
+                rowid=np.asarray(rowids, dtype=np.int64))
+            chunk.finalize_stats()
+            td.chunks.append(chunk)
             td.pk_index = None
             td.generation += 1
         return n
@@ -465,13 +515,15 @@ class ColumnStore:
                 n = len(next(iter(data.values())))
                 rid0 = td.next_rowid
                 td.next_rowid += n
-                td.chunks.append(Chunk(
+                chunk = Chunk(
                     data={k: np.asarray(v) for k, v in data.items()},
                     valid={k: np.asarray(v, dtype=bool)
                            for k, v in vmap.items()},
                     mvcc_ts=np.full(n, tsi, dtype=np.int64),
                     mvcc_del=np.full(n, MAX_TS_INT, dtype=np.int64), n=n,
-                    rowid=np.arange(rid0, rid0 + n, dtype=np.int64)))
+                    rowid=np.arange(rid0, rid0 + n, dtype=np.int64))
+                chunk.finalize_stats()
+                td.chunks.append(chunk)
             td.pk_index = None
             td.generation += 1
         return updated
@@ -535,6 +587,13 @@ class ColumnStore:
                 chunk.data[colname] = np.full(n, v,
                                               dtype=col.type.np_dtype)
                 chunk.valid[colname] = np.ones(n, dtype=bool)
+            if chunk._stats is not None:
+                chunkstats.extend(chunk._stats, colname,
+                                  chunk.data[colname],
+                                  chunk.valid[colname])
+                chunk._zones[colname] = chunk._stats.zones[colname]
+            else:
+                chunk.finalize_stats()
             td.generation += 1
             return True
 
@@ -572,6 +631,11 @@ class ColumnStore:
             for c in td.chunks:
                 c.data.pop(colname, None)
                 c.valid.pop(colname, None)
+                c._zones.pop(colname, None)
+                if c._stats is not None:
+                    c._stats.zones.pop(colname, None)
+                    c._stats.blooms.pop(colname, None)
+                    c._stats.distinct.pop(colname, None)
             td._codec = None
             td.pk_index = None
             td.generation += 1
@@ -1077,14 +1141,20 @@ class ColumnStore:
                     continue
                 removed += drop
                 if keep.any():
-                    new_chunks.append(Chunk(
+                    # compaction: the rebuilt chunk recomputes its
+                    # write-time summaries (zones, blooms, sketches,
+                    # MVCC window) — the invalidation story is
+                    # "rebuild recomputes", never "patch in place"
+                    nc = Chunk(
                         data={k: v[keep] for k, v in chunk.data.items()},
                         valid={k: v[keep] for k, v in chunk.valid.items()},
                         mvcc_ts=chunk.mvcc_ts[keep],
                         mvcc_del=chunk.mvcc_del[keep],
                         n=int(keep.sum()),
                         rowid=(chunk.rowid[keep]
-                               if chunk.rowid is not None else None)))
+                               if chunk.rowid is not None else None))
+                    nc.finalize_stats()
+                    new_chunks.append(nc)
             td.chunks = new_chunks
             td.pk_index = None
             td.generation += 1

@@ -184,6 +184,14 @@ class SessionVars:
         # budget; on: force spill whenever the plan shape is eligible;
         # off: escape hatch / bench A/B lever
         "spill": "auto",             # auto | on | off
+        # join-induced data skipping (exec/joinfilter.py): summarize
+        # the build side of an inner/semi hash join (min/max + exact
+        # keys or bloom) and skip probe-side pages/chunks/rows that
+        # cannot match. auto (default): derive when the build is
+        # small enough to summarize cheaply; on: always derive; off:
+        # escape hatch / bench A/B lever. Results are bit-identical
+        # in every mode — the filter is never false-negative.
+        "join_filter": "auto",       # auto | on | off
         # SET tracing = off | on | cluster (exec/engine.py): on
         # records each statement gateway-locally for SHOW TRACE FOR
         # SESSION; cluster additionally requests remote recordings

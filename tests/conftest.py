@@ -51,13 +51,17 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
-def _hermetic_coldstart(tmp_path, monkeypatch):
-    """Route each test's compile cache + tuning table + shapes journal
-    into its own tmpdir, and assert nothing leaked into the user's
-    default cache root (the on-disk state must be opt-in for tests)."""
+def _hermetic_coldstart(tmp_path_factory, monkeypatch):
+    """Route compile cache + tuning table + shapes journal into one
+    SESSION-scoped tmpdir (still hermetic — nothing may leak into the
+    user's default cache root; on-disk state stays opt-in for tests).
+    Sharing the dir across tests lets later tests deserialize XLA
+    programs earlier tests already compiled, which is what keeps the
+    tier-1 wall clock inside its budget. Tests that need a cold cache
+    (e.g. cache-miss assertions) set their own dir on top of this."""
     from cockroach_tpu.exec import coldstart
-    monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR",
-                       str(tmp_path / "coldstate"))
+    shared = tmp_path_factory.getbasetemp() / "coldstate-shared"
+    monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR", str(shared))
     default_root = coldstart.default_cache_root()
     existed_before = os.path.exists(default_root)
     yield

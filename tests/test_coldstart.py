@@ -80,7 +80,12 @@ class TestCompileCachePlumbing:
         assert jax.default_backend() in \
             os.path.basename(eng._compile_cache_dir)
 
-    def test_compile_metrics_move_on_first_compile(self):
+    def test_compile_metrics_move_on_first_compile(
+            self, tmp_path, monkeypatch):
+        # needs a genuinely cold cache (the suite-shared dir may
+        # already hold this statement's programs)
+        monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cold"))
         eng = Engine()
         eng.execute("CREATE TABLE cm (v INT)")
         eng.execute("INSERT INTO cm VALUES (1), (2), (3)")
@@ -140,7 +145,11 @@ class TestCompileCachePlumbing:
                      "mean_exec_s"):
             assert isinstance(getattr(s, attr), float)
 
-    def test_journal_and_prewarm(self):
+    def test_journal_and_prewarm(self, tmp_path, monkeypatch):
+        # private cache: the suite-shared journal holds other tests'
+        # statements, which would crowd out this one's top-k slot
+        monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "jw"))
         eng = Engine()
         eng.execute("CREATE TABLE jw (k INT, v INT)")
         eng.execute("INSERT INTO jw VALUES (1, 10), (2, 20), (3, 30)")
