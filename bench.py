@@ -696,6 +696,185 @@ def run_dispatchq(rows, workers=2, iters=6):
     return serial_qps, conc_qps
 
 
+def run_concurrency(rows, sessions=(1, 8, 32, 100)):
+    """Multi-tenant front door (round 11 tentpole): N concurrent
+    sessions drive a YCSB-E + TPC-H-shaped q3/q6 mix through the
+    admission front door, sub-mesh dispatch on (auto) vs off. The
+    analytic statements vary their literals per op, so steady state
+    also rides the statement-shape plan cache (one trace per shape,
+    not per literal). A distributed-only rung at 8 sessions isolates
+    the sub-mesh concurrency win; at the 100-session rung the shed
+    thresholds arm and half the sessions run low-priority — their
+    rejections must be clean (counted, never stalled) while admitted
+    work's p99 stays bounded."""
+    import threading as _th
+
+    import numpy as _np
+
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.parallel.mesh import make_mesh
+    from cockroach_tpu.utils.admission import AdmissionRejected
+    from cockroach_tpu.workload.ycsb import YCSB
+
+    eng = Engine(mesh=make_mesh())
+    ndev = eng.mesh.devices.size
+    t0 = time.time()
+    tpch.load(eng, sf=rows / tpch.LINEITEM_PER_SF, rows=rows,
+              tables=("lineitem", "orders"), encoded=True)
+    YCSB(eng, workload="E", records=4000, seed=1).setup()
+    print(f"# concurrency datagen_s={time.time() - t0:.1f} "
+          f"rows={rows} devices={ndev}", file=sys.stderr)
+
+    def q6_text(rng):
+        return ("SELECT sum(l_extendedprice * l_discount) "
+                "FROM lineitem WHERE l_quantity < "
+                f"{int(rng.integers(20, 40))}")
+
+    def q3_text(rng):
+        return ("SELECT o_orderkey, sum(l_extendedprice) AS rev "
+                "FROM lineitem JOIN orders ON l_orderkey = o_orderkey "
+                f"WHERE l_quantity > {int(rng.integers(5, 30))} "
+                "GROUP BY o_orderkey ORDER BY rev DESC, o_orderkey "
+                "LIMIT 10")
+
+    # warm every executable OUTSIDE the timed rungs: the full-mesh
+    # programs, then each sub-mesh's own trace at every size auto can
+    # pick (round-robin acquisition covers all domains of a size)
+    rng0 = _np.random.default_rng(0)
+    warm = [q6_text(rng0), q3_text(rng0)]
+    eng.settings.set("sql.exec.submesh.size", "off")
+    parity = [eng.execute(q).rows for q in warm]
+    size = ndev // 2
+    while size >= 1:
+        eng.settings.set("sql.exec.submesh.size", str(size))
+        for _ in range(ndev // size):
+            got = [eng.execute(q).rows for q in warm]
+            assert got == parity, f"sub-mesh size {size} drifted"
+        size //= 2
+    print("# concurrency warmup done, parity held across sizes",
+          file=sys.stderr)
+
+    results = {"conc_parity": True}
+    rung = 0
+    for arm in ("off", "auto"):
+        eng.settings.set("sql.exec.submesh.size", arm)
+        for n in sessions:
+            rung += 1
+            iters = max(2, 64 // n)
+            shed_armed = n >= 100
+            if shed_armed:
+                eng.settings.set("sql.admission.shed.queue_depth", 48)
+            lat = {"ycsb": [], "q6": [], "q3": []}
+            rejects = [0]
+            errors: list = []
+            lock = _th.Lock()
+
+            def worker(idx, iters=iters, shed_armed=shed_armed,
+                       lat=lat, rejects=rejects, errors=errors,
+                       rung=rung):
+                try:
+                    s = eng.session()
+                    if shed_armed and idx % 2 == 1:
+                        s.vars.set("admission_priority", "low")
+                    rng = _np.random.default_rng(7000 + idx)
+                    d = YCSB(eng, workload="E", records=4000,
+                             seed=2000 + idx)
+                    # disjoint insert keyspace per (rung, worker):
+                    # every rung builds fresh drivers, so the offset
+                    # must never repeat across rungs either
+                    d.next_key = 4000 + \
+                        (rung * 128 + idx + 1) * 1_000_000
+                    for _ in range(iters):
+                        r = rng.random()
+                        t1 = time.monotonic()
+                        try:
+                            if r < 0.5:
+                                d.step()
+                                kind = "ycsb"
+                            elif r < 0.8:
+                                eng.execute(q6_text(rng), s)
+                                kind = "q6"
+                            else:
+                                eng.execute(q3_text(rng), s)
+                                kind = "q3"
+                        except AdmissionRejected:
+                            with lock:
+                                rejects[0] += 1
+                            continue
+                        with lock:
+                            lat[kind].append(time.monotonic() - t1)
+                except BaseException as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [_th.Thread(target=worker, args=(i,))
+                       for i in range(n)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.time() - t0
+            if errors:
+                raise errors[0]
+            done = sum(len(v) for v in lat.values())
+            ops = done / wall if wall else 0.0
+            ana = sorted(lat["q6"] + lat["q3"])
+            p50 = p99 = 0.0
+            if ana:
+                p50 = ana[len(ana) // 2] * 1000
+                p99 = ana[min(len(ana) - 1,
+                              int(len(ana) * 0.99))] * 1000
+            key = f"conc_{arm}_{n}"
+            results[f"{key}_ops_per_sec"] = round(ops, 1)
+            results[f"{key}_p50_ms"] = round(p50, 1)
+            results[f"{key}_p99_ms"] = round(p99, 1)
+            if shed_armed:
+                results[f"{key}_rejected"] = rejects[0]
+                eng.settings.set("sql.admission.shed.queue_depth", 0)
+            print(f"# concurrency arm={arm} n={n} "
+                  f"ops_per_sec={ops:.1f} p50_ms={p50:.1f} "
+                  f"p99_ms={p99:.1f} rejected={rejects[0]}",
+                  file=sys.stderr)
+
+    # distributed-only rung: 8 sessions of small distributed q6
+    # variants — the shape the sub-mesh pool exists for
+    dist = {}
+    for arm in ("off", "auto"):
+        eng.settings.set("sql.exec.submesh.size", arm)
+        n, iters = 8, 6
+        errors = []
+
+        def dworker(idx, errors=errors):
+            try:
+                s = eng.session()
+                rng = _np.random.default_rng(9000 + idx)
+                for _ in range(6):
+                    eng.execute(q6_text(rng), s)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [_th.Thread(target=dworker, args=(i,))
+                   for i in range(n)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        if errors:
+            raise errors[0]
+        dist[arm] = n * iters / wall if wall else 0.0
+        print(f"# concurrency dist8 arm={arm} "
+              f"qps={dist[arm]:.2f}", file=sys.stderr)
+    eng.settings.set("sql.exec.submesh.size", "auto")
+    results["conc_dist8_off_qps"] = round(dist["off"], 2)
+    results["conc_dist8_auto_qps"] = round(dist["auto"], 2)
+    results["conc_dist8_speedup"] = \
+        round(dist["auto"] / dist["off"], 3) if dist["off"] else 0.0
+    return results
+
+
 def run_coldstart(query: str, rows: int):
     """Leaf: time-to-first-result for one headline query in THIS
     fresh process (round 9 tentpole). Data generation is excluded;
@@ -770,6 +949,16 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
         # cold arm prices the compiler, not a tunnel round trip
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    if mode == "concurrency_child":
+        # the multi-tenant front-door bench measures the CPU-host
+        # mesh (ISSUE round 11); sub-mesh routing needs >1 device
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if mode == "tpcc_child":
         # TPC-C is a HOST path (txn machinery, index fastpaths);
         # statements that do fall to a compiled scan should compile
@@ -923,6 +1112,17 @@ def main():
             "metric": "joinskip_q3_auto_rows_per_sec",
             "value": per.get("joinskip_q3_auto_rows_per_sec", 0),
             "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
+    if mode == "concurrency_child":
+        per = run_concurrency(
+            rows, sessions=tuple(int(x) for x in os.environ.get(
+                "BENCH_CONCURRENCY_SESSIONS", "1,8,32,100").split(",")))
+        print(json.dumps({
+            "metric": "conc_dist8_speedup",
+            "value": per.get("conc_dist8_speedup", 0),
+            "unit": "x", "rows": rows,
             **per,
         }))
         return
@@ -1099,6 +1299,15 @@ def main():
             out["dispatch_serial_qps"] = r["dispatch_serial_qps"]
             out["dispatch_concurrency_speedup"] = \
                 r["dispatch_concurrency_speedup"]
+    if os.environ.get("BENCH_CONCURRENCY", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_CONCURRENCY_ROWS",
+                                         1 << 17)),
+                      "concurrency", child_timeout,
+                      mode="concurrency_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("conc_")})
+            out.setdefault("concurrency_rows", r["rows"])
     if os.environ.get("BENCH_TPCC", "1") != "0":
         r = run_child(0, "tpcc", 900, mode="tpcc_child")
         if r is not None:

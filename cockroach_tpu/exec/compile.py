@@ -106,20 +106,23 @@ class RunContext:
     disk). nparts=1/pid=0 (or None) means unpartitioned."""
 
     def __init__(self, scans: dict[str, ColumnBatch], read_ts,
-                 nparts=None, pid=None):
+                 nparts=None, pid=None, params: tuple = ()):
         self.scans = scans
         self.read_ts = read_ts
         self.nparts = nparts
         self.pid = pid
+        # runtime statement parameters (exec/planparam.py): literal
+        # scalars the statement-shape plan cache lifted out of filters
+        self.params = params
 
 
 CompiledNode = Callable[[RunContext], ColumnBatch]
 
 
-def _ctx_of(batch: ColumnBatch, aggs=None) -> ExprContext:
+def _ctx_of(batch: ColumnBatch, aggs=None, params: tuple = ()) -> ExprContext:
     cols = {name: (batch.data[i], batch.valid[i])
             for i, name in enumerate(batch.names)}
-    return ExprContext(cols, batch.n, aggs)
+    return ExprContext(cols, batch.n, aggs, params)
 
 
 def compile_plan(node: P.PlanNode, params: ExecParams,
@@ -132,7 +135,7 @@ def compile_plan(node: P.PlanNode, params: ExecParams,
 
         def run_filter(rc):
             b = childf(rc)
-            pv = predf(_ctx_of(b))
+            pv = predf(_ctx_of(b, params=rc.params))
             return b.and_sel(jnp.logical_and(pv[0], pv[1]))
         return run_filter
     if isinstance(node, P.Project):
@@ -228,7 +231,7 @@ def _compile_scan(node: P.Scan, params: ExecParams) -> CompiledNode:
         b = ColumnBatch.from_dict(cols, valid,
                                   sel=jnp.logical_and(raw.sel, live))
         if predf is not None:
-            pv = predf(_ctx_of(b))
+            pv = predf(_ctx_of(b, params=rc.params))
             b = b.and_sel(jnp.logical_and(pv[0], pv[1]))
         for cname, cf in computedf:
             d, v = cf(_ctx_of(b))

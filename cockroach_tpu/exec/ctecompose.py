@@ -163,6 +163,11 @@ def build_composition(engine, session, capture) -> ComposedCTE | None:
     main = capture["preps"][-1]
     if main.stream is not None or main.as_of is not None:
         return None
+    # parameterized programs (statement-shape plan cache) take their
+    # literals as a 5th runtime arg; the composed dispatch is a fixed
+    # 4-arg pipeline, so keep the slow path for those
+    if any(getattr(p, "params", ()) for p in capture["preps"]):
+        return None
     scan_tables = getattr(main, "scan_tables", None)
     if not scan_tables:
         return None

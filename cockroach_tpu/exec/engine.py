@@ -52,6 +52,7 @@ from ..utils.settings import SessionVars, Settings
 from . import coldstart
 from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
+from .planparam import parameterize, plan_fingerprint, shape_text
 from .expr import ExprContext, compile_expr
 from .stream import extract_zone_preds
 from .session import (CompactOverflow, EngineError, HashCapacityExceeded,
@@ -76,6 +77,119 @@ from .fastpath import FastpathMixin  # noqa: E402
 from .maintenance import MaintenanceMixin  # noqa: E402
 from .oltplane import OltpLaneMixin  # noqa: E402
 from .scanplane import ScanPlaneMixin  # noqa: E402
+
+
+class _DistRouter:
+    """Per-dispatch routing of one prepared distributed plan onto the
+    full mesh or a pool sub-mesh (parallel/mesh.py MeshPool).
+
+    Stored in ``_exec_cache`` in place of the jitted callable — it
+    matches the jfn calling convention ``(scans, ts, nparts, pid,
+    lits)`` — and lazily builds one compiled program + dispatcher
+    wrapper per target mesh (the mesh is baked into shard_map, so each
+    sub-mesh traces its own executable; ``psum`` over fewer shards is
+    still exact, keeping results bit-identical across targets).
+
+    Routing policy (sql.exec.submesh.size): ``off`` = always the full
+    mesh (the pre-pool behavior); a power of two = always that
+    sub-mesh size when the working set fits, escalating to larger
+    sizes / the full mesh when it doesn't; ``auto`` = full mesh while
+    the front door is idle, smallest fitting sub-mesh once dispatches
+    are queueing — small queries then run side-by-side on disjoint
+    rendezvous domains instead of serializing behind one dispatch
+    thread."""
+
+    # share of a device's HBM-budget slice a routed plan may occupy
+    FOOTPRINT_FRAC = 0.5
+
+    def __init__(self, engine, node, meta, scan_aliases, decision,
+                 exec_params, upload_spec, sharded_bytes, repl_bytes):
+        self.engine = engine
+        self.node = node
+        self.meta = meta
+        self.scan_aliases = scan_aliases
+        self.decision = decision
+        self.exec_params = exec_params
+        # [(alias, tname, placement, cols, narrow)] — how each scan
+        # resolves a device batch against an arbitrary target mesh
+        self.upload_spec = upload_spec
+        self.sharded_bytes = sharded_bytes
+        self.repl_bytes = repl_bytes
+        self._lock = threading.Lock()
+        self._runfs: dict = {}   # n_shards -> compiled plan fn
+        self._calls: dict = {}   # "full" | (size, idx) -> queued call
+
+    def _runf_for(self, n_shards: int):
+        f = self._runfs.get(n_shards)
+        if f is None:
+            import dataclasses as _dc
+            p = _dc.replace(self.exec_params, n_shards=n_shards)
+            f = compile_plan(self.node, p, self.meta)
+            self._runfs[n_shards] = f
+        return f
+
+    def _call_for(self, key, mesh, n_shards: int):
+        with self._lock:
+            c = self._calls.get(key)
+            if c is None:
+                c = queued_collective_call(
+                    jax.jit(make_distributed_fn(
+                        self._runf_for(n_shards), mesh,
+                        self.scan_aliases, self.decision)),
+                    metrics=self.engine.metrics, mesh=mesh)
+                self._calls[key] = c
+            return c
+
+    def _target_size(self):
+        """Sub-mesh size for this dispatch, or None for the full mesh."""
+        eng = self.engine
+        try:
+            mode = str(eng.settings.get("sql.exec.submesh.size"))
+        except Exception:
+            return None
+        if mode == "off":
+            return None
+        pool = eng._submesh_pool()
+        if pool is None:
+            return None
+        full = eng.mesh.devices.size
+        sizes = sorted(pool.sizes())  # ascending; full mesh excluded
+        if mode == "auto":
+            from ..parallel.distagg import _dispatcher_for
+            busy = (_dispatcher_for(eng.mesh).depth() > 0
+                    or pool.occupancy() > 0)
+            if not busy:
+                return None
+        else:
+            want = int(mode)
+            if want >= full:
+                return None
+            sizes = [s for s in sizes if s >= want]
+        per_dev_budget = eng.hbm.limit / max(full, 1)
+        for s in sizes:
+            if (self.sharded_bytes / s + self.repl_bytes
+                    <= self.FOOTPRINT_FRAC * per_dev_budget):
+                return s
+        return None  # working set needs the full mesh
+
+    def __call__(self, scans, tsv, nparts, pid, lits=()):
+        size = self._target_size()
+        if size is None:
+            call = self._call_for("full", self.engine.mesh,
+                                  self.engine.mesh.devices.size)
+            return call(scans, tsv, nparts, pid, lits)
+        eng = self.engine
+        pool = eng._submesh_pool()
+        submesh, token = pool.acquire(size)
+        try:
+            call = self._call_for(token, submesh, size)
+            sub = {alias: eng._device_table(tname, placement, cols,
+                                            narrow=narrow, mesh=submesh)
+                   for alias, tname, placement, cols, narrow
+                   in self.upload_spec}
+            return call(sub, tsv, nparts, pid, lits)
+        finally:
+            pool.release(token)
 
 
 class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
@@ -129,6 +243,11 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if mesh is None and len(jax.devices()) > 1:
             mesh = meshmod.make_mesh()
         self.mesh = mesh
+        # sub-mesh dispatch pool (parallel/mesh.py MeshPool): built
+        # lazily on the first routed distributed dispatch; None until
+        # then and forever on meshes too small to split
+        self._mesh_pool = None
+        self._mesh_pool_lock = threading.Lock()
         self._device_tables: dict[tuple, ColumnBatch] = {}
         self._exec_cache: dict[tuple, tuple] = {}
         self._parse_cache: dict[str, object] = {}
@@ -241,7 +360,82 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # than sql.trace.slow_statement.threshold (0 disables)
         from collections import deque as _deque
         self.slow_traces: _deque = _deque(maxlen=32)
+        # admission-control plane: counters read live off the
+        # controller; the wait histogram observes every queued grant
+        self.metrics.func_counter(
+            "admission.admitted", lambda: self.admission.admitted,
+            "statements granted an execution slot")
+        self.metrics.func_counter(
+            "admission.rejected", lambda: self.admission.rejected,
+            "statements rejected (queue full, wait timeout, or shed)")
+        self.metrics.func_counter(
+            "admission.queued", lambda: self.admission.queued,
+            "statements that waited in the admission queue")
+        self.admission.wait_observer = self.metrics.histogram(
+            "admission.wait_seconds",
+            "admission queue wait per queued grant (s)").observe
+        self._admission_settings()
+        self.settings.on_change(
+            lambda n, v: self._admission_settings()
+            if n.startswith("sql.admission.") else None)
+        # sub-mesh dispatch plane (exec.submesh.dispatches counts in
+        # _submesh_pool's router; count/occupancy read the pool live)
+        self.metrics.func_gauge(
+            "exec.submesh.count",
+            lambda: (0 if self._mesh_pool is None else
+                     sum(self._mesh_pool.count(s)
+                         for s in self._mesh_pool.sizes())),
+            "sub-meshes in the dispatch pool (0 = pool not built)")
+        self.metrics.func_counter(
+            "exec.submesh.dispatches",
+            lambda: (0 if self._mesh_pool is None else
+                     self._mesh_pool.dispatches),
+            "distributed dispatches routed to a sub-mesh")
+        self.metrics.func_gauge(
+            "exec.submesh.occupancy",
+            lambda: (0 if self._mesh_pool is None else
+                     self._mesh_pool.occupancy()),
+            "in-flight distributed dispatches across all sub-meshes")
         self._lane_init()
+
+    def _admission_settings(self) -> None:
+        """Refresh the controller's shed thresholds from cluster
+        settings (sql.admission.shed.*; 0 disables)."""
+        try:
+            self.admission.shed_queue_depth = int(self.settings.get(
+                "sql.admission.shed.queue_depth"))
+            self.admission.shed_wait_seconds = float(self.settings.get(
+                "sql.admission.shed.wait_seconds"))
+        except Exception:
+            pass
+
+    def _submesh_pool(self):
+        """Lazy MeshPool over this engine's mesh; None when the mesh
+        can't split (absent or single-device)."""
+        if self.mesh is None or self.mesh.devices.size < 2:
+            return None
+        pool = self._mesh_pool
+        if pool is None:
+            with self._mesh_pool_lock:
+                pool = self._mesh_pool
+                if pool is None:
+                    pool = self._mesh_pool = meshmod.MeshPool(self.mesh)
+        return pool
+
+    def close(self) -> None:
+        """Retire engine-held device state: dispatcher threads (full
+        mesh and every pool sub-mesh) and the device table cache.
+        Dispatcher objects stay registered — a later dispatch through a
+        cached closure respawns its thread (parallel/distagg.py)."""
+        from ..parallel.distagg import shutdown_dispatchers
+        self.drop_device_cache()
+        if self.mesh is not None:
+            shutdown_dispatchers(self.mesh)
+        pool = self._mesh_pool
+        if pool is not None:
+            for s in pool.sizes():
+                for m in pool.submeshes(s):
+                    shutdown_dispatchers(m)
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:
@@ -436,7 +630,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         import time as _time
         t0 = _time.monotonic()
         prio = session.vars.get("admission_priority", "normal")
-        self.admission.acquire(priority=prio)
+        # tenant identity for the fair queue: application_name when the
+        # client set one (the multi-tenant front door's natural key),
+        # else the session object — each anonymous connection is its
+        # own tenant rather than one shared bucket
+        tenant = session.vars.get("application_name") or f"s{id(session)}"
+        self.admission.acquire(priority=prio, tenant=tenant)
         # SET tracing = on|cluster (pgwire trace control): "on"
         # records gateway-local; "cluster" additionally sets the
         # recording-request bit so every RPC / DistSQL flow the
@@ -1590,6 +1789,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         scans = {}
         gens = []
         shapes = []
+        # distributed plans record how each scan resolves against an
+        # arbitrary target mesh (sub-mesh dispatch re-uploads lazily)
+        # plus the working-set footprint the router sizes against
+        upload_spec = []
+        sharded_bytes = 0
+        repl_bytes = 0
         for alias, tname in scan_aliases.items():
             self._register_table_read(session.txn, tname, read_ts)
             cols = scan_cols.get(alias)
@@ -1625,10 +1830,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 gens.append((tname, -1))
             elif decision is not None:
                 sharded = alias in decision.sharded
-                b = self._device_table(tname, "sharded" if sharded
-                                       else "replicated", cols,
+                placement = "sharded" if sharded else "replicated"
+                b = self._device_table(tname, placement, cols,
                                        narrow=do_narrow)
                 gens.append((tname, self.store.table(tname).generation))
+                upload_spec.append((alias, tname, placement, cols,
+                                    do_narrow))
+                nb = sum(int(x.nbytes) for x in jax.tree.leaves(b))
+                if sharded:
+                    sharded_bytes += nb
+                else:
+                    repl_bytes += nb
             else:
                 b = self._device_table(tname, cols=cols,
                                        narrow=do_narrow)
@@ -1670,14 +1882,40 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             # and distributed plans (per-shard top_k + psum merges
             # would need sentinel plumbing through collectives)
             node = self._insert_compaction(node)
-        # plan fingerprint: subquery results are inlined into the plan
-        # as constants, so two preparations of the SAME sql_text can
-        # compile DIFFERENT programs when underlying data moved —
-        # sql_text alone would hand back a stale compiled constant
-        plan_fp = hash(repr(node))
-        key = (sql_text, tuple(sorted(shapes)), decision is not None,
+        # statement-shape plan cache: lift filter literals out of the
+        # plan into runtime arguments so literal-varying statements of
+        # one shape share a compiled program (the reference strips
+        # placeholders before fingerprinting, sql/plan_opt.go; the OLTP
+        # lane's literal-stripped point lookups generalized to the
+        # analytic path). Gated off under streaming/spill (their page
+        # programs re-derive plans elsewhere), overlay, CTE capture
+        # (composition re-binds constants), and plan_shape_cache=off.
+        pvals: tuple = ()
+        psc = str(session.vars.get("plan_shape_cache", "auto")).lower()
+        if psc != "off" and stream is None and spill is None \
+                and not overlay and self._cte_capture is None:
+            pnode, vals = parameterize(node)
+            if vals is not None:
+                node, pvals = pnode, vals
+        if pvals:
+            # literals left the plan, so they must leave the key text
+            # too; the structural fingerprint below is what rejects a
+            # literal that changed the plan's SHAPE (e.g. LIMIT, or a
+            # constant that re-ordered the memo's join plan)
+            keytext = shape_text(sql_text)
+            plan_fp = plan_fingerprint(node)
+        else:
+            # plan fingerprint: subquery results are inlined into the
+            # plan as constants, so two preparations of the SAME
+            # sql_text can compile DIFFERENT programs when underlying
+            # data moved — sql_text alone would hand back a stale
+            # compiled constant
+            keytext = sql_text
+            plan_fp = hash(repr(node))
+        psig = tuple(str(v.dtype) for v in pvals)
+        key = (keytext, tuple(sorted(shapes)), decision is not None,
                stream, spill, cap, pallas, sortn, plan_fp, no_topk,
-               no_compact)
+               no_compact, psig)
         cached = self._exec_cache.get(key)
         self.tracer.tag(plan_cache="hit" if cached else "miss")
         self.metrics.counter(
@@ -1746,17 +1984,19 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                      jax.jit(splan.combine),
                                      jax.jit(splan.final_fn))
                 elif decision is not None:
-                    runf = compile_plan(node, params, meta)
-                    jfn = queued_collective_call(
-                        jax.jit(make_distributed_fn(
-                            runf, self.mesh, scan_aliases, decision)),
-                        metrics=self.metrics, mesh=self.mesh)
+                    # the router matches the queued-call convention but
+                    # picks full mesh vs pool sub-mesh per dispatch;
+                    # each target mesh lazily traces its own executable
+                    jfn = _DistRouter(self, node, meta, scan_aliases,
+                                      decision, params, upload_spec,
+                                      sharded_bytes, repl_bytes)
                 else:
                     runf = compile_plan(node, params, meta)
 
-                    def fn(scans_in, ts_in, nparts, pid):
+                    def fn(scans_in, ts_in, nparts, pid, lits=()):
                         return runf(
-                            RunContext(scans_in, ts_in, nparts, pid))
+                            RunContext(scans_in, ts_in, nparts, pid,
+                                       params=lits))
                     jfn = jax.jit(fn)
             self._exec_cache_put(key, (jfn, meta))
         else:
@@ -1794,7 +2034,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                             spill_cols=(scan_cols.get(spill.build_alias)
                                         if spill is not None
                                         and spill.build_alias else None),
-                            joinfilter=jf_specs)
+                            joinfilter=jf_specs,
+                            params=pvals)
         # alias -> table map (composed CTE execution patches temp
         # aliases' scan batches per run, exec/ctecompose.py)
         prepared.scan_tables = dict(scan_aliases)

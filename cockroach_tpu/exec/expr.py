@@ -23,18 +23,21 @@ import numpy as np
 from ..ops import kernels as K
 from ..sql.bound import (BAggRef, BBetween, BBin, BCase, BCast, BCoalesce,
                          BCol, BConst, BDictGather, BDictLookup, BDictRemap,
-                         BExpr, BExtract, BFunc, BInList, BIsNull, BUnary,
-                         BWinRef)
+                         BExpr, BExtract, BFunc, BInList, BIsNull, BParam,
+                         BUnary, BWinRef)
 from ..sql.types import Family, SQLType
 
 
 class ExprContext:
-    """Evaluation context: column name -> (data, valid); agg results."""
+    """Evaluation context: column name -> (data, valid); agg results;
+    runtime statement parameters (exec/planparam.py BParam values)."""
 
-    def __init__(self, cols: dict, n: int, aggs: list | None = None):
+    def __init__(self, cols: dict, n: int, aggs: list | None = None,
+                 params: tuple = ()):
         self.cols = cols
         self.n = n
         self.aggs = aggs or []
+        self.params = params
 
     def col(self, name: str):
         return self.cols[name]
@@ -61,6 +64,17 @@ def compile_expr(e: BExpr) -> CompiledExpr:
             d = jnp.full((ctx.n,), val, dtype=_np_dtype(ty))
             return d, jnp.ones((ctx.n,), dtype=jnp.bool_)
         return f_const
+
+    if isinstance(e, BParam):
+        idx, pty = e.index, e.type
+
+        def f_param(ctx):
+            # runtime scalar (statement-shape plan cache): same dtype
+            # and broadcast semantics as the baked f_const above
+            v = jnp.asarray(ctx.params[idx], dtype=_np_dtype(pty))
+            d = jnp.broadcast_to(v, (ctx.n,))
+            return d, jnp.ones((ctx.n,), dtype=jnp.bool_)
+        return f_param
 
     if isinstance(e, BCol):
         name = e.name

@@ -540,15 +540,25 @@ class ScanPlaneMixin:
 
     def _device_table(self, name: str, placement: str = "single",
                       cols: frozenset | None = None,
-                      narrow: bool = True) -> ColumnBatch:
+                      narrow: bool = True, mesh=None) -> ColumnBatch:
         with self._device_lock:
             return self._device_table_locked(name, placement, cols,
-                                             narrow)
+                                             narrow, mesh)
 
     def _device_table_locked(self, name: str, placement: str = "single",
                              cols: frozenset | None = None,
-                             narrow: bool = True) -> ColumnBatch:
+                             narrow: bool = True,
+                             mesh=None) -> ColumnBatch:
         td = self.store.table(name)
+        # the target mesh is part of the upload's identity: sub-mesh
+        # dispatch (parallel/mesh.py MeshPool) shards/replicates the
+        # same table over different device subsets, and a batch placed
+        # on sub-mesh A must never serve a program compiled for B
+        if placement == "single":
+            mesh, devids = None, ()
+        else:
+            mesh = mesh if mesh is not None else self.mesh
+            devids = tuple(int(d.id) for d in mesh.devices.flat)
         # a cached upload with a SUPERSET of the needed columns serves
         # this scan directly (scans read columns by name); this keeps
         # one resident copy per table instead of one per column set.
@@ -557,8 +567,8 @@ class ScanPlaneMixin:
         # served an int32-narrowed upload
         for k, v in self._device_tables.items():
             if (k[0] == name and k[1] == td.generation
-                    and k[2] == placement
-                    and (len(k) < 5 or k[4] == narrow)
+                    and k[2] == placement and k[4] == narrow
+                    and k[5] == devids
                     and (k[3] is None
                          or (cols is not None and cols <= k[3]))):
                 return v
@@ -568,30 +578,31 @@ class ScanPlaneMixin:
             self._evict_device(k)
         if td.open_ts:
             self.store.seal(name)
-        key = (name, td.generation, placement, cols, narrow)
+        key = (name, td.generation, placement, cols, narrow, devids)
         # account BEFORE upload; replication costs a copy per device.
         # The reservation uses the same narrow set the upload will,
         # so narrowed tables no longer reserve ~2x their real bytes
         narrow_set = (self.narrow32_cols(name, cols) if narrow
                       else frozenset())
         nbytes = self._table_device_bytes(td, cols, narrow=narrow_set)
-        if placement == "replicated" and self.mesh is not None:
-            nbytes *= self.mesh.size
+        if placement == "replicated" and mesh is not None:
+            nbytes *= mesh.size
         self.hbm.reserve(key, nbytes)
         try:
             b = self._batch_from_chunks(td, td.chunks, cols,
                                         narrow=narrow_set)
             if placement == "sharded":
-                b = jax.device_put(b, meshmod.row_sharding(self.mesh))
+                b = jax.device_put(b, meshmod.row_sharding(mesh))
             elif placement == "replicated":
-                b = jax.device_put(b, meshmod.replicated(self.mesh))
+                b = jax.device_put(b, meshmod.replicated(mesh))
         except BaseException:
             self.hbm.release(key)
             raise
         # drop now-redundant strict-subset uploads of the same table
         for k in [k for k in self._device_tables
                   if k[0] == name and k[1] == td.generation
-                  and k[2] == placement and k[3] is not None
+                  and k[2] == placement and k[5] == devids
+                  and k[3] is not None
                   and (cols is None or k[3] < cols)]:
             self._evict_device(k)
         self._device_tables[key] = b

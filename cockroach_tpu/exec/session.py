@@ -137,6 +137,10 @@ class Prepared:
     # dispatch derives the build-side key summary at its read
     # timestamp and feeds it into the probe's zone predicates
     joinfilter: tuple = ()
+    # statement-shape plan cache (exec/planparam.py): THIS statement's
+    # literal values, riding each dispatch as runtime scalars into the
+    # shared parameterized executable; () = unparameterized
+    params: tuple = ()
 
     def _refresh(self) -> "Prepared":
         cur = tuple((t, self.engine.store.table(t).generation)
@@ -155,6 +159,7 @@ class Prepared:
         self.stream_zone = p.stream_zone
         self.spill, self.spill_cols = p.spill, p.spill_cols
         self.joinfilter = p.joinfilter
+        self.params = p.params
         self.as_of = p.as_of  # keep guard + execution timestamps
         # consistent (interval forms re-resolve on refresh)
 
@@ -198,7 +203,7 @@ class Prepared:
             return run_spill_join(self.engine, self, tsv)
         if self.stream is None:
             return self.jfn(self.scans, tsv, np.int32(nparts),
-                            np.int32(pid))
+                            np.int32(pid), self.params)
         # paged execution through the prefetch pipeline: a bounded
         # background worker assembles+uploads page i+1 while the
         # device computes page i, and zone-pruned pages never move

@@ -131,6 +131,34 @@ def partials_replannable(node: P.PlanNode) -> bool:
 # while dropping the hold time to just the DISPATCH: jitted calls
 # return as soon as XLA enqueues the work, so the dispatcher can
 # issue query i+1 while the devices still execute query i.
+#
+# Host-platform caveat: the CPU client runs every execution's
+# per-device computations on ONE fixed-size executor pool, so two
+# collective executions live at once can each grab a subset of the
+# pool and starve at their rendezvous (neither can seat all its
+# participants; both wait forever). Real accelerators order programs
+# per core, so dispatch/execute overlap is safe there — on the cpu
+# backend the dispatcher instead drains each execution to completion
+# before issuing the next (_dispatch_drains below).
+
+_SHUTDOWN = object()
+
+_DRAIN = None  # lazily: True on the cpu backend (see caveat above)
+
+
+def _dispatch_drains() -> bool:
+    global _DRAIN
+    if _DRAIN is None:
+        _DRAIN = jax.default_backend() == "cpu"
+    return _DRAIN
+
+
+def _fail_future(fut, msg: str) -> None:
+    try:
+        fut.set_exception(CollectiveFault(msg))
+    except Exception:
+        pass  # already done/cancelled
+
 
 class _MeshDispatcher:
     """Single-thread FIFO executor for one device set.
@@ -139,18 +167,58 @@ class _MeshDispatcher:
     dispatcher thread issues XLA executions back-to-back in program
     order. Keyed by the mesh's device-id tuple, NOT mesh identity:
     two equal meshes built by two engines over the same devices share
-    one rendezvous domain and MUST share one dispatcher."""
+    one rendezvous domain and MUST share one dispatcher.
+
+    A dispatcher thread that dies must not leave futures hanging: a
+    loop-level failure fails the in-flight and queued futures with
+    CollectiveFault (sessions fall back gateway-locally) and marks the
+    dispatcher dead; the next submit() respawns the thread. shutdown()
+    retires the thread cleanly (engine close / test teardown) — a
+    later submit on a retired dispatcher likewise respawns."""
 
     def __init__(self, name: str):
         import queue
+        self._name = name
         self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._dead = False
+        self._kill_next = False  # fault-injection hook (inject_death)
+        self.respawns = 0
+        self._thread: threading.Thread = None
+        self._spawn_locked()
+
+    def _spawn_locked(self):
         self._thread = threading.Thread(
-            target=self._loop, name=f"mesh-dispatch-{name}",
+            target=self._loop, name=f"mesh-dispatch-{self._name}",
             daemon=True)
         self._thread.start()
 
     def depth(self) -> int:
         return self._q.qsize()
+
+    def inject_death(self) -> None:
+        """Fault hook (tests): the dispatcher thread dies abruptly on
+        its next dequeue, outside the per-item protection — the shape
+        of a real dispatch-loop bug."""
+        self._kill_next = True
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        with self._lock:
+            t = self._thread
+            self._q.put(_SHUTDOWN)
+        if t is not None:
+            t.join(timeout)
+
+    def _fail_pending_locked(self) -> None:
+        import queue as _queue
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except _queue.Empty:
+                return
+            if item is _SHUTDOWN:
+                continue
+            _fail_future(item[3], "mesh dispatcher thread died")
 
     def submit(self, fn, args, kwargs, on_start=None):
         import concurrent.futures
@@ -161,34 +229,86 @@ class _MeshDispatcher:
         # tracing (and hence XLA backend compilation) happens on the
         # dispatcher thread, but the compile bill belongs to the
         # statement that enqueued the call (exec/coldstart.py)
-        self._q.put((fn, args, kwargs, fut, _time.monotonic(),
-                     on_start, coldstart.attribution_cell()))
+        item = (fn, args, kwargs, fut, _time.monotonic(),
+                on_start, coldstart.attribution_cell())
+        with self._lock:
+            if self._dead or self._thread is None \
+                    or not self._thread.is_alive():
+                self._fail_pending_locked()
+                self._dead = False
+                self.respawns += 1
+                self._spawn_locked()
+            self._q.put(item)
         return fut
 
     def _loop(self):
         import time as _time
         from ..exec import coldstart
-        while True:
-            fn, args, kwargs, fut, t_enq, on_start, cell = \
-                self._q.get()
-            if on_start is not None:
+        fut = None
+        try:
+            while True:
+                item = self._q.get()
+                if item is _SHUTDOWN:
+                    with self._lock:
+                        self._dead = True
+                        self._fail_pending_locked()
+                    return
+                fn, args, kwargs, fut, t_enq, on_start, cell = item
+                if self._kill_next:
+                    self._kill_next = False
+                    raise RuntimeError("injected dispatcher death")
+                if on_start is not None:
+                    try:
+                        on_start(_time.monotonic() - t_enq)
+                    except Exception:
+                        pass
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                prev = coldstart.set_attribution_cell(cell)
                 try:
-                    on_start(_time.monotonic() - t_enq)
-                except Exception:
-                    pass
-            if not fut.set_running_or_notify_cancel():
-                continue
-            prev = coldstart.set_attribution_cell(cell)
-            try:
-                fut.set_result(fn(*args, **kwargs))
-            except BaseException as e:
-                fut.set_exception(e)
-            finally:
-                coldstart.set_attribution_cell(prev)
+                    out = fn(*args, **kwargs)
+                    if _dispatch_drains():
+                        jax.block_until_ready(out)
+                    fut.set_result(out)
+                except BaseException as e:
+                    fut.set_exception(e)
+                finally:
+                    coldstart.set_attribution_cell(prev)
+        except BaseException:
+            # Loop-level failure (the per-item try above shields normal
+            # execution errors): fail the in-flight future and every
+            # queued one so no session blocks forever, mark dead so the
+            # next submit() respawns under the same lock — no window
+            # where an enqueue can race a dying thread into a hang.
+            if fut is not None:
+                _fail_future(fut, "mesh dispatcher thread died")
+            with self._lock:
+                self._dead = True
+                self._fail_pending_locked()
 
 
 _DISPATCHERS: dict = {}
 _DISPATCHERS_LOCK = threading.Lock()
+
+
+def shutdown_dispatchers(mesh=None) -> None:
+    """Retire dispatcher threads (engine close / test teardown): with a
+    mesh, only that device set's dispatcher; otherwise every one. The
+    module dict would otherwise accumulate a live thread per device-id
+    set forever; the thread is the resource, so it is joined here while
+    the dispatcher OBJECT stays registered — device-set -> dispatcher
+    identity must be stable (two dispatchers on one rendezvous domain
+    would reintroduce the interleaving deadlock), and any later submit
+    transparently respawns the retired thread."""
+    with _DISPATCHERS_LOCK:
+        if mesh is None:
+            items = list(_DISPATCHERS.values())
+        else:
+            key = tuple(int(d.id) for d in mesh.devices.flat)
+            d = _DISPATCHERS.get(key)
+            items = [d] if d is not None else []
+    for d in items:
+        d.shutdown()
 
 
 class CollectiveFault(RuntimeError):
@@ -300,8 +420,18 @@ def queued_collective_call(jfn, metrics=None, mesh=None):
                     _time.sleep(d)
                 if m_depth is not None:
                     m_depth.set(disp.depth() + 1)
-                fut = disp.submit(jfn, args, kwargs, on_start)
-                out = fut.result()
+                # domain-family gate (parallel/mesh.py): a full-mesh
+                # and a sub-mesh execution share devices, so their
+                # windows must not overlap — same-mode dispatches
+                # still run concurrently
+                win = meshmod.execution_window(mesh)
+                if win is None:
+                    fut = disp.submit(jfn, args, kwargs, on_start)
+                    out = fut.result()
+                else:
+                    with win:
+                        fut = disp.submit(jfn, args, kwargs, on_start)
+                        out = fut.result()
             return out
         finally:
             if m_calls is not None:
@@ -325,18 +455,22 @@ def make_distributed_fn(runf, mesh, scan_aliases: dict, decision: DistDecision):
     def one(alias):
         return shard_leaf if alias in decision.sharded else repl_leaf
 
-    def fn(scans, read_ts, nparts, pid):
-        return runf(RunContext(scans, read_ts, nparts, pid))
+    def fn(scans, read_ts, nparts, pid, lits=()):
+        return runf(RunContext(scans, read_ts, nparts, pid, params=lits))
 
-    # pytree of specs matching (scans dict, read_ts, nparts, pid)
+    # pytree of specs matching (scans dict, read_ts, nparts, pid, lits)
     def spec_for_scans(scans):
         return {alias: jax.tree.map(lambda _: one(alias), b)
                 for alias, b in scans.items()}
 
-    def wrapped(scans, read_ts, nparts, pid):
-        in_specs = (spec_for_scans(scans), repl_leaf, repl_leaf, repl_leaf)
+    def wrapped(scans, read_ts, nparts, pid, lits=()):
+        # lits: stripped statement literals riding along as replicated
+        # runtime scalars (the statement-shape plan cache,
+        # exec/planparam.py); () for unparameterized plans.
+        in_specs = (spec_for_scans(scans), repl_leaf, repl_leaf, repl_leaf,
+                    tuple(repl_leaf for _ in lits))
         return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=repl_leaf,
                          **{_SM_CHECK_KW: False})(scans, read_ts,
-                                                  nparts, pid)
+                                                  nparts, pid, lits)
     return wrapped

@@ -15,6 +15,8 @@ axes for scan-parallel x partition-parallel shuffles) layer on later.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 import jax
@@ -53,3 +55,142 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+class _DomainGate:
+    """Two-mode execution window for one domain family (a root mesh
+    and its sub-meshes). Entries of the SAME mode run concurrently
+    (disjoint sub-meshes, or full-mesh calls serialized by their own
+    dispatcher); entries of DIFFERENT modes exclude each other,
+    because their device sets overlap: a full-mesh collective and a
+    sub-mesh collective in flight at once can either starve the
+    host-platform's fixed executor pool mid-rendezvous (each run
+    holding some threads while waiting for the rest) or, on real
+    chips, enqueue in different per-core orders. A waiting mode also
+    blocks NEW entries of the active mode, so a steady sub-mesh
+    stream cannot starve a full-mesh statement (and vice versa)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._active = {"root": 0, "sub": 0}
+        self._waiting = {"root": 0, "sub": 0}
+
+    @contextlib.contextmanager
+    def window(self, mode: str):
+        other = "sub" if mode == "root" else "root"
+        with self._cv:
+            self._waiting[mode] += 1
+            while self._active[other] > 0 or (
+                    self._waiting[other] > 0 and self._active[mode] > 0):
+                self._cv.wait()
+            self._waiting[mode] -= 1
+            self._active[mode] += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._active[mode] -= 1
+                self._cv.notify_all()
+
+
+# device-id tuple -> (gate, mode); populated by MeshPool so that
+# distagg.queued_collective_call can bracket every collective dispatch
+# of a registered family. Meshes outside any pool family dispatch
+# ungated (zero overhead until a pool exists).
+_DOMAIN_GATES: dict = {}
+_DOMAIN_GATES_LOCK = threading.Lock()
+
+
+def _devkey(mesh) -> tuple:
+    return tuple(int(d.id) for d in mesh.devices.flat)
+
+
+def execution_window(mesh):
+    """Context manager bracketing a collective dispatch on ``mesh``
+    (enqueue through completion), or None when the mesh belongs to no
+    registered domain family."""
+    if mesh is None:
+        return None
+    ent = _DOMAIN_GATES.get(_devkey(mesh))
+    if ent is None:
+        return None
+    gate, mode = ent
+    return gate.window(mode)
+
+
+class MeshPool:
+    """Partition a mesh's devices into disjoint sub-meshes per pow2 size.
+
+    The sub-mesh dispatch plane (cf. Tailwind's multiplexing of many
+    queries onto one accelerator pool, and the DataParallelPartitioner
+    sub-mesh shape): an 8-device mesh yields two 4-device or four
+    2-device domains. Disjoint device sets are disjoint rendezvous
+    domains — each keeps its own ``_MeshDispatcher``
+    (parallel/distagg.py keys by device-id tuple), so distributed
+    programs on different sub-meshes execute truly concurrently
+    instead of serializing on one dispatch thread.
+
+    ``acquire(size)`` returns the least-loaded sub-mesh of that size
+    (in-flight counters, incremented here and decremented by
+    ``release``); results are bit-identical across sizes because the
+    partial-aggregate merges are exact at any shard count.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        devs = list(mesh.devices.flat)
+        self._subs: dict[int, list[Mesh]] = {}
+        size = len(devs) // 2
+        while size >= 1:
+            self._subs[size] = [
+                Mesh(np.asarray(devs[i:i + size]), (SHARD_AXIS,))
+                for i in range(0, len(devs), size)
+            ]
+            size //= 2
+        self._inflight: dict[int, list[int]] = {
+            s: [0] * len(ms) for s, ms in self._subs.items()}
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.dispatches = 0
+        # register the domain family: two pools over the same devices
+        # (two engines on one mesh) must share ONE gate, exactly as
+        # they share one rendezvous domain per device set
+        with _DOMAIN_GATES_LOCK:
+            ent = _DOMAIN_GATES.get(_devkey(mesh))
+            gate = ent[0] if ent is not None else _DomainGate()
+            _DOMAIN_GATES[_devkey(mesh)] = (gate, "root")
+            for ms in self._subs.values():
+                for m in ms:
+                    _DOMAIN_GATES[_devkey(m)] = (gate, "sub")
+
+    def sizes(self) -> list[int]:
+        return sorted(self._subs, reverse=True)
+
+    def count(self, size: int) -> int:
+        return len(self._subs.get(size, ()))
+
+    def submeshes(self, size: int) -> list:
+        return list(self._subs.get(size, ()))
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return sum(sum(v) for v in self._inflight.values())
+
+    def acquire(self, size: int):
+        """Least-loaded sub-mesh of ``size``; returns (mesh, token).
+        Ties rotate round-robin — dispatch is asynchronous, so
+        in-flight counts are often all zero and min() alone would pile
+        every dispatch onto sub-mesh 0."""
+        with self._lock:
+            load = self._inflight[size]
+            k = len(load)
+            i = min(range(k), key=lambda j: (load[j], (j - self._rr) % k))
+            self._rr = (i + 1) % k
+            load[i] += 1
+            self.dispatches += 1
+            return self._subs[size][i], (size, i)
+
+    def release(self, token) -> None:
+        size, i = token
+        with self._lock:
+            self._inflight[size][i] = max(0, self._inflight[size][i] - 1)

@@ -77,11 +77,16 @@ def _scram_attrs(msg: str) -> dict:
 
 
 def _sqlstate(exc: Exception) -> str:
+    from ..utils.admission import AdmissionRejected
     from ..utils.mon import MemoryQuotaError
 
     msg = str(exc)
     if isinstance(exc, CopyDataError):
         return "22P02"  # invalid_text_representation
+    if isinstance(exc, AdmissionRejected):
+        # admission queue full / load shed: the clean front-door
+        # rejection clients should retry with backoff
+        return "53300"  # too_many_connections
     if "restart transaction" in msg:
         return "40001"  # serialization_failure
     if "transaction is aborted" in msg:

@@ -27,6 +27,19 @@ class BConst(BExpr):
 
 
 @dataclass
+class BParam(BExpr):
+    """Runtime statement parameter i — a literal the statement-shape
+    plan cache (exec/planparam.py) stripped out of the plan so
+    literal-varying statements share one compiled entry. Compiles to a
+    broadcast of ``ctx.params[index]`` (exec/expr.py); the value rides
+    the dispatch as a replicated runtime scalar instead of baking into
+    the trace. ``repr`` deliberately shows index+type only, so the
+    parameterized plan's fingerprint is literal-independent."""
+    index: int
+    type: SQLType = None
+
+
+@dataclass
 class BCol(BExpr):
     name: str  # unique batch column name ("alias.col")
     type: SQLType = None

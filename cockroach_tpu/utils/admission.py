@@ -1,20 +1,37 @@
-"""Admission control: bounded, priority-ordered statement admission.
+"""Admission control: bounded, fair-queued statement admission.
 
 The analogue of pkg/util/admission (work queues in front of each
 resource). Here the guarded resource is engine execution slots: each
 statement acquires a slot before running; when slots are exhausted,
-waiters queue ordered by (priority, arrival) and a bounded queue
-rejects overload with a clean error instead of letting latency grow
-unboundedly (the reference's admission.WorkQueue ordering + the
-sql.conn.max_open semantics folded together)."""
+waiters queue and a bounded queue rejects overload with a clean error
+instead of letting latency grow unboundedly (the reference's
+admission.WorkQueue ordering + the sql.conn.max_open semantics folded
+together).
+
+Ordering is strict priority tiers (high > normal > low, the
+WorkPriority analogue) with per-tenant weighted fair queueing inside a
+tier: each tenant (session / application_name) carries a virtual
+finish time advanced by 1/weight per admitted statement, so a tenant
+flooding the queue interleaves with — rather than starves — the
+others, like the reference's tenant-weighted WorkQueue heap ordering.
+
+Load shedding: when queue depth or the recent grant-wait EWMA crosses
+the shed thresholds (wired to sql.admission.shed.* cluster settings),
+low-priority work is rejected up front with ``AdmissionRejected``
+rather than queued into unbounded p99 growth.
+"""
 
 from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 
 PRIORITIES = {"high": 0, "normal": 1, "low": 2}
+
+# EWMA smoothing for the recent grant-wait signal that drives shedding.
+_WAIT_ALPHA = 0.3
 
 
 class AdmissionRejected(Exception):
@@ -23,9 +40,13 @@ class AdmissionRejected(Exception):
 
 @dataclass(order=True)
 class _Waiter:
+    # (priority tier, virtual finish time, arrival seq): strict
+    # priority first, weighted fair order within the tier, FIFO as the
+    # final tie-break.
     rank: tuple
     event: threading.Event = field(compare=False)
     granted: bool = field(default=False, compare=False)
+    t_enq: float = field(default=0.0, compare=False)
 
 
 class AdmissionController:
@@ -36,12 +57,37 @@ class AdmissionController:
         self._in_use = 0
         self._queue: list[_Waiter] = []
         self._seq = itertools.count()
+        # per-tenant fair-queue state
+        self._weights: dict[str, float] = {}
+        self._vfinish: dict[str, float] = {}
+        self._vclock = 0.0
+        # shed thresholds (0 disables); wired from sql.admission.shed.*
+        self.shed_queue_depth = 0
+        self.shed_wait_seconds = 0.0
+        self._wait_ewma = 0.0
+        # counters (always mutated under _mu)
         self.admitted = 0
         self.rejected = 0
         self.queued = 0
+        self.shed = 0
+        # optional hook: called with the grant wait in seconds for
+        # every admission that had to queue (engine wires a histogram)
+        self.wait_observer = None
 
-    def acquire(self, priority: str = "normal",
-                timeout: float = 30.0) -> None:
+    def set_weight(self, tenant: str, weight: float) -> None:
+        with self._mu:
+            self._weights[tenant] = max(float(weight), 1e-6)
+
+    def _vft(self, tenant: str) -> float:
+        """Virtual finish time for the tenant's next statement."""
+        w = self._weights.get(tenant, 1.0)
+        start = max(self._vclock, self._vfinish.get(tenant, 0.0))
+        vft = start + 1.0 / w
+        self._vfinish[tenant] = vft
+        return vft
+
+    def acquire(self, priority: str = "normal", timeout: float = 30.0,
+                tenant: str = "") -> None:
         p = PRIORITIES.get(priority, 1)
         with self._mu:
             if self._in_use < self.slots and not self._queue:
@@ -52,25 +98,53 @@ class AdmissionController:
                 self.rejected += 1
                 raise AdmissionRejected(
                     f"admission queue full ({self.max_queue} waiters)")
-            w = _Waiter((p, next(self._seq)), threading.Event())
+            if p >= PRIORITIES["low"] and self._should_shed_locked():
+                self.rejected += 1
+                self.shed += 1
+                raise AdmissionRejected(
+                    "admission load shed: queue depth "
+                    f"{len(self._queue)}, recent wait "
+                    f"{self._wait_ewma:.2f}s over threshold")
+            w = _Waiter((p, self._vft(tenant), next(self._seq)),
+                        threading.Event(), t_enq=time.monotonic())
             import bisect
             bisect.insort(self._queue, w)
             self.queued += 1
-        if not w.event.wait(timeout):
-            with self._mu:
-                if w in self._queue:
-                    self._queue.remove(w)
-                    self.rejected += 1
-                    raise AdmissionRejected(
-                        f"admission wait exceeded {timeout}s")
-            # granted between timeout and lock: fall through
-        self.admitted += 1
+        granted = w.event.wait(timeout)
+        obs = None
+        with self._mu:
+            if granted or w.granted:
+                # release() handed the slot to us (possibly between the
+                # wait timing out and this lock): the slot is ours.
+                self.admitted += 1
+                obs = self.wait_observer
+                wait = time.monotonic() - w.t_enq
+                self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
+            else:
+                # Timed out while still queued: remove ourselves so a
+                # later release() can never hand a slot to a waiter
+                # that already gave up (a stale waiter absorbing a
+                # grant would leak the slot).
+                self._queue.remove(w)
+                self.rejected += 1
+                raise AdmissionRejected(
+                    f"admission wait exceeded {timeout}s")
+        if obs is not None:
+            obs(wait)
+
+    def _should_shed_locked(self) -> bool:
+        if self.shed_queue_depth and len(self._queue) >= self.shed_queue_depth:
+            return True
+        if self.shed_wait_seconds and self._wait_ewma >= self.shed_wait_seconds:
+            return True
+        return False
 
     def release(self) -> None:
         with self._mu:
             if self._queue:
-                w = self._queue.pop(0)  # best (priority, arrival)
+                w = self._queue.pop(0)  # best (priority, vft, arrival)
                 w.granted = True
+                self._vclock = max(self._vclock, w.rank[1])
                 w.event.set()
                 return  # slot hands off directly
             self._in_use = max(0, self._in_use - 1)

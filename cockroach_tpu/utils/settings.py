@@ -91,6 +91,17 @@ def _pow2(v):
         raise SettingError("must be a power of two")
 
 
+def _submesh_size(v):
+    if v in ("auto", "off"):
+        return
+    try:
+        n = int(v)
+    except ValueError:
+        raise SettingError("must be auto, off, or a power of two")
+    if n < 1 or n & (n - 1) != 0:
+        raise SettingError("must be auto, off, or a power of two")
+
+
 def _register_builtins(s: Settings):
     s.register("version", "25.3-tpu.1", str, "cluster version gate")
     s.register("sql.tpu.direct_columnar_scans.enabled", True, bool,
@@ -136,6 +147,20 @@ def _register_builtins(s: Settings):
                "persisted tuning table, tune on first use on real "
                "TPU; on = force tuning even off-TPU (test hook); "
                "off = shipped constants")
+    # multi-tenant front door: sub-mesh dispatch + admission shedding
+    s.register("sql.exec.submesh.size", "auto", str,
+               "devices per dispatch sub-mesh for eligible distributed "
+               "plans: a power of two divides the mesh into disjoint "
+               "rendezvous domains that execute concurrently; auto = "
+               "pick the smallest size whose per-device working set "
+               "fits the HBM budget share; off = always the full mesh",
+               _submesh_size)
+    s.register("sql.admission.shed.queue_depth", 0, int,
+               "admission queue depth at which low-priority statements "
+               "are rejected up front instead of queued (0 disables)")
+    s.register("sql.admission.shed.wait_seconds", 0.0, float,
+               "recent admission grant-wait (EWMA, seconds) above which "
+               "low-priority statements are shed (0 disables)")
 
 
 def _meta_page_rows() -> int:
@@ -197,6 +222,16 @@ class SessionVars:
         # SESSION; cluster additionally requests remote recordings
         # from every RPC / DistSQL flow the statement touches
         "tracing": "off",            # off | on | cluster
+        # statement-shape plan cache (exec/planparam.py): strip
+        # eligible filter literals into runtime args so statements
+        # differing only in literals share one compiled _exec_cache
+        # entry. auto (default): parameterize resident + distributed
+        # selects, conservative bail-out when a literal shapes the
+        # plan; off: text keying (escape hatch / bench A/B lever)
+        "plan_shape_cache": "auto",  # auto | off
+        # admission tier for this session's statements (the reference's
+        # admission.WorkPriority): high | normal | low
+        "admission_priority": "normal",
         "application_name": "",
         "database": "defaultdb",
         "extra_float_digits": 0,

@@ -539,3 +539,73 @@ class TestRetryTracing:
                       for s in rec.find_all("flow")}
         assert {1, 2} <= flow_nodes, rec.tree_lines()
         assert 3 not in flow_nodes
+
+
+class TestDispatcherDeath:
+    """A mesh dispatch thread that dies abruptly (loop-level bug, not
+    a per-item execution error) must fail the in-flight and queued
+    futures with CollectiveFault — sessions fall back gateway-local —
+    and respawn transparently on the next submit."""
+
+    def test_death_fails_futures_then_respawns(self):
+        import threading
+
+        from cockroach_tpu.parallel import distagg
+
+        d = distagg._MeshDispatcher("test-death-unit")
+        assert d.submit(lambda: 1, (), {}).result(timeout=5) == 1
+        # park the loop on a gate so the kill and the queued items
+        # are deterministically ordered: blocker, then death, then
+        # three victims already in the queue
+        gate = threading.Event()
+        started = threading.Event()
+
+        def block():
+            started.set()
+            gate.wait(5)
+
+        blocker = d.submit(block, (), {})
+        assert started.wait(5)  # the loop holds the blocker, not a victim
+        d.inject_death()
+        victims = [d.submit(lambda i=i: i, (), {}) for i in range(3)]
+        gate.set()
+        blocker.result(timeout=5)
+        for f in victims:
+            with pytest.raises(distagg.CollectiveFault,
+                               match="dispatcher thread died"):
+                f.result(timeout=5)
+        # next submit respawns the thread; service resumes
+        r0 = d.respawns
+        assert d.submit(lambda: 41 + 1, (), {}).result(timeout=5) == 42
+        assert d.respawns == r0 + 1
+
+    def test_shutdown_retires_and_submit_revives(self):
+        from cockroach_tpu.parallel import distagg
+
+        d = distagg._MeshDispatcher("test-death-shutdown")
+        d.shutdown()
+        assert d.submit(lambda: "back", (), {}).result(timeout=5) == "back"
+
+    def test_engine_query_survives_dispatcher_death(self):
+        """End to end: kill the engine mesh's dispatcher mid-workload.
+        The poisoned dispatch surfaces CollectiveFault, the session
+        ladder answers gateway-local (distsql off re-prepare), and the
+        NEXT distributed statement respawns the dispatcher."""
+        from cockroach_tpu.exec.engine import Engine
+        from cockroach_tpu.parallel import distagg
+        from cockroach_tpu.parallel.mesh import make_mesh
+
+        e = Engine(mesh=make_mesh())
+        e.execute("CREATE TABLE td (a INT PRIMARY KEY, g INT)")
+        e.execute("INSERT INTO td (a, g) VALUES "
+                  + ",".join(f"({i},{i % 3})" for i in range(600)))
+        q = "SELECT g, count(*) FROM td GROUP BY g ORDER BY g"
+        want = e.execute(q).rows
+        assert want == [(0, 200), (1, 200), (2, 200)]
+        d = distagg._dispatcher_for(e.mesh)
+        r0 = d.respawns
+        d.inject_death()
+        assert e.execute(q).rows == want  # gateway-local fallback
+        assert e.execute(q).rows == want  # distributed path is back
+        assert d.respawns >= r0 + 1
+        e.close()

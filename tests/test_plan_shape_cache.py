@@ -1,0 +1,108 @@
+"""Cross-session statement-shape plan cache (this PR's tentpole,
+part c): analytic statements differing only in filter literals share
+one compiled ``_exec_cache`` entry — literals ride the dispatch as
+runtime scalars (exec/planparam.py) — while a literal that shapes the
+compiled program (LIMIT) conservatively misses."""
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.exec.planparam import (parameterize, plan_fingerprint,
+                                          shape_text)
+from cockroach_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine(mesh=make_mesh())
+    e.execute("CREATE TABLE t (k INT PRIMARY KEY, v INT, g INT)")
+    vals = ",".join(f"({i},{(i * 13) % 500},{i % 4})"
+                    for i in range(2500))
+    e.execute(f"INSERT INTO t (k, v, g) VALUES {vals}")
+    yield e
+    e.close()
+
+
+def _misses(e):
+    c = e.metrics.get("sql.plan.cache.miss")
+    return 0 if c is None else c.value()
+
+
+class TestShapeHelpers:
+    def test_shape_text_strips_literals(self):
+        a = shape_text("SELECT v FROM t WHERE v > 10 AND g = 3")
+        b = shape_text("SELECT v FROM t WHERE v > 999 AND g = 1")
+        assert a == b and "?" in a
+        # floats and quoted strings normalize; identifiers survive
+        s = shape_text("SELECT t1.v FROM t1 WHERE w > 1.5e2 "
+                       "AND name = 'bob''s'")
+        assert "1.5e2" not in s and "bob" not in s and "t1.v" in s
+
+    def test_fingerprint_tracks_structure_not_literals(self, eng):
+        node_a, _ = eng._plan(
+            eng._parse_cached("SELECT g, sum(v) FROM t WHERE v > 10 "
+                              "GROUP BY g"), eng.session())
+        node_b, _ = eng._plan(
+            eng._parse_cached("SELECT g, sum(v) FROM t WHERE v > 77 "
+                              "GROUP BY g"), eng.session())
+        pa, va = parameterize(node_a)
+        pb, vb = parameterize(node_b)
+        assert va is not None and vb is not None
+        assert [x.item() for x in va] != [x.item() for x in vb]
+        assert plan_fingerprint(pa) == plan_fingerprint(pb)
+        # un-parameterized, the literal keeps the plans distinct
+        assert plan_fingerprint(node_a) != plan_fingerprint(node_b)
+
+
+class TestShapeCache:
+    def test_literal_varying_statements_share_one_entry(self, eng):
+        s = eng.session()
+        q = "SELECT g, sum(v) FROM t WHERE v > {} GROUP BY g ORDER BY g"
+        eng.execute(q.format(17), s)  # pays the one trace per shape
+        m0 = _misses(eng)
+        for lit in (23, 99, 250, 444):
+            eng.execute(q.format(lit), s)
+        assert _misses(eng) == m0  # every literal variant hit
+
+    def test_hits_cross_sessions(self, eng):
+        q = "SELECT count(*) FROM t WHERE g = {}"
+        eng.execute(q.format(0), eng.session())
+        m0 = _misses(eng)
+        assert eng.execute(q.format(2), eng.session()).rows \
+            == [(2500 // 4,)]
+        assert _misses(eng) == m0
+
+    def test_results_track_the_literal_not_the_cache(self, eng):
+        """A hit must evaluate the NEW literal: compare every answer
+        against a session with the shape cache off."""
+        s = eng.session()
+        off = eng.session()
+        off.vars.set("plan_shape_cache", "off")
+        q = "SELECT g, count(*), min(v) FROM t WHERE v > {} " \
+            "GROUP BY g ORDER BY g"
+        eng.execute(q.format(100), s)
+        for lit in (3, 250, 498):
+            assert eng.execute(q.format(lit), s).rows \
+                == eng.execute(q.format(lit), off).rows, lit
+
+    def test_shape_changing_literal_misses(self, eng):
+        """LIMIT is baked into the program: same statement shape,
+        different LIMIT must recompile (the conservative bail-out),
+        while re-varying the WHERE literal still hits."""
+        s = eng.session()
+        q = "SELECT v FROM t WHERE v > {} ORDER BY v, k LIMIT {}"
+        eng.execute(q.format(10, 5), s)
+        m0 = _misses(eng)
+        eng.execute(q.format(99, 5), s)   # literal-only: hit
+        assert _misses(eng) == m0
+        eng.execute(q.format(10, 7), s)   # shape change: miss
+        assert _misses(eng) == m0 + 1
+
+    def test_off_switch_restores_text_keying(self, eng):
+        s = eng.session()
+        s.vars.set("plan_shape_cache", "off")
+        q = "SELECT max(v) FROM t WHERE v < {}"
+        eng.execute(q.format(400), s)
+        m0 = _misses(eng)
+        eng.execute(q.format(401), s)
+        assert _misses(eng) == m0 + 1  # every literal pays a trace

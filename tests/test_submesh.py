@@ -1,0 +1,239 @@
+"""Sub-mesh parallel dispatch (this PR's tentpole): MeshPool carves
+the device mesh into disjoint pow2 sub-meshes, the engine routes
+eligible distributed plans onto the least-loaded one, and results stay
+bit-identical at every shard count (the partial-aggregate merges are
+exact regardless of how many shards contribute)."""
+
+import random
+import threading
+
+import pytest
+
+from cockroach_tpu.exec.engine import Engine, _DistRouter
+from cockroach_tpu.parallel import distagg
+from cockroach_tpu.parallel.mesh import MeshPool, make_mesh
+
+ROWS = 3000
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine(mesh=make_mesh())
+    e.execute("CREATE TABLE fact (k INT PRIMARY KEY, v INT, w FLOAT, "
+              "g INT, h INT)")
+    rng = random.Random(7)
+    vals = ",".join(
+        f"({i},{rng.randrange(1000)},{rng.random() * 100:.3f},"
+        f"{i % 7},{i % 3})" for i in range(ROWS))
+    e.execute(f"INSERT INTO fact (k, v, w, g, h) VALUES {vals}")
+    e.execute("CREATE TABLE dim (g INT PRIMARY KEY, tag INT)")
+    e.execute("INSERT INTO dim (g, tag) VALUES "
+              + ",".join(f"({i},{i % 2})" for i in range(7)))
+    yield e
+    e.settings.set("sql.exec.submesh.size", "auto")
+    e.close()
+
+
+class TestMeshPool:
+    def test_partitions_are_disjoint_pow2_covers(self):
+        pool = MeshPool(make_mesh())
+        assert pool.sizes() == [4, 2, 1]
+        for s in pool.sizes():
+            subs = pool.submeshes(s)
+            assert len(subs) == pool.count(s) == 8 // s
+            ids = [tuple(int(d.id) for d in m.devices.flat)
+                   for m in subs]
+            assert all(len(t) == s for t in ids)
+            flat = sorted(i for t in ids for i in t)
+            assert flat == list(range(8))  # disjoint, full cover
+
+    def test_acquire_rotates_ties_and_tracks_load(self):
+        pool = MeshPool(make_mesh())
+        # all idle: consecutive acquires must spread, not pile on 0
+        toks = [pool.acquire(2)[1] for _ in range(4)]
+        assert sorted(t[1] for t in toks) == [0, 1, 2, 3]
+        assert pool.occupancy() == 4
+        for t in toks:
+            pool.release(t)
+        assert pool.occupancy() == 0
+        # a loaded sub-mesh is skipped while an idle one exists
+        _, busy = pool.acquire(4)
+        _, other = pool.acquire(4)
+        assert other[1] != busy[1]
+        pool.release(busy)
+        pool.release(other)
+        assert pool.dispatches == 4 + 2
+
+    def test_release_never_goes_negative(self):
+        pool = MeshPool(make_mesh())
+        _, t = pool.acquire(4)
+        pool.release(t)
+        pool.release(t)  # double release clamps at zero
+        assert pool.occupancy() == 0
+
+    def test_domain_gate_excludes_cross_mode_shares_same_mode(self):
+        from cockroach_tpu.parallel.mesh import _DomainGate
+        gate = _DomainGate()
+        order = []
+        entered = threading.Event()
+        release = threading.Event()
+
+        def sub_holder():
+            with gate.window("sub"):
+                order.append("sub1")
+                entered.set()
+                release.wait(5)
+
+        def root_entrant():
+            with gate.window("root"):
+                order.append("root")
+
+        t1 = threading.Thread(target=sub_holder)
+        t1.start()
+        assert entered.wait(5)
+        t2 = threading.Thread(target=root_entrant)
+        t2.start()
+        # root must not enter while a sub window is active ...
+        t2.join(0.2)
+        assert t2.is_alive() and order == ["sub1"]
+        # ... and a SECOND sub entry must queue behind the waiting
+        # root (no same-mode starvation of the other mode)
+        t3 = threading.Thread(
+            target=lambda: gate.window("sub").__enter__())
+        t3.start()
+        t3.join(0.2)
+        assert t3.is_alive()
+        release.set()
+        t1.join(5)
+        t2.join(5)
+        assert not t2.is_alive() and order == ["sub1", "root"]
+
+
+class TestSubmeshRouting:
+    Q = "SELECT g, sum(v) FROM fact GROUP BY g ORDER BY g"
+
+    def test_explicit_size_routes_through_pool(self, eng):
+        pool = eng._submesh_pool()
+        assert pool is not None
+        base = pool.dispatches
+        eng.settings.set("sql.exec.submesh.size", "2")
+        eng.execute(self.Q)
+        assert pool.dispatches == base + 1
+        eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_off_and_idle_auto_stay_on_full_mesh(self, eng):
+        pool = eng._submesh_pool()
+        for mode in ("off", "auto"):
+            eng.settings.set("sql.exec.submesh.size", mode)
+            base = pool.dispatches
+            eng.execute(self.Q)
+            assert pool.dispatches == base, mode
+        eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_oversized_working_set_escalates_to_full_mesh(self, eng):
+        # router whose recorded sharded footprint cannot fit any
+        # sub-mesh slice: explicit sizing must fall back to the mesh
+        r = _DistRouter(eng, None, None, {}, None, None, [],
+                        sharded_bytes=10 ** 15, repl_bytes=0)
+        eng.settings.set("sql.exec.submesh.size", "2")
+        try:
+            assert r._target_size() is None
+        finally:
+            eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_small_working_set_takes_requested_size(self, eng):
+        r = _DistRouter(eng, None, None, {}, None, None, [],
+                        sharded_bytes=1 << 10, repl_bytes=0)
+        eng.settings.set("sql.exec.submesh.size", "2")
+        try:
+            assert r._target_size() == 2
+        finally:
+            eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_submesh_metrics_registered(self, eng):
+        eng._submesh_pool()
+        n = eng.metrics.get("exec.submesh.count").value()
+        assert n == 2 + 4 + 8  # sub-meshes at sizes 4, 2, 1
+        assert eng.metrics.get("exec.submesh.dispatches").value() >= 0
+        assert eng.metrics.get("exec.submesh.occupancy").value() == 0
+
+
+class TestSubmeshParity:
+    """Fuzzed distributed GROUP BYs: identical rows across the full
+    mesh, every sub-mesh size, and a single device. Aggregates chosen
+    exact at any shard count (int sums, count, min/max) so equality is
+    bitwise, not approximate."""
+
+    AGGS = ("sum(v)", "count(*)", "min(v)", "max(v)", "min(w)", "max(w)")
+
+    def test_fuzzed_groupby_parity_across_sizes(self, eng):
+        rng = random.Random(1234)
+        queries = []
+        for _ in range(2):
+            a1, a2 = rng.sample(self.AGGS, 2)
+            key = rng.choice(("g", "h"))
+            lit = rng.randrange(100, 900)
+            queries.append(
+                f"SELECT {key}, {a1}, {a2} FROM fact "
+                f"WHERE v > {lit} GROUP BY {key} ORDER BY {key}")
+        queries.append(  # distributed join (replicated build side)
+            "SELECT tag, count(*), sum(v) FROM fact "
+            "JOIN dim ON fact.g = dim.g "
+            "WHERE v > 250 GROUP BY tag ORDER BY tag")
+        s = eng.session()
+        try:
+            for q in queries:
+                eng.settings.set("sql.exec.submesh.size", "off")
+                want = eng.execute(q, s).rows
+                for size in ("4", "2", "1"):
+                    eng.settings.set("sql.exec.submesh.size", size)
+                    got = eng.execute(q, s).rows
+                    assert got == want, (q, size)
+        finally:
+            eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_concurrent_sessions_on_disjoint_submeshes(self, eng):
+        """Two sessions dispatch onto sub-meshes concurrently —
+        disjoint rendezvous domains, so neither serializes behind the
+        other's dispatcher, and both agree with serial execution."""
+        q_a = "SELECT g, sum(v) FROM fact GROUP BY g ORDER BY g"
+        q_b = "SELECT h, count(*) FROM fact WHERE v > 111 " \
+              "GROUP BY h ORDER BY h"
+        eng.settings.set("sql.exec.submesh.size", "off")
+        want = {q: eng.execute(q).rows for q in (q_a, q_b)}
+        eng.settings.set("sql.exec.submesh.size", "4")
+        results: dict = {}
+        errors: list = []
+
+        def run(q):
+            try:
+                s = eng.session()
+                for _ in range(4):
+                    results[q] = eng.execute(q, s).rows
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        try:
+            ts = [threading.Thread(target=run, args=(q,))
+                  for q in (q_a, q_b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts), \
+                "concurrent sub-mesh dispatch deadlocked"
+            assert not errors, errors
+            assert results[q_a] == want[q_a]
+            assert results[q_b] == want[q_b]
+        finally:
+            eng.settings.set("sql.exec.submesh.size", "auto")
+
+    def test_close_retires_threads_and_respawns_on_demand(self, eng):
+        eng.close()
+        # dispatcher identity is stable across close; the next
+        # distributed dispatch transparently respawns its thread
+        d = distagg._dispatcher_for(eng.mesh)
+        q = "SELECT g, count(*) FROM fact GROUP BY g ORDER BY g"
+        rows = eng.execute(q).rows
+        assert len(rows) == 7
+        assert d is distagg._dispatcher_for(eng.mesh)
