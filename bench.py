@@ -645,6 +645,116 @@ def run_joinskip_ab(rows, repeats):
     return out
 
 
+def run_joinorder_ab(rows, repeats):
+    """Sketch-fed join ordering A/B (round 12 tentpole): the memo's
+    cost-based join-order search running on seal-time sketch
+    statistics alone — no ANALYZE is ever issued, so the syntax arm
+    cannot borrow cardinalities either.
+
+    q9-class ladder: lineitem joins supplier, part and an EXPANDING
+    partsupp (partkey only — 4 rows per part, so the join copies
+    every probe lane 4x) before the one join that actually cuts
+    rows — orders, restricted to ~2% of customers. orders is also
+    the LARGEST dim, so the stats-blind orderer (build tables
+    ascending by row count) agrees with syntax order and schedules
+    it last. Two arms over identical data:
+
+      syntax  optimizer_sketch_stats=off — without distinct counts
+              the memo search disengages; every dim join probes at
+              full fact width, the partsupp expansion quadruples
+              that width, and the dense GROUP BY scatters over it.
+              The expansion also caps the compaction walk, so no
+              Compact ever lands: full price on every stage.
+      sketch  default — HLL distincts give the memo real join output
+              cardinalities (out = probe * build / max(nd)), so it
+              pulls the filtered orders join to the bottom and the
+              expanding partsupp join to the top; the compaction
+              gate wraps the ~2% orders output and the remaining
+              probes, the 4x expansion and the aggregation all run
+              at a fraction of the batch width.
+
+    All aggregates are exact-int (count/min/max + int sums), so the
+    two plans must return bit-identical rows."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+
+    eng = Engine(mesh=None)
+    t0 = time.time()
+    sf = rows / tpch.LINEITEM_PER_SF
+    ts = eng.clock.now()
+    gens = {
+        "lineitem": lambda: tpch.gen_lineitem(sf, rows=rows,
+                                              encoded=True),
+        "orders": lambda: tpch.gen_orders(sf),
+        "supplier": lambda: tpch.gen_supplier(sf),
+        "part": lambda: tpch.gen_part(sf),
+        "partsupp": lambda: tpch.gen_partsupp(sf),
+    }
+    for t, gen in gens.items():
+        eng.execute(tpch.DDL[t])
+        if t == "lineitem":
+            for cn, vals in tpch.LINEITEM_DICTS.items():
+                eng.store.set_dictionary(t, cn, vals)
+        cols = gen()
+        n = len(next(iter(cols.values())))
+        for lo in range(0, n, 1 << 14):
+            eng.store.insert_columns(
+                t, {k: v[lo:lo + (1 << 14)] for k, v in cols.items()},
+                ts)
+        eng.store.seal(t)
+    print(f"# joinorder datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    # filter on o_custkey, NOT o_orderkey: custkeys are uniform over
+    # the orders while lineitem is clustered by orderkey, so the
+    # surviving fact rows spread evenly across compact blocks (a
+    # clustered prefix would overflow the per-block capacity and
+    # replan uncompacted — a different bench)
+    ncust = tpch._n_cust(sf)
+    cap = max(ncust // 50, 10)   # ~2% of orders survive
+    sql = ("SELECT l_partkey AS pk, count(*) AS n, "
+           "sum(l_linenumber) AS sl, sum(ps_availqty) AS sa, "
+           "min(l_orderkey) AS mn, max(l_orderkey) AS mx "
+           "FROM lineitem "
+           "JOIN supplier ON l_suppkey = s_suppkey "
+           "JOIN part ON l_partkey = p_partkey "
+           "JOIN partsupp ON l_partkey = ps_partkey "
+           "JOIN orders ON l_orderkey = o_orderkey "
+           f"WHERE o_custkey <= {cap} "
+           "GROUP BY l_partkey ORDER BY pk LIMIT 64")
+    out = {"joinorder_ckey_cap": cap, "joinorder_ncust": ncust}
+    base = None
+    for arm in ("syntax", "sketch"):
+        eng.drop_device_cache()
+        s = eng.session()
+        s.vars.set("distsql", "off")
+        if arm == "syntax":
+            s.vars.set("optimizer_sketch_stats", "off")
+        snap0 = eng.metrics.snapshot()
+        res = eng.execute(sql, s)  # warmup: compile + upload
+        per = []
+        for _ in range(repeats):
+            t0 = time.time()
+            res = eng.execute(sql, s)
+            per.append(rows / (time.time() - t0))
+        rps = statistics.median(per)
+        d = metric_deltas(snap0, eng.metrics.snapshot())
+        out[f"joinorder_{arm}_rows_per_sec"] = round(rps)
+        out[f"joinorder_{arm}_plans"] = d.get(
+            f"sql.optimizer.{'default' if arm == 'syntax' else 'sketch'}"
+            "_plans", 0)
+        if base is None:
+            base = res.rows
+        else:
+            out["joinorder_parity"] = res.rows == base
+        print(f"# joinorder arm={arm} rows_per_sec={rps:.3e}",
+              file=sys.stderr)
+    syn = out.get("joinorder_syntax_rows_per_sec", 0)
+    if syn:
+        out["joinorder_speedup"] = round(
+            out["joinorder_sketch_rows_per_sec"] / syn, 3)
+    return out
+
+
 def run_dispatchq(rows, workers=2, iters=6):
     """Concurrent distributed dispatch (PR 3 tentpole): N sessions
     issue distributed GROUP BYs at once through the per-mesh FIFO
@@ -1115,6 +1225,15 @@ def main():
             **per,
         }))
         return
+    if mode == "joinorder_child":
+        per = run_joinorder_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "joinorder_sketch_rows_per_sec",
+            "value": per.get("joinorder_sketch_rows_per_sec", 0),
+            "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
     if mode == "concurrency_child":
         per = run_concurrency(
             rows, sessions=tuple(int(x) for x in os.environ.get(
@@ -1290,6 +1409,18 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("joinskip_")})
             out.setdefault("joinskip_rows", r["rows"])
+    # round 12 tentpole A/B: sketch-fed cost-based join ordering vs
+    # the syntax-ordered plan (optimizer_sketch_stats=off, no ANALYZE)
+    # on a q9-class ladder whose selective join hides last in syntax
+    if os.environ.get("BENCH_JOINORDER", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_JOINORDER_ROWS",
+                                         1 << 20)),
+                      "joinorder", child_timeout,
+                      mode="joinorder_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("joinorder_")})
+            out.setdefault("joinorder_rows", r["rows"])
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),

@@ -81,6 +81,13 @@ class FlowSpec:
     # scanned set, never change visible rows — safe to drop on any
     # node that cannot apply them)
     joinfilter: Optional[list] = None
+    # adaptive partial aggregation: the gateway built a raw-row fold
+    # for this statement, so each node MAY ship raw source rows
+    # instead of partials when its shard's group cardinality makes the
+    # partial stage pure overhead (node.py _adaptive_agg_stage). Off =
+    # every shard ships partials (the A/B lever and the safe default
+    # for statements without a raw fold).
+    adaptive: bool = False
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -89,7 +96,8 @@ class FlowSpec:
                 "chunk_rows": self.chunk_rows, "read_ts": self.read_ts,
                 "window": self.window, "spans": self.spans,
                 "graph": self.graph, "data_nodes": self.data_nodes,
-                "trace": self.trace, "joinfilter": self.joinfilter}
+                "trace": self.trace, "joinfilter": self.joinfilter,
+                "adaptive": self.adaptive}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

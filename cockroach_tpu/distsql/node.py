@@ -29,7 +29,7 @@ from cockroach_tpu.distsql import serde
 from cockroach_tpu.distsql import shuffle as shfl
 from cockroach_tpu.distsql.flow import (FlowCancelled, FlowRegistry,
                                         FlowSpec, Outbox)
-from cockroach_tpu.distsql.physical import UNION, split
+from cockroach_tpu.distsql.physical import RAW, UNION, split
 from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
 from cockroach_tpu.ops.batch import ColumnBatch
 from cockroach_tpu.sql import parser
@@ -293,6 +293,9 @@ class DistSQLNode:
                else eng.clock.now())
         eng._check_join_builds(node, rts)
         stage = split(node)
+        if spec.adaptive and stage.stage == "partial_agg" \
+                and stage.raw_local is not None:
+            stage = self._adaptive_agg_stage(stage)
         runf = compile_plan(stage.local, ExecParams())
         # narrow=False: per-node narrowing decisions would reflect
         # only the LOCAL shard's value range (non-deterministic across
@@ -327,6 +330,96 @@ class DistSQLNode:
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
         return runf(RunContext(scans, read_ts)), stage
+
+    def _adaptive_agg_stage(self, stage):
+        """Partial Partial Aggregates: decide, per shard at flow setup
+        time, whether the partial-aggregate stage actually reduces THIS
+        shard's data. A high-cardinality group key means nearly one
+        group per row — the partial stage then moves the same bytes
+        PLUS a device hash build for nothing — so such shards ship raw
+        source rows instead and the gateway folds them through
+        stage.raw_merge. The fold is restricted to combine-exact
+        aggregates (physical.combine_exact), so results are
+        bit-identical no matter which shards flip."""
+        import dataclasses
+        eng = self.engine
+        ship_raw = False
+        try:
+            frac = float(eng.settings.get(
+                "exec.agg.adaptive_raw_fraction"))
+            if frac > 0:
+                rows, groups = self._shard_group_estimate(stage)
+                ship_raw = rows > 0 and groups >= frac * rows
+        except Exception:
+            ship_raw = False          # estimate failure -> partials
+        if ship_raw:
+            eng.metrics.counter(
+                "exec.agg.adaptive.ship_raw",
+                "adaptive DistSQL aggregation: shards that shipped "
+                "raw rows (partials would not have reduced)").inc()
+            return dataclasses.replace(
+                stage, local=stage.raw_local,
+                union_columns=list(stage.raw_columns),
+                string_cols=dict(stage.raw_strings))
+        eng.metrics.counter(
+            "exec.agg.adaptive.partial",
+            "adaptive DistSQL aggregation: shards that kept the "
+            "partial-aggregate stage").inc()
+        return stage
+
+    def _shard_group_estimate(self, stage):
+        """(shard rows, estimated group count) for this node's shard,
+        from seal-time chunk sketches (storage/columnstore.py) — a
+        host-side lookup, no device work. Group cardinality is the
+        row-capped product of per-key HLL distincts; cross-column
+        correlation makes the product an upper bound, which only errs
+        toward shipping raw — never a wrong answer, only a perf
+        misjudgement. Any unresolvable key (computed column, column
+        without a sketch) bails to (rows, 0): keep the partial stage,
+        the status quo."""
+        from cockroach_tpu.sql import plan as P
+        from cockroach_tpu.sql.bound import BCol, walk
+        eng = self.engine
+        colmap: dict = {}          # output column -> (table, stored)
+        tables: set = set()
+
+        def rec(n):
+            if isinstance(n, P.Scan):
+                if n.table not in (UNION, RAW):
+                    tables.add(n.table)
+                    for out, stored in n.columns.items():
+                        colmap[out] = (n.table, stored)
+            elif isinstance(n, P.HashJoin):
+                rec(n.left)
+                rec(n.right)
+            elif hasattr(n, "child"):
+                rec(n.child)
+        rec(stage.local)
+        if not tables:
+            return 0, 0
+        rows = 0
+        for t in tables:
+            # seal so freshly materialized span rows have sketches
+            try:
+                eng.store.seal(t)
+            except Exception:
+                pass
+            rows = max(rows, eng.store.table(t).row_count)
+        groups = 1.0
+        for _, ge in stage.raw_merge.group_by:
+            nd = 1.0
+            for c in walk(ge):
+                if not isinstance(c, BCol):
+                    continue
+                tc = colmap.get(c.name)
+                if tc is None:
+                    return rows, 0
+                d = eng.store.sketch_stats(tc[0]).distinct.get(tc[1])
+                if d is None:
+                    return rows, 0
+                nd *= max(1, int(d))
+            groups = min(groups * nd, float(rows) * 2.0 + 1.0)
+        return rows, min(groups, float(rows))
 
     def _host_output(self, batch, plan, string_cols,
                      shared_dict=None):
@@ -680,12 +773,17 @@ class Gateway:
                  replicated_tables: set | None = None,
                  flow_timeout: float = FLOW_TIMEOUT,
                  monitor=None, window: int = 8, cluster=None,
-                 prefer_shuffle: bool = False):
+                 prefer_shuffle: bool = False,
+                 adaptive_agg: bool = True):
         # prefer_shuffle: route every shuffle-decomposable statement
         # through the multi-stage hash-exchange graph, even when a
         # single-stage plan would work (the sharded⋈sharded path is
         # always taken regardless — it has no single-stage plan)
         self.prefer_shuffle = prefer_shuffle
+        # adaptive partial aggregation (Partial Partial Aggregates):
+        # let each shard pick partials vs raw rows per statement; off
+        # forces the classic always-partial stage (A/B lever)
+        self.adaptive_agg = adaptive_agg
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -1074,6 +1172,8 @@ class Gateway:
         # ANALYZE); a gateway-local recording keeps them dark
         trace = tracing.recording_requested()
         registry = self.own.registry
+        adaptive = (self.adaptive_agg and stage.stage == "partial_agg"
+                    and stage.raw_local is not None)
         inboxes = []
         for i, nid in enumerate(nodes):
             spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
@@ -1082,13 +1182,15 @@ class Gateway:
                             spans=(spans_by_node.get(nid)
                                    if spans_by_node is not None
                                    else None),
-                            trace=trace, joinfilter=jf_frames)
+                            trace=trace, joinfilter=jf_frames,
+                            adaptive=adaptive)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
         union, merged_dicts = self._pump_and_union(
             flow_id, inboxes, stage.union_columns, stage.string_cols,
-            nodes)
+            nodes, stage=(stage if adaptive else None),
+            read_ts=read_ts)
 
         # output dictionaries come from the merged wire strings, not the
         # gateway's (possibly empty) local shard
@@ -1182,7 +1284,8 @@ class Gateway:
         return out
 
     def _pump_and_union(self, flow_id, inboxes, union_columns,
-                        string_cols, nodes: list | None = None):
+                        string_cols, nodes: list | None = None,
+                        stage=None, read_ts=None):
         nodes = nodes if nodes is not None else list(self.nodes)
         transport = self.own.transport
         registry = self.own.registry
@@ -1233,9 +1336,11 @@ class Gateway:
             for ib in inboxes:
                 for w in ib.spans:
                     tracing.attach_remote(w)
+            chunks = [c for ib in inboxes for c in ib.drain_arrays()]
+            if stage is not None:
+                chunks = self._fold_raw_chunks(chunks, stage, read_ts)
             union, merged_dicts = self._union_batch(
-                [c for ib in inboxes for c in ib.drain_arrays()],
-                union_columns, string_cols)
+                chunks, union_columns, string_cols)
         except Exception:
             # tell every producer to stop: without this a stalled or
             # errored flow leaves remote stages running and pushing
@@ -1253,6 +1358,61 @@ class Gateway:
             # re-creating registry inboxes nobody will drain
             self.own._cancel(flow_id)
         return union, merged_dicts
+
+    def _fold_raw_chunks(self, chunks, stage, read_ts):
+        """Adaptive-aggregation merge: inbound chunks arrive in two
+        forms — partial (they carry the ``__p0..`` partial-aggregate
+        columns) and raw (source rows from shards whose group
+        cardinality made partials pointless). Raw chunks union over
+        the ``__rawunion`` pseudo-table and fold through
+        stage.raw_merge — the exact combine-exact aggregate every node
+        would have run — yielding ONE more partial-form chunk; the
+        statement's union/final stages then proceed unchanged. This is
+        the top rung of the hierarchical merge: psum folds partials
+        inside a mesh, per-node partials tree-merge here across
+        rendezvous domains, and raw shards skip straight to this fold."""
+        partial = [c for c in chunks if "__p0" in c[1]]
+        raw = [c for c in chunks if "__p0" not in c[1]]
+        if not raw:
+            return partial
+        self._count("distsql.agg.raw_folds",
+                    "adaptive aggregation: gateway-side raw-row folds")
+        raw_union, raw_dicts = self._union_batch(
+            raw, stage.raw_columns, stage.raw_strings)
+        runf = compile_plan(stage.raw_merge, ExecParams())
+        out = runf(RunContext({RAW: raw_union}, jnp.int64(read_ts)))
+        host = {n: np.asarray(d) for n, d in zip(out.names, out.data)}
+        sel = np.asarray(out.sel).astype(bool)
+        for flag in ("__sum_overflow", "__ht_overflow"):
+            if flag in host and bool(np.any(host[flag][sel])):
+                raise FlowError(f"raw-row fold error: {flag}")
+        cols = {c: host[c][sel] for c in stage.union_columns}
+        valid = {c: np.asarray(out.col_valid(c))[sel]
+                 for c in stage.union_columns}
+        n = int(sel.sum())
+        # dict-coded group keys came out as codes into the raw union's
+        # merged dictionaries — decode to wire strings so the outer
+        # union re-encodes them alongside the nodes' partial chunks
+        for name, src in stage.string_cols.items():
+            d = raw_dicts.get(src)
+            codes = np.asarray(cols[name])
+            if d is None or len(d) == 0:
+                if valid[name].any():
+                    raise FlowError(
+                        f"{name}: valid raw-fold rows but missing/"
+                        "empty dictionary")
+                vals = np.zeros(len(codes), dtype="S1")
+            else:
+                bad = valid[name] & ((codes < 0) | (codes >= len(d)))
+                if bad.any():
+                    raise FlowError(
+                        f"{name}: raw-fold dictionary code out of "
+                        f"range (code {int(codes[bad][0])}, dict "
+                        f"size {len(d)})")
+                safe = np.clip(codes, 0, len(d) - 1)
+                vals = d.decode_array(safe).astype("S")
+            cols[name] = np.where(valid[name], vals, b"")
+        return partial + [(n, cols, valid)]
 
     def _union_batch(self, chunks, columns, string_cols):
         from cockroach_tpu.storage.columnstore import Dictionary

@@ -17,11 +17,15 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from cockroach_tpu.sql import plan as P
-from cockroach_tpu.sql.bound import (BAggRef, BBin, BCast, BCol, BExpr,
-                                     BoundAgg, walk)
+from cockroach_tpu.sql.bound import (BAggRef, BBin, BCast, BCol,
+                                     BDictLookup, BExpr, BoundAgg, walk)
 from cockroach_tpu.sql.types import FLOAT8, Family
 
 UNION = "__union"
+# pseudo-table the gateway's raw-row fold scans (adaptive partial
+# aggregation): the union of raw source rows from shards that chose to
+# ship rows instead of per-shard partials
+RAW = "__rawunion"
 
 
 @dataclass
@@ -40,6 +44,18 @@ class StagePlan:
     # final output name -> union string column whose merged dictionary
     # decodes it (fixes up OutputMeta.dictionaries at the gateway)
     dict_outputs: dict = field(default_factory=dict)
+    # adaptive partial aggregation (Partial Partial Aggregates): when
+    # the aggregate merges exactly (combine_exact), a shard whose group
+    # cardinality approaches its row count may ship RAW source rows —
+    # the partial stage would not reduce anything there — and the
+    # gateway folds them through raw_merge (the same aggregate each
+    # node would have run) into one extra partial chunk. None/empty
+    # when the statement is not eligible; per-shard choice happens at
+    # flow setup time (node.py _adaptive_agg_stage).
+    raw_local: P.PlanNode = None       # Project of the source columns
+    raw_columns: list = field(default_factory=list)
+    raw_strings: dict = field(default_factory=dict)
+    raw_merge: P.PlanNode = None       # Aggregate over Scan(__rawunion)
 
 
 def _peel(node: P.PlanNode):
@@ -128,6 +144,59 @@ def _subst_aggrefs(e: BExpr, mapping: dict[int, BExpr]) -> BExpr:
 
 SPLITTABLE = {"sum", "sum_int", "count", "count_rows", "min", "max",
               "any", "avg"}
+
+# aggregates whose partial/merge decomposition is bit-identical to
+# aggregating the raw rows in any order and grouping
+_ORDER_FREE = {"count", "count_rows", "min", "max", "any"}
+
+
+def combine_exact(aggs) -> bool:
+    """True when merging per-shard partials gives bit-identical results
+    to aggregating the raw rows directly, regardless of how rows split
+    across shards: min/max/any/count are order-free, and integer (or
+    scaled-decimal) sums are exactly associative. FLOAT sums — and AVG,
+    whose local stage is a float sum — depend on addition order, so the
+    adaptive raw-ship path must not rewrite them."""
+    for a in aggs:
+        if a.func in _ORDER_FREE:
+            continue
+        if a.func in ("sum", "sum_int") and a.type is not None \
+                and a.type.family is not Family.FLOAT:
+            continue
+        return False
+    return True
+
+
+def _raw_safe(core: P.Aggregate) -> bool:
+    """May this aggregate's raw source rows cross the wire? Dictionary
+    codes are node-local: the gateway re-encodes wire strings against a
+    merged dictionary, so any expression that interprets codes
+    numerically breaks under raw shipping. A PLAIN dict-coded group key
+    is safe (re-encoding preserves group identity and the hash strategy
+    regroups by the merged codes) — but a BDictLookup (its table
+    indexes the ORIGINAL codes) or a dict-coded column inside a
+    computed expression is not."""
+    def hazard(e, allow_plain_col: bool) -> bool:
+        if e is None:
+            return False
+        if allow_plain_col and isinstance(e, BCol):
+            return False
+        for sub in walk(e):
+            if isinstance(sub, BDictLookup):
+                return True
+            ty = getattr(sub, "type", None)
+            if isinstance(sub, BCol) and ty is not None \
+                    and ty.uses_dictionary:
+                return True
+        return False
+
+    for _, ge in core.group_by:
+        if hazard(ge, allow_plain_col=True):
+            return False
+    for a in core.aggs:
+        if hazard(a.arg, allow_plain_col=False):
+            return False
+    return True
 
 
 def split(node: P.PlanNode) -> StagePlan:
@@ -289,5 +358,41 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
                                   else list(core.group_lo)))
     dict_outputs = {n: e.name for n, e in final_items
                     if isinstance(e, BCol) and e.name in strings}
-    return StagePlan("partial_agg", local, _rewrap(wrappers, final),
-                     union_cols, strings, dict_outputs)
+    sp = StagePlan("partial_agg", local, _rewrap(wrappers, final),
+                   union_cols, strings, dict_outputs)
+
+    # adaptive raw-ship alternative: only for combine-exact aggregates
+    # (bit-identity across the per-shard choice) with at least one agg
+    # (so partial chunks are distinguishable by their __p0 column) and
+    # no dictionary-code hazard in the exprs that re-run at the gateway
+    if local_aggs and combine_exact(core.aggs) and _raw_safe(core):
+        types = _coltypes(core)
+        needed = set()
+        for _, e in core.group_by:
+            needed |= {c.name for c in walk(e) if isinstance(c, BCol)}
+        for a in core.aggs:
+            if a.arg is not None:
+                needed |= {c.name for c in walk(a.arg)
+                           if isinstance(c, BCol)}
+        raw_cols = sorted(needed)
+        raw_items = [(n, BCol(n, types.get(n))) for n in raw_cols]
+        try:
+            raw_strings = _string_union_cols(raw_items)
+        except DistUnsupported:
+            return sp
+        sp.raw_local = P.Project(core.child, items=raw_items)
+        sp.raw_columns = raw_cols
+        sp.raw_strings = raw_strings
+        raw_child = P.Scan(RAW, RAW, columns={n: n for n in raw_cols})
+        # same shape as the per-node partial stage, scanning the raw
+        # union: its output schema is exactly union_cols, so the fold
+        # result joins the partial chunks unchanged. Dict-coded keys
+        # force the hash strategy (codes are merged-dict at the
+        # gateway, not the planner's).
+        hashed = bool(strings or raw_strings)
+        sp.raw_merge = P.Aggregate(
+            raw_child, list(core.group_by), local_aggs, None,
+            local_items, 0 if hashed else core.max_groups,
+            [] if hashed else list(core.group_dims),
+            group_lo=([] if hashed else list(core.group_lo)))
+    return sp

@@ -93,6 +93,11 @@ class ExecParams:
     # — in practice everything on device) and fall back to lexsort
     # otherwise, tallied; off is the escape hatch / bench A/B lever.
     sort_normalized: str = "auto"
+    # EXPLAIN ANALYZE instrumentation: fn(plan_node, batch) invoked
+    # after every operator. Only meaningful on an UNJITTED eager run
+    # (the hook reads concrete row counts host-side); the engine never
+    # sets it on the jitted execution path.
+    row_hook: object = None
 
 
 class RunContext:
@@ -127,6 +132,20 @@ def _ctx_of(batch: ColumnBatch, aggs=None, params: tuple = ()) -> ExprContext:
 
 def compile_plan(node: P.PlanNode, params: ExecParams,
                  meta: P.OutputMeta | None = None) -> CompiledNode:
+    fn = _compile_plan(node, params, meta)
+    hook = params.row_hook
+    if hook is None:
+        return fn
+
+    def run_hooked(rc):
+        b = fn(rc)
+        hook(node, b)
+        return b
+    return run_hooked
+
+
+def _compile_plan(node: P.PlanNode, params: ExecParams,
+                  meta: P.OutputMeta | None = None) -> CompiledNode:
     if isinstance(node, P.Scan):
         return _compile_scan(node, params)
     if isinstance(node, P.Filter):
@@ -249,16 +268,20 @@ def compact_batch(b: ColumnBatch, frac: float,
     """Pack selected rows to the front of a batch `frac` the size.
 
     Blocked: each `block`-row segment keeps its first block*frac
-    selected rows via top_k over (sel ? index : -1) — measured on a
-    v5e, the blocked form costs ~1/3 of the full-width gather it
-    replaces at 8.4M rows, and every downstream per-row op (join
-    probe gathers, CASE math, agg partials) then runs at frac width.
+    selected rows, and every downstream per-row op (join probe
+    gathers, CASE math, agg partials) then runs at frac width. Two
+    pack strategies by backend: on TPU, top_k over (sel ? index : -1)
+    — measured on a v5e, ~1/3 the cost of the full-width gather it
+    replaces at 8.4M rows; elsewhere, cumsum-rank + scatter into a
+    (kb+1)-slot frame per block — XLA's CPU top_k costs ~3x the
+    scatter (measured at 2^18), inverting the v5e tradeoff.
     A segment with more selected rows than its capacity sets the
     __compact_overflow sentinel; results would be missing rows, so
     the engine rechecks it at materialize time and replans without
     compaction (same pattern as __ht_overflow / __topk_inexact).
-    Relative row order is NOT preserved (top_k emits largest index
-    first) — the engine only compacts under aggregation."""
+    Relative row order is NOT preserved on the top_k path (largest
+    index first; the scatter path happens to be stable) — the engine
+    only compacts under aggregation."""
     n = int(b.sel.shape[0])
     if n < 2 * block or n % block:
         return b
@@ -268,14 +291,29 @@ def compact_batch(b: ColumnBatch, frac: float,
     if kb >= block:
         return b
     sel = b.sel
-    score = jnp.where(sel, jax.lax.iota(jnp.int32, n),
-                      jnp.int32(-1)).reshape(nb, block)
-    top, idx = jax.lax.top_k(score, kb)
-    live = (top >= 0).reshape(-1)
-    base = (jnp.arange(nb, dtype=jnp.int32) * block)[:, None]
-    flat = (idx.astype(jnp.int32) + base).reshape(-1)
-    overflow = jnp.any(
-        jnp.sum(sel.reshape(nb, block), axis=1) > kb)
+    if jax.default_backend() != "tpu":
+        s = sel.reshape(nb, block)
+        pos = jnp.cumsum(s.astype(jnp.int32), axis=1) - 1
+        overflow = jnp.any(pos[:, -1] + 1 > kb)
+        base = (jnp.arange(nb, dtype=jnp.int32) * (kb + 1))[:, None]
+        # beyond-capacity and unselected rows both land in the extra
+        # slot kb, which the [:kb] slice below discards
+        dst = (jnp.where(jnp.logical_and(s, pos < kb), pos, kb)
+               + base).reshape(-1)
+        scat = jnp.full((nb * (kb + 1),), -1, jnp.int32).at[dst].set(
+            jax.lax.iota(jnp.int32, n), mode="drop")
+        flat = scat.reshape(nb, kb + 1)[:, :kb].reshape(-1)
+        live = flat >= 0
+        flat = jnp.maximum(flat, 0)
+    else:
+        score = jnp.where(sel, jax.lax.iota(jnp.int32, n),
+                          jnp.int32(-1)).reshape(nb, block)
+        top, idx = jax.lax.top_k(score, kb)
+        live = (top >= 0).reshape(-1)
+        base = (jnp.arange(nb, dtype=jnp.int32) * block)[:, None]
+        flat = (idx.astype(jnp.int32) + base).reshape(-1)
+        overflow = jnp.any(
+            jnp.sum(sel.reshape(nb, block), axis=1) > kb)
     cols = {}
     valid = {}
     for name in b.names:

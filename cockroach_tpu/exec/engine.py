@@ -1143,7 +1143,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         xla_ms = (coldstart.thread_compile_seconds() - c0) * 1e3
         node, _ = self._plan(sel, session)
         from ..sql.stats import estimate
-        costs = estimate(node, self.catalog_view().stats)
+        cv = self.catalog_view()
+        costs = estimate(node, cv.stats)
+        sources = self._scan_estimate_sources(node, cv)
+        try:
+            actuals = self._measure_actual_rows(node)
+        except Exception:
+            actuals = None  # diagnostics must never fail the statement
         lines = ["planning/execution:"]
         for name in ("plan", "compile", "upload", "dispatch",
                      "materialize"):
@@ -1160,7 +1166,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                      f"rows returned: {len(res.rows)}")
         lines.append("plan:")
         lines.extend("  " + ln for ln in P.plan_tree_repr(
-            node, costs=costs).rstrip().split("\n"))
+            node, costs=costs, actuals=actuals,
+            sources=sources).rstrip().split("\n"))
 
         # stitched remote recordings (trace propagation): subtrees
         # tagged with the serving node id render per-node, the
@@ -1183,10 +1190,49 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         return Result(names=["info"], rows=[(ln,) for ln in lines],
                       tag="EXPLAIN ANALYZE")
 
+    def _scan_estimate_sources(self, node, cv) -> dict:
+        """id(scan) -> where the optimizer's cardinalities for that
+        table came from ("analyze" | "sketch" | "default"), rendered
+        next to the estimates by EXPLAIN ANALYZE."""
+        out: dict = {}
+
+        def rec(n):
+            if isinstance(n, P.Scan):
+                st = cv.stats.get(n.table)
+                out[id(n)] = getattr(st, "source", "default")
+            for attr in ("child", "left", "right"):
+                c = getattr(n, attr, None)
+                if c is not None:
+                    rec(c)
+        rec(node)
+        return out
+
+    def _measure_actual_rows(self, node) -> dict:
+        """Instrumented re-execution for EXPLAIN ANALYZE: compile the
+        plan with a row hook and run it eagerly (unjitted) over wide
+        resident uploads, recording every operator's post-sel row
+        count — the measured side of the est-vs-actual columns.
+        Diagnostics only: gateway-local and resident regardless of
+        the statement's real placement verdict, and any failure falls
+        back to estimate-only rendering at the call site."""
+        actual: dict = {}
+
+        def hook(n, batch):
+            try:
+                actual[id(n)] = int(np.asarray(batch.sel).sum())
+            except Exception:
+                pass
+        scans = {alias: self._device_table(tname, narrow=False)
+                 for alias, tname in _collect_scans(node).items()}
+        runf = compile_plan(node, ExecParams(row_hook=hook))
+        runf(RunContext(scans, jnp.int64(self.clock.now().to_int())))
+        return actual
+
     # -- catalog -------------------------------------------------------------
     def catalog_view(self, int_ranges: bool = True,
                      read_ts: Timestamp | None = None,
-                     stats: bool = True) -> CatalogView:
+                     stats: bool = True,
+                     sketch: bool = True) -> CatalogView:
         """``stats=False`` hides every data-dependent signal (row
         counts, distinct/uniqueness probes, int ranges) so the plan
         SHAPE is a pure function of schema + statement — required by
@@ -1223,17 +1269,33 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 indexes[n] = pub
         if not stats:
             return CatalogView(schemas, dicts, {}, indexes=indexes)
+        stale_frac = self.settings.get("sql.stats.stale_row_fraction")
         stats_map = {}
         for n, td in self.store.tables.items():
+            st = None
             if td.stats is not None:
-                # stale ANALYZE output (mutations since) still informs
-                # estimates but no longer counts as authoritative
-                st = TableStats(
-                    row_count=td.row_count,
-                    distinct=dict(td.stats.distinct),
-                    null_frac=dict(td.stats.null_frac),
-                    analyzed=td.stats_generation == td.generation)
-            else:
+                # ANALYZE output wins while the table hasn't drifted
+                # far from the row count it was computed at; past the
+                # threshold it is STALE — exact-but-wrong numbers stop
+                # beating live sketch estimates
+                base = max(td.stats.analyzed_rows, 0)
+                drifted = abs(td.row_count - base) > \
+                    stale_frac * max(base, 1)
+                if not (sketch and drifted):
+                    st = TableStats(
+                        row_count=td.row_count,
+                        distinct=dict(td.stats.distinct),
+                        null_frac=dict(td.stats.null_frac),
+                        analyzed=td.stats_generation == td.generation,
+                        source="analyze",
+                        analyzed_rows=td.stats.analyzed_rows)
+            if st is None and sketch and td.chunks:
+                try:
+                    st = self.store.sketch_stats(n)
+                    st.row_count = td.row_count
+                except Exception:
+                    st = None
+            if st is None:
                 st = TableStats(row_count=td.row_count)
             stats_map[n] = st
         unique_fn = None
@@ -1307,13 +1369,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # to a placeholder instead of allocating (pg EXPLAIN semantics)
         seq_ops = ((lambda fn, name, arg: 0) if for_explain
                    else self._sequence_ops(session))
-        planner = Planner(
+        cv = self.catalog_view(
             # int-range dense GROUP BY is withheld inside explicit
             # txns: overlay rows could fall outside the committed range
             # and corrupt the mixed-radix group code
-            self.catalog_view(int_ranges=(session.txn is None),
-                              read_ts=(read_ts if session.txn is None
-                                       else None)),
+            int_ranges=(session.txn is None),
+            read_ts=(read_ts if session.txn is None else None),
+            sketch=(str(session.vars.get("optimizer_sketch_stats",
+                                         "on")).lower()
+                    not in ("off", "false")))
+        planner = Planner(
+            cv,
             subquery_eval=lambda sel, lim: self._eval_subquery(
                 _propagate_as_of(sel, stmt), session, lim),
             now_micros=read_ts.wall // 1000,
@@ -1325,7 +1391,38 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             rules=(session.vars.get("optimizer_rules", "on")
                    != "off"),
             trace=trace)
-        return planner.plan_select(stmt)
+        result = planner.plan_select(stmt)
+        if not for_explain:
+            self._count_plan_source(result[0], cv)
+        return result
+
+    def _count_plan_source(self, node, cv) -> None:
+        """sql.optimizer.{sketch,analyze,default}_plans: classify each
+        planned statement by the best estimate source its scans drew
+        on (sketch beats analyze beats default, mirroring how much of
+        the new costing actually engaged)."""
+        try:
+            from ..sql import plan as P
+            srcs = set()
+
+            def rec(n):
+                if isinstance(n, P.Scan):
+                    st = cv.stats.get(n.table)
+                    if st is not None:
+                        srcs.add(getattr(st, "source", "default"))
+                for attr in ("child", "left", "right"):
+                    c = getattr(n, attr, None)
+                    if c is not None:
+                        rec(c)
+            rec(node)
+            kind = ("sketch" if "sketch" in srcs
+                    else "analyze" if "analyze" in srcs
+                    else "default")
+            self.metrics.counter(
+                f"sql.optimizer.{kind}_plans",
+                "planned statements by estimate source").inc()
+        except Exception:
+            pass
 
     # -- sequences ------------------------------------------------------------
     SEQ_PREFIX = b"/seq/"
@@ -1837,13 +1934,22 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 upload_spec.append((alias, tname, placement, cols,
                                     do_narrow))
                 nb = sum(int(x.nbytes) for x in jax.tree.leaves(b))
+                # the router's footprint check sizes sub-meshes from
+                # the ESTIMATED post-filter working set: a selective
+                # scan's uploaded bytes mostly die at the filter, so
+                # they shouldn't force the full mesh (the check is
+                # advisory — hbm.reserve still accounts exact bytes)
+                frac = self._scan_survival_frac(node, alias, tname)
                 if sharded:
-                    sharded_bytes += nb
+                    sharded_bytes += int(nb * frac)
                 else:
-                    repl_bytes += nb
+                    repl_bytes += int(nb * frac)
             else:
-                b = self._device_table(tname, cols=cols,
-                                       narrow=do_narrow)
+                b = self._maybe_pruned_upload(node, alias, tname,
+                                              cols, do_narrow)
+                if b is None:
+                    b = self._device_table(tname, cols=cols,
+                                           narrow=do_narrow)
                 gens.append((tname, self.store.table(tname).generation))
             scans[alias] = b
             dictlens = tuple(
@@ -2786,9 +2892,11 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         join additionally require a scatter-strategy aggregate (hash,
         or dense beyond the unrolled small-G path) so there is real
         work left to shrink. Expanding joins (duplicate build keys)
-        stop the walk — their output length breaks the est bookkeeping.
-        Project and Window stop it too (fresh columns would drop the
-        sentinel / order matters)."""
+        bound the wrap point — their output length breaks the est
+        bookkeeping above, but the spine below them still compacts,
+        so the K-way copy runs over the packed width. Project and
+        Window stop the walk (fresh columns would drop the sentinel /
+        order matters)."""
         from ..sql import plan as P
 
         def build_sel(jn) -> float:
@@ -2815,7 +2923,18 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 return n, est, False, 0
             if isinstance(n, P.HashJoin):
                 if n.expand != 1:
-                    return n, 1.0, False, 1
+                    # output width is expand*input, which breaks the
+                    # est bookkeeping for wraps at or above this node
+                    # — but the probe spine BELOW still benefits: a
+                    # selective join under the expansion compacts,
+                    # and the K-way copy then multiplies the packed
+                    # width instead of the full batch. Report wrapped
+                    # so nothing above tries to compact the expanded
+                    # output.
+                    c, _, _, jb = spine(n.left, joins_above + 1,
+                                        agg_scatters)
+                    n.left = c
+                    return n, 1.0, True, jb + 1
                 c, left_est, wrapped, jb = spine(
                     n.left, joins_above + 1, agg_scatters)
                 n.left = c

@@ -183,8 +183,6 @@ class ScanPlaneMixin:
         # compiles to ~12GB of HLO temps), so a table that "fits" can
         # still OOM at compile time without this term.
         n_aggs = _count_aggs(node)
-        padded = self._row_bucket(td.row_count)
-        temp_bytes = 16 * n_aggs * padded
         # the resident upload this decision weighs would narrow its
         # int32-provable columns UNLESS the scan feeds a join
         # (_set_scan_narrowing keeps probe spines wide) — charging
@@ -193,8 +191,13 @@ class ScanPlaneMixin:
         cols = scan_cols.get(alias)
         narrow = (frozenset() if _has_join(node)
                   else self.narrow32_cols(tname, cols))
-        if (self._table_device_bytes(td, cols, narrow=narrow)
-                + temp_bytes <= budget):
+        # the working set a resident execution would REALLY upload:
+        # zone-surviving chunks when the whole table is over budget
+        # (selective scans stop escalating to paging unnecessarily)
+        eff_bytes, eff_rows = self._effective_table_bytes(
+            node, alias, tname, cols, narrow=narrow)
+        temp_bytes = 16 * n_aggs * self._row_bucket(eff_rows)
+        if eff_bytes + temp_bytes <= budget:
             return None
         # Build-side tables still upload whole: streaming the probe is
         # strictly better than not, and an over-budget build fails
@@ -314,15 +317,19 @@ class ScanPlaneMixin:
         temp_bytes = 2 * 16 * n_aggs * page_padded
         page_bytes = 2 * self._page_device_bytes(
             ptd, scan_cols.get(alias), page_rows)  # depth-2 prefetch
+        # builds charge what they will actually upload (the scans loop
+        # prunes zone-failing chunks from over-budget builds), so a
+        # selective build no longer forces the spill tier
         build_total = sum(
-            self._table_device_bytes(self.store.table(t),
-                                     scan_cols.get(a))
+            self._effective_table_bytes(node, a, t, scan_cols.get(a))[0]
             for a, t in scan_aliases.items() if a != alias)
         if (mode == "auto"
                 and build_total + temp_bytes + page_bytes <= budget):
             return None
         des_bytes, j, b, pkeys, bkeys = max(joins, key=lambda x: x[0])
-        avail = max(budget - (build_total - des_bytes)
+        # des_bytes is the FULL build (partitions gather every build
+        # row); build_total is effective, so clamp the residual
+        avail = max(budget - max(build_total - des_bytes, 0)
                     - temp_bytes - page_bytes, 1)
         nparts = 2
         while (nparts < self.MAX_SPILL_PARTITIONS
@@ -386,11 +393,10 @@ class ScanPlaneMixin:
                 return None
         cols = scan_cols.get(alias)
         if mode == "auto":
-            padded = self._row_bucket(td.row_count)
-            fits = (self._table_device_bytes(
-                td, cols, narrow=self.narrow32_cols(tname, cols))
-                + 24 * padded <= budget)
-            if fits:
+            eff_bytes, eff_rows = self._effective_table_bytes(
+                node, alias, tname, cols,
+                narrow=self.narrow32_cols(tname, cols))
+            if eff_bytes + 24 * self._row_bucket(eff_rows) <= budget:
                 return None
         return SpillPlan(
             kind="sort", alias=alias, table=tname, page_rows=page_rows,
@@ -453,6 +459,145 @@ class ScanPlaneMixin:
                  else np.dtype(col.type.np_dtype).itemsize)
             total += (w + 1) * padded
         return total
+
+    def _chunks_device_bytes(self, td, chunks, cols,
+                             narrow: frozenset = frozenset()) -> int:
+        """_table_device_bytes over a chunk subset (+ any open rows)."""
+        n = sum(c.n for c in chunks) + len(td.open_ts)
+        padded = self._row_bucket(n)
+        total = 16 * padded
+        for col in td.schema.columns:
+            if cols is not None and col.name not in cols:
+                continue
+            w = (4 if col.name in narrow
+                 else np.dtype(col.type.np_dtype).itemsize)
+            total += (w + 1) * padded
+        return total
+
+    def _zone_surviving_chunks(self, node, alias, tname):
+        """(surviving chunks, compiled preds) for the plan's pushed-
+        down predicates over `alias`, judged against seal-time zones
+        and blooms — the same per-chunk verdict the streamed page
+        source renders, evaluated once at decision/upload time. Empty
+        preds means nothing was zone-judgeable (keep == all chunks)."""
+        from .stream import extract_zone_preds
+        td = self.store.table(tname)
+        preds = extract_zone_preds(node, alias)
+        if not preds:
+            return list(td.chunks), ()
+        keep = []
+        for c in td.chunks:
+            ok = True
+            for p in preds:
+                if p.col is None:
+                    if not p.check(None, None, 0, 0):
+                        ok = False
+                        break
+                    continue
+                lo, hi, nulls, nvalid = c.zone(p.col)
+                if not p.check(lo, hi, nulls, nvalid):
+                    ok = False
+                    break
+                if p.member is not None \
+                        and not p.member.chunk_ok(c, p.col):
+                    ok = False
+                    break
+            if ok:
+                keep.append(c)
+        return keep, preds
+
+    def _effective_table_bytes(self, node, alias, tname, cols,
+                               narrow: frozenset = frozenset()
+                               ) -> tuple[int, int]:
+        """(device bytes, rows) the upload of this scan will ACTUALLY
+        take: the whole table when it fits the budget (the cached
+        resident path), else the zone-surviving chunk subset — exactly
+        what _maybe_pruned_upload ships. Sizing the stream/spill
+        verdicts from this instead of the declared table keeps
+        selective scans from escalating to paging/spill when their
+        post-filter working set fits."""
+        td = self.store.table(tname)
+        full = self._table_device_bytes(td, cols, narrow=narrow)
+        budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
+        if budget <= 0 or full <= budget:
+            return full, td.row_count
+        keep, preds = self._zone_surviving_chunks(node, alias, tname)
+        if not preds or len(keep) == len(td.chunks):
+            return full, td.row_count
+        rows = sum(c.n for c in keep) + len(td.open_ts)
+        return (self._chunks_device_bytes(td, keep, cols,
+                                          narrow=narrow), rows)
+
+    def _maybe_pruned_upload(self, node, alias, tname, cols,
+                             do_narrow: bool):
+        """UNCACHED upload of only the zone-surviving chunks, used
+        when the whole table would blow the HBM budget but the scan's
+        pushed-down predicates prune chunks host-side — the resident
+        analogue of streamed page skipping, with the same correctness
+        contract (a dropped chunk's rows fail the predicate for every
+        row version, so the device filter would drop them anyway).
+        None -> caller keeps the cached whole-table path."""
+        budget = int(self.settings.get("sql.exec.hbm_budget_bytes"))
+        if budget <= 0:
+            return None
+        td = self.store.table(tname)
+        narrow = (self.narrow32_cols(tname, cols) if do_narrow
+                  else frozenset())
+        if self._table_device_bytes(td, cols, narrow=narrow) <= budget:
+            return None
+        if td.open_ts:
+            self.store.seal(tname)
+        keep, preds = self._zone_surviving_chunks(node, alias, tname)
+        if not preds or len(keep) == len(td.chunks):
+            return None
+        row_w = 16 + sum(
+            np.dtype(c.type.np_dtype).itemsize + 1
+            for c in td.schema.columns
+            if cols is None or c.name in cols)
+        dropped_rows = sum(c.n for c in td.chunks) \
+            - sum(c.n for c in keep)
+        self.metrics.counter(
+            "exec.skip.predicate.chunks",
+            "over-budget resident scan chunks pruned host-side by "
+            "pushed-down zone predicates").inc(
+                len(td.chunks) - len(keep))
+        self.metrics.counter(
+            "exec.skip.predicate.bytes",
+            "host->device bytes avoided by predicate chunk pruning"
+        ).inc(row_w * dropped_rows)
+        return self._batch_from_chunks(td, keep, cols, narrow=narrow)
+
+    def _scan_survival_frac(self, node, alias, tname) -> float:
+        """Estimated post-filter fraction of a scan's rows: sketch-
+        stats selectivity of its pushed-down predicates (scan filter
+        plus Filter nodes separated only by Filter/Compact, the
+        extract_zone_preds discipline). 1.0 when nothing is judgeable;
+        floored at 1/64 so footprint heuristics never size to zero."""
+        from ..sql import stats as S
+        from .stream import _find_chain
+        td = self.store.table(tname)
+        if td.row_count == 0:
+            return 1.0
+        try:
+            st = self.store.sketch_stats(tname)
+        except Exception:
+            return 1.0
+        chain = _find_chain(node, alias)
+        if chain is None:
+            return 1.0
+        sel = 1.0
+        scan = chain[0]
+        if scan.filter is not None:
+            sel *= S._pred_selectivity(scan.filter, st)
+        for anc in chain[1:]:
+            if isinstance(anc, P.Compact):
+                continue
+            if isinstance(anc, P.Filter):
+                if anc.pred is not None:
+                    sel *= S._pred_selectivity(anc.pred, st)
+                continue
+            break
+        return float(min(1.0, max(sel, 1.0 / 64.0)))
 
     def _page_source(self, tname: str, cols, page_rows: int,
                      zone_preds=(), read_ts=None) -> PageSource:

@@ -129,7 +129,13 @@ def search(aliases: list[str], scan_rows, join_info,
                 if build_mult > MAX_BUILD_MULT:
                     cost += MULT_PENALTY * build_mult
                 considered += 1
-                if champion is None or cost < champion.cost:
+                # the 2-table case is an exact tie under this model
+                # (cost is symmetric in probe/build), so break ties
+                # toward the smaller build: it caps hash-table HBM
+                # and keeps the bigger side streamable as the probe
+                key = (cost, build)
+                if champion is None or key < champ_key:
+                    champ_key = key
                     champion = GroupPlan(cost=cost, rows=out,
                                          root=b.root,
                                          order=b.order + [last])

@@ -153,21 +153,33 @@ class OutputMeta:
 
 
 def plan_tree_repr(node: PlanNode, indent: int = 0,
-                   costs: dict | None = None) -> str:
+                   costs: dict | None = None,
+                   actuals: dict | None = None,
+                   sources: dict | None = None) -> str:
     """Render the plan tree; with ``costs`` (sql/stats.estimate output,
     id(node) -> (est_rows, est_cost)) each line gets the optimizer's
     cardinality/cost annotations, like EXPLAIN's estimated-row counts
-    in the reference."""
+    in the reference. EXPLAIN ANALYZE additionally passes ``actuals``
+    (id(node) -> measured post-sel rows from the instrumented rerun)
+    and ``sources`` (id(scan) -> "analyze"|"sketch"|"default", where
+    the scan's cardinalities came from) so est-vs-actual drift — and
+    which estimator produced the est — reads off each line."""
     pad = "  " * indent
 
     def ann() -> str:
-        if costs is None or id(node) not in costs:
-            return ""
-        rows, cost = costs[id(node)]
-        return f"  (rows≈{rows:.0f} cost≈{cost:.0f})"
+        s = ""
+        if costs is not None and id(node) in costs:
+            rows, cost = costs[id(node)]
+            src = ("" if sources is None or id(node) not in sources
+                   else f" est={sources[id(node)]}")
+            s += f"  (rows≈{rows:.0f} cost≈{cost:.0f}{src})"
+        if actuals is not None and id(node) in actuals:
+            s += f"  (actual rows={actuals[id(node)]})"
+        return s
 
     def child(n, extra_indent: int = 1) -> str:
-        return plan_tree_repr(n, indent + extra_indent, costs)
+        return plan_tree_repr(n, indent + extra_indent, costs,
+                              actuals, sources)
 
     if isinstance(node, Scan):
         f = f" filter={node.filter!r}" if node.filter is not None else ""

@@ -938,6 +938,24 @@ class ColumnStore:
             td.stats_generation = td.generation
             return td.stats
 
+    def sketch_stats(self, name: str):
+        """Planner stats derived from seal-time chunk summaries
+        (sql/stats.sketch_table_stats) — cached per table generation
+        like _ts_hi_locked, because the merge walks every chunk's
+        sketch registers. Never seals: open rows simply don't
+        contribute (the execution path seals before planning, so in
+        practice the summaries cover everything)."""
+        from ..sql.stats import sketch_table_stats
+        td = self.table(name)
+        with self._lock:
+            ck = ("__sketch_stats__",)
+            hit = td.key_distinct_cache.get(ck)
+            if hit is not None and hit[0] == td.generation:
+                return hit[1]
+            st = sketch_table_stats(td)
+            td.key_distinct_cache[ck] = (td.generation, st)
+            return st
+
     def _distinct_under(self, td: TableData, cols: tuple,
                         row_mask_fn) -> tuple[int, int]:
         """(distinct combined-key count, non-NULL-key row count) over

@@ -118,6 +118,16 @@ def _register_builtins(s: Settings):
                "device-memory budget for resident table uploads; "
                "aggregate scans over bigger tables stream in pages "
                "(the HBM analogue of --max-sql-memory / workmem)")
+    s.register("sql.stats.stale_row_fraction", 0.2, float,
+               "row-count drift (fraction of the ANALYZE-time count) "
+               "past which ANALYZE statistics are considered stale "
+               "and the planner falls back to seal-time sketch "
+               "estimates")
+    s.register("exec.agg.adaptive_raw_fraction", 0.5, float,
+               "DistSQL adaptive aggregation: when a shard's "
+               "estimated group count exceeds this fraction of its "
+               "row count, ship raw rows instead of per-shard "
+               "partial aggregates (Partial Partial Aggregates)")
     s.register("sql.trace.slow_statement.threshold", 0.0, float,
                "statements slower than this many seconds keep their "
                "trace recording in the /debug/tracez ring buffer "

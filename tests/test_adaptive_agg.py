@@ -1,0 +1,192 @@
+"""Adaptive partial aggregation (Partial Partial Aggregates).
+
+Each DistSQL shard checks, at flow setup time, whether the
+partial-aggregate stage would actually reduce its data: when the
+estimated group count approaches the shard's row count the partials
+are pure overhead, so the shard ships raw source rows and the gateway
+folds them through the same combine-exact aggregate
+(distsql/physical.py raw_merge). Restricted to order-free /
+integer-sum aggregates, the result is bit-identical no matter which
+shards flip — verified here against the always-partial arm and the
+single-engine oracle."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.distsql import physical
+from cockroach_tpu.distsql.node import DistSQLNode, Gateway
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.kvserver.transport import LocalTransport
+from cockroach_tpu.sql import parser
+from cockroach_tpu.sql.planner import Planner
+
+ROWS = 1500
+DDL = ("CREATE TABLE t (id INT PRIMARY KEY, k INT, s STRING, "
+       "v INT, f FLOAT)")
+
+
+def _cols(ids: np.ndarray, keyspace: int) -> dict:
+    return {
+        "id": ids.astype(np.int64),
+        "k": (ids * 7919 % keyspace).astype(np.int64),
+        "s": np.array([f"u{j * 13 % keyspace}" for j in ids]),
+        "v": (ids % 97).astype(np.int64),
+        "f": (ids % 97).astype(np.float64) / 7.0,
+    }
+
+
+def _build(adaptive: bool, keyspaces=(10_0003, 10_0003, 10_0003)):
+    """3 data nodes + gateway; per-node group-key cardinality set by
+    that shard's keyspace (small keyspace -> few groups -> partials)."""
+    transport = LocalTransport()
+    nodes, engines = [], []
+    for i in range(4):
+        eng = Engine()
+        eng.execute(DDL)
+        if i > 0:
+            lo, hi = (i - 1) * ROWS // 3, i * ROWS // 3
+            eng.store.insert_columns(
+                "t", _cols(np.arange(lo, hi), keyspaces[i - 1]),
+                eng.clock.now())
+            eng.store.seal("t")
+        engines.append(eng)
+        nodes.append(DistSQLNode(i, eng, transport))
+    gw = Gateway(nodes[0], [1, 2, 3], adaptive_agg=adaptive)
+    return gw, engines
+
+
+def _oracle(keyspaces=(10_0003, 10_0003, 10_0003)) -> Engine:
+    eng = Engine()
+    eng.execute(DDL)
+    for i, ks in enumerate(keyspaces):
+        lo, hi = i * ROWS // 3, (i + 1) * ROWS // 3
+        eng.store.insert_columns("t", _cols(np.arange(lo, hi), ks),
+                                 eng.clock.now())
+    return eng
+
+
+def _msum(engines, name) -> float:
+    return sum(m.value() for e in engines
+               if (m := e.metrics.get(name)) is not None)
+
+
+QUERIES = [
+    ("SELECT k, count(*), sum(v), min(v), max(v) FROM t GROUP BY k "
+     "ORDER BY k LIMIT 60"),
+    "SELECT s, count(*), sum(v) FROM t GROUP BY s ORDER BY s LIMIT 60",
+    "SELECT k, min(id), max(id) FROM t GROUP BY k ORDER BY k LIMIT 40",
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("qi", range(len(QUERIES)))
+    def test_bit_identical_and_ships_raw(self, qi):
+        q = QUERIES[qi]
+        gw_on, e_on = _build(True)
+        gw_off, e_off = _build(False)
+        got_on = gw_on.run(q)
+        got_off = gw_off.run(q)
+        want = _oracle().execute(q)
+        assert got_on.rows == got_off.rows      # bit-identical A/B
+        assert got_on.rows == want.rows
+        assert got_on.names == want.names
+        # near-unique keys: every shard flips, the gateway folds once
+        assert _msum(e_on, "exec.agg.adaptive.ship_raw") == 3
+        assert _msum(e_on, "distsql.agg.raw_folds") == 1
+        assert _msum(e_off, "exec.agg.adaptive.ship_raw") == 0
+
+    def test_mixed_shards_fold_both_forms(self):
+        """One low-cardinality shard keeps partials while two flip to
+        raw — the gateway merges both chunk forms into one answer."""
+        keyspaces = (5, 10_0003, 10_0003)
+        gw_on, e_on = _build(True, keyspaces)
+        gw_off, _ = _build(False, keyspaces)
+        q = QUERIES[0]
+        assert gw_on.run(q).rows == gw_off.run(q).rows
+        assert _msum(e_on, "exec.agg.adaptive.partial") == 1
+        assert _msum(e_on, "exec.agg.adaptive.ship_raw") == 2
+        assert _msum(e_on, "distsql.agg.raw_folds") == 1
+
+    def test_low_cardinality_keeps_partials(self):
+        keyspaces = (7, 7, 7)
+        gw_on, e_on = _build(True, keyspaces)
+        q = QUERIES[0]
+        want = _oracle(keyspaces).execute(q)
+        assert gw_on.run(q).rows == want.rows
+        assert _msum(e_on, "exec.agg.adaptive.partial") == 3
+        assert _msum(e_on, "exec.agg.adaptive.ship_raw") == 0
+
+    def test_fuzzed_parity(self):
+        """Random shard sizes/cardinalities x random eligible
+        aggregate mixes: on == off == oracle, always."""
+        rng = np.random.default_rng(7)
+        aggsets = ["count(*), sum(v)", "min(v), max(id)",
+                   "sum(id), count(*), max(v)"]
+        for trial in range(3):
+            ks = tuple(int(rng.choice([3, 40, 9973, 10_0003]))
+                       for _ in range(3))
+            q = (f"SELECT k, {aggsets[trial]} FROM t GROUP BY k "
+                 "ORDER BY k LIMIT 50")
+            gw_on, _ = _build(True, ks)
+            gw_off, _ = _build(False, ks)
+            want = _oracle(ks).execute(q)
+            assert gw_on.run(q).rows == gw_off.run(q).rows == want.rows, \
+                (trial, ks)
+
+
+class TestBytesMoved:
+    def test_high_cardinality_ships_fewer_bytes(self):
+        """The point of the feature: with ~one group per row, raw rows
+        (2 source columns) are strictly smaller on the wire than
+        partial groups (key + 4 partial columns)."""
+        q = QUERIES[0]
+        gw_on, e_on = _build(True)
+        gw_off, e_off = _build(False)
+        assert gw_on.run(q).rows == gw_off.run(q).rows
+        sent_on = _msum(e_on, "shuffle.bytes.sent")
+        sent_off = _msum(e_off, "shuffle.bytes.sent")
+        assert sent_on < sent_off, (sent_on, sent_off)
+
+
+class TestEligibility:
+    def _stage(self, sql: str):
+        eng = Engine()
+        eng.execute(DDL)
+        node, _ = Planner(eng.catalog_view(int_ranges=False),
+                          use_memo=False).plan_select(parser.parse(sql))
+        return physical.split(node)
+
+    def test_float_sum_not_eligible(self):
+        st = self._stage("SELECT k, sum(f) FROM t GROUP BY k")
+        assert st.stage == "partial_agg" and st.raw_local is None
+
+    def test_avg_not_eligible(self):
+        st = self._stage("SELECT k, avg(v) FROM t GROUP BY k")
+        assert st.stage == "partial_agg" and st.raw_local is None
+
+    def test_int_aggs_eligible(self):
+        st = self._stage(
+            "SELECT s, count(*), sum(v), min(v) FROM t GROUP BY s")
+        assert st.raw_local is not None
+        assert st.raw_columns == ["t.s", "t.v"]
+        assert "t.s" in st.raw_strings
+        assert st.raw_merge is not None
+
+    def test_dict_code_hazard_blocks_raw(self):
+        """min/max over a dictionary-coded column would compare
+        node-local codes after a gateway re-encode — never raw-ship."""
+        st = self._stage("SELECT k, min(s) FROM t GROUP BY k")
+        if st.stage == "partial_agg":
+            assert st.raw_local is None
+
+    def test_combine_exact_unit(self):
+        from cockroach_tpu.sql.bound import BCol, BoundAgg
+        from cockroach_tpu.sql.types import FLOAT8, INT8
+        ok = [BoundAgg("count_rows", None, INT8),
+              BoundAgg("sum_int", BCol("x", INT8), INT8),
+              BoundAgg("min", BCol("x", INT8), INT8)]
+        assert physical.combine_exact(ok)
+        assert not physical.combine_exact(
+            ok + [BoundAgg("sum", BCol("y", FLOAT8), FLOAT8)])
+        assert not physical.combine_exact(
+            [BoundAgg("avg", BCol("x", INT8), INT8)])

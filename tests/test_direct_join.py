@@ -126,15 +126,18 @@ class TestEngineDirectJoin:
         e = Engine()
         e.execute("CREATE TABLE dim (k INT PRIMARY KEY, v INT)")
         e.execute("CREATE TABLE fact (k INT)")
-        # 3 keys spread over a 10^9 span: direct table would be huge.
-        # fact has duplicate keys so the optimizer cannot swap it into
-        # the build side — the sparse dim MUST be the build.
+        # dim's 3 keys spread over a 10^9 span: a direct table over
+        # them would be huge. Whichever build side the optimizer
+        # picks (sketch distinct counts let it build the dup-keyed
+        # fact instead, whose single key spans 1), the span guard
+        # must hold: direct addressing either disengages or covers a
+        # small span — never a 10^9-slot table.
         e.execute("INSERT INTO dim VALUES (1,1), (500000000,2), "
                   "(1000000000,3)")
         e.execute("INSERT INTO fact VALUES (500000000), (500000000)")
         j = self._join_node(
             e, "SELECT d.v FROM fact f JOIN dim d ON f.k = d.k")
-        assert j.direct is None
+        assert j.direct is None or j.direct[1] <= 1024
         assert e.execute("SELECT d.v FROM fact f "
                          "JOIN dim d ON f.k = d.k").rows == [(2,), (2,)]
 

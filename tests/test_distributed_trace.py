@@ -181,7 +181,7 @@ def _parse_vars(text: str):
     samples: dict = {}
     types: dict = {}
     sample_re = re.compile(
-        r'^([a-z_][a-z0-9_]*)(\{le="[^"]+"\})? (-?[0-9.eE+]+|'
+        r'^([a-z_][a-z0-9_]*)(\{le="[^"]+"\})? (-?[0-9.eE+-]+|'
         r'-?inf|nan)$')
     for ln in text.splitlines():
         if not ln.strip():

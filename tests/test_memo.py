@@ -3,8 +3,10 @@
 The compact analogue of pkg/sql/opt's memo + xform exploration +
 costing (optimizer.go:239): System-R DP over connected left-deep
 orders with stats-driven selectivity and build-multiplicity
-constraints. Engages only when every table has ANALYZE statistics;
-falls back to the greedy orderer otherwise.
+constraints. Engages when every table has cardinalities — from
+ANALYZE, or derived at plan time from seal-time chunk sketches
+(sql/stats.sketch_table_stats); falls back to the greedy orderer
+otherwise (e.g. `SET optimizer_sketch_stats = off` with no ANALYZE).
 """
 
 import pytest
@@ -69,11 +71,23 @@ class TestPlannerIntegration:
          "GROUP BY dim1.grp, dim2.cat ORDER BY dim1.grp, dim2.cat")
 
     def test_memo_engages_only_with_stats(self, eng):
+        # sketch stats withheld and no ANALYZE -> greedy ordering
+        s = eng.session()
+        s.vars.set("optimizer_sketch_stats", "off")
         plan = "\n".join(
-            r[0] for r in eng.execute("EXPLAIN " + self.Q).rows)
-        assert "memo:" not in plan  # no ANALYZE yet -> greedy
+            r[0] for r in eng.execute("EXPLAIN " + self.Q, s).rows)
+        assert "memo:" not in plan
         for t in ("f", "dim1", "dim2"):
             eng.execute(f"ANALYZE {t}")
+        plan = "\n".join(
+            r[0] for r in eng.execute("EXPLAIN " + self.Q, s).rows)
+        assert "memo:" in plan and "best order ['f'" in plan
+
+    def test_memo_engages_from_sketch_stats(self, eng):
+        """Without any ANALYZE, seal-time HLL sketches supply the
+        distinct counts the memo gate needs — once chunks exist."""
+        for t in ("f", "dim1", "dim2"):
+            eng.store.seal(t)
         plan = "\n".join(
             r[0] for r in eng.execute("EXPLAIN " + self.Q).rows)
         assert "memo:" in plan and "best order ['f'" in plan

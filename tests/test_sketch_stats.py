@@ -1,0 +1,213 @@
+"""Sketch-fed cost-based planning (sql/stats.py sketch_table_stats +
+zone/bloom selectivity).
+
+The statistics-without-ANALYZE half of the optimizer: seal-time HLL
+sketches union mergeably across chunks into planner cardinalities, and
+zone maps + blooms turn the SEL_EQ/SEL_RANGE constants into real
+per-chunk overlap fractions. The reference gets the same numbers from
+its stats cache + histogram forecasts (pkg/sql/stats); here the
+summaries are free by-products of chunk sealing."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.exec.engine import Engine
+from cockroach_tpu.sql import stats as S
+from cockroach_tpu.sql.bound import (BBetween, BBin, BCol, BConst,
+                                     BInList, BIsNull)
+from cockroach_tpu.sql.types import INT8
+from cockroach_tpu.storage.chunkstats import DistinctSketch
+
+
+class TestHLLMergeFuzz:
+    """Chunked HLL merge must track np.unique within ±15% (256
+    registers: ~6.5% stddev; linear counting below ~640)."""
+
+    @pytest.mark.parametrize("dtype", [np.int16, np.int32, np.int64])
+    @pytest.mark.parametrize("seed,n_chunks,distinct", [
+        (1, 1, 200), (2, 3, 700), (3, 6, 2000), (4, 4, 25_000),
+    ])
+    def test_sketch_level_merge(self, dtype, seed, n_chunks, distinct):
+        rng = np.random.default_rng(seed * 1000 + n_chunks)
+        info = np.iinfo(dtype)
+        vals = rng.choice(
+            np.arange(info.min, info.min + 4 * distinct, 4,
+                      dtype=np.int64),
+            size=distinct, replace=False).astype(dtype)
+        rows = np.repeat(vals, rng.integers(1, 4, size=distinct))
+        rng.shuffle(rows)
+        merged = DistinctSketch()
+        for part in np.array_split(rows, n_chunks):
+            sk = DistinctSketch()
+            sk.add(part.astype(np.int64))
+            merged.merge(sk)
+        true = len(np.unique(rows))
+        assert merged.estimate() == pytest.approx(true, rel=0.15)
+
+    @pytest.mark.parametrize("seed,null_frac,n_batches", [
+        (10, 0.0, 2), (11, 0.3, 3), (12, 0.9, 4),
+    ])
+    def test_table_level_with_nulls(self, seed, null_frac, n_batches):
+        """Store-level merge: one sealed chunk per batch, NULLs must
+        feed null_frac but never the distinct sketch."""
+        rng = np.random.default_rng(seed)
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, x INT)")
+        nid = 0
+        kept = []
+        for _ in range(n_batches):
+            n = 500
+            xs = rng.integers(0, 900, size=n)
+            isnull = rng.random(n) < null_frac
+            vals = ",".join(
+                f"({nid + i},{'NULL' if isnull[i] else xs[i]})"
+                for i in range(n))
+            eng.execute(f"INSERT INTO t VALUES {vals}")
+            eng.store.seal("t")
+            kept.append(xs[~isnull])
+            nid += n
+        st = eng.store.sketch_stats("t")
+        assert st.source == "sketch"
+        true = len(np.unique(np.concatenate(kept)))
+        if true == 0:
+            assert st.distinct.get("x", 1) <= 2
+        else:
+            assert st.distinct["x"] == pytest.approx(true, rel=0.15)
+        want_nulls = nid - sum(len(k) for k in kept)
+        assert st.null_frac["x"] == pytest.approx(
+            want_nulls / nid, abs=0.02)
+
+    def test_dict_coded_strings_keep_distinct_drop_zones(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, s STRING)")
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},'name-{i % 37}')" for i in range(600)))
+        eng.store.seal("t")
+        st = eng.store.sketch_stats("t")
+        assert st.distinct["s"] == pytest.approx(37, rel=0.15)
+        # codes are dictionary-insertion-ordered: min/max over them is
+        # meaningless against SQL constants, so no zones/blooms
+        assert "s" not in st.zones and "s" not in st.blooms
+
+
+def _int_col(name: str) -> BCol:
+    return BCol(name, INT8)
+
+
+def _eq(col: str, v) -> BBin:
+    return BBin("=", _int_col(col), BConst(v, INT8), None)
+
+
+class TestZoneSelectivity:
+    """Zone-overlap selectivity units: chunk layout [0,999] and
+    [1000,1999], 1000 valid rows each, all values distinct."""
+
+    @pytest.fixture(scope="class")
+    def stats(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        for lo in (0, 1000):
+            eng.execute("INSERT INTO t VALUES " + ",".join(
+                f"({i},{i})" for i in range(lo, lo + 1000)))
+            eng.store.seal("t")
+        return eng.store.sketch_stats("t")
+
+    def test_eq_present_value(self, stats):
+        # one chunk contains it: cand/total * 1/nd ≈ (1/2) * (1/2000)
+        sel = S._pred_selectivity(_eq("t.v", 500), stats)
+        assert sel == pytest.approx(0.5 / stats.distinct["v"], rel=0.3)
+
+    def test_eq_absent_value_bloom_zeroed(self, stats):
+        # inside the zone range of chunk 1 but filtered by its bloom
+        # (values are multiples of 1 so pick beyond max instead);
+        # fully outside every zone -> the 0.5/total floor
+        sel = S._pred_selectivity(_eq("t.v", 10_000_000), stats)
+        assert sel == pytest.approx(0.5 / 2000)
+
+    def test_range_half_overlap(self, stats):
+        pred = BBin("<", _int_col("t.v"), BConst(1000, INT8), None)
+        sel = S._pred_selectivity(pred, stats)
+        assert sel == pytest.approx(0.5, rel=0.05)
+
+    def test_range_no_overlap_floor(self, stats):
+        pred = BBin(">", _int_col("t.v"), BConst(50_000, INT8), None)
+        sel = S._pred_selectivity(pred, stats)
+        assert sel <= 0.01
+
+    def test_between_quarter(self, stats):
+        pred = BBetween(_int_col("t.v"), BConst(0, INT8),
+                        BConst(499, INT8), False)
+        sel = S._pred_selectivity(pred, stats)
+        assert sel == pytest.approx(0.25, rel=0.1)
+
+    def test_negated_between_complements(self, stats):
+        pred = BBetween(_int_col("t.v"), BConst(0, INT8),
+                        BConst(499, INT8), True)
+        sel = S._pred_selectivity(pred, stats)
+        assert sel == pytest.approx(0.75, rel=0.1)
+
+    def test_inlist_sums_eq_sels(self, stats):
+        pred = BInList(_int_col("t.v"),
+                       [3, 700, 1500, 99_999_999], False)
+        sel = S._pred_selectivity(pred, stats)
+        # three present values + one absent: ~3 * (0.5/nd)
+        assert sel == pytest.approx(
+            3 * 0.5 / stats.distinct["v"], rel=0.5)
+
+    def test_isnull_uses_null_frac(self, stats):
+        pred = BIsNull(_int_col("t.v"), False)
+        assert S._pred_selectivity(pred, stats) <= 0.001
+        notnull = BIsNull(_int_col("t.v"), True)
+        assert S._pred_selectivity(notnull, stats) >= 0.999
+
+
+class TestStaleness:
+    def test_analyze_goes_stale_after_drift(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i % 10})" for i in range(1000)))
+        eng.execute("ANALYZE t")
+        assert eng.catalog_view().stats["t"].source == "analyze"
+        # +30% rows > sql.stats.stale_row_fraction (0.2 default)
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i % 10})" for i in range(1000, 1300)))
+        eng.store.seal("t")
+        st = eng.catalog_view().stats["t"]
+        assert st.source == "sketch"
+        assert st.row_count == 1300
+        # a fresh ANALYZE re-earns exact stats
+        eng.execute("ANALYZE t")
+        assert eng.catalog_view().stats["t"].source == "analyze"
+
+    def test_sketch_optout_session_var(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i})" for i in range(100)))
+        eng.store.seal("t")
+        assert eng.catalog_view().stats["t"].source == "sketch"
+        assert eng.catalog_view(sketch=False).stats["t"].source \
+            == "default"
+
+    def test_plan_source_metrics_and_explain_tag(self):
+        eng = Engine()
+        eng.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i})" for i in range(200)))
+        eng.execute("SELECT count(*) FROM t WHERE v < 50")
+        m = eng.metrics.get("sql.optimizer.sketch_plans")
+        assert m is not None and m.value() >= 1
+        txt = "\n".join(
+            r[0] for r in eng.execute(
+                "EXPLAIN ANALYZE SELECT count(*) FROM t "
+                "WHERE v < 50").rows)
+        assert "est=sketch" in txt and "actual rows=" in txt
+        eng.execute("ANALYZE t")
+        txt = "\n".join(
+            r[0] for r in eng.execute(
+                "EXPLAIN ANALYZE SELECT count(*) FROM t "
+                "WHERE v < 50").rows)
+        assert "est=analyze" in txt
+        m = eng.metrics.get("sql.optimizer.analyze_plans")
+        assert m is not None and m.value() >= 1
