@@ -201,6 +201,17 @@ def run(rows_by_query, pipeline, repeats, tag=""):
                 eng, tpch.QUERIES[which], rows, q_pipe, q_reps,
                 lat_probes=o_lat)
             deltas[which] = metric_deltas(snap0, eng.metrics.snapshot())
+            # operator-profile digest (round 13): the instrumented
+            # eager rerun attributes the query's device seconds and
+            # bytes moved to individual plan operators — top-3 by
+            # device time lands in the BENCH record next to the rate
+            # it explains. Never lets a profiling failure kill the
+            # measured number.
+            try:
+                deltas[which]["profile"] = eng.operator_profile(
+                    tpch.QUERIES[which])
+            except Exception as e:  # pragma: no cover
+                deltas[which]["profile"] = {"error": type(e).__name__}
             results[which] = rps
             rows_used[which] = rows
             gbps = ""
@@ -224,6 +235,11 @@ def run(rows_by_query, pipeline, repeats, tag=""):
             if interesting:
                 print(f"# {tag}{which} metric deltas: "
                       f"{json.dumps(interesting, sort_keys=True)}",
+                      file=sys.stderr)
+            prof = deltas[which].get("profile")
+            if prof and "top_ops" in prof:
+                print(f"# {tag}{which} profile: "
+                      f"{json.dumps(prof, sort_keys=True)}",
                       file=sys.stderr)
         print(f"# {tag}datagen_s={gen_s:.1f} rows={rows}", file=sys.stderr)
         del eng

@@ -88,6 +88,12 @@ class FlowSpec:
     # every shard ships partials (the A/B lever and the safe default
     # for statements without a raw fold).
     adaptive: bool = False
+    # statement diagnostics: when the gateway's statement wants a
+    # per-operator profile (EXPLAIN ANALYZE (DEBUG) / armed capture),
+    # remote nodes run their stage under a fine ProfileSink and ship
+    # the node-tagged operator table back ahead of EOF (a
+    # "flow_profile" frame, the flow_span analogue)
+    profile: bool = False
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -97,7 +103,7 @@ class FlowSpec:
                 "window": self.window, "spans": self.spans,
                 "graph": self.graph, "data_nodes": self.data_nodes,
                 "trace": self.trace, "joinfilter": self.joinfilter,
-                "adaptive": self.adaptive}
+                "adaptive": self.adaptive, "profile": self.profile}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":
@@ -114,6 +120,9 @@ class Inbox:
         self.eof = False
         self.error: Optional[str] = None
         self.spans: list[dict] = []   # remote span subtrees (wire)
+        # remote operator profiles: {"node", "device_time_s", "ops"}
+        # wire dicts from flow_profile frames, stitched at the gateway
+        self.profiles: list[dict] = []
         self.bytes_received = 0
 
     def push(self, chunk: Optional[bytes], eof: bool,

@@ -31,6 +31,7 @@ from cockroach_tpu.distsql.flow import (FlowCancelled, FlowRegistry,
                                         FlowSpec, Outbox)
 from cockroach_tpu.distsql.physical import RAW, UNION, split
 from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
+from cockroach_tpu.exec import profile as _prof
 from cockroach_tpu.ops.batch import ColumnBatch
 from cockroach_tpu.sql import parser
 from cockroach_tpu.sql.planner import Planner, PlanError
@@ -67,6 +68,10 @@ class _GraphFlowState:
         self.done: set[int] = set()
         self.running = False
         self.spans: list[dict] = []   # per-stage recordings (wire)
+        # one sink across this node's stages of a profiling flow: its
+        # wall_s accumulates per-stage execution time and ships home
+        # once, on the gather stream
+        self.psink = _prof.ProfileSink() if spec.profile else None
 
 
 def _arrays_to_batch(chunks, columns, string_cols, shared_dict):
@@ -186,6 +191,13 @@ class DistSQLNode:
             if flow_id not in self.cancelled_flows:
                 self.registry.inbox(flow_id, stream_id).spans.append(
                     wire)
+        elif kind == "flow_profile":
+            # a producer's node-tagged operator profile (statement
+            # diagnostics), shipped ahead of EOF like flow_span
+            _, flow_id, stream_id, wire = payload
+            if flow_id not in self.cancelled_flows:
+                self.registry.inbox(flow_id, stream_id).profiles \
+                    .append(wire)
         elif kind == "flow_ack":
             _, flow_id, stream_id, n = payload
             key = (flow_id, stream_id)
@@ -224,10 +236,12 @@ class DistSQLNode:
         try:
             self.flows_run += 1
 
+            sink = _prof.ProfileSink() if spec.profile else None
+
             def body():
                 if spec.spans is not None:
                     self._materialize_spans(spec.spans)
-                batch, stage = self._run_local(spec)
+                batch, stage = self._run_local(spec, sink=sink)
                 n, cols, valid = self._host_output(batch, stage.local,
                                                    stage.string_cols)
                 outbox.send_arrays(n, cols, valid, spec.chunk_rows)
@@ -240,6 +254,15 @@ class DistSQLNode:
                 self._send_flow_span(spec, tracing.span_to_wire(rec))
             else:
                 body()
+            if sink is not None:
+                # node-tagged operator table, ahead of EOF (flow_span
+                # discipline); device_time_s is the stage's measured
+                # execution wall — planning/setup excluded, so the
+                # gateway's stitched Σ(op device_seconds) matches it
+                self._send_flow_profile(spec, {
+                    "node": self.node_id,
+                    "device_time_s": sink.wall_s,
+                    "ops": sink.to_wire(node=self.node_id)})
             outbox.close()
         except FlowCancelled:
             # the gateway told us to stop: abort quietly, nothing to
@@ -256,6 +279,11 @@ class DistSQLNode:
     def _send_flow_span(self, spec: FlowSpec, wire: dict) -> None:
         self.transport.send(self.node_id, spec.gateway,
                             ("flow_span", spec.flow_id,
+                             spec.stream_id, wire))
+
+    def _send_flow_profile(self, spec: FlowSpec, wire: dict) -> None:
+        self.transport.send(self.node_id, spec.gateway,
+                            ("flow_profile", spec.flow_id,
                              spec.stream_id, wire))
 
     def _materialize_spans(self, spans: dict) -> None:
@@ -277,7 +305,7 @@ class DistSQLNode:
             rt.materialize_into(self.engine, spans=decoded or [],
                                 ts=Timestamp(1, 0))
 
-    def _run_local(self, spec: FlowSpec):
+    def _run_local(self, spec: FlowSpec, sink=None):
         eng = self.engine
         node, meta = Planner(
             # int_ranges off: key_int_range reflects only this node's
@@ -296,7 +324,10 @@ class DistSQLNode:
         if spec.adaptive and stage.stage == "partial_agg" \
                 and stage.raw_local is not None:
             stage = self._adaptive_agg_stage(stage)
-        runf = compile_plan(stage.local, ExecParams())
+        # profiling flows wrap every operator closure in a timed span
+        # (exec/profile.py fine plane) — stages run eagerly here, so
+        # this times the REAL distributed execution, not a rerun
+        runf = compile_plan(stage.local, ExecParams(profile=sink))
         # narrow=False: per-node narrowing decisions would reflect
         # only the LOCAL shard's value range (non-deterministic across
         # the fabric) and the worker's plan compiles without the
@@ -329,7 +360,12 @@ class DistSQLNode:
                             else eng._device_table(tbl, narrow=False))
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
-        return runf(RunContext(scans, read_ts)), stage
+        if sink is None:
+            return runf(RunContext(scans, read_ts)), stage
+        t0 = _time.monotonic()
+        out = runf(RunContext(scans, read_ts))
+        sink.wall_s += _time.monotonic() - t0
+        return out, stage
 
     def _adaptive_agg_stage(self, stage):
         """Partial Partial Aggregates: decide, per shard at flow setup
@@ -671,10 +707,16 @@ class DistSQLNode:
             scans[shfl.exch_table(e)] = self._edge_batch(
                 st, st.graph.edges[e], shared)
         self._patch_probe_join(stage.plan, scans)
-        runf = compile_plan(stage.plan, ExecParams())
+        runf = compile_plan(stage.plan,
+                            ExecParams(profile=st.psink))
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
-        return runf(RunContext(scans, read_ts))
+        if st.psink is None:
+            return runf(RunContext(scans, read_ts))
+        t0 = _time.monotonic()
+        out = runf(RunContext(scans, read_ts))
+        st.psink.wall_s += _time.monotonic() - t0
+        return out
 
     def _run_stage(self, st: _GraphFlowState, stage) -> None:
         from cockroach_tpu.storage.columnstore import Dictionary
@@ -702,6 +744,11 @@ class DistSQLNode:
                     # the gather stream, ahead of its EOF
                     for w in st.spans:
                         self._send_flow_span(spec, w)
+                if st.psink is not None:
+                    self._send_flow_profile(spec, {
+                        "node": self.node_id,
+                        "device_time_s": st.psink.wall_s,
+                        "ops": st.psink.to_wire(node=self.node_id)})
                 out.close()
             finally:
                 self._producing.discard(key)
@@ -1008,8 +1055,11 @@ class Gateway:
                 return res
         stripped = sql.lstrip()
         if stripped[:15].upper() == "EXPLAIN ANALYZE":
-            return self.explain_analyze(stripped[15:].lstrip(),
-                                        chunk_rows)
+            rest = stripped[15:].lstrip()
+            debug = rest[:7].upper() == "(DEBUG)"
+            if debug:
+                rest = rest[7:].lstrip()
+            return self.explain_analyze(rest, chunk_rows, debug=debug)
         first = live()
         try:
             return self._run_once(sql, chunk_rows, first)
@@ -1061,14 +1111,18 @@ class Gateway:
                         "node set")
             return self._run_once(sql, chunk_rows, healthy)
 
-    def explain_analyze(self, sql: str, chunk_rows: int = 65536):
+    def explain_analyze(self, sql: str, chunk_rows: int = 65536,
+                        debug: bool = False):
         """EXPLAIN ANALYZE over the fabric: run the statement under a
         recording; remote nodes ship their stage recordings back on
         the flow streams and the result renders the stitched,
         node-tagged span tree (the reference's distributed statement
-        diagnostics)."""
+        diagnostics). With ``debug``, capture a full diagnostics
+        bundle instead (node-tagged operator profiles + trace)."""
         from cockroach_tpu.exec.engine import Result
         import time as __time
+        if debug:
+            return self._explain_analyze_debug(sql, chunk_rows)
         with tracing.capture("explain-analyze",
                              gateway=self.own.node_id) as rec:
             t0 = __time.monotonic()
@@ -1080,6 +1134,68 @@ class Gateway:
         lines.extend("  " + ln for ln in rec.tree_lines())
         return Result(names=["info"], rows=[(ln,) for ln in lines],
                       tag="EXPLAIN ANALYZE")
+
+    def _explain_analyze_debug(self, sql: str, chunk_rows: int):
+        """EXPLAIN ANALYZE (DEBUG) over the fabric: run with the fine
+        profile request bit set so every remote flow executes under a
+        per-flow ProfileSink and ships its node-tagged operator table
+        and execution wall home (flow_profile frames); the gateway
+        stitches those with its own final-stage ops into a statement
+        diagnostics bundle, stores it in the engine's stmtdiag
+        registry, and returns it as one JSON row."""
+        import json as _json
+        from cockroach_tpu.exec.engine import Result
+        from cockroach_tpu.utils.sqlstats import fingerprint as _fp
+        eng = self.own.engine
+        psink = _prof.ProfileSink()
+        try:
+            m0 = {k: v for k, v in eng.metrics.snapshot().items()
+                  if isinstance(v, (int, float))}
+        except Exception:
+            m0 = {}
+        with _prof.active(psink, fine=True):
+            with tracing.capture("explain-analyze-debug",
+                                 gateway=self.own.node_id,
+                                 record_request=True) as rec:
+                t0 = _time.monotonic()
+                res = self.run(sql, chunk_rows)
+                dt = _time.monotonic() - t0
+        # statement device time = Σ remote flow execution walls + the
+        # gateway's own final-stage wall — each measured tightly
+        # around the op-wrapped region, so the node-tagged operator
+        # device_seconds sum to it by construction
+        device_s = (sum(w for _n, w in psink.remote_walls)
+                    + psink.wall_s)
+        bundle = {"sql": sql, "fingerprint": _fp(sql),
+                  "gateway": self.own.node_id,
+                  "nodes": list(self.nodes),
+                  "latency_s": dt,
+                  "device_time_s": device_s,
+                  "rows_returned": len(res.rows),
+                  "profile": {
+                      "device_time_s": device_s,
+                      "ops": psink.to_wire(node=self.own.node_id)}}
+        try:
+            bundle["trace"] = tracing.span_to_wire(rec)
+        except Exception:
+            pass
+        try:
+            bundle["settings"] = {k: str(v) for k, v in
+                                  eng.settings.snapshot().items()}
+        except Exception:
+            pass
+        try:
+            m1 = {k: v for k, v in eng.metrics.snapshot().items()
+                  if isinstance(v, (int, float))}
+            bundle["metric_deltas"] = {
+                k: v - m0.get(k, 0) for k, v in m1.items()
+                if v != m0.get(k, 0)}
+        except Exception:
+            bundle["metric_deltas"] = {}
+        bundle["id"] = eng.stmtdiag.fulfill(None, bundle)
+        return Result(names=["bundle"],
+                      rows=[(_json.dumps(bundle, default=str),)],
+                      tag="EXPLAIN ANALYZE (DEBUG)")
 
     def _replannable(self, sql: str) -> bool:
         """Gate the distributed-replan rung: lost partial-aggregate
@@ -1171,6 +1287,10 @@ class Gateway:
         # for remote recordings (SET tracing = cluster / EXPLAIN
         # ANALYZE); a gateway-local recording keeps them dark
         trace = tracing.recording_requested()
+        # same request-bit discipline for operator profiles: remote
+        # flows run under a fine sink only when the statement asked
+        # (EXPLAIN ANALYZE (DEBUG) / armed diagnostics)
+        profiled = _prof.requested()
         registry = self.own.registry
         adaptive = (self.adaptive_agg and stage.stage == "partial_agg"
                     and stage.raw_local is not None)
@@ -1183,7 +1303,7 @@ class Gateway:
                                    if spans_by_node is not None
                                    else None),
                             trace=trace, joinfilter=jf_frames,
-                            adaptive=adaptive)
+                            adaptive=adaptive, profile=profiled)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
@@ -1197,8 +1317,15 @@ class Gateway:
         for out_name, union_col in stage.dict_outputs.items():
             if union_col in merged_dicts:
                 meta.dictionaries[out_name] = merged_dicts[union_col]
-        runf = compile_plan(stage.final, ExecParams(), meta)
-        out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+        gsink = _prof.current() if profiled else None
+        runf = compile_plan(stage.final, ExecParams(profile=gsink),
+                            meta)
+        if gsink is None:
+            out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+        else:
+            t0 = _time.monotonic()
+            out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+            gsink.wall_s += _time.monotonic() - t0
         return eng._materialize(out, meta)
 
     def _run_graph(self, sql: str, kind: str, chunk_rows: int,
@@ -1231,6 +1358,7 @@ class Gateway:
         self._count("distsql.flows.launched",
                     "distributed flows fanned out by this gateway")
         trace = tracing.recording_requested()
+        profiled = _prof.requested()
         registry = self.own.registry
         inboxes = []
         for nid in nodes:
@@ -1242,7 +1370,7 @@ class Gateway:
                                    if spans_by_node is not None
                                    else None),
                             graph=kind, data_nodes=list(nodes),
-                            trace=trace)
+                            trace=trace, profile=profiled)
             inboxes.append(registry.inbox(flow_id, sid))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
@@ -1252,8 +1380,15 @@ class Gateway:
         for out_name, union_col in graph.dict_outputs.items():
             if union_col in merged_dicts:
                 meta.dictionaries[out_name] = merged_dicts[union_col]
-        runf = compile_plan(graph.final, ExecParams(), meta)
-        out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+        gsink = _prof.current() if profiled else None
+        runf = compile_plan(graph.final, ExecParams(profile=gsink),
+                            meta)
+        if gsink is None:
+            out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+        else:
+            t0 = _time.monotonic()
+            out = runf(RunContext({UNION: union}, jnp.int64(read_ts)))
+            gsink.wall_s += _time.monotonic() - t0
         return eng._materialize(out, meta)
 
     def _partition_tables(self, tables: dict,
@@ -1336,6 +1471,22 @@ class Gateway:
             for ib in inboxes:
                 for w in ib.spans:
                     tracing.attach_remote(w)
+            # same stitch for operator profiles: node-tagged remote op
+            # tables and per-node execution walls merge into the
+            # statement's sink; coarse shuffle accounting rides along
+            psink = _prof.current()
+            if psink is not None:
+                total_rx = sum(ib.bytes_received for ib in inboxes)
+                if total_rx:
+                    psink.note("shuffle:gather", batches=len(inboxes),
+                               bytes_shuffled=total_rx)
+                for ib in inboxes:
+                    for w in ib.profiles:
+                        psink.merge_wire(w.get("ops", []),
+                                         node=w.get("node"))
+                        psink.remote_walls.append(
+                            (w.get("node"),
+                             float(w.get("device_time_s", 0.0))))
             chunks = [c for ib in inboxes for c in ib.drain_arrays()]
             if stage is not None:
                 chunks = self._fold_raw_chunks(chunks, stage, read_ts)

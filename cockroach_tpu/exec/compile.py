@@ -98,6 +98,14 @@ class ExecParams:
     # (the hook reads concrete row counts host-side); the engine never
     # sets it on the jitted execution path.
     row_hook: object = None
+    # Fine-grained operator profiling (exec/profile.py ProfileSink):
+    # every operator closure wraps in a timed span that blocks on the
+    # batch and attributes self device_seconds + output rows. Same
+    # contract as row_hook — UNJITTED eager runs only (EXPLAIN
+    # ANALYZE (DEBUG), armed diagnostics, DistSQL remote stages); the
+    # jitted hot path never carries a sink, so profiled and
+    # unprofiled statements run the identical compiled program.
+    profile: object = None
 
 
 class RunContext:
@@ -111,7 +119,8 @@ class RunContext:
     disk). nparts=1/pid=0 (or None) means unpartitioned."""
 
     def __init__(self, scans: dict[str, ColumnBatch], read_ts,
-                 nparts=None, pid=None, params: tuple = ()):
+                 nparts=None, pid=None, params: tuple = (),
+                 profile=None):
         self.scans = scans
         self.read_ts = read_ts
         self.nparts = nparts
@@ -119,6 +128,10 @@ class RunContext:
         # runtime statement parameters (exec/planparam.py): literal
         # scalars the statement-shape plan cache lifted out of filters
         self.params = params
+        # per-execution ProfileSink override: lets one profiled compile
+        # serve concurrent dispatches with per-dispatch sinks (falls
+        # back to the compile-time ExecParams.profile when unset)
+        self.profile = profile
 
 
 CompiledNode = Callable[[RunContext], ColumnBatch]
@@ -130,16 +143,40 @@ def _ctx_of(batch: ColumnBatch, aggs=None, params: tuple = ()) -> ExprContext:
     return ExprContext(cols, batch.n, aggs, params)
 
 
+def _batch_nbytes(b: ColumnBatch) -> int:
+    try:
+        n = int(getattr(b.sel, "nbytes", 0))
+        for d in b.data:
+            n += int(getattr(d, "nbytes", 0))
+        return n
+    except Exception:       # noqa: BLE001 — diagnostics never raise
+        return 0
+
+
 def compile_plan(node: P.PlanNode, params: ExecParams,
                  meta: P.OutputMeta | None = None) -> CompiledNode:
     fn = _compile_plan(node, params, meta)
     hook = params.row_hook
-    if hook is None:
+    if hook is None and params.profile is None:
         return fn
 
     def run_hooked(rc):
-        b = fn(rc)
-        hook(node, b)
+        sink = getattr(rc, "profile", None) or params.profile
+        if sink is None:
+            b = fn(rc)
+        else:
+            with sink.op(node) as rec:
+                b = fn(rc)
+                try:
+                    jax.block_until_ready(b.sel)
+                    rec.rows = int(np.asarray(b.sel).sum())
+                    if isinstance(node, P.Scan):
+                        # a scan's output IS the uploaded table slice
+                        rec.bytes_uploaded = _batch_nbytes(b)
+                except Exception:   # noqa: BLE001 — tracers/aborted
+                    pass            # runs must not fail the profile
+        if hook is not None:
+            hook(node, b)
         return b
     return run_hooked
 

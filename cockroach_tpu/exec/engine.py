@@ -285,6 +285,22 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # lock SELECTs race the resident-table map otherwise)
         self._device_lock = threading.RLock()
         self.metrics = MetricRegistry()
+        # statement diagnostics (utils/stmtdiag.py): armed fingerprints
+        # capture a JSON bundle on their next execution; bundles serve
+        # at /_status/stmtdiag/<id> and inline via EXPLAIN ANALYZE
+        # (DEBUG)
+        from ..utils.stmtdiag import StmtDiagRegistry
+        self.stmtdiag = StmtDiagRegistry(metrics=self.metrics)
+        # most recent statement's coarse operator profile
+        # (exec/profile.py ProfileSink) — read by bench.py for the
+        # per-query top-operator summary; overwritten per statement
+        self.last_profile = None
+        self.metrics.counter(
+            "exec.profile.statements",
+            "statements executed with an active profile sink")
+        self.metrics.counter(
+            "exec.profile.operators",
+            "operator entries recorded into profile sinks")
         # cold-start elimination (exec/coldstart.py): persistent XLA
         # compile cache so a restarted process deserializes instead of
         # recompiling; None when disabled or the backend/dir refuses
@@ -428,6 +444,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         Dispatcher objects stay registered — a later dispatch through a
         cached closure respawns its thread (parallel/distagg.py)."""
         from ..parallel.distagg import shutdown_dispatchers
+        # profiling lifecycle: drop armed diagnostics requests,
+        # retained bundles, and the last statement's sink — a closed
+        # engine must leak no profiling state (sinks hold no threads;
+        # per-statement sinks die with their statement's thread-local)
+        self.stmtdiag.clear()
+        self.last_profile = None
         self.drop_device_cache()
         if self.mesh is not None:
             shutdown_dispatchers(self.mesh)
@@ -634,7 +656,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # client set one (the multi-tenant front door's natural key),
         # else the session object — each anonymous connection is its
         # own tenant rather than one shared bucket
-        tenant = session.vars.get("application_name") or f"s{id(session)}"
+        app_name = str(session.vars.get("application_name") or "")
+        tenant = app_name or f"s{id(session)}"
         self.admission.acquire(priority=prio, tenant=tenant)
         # SET tracing = on|cluster (pgwire trace control): "on"
         # records gateway-local; "cluster" additionally sets the
@@ -649,12 +672,37 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         except Exception:
             slow_thresh = 0.0
         from ..utils import tracing as _trc
+        from ..utils.sqlstats import fingerprint as _fp
+        from . import profile as _prof
+        # statement diagnostics (utils/stmtdiag.py): an armed
+        # fingerprint captures a bundle on THIS execution, which needs
+        # a trace recording and a before-snapshot of the metric plane
+        fp = _fp(sql_text) if sql_text else type(stmt).__name__
+        diag_req = (self.stmtdiag.should_capture(fp)
+                    if sql_text else None)
+        diag_m0 = None
+        if diag_req is not None:
+            try:
+                diag_m0 = self.metrics.snapshot()
+            except Exception:
+                diag_m0 = {}
+        # per-statement coarse operator profile: the data-movement
+        # call sites (uploads, stream page loops, spill sweeps,
+        # shuffle) attribute bytes/stalls to this sink via the
+        # thread-local exec/profile.py plane. Host-side accounting
+        # only — the jitted program is identical with or without it.
+        psink = None
+        try:
+            if bool(self.settings.get("sql.stmt_profile.enabled")):
+                psink = _prof.ProfileSink()
+        except Exception:
+            psink = _prof.ProfileSink()
         # slow-statement sampling records even untraced statements —
         # but never nested ones (an active span means some outer
         # statement already owns the recording on this thread)
-        capture = tracing or (slow_thresh > 0
-                              and _trc.current_span() is None
-                              and not isinstance(stmt, ast.ShowTrace))
+        capture = tracing or diag_req is not None or (
+            slow_thresh > 0 and _trc.current_span() is None
+            and not isinstance(stmt, ast.ShowTrace))
         shared = self._stmt_read_only(stmt, session, sql_text)
         # per-statement compile-vs-execute split: XLA backend
         # compilation runs synchronously on this thread, so the
@@ -666,7 +714,9 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
 
         def _run():
             nonlocal compile_s
-            r = self._dispatch_locked(stmt, session, sql_text, shared)
+            with _prof.active(psink):
+                r = self._dispatch_locked(stmt, session, sql_text,
+                                          shared)
             compile_s = coldstart.thread_compile_seconds() - c0
             if compile_s > 0:
                 # tagged while the statement span is still open, so
@@ -705,16 +755,51 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                                      compile_s=compile_s)
             # device-execute seconds: the statement's wall time net of
             # its XLA compile bill (utils/devstats.py)
-            self.devstats.note_execute(max(0.0, dt - compile_s))
+            device_s = max(0.0, dt - compile_s)
+            self.devstats.note_execute(device_s)
+            # per-tenant resource rollup (/_status/tenants): the
+            # application_name-keyed device-seconds / bytes-moved /
+            # HBM-held attribution feeding the admission/WFQ story
+            if psink is not None:
+                self.sqlstats.record_tenant(
+                    app_name or "(unset)", device_s=device_s,
+                    bytes_moved=psink.total_bytes_moved(),
+                    rows=max(len(res.rows), res.row_count),
+                    hbm_bytes=self.devstats.hbm_bytes(),
+                    stall_s=psink.total_stall_seconds())
+                self.metrics.counter(
+                    "exec.profile.statements",
+                    "statements executed with an active profile "
+                    "sink").inc()
+                n_ops = len(psink.entries())
+                if n_ops:
+                    self.metrics.counter(
+                        "exec.profile.operators",
+                        "operator entries recorded into profile "
+                        "sinks").inc(n_ops)
+                self.last_profile = psink
             if rec is not None and slow_thresh > 0 \
                     and dt >= slow_thresh:
-                from ..utils.sqlstats import fingerprint
+                # tenant-attributable slow traces: application_name +
+                # session id ride every ring entry (/debug/tracez)
                 self.slow_traces.append({
                     "sql": sql_text or type(stmt).__name__,
-                    "fingerprint": fingerprint(sql_text) if sql_text
-                    else type(stmt).__name__,
+                    "fingerprint": fp,
+                    "application_name": app_name,
+                    "session": f"s{id(session):x}",
                     "duration_s": dt,
                     "span": _trc.span_to_wire(rec)})
+            if diag_req is not None:
+                # armed capture: assemble and store the bundle; any
+                # failure re-arms the fingerprint (diagnostics must
+                # never fail the statement)
+                try:
+                    bundle = self._diag_bundle(
+                        stmt, session, sql_text, rec, psink, dt,
+                        compile_s, diag_m0)
+                    self.stmtdiag.fulfill(diag_req, bundle)
+                except Exception:
+                    self.stmtdiag.rearm(fp, diag_req)
             return res
         except Exception:
             # any error inside an explicit txn block aborts it until
@@ -722,10 +807,20 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             # machine's stateAborted) — not just DML failures
             self.metrics.counter("sql.failure.count",
                                  "statements that errored").inc()
+            if diag_req is not None:
+                # the armed execution failed before capture: keep the
+                # request pending for the next matching execution
+                self.stmtdiag.rearm(fp, diag_req)
             if sql_text:
                 self.sqlstats.record(
                     sql_text, _time.monotonic() - t0, 0, failed=True,
                     compile_s=coldstart.thread_compile_seconds() - c0)
+            if psink is not None:
+                self.sqlstats.record_tenant(
+                    app_name or "(unset)",
+                    device_s=max(0.0, _time.monotonic() - t0),
+                    bytes_moved=psink.total_bytes_moved(),
+                    failed=True)
             if session.txn is not None and not isinstance(
                     stmt, ast.BeginTxn):
                 session.txn_aborted = True
@@ -882,6 +977,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if isinstance(stmt, ast.SetVar):
             if stmt.cluster:
                 self.settings.set(stmt.name, stmt.value)
+            elif stmt.name == "statement_diagnostics":
+                # SQL arming surface for the diagnostics registry:
+                # SET statement_diagnostics = '<stmt text>' arms that
+                # statement's fingerprint so its NEXT execution
+                # captures a bundle (the HTTP twin is POST
+                # /_status/stmtdiag; fetch at /_status/stmtdiag/<id>)
+                req = self.stmtdiag.arm(str(stmt.value))
+                return Result(
+                    names=["request_id", "fingerprint"],
+                    rows=[(req["request_id"], req["fingerprint"])],
+                    tag="SET")
             else:
                 session.vars.set(stmt.name, stmt.value)
             return Result(tag="SET")
@@ -942,7 +1048,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             from ..sql.stats import estimate
             if stmt.analyze:
                 return self._explain_analyze(stmt.stmt, session,
-                                             sql_text)
+                                             sql_text,
+                                             debug=stmt.debug)
             target = stmt.stmt
             from ..sql.rules import RuleTrace
             rtrace = RuleTrace()
@@ -1127,14 +1234,41 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         return lines
 
     def _explain_analyze(self, sel, session: Session,
-                         sql_text: str) -> Result:
+                         sql_text: str, debug: bool = False) -> Result:
         """EXPLAIN ANALYZE: run the statement under a trace recording
         and render the plan with measured phase timings + row counts
         (the reference's instrumented statement diagnostics,
-        sql/instrumentation.go)."""
+        sql/instrumentation.go). ``debug`` (EXPLAIN ANALYZE (DEBUG))
+        instead captures a full statement diagnostics bundle, stores
+        it in the registry (fetchable at /_status/stmtdiag/<id>), and
+        returns the JSON inline."""
         if not isinstance(sel, ast.Select):
             raise EngineError("can only EXPLAIN ANALYZE SELECT")
         import time as _time
+        from . import profile as _prof
+        if debug:
+            import json as _json
+            try:
+                m0 = self.metrics.snapshot()
+            except Exception:
+                m0 = {}
+            dc0 = coldstart.thread_compile_seconds()
+            psink = _prof.ProfileSink()
+            with _prof.active(psink, fine=True):
+                with self.tracer.capture(
+                        "explain-analyze-debug",
+                        record_request=True) as rec:
+                    t0 = _time.monotonic()
+                    self._exec_select(sel, session, sql_text)
+                    dt = _time.monotonic() - t0
+            compile_s = coldstart.thread_compile_seconds() - dc0
+            bundle = self._diag_bundle(sel, session, sql_text, rec,
+                                       psink, dt, compile_s, m0)
+            bundle["id"] = self.stmtdiag.fulfill(None, bundle)
+            return Result(
+                names=["bundle"],
+                rows=[(_json.dumps(bundle, default=str),)],
+                tag="EXPLAIN ANALYZE (DEBUG)")
         c0 = coldstart.thread_compile_seconds()
         with self.tracer.capture("explain-analyze") as rec:
             t0 = _time.monotonic()
@@ -1147,9 +1281,10 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         costs = estimate(node, cv.stats)
         sources = self._scan_estimate_sources(node, cv)
         try:
-            actuals = self._measure_actual_rows(node)
+            actuals, prof, _pw = self._measure_operator_profile(node)
         except Exception:
-            actuals = None  # diagnostics must never fail the statement
+            actuals = prof = None   # diagnostics must never fail the
+            #                         statement
         lines = ["planning/execution:"]
         for name in ("plan", "compile", "upload", "dispatch",
                      "materialize"):
@@ -1167,7 +1302,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         lines.append("plan:")
         lines.extend("  " + ln for ln in P.plan_tree_repr(
             node, costs=costs, actuals=actuals,
-            sources=sources).rstrip().split("\n"))
+            sources=sources, profile=prof).rstrip().split("\n"))
 
         # stitched remote recordings (trace propagation): subtrees
         # tagged with the serving node id render per-node, the
@@ -1208,13 +1343,26 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         return out
 
     def _measure_actual_rows(self, node) -> dict:
-        """Instrumented re-execution for EXPLAIN ANALYZE: compile the
-        plan with a row hook and run it eagerly (unjitted) over wide
-        resident uploads, recording every operator's post-sel row
-        count — the measured side of the est-vs-actual columns.
+        """Back-compat shim: actual row counts only (the est-vs-actual
+        columns). Prefer _measure_operator_profile."""
+        return self._measure_operator_profile(node)[0]
+
+    def _measure_operator_profile(self, node):
+        """Instrumented re-execution for EXPLAIN ANALYZE / diagnostics
+        bundles: compile the plan with a row hook AND a ProfileSink
+        and run it eagerly (unjitted) over wide resident uploads. Each
+        operator closure records post-sel rows, self device-seconds
+        (block_until_ready at operator exit; self = inclusive minus
+        children), and scan upload bytes. Returns
+        ``(actuals, sink, wall_s)`` where actuals is the
+        id(node) -> rows dict of the est-vs-actual columns and wall_s
+        is the profiled execution's independently-measured wall — the
+        denominator the per-operator device_seconds must sum close to.
         Diagnostics only: gateway-local and resident regardless of
         the statement's real placement verdict, and any failure falls
         back to estimate-only rendering at the call site."""
+        import time as _time
+        from . import profile as _prof
         actual: dict = {}
 
         def hook(n, batch):
@@ -1222,11 +1370,132 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 actual[id(n)] = int(np.asarray(batch.sel).sum())
             except Exception:
                 pass
+        sink = _prof.ProfileSink()
         scans = {alias: self._device_table(tname, narrow=False)
                  for alias, tname in _collect_scans(node).items()}
-        runf = compile_plan(node, ExecParams(row_hook=hook))
-        runf(RunContext(scans, jnp.int64(self.clock.now().to_int())))
-        return actual
+        runf = compile_plan(node,
+                            ExecParams(row_hook=hook, profile=sink))
+        t0 = _time.monotonic()
+        with _prof.active(sink, fine=True):
+            runf(RunContext(scans,
+                            jnp.int64(self.clock.now().to_int())))
+        return actual, sink, _time.monotonic() - t0
+
+    def _diag_bundle(self, stmt, session: Session, sql_text: str,
+                     rec, psink, dt: float, compile_s: float,
+                     m0) -> dict:
+        """Assemble one statement diagnostics bundle (the reference's
+        stmtdiagnostics zip, here a JSON dict): bound plan with
+        per-operator profile annotations, the operator profile itself,
+        the trace recording, cluster settings + session vars, sketch
+        stats for every referenced table, and the statement's metric
+        deltas. Every section is best-effort — diagnostics must never
+        fail the statement that carried them."""
+        from ..utils import tracing as _trc
+        from ..utils.sqlstats import fingerprint
+        from . import profile as _prof
+        bundle: dict = {
+            "sql": sql_text,
+            "fingerprint": (fingerprint(sql_text) if sql_text
+                            else type(stmt).__name__),
+            "statement": type(stmt).__name__,
+            "latency_s": dt,
+            "compile_s": compile_s,
+            "device_time_s": max(0.0, dt - compile_s),
+        }
+        target = stmt.stmt if isinstance(stmt, ast.Explain) else stmt
+        merged = _prof.ProfileSink()
+        if psink is not None:
+            merged.merge(psink)
+        prof_wall = None
+        node = None
+        try:
+            if isinstance(target, ast.Select) and not target.ctes \
+                    and not self._has_derived(target):
+                node, _ = self._plan(target, session)
+                from ..sql.stats import estimate
+                cv = self.catalog_view()
+                costs = estimate(node, cv.stats)
+                actuals, fine, prof_wall = \
+                    self._measure_operator_profile(node)
+                merged.merge(fine)
+                bundle["plan"] = P.plan_tree_repr(
+                    node, costs=costs, actuals=actuals,
+                    sources=self._scan_estimate_sources(node, cv),
+                    profile=fine).rstrip().split("\n")
+        except Exception:
+            pass
+        bundle.setdefault("plan", [])
+        bundle["profile"] = {
+            # the profiled execution's wall: remote-stitched entries
+            # carry their own walls in "remote_device_time_s" slots
+            # merged by the caller (distsql Gateway); locally it is
+            # the instrumented rerun's measured wall
+            "device_time_s": (prof_wall if prof_wall is not None
+                              else max(0.0, dt - compile_s)),
+            "ops": merged.to_wire(),
+        }
+        try:
+            bundle["trace"] = (_trc.span_to_wire(rec)
+                               if rec is not None else None)
+        except Exception:
+            bundle["trace"] = None
+        try:
+            bundle["settings"] = {k: str(v) for k, v in
+                                  self.settings.snapshot().items()}
+        except Exception:
+            bundle["settings"] = {}
+        try:
+            bundle["session_vars"] = {
+                k: str(v) for k, v in session.vars.values.items()}
+        except Exception:
+            bundle["session_vars"] = {}
+        try:
+            stats: dict = {}
+            if node is not None:
+                cv = self.catalog_view()
+                for tname in sorted(
+                        set(_collect_scans(node).values())):
+                    st = cv.stats.get(tname)
+                    if st is None:
+                        continue
+                    d = {}
+                    for a in ("rows", "row_count", "source",
+                              "analyzed_rows", "distinct"):
+                        v = getattr(st, a, None)
+                        if isinstance(v, (int, float, str)):
+                            d[a] = v
+                    stats[tname] = d
+            bundle["sketch_stats"] = stats
+        except Exception:
+            bundle["sketch_stats"] = {}
+        try:
+            m1 = self.metrics.snapshot()
+            m0 = m0 or {}
+            bundle["metric_deltas"] = {
+                k: v - m0.get(k, 0) for k, v in m1.items()
+                if isinstance(v, (int, float))
+                and isinstance(m0.get(k, 0), (int, float))
+                and v != m0.get(k, 0)}
+        except Exception:
+            bundle["metric_deltas"] = {}
+        return bundle
+
+    def operator_profile(self, sql: str,
+                         session: Session | None = None) -> dict:
+        """Profile one SELECT's operators via the instrumented eager
+        rerun and return the digest (bench.py records this per
+        headline query: top operators by device_seconds + total bytes
+        moved). Never touches the statement's real execution path."""
+        sess = session or self.session()
+        stmt = parser.parse(sql)
+        if isinstance(stmt, ast.Explain):
+            stmt = stmt.stmt
+        node, _ = self._plan(stmt, sess)
+        _actuals, sink, wall = self._measure_operator_profile(node)
+        out = sink.summary()
+        out["wall_s"] = round(wall, 6)
+        return out
 
     # -- catalog -------------------------------------------------------------
     def catalog_view(self, int_ranges: bool = True,

@@ -32,6 +32,8 @@ from .session import (SENTINEL_COLUMNS, CompactOverflow, EngineError,
 from .stmtutil import (_collect_scans, _count_aggs, _decode_column, _has_join, _host_sort, _pad)
 from .stream import PageSource
 from .stream import prefetch as stream_prefetch
+from . import profile as _prof
+import time as _time
 
 
 # exception factory per sentinel; names come from the one registry
@@ -142,6 +144,7 @@ class ScanPlaneMixin:
                 f"GROUP BY did not fit hash_group_capacity even at "
                 f"{self.MAX_SPILL_PARTITIONS} spill partitions")
 
+        _prof.note("spill:agg", batches=nparts, rows=len(all_rows))
         rows = all_rows
         if sort_node is not None:
             rows = _host_sort(rows, meta, sort_node.keys)
@@ -622,13 +625,45 @@ class ScanPlaneMixin:
         src = self._page_source(tname, cols, page_rows, zone_preds,
                                 read_ts=read_ts)
         if not pipeline:
-            return src.pages()
-        return stream_prefetch(
-            src.pages(),
-            stall_hist=self.metrics.histogram(
-                "exec.stream.prefetch_stall_seconds",
-                "consumer wait per streamed page (0 when the "
-                "prefetch pipeline is ahead of the device)"))
+            it = src.pages()
+        else:
+            it = stream_prefetch(
+                src.pages(),
+                stall_hist=self.metrics.histogram(
+                    "exec.stream.prefetch_stall_seconds",
+                    "consumer wait per streamed page (0 when the "
+                    "prefetch pipeline is ahead of the device)"))
+        return self._metered_pages(it, tname, src.page_bytes,
+                                   stalls=pipeline)
+
+    @staticmethod
+    def _metered_pages(it, tname: str, page_bytes: int,
+                       stalls: bool = False):
+        """Statement-profile metering wrapper around a page iterator:
+        runs on the CONSUMER thread (where the statement's thread-local
+        sink lives — the prefetch worker would miss it). With a
+        pipeline upstream the wait for ``next`` is consumer stall; the
+        synchronous path's wait is assembly+upload work, not stall."""
+        inner = iter(it)
+        label = f"stream:{tname}"
+        try:
+            while True:
+                t0 = _time.monotonic()
+                try:
+                    b = next(inner)
+                except StopIteration:
+                    return
+                sink = _prof.current()
+                if sink is not None:
+                    sink.note(label, batches=1, rows=int(b.n),
+                              bytes_uploaded=page_bytes,
+                              stall_seconds=((_time.monotonic() - t0)
+                                             if stalls else 0.0))
+                yield b
+        finally:
+            close = getattr(inner, "close", None)
+            if close is not None:
+                close()
 
     def _filtered_scan_batch(self, tname: str, filters, read_ts):
         """Remote-side application of gateway-shipped join-filter
@@ -756,6 +791,8 @@ class ScanPlaneMixin:
         self.metrics.counter(
             "sql.device.upload.bytes",
             "host->device bytes moved by table uploads").inc(nbytes)
+        _prof.note(f"upload:{name}", batches=1, rows=td.row_count,
+                   bytes_uploaded=nbytes)
         return b
 
     def narrow32_cols(self, name: str,

@@ -62,6 +62,7 @@ from .compile import (ExecError, RunContext, _normalized_lanes,
                       _sort_rank_tables, compile_plan)
 from .stmtutil import _decode_column
 from .stream import prefetch as stream_prefetch
+from . import profile as _prof
 
 # scanplane._stream_pages registers this histogram with the same help
 # text; both paths feed it so "is the pipeline ahead of the device?"
@@ -227,6 +228,11 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
                              bucket=bpad)
 
     busy = [0.0]
+    # statement-profile accounting: plain accumulators updated on the
+    # feed side (possibly the prefetch worker), noted once into the
+    # statement's sink on the consumer thread after the sweep
+    moved = [0]
+    units = [0]
 
     def feed():
         """(kind, batch) stream: each partition's build batch, then
@@ -241,6 +247,8 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
             busy[0] += time.monotonic() - t0
             m_parts.inc()
             m_bytes.inc(bbytes)
+            units[0] += 1
+            moved[0] += bbytes
             yield ("build", bb)
             it = psrc.gather_pages(pidx[p])
             while True:
@@ -251,6 +259,7 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
                     break
                 busy[0] += time.monotonic() - t0
                 m_bytes.inc(psrc.page_bytes)
+                moved[0] += psrc.page_bytes
                 yield ("page", page)
 
     pipeline = prep.session.vars.get("streaming_pipeline",
@@ -281,6 +290,8 @@ def run_spill_join(engine, prep, tsv) -> ColumnBatch:
         scans[sp.alias] = psrc.empty_page()
         state = fns.page(scans, tsv)
     m_overlap.inc(max(0.0, busy[0] - stall.total))
+    _prof.note(f"spill:join:{sp.table}", batches=units[0],
+               bytes_spilled=moved[0], stall_seconds=stall.total)
     return fns.final(state)
 
 
@@ -380,6 +391,8 @@ def run_spill_sort(engine, prep, tsv):
             out, lanes = prep.jfn(scans, tsv)
             m_parts.inc()
             m_bytes.inc(_batch_bytes(src, sp.page_rows))
+            _prof.note(f"spill:sort:{sp.table}", batches=1,
+                       bytes_spilled=_batch_bytes(src, sp.page_rows))
             pulled = pull_arrays(
                 [out.sel, lanes]
                 + [out.col(c) for c in names]
@@ -396,6 +409,7 @@ def run_spill_sort(engine, prep, tsv):
         if close is not None:
             close()
     m_overlap.inc(max(0.0, busy[0] - stall.total))
+    _prof.note(f"spill:sort:{sp.table}", stall_seconds=stall.total)
 
     res = Result(names=names, types=list(meta.types))
     if not runs:

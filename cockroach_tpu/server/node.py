@@ -95,13 +95,24 @@ def _statements_payload(engine) -> dict:
     } for s in engine.sqlstats.all()]}
 
 
+def _tenants_payload(engine) -> dict:
+    """The /_status/tenants body: application_name-keyed resource
+    rollups (device-seconds, bytes moved, HBM high-water) from the
+    always-on statement profile plane (exec/profile.py)."""
+    return {"tenants": [t.to_wire()
+                        for t in engine.sqlstats.tenants()]}
+
+
 def register_status_sources(cluster, engine) -> None:
-    """Expose this engine's tracez/statements payloads to peers over
-    the NetCluster "status" RPC (the server side of ?cluster=1)."""
+    """Expose this engine's tracez/statements/tenants payloads to
+    peers over the NetCluster "status" RPC (the server side of
+    ?cluster=1)."""
     cluster.status_handlers["tracez"] = \
         lambda: _tracez_payload(engine)
     cluster.status_handlers["statements"] = \
         lambda: _statements_payload(engine)
+    cluster.status_handlers["tenants"] = \
+        lambda: _tenants_payload(engine)
 
 
 def _fanout_status(cluster, what: str,
@@ -180,6 +191,35 @@ def _merge_statements(own_id: int, local: dict, remote: dict,
     stmts = sorted(merged.values(),
                    key=lambda m: -m["total_latency_s"])
     return {"statements": stmts, "cluster": True, "partial": partial,
+            "nodes": sorted([own_id, *remote])}
+
+
+def _merge_tenants(own_id: int, local: dict, remote: dict,
+                   partial: bool) -> dict:
+    """Per-tenant exact merge: counters and seconds sum across nodes;
+    hbm_bytes_held is a per-node high-water, so the cluster view takes
+    the max (the tenant held at most that much on any one node)."""
+    merged: dict[str, dict] = {}
+
+    def fold(payload):
+        for t in payload.get("tenants", []):
+            m = merged.get(t["app_name"])
+            if m is None:
+                merged[t["app_name"]] = dict(t)
+                continue
+            for k in ("statements", "failures", "rows",
+                      "device_seconds", "bytes_moved",
+                      "stall_seconds"):
+                m[k] += t[k]
+            m["hbm_bytes_held"] = max(m["hbm_bytes_held"],
+                                      t["hbm_bytes_held"])
+
+    fold(local)
+    for _, payload in sorted(remote.items()):
+        fold(payload)
+    tenants = sorted(merged.values(),
+                     key=lambda m: -m["device_seconds"])
+    return {"tenants": tenants, "cluster": True, "partial": partial,
             "nodes": sorted([own_id, *remote])}
 
 
@@ -330,6 +370,43 @@ class Node:
                             c.node_id, payload, remote, part)
                     body = json.dumps(payload).encode()
                     ctype = "application/json"
+                elif path == "/_status/tenants":
+                    # application_name-keyed resource rollups from the
+                    # statement profile plane; ?cluster=1 sums tenants
+                    # across every live peer (hbm high-water maxes)
+                    payload = _tenants_payload(node.engine)
+                    c = node._status_cluster
+                    if qs.get("cluster", ["0"])[0] == "1" \
+                            and c is not None:
+                        timeout = float(
+                            qs.get("timeout", ["2.0"])[0])
+                        remote, part = _fanout_status(
+                            c, "tenants", timeout)
+                        payload = _merge_tenants(
+                            c.node_id, payload, remote, part)
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif path == "/_status/stmtdiag":
+                    # pending diagnostics requests + completed bundle
+                    # summaries (POST here arms a fingerprint)
+                    body = json.dumps(
+                        node.engine.stmtdiag.summary()).encode()
+                    ctype = "application/json"
+                elif path.startswith("/_status/stmtdiag/"):
+                    # one completed bundle by id
+                    try:
+                        bid = int(path.rsplit("/", 1)[1])
+                    except ValueError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    b = node.engine.stmtdiag.get(bid)
+                    if b is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(b, default=str).encode()
+                    ctype = "application/json"
                 elif path == "/_debug/ranges":
                     # `cockroach debug` analogue: range descriptors +
                     # leaseholders when this node serves a cluster
@@ -356,6 +433,38 @@ class Node:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                from urllib.parse import urlparse
+                path = urlparse(self.path).path
+                if path != "/_status/stmtdiag":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                # arm a statement fingerprint: the next matching
+                # execution captures a diagnostics bundle. Body:
+                # {"sql": "..."} or {"fingerprint": "..."}
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if "fingerprint" in req:
+                        out = node.engine.stmtdiag.arm(
+                            str(req["fingerprint"]),
+                            is_fingerprint=True)
+                    else:
+                        out = node.engine.stmtdiag.arm(
+                            str(req["sql"]))
+                except (KeyError, ValueError) as ex:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(ex).encode())
+                    return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -507,14 +616,28 @@ class Node:
                         pass
                 try:
                     # metric samples into the KV-backed time-series DB
-                    # + its rollup/prune pass (pkg/ts maintenance)
+                    # + its rollup/prune pass (pkg/ts maintenance).
+                    # Fine-slab retention follows the cluster setting
+                    # (timeseries.storage.resolution_10s.ttl analogue)
                     self.tsdb.record()
-                    self.tsdb.maintain()
+                    self.run_ts_maintenance()
                 except Exception:
                     pass
 
         self._maint_thread = threading.Thread(target=loop, daemon=True)
         self._maint_thread.start()
+
+    def run_ts_maintenance(self) -> None:
+        """One tsdb rollup/prune pass with the fine-slab retention
+        taken from the ``timeseries.retention.seconds`` cluster
+        setting (factored out of the maintenance loop so tests can
+        tick it synchronously)."""
+        try:
+            fine_s = int(self.settings.get(
+                "timeseries.retention.seconds"))
+        except Exception:
+            fine_s = 6 * 3600
+        self.tsdb.maintain(retention_fine_s=fine_s)
 
     def stop(self):
         if getattr(self, "_maint_stop", None) is not None:

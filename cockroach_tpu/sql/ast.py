@@ -448,6 +448,10 @@ class Restore(Statement):
 class Explain(Statement):
     stmt: Statement
     analyze: bool = False
+    # EXPLAIN ANALYZE (DEBUG): capture a statement diagnostics bundle
+    # (plan + operator profile + trace + settings) inline, the
+    # reference's stmtdiagnostics bundle path
+    debug: bool = False
 
 
 @dataclass

@@ -183,7 +183,27 @@ class Parser:
         if t.is_kw("explain"):
             self.next()
             analyze = self.accept_kw("analyze")
-            return ast.Explain(self.parse_statement(), analyze=analyze)
+            debug = False
+            if analyze and self.accept_op("("):
+                # EXPLAIN ANALYZE (DEBUG): the reference's option list
+                # (sql.y explain_option_list); DEBUG — produce a
+                # statement diagnostics bundle — is the only option
+                # understood here
+                while True:
+                    o = self.next()
+                    if o.kind not in (Tok.IDENT, Tok.KEYWORD) \
+                            or o.text.lower() != "debug":
+                        raise ParseError(
+                            f"unsupported EXPLAIN ANALYZE option "
+                            f"{o.text!r} (only DEBUG)")
+                    debug = True
+                    if not self.accept_op(","):
+                        break
+                if not self.accept_op(")"):
+                    raise ParseError(
+                        "expected ) closing EXPLAIN ANALYZE options")
+            return ast.Explain(self.parse_statement(), analyze=analyze,
+                               debug=debug)
         if t.is_kw("analyze"):
             self.next()
             return ast.Analyze(self.expect_ident())

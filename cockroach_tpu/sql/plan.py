@@ -155,7 +155,8 @@ class OutputMeta:
 def plan_tree_repr(node: PlanNode, indent: int = 0,
                    costs: dict | None = None,
                    actuals: dict | None = None,
-                   sources: dict | None = None) -> str:
+                   sources: dict | None = None,
+                   profile=None) -> str:
     """Render the plan tree; with ``costs`` (sql/stats.estimate output,
     id(node) -> (est_rows, est_cost)) each line gets the optimizer's
     cardinality/cost annotations, like EXPLAIN's estimated-row counts
@@ -163,7 +164,11 @@ def plan_tree_repr(node: PlanNode, indent: int = 0,
     (id(node) -> measured post-sel rows from the instrumented rerun)
     and ``sources`` (id(scan) -> "analyze"|"sketch"|"default", where
     the scan's cardinalities came from) so est-vs-actual drift — and
-    which estimator produced the est — reads off each line."""
+    which estimator produced the est — reads off each line. With
+    ``profile`` (an exec/profile.ProfileSink from the same rerun) each
+    operator additionally shows its measured device-seconds and moved
+    bytes — the per-operator attribution the Theseus/Tailwind framing
+    asks for."""
     pad = "  " * indent
 
     def ann() -> str:
@@ -175,11 +180,17 @@ def plan_tree_repr(node: PlanNode, indent: int = 0,
             s += f"  (rows≈{rows:.0f} cost≈{cost:.0f}{src})"
         if actuals is not None and id(node) in actuals:
             s += f"  (actual rows={actuals[id(node)]})"
+        if profile is not None:
+            ent = profile.op_entry(node)
+            if ent is not None:
+                s += (f"  (device={ent.device_seconds * 1e3:.2f}ms"
+                      + (f" bytes={ent.bytes_moved}"
+                         if ent.bytes_moved else "") + ")")
         return s
 
     def child(n, extra_indent: int = 1) -> str:
         return plan_tree_repr(n, indent + extra_indent, costs,
-                              actuals, sources)
+                              actuals, sources, profile)
 
     if isinstance(node, Scan):
         f = f" filter={node.filter!r}" if node.filter is not None else ""

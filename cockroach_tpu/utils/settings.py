@@ -171,6 +171,20 @@ def _register_builtins(s: Settings):
     s.register("sql.admission.shed.wait_seconds", 0.0, float,
                "recent admission grant-wait (EWMA, seconds) above which "
                "low-priority statements are shed (0 disables)")
+    # observability: operator profiles + statement diagnostics
+    s.register("sql.stmt_profile.enabled", True, bool,
+               "per-statement coarse operator profile (exec/profile"
+               ".py): data-movement call sites attribute bytes/stalls "
+               "to the executing statement's sink, feeding per-tenant "
+               "rollups at /_status/tenants. Off = the kill switch "
+               "(profiling is host-side accounting only; results are "
+               "identical either way)")
+    s.register("timeseries.retention.seconds", 6 * 3600, int,
+               "fine-resolution (10s) timeseries slabs older than "
+               "this are rolled up to coarse resolution and pruned by "
+               "the maintenance loop (timeseries.storage.resolution_"
+               "10s.ttl analogue); coarse slabs keep their own 30-day "
+               "retention")
 
 
 def _meta_page_rows() -> int:

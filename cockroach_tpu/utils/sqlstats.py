@@ -78,10 +78,37 @@ class StmtStats:
         return max(0.0, self.mean_latency_s - self.mean_compile_s)
 
 
+@dataclass
+class TenantStats:
+    """Per-tenant (application_name-keyed) resource rollup — the
+    accelerator-utilization attribution the admission/WFQ story needs:
+    device-seconds consumed, bytes moved (uploads + shuffle + spill),
+    and the HBM high-water observed while the tenant's statements ran.
+    """
+    app_name: str
+    statements: int = 0
+    failures: int = 0
+    rows: int = 0
+    device_seconds: float = 0.0
+    bytes_moved: int = 0
+    hbm_bytes_held: int = 0      # high-water across the tenant's stmts
+    stall_seconds: float = 0.0
+
+    def to_wire(self) -> dict:
+        return {"app_name": self.app_name,
+                "statements": self.statements,
+                "failures": self.failures, "rows": self.rows,
+                "device_seconds": self.device_seconds,
+                "bytes_moved": self.bytes_moved,
+                "hbm_bytes_held": self.hbm_bytes_held,
+                "stall_seconds": self.stall_seconds}
+
+
 class StatsRegistry:
     def __init__(self):
         self._mu = threading.Lock()
         self._stats: dict[str, StmtStats] = {}
+        self._tenants: dict[str, TenantStats] = {}
 
     def record(self, sql: str, latency_s: float, rows: int,
                failed: bool = False, compile_s: float = 0.0) -> None:
@@ -105,6 +132,31 @@ class StatsRegistry:
             if failed:
                 st.failures += 1
 
+    def record_tenant(self, app_name: str, device_s: float = 0.0,
+                      bytes_moved: int = 0, rows: int = 0,
+                      hbm_bytes: int = 0, stall_s: float = 0.0,
+                      failed: bool = False) -> None:
+        """Accumulate one statement's resource use against its tenant
+        (engine: ``application_name`` session var, '(unset)' when
+        empty). Exposed at /_status/tenants with cluster fan-out."""
+        with self._mu:
+            t = self._tenants.get(app_name)
+            if t is None:
+                t = self._tenants[app_name] = TenantStats(app_name)
+            t.statements += 1
+            t.rows += rows
+            t.device_seconds += device_s
+            t.bytes_moved += bytes_moved
+            t.hbm_bytes_held = max(t.hbm_bytes_held, hbm_bytes)
+            t.stall_seconds += stall_s
+            if failed:
+                t.failures += 1
+
+    def tenants(self) -> list[TenantStats]:
+        with self._mu:
+            return sorted(self._tenants.values(),
+                          key=lambda t: -t.device_seconds)
+
     def all(self) -> list[StmtStats]:
         with self._mu:
             return sorted(self._stats.values(),
@@ -117,3 +169,4 @@ class StatsRegistry:
     def reset(self) -> None:
         with self._mu:
             self._stats.clear()
+            self._tenants.clear()

@@ -140,6 +140,47 @@ class TestDocDrift:
             f"from the OBSERVABILITY.md endpoint table: {missing}")
 
 
+class TestDiagnosticsDocCoverage:
+    """Round 13: the statement-diagnostics surface — profile metric
+    families, the stmtdiag registry counters, and the new status
+    endpoints — must be registered in code AND documented, so neither
+    side can silently drop the other."""
+
+    NEW_FAMILIES = ("exec.profile.statements", "exec.profile.operators",
+                    "stmtdiag.armed", "stmtdiag.captured",
+                    "stmtdiag.fetched")
+    NEW_ENDPOINTS = ("/_status/stmtdiag", "/_status/tenants")
+
+    def test_profile_families_registered(self):
+        regs = {n for _, _, n in _registrations()}
+        for name in self.NEW_FAMILIES:
+            assert name in regs, f"{name} no longer registered"
+
+    def test_profile_families_documented(self):
+        exact, prefixes = _documented_families()
+        for name in self.NEW_FAMILIES:
+            assert name in exact or \
+                any(name.startswith(p) for p in prefixes), \
+                f"{name} missing from OBSERVABILITY.md"
+
+    def test_diag_endpoints_served_and_documented(self):
+        node_py = (REPO / "cockroach_tpu" / "server"
+                   / "node.py").read_text()
+        served = {m.group(1) for m in re.finditer(
+            r"[\"'](/[a-zA-Z_][a-zA-Z0-9_/]*)[\"']", node_py)}
+        documented = {s.split("?")[0] for s in
+                      _CODE_SPAN.findall(OBSERVABILITY)
+                      if s.startswith("/")}
+        for ep in self.NEW_ENDPOINTS:
+            assert ep in served, f"{ep} no longer served"
+            assert ep in documented, \
+                f"{ep} missing from OBSERVABILITY.md"
+        # the by-id fetch path (a startswith route, so its literal
+        # carries the trailing slash)
+        assert "/_status/stmtdiag/" in served
+        assert "/_status/stmtdiag/" in documented
+
+
 class TestExpositionFormat:
     def _registry(self):
         reg = MetricRegistry()

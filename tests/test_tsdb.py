@@ -163,6 +163,42 @@ class TestMaintenanceIdempotent:
         assert vals == sorted(vals)
 
 
+class TestRetentionSetting:
+    def test_retention_setting_drives_maintenance(self):
+        """`timeseries.retention.seconds` (cluster setting) is the
+        fine-slab retention the node's maintenance pass actually uses:
+        at the default the hour-old slab survives, after shrinking the
+        setting the same pass rolls it up."""
+        from cockroach_tpu.server.node import Node, NodeConfig
+        n = Node(NodeConfig(http_port=0, listen_port=0))
+        n.start()
+        try:
+            clock = FakeClock()
+            n.tsdb.now_s = clock
+            g = n.engine.metrics.gauge("ret.g", "x")
+            t0 = clock.t
+            for i in range(SLAB_S // FINE_RES_S):
+                g.set(float(i))
+                n.tsdb.record()
+                clock.t += FINE_RES_S
+            # 2h later: inside the 6h default, nothing rolls up
+            clock.t = t0 + SLAB_S + 2 * 3600
+            n.run_ts_maintenance()
+            fine_key = f"/ts/{FINE_RES_S}/ret.g/".encode()
+            assert list(n.engine.kv.scan(fine_key,
+                                         fine_key + b"\xff"))
+            # shrink retention to 1h: the same pass now rolls up
+            n.settings.set("timeseries.retention.seconds", 3600)
+            n.run_ts_maintenance()
+            assert not list(n.engine.kv.scan(fine_key,
+                                             fine_key + b"\xff"))
+            pts = n.tsdb.query("ret.g", t0, t0 + SLAB_S,
+                               downsample_s=COARSE_RES_S)
+            assert len(pts) == SLAB_S // COARSE_RES_S
+        finally:
+            n.stop()
+
+
 class TestDeviceUtilizationSeries:
     def test_device_family_recorded_and_queryable(self):
         """The exec.device.* func-metrics are scalars, so record()
