@@ -558,6 +558,143 @@ def run_spill_ab(rows, repeats):
     return out
 
 
+def run_movement_ab(rows, repeats):
+    """Data-movement A/B (round 13 tentpole): a distributed join
+    ladder where each data node's lineitem shard is sized at 0.5x /
+    1x / 2x / 4x of the node's HBM slice (the replicated orders build
+    side always stays resident — build sides cannot page). Pre-round-
+    13 every rung past 0.5x DIED with MemoryQuotaError on the data
+    nodes; now the node-side distributed spill pages the shard
+    through the movement scheduler. Two arms per rung:
+
+      overlap  FlowSpec.overlap=True (default): producers double-
+               buffer the send side and page uploads ride the
+               prefetch worker — ship time hides behind compute
+      serial   overlap=False: the historical compute-then-ship frame
+               exchange
+
+    Headline: completion + bit-parity against the all-resident
+    single-engine oracle on every rung, and the 2x/1x overlap-arm
+    throughput ratio (the linear-degradation gate: paging a working
+    set 2x over budget should cost bandwidth, not fall off a cliff).
+    NOTE: on XLA-CPU 'device' compute shares host cores with page
+    assembly and frame serialization, so overlap seconds understate
+    a real chip."""
+    from cockroach_tpu.distsql.node import DistSQLNode, Gateway
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.kvserver.transport import LocalTransport
+    from cockroach_tpu.models import tpch
+
+    sf = rows / tpch.LINEITEM_PER_SF
+    t0 = time.time()
+    li = tpch.gen_lineitem(sf, rows=rows)
+    orders = tpch.gen_orders(sf)
+    print(f"# movement datagen_s={time.time() - t0:.1f} rows={rows}",
+          file=sys.stderr)
+    nshards = 3
+    transport = LocalTransport()
+    bounds = [i * rows // nshards for i in range(nshards + 1)]
+    nodes, engines = [], []
+    for i in range(nshards + 1):            # 0 = gateway, ample
+        eng = Engine()
+        eng.execute(tpch.DDL["lineitem"])
+        eng.execute(tpch.DDL["orders"])
+        ts = eng.clock.now()
+        if i > 0:
+            eng.store.insert_columns(
+                "lineitem",
+                {k: v[bounds[i - 1]:bounds[i]] for k, v in li.items()},
+                ts)
+        eng.store.insert_columns("orders", orders, ts)
+        engines.append(eng)
+        nodes.append(DistSQLNode(i, eng, transport))
+    gw = Gateway(nodes[0], list(range(1, nshards + 1)),
+                 replicated_tables={"orders"})
+    sql = ("SELECT o_orderpriority, count(*) AS n, "
+           "sum(l_quantity) AS q FROM lineitem JOIN orders "
+           "ON l_orderkey = o_orderkey "
+           "GROUP BY o_orderpriority ORDER BY o_orderpriority")
+    oracle = Engine()
+    tpch.load(oracle, sf=sf, rows=rows, tables=("lineitem", "orders"),
+              encoded=True)
+    base = oracle.execute(sql).rows
+
+    e1 = engines[1]
+    shard_b = e1._table_device_bytes(e1.store.table("lineitem"), None)
+    orders_b = e1._table_device_bytes(e1.store.table("orders"), None)
+    out = {"movement_shard_bytes": int(shard_b),
+           "movement_build_bytes": int(orders_b)}
+    spill_keys = ("exec.movement.dist_spill_fallbacks",
+                  "exec.stream.pages",
+                  "exec.movement.overlap_seconds",
+                  "exec.spill.upload_overlap_seconds")
+    for label, factor in (("0p5x", 0.5), ("1x", 1.0), ("2x", 2.0),
+                          ("4x", 4.0)):
+        budget = int(orders_b + shard_b / factor)
+        for eng in engines[1:]:
+            eng.drop_device_cache()
+            eng.settings.set("sql.exec.hbm_budget_bytes", str(budget))
+        out[f"movement_{label}_node_budget_bytes"] = budget
+        for arm in ("overlap", "serial"):
+            gw.overlap = arm == "overlap"
+            snap0 = [e.metrics.snapshot() for e in engines[1:]]
+            try:
+                res = gw.run(sql)          # warmup: compile + upload
+                per = []
+                for _ in range(repeats):
+                    t0 = time.time()
+                    res = gw.run(sql)
+                    per.append(rows / (time.time() - t0))
+                rps = statistics.median(per)
+            except Exception as e:
+                out[f"movement_{label}_{arm}_rows_per_sec"] = 0
+                out[f"movement_{label}_{arm}_error"] = type(e).__name__
+                print(f"# movement {label} arm={arm} "
+                      f"error={type(e).__name__}: {str(e)[:100]}",
+                      file=sys.stderr)
+                continue
+            d = {}
+            for s0, eng in zip(snap0, engines[1:]):
+                for k, v in metric_deltas(
+                        s0, eng.metrics.snapshot()).items():
+                    if k in spill_keys:
+                        d[k] = d.get(k, 0) + v
+            out[f"movement_{label}_{arm}_rows_per_sec"] = round(rps)
+            out[f"movement_{label}_{arm}_parity"] = res.rows == base
+            if arm == "overlap":
+                out[f"movement_{label}_overlap_s"] = round(
+                    d.get("exec.movement.overlap_seconds", 0), 4)
+                out[f"movement_{label}_spill_overlap_s"] = round(
+                    d.get("exec.spill.upload_overlap_seconds", 0), 4)
+                out[f"movement_{label}_pages"] = \
+                    d.get("exec.stream.pages", 0)
+            print(f"# movement {label} arm={arm} "
+                  f"rows_per_sec={rps:.3e} parity={res.rows == base} "
+                  f"pages={d.get('exec.stream.pages', 0)} "
+                  f"fallbacks="
+                  f"{d.get('exec.movement.dist_spill_fallbacks', 0)} "
+                  f"overlap_s="
+                  f"{d.get('exec.movement.overlap_seconds', 0):.4f}",
+                  file=sys.stderr)
+        gw.overlap = True
+    # the linear-degradation gate: a 2x-over-budget working set pages
+    # half its scans per rerun — throughput should degrade toward the
+    # movement bound, not collapse (cliff = the scheduler failed to
+    # overlap or thrashed pages)
+    r1 = out.get("movement_1x_overlap_rows_per_sec", 0)
+    r2 = out.get("movement_2x_overlap_rows_per_sec", 0)
+    if r1:
+        out["movement_ratio_2x_1x"] = round(r2 / r1, 3)
+        if r2 / r1 < 0.35:
+            print(f"# REGRESSION movement_ratio_2x_1x="
+                  f"{r2 / r1:.3f} < 0.35: beyond-HBM rung fell off "
+                  "a cliff instead of degrading linearly",
+                  file=sys.stderr)
+            out.setdefault("regressions", []).append(
+                "movement_ratio_2x_1x")
+    return out
+
+
 def run_joinskip_ab(rows, repeats):
     """Join-induced data skipping A/B (round 10 tentpole): semi-join
     filters derived from the hash-join build side at dispatch time,
@@ -1085,6 +1222,13 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
             env["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
+    if mode == "movement_child":
+        # the fakedist cluster is N in-process Engines over a local
+        # transport; page assembly + frame exchange are host paths, so
+        # measure on XLA-CPU (each Engine runs single-device — the
+        # distribution axis is across Engines, not mesh devices)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     if mode == "tpcc_child":
         # TPC-C is a HOST path (txn machinery, index fastpaths);
         # statements that do fall to a compiled scan should compile
@@ -1247,6 +1391,15 @@ def main():
             "metric": "joinorder_sketch_rows_per_sec",
             "value": per.get("joinorder_sketch_rows_per_sec", 0),
             "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
+    if mode == "movement_child":
+        per = run_movement_ab(rows, max(3, repeats - 2))
+        print(json.dumps({
+            "metric": "movement_ratio_2x_1x",
+            "value": per.get("movement_ratio_2x_1x", 0),
+            "unit": "x", "rows": rows,
             **per,
         }))
         return
@@ -1437,6 +1590,18 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("joinorder_")})
             out.setdefault("joinorder_rows", r["rows"])
+    # round 13 tentpole A/B: data-movement-first distributed executor
+    # — beyond-HBM join ladder (working set 0.5x..4x of each node's
+    # budget), overlapped vs serial exchange, on a fakedist cluster
+    if os.environ.get("BENCH_MOVEMENT", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_MOVEMENT_ROWS",
+                                         1 << 17)),
+                      "movement", child_timeout,
+                      mode="movement_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("movement_")})
+            out.setdefault("movement_rows", r["rows"])
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),
@@ -1501,7 +1666,8 @@ def main():
 _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "cpu_rows", "ssb_rows", "tpcc_warehouses",
                   "spill_budget_bytes", "coldstart_rows",
-                  "joinskip_budget_bytes", "joinskip_okey_cap"}
+                  "joinskip_budget_bytes", "joinskip_okey_cap",
+                  "movement_shard_bytes", "movement_build_bytes"}
 
 
 def regression_report(out: dict) -> None:
@@ -1527,6 +1693,8 @@ def regression_report(out: dict) -> None:
         pv, cv = prev[k], out[k]
         if k in _NON_PERF_KEYS or k.endswith("_rows") or \
                 k.endswith("_cache_hits") or \
+                k.endswith("_node_budget_bytes") or \
+                k.endswith("_overlap_s") or k.endswith("_pages") or \
                 isinstance(pv, bool) or isinstance(cv, bool) or \
                 not isinstance(pv, (int, float)) or \
                 not isinstance(cv, (int, float)) or not pv:

@@ -94,6 +94,13 @@ class FlowSpec:
     # the node-tagged operator table back ahead of EOF (a
     # "flow_profile" frame, the flow_span analogue)
     profile: bool = False
+    # overlapped exchange (exec/movement.py): producers double-buffer
+    # the send side — batch k+1's device work (and the page upload
+    # behind it) dispatches BEFORE the producer blocks on batch k's
+    # host transfer and send (the stream.prefetch discipline turned
+    # around). Off = the historical compute-then-ship frame exchange
+    # — the A/B lever for the parity fuzz and the movement bench.
+    overlap: bool = True
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -103,7 +110,8 @@ class FlowSpec:
                 "window": self.window, "spans": self.spans,
                 "graph": self.graph, "data_nodes": self.data_nodes,
                 "trace": self.trace, "joinfilter": self.joinfilter,
-                "adaptive": self.adaptive, "profile": self.profile}
+                "adaptive": self.adaptive, "profile": self.profile,
+                "overlap": self.overlap}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

@@ -36,10 +36,15 @@ from cockroach_tpu.ops.batch import ColumnBatch
 from cockroach_tpu.sql import parser
 from cockroach_tpu.sql.planner import Planner, PlanError
 from cockroach_tpu.utils import tracing
+from cockroach_tpu.utils.mon import MemoryQuotaError
 
 
 class FlowError(Exception):
     pass
+
+
+# end-of-iteration sentinel for the overlapped-send double buffer
+_SHIP_DONE = object()
 
 
 class FlowUnavailable(FlowError):
@@ -241,10 +246,8 @@ class DistSQLNode:
             def body():
                 if spec.spans is not None:
                     self._materialize_spans(spec.spans)
-                batch, stage = self._run_local(spec, sink=sink)
-                n, cols, valid = self._host_output(batch, stage.local,
-                                                   stage.string_cols)
-                outbox.send_arrays(n, cols, valid, spec.chunk_rows)
+                batches, stage = self._run_local(spec, sink=sink)
+                self._ship_batches(spec, outbox, batches, stage)
             if spec.trace:
                 # record this stage locally and ship the subtree back
                 # BEFORE EOF (the gateway's pump loop exits on EOF)
@@ -347,7 +350,17 @@ class DistSQLNode:
             for d in spec.joinfilter:
                 f = JoinFilter.from_wire(d)
                 jf_by_table.setdefault(f.table, []).append(f)
-        for alias, tbl in local_scans.items():
+        paged = None   # (alias, table) whose upload overflowed HBM
+        builds = _join_build_aliases(stage.local)
+        # build sides first: they can never page (every probe row must
+        # see the whole build table), so give them first claim on the
+        # HBM slice — any overflow then lands on a probe/source scan,
+        # which the paged fallback below CAN absorb. Without this, a
+        # probe shard that happens to fit alone reserves first and the
+        # build-side reservation fails the whole flow.
+        for alias, tbl in sorted(local_scans.items(),
+                                 key=lambda kv: (kv[0] not in builds,
+                                                 kv[0])):
             fl = jf_by_table.get(tbl)
             b = None
             if fl:
@@ -356,16 +369,148 @@ class DistSQLNode:
                         tbl, fl, spec.read_ts)
                 except Exception:
                     b = None
-            scans[alias] = (b if b is not None
-                            else eng._device_table(tbl, narrow=False))
+            if b is not None:
+                scans[alias] = b
+                continue
+            try:
+                scans[alias] = eng._device_table(tbl, narrow=False)
+            except MemoryQuotaError:
+                # distributed spill, node side: this shard's working
+                # set exceeds the node's HBM slice, so page THE ONE
+                # over-budget scan through the spill-tier fixed-shape
+                # page machinery instead of failing the flow. Pages
+                # partition the shard exactly the way shards partition
+                # the table, so per-page stage outputs union at the
+                # gateway bit-identically to per-shard outputs — but
+                # only where that algebra holds: never a hash-join
+                # BUILD side (every probe row must see the full build
+                # table), never a graph flow (rows route positionally
+                # through exchange buckets), and at most one scan.
+                if paged is not None or spec.graph is not None \
+                        or alias in builds:
+                    raise
+                paged = (alias, tbl)
         read_ts = jnp.int64(spec.read_ts if spec.read_ts is not None
                             else eng.clock.now().to_int())
-        if sink is None:
-            return runf(RunContext(scans, read_ts)), stage
-        t0 = _time.monotonic()
-        out = runf(RunContext(scans, read_ts))
-        sink.wall_s += _time.monotonic() - t0
-        return out, stage
+        if paged is not None:
+            return self._paged_local(spec, runf, scans, paged,
+                                     read_ts, sink=sink), stage
+
+        def run_once():
+            if sink is None:
+                return runf(RunContext(scans, read_ts))
+            t0 = _time.monotonic()
+            out = runf(RunContext(scans, read_ts))
+            sink.wall_s += _time.monotonic() - t0
+            return out
+        return [run_once()], stage
+
+    def _paged_local(self, spec: FlowSpec, runf, scans, paged,
+                     read_ts, sink=None):
+        """Generator of per-page stage outputs for a flow whose scan
+        overflowed this node's HBM slice (_run_local's distributed-
+        spill rung). Page size comes from the budget headroom so two
+        pages (the one computing + the one the prefetch worker is
+        uploading) fit in the slice; the upload pipeline overlap is
+        accounted to the movement scheduler the same way the spill
+        tier's run_spill_join accounts its feed."""
+        from cockroach_tpu.exec.spill import _STALL_HELP, _StallSum
+        from cockroach_tpu.exec.stream import prefetch as stream_prefetch
+        alias, tbl = paged
+        eng = self.engine
+        mv = eng.movement
+        mv.m_spill_fallbacks.inc()
+        td = eng.store.table(tbl)
+        nrows = max(int(td.row_count), 1)
+        per_row = max(1, eng._table_device_bytes(td, None)
+                      // max(1, eng._row_bucket(nrows)))
+        free = max(int(eng.hbm.limit) - int(eng.hbm.used), 0)
+        target = max(1024, min(nrows, free // (2 * per_row)))
+        page_rows = eng._row_bucket(target)
+        src = eng._page_source(tbl, None, page_rows,
+                               read_ts=spec.read_ts)
+
+        def run_page(batch):
+            s = dict(scans)
+            s[alias] = batch
+            if sink is None:
+                return runf(RunContext(s, read_ts))
+            t0 = _time.monotonic()
+            out = runf(RunContext(s, read_ts))
+            sink.wall_s += _time.monotonic() - t0
+            return out
+
+        def gen():
+            stall = _StallSum(eng.metrics.histogram(
+                "exec.stream.prefetch_stall_seconds", _STALL_HELP))
+            busy = [0.0]
+            got = False
+            with mv.soft_lease("page", 2 * src.page_bytes):
+                it = stream_prefetch(src.pages(), stall_hist=stall)
+                try:
+                    for page in it:
+                        got = True
+                        t0 = _time.monotonic()
+                        yield run_page(page)
+                        # time the consumer spent computing/shipping
+                        # while the worker assembled the next page
+                        busy[0] += _time.monotonic() - t0
+                finally:
+                    it.close()
+                if not got:
+                    # every page MVCC-skipped: aggregates still need
+                    # their identity state from one padding-only page
+                    yield run_page(src.empty_page())
+            ov = max(0.0, busy[0] - stall.total)
+            mv.note_overlap(ov)
+            # the distributed rung of the spill tier: account its
+            # hidden upload time to the same counter the local
+            # spill-join feed uses, so one metric answers "did paging
+            # overlap compute" regardless of which plane paged
+            eng.metrics.counter(
+                "exec.spill.upload_overlap_seconds",
+                "seconds of partition/page assembly+upload hidden "
+                "under device compute (worker busy time not surfacing "
+                "as consumer stalls) — the prefetch-overlap evidence"
+            ).inc(ov)
+        return gen()
+
+    def _ship_batches(self, spec: FlowSpec, outbox: Outbox, batches,
+                      stage) -> None:
+        """Ship every stage-output batch on the flow's stream. With
+        ``spec.overlap`` the producer double-buffers: it pulls batch
+        k+1 (dispatching its device work, and behind it the next page
+        upload) BEFORE blocking on batch k's host transfer and send —
+        the stream.prefetch discipline turned around for the send
+        side. Off = the historical compute-then-ship frame exchange
+        (the A/B lever for the parity fuzz and the movement bench)."""
+        mv = self.engine.movement
+
+        def ship(batch):
+            n, cols, valid = self._host_output(batch, stage.local,
+                                               stage.string_cols)
+            outbox.send_arrays(n, cols, valid, spec.chunk_rows)
+        try:
+            if not spec.overlap:
+                for batch in batches:
+                    ship(batch)
+                return
+            it = iter(batches)
+            prev = next(it, _SHIP_DONE)
+            overlapped = 0.0
+            while prev is not _SHIP_DONE:
+                nxt = next(it, _SHIP_DONE)
+                t0 = _time.monotonic()
+                ship(prev)
+                if nxt is not _SHIP_DONE:
+                    # send of batch k ran while batch k+1's device
+                    # work (dispatched by the pull above) proceeded
+                    overlapped += _time.monotonic() - t0
+                prev = nxt
+            if overlapped > 0.0:
+                mv.note_overlap(overlapped)
+        finally:
+            mv.note_exchange(outbox.bytes_sent)
 
     def _adaptive_agg_stage(self, stage):
         """Partial Partial Aggregates: decide, per shard at flow setup
@@ -803,6 +948,27 @@ def _collect_scans(node) -> dict[str, str]:
     return out
 
 
+def _join_build_aliases(node) -> set:
+    """Aliases scanned under any hash-join BUILD subtree. A build side
+    must be device-resident in full — probing against pages of it
+    would silently drop matches — so those scans may never take the
+    paged distributed-spill rung."""
+    from cockroach_tpu.sql import plan as P
+    out: set = set()
+
+    def rec(n, under_build):
+        if isinstance(n, P.Scan):
+            if under_build and n.table != UNION:
+                out.add(n.alias)
+        elif isinstance(n, P.HashJoin):
+            rec(n.left, under_build)
+            rec(n.right, True)
+        elif hasattr(n, "child"):
+            rec(n.child, under_build)
+    rec(node, False)
+    return out
+
+
 class Gateway:
     """Plans and runs one distributed statement (PlanAndRunAll,
     ``pkg/sql/distsql_running.go:1519``). The gateway owns a
@@ -821,7 +987,8 @@ class Gateway:
                  flow_timeout: float = FLOW_TIMEOUT,
                  monitor=None, window: int = 8, cluster=None,
                  prefer_shuffle: bool = False,
-                 adaptive_agg: bool = True):
+                 adaptive_agg: bool = True,
+                 overlap: bool = True):
         # prefer_shuffle: route every shuffle-decomposable statement
         # through the multi-stage hash-exchange graph, even when a
         # single-stage plan would work (the sharded⋈sharded path is
@@ -831,6 +998,10 @@ class Gateway:
         # let each shard pick partials vs raw rows per statement; off
         # forces the classic always-partial stage (A/B lever)
         self.adaptive_agg = adaptive_agg
+        # overlapped exchange (exec/movement.py): producers double-
+        # buffer compute against host transfer + send; off forces the
+        # classic compute-then-ship frame exchange (A/B lever)
+        self.overlap = overlap
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -1303,7 +1474,8 @@ class Gateway:
                                    if spans_by_node is not None
                                    else None),
                             trace=trace, joinfilter=jf_frames,
-                            adaptive=adaptive, profile=profiled)
+                            adaptive=adaptive, profile=profiled,
+                            overlap=self.overlap)
             inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))

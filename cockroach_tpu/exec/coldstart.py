@@ -318,9 +318,13 @@ def journal_path(cache_d: str) -> str:
 
 
 def journal_record(cache_d: str | None, sql_text: str,
-                   bucket: int = 0) -> None:
+                   bucket: int = 0, vars: dict | None = None) -> None:
     """Append an executable-cache miss to the shapes journal. Best
-    effort: journal loss only costs pre-warm coverage."""
+    effort: journal loss only costs pre-warm coverage. ``vars`` holds
+    the plan-key-changing session vars the statement compiled under
+    (non-default values only), so a pre-warm re-prepares the SAME
+    executable the statement actually ran, not the default-session
+    plan of the same text."""
     if not cache_d or not sql_text:
         return
     try:
@@ -330,10 +334,12 @@ def journal_record(cache_d: str | None, sql_text: str,
                 return
         except OSError:
             pass
+        rec = {"sql": sql_text, "n": int(bucket)}
+        if vars:
+            rec["vars"] = dict(vars)
         with _LOCK:
             with open(p, "a", encoding="utf-8") as f:
-                f.write(json.dumps({"sql": sql_text, "n": int(bucket)})
-                        + "\n")
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
     except Exception:
         pass
 
@@ -341,16 +347,20 @@ def journal_record(cache_d: str | None, sql_text: str,
 def journal_entries(cache_d: str | None, k: int) -> list[tuple]:
     """The k hottest statement texts from the journal, each paired
     with its dominant recorded shape bucket (0 when the statement
-    never journaled one — resident plans). The bucket is what
-    Engine.prewarm compiles streamed-page and spill-partition
-    executables at, so a restarted process warms the page shapes the
-    previous one actually ran, not just the statement texts. Corrupt
-    lines are skipped, a missing journal is an empty plan."""
+    never journaled one — resident plans) and its dominant recorded
+    session-var dict ({} when it always ran at defaults). The bucket
+    is what Engine.prewarm compiles streamed-page and spill-partition
+    executables at, and the vars are what it re-prepares under, so a
+    restarted process warms the plans the previous one actually ran,
+    not just the statement texts. Corrupt lines are skipped, a
+    missing journal is an empty plan."""
     if not cache_d or k <= 0:
         return []
     from collections import Counter
     counts: Counter = Counter()
     buckets: dict[str, Counter] = {}
+    varcounts: dict[str, Counter] = {}
+    vartabs: dict[str, dict] = {}
     try:
         with open(journal_path(cache_d), encoding="utf-8") as f:
             for line in f:
@@ -362,16 +372,29 @@ def journal_entries(cache_d: str | None, k: int) -> list[tuple]:
                         b = int(rec.get("n") or 0)
                         if b > 0:
                             buckets.setdefault(sql, Counter())[b] += 1
+                        jv = rec.get("vars")
+                        if isinstance(jv, dict) and jv:
+                            key = json.dumps(jv, sort_keys=True)
+                            varcounts.setdefault(sql, Counter())[key] += 1
+                            vartabs.setdefault(sql, {})[key] = jv
                 except Exception:
                     continue
     except OSError:
         return []
-    return [(sql, (buckets[sql].most_common(1)[0][0]
-                   if sql in buckets else 0))
+
+    def dominant_vars(sql: str) -> dict:
+        if sql not in varcounts:
+            return {}
+        return vartabs[sql][varcounts[sql].most_common(1)[0][0]]
+
+    return [(sql,
+             (buckets[sql].most_common(1)[0][0]
+              if sql in buckets else 0),
+             dominant_vars(sql))
             for sql, _ in counts.most_common(k)]
 
 
 def journal_top(cache_d: str | None, k: int) -> list[str]:
     """The k statement texts with the most recorded compile misses,
-    hottest first (journal_entries without the shape buckets)."""
-    return [sql for sql, _ in journal_entries(cache_d, k)]
+    hottest first (journal_entries without the buckets/vars)."""
+    return [e[0] for e in journal_entries(cache_d, k)]

@@ -77,6 +77,11 @@ class ExecParams:
     pallas_group_tile: int = 512
     pallas_block_rows: int = 1024
     pallas_limb_cap: int = 22
+    # Kernel paths the parity gate (ops/pallas/paritygate.py) proved
+    # bit-identical to the XLA oracle on this backend: `auto` routing
+    # admits exactly these beyond its always-exact envelope. Perf-only
+    # under the gate's exactness proof, so NOT in the cache key.
+    pallas_exact_paths: tuple = ()
     # Sort+Limit fusion: XLA's variadic sort costs ~20s of compile PER
     # OPERAND beyond 64K rows (measured on v5e; a 5-operand lexsort at
     # 262K compiles ~300s), so ORDER BY ... LIMIT k plans take a
@@ -633,16 +638,19 @@ def _large_interpret_over_budget(interpret: bool, n: int,
     return gtiles * (n // blk) > AUTO_INTERPRET_STEPS
 
 
-def _pallas_large_ok(aggs, mode: str) -> bool:
+def _pallas_large_ok(aggs, mode: str, exact_paths: tuple = ()) -> bool:
     """Static (SQL-type) envelope check for the large-G kernel
     (ops/pallas/groupagg_large.py).
 
     `auto` admits only aggregates whose kernel results are exact —
-    counts, `any` (representative-row gather), and int64-limb
-    sums/avgs over INT/DECIMAL args — so default routing cannot
-    perturb results. `on` additionally admits f32-accumulated float
-    sum/avg/min/max (approximate vs the XLA f64 path, same contract
-    as the small kernel)."""
+    counts, `any` (representative-row gather), int64-limb sums/avgs
+    over INT/DECIMAL args, and whatever `exact_paths` the parity gate
+    (ops/pallas/paritygate.py) proved bit-identical on this backend
+    (the ordered-int MIN/MAX hi-limb path verifies everywhere; the
+    f32 float sum only on a backend whose fuzz came back clean) — so
+    default routing cannot perturb results. `on` force-admits every
+    path including f32-accumulated float sum/avg/min/max (approximate
+    vs the XLA f64 path, same contract as the small kernel)."""
     for a in aggs:
         if a.distinct:
             return False  # dedup mask is an XLA-path construct
@@ -652,12 +660,16 @@ def _pallas_large_ok(aggs, mode: str) -> bool:
         if a.func in ("sum", "sum_int", "avg"):
             if fam in (Family.INT, Family.DECIMAL):
                 continue
-            if mode == "on" and fam == Family.FLOAT:
+            if fam == Family.FLOAT and \
+                    (mode == "on" or "float_sum" in exact_paths):
                 continue
             return False
-        if a.func in ("min", "max") and mode == "on" \
-                and fam == Family.FLOAT:
-            continue
+        if a.func in ("min", "max"):
+            if fam in (Family.INT, Family.DECIMAL) and \
+                    (mode == "on" or "int_minmax" in exact_paths):
+                continue
+            if mode == "on" and fam == Family.FLOAT:
+                continue
         return False
     return True
 
@@ -681,15 +693,18 @@ def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
     agrees on the value)."""
     from ..ops.pallas import groupagg as pg
     from ..ops.pallas import groupagg_large as pgl
+    from ..ops.pallas import paritygate as _pgate
     n = b.n
     sel = b.sel
     argdata = {i: argf(ctx) for i, (a, argf) in enumerate(aggfs)
                if argf is not None}
     for i, (a, _) in enumerate(aggfs):
-        if a.func in ("sum", "sum_int", "avg") and a.arg is not None \
+        if a.func in ("sum", "sum_int", "avg", "min", "max") \
+                and a.arg is not None \
                 and a.arg.type.family in (Family.INT, Family.DECIMAL):
             # the static check ran on SQL types; re-check the traced
-            # dtype (a cast upstream could hand us floats)
+            # dtype (a cast upstream could hand us floats) — limb
+            # sums and the MIN/MAX hi-limb both need real ints
             if argdata[i][0].dtype not in (jnp.int64, jnp.int32):
                 return None
     f_cols, f_tags = [], []     # f32-accumulated matmul columns
@@ -713,7 +728,19 @@ def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
             continue
         if a.func in ("min", "max"):
             ident = np.float32(np.inf if a.func == "min" else -np.inf)
-            mm_cols.append(jnp.where(m, d0.astype(jnp.float32), ident))
+            if a.arg.type.family in (Family.INT, Family.DECIMAL):
+                # exact ordered-int path (paritygate "int_minmax"):
+                # the kernel reduces the ARITHMETIC high limb — order-
+                # preserving, |limb| <= 2^23 so f32-exact — and the
+                # full-width winner is refined on XLA in the output
+                # loop below over just the rows holding that limb
+                hi = jnp.right_shift(d0.astype(jnp.int64),
+                                     jnp.int64(_pgate.MM_HI_SHIFT))
+                mm_cols.append(
+                    jnp.where(m, hi.astype(jnp.float32), ident))
+            else:
+                mm_cols.append(
+                    jnp.where(m, d0.astype(jnp.float32), ident))
             mm_ops_l.append(pg.MIN if a.func == "min" else pg.MAX)
             mm_tags.append(("mm", i))
             continue
@@ -797,9 +824,31 @@ def _pallas_large_partials(aggfs, b, ctx, gid, num_groups: int,
             if axis_name:
                 d = (jax.lax.pmin if a.func == "min"
                      else jax.lax.pmax)(d, axis_name)
+            if a.arg.type.family in (Family.INT, Family.DECIMAL):
+                # refine the (globally merged) winning hi limb to the
+                # full-width value with the dtype-preserving XLA fold
+                # over only the rows that hold it — every survivor is
+                # an actual input value, so the result is bit-equal to
+                # the pure-XLA path (shards without the winning limb
+                # refine an empty mask, whose fold identity loses the
+                # second pmin/pmax just like an empty-shard group)
+                d0, v0 = argdata[i]
+                m = jnp.logical_and(sel, v0)
+                rowhi = jnp.right_shift(d0.astype(jnp.int64),
+                                        jnp.int64(_pgate.MM_HI_SHIFT))
+                refine = jnp.logical_and(
+                    m, rowhi == d.astype(jnp.int64)[gid])
+                fold = aggops.group_min if a.func == "min" \
+                    else aggops.group_max
+                dref = fold(d0, gid, refine, num_groups)
+                if axis_name:
+                    dref = (jax.lax.pmin if a.func == "min"
+                            else jax.lax.pmax)(dref, axis_name)
+                aggs_out.append((dref, nonempty))
+                continue
             aggs_out.append((d.astype(jnp.float64), nonempty))
             continue
-        if i not in exact:  # float sum/avg (mode "on")
+        if i not in exact:  # float sum/avg ("on" or promoted)
             d = ps(acc_f[frow[("fsum", i)], :]).astype(jnp.float64)
             if a.func == "avg":
                 d = d / jnp.maximum(cnt, 1).astype(jnp.float64)
@@ -1004,7 +1053,8 @@ def _compile_aggregate(node: P.Aggregate, params: ExecParams) -> CompiledNode:
                              params.pallas_interpret, b.n, num_groups,
                              params.pallas_group_tile,
                              params.pallas_block_rows))
-                and _pallas_large_ok([a for a, _ in aggfs], mode)):
+                and _pallas_large_ok([a for a, _ in aggfs], mode,
+                                     params.pallas_exact_paths)):
             large = True
         overflow = jnp.bool_(False)
         rep_state = None

@@ -50,6 +50,7 @@ from ..utils.metric import MetricRegistry
 from ..utils.mon import BytesMonitor, MemoryQuotaError
 from ..utils.settings import SessionVars, Settings
 from . import coldstart
+from . import movement
 from .compile import (ExecParams, RunContext, can_stream, compile_plan,
                       compile_streaming)
 from .planparam import parameterize, plan_fingerprint, shape_text
@@ -136,7 +137,14 @@ class _DistRouter:
                     jax.jit(make_distributed_fn(
                         self._runf_for(n_shards), mesh,
                         self.scan_aliases, self.decision)),
-                    metrics=self.engine.metrics, mesh=mesh)
+                    metrics=self.engine.metrics, mesh=mesh,
+                    movement=self.engine.movement,
+                    # per-dispatch exchange working-buffer estimate:
+                    # exchanged rows are bounded by one shard's
+                    # post-filter slice plus the replicated builds
+                    lease_bytes=(self.sharded_bytes
+                                 // max(n_shards, 1)
+                                 + self.repl_bytes))
                 self._calls[key] = c
             return c
 
@@ -309,6 +317,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         coldstart.register_metrics(self.metrics)
         from ..ops.pallas import autotune as _tune
         _tune.register_metrics(self.metrics)
+        from ..ops.pallas import paritygate as _pgate
+        _pgate.register_metrics(self.metrics)
         # device-memory accounting: resident table uploads reserve
         # against the HBM budget BEFORE device_put, so an over-budget
         # upload fails with a quota error naming the knob instead of
@@ -319,6 +329,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             on_change=lambda used: self.metrics.gauge(
                 "sql.mem.device.current",
                 "bytes of HBM reserved by resident tables").set(used))
+        # data-movement-first executor (exec/movement.py): every
+        # data-moving path — resident uploads, stream/spill pages,
+        # shuffle buffers — admits its bytes through one scheduler so
+        # concurrent sessions stop racing the single HBM budget
+        self.movement = movement.TransferScheduler(self.hbm,
+                                                   self.metrics)
+        from ..parallel import shuffle as _shuf
+        self.metrics.func_counter(
+            "exec.movement.exchange.traced.bytes",
+            lambda: _shuf.EXCHANGE_TRACED.value(),
+            "all_to_all exchange buffer bytes, tallied at trace time")
         # TPU-plane visibility: Pallas kernel tallies are trace-time
         # module counters (ops/pallas/groupagg.py); read live at
         # scrape. All of them count at TRACE time — executions run
@@ -536,6 +557,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         mode = str(mode).lower()
         return mode if mode in ("auto", "on", "off") else "auto"
 
+    # session vars a journal entry may replay into a prewarm session:
+    # exactly the plan-key-changing vars _prepare_select journals —
+    # anything else in a (possibly hand-edited) journal is ignored
+    _PREWARM_VARS = ("hash_group_capacity", "pallas_groupagg",
+                     "sort_normalized")
+
     def prewarm(self, top_k: int | None = None) -> int:
         """Re-prepare the top-K statement texts from the shapes
         journal of a previous run (exec/coldstart.py), so their
@@ -552,16 +579,24 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if not top_k or not self._compile_cache_dir:
             return 0
         warmed = 0
-        for sql, bucket in coldstart.journal_entries(
+        for sql, bucket, jvars in coldstart.journal_entries(
                 self._compile_cache_dir, top_k):
             try:
+                jvars = {k: v for k, v in (jvars or {}).items()
+                         if k in self._PREWARM_VARS}
                 session = None
-                if bucket:
+                if bucket or jvars:
                     # a journaled page bucket means the statement ran
                     # on a paged plane (streamed or spill); re-derive
                     # that shape rather than the resident/distributed
-                    # plan a fresh default session might pick
+                    # plan a fresh default session might pick.
+                    # Journaled vars are the plan-key-changing session
+                    # vars the statement compiled under — re-prepare
+                    # under them or the warm misses its executable
                     session = self.session()
+                    for name, val in jvars.items():
+                        session.vars.set(name, val)
+                if bucket:
                     session.vars.set("distsql", "off")
                     session.vars.set("streaming_page_rows", bucket)
                 prep = self.prepare(sql, session)
@@ -2088,77 +2123,21 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                         sql_text: str,
                         no_memo: bool = False,
                         no_topk: bool = False,
-                        no_compact: bool = False) -> "Prepared":
-        for td in self.store.tables.values():
-            if td.open_ts:
-                self.store.seal(td.schema.name)
-        with self.tracer.span("plan"):
-            node, meta = self._plan(sel, session, no_memo=no_memo)
+                        no_compact: bool = False,
+                        no_dist: bool = False) -> "Prepared":
+        return self._prepare_select_inner(
+            sel, session, sql_text, no_memo=no_memo, no_topk=no_topk,
+            no_compact=no_compact, no_dist=no_dist)
 
-        scan_aliases = _collect_scans(node)
-        scan_cols = _collect_scan_columns(node)
-        # read-your-own-writes: tables this txn has written get an
-        # overlay snapshot (committed + buffered effects), not the
-        # shared device cache; overlay scans stay single-device
-        overlay = set()
-        if session.txn is not None and session.effects:
-            touched = {tb for tb, _ in session.effects}
-            overlay = touched & set(scan_aliases.values())
-        decision = None if overlay else self._dist_decision(node, session)
-        # four-way placement verdict: distributed > spill > stream-scan
-        # > resident. Spill outranks stream-scan because it covers the
-        # shapes streaming can't rescue: over-budget join builds (the
-        # stream path uploads builds whole and dies at hbm.reserve) and
-        # Sort/Limit plans with no aggregate to page into partials.
-        spill = (None if (overlay or decision is not None)
-                 else self._spill_decision(node, scan_aliases, scan_cols,
-                                           session, meta))
-        stream = (None if (overlay or decision is not None
-                           or spill is not None)
-                  else self._stream_decision(node, scan_aliases, scan_cols,
-                                             session))
-        read_ts = self._read_ts(session)
-        # the join-build uniqueness guard is snapshot-aware: it must
-        # judge the rows visible at THIS query's read timestamp — and
-        # know about txn-buffered build rows the store can't see
-        as_of = self._as_of_ts(sel, session)
-        if as_of is not None:
-            read_ts = as_of
-        overlay_puts = {
-            t: sum(1 for tb, op in session.effects
-                   if tb == t and op[0] == "put")
-            for t in overlay}
-        try:
-            self._check_join_builds(node, read_ts, overlay_puts)
-            self._bound_agg_group_rows(node, read_ts, overlay_puts)
-            wide = set()
-            if stream is not None:
-                wide.add(stream[0])
-            if spill is not None:
-                wide.add(spill.alias)
-                if spill.build_alias:
-                    wide.add(spill.build_alias)
-            narrow_by_alias = self._set_scan_narrowing(
-                node, overlay, frozenset(wide))
-        except EngineError:
-            if meta.memo is not None and not no_memo:
-                # the memo's stats-estimated build order violated the
-                # engine's EXACT multiplicity cap (avg vs max skew):
-                # replan with the greedy orderer, which consults the
-                # store's exact probes (the reference's optimizer
-                # likewise falls back when exploration yields no
-                # executable plan)
-                return self._prepare_select(sel, session, sql_text,
-                                            no_memo=True)
-            raise
-
-        scans = {}
-        gens = []
-        shapes = []
-        # distributed plans record how each scan resolves against an
-        # arbitrary target mesh (sub-mesh dispatch re-uploads lazily)
-        # plus the working-set footprint the router sizes against
-        upload_spec = []
+    def _upload_prepare_scans(self, node, session, scan_aliases,
+                              scan_cols, overlay, decision, stream,
+                              spill, narrow_by_alias, read_ts,
+                              scans, gens, shapes, upload_spec):
+        """Resolve every scan alias to a device batch (the
+        _prepare_select upload loop, extracted so the distributed
+        verdict can catch MemoryQuotaError and fall to the spill
+        tier). Mutates scans/gens/shapes/upload_spec; returns the
+        router's (sharded_bytes, repl_bytes) footprint estimate."""
         sharded_bytes = 0
         repl_bytes = 0
         for alias, tname in scan_aliases.items():
@@ -2225,6 +2204,105 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 sorted((cn, len(d)) for cn, d in
                        self.store.table(tname).dictionaries.items()))
             shapes.append((tname, b.n, dictlens))
+        return sharded_bytes, repl_bytes
+
+    def _prepare_select_inner(self, sel, session: Session,
+                              sql_text: str,
+                              no_memo: bool = False,
+                              no_topk: bool = False,
+                              no_compact: bool = False,
+                              no_dist: bool = False) -> "Prepared":
+        for td in self.store.tables.values():
+            if td.open_ts:
+                self.store.seal(td.schema.name)
+        with self.tracer.span("plan"):
+            node, meta = self._plan(sel, session, no_memo=no_memo)
+
+        scan_aliases = _collect_scans(node)
+        scan_cols = _collect_scan_columns(node)
+        # read-your-own-writes: tables this txn has written get an
+        # overlay snapshot (committed + buffered effects), not the
+        # shared device cache; overlay scans stay single-device
+        overlay = set()
+        if session.txn is not None and session.effects:
+            touched = {tb for tb, _ in session.effects}
+            overlay = touched & set(scan_aliases.values())
+        decision = (None if (overlay or no_dist)
+                    else self._dist_decision(node, session))
+        # four-way placement verdict: distributed > spill > stream-scan
+        # > resident. Spill outranks stream-scan because it covers the
+        # shapes streaming can't rescue: over-budget join builds (the
+        # stream path uploads builds whole and dies at hbm.reserve) and
+        # Sort/Limit plans with no aggregate to page into partials.
+        spill = (None if (overlay or decision is not None)
+                 else self._spill_decision(node, scan_aliases, scan_cols,
+                                           session, meta))
+        stream = (None if (overlay or decision is not None
+                           or spill is not None)
+                  else self._stream_decision(node, scan_aliases, scan_cols,
+                                             session))
+        read_ts = self._read_ts(session)
+        # the join-build uniqueness guard is snapshot-aware: it must
+        # judge the rows visible at THIS query's read timestamp — and
+        # know about txn-buffered build rows the store can't see
+        as_of = self._as_of_ts(sel, session)
+        if as_of is not None:
+            read_ts = as_of
+        overlay_puts = {
+            t: sum(1 for tb, op in session.effects
+                   if tb == t and op[0] == "put")
+            for t in overlay}
+        try:
+            self._check_join_builds(node, read_ts, overlay_puts)
+            self._bound_agg_group_rows(node, read_ts, overlay_puts)
+            wide = set()
+            if stream is not None:
+                wide.add(stream[0])
+            if spill is not None:
+                wide.add(spill.alias)
+                if spill.build_alias:
+                    wide.add(spill.build_alias)
+            narrow_by_alias = self._set_scan_narrowing(
+                node, overlay, frozenset(wide))
+        except EngineError:
+            if meta.memo is not None and not no_memo:
+                # the memo's stats-estimated build order violated the
+                # engine's EXACT multiplicity cap (avg vs max skew):
+                # replan with the greedy orderer, which consults the
+                # store's exact probes (the reference's optimizer
+                # likewise falls back when exploration yields no
+                # executable plan)
+                return self._prepare_select(sel, session, sql_text,
+                                            no_memo=True,
+                                            no_dist=no_dist)
+            raise
+
+        scans = {}
+        gens = []
+        shapes = []
+        # distributed plans record how each scan resolves against an
+        # arbitrary target mesh (sub-mesh dispatch re-uploads lazily)
+        # plus the working-set footprint the router sizes against
+        upload_spec = []
+        sharded_bytes = 0
+        repl_bytes = 0
+        try:
+            sharded_bytes, repl_bytes = self._upload_prepare_scans(
+                node, session, scan_aliases, scan_cols, overlay,
+                decision, stream, spill, narrow_by_alias, read_ts,
+                scans, gens, shapes, upload_spec)
+        except MemoryQuotaError:
+            if decision is None:
+                raise
+            # distributed spill: a shard working set that outgrows its
+            # HBM slice re-prepares WITHOUT the distributed verdict —
+            # the spill/stream tiers then page the same (mergeable by
+            # construction) partials through the partition machinery
+            # instead of dying on the upload reservation
+            self.movement.m_spill_fallbacks.inc()
+            return self._prepare_select(
+                sel, session, sql_text, no_memo=no_memo,
+                no_topk=no_topk, no_compact=no_compact, no_dist=True)
 
         cap = int(session.vars.get("hash_group_capacity", 1 << 17))
         # auto | on | off; legacy bool spellings normalize (True was
@@ -2300,11 +2378,22 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             # feed the startup pre-warm: texts that missed here are
             # what a restarted process should compile first, at the
             # shape bucket their paged executables specialize on
+            # plan-key-changing vars (non-default only): prewarm must
+            # re-prepare under these or it compiles a different
+            # executable than the one this statement is about to miss
+            jvars = {}
+            if cap != 1 << 17:
+                jvars["hash_group_capacity"] = cap
+            if pallas != "auto":
+                jvars["pallas_groupagg"] = pallas
+            if sortn != "auto":
+                jvars["sort_normalized"] = sortn
             coldstart.journal_record(
                 self._compile_cache_dir, sql_text,
                 bucket=(stream[2] if stream is not None
                         else spill.page_rows if spill is not None
-                        else 0))
+                        else 0),
+                vars=jvars)
             # large-G kernel tile point: the per-backend tuning table
             # (or shipped constants); perf-only, bit-identical either
             # way, so deliberately NOT in the cache key above
@@ -2314,6 +2403,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 jax.default_backend(), self._compile_cache_dir,
                 mode=self._autotune_mode(session), interpret=interp) \
                 if pallas != "off" else _tune.DEFAULT
+            # parity-gated promotion: kernel paths measured bit-exact
+            # on this backend widen `auto`'s envelope; perf-only (the
+            # gate proves exactness) so, like the tile point, NOT in
+            # the cache key
+            from ..ops.pallas import paritygate as _pgate
+            exact_paths = _pgate.promoted(
+                jax.default_backend(), self._compile_cache_dir,
+                interp) if pallas == "auto" else ()
             with self.tracer.span("compile"):
                 params = ExecParams(
                     hash_group_capacity=cap,
@@ -2326,6 +2423,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                     pallas_group_tile=gt,
                     pallas_block_rows=br,
                     pallas_limb_cap=limb_cap,
+                    pallas_exact_paths=exact_paths,
                     topk_sort=not no_topk,
                     sort_normalized=sortn)
                 if spill is not None and spill.kind == "join":

@@ -20,6 +20,7 @@ from ..parallel.distagg import make_distributed_fn, queued_collective_call
 from ..parallel.mesh import SHARD_AXIS
 from ..sql import plan as P
 from ..storage.hlc import Timestamp
+from ..utils.mon import MemoryQuotaError
 from .compile import (ExecParams, RunContext, can_spill_sort,
                       can_stream, compile_plan)
 
@@ -127,22 +128,63 @@ class ScanPlaneMixin:
 
         ts = read_ts or self._read_ts(prep.session)
         tsv = np.int64(ts.to_int())
-        nparts = 2
-        while nparts <= self.MAX_SPILL_PARTITIONS:
+
+        def run_pid(fn, scans, np_enc: int, pid_enc: int) -> list:
+            out = fn(scans, tsv, np.int32(np_enc), np.int32(pid_enc))
+            return self._materialize(out, meta).rows
+
+        def pid_rows(fn, scans, nparts: int, pid: int) -> list:
             try:
-                all_rows: list[tuple] = []
-                for pid in range(nparts):
-                    out = jfn(prep.scans, tsv, np.int32(nparts),
-                              np.int32(pid))
-                    part = self._materialize(out, meta)
-                    all_rows.extend(part.rows)
+                return run_pid(fn, scans, nparts, pid)
+            except HashCapacityExceeded:
+                if nparts < self.MAX_SPILL_PARTITIONS:
+                    raise  # outer loop doubles the level-1 fan-out
+                # grace-style recursion (the reference's
+                # hash_based_partitioner): at the level-1 ceiling this
+                # partition's keys collide under the first salt, so
+                # doubling can never separate them — subdivide JUST
+                # this partition under the rotated salt (encoded into
+                # the same (nparts, pid) scalars, ops/hashtable.py)
+                l2 = 2
+                while l2 <= self.MAX_SPILL_PARTITIONS:
+                    try:
+                        rows: list = []
+                        for pid2 in range(l2):
+                            rows.extend(run_pid(
+                                fn, scans, nparts * l2,
+                                pid2 * nparts + pid))
+                        self.metrics.counter(
+                            "exec.spill.grace_subsweeps",
+                            "spill partitions subdivided under a "
+                            "rotated hash past the level-1 ceiling"
+                        ).inc()
+                        return rows
+                    except HashCapacityExceeded:
+                        l2 *= 2
+                raise HashCapacityExceeded(
+                    f"GROUP BY did not fit hash_group_capacity even "
+                    f"at {self.MAX_SPILL_PARTITIONS} spill partitions "
+                    f"x {self.MAX_SPILL_PARTITIONS} rotated-salt "
+                    f"sub-partitions")
+
+        # transient working-set estimate for the unified transfer
+        # budget: one partition's slice of the resident inputs
+        scan_bytes = sum(int(x.nbytes)
+                         for b in prep.scans.values()
+                         for x in jax.tree.leaves(b))
+        nparts = 2
+        while True:
+            try:
+                with self.movement.soft_lease(
+                        "spill", scan_bytes // max(nparts, 1)):
+                    all_rows = self._sweep_spill_partitions(
+                        jfn, decision, prep, nparts, pid_rows, key,
+                        node, meta, cap)
                 break
             except HashCapacityExceeded:
+                if nparts >= self.MAX_SPILL_PARTITIONS:
+                    raise  # grace depth exhausted inside pid_rows
                 nparts *= 2
-        else:
-            raise HashCapacityExceeded(
-                f"GROUP BY did not fit hash_group_capacity even at "
-                f"{self.MAX_SPILL_PARTITIONS} spill partitions")
 
         _prof.note("spill:agg", batches=nparts, rows=len(all_rows))
         rows = all_rows
@@ -154,6 +196,96 @@ class ScanPlaneMixin:
                    if limit_node.limit is not None else None)
             rows = rows[off:end]
         return Result(names=list(meta.names), rows=rows)
+
+    def _sweep_spill_partitions(self, jfn, decision, prep, nparts: int,
+                                pid_rows, key, node, meta, cap) -> list:
+        """Run every spill partition and concatenate rows in pid
+        order. With a distributed decision and a splittable mesh, the
+        sweep fans out over DISJOINT pool sub-meshes (round-10
+        MeshPool) so independent partitions overlap instead of
+        serializing through one device set; any failure to stand up
+        the sub-mesh plane (budget, pool shape) falls back to the
+        serial full-mesh sweep."""
+        subs = None
+        if decision is not None and nparts >= 2:
+            subs = self._submesh_spill_calls(key, node, meta, cap,
+                                             decision)
+        if subs is None:
+            out: list = []
+            for pid in range(nparts):
+                out.extend(pid_rows(jfn, prep.scans, nparts, pid))
+            return out
+        calls, scanses = subs
+        nsub = len(calls)
+        import concurrent.futures as cf
+        results: list = [None] * nparts
+
+        def worker(pid: int) -> list:
+            # fixed pid->sub-mesh assignment: two pids on one sub-mesh
+            # serialize through its FIFO dispatcher; different
+            # sub-meshes run concurrently (disjoint rendezvous
+            # domains, same-mode gate windows)
+            idx = pid % nsub
+            return pid_rows(calls[idx], scanses[idx], nparts, pid)
+
+        with cf.ThreadPoolExecutor(max_workers=nsub) as ex:
+            futs = {pid: ex.submit(worker, pid)
+                    for pid in range(nparts)}
+            err = None
+            for pid, f in futs.items():
+                try:
+                    results[pid] = f.result()
+                except HashCapacityExceeded as e:
+                    err = err or e
+            if err is not None:
+                raise err
+        self.metrics.counter(
+            "exec.spill.submesh_sweeps",
+            "spill partition sweeps fanned out over pool sub-meshes"
+        ).inc()
+        return [r for part in results for r in part]
+
+    def _submesh_spill_calls(self, key, node, meta, cap, decision):
+        """Per-sub-mesh compiled calls + re-resolved device scans for
+        the concurrent spill sweep, cached under the spill exec-cache
+        key. None when the pool can't yield >=2 disjoint sub-meshes
+        or the budget can't hold the per-sub-mesh table copies."""
+        pool = self._submesh_pool()
+        if pool is None:
+            return None
+        sizes = [s for s in sorted(pool.sizes(), reverse=True)
+                 if s >= 2 and pool.count(s) >= 2]
+        if not sizes:
+            return None
+        size = sizes[0]
+        ck = key + ("submesh", size)
+        cached = self._exec_cache.get(ck)
+        if cached is not None:
+            return cached
+        aliases = _collect_scans(node)
+        params = ExecParams(hash_group_capacity=cap,
+                            axis_name=SHARD_AXIS, n_shards=size)
+        runf = compile_plan(node, params, meta)
+        calls = []
+        scanses = []
+        try:
+            for sub in pool.submeshes(size):
+                calls.append(queued_collective_call(
+                    jax.jit(make_distributed_fn(runf, sub, aliases,
+                                                decision)),
+                    metrics=self.metrics, mesh=sub))
+                scanses.append({
+                    alias: self._device_table(
+                        tname,
+                        ("sharded" if alias in decision.sharded
+                         else "replicated"),
+                        cols=None, narrow=False, mesh=sub)
+                    for alias, tname in aliases.items()})
+        except MemoryQuotaError:
+            return None
+        out = (calls, scanses)
+        self._exec_cache_put(ck, out)
+        return out
 
     # -- beyond-HBM streaming ------------------------------------------------
     def _stream_decision(self, node, scan_aliases: dict, scan_cols: dict,
@@ -633,8 +765,18 @@ class ScanPlaneMixin:
                     "exec.stream.prefetch_stall_seconds",
                     "consumer wait per streamed page (0 when the "
                     "prefetch pipeline is ahead of the device)"))
-        return self._metered_pages(it, tname, src.page_bytes,
-                                   stalls=pipeline)
+        metered = self._metered_pages(it, tname, src.page_bytes,
+                                      stalls=pipeline)
+        # the stream's transient working window (the page computing +
+        # the one the prefetch worker holds) charges the unified
+        # movement budget for its lifetime — best-effort, so a tight
+        # budget degrades to observable overcommit, never a failure
+        window = (2 if pipeline else 1) * src.page_bytes
+
+        def leased():
+            with self.movement.soft_lease("page", window):
+                yield from metered
+        return leased()
 
     @staticmethod
     def _metered_pages(it, tname: str, page_bytes: int,
@@ -709,7 +851,7 @@ class ScanPlaneMixin:
     def _evict_device(self, key) -> None:
         with self._device_lock:
             self._device_tables.pop(key, None)
-            self.hbm.release(key)
+            self.movement.release_resident(key)
 
     def drop_device_cache(self) -> None:
         """Evict every resident table upload AND release its memory
@@ -767,7 +909,7 @@ class ScanPlaneMixin:
         nbytes = self._table_device_bytes(td, cols, narrow=narrow_set)
         if placement == "replicated" and mesh is not None:
             nbytes *= mesh.size
-        self.hbm.reserve(key, nbytes)
+        self.movement.reserve_resident(key, nbytes)
         try:
             b = self._batch_from_chunks(td, td.chunks, cols,
                                         narrow=narrow_set)
@@ -776,7 +918,7 @@ class ScanPlaneMixin:
             elif placement == "replicated":
                 b = jax.device_put(b, meshmod.replicated(mesh))
         except BaseException:
-            self.hbm.release(key)
+            self.movement.release_resident(key)
             raise
         # drop now-redundant strict-subset uploads of the same table
         for k in [k for k in self._device_tables

@@ -48,16 +48,41 @@ def _hash_columns(key_cols: tuple, capacity: int) -> jnp.ndarray:
     return (h & jnp.uint32(capacity - 1)).astype(jnp.int32)
 
 
+# level-1 fan-out ceiling for the two-level partition encoding below;
+# must match exec.scanplane.ScanPlaneMixin.MAX_SPILL_PARTITIONS
+PARTITION_L1 = 256
+
+
 def partition_mask(key_cols: tuple, nparts, pid) -> jnp.ndarray:
     """Row mask for hash-partitioned spill recursion: True where
     salted_hash(keys) & (nparts-1) == pid. The salt column decorrelates
     the partition hash from the group-table hash so one partition's
     groups spread over all table slots (cf. the reference's
     hash_based_partitioner using a different hash per recursion level).
-    nparts must be a power of two; nparts==1 keeps every row."""
+    nparts must be a power of two; nparts==1 keeps every row.
+
+    Grace-style recursion rides the SAME two scalars: past the
+    level-1 ceiling (PARTITION_L1), ``nparts = l1 * l2`` encodes a
+    second partitioning level under a ROTATED salt —
+    ``pid = pid2 * l1 + pid1`` selects level-1 bucket pid1 AND
+    level-2 bucket pid2. Keys that collide under the first salt
+    (doubling can never separate them) re-spread under the second,
+    so an overflowing partition subdivides instead of raising. Both
+    levels are traced arithmetic: the compiled program is unchanged
+    across depths, and nparts <= PARTITION_L1 makes the second mask
+    trivially all-True (l2 == 1)."""
+    np_ = jnp.int32(nparts)
+    l1 = jnp.minimum(np_, jnp.int32(PARTITION_L1))
+    l2 = np_ // l1
+    pid1 = jnp.int32(pid) & (l1 - 1)
+    pid2 = jnp.int32(pid) // l1
     salt = jnp.full(key_cols[0].shape, 0x85EBCA6B, dtype=jnp.int32)
     h = _hash_columns(tuple(key_cols) + (salt,), 1 << 16)
-    return (h & (jnp.int32(nparts) - 1)) == jnp.int32(pid)
+    m = (h & (l1 - 1)) == pid1
+    # rotated-salt level: murmur3's other mixing constant
+    salt2 = jnp.full(key_cols[0].shape, 0x5C2B2AE3, dtype=jnp.int32)
+    h2 = _hash_columns(tuple(key_cols) + (salt2,), 1 << 16)
+    return m & ((h2 & (l2 - 1)) == pid2)
 
 
 @dataclass(frozen=True)

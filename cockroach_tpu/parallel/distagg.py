@@ -351,7 +351,8 @@ def _dispatcher_for(mesh) -> _MeshDispatcher:
         return d
 
 
-def queued_collective_call(jfn, metrics=None, mesh=None):
+def queued_collective_call(jfn, metrics=None, mesh=None,
+                           movement=None, lease_bytes: int = 0):
     """Wrap a jitted multi-device callable so concurrent sessions
     cannot interleave collective rendezvous (deadlock otherwise —
     this must wrap the CALL: a lock inside the traced function would
@@ -388,6 +389,17 @@ def queued_collective_call(jfn, metrics=None, mesh=None):
 
     @functools.wraps(jfn)
     def call(*args, **kwargs):
+        # unified transfer budget (exec/movement.py): a collective
+        # dispatch's shuffle/exchange working buffers ride a soft
+        # lease — admitted when the pool has room, observable
+        # overcommit when it doesn't (the buffers allocate inside XLA
+        # either way)
+        if movement is not None and lease_bytes > 0:
+            with movement.soft_lease("exchange", lease_bytes):
+                return _call_inner(*args, **kwargs)
+        return _call_inner(*args, **kwargs)
+
+    def _call_inner(*args, **kwargs):
         t0 = _time.monotonic()
         try:
             # ICI-path fault hook (install_ici_faults): evaluated

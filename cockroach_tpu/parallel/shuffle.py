@@ -30,11 +30,37 @@ the hash table uses for capacity overflow.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
 from ..ops.hashtable import _hash_columns
 from .mesh import SHARD_AXIS
+
+
+class _ByteTally:
+    """Thread-safe trace-time byte counter (the groupagg._KernelTally
+    discipline): bumped inside jit-traced bodies, so it counts the
+    bytes a TRACED exchange moves per shard per execution of that
+    program build — the engine exposes it through the
+    ``exec.movement.*`` family as the shuffle plane's contribution to
+    the unified transfer budget."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def bump(self, nbytes: int) -> None:
+        with self._lock:
+            self._bytes += int(nbytes)
+
+    def value(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+EXCHANGE_TRACED = _ByteTally()
 
 # ---------------------------------------------------------------------------
 # per-link fault injection
@@ -128,6 +154,11 @@ def exchange(dest: jnp.ndarray, valid: jnp.ndarray, n_shards: int,
     (source shard, local order). Output length n_shards * cap."""
     packed, pvalid, overflow = pack_for_exchange(
         dest, valid, n_shards, cap, arrays)
+    # unified transfer accounting: the all_to_all lives inside the
+    # XLA program (no host hook per execution), so tally its buffer
+    # footprint at trace time — n_shards*cap rows per payload column
+    EXCHANGE_TRACED.bump(sum(int(p.size) * p.dtype.itemsize
+                             for p in packed))
 
     def a2a(x):
         return jax.lax.all_to_all(x, axis, split_axis=0,

@@ -170,6 +170,32 @@ class TestCompileCachePlumbing:
         eng = Engine()
         assert eng.prewarm() == 0  # setting defaults to 0
 
+    def test_journal_replays_session_vars(self, tmp_path, monkeypatch):
+        # a statement that compiled under non-default plan-key vars
+        # journals them; prewarm re-prepares under the SAME vars, so
+        # the session that set them gets a plan-cache hit after the
+        # simulated restart instead of a recompile at defaults
+        monkeypatch.setenv("COCKROACH_TPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "jv"))
+        eng = Engine()
+        eng.execute("CREATE TABLE jv (k INT, v INT)")
+        eng.execute("INSERT INTO jv VALUES (1, 10), (2, 20), (3, 30)")
+        s = eng.session()
+        s.vars.set("hash_group_capacity", 4096)
+        s.vars.set("pallas_groupagg", "off")
+        sql = "SELECT k, sum(v) FROM jv GROUP BY k"
+        want = eng.execute(sql, s).rows
+        vars_of = {e[0]: e[2] for e in coldstart.journal_entries(
+            eng._compile_cache_dir, 10)}
+        assert vars_of[sql] == {"hash_group_capacity": 4096,
+                                "pallas_groupagg": "off"}
+        eng._exec_cache.clear()
+        assert eng.prewarm(top_k=10) >= 1
+        hits = eng.metrics.snapshot().get("sql.plan.cache.hit", 0)
+        assert eng.execute(sql, s).rows == want
+        assert eng.metrics.snapshot().get(
+            "sql.plan.cache.hit", 0) > hits
+
 
 # ------------------------------------------------- bounded cache policy
 

@@ -503,8 +503,8 @@ class TestPrewarmStreamed:
         coldstart.journal_record(d, "SELECT 1", bucket=2048)
         coldstart.journal_record(d, "SELECT 2", bucket=0)
         ents = coldstart.journal_entries(d, 10)
-        assert ("SELECT 1", 2048) in ents
-        assert ("SELECT 2", 0) in ents
+        assert ("SELECT 1", 2048, {}) in ents
+        assert ("SELECT 2", 0, {}) in ents
         # back-compat: journal_top still returns bare texts
         assert "SELECT 1" in coldstart.journal_top(d, 10)
 

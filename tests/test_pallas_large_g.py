@@ -318,6 +318,67 @@ class TestEngineEligibility:
             assert want in snap
 
 
+class TestParityGatePromotion:
+    """The fuzzed parity gate (ops/pallas/paritygate.py) promotes
+    measured-exact kernel paths into `auto`; everything else stays
+    `on`-gated. auto == off bit-parity is the invariant throughout."""
+
+    def test_gate_promotes_int_minmax_not_float_sum(self, tmp_path):
+        from cockroach_tpu.ops.pallas import paritygate as pgate
+        got = pgate.fuzz("cpu", str(tmp_path), interpret=True)
+        assert "int_minmax" in got, \
+            "hi-limb MIN/MAX + XLA refinement must fuzz bit-exact"
+        assert "float_sum" not in got, \
+            "f32 accumulation cannot bit-match the f64 oracle"
+        # verdict persisted in the autotune-style backend table
+        assert pgate.load_table(str(tmp_path))["cpu"]["exact"] == \
+            ["int_minmax"]
+
+    def test_corrupt_table_demotes_everything(self, tmp_path):
+        from cockroach_tpu.ops.pallas import paritygate as pgate
+        with open(pgate.table_path(str(tmp_path)), "w") as f:
+            f.write("{not json")
+        assert pgate.load_table(str(tmp_path)) == {}
+
+    def test_int_minmax_rides_kernel_under_auto_bit_exact(self, teng):
+        # adjacent giant int64 values: a plain f32 kernel MIN/MAX
+        # would collapse them (2^40 + k all round to the same float),
+        # so bit-parity here proves the hi-limb + dtype-preserving
+        # refinement actually ran end to end
+        teng.execute("CREATE TABLE mmx (g INT8 NOT NULL, v INT8)")
+        rng = np.random.default_rng(77)
+        n = 8192
+        gk = rng.integers(0, 64, n).astype(np.int64)
+        v = (np.int64(1) << 40) + rng.integers(
+            -1000, 1000, n).astype(np.int64)
+        v[rng.random(n) < 0.5] *= -1
+        teng.store.insert_columns("mmx", {"g": gk, "v": v},
+                                  teng.clock.now())
+        sql = ("SELECT g, min(v) AS mn, max(v) AS mx FROM mmx "
+               "GROUP BY g ORDER BY g")
+        s = _local_session(teng)
+        s.vars.set("pallas_groupagg", "off")
+        want = teng.execute(sql, session=s).rows
+        before = pg.BUILDS.value("large")
+        s.vars.set("pallas_groupagg", "auto")
+        got = teng.execute(sql, session=s).rows
+        assert pg.BUILDS.value("large") > before, \
+            "promoted int MIN/MAX did not route to the large kernel"
+        assert got == want
+        # spot-check one group against numpy to catch a both-arms bug
+        g0 = int(got[0][0])
+        m = gk == g0
+        assert got[0][1:] == (int(v[m].min()), int(v[m].max()))
+
+    def test_paritygate_metrics_exported(self, teng):
+        snap = teng.metrics.snapshot()
+        for want in ("exec.paritygate.checks",
+                     "exec.paritygate.seconds",
+                     "exec.paritygate.table_hit",
+                     "exec.paritygate.table_miss"):
+            assert want in snap
+
+
 class TestNoScatterHLO:
     """The acceptance bar: under auto the compiled program for an
     eligible GROUP BY contains no input-width aggregation scatters;

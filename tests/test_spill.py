@@ -11,9 +11,8 @@ groups must pass.
 """
 
 import numpy as np
-import pytest
 
-from cockroach_tpu.exec.engine import Engine, HashCapacityExceeded
+from cockroach_tpu.exec.engine import Engine
 
 
 def _mk(n_rows: int, n_keys: int, distsql="off") -> tuple:
@@ -74,8 +73,29 @@ class TestSpill:
             assert rs[:4] == rd[:4]
             assert abs(rs[4] - rd[4]) < 1e-9
 
-    def test_unspillable_beyond_max_partitions(self):
-        eng, s, k, v = _mk(60_000, 40_000)
-        s.vars.set("hash_group_capacity", 64)  # 64*256 < 40_000
-        with pytest.raises(HashCapacityExceeded, match="spill partitions"):
-            eng.execute("SELECT k, sum(v) AS sv FROM sp GROUP BY k", s)
+    def test_grace_recursion_beyond_max_partitions(self, monkeypatch):
+        """capacity * MAX_SPILL_PARTITIONS < distinct groups: doubling
+        alone can never fit a partition, so the sweep must subdivide
+        overflowing partitions under the rotated-salt second hash level
+        (ops/hashtable.partition_mask) instead of raising. The coupled
+        level-1 ceilings shrink 256 -> 8 so recursion triggers at a
+        tier-1-sized sweep instead of a 150s one (at the real ceiling
+        the arithmetic is identical — nparts and pid stay two traced
+        scalars at every depth)."""
+        from cockroach_tpu.exec import scanplane
+        from cockroach_tpu.ops import hashtable
+        monkeypatch.setattr(scanplane.ScanPlaneMixin,
+                            "MAX_SPILL_PARTITIONS", 8)
+        monkeypatch.setattr(hashtable, "PARTITION_L1", 8)
+        eng, s, k, v = _mk(3_000, 1_200)
+        s.vars.set("hash_group_capacity", 64)  # 64*8 < 1_200
+        r = eng.execute(
+            "SELECT k, sum(v) AS gsv FROM sp GROUP BY k", s)
+        distinct = np.unique(k)
+        assert len(r.rows) == len(distinct)
+        assert eng.metrics.snapshot().get(
+            "exec.spill.grace_subsweeps", 0) > 0
+        got = {row[0]: row[1] for row in r.rows}
+        for key in (int(distinct[0]), int(distinct[234]),
+                    int(distinct[-1])):
+            assert got[key] == int(v[k == key].sum())
