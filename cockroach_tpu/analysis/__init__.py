@@ -1,0 +1,39 @@
+"""graftlint: AST-based invariant analysis for the cockroach_tpu tree.
+
+Thirteen PRs each re-discovered the same hazard classes at runtime:
+`jnp.asarray` zero-copy aliasing corrupted streamed pages, concurrent
+collective executions deadlocked the XLA host rendezvous, bare module
+globals raced under concurrent sessions, and plan-key-changing session
+vars silently missed the plan cache key. The reference encodes exactly
+this shape of rule statically (pkg/testutils/lint walks the AST to ban
+hazardous call patterns repo-wide); this package does the same for the
+invariants this repo learned the hard way.
+
+Layout:
+
+- ``core``                — module index, call graph, thread-role
+                            classification, waiver parsing
+- ``rules_device``        — no-aliasing-upload, collective-discipline
+- ``rules_concurrency``   — racy-global, blocking-under-lock
+- ``rules_plan``          — plan-key-completeness
+- ``rules_registration``  — registration-drift (metrics, settings,
+                            session vars, HTTP endpoints)
+- ``runner``              — rule registry, file discovery, output
+
+Run it::
+
+    python -m cockroach_tpu.analysis            # human output
+    python -m cockroach_tpu.analysis --json     # machine output
+    python -m cockroach_tpu.analysis --changed-only   # git-diff scope
+
+Waive a finding in place, always with a reason::
+
+    x = jnp.asarray(buf)  # graftlint: waive[no-aliasing-upload] fresh
+                          # buffer from np.concatenate, nothing aliases
+
+An empty reason is itself a finding (``waiver-syntax``), so waivers
+stay auditable. See STATIC_ANALYSIS.md for the rule-by-rule history.
+"""
+
+from .core import Finding, ModuleIndex  # noqa: F401
+from .runner import RULES, run, render_human, render_json  # noqa: F401

@@ -117,6 +117,8 @@ def _arrays_to_batch(chunks, columns, string_cols, shared_dict):
     data["_mvcc_ts"] = np.zeros(n, dtype=np.int64)
     data["_mvcc_del"] = np.full(n, np.iinfo(np.int64).max,
                                 dtype=np.int64)
+    # graftlint: waive[no-aliasing-upload] data/vmask/sel are fresh
+    # np.concatenate/np.zeros buffers built above; no later writes
     return ColumnBatch.from_dict(
         {k: jnp.asarray(v) for k, v in data.items()},
         {k: jnp.asarray(v) for k, v in vmask.items()},
@@ -1770,6 +1772,8 @@ class Gateway:
         data["_mvcc_ts"] = np.zeros(n, dtype=np.int64)
         data["_mvcc_del"] = np.full(n, np.iinfo(np.int64).max,
                                     dtype=np.int64)
+        # graftlint: waive[no-aliasing-upload] data/vmask/sel are fresh
+        # np.concatenate/np.zeros buffers built above; no later writes
         batch = ColumnBatch.from_dict(
             {k: jnp.asarray(v) for k, v in data.items()},
             {k: jnp.asarray(v) for k, v in vmask.items()},

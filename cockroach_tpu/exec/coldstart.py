@@ -67,6 +67,15 @@ PREWARMED = 0  # statements re-prepared by Engine.prewarm
 _TLS = threading.local()
 
 
+def note_prewarmed() -> None:
+    """Locked bump of the prewarm tally: engines prewarm on their own
+    threads (tests run several engines in-process), and an unlocked
+    cross-module ``PREWARMED += 1`` loses increments."""
+    global PREWARMED
+    with _LOCK:
+        PREWARMED += 1
+
+
 def cache_hits() -> int:
     return _HITS
 

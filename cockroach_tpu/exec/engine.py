@@ -257,6 +257,10 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         self._mesh_pool = None
         self._mesh_pool_lock = threading.Lock()
         self._device_tables: dict[tuple, ColumnBatch] = {}
+        # coarse (name, placement, devids, narrow) -> Event for uploads
+        # in flight: non-owners wait on the event OUTSIDE _device_lock
+        # so the host->device transfer never runs under the cache lock
+        self._device_inflight: dict[tuple, threading.Event] = {}
         self._exec_cache: dict[tuple, tuple] = {}
         self._parse_cache: dict[str, object] = {}
         # SELECT texts proven view-free/subquery-free: the "_plain"
@@ -411,6 +415,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         self.admission.wait_observer = self.metrics.histogram(
             "admission.wait_seconds",
             "admission queue wait per queued grant (s)").observe
+        # transfer-stall back-pressure: when the p99 of
+        # exec.movement.wait_seconds crosses the shed threshold, the
+        # interconnect is saturated and low-priority statements shed
+        # before queueing (ROADMAP follow-up: the histogram was
+        # recorded but nothing shed on it)
+        self.admission.movement_wait_p99 = (
+            lambda: self.movement.m_wait.quantile(0.99))
         self._admission_settings()
         self.settings.on_change(
             lambda n, v: self._admission_settings()
@@ -614,7 +625,7 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 else:
                     jax.block_until_ready(prep.dispatch())
                 warmed += 1
-                coldstart.PREWARMED += 1
+                coldstart.note_prewarmed()
             except Exception:
                 continue
         return warmed

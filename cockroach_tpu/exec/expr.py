@@ -71,7 +71,7 @@ def compile_expr(e: BExpr) -> CompiledExpr:
         def f_param(ctx):
             # runtime scalar (statement-shape plan cache): same dtype
             # and broadcast semantics as the baked f_const above
-            v = jnp.asarray(ctx.params[idx], dtype=_np_dtype(pty))
+            v = jnp.array(ctx.params[idx], dtype=_np_dtype(pty))
             d = jnp.broadcast_to(v, (ctx.n,))
             return d, jnp.ones((ctx.n,), dtype=jnp.bool_)
         return f_param
@@ -242,7 +242,11 @@ def compile_expr(e: BExpr) -> CompiledExpr:
 
         def f_gather(ctx):
             d, v = xf(ctx)
-            lut = jnp.asarray(tbl)
+            # jnp.array, not asarray: tbl can alias the dictionary's
+            # live array, and an aliased trace constant is only safe
+            # by a distant append-only argument (graftlint
+            # no-aliasing-upload)
+            lut = jnp.array(tbl)
             codes = jnp.clip(d, 0, tbl.shape[0] - 1)
             if ntbl is not None:
                 v = v & _small_lut(ntbl, codes)
@@ -293,11 +297,15 @@ def _small_lut(tbl: np.ndarray, codes):
             and np.abs(tbl).max() >= (1 << 24)):
         # f32 holds integers exactly only below 2^24: big remap values
         # (SF100-class target dictionaries) stay on the gather path
-        return jnp.asarray(tbl)[codes]
+        # (jnp.array: tbl is caller-owned, copy rather than alias —
+        # graftlint no-aliasing-upload)
+        return jnp.array(tbl)[codes]
     lp = max(128, 1 << (L - 1).bit_length())
     padded = np.zeros((lp,), dtype=np.float32)
     padded[:L] = tbl.astype(np.float32)
     oh = jax.nn.one_hot(codes, lp, dtype=jnp.float32)
+    # graftlint: waive[no-aliasing-upload] padded is np.zeros allocated
+    # two lines up, function-local and never written after this point
     out = oh @ jnp.asarray(padded)
     if tbl.dtype == np.bool_:
         return out > 0.5

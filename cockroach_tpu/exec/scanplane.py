@@ -7,6 +7,7 @@ module's docstring for the overall execution model."""
 
 
 import datetime
+import threading
 from typing import Optional
 
 import jax
@@ -863,15 +864,15 @@ class ScanPlaneMixin:
     def _device_table(self, name: str, placement: str = "single",
                       cols: frozenset | None = None,
                       narrow: bool = True, mesh=None) -> ColumnBatch:
-        with self._device_lock:
-            return self._device_table_locked(name, placement, cols,
-                                             narrow, mesh)
+        """Resident device copy of ``name`` — cached, or uploaded now.
 
-    def _device_table_locked(self, name: str, placement: str = "single",
-                             cols: frozenset | None = None,
-                             narrow: bool = True,
-                             mesh=None) -> ColumnBatch:
-        td = self.store.table(name)
+        The cache lock guards only dict state. The expensive part
+        (host assembly + jax.device_put, tens of ms for a large
+        table) runs OUTSIDE ``_device_lock`` behind a per-(table,
+        placement) in-flight event, so concurrent statements needing
+        OTHER tables — or a cached hit on this one — never convoy
+        behind a PCIe transfer, and two statements needing the SAME
+        cold table produce one upload, not two."""
         # the target mesh is part of the upload's identity: sub-mesh
         # dispatch (parallel/mesh.py MeshPool) shards/replicates the
         # same table over different device subsets, and a batch placed
@@ -881,22 +882,66 @@ class ScanPlaneMixin:
         else:
             mesh = mesh if mesh is not None else self.mesh
             devids = tuple(int(d.id) for d in mesh.devices.flat)
-        # a cached upload with a SUPERSET of the needed columns serves
-        # this scan directly (scans read columns by name); this keeps
-        # one resident copy per table instead of one per column set.
-        # The narrow flag is part of the identity: a wide consumer
-        # (DistSQL workers compile without the upcast) must never be
-        # served an int32-narrowed upload
+        flight = (name, placement, devids, narrow)
+        while True:
+            with self._device_lock:
+                td = self.store.table(name)
+                hit = self._device_lookup_locked(
+                    name, td.generation, placement, devids, narrow,
+                    cols)
+                if hit is not None:
+                    return hit
+                ev = self._device_inflight.get(flight)
+                if ev is None:
+                    ev = threading.Event()
+                    self._device_inflight[flight] = ev
+                    break  # this thread owns the upload
+            # another thread is uploading this table: wait without the
+            # lock, then retry the lookup (the timeout only bounds the
+            # re-check; a failed owner clears the event in its finally
+            # and the retrier becomes the new owner)
+            ev.wait(timeout=5.0)
+        try:
+            return self._device_upload(name, td, placement, cols,
+                                       narrow, mesh, devids)
+        finally:
+            with self._device_lock:
+                self._device_inflight.pop(flight, None)
+            ev.set()
+
+    def _device_lookup_locked(self, name: str, generation,
+                              placement: str, devids: tuple,
+                              narrow: bool,
+                              cols: frozenset | None):
+        """Cache probe; caller holds ``_device_lock``. A cached upload
+        with a SUPERSET of the needed columns serves this scan
+        directly (scans read columns by name); this keeps one resident
+        copy per table instead of one per column set. The narrow flag
+        is part of the identity: a wide consumer (DistSQL workers
+        compile without the upcast) must never be served an
+        int32-narrowed upload."""
         for k, v in self._device_tables.items():
-            if (k[0] == name and k[1] == td.generation
+            if (k[0] == name and k[1] == generation
                     and k[2] == placement and k[4] == narrow
                     and k[5] == devids
                     and (k[3] is None
                          or (cols is not None and cols <= k[3]))):
                 return v
+        return None
+
+    def _device_upload(self, name: str, td, placement: str,
+                       cols: frozenset | None, narrow: bool, mesh,
+                       devids: tuple) -> ColumnBatch:
+        """Assemble and upload one resident table copy. Runs with NO
+        lock held (graftlint blocking-under-lock: the original
+        held ``_device_lock`` across seal + host assembly +
+        jax.device_put, serializing every concurrent scan behind one
+        upload); only the final cache insert re-takes the lock."""
         # evict stale generations of this table
-        for k in [k for k in self._device_tables if k[0] == name
-                  and k[1] != td.generation]:
+        with self._device_lock:
+            stale = [k for k in self._device_tables if k[0] == name
+                     and k[1] != td.generation]
+        for k in stale:
             self._evict_device(k)
         if td.open_ts:
             self.store.seal(name)
@@ -921,13 +966,16 @@ class ScanPlaneMixin:
             self.movement.release_resident(key)
             raise
         # drop now-redundant strict-subset uploads of the same table
-        for k in [k for k in self._device_tables
-                  if k[0] == name and k[1] == td.generation
-                  and k[2] == placement and k[5] == devids
-                  and k[3] is not None
-                  and (cols is None or k[3] < cols)]:
+        with self._device_lock:
+            subsets = [k for k in self._device_tables
+                       if k[0] == name and k[1] == td.generation
+                       and k[2] == placement and k[5] == devids
+                       and k[3] is not None
+                       and (cols is None or k[3] < cols)]
+        for k in subsets:
             self._evict_device(k)
-        self._device_tables[key] = b
+        with self._device_lock:
+            self._device_tables[key] = b
         self.metrics.counter("sql.device.table_uploads",
                              "resident table uploads to HBM").inc()
         self.metrics.counter(
@@ -1008,6 +1056,8 @@ class ScanPlaneMixin:
         # padding rows are never visible: created at +inf
         cols["_mvcc_ts"] = _pad(mts, padded, fill=np.int64(2**62))
         cols["_mvcc_del"] = _pad(mdl, padded, fill=np.int64(0))
+        # graftlint: waive[no-aliasing-upload] cols/valid hold fresh
+        # np.concatenate/_pad outputs built above; no later writes
         return ColumnBatch.from_dict(
             {k: jnp.asarray(v) for k, v in cols.items()},
             {k: jnp.asarray(v) for k, v in valid.items()})

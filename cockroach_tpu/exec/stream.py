@@ -453,6 +453,9 @@ class PageSource:
         batch = ColumnBatch.from_dict(
             {cn: jnp.array(bufs[cn])  # copy=True: see __init__
              for cn in (*self.names, "_mvcc_ts", "_mvcc_del")},
+            # graftlint: waive[no-aliasing-upload] every vmap value is a
+            # vbuf np.ones freshly allocated by _gather_into/_assemble
+            # for this page; nothing writes it after this conversion
             {cn: jnp.asarray(v) for cn, v in vmap.items()})
         if self._m_pages is not None:
             self._m_pages.inc()
@@ -516,6 +519,9 @@ class PageSource:
         return ColumnBatch.from_dict(
             {cn: jnp.array(bufs[cn])  # copy=True: see __init__
              for cn in (*self.names, "_mvcc_ts", "_mvcc_del")},
+            # graftlint: waive[no-aliasing-upload] every vmap value is a
+            # vbuf np.ones freshly allocated by _gather_into/_assemble
+            # for this page; nothing writes it after this conversion
             {cn: jnp.asarray(v) for cn, v in vmap.items()})
 
     def gather_pages(self, idx: np.ndarray):
@@ -528,6 +534,9 @@ class PageSource:
             yield ColumnBatch.from_dict(
                 {cn: jnp.array(self._bufs[cn])
                  for cn in (*self.names, "_mvcc_ts", "_mvcc_del")},
+                # graftlint: waive[no-aliasing-upload] vmap values are
+                # per-call np.ones buffers (only self._bufs is reused,
+                # and those go through the jnp.array copy above)
                 {cn: jnp.asarray(v) for cn, v in vmap.items()})
 
     def empty_page(self):
@@ -541,6 +550,8 @@ class PageSource:
                                    dtype=np.int64)
         cols["_mvcc_del"] = np.zeros(self.page_rows, dtype=np.int64)
         return ColumnBatch.from_dict(
+            # graftlint: waive[no-aliasing-upload] cols are np.zeros/
+            # np.full allocated three lines up, never written again
             {cn: jnp.asarray(v) for cn, v in cols.items()}, {})
 
 

@@ -191,7 +191,11 @@ def autotune(backend: str, root: str | None, interpret: bool,
         timings[f"{gt}x{br}w{cap}"] = dt
         if dt < best_t:
             best, best_t = (gt, br, cap), dt
-    SECONDS[0] += time.perf_counter() - t_sweep
+    # two sessions autotuning different backends sweep concurrently;
+    # an unlocked read-modify-write here loses increments (graftlint
+    # racy-global)
+    with _LOCK:
+        SECONDS[0] += time.perf_counter() - t_sweep
     if root:
         _save(root, backend, best, timings)
     return best

@@ -196,7 +196,10 @@ def fuzz(backend: str, root: str | None,
         CHECKS.bump("exact" if ok else "approx")
         if ok:
             exact.append(path)
-    SECONDS[0] += time.perf_counter() - t0
+    # concurrent sessions can fuzz different backends; the unlocked
+    # read-modify-write loses increments (graftlint racy-global)
+    with _LOCK:
+        SECONDS[0] += time.perf_counter() - t0
     out = tuple(exact)
     if root:
         _save(root, backend, out)

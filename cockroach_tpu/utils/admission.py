@@ -73,6 +73,15 @@ class AdmissionController:
         # optional hook: called with the grant wait in seconds for
         # every admission that had to queue (engine wires a histogram)
         self.wait_observer = None
+        # optional hook: () -> p99 seconds of the data-movement wait
+        # histogram (exec.movement.wait_seconds). When it crosses
+        # shed_wait_seconds, the device interconnect is the bottleneck
+        # — queueing MORE low-priority work only grows transfer-stall
+        # p99 — so shedding triggers even while the grant-wait EWMA
+        # still looks healthy. Never called under _mu by callers; we
+        # call it inside _should_shed_locked, so it must not call back
+        # into this controller.
+        self.movement_wait_p99 = None
 
     def set_weight(self, tenant: str, weight: float) -> None:
         with self._mu:
@@ -137,6 +146,13 @@ class AdmissionController:
             return True
         if self.shed_wait_seconds and self._wait_ewma >= self.shed_wait_seconds:
             return True
+        if self.shed_wait_seconds and self.movement_wait_p99 is not None:
+            try:
+                p99 = self.movement_wait_p99()
+            except Exception:
+                p99 = None  # a broken signal must not wedge admission
+            if p99 is not None and p99 >= self.shed_wait_seconds:
+                return True
         return False
 
     def release(self) -> None:

@@ -15,11 +15,17 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 
 _seed = os.environ.get("COCKROACH_TPU_METAMORPHIC")
 _rng = random.Random(int(_seed)) if _seed else None
 
 chosen: dict[str, object] = {}
+
+# two threads first-touching the same knob would each draw from _rng
+# and could adopt DIFFERENT "constants" for one name (graftlint
+# racy-global); the check-and-draw must be atomic
+_CHOSEN_LOCK = threading.Lock()
 
 
 def is_active() -> bool:
@@ -30,9 +36,10 @@ def metamorphic_int(name: str, default: int, lo: int, hi: int) -> int:
     """A constant in [lo, hi]; `default` in production."""
     if _rng is None:
         return default
-    if name not in chosen:
-        chosen[name] = _rng.randint(lo, hi)
-    return chosen[name]
+    with _CHOSEN_LOCK:
+        if name not in chosen:
+            chosen[name] = _rng.randint(lo, hi)
+        return chosen[name]
 
 
 def metamorphic_pow2(name: str, default: int, lo_bits: int,
@@ -40,14 +47,16 @@ def metamorphic_pow2(name: str, default: int, lo_bits: int,
     """A power-of-two constant in [2^lo_bits, 2^hi_bits]."""
     if _rng is None:
         return default
-    if name not in chosen:
-        chosen[name] = 1 << _rng.randint(lo_bits, hi_bits)
-    return chosen[name]
+    with _CHOSEN_LOCK:
+        if name not in chosen:
+            chosen[name] = 1 << _rng.randint(lo_bits, hi_bits)
+        return chosen[name]
 
 
 def metamorphic_bool(name: str, default: bool) -> bool:
     if _rng is None:
         return default
-    if name not in chosen:
-        chosen[name] = _rng.random() < 0.5
-    return chosen[name]
+    with _CHOSEN_LOCK:
+        if name not in chosen:
+            chosen[name] = _rng.random() < 0.5
+        return chosen[name]

@@ -253,6 +253,19 @@ class SessionVars:
         # selects, conservative bail-out when a literal shapes the
         # plan; off: text keying (escape hatch / bench A/B lever)
         "plan_shape_cache": "auto",  # auto | off
+        # memo-based join ordering / rule pipeline / sketch-fed
+        # costing (off = syntax order, no rewrites, ANALYZE-only
+        # stats). Registered with the same defaults the read sites
+        # fall back to — graftlint registration-drift found these
+        # read-but-unregistered (invisible to SHOW and the journal)
+        "optimizer": "on",           # on | off
+        "optimizer_rules": "on",     # on | off
+        "optimizer_sketch_stats": "on",   # on | off
+        # secondary-index locator plane (exec/fastpath.py,
+        # exec/oltplane.py): index scans and the per-key row limit
+        # past which a warm locator declines in favor of the scan
+        "index_scan": "on",          # on | off
+        "index_lookup_limit": 4096,
         # admission tier for this session's statements (the reference's
         # admission.WorkPriority): high | normal | low
         "admission_priority": "normal",

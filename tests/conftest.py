@@ -48,6 +48,9 @@ def pytest_configure(config):
     # deselection is declared, not a typo (PytestUnknownMarkWarning)
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "graftlint: static-analysis gate tests "
+        "(python -m cockroach_tpu.analysis); select with -m graftlint")
 
 
 @pytest.fixture(autouse=True)

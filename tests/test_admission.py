@@ -155,6 +155,53 @@ class TestLoadShed:
         assert a.shed == 0
         a.release()
 
+    def test_shed_on_movement_wait_p99(self):
+        # exec.movement.wait_seconds p99 over the shed threshold:
+        # the interconnect is saturated, low-priority work sheds even
+        # while the grant-wait EWMA still looks healthy
+        a = AdmissionController(slots=1, max_queue=8)
+        a.shed_wait_seconds = 0.5
+        a.movement_wait_p99 = lambda: 0.9
+        a.acquire()
+        assert a._wait_ewma == 0.0  # the EWMA alone would not shed
+        with pytest.raises(AdmissionRejected, match="load shed"):
+            a.acquire(priority="low", timeout=10)
+        assert a.shed == 1
+        # normal priority queues through the pressure, never sheds
+        with pytest.raises(AdmissionRejected, match="exceeded"):
+            a.acquire(priority="normal", timeout=0.05)
+        a.release()
+
+    def test_movement_p99_below_threshold_admits(self):
+        a = AdmissionController(slots=1, max_queue=8)
+        a.shed_wait_seconds = 0.5
+        a.movement_wait_p99 = lambda: 0.1
+        a.acquire()
+        with pytest.raises(AdmissionRejected, match="exceeded"):
+            a.acquire(priority="low", timeout=0.05)  # queued, no shed
+        assert a.shed == 0
+        a.release()
+
+    def test_broken_movement_signal_does_not_wedge(self):
+        def boom():
+            raise RuntimeError("histogram gone")
+        a = AdmissionController(slots=1, max_queue=8)
+        a.shed_wait_seconds = 0.5
+        a.movement_wait_p99 = boom
+        a.acquire()
+        with pytest.raises(AdmissionRejected, match="exceeded"):
+            a.acquire(priority="low", timeout=0.05)
+        assert a.shed == 0
+        a.release()
+
+    def test_engine_wires_movement_p99(self):
+        from cockroach_tpu.exec.engine import Engine
+        e = Engine()
+        assert e.admission.movement_wait_p99 is not None
+        assert e.admission.movement_wait_p99() == 0.0
+        e.movement.m_wait.observe(3.0)
+        assert e.admission.movement_wait_p99() > 0.0
+
 
 class TestTimeoutAudit:
     def test_timed_out_waiter_leaves_the_queue(self):

@@ -12,43 +12,45 @@
 3. Doc-drift lints against OBSERVABILITY.md: every registered metric
    family must appear in its metric-families table, and every HTTP
    path served by server/node.py must appear in its endpoint table.
+
+The scans themselves moved to cockroach_tpu/analysis/rules_registration
+(this file's original regexes generalized into AST visitors on the
+graftlint module index, which also powers the registration-drift rule
+in ``python -m cockroach_tpu.analysis``); the assertions here are
+unchanged and keep pinning the same invariants.
 """
 
-import pathlib
 import re
 
+import pytest
+
+from cockroach_tpu.analysis import ModuleIndex
+from cockroach_tpu.analysis.rules_registration import (
+    _CODE_SPAN, documented_endpoints, documented_families,
+    metric_registrations, repo_root, served_endpoints)
 from cockroach_tpu.utils.metric import MetricRegistry
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+REPO = repo_root()
 OBSERVABILITY = (REPO / "OBSERVABILITY.md").read_text()
 
-# .counter("name") / .func_gauge(f"name.{x}") ... across line breaks
-_REG_RE = re.compile(
-    r"\.(counter|gauge|histogram|func_counter|func_gauge)"
-    r"\(\s*(f?)[\"']([^\"']+)[\"']")
+
+@pytest.fixture(scope="module")
+def index():
+    return ModuleIndex.build(REPO)
 
 
-def _registrations():
-    """(file, kind-family, name) for every literal registration;
-    f-string placeholders collapse to '0' so dynamic per-peer names
-    lint like their static shape."""
-    out = []
-    for p in sorted((REPO / "cockroach_tpu").rglob("*.py")):
-        for m in _REG_RE.finditer(p.read_text()):
-            kind, isf, name = m.group(1), m.group(2), m.group(3)
-            if isf:
-                name = re.sub(r"\{[^}]*\}", "0", name)
-            family = ("counter" if "counter" in kind
-                      else "gauge" if "gauge" in kind
-                      else "histogram")
-            out.append((str(p.relative_to(REPO)), family, name))
-    return out
+@pytest.fixture(scope="module")
+def registrations(index):
+    """(file, kind-family, name) triples, as the old regex scan
+    returned them; f-string placeholders collapse to '0' so dynamic
+    per-peer names lint like their static shape."""
+    return [(rel, family, name)
+            for rel, family, name, _lineno in metric_registrations(index)]
 
 
 class TestStaticNameLint:
-    def test_scan_finds_the_registry(self):
-        regs = _registrations()
-        names = {n for _, _, n in regs}
+    def test_scan_finds_the_registry(self, registrations):
+        names = {n for _, _, n in registrations}
         # the scan must keep seeing the core families — an empty scan
         # would vacuously pass everything below
         assert len(names) >= 20
@@ -57,57 +59,22 @@ class TestStaticNameLint:
                        "sql.exec.latency"):
             assert expect in names, f"scan lost {expect}"
 
-    def test_names_are_lowercase_dotted(self):
-        bad = [(f, n) for f, _, n in _registrations()
+    def test_names_are_lowercase_dotted(self, registrations):
+        bad = [(f, n) for f, _, n in registrations
                if not re.fullmatch(r"[a-z0-9._]+", n)]
         assert not bad, f"invalid metric names: {bad}"
 
-    def test_no_name_registered_under_two_kinds(self):
+    def test_no_name_registered_under_two_kinds(self, registrations):
         kinds: dict = {}
-        for f, family, name in _registrations():
+        for f, family, name in registrations:
             kinds.setdefault(name, {})[family] = f
         dups = {n: k for n, k in kinds.items() if len(k) > 1}
         assert not dups, f"metric kind collisions: {dups}"
 
 
-_CODE_SPAN = re.compile(r"`([^`]+)`")
-
-
-def _expand_brace_alts(s: str) -> list[str]:
-    """`a.{x,y}.b` -> [a.x.b, a.y.b] (recursively, so multiple brace
-    groups expand as a cartesian product)."""
-    m = re.search(r"\{([^{}]*,[^{}]*)\}", s)
-    if not m:
-        return [s]
-    out = []
-    for alt in m.group(1).split(","):
-        out.extend(_expand_brace_alts(
-            s[:m.start()] + alt.strip() + s[m.end():]))
-    return out
-
-
-def _documented_families():
-    """(exact names, prefix wildcards) from OBSERVABILITY.md code
-    spans, normalized the same way _registrations normalizes f-string
-    registrations: `{a,b}` alternation expands, any leftover `{x}`
-    placeholder collapses to '0', and `fam.*` is a prefix wildcard."""
-    exact, prefixes = set(), []
-    for span in _CODE_SPAN.findall(OBSERVABILITY):
-        span = span.strip()
-        if not re.fullmatch(r"[a-z0-9._{},* ]+", span):
-            continue
-        for name in _expand_brace_alts(span):
-            name = re.sub(r"\{[^}]*\}", "0", name).strip()
-            if name.endswith(".*"):
-                prefixes.append(name[:-1])      # keep the dot
-            elif re.fullmatch(r"[a-z0-9._]+", name):
-                exact.add(name)
-    return exact, prefixes
-
-
 class TestDocDrift:
     def test_doc_scan_finds_the_tables(self):
-        exact, prefixes = _documented_families()
+        exact, prefixes = documented_families(OBSERVABILITY)
         # an empty parse would vacuously pass the drift checks below
         assert len(exact) >= 20
         assert "sql." in prefixes
@@ -115,25 +82,20 @@ class TestDocDrift:
                        "exec.queue.depth"):
             assert expect in exact, f"doc parse lost {expect}"
 
-    def test_registered_metrics_documented(self):
-        exact, prefixes = _documented_families()
+    def test_registered_metrics_documented(self, registrations):
+        exact, prefixes = documented_families(OBSERVABILITY)
         missing = sorted({
-            n for _, _, n in _registrations()
+            n for _, _, n in registrations
             if n not in exact
             and not any(n.startswith(p) for p in prefixes)})
         assert not missing, (
             "metric families registered in code but missing from the "
             f"OBSERVABILITY.md table: {missing}")
 
-    def test_served_endpoints_documented(self):
-        node_py = (REPO / "cockroach_tpu" / "server"
-                   / "node.py").read_text()
-        served = {m.group(1) for m in re.finditer(
-            r"[\"'](/[a-zA-Z_][a-zA-Z0-9_/]*)[\"']", node_py)}
+    def test_served_endpoints_documented(self, index):
+        served = {p for p, _lineno in served_endpoints(index)}
         assert "/debug/tracez" in served, "endpoint scan lost tracez"
-        documented = {s.split("?")[0] for s in
-                      _CODE_SPAN.findall(OBSERVABILITY)
-                      if s.startswith("/")}
+        documented = documented_endpoints(OBSERVABILITY)
         missing = sorted(served - documented)
         assert not missing, (
             "HTTP endpoints served by server/node.py but missing "
@@ -151,26 +113,21 @@ class TestDiagnosticsDocCoverage:
                     "stmtdiag.fetched")
     NEW_ENDPOINTS = ("/_status/stmtdiag", "/_status/tenants")
 
-    def test_profile_families_registered(self):
-        regs = {n for _, _, n in _registrations()}
+    def test_profile_families_registered(self, registrations):
+        regs = {n for _, _, n in registrations}
         for name in self.NEW_FAMILIES:
             assert name in regs, f"{name} no longer registered"
 
     def test_profile_families_documented(self):
-        exact, prefixes = _documented_families()
+        exact, prefixes = documented_families(OBSERVABILITY)
         for name in self.NEW_FAMILIES:
             assert name in exact or \
                 any(name.startswith(p) for p in prefixes), \
                 f"{name} missing from OBSERVABILITY.md"
 
-    def test_diag_endpoints_served_and_documented(self):
-        node_py = (REPO / "cockroach_tpu" / "server"
-                   / "node.py").read_text()
-        served = {m.group(1) for m in re.finditer(
-            r"[\"'](/[a-zA-Z_][a-zA-Z0-9_/]*)[\"']", node_py)}
-        documented = {s.split("?")[0] for s in
-                      _CODE_SPAN.findall(OBSERVABILITY)
-                      if s.startswith("/")}
+    def test_diag_endpoints_served_and_documented(self, index):
+        served = {p for p, _lineno in served_endpoints(index)}
+        documented = documented_endpoints(OBSERVABILITY)
         for ep in self.NEW_ENDPOINTS:
             assert ep in served, f"{ep} no longer served"
             assert ep in documented, \
@@ -179,6 +136,11 @@ class TestDiagnosticsDocCoverage:
         # carries the trailing slash)
         assert "/_status/stmtdiag/" in served
         assert "/_status/stmtdiag/" in documented
+
+    def test_doc_span_regex_shared_with_rule(self):
+        # the endpoint table parse and the metric table parse read the
+        # same code spans the registration-drift rule reads
+        assert _CODE_SPAN.findall("`a.b` and `/x/y`") == ["a.b", "/x/y"]
 
 
 class TestExpositionFormat:
