@@ -1192,6 +1192,109 @@ def run_coldstart(query: str, rows: int):
     }
 
 
+def run_multihost(rows: int, repeat: int = 3) -> dict:
+    """Round-15 multi-host pod ladder: 1/2/4 REAL host processes on
+    localhost (server/hostd.py, jax.distributed rendezvous + socket
+    fabric), each owning its contiguous shard of lineitem, running the
+    combine-exact partial-agg rungs through the hierarchical merge
+    tree (fanout 2), plus a flat fan-in (fanout 0) A/B arm at 4 hosts.
+
+    Caveat recorded with the numbers: on one machine every "host"
+    shares the same CPU cores and XLA-CPU cannot run cross-process
+    device computations, so rows/s here prices the control/data-plane
+    orchestration, NOT pod compute scaling — the transferable signal
+    is the BYTES story (gateway ingest shrinking under the tree while
+    interior hosts absorb merge bytes)."""
+    import socket as _socket
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def _pod(n, fanout):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env.setdefault("JAX_ENABLE_X64", "1")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+
+        def cmd(pid):
+            return [sys.executable, "-m", "cockroach_tpu.server.hostd",
+                    "--process-id", str(pid),
+                    "--num-processes", str(n),
+                    "--coordinator", f"127.0.0.1:{port}",
+                    "--fanout", str(fanout), "--rows", str(rows),
+                    "--queries", "q6,groupby",
+                    "--repeat", str(repeat)]
+
+        workers = [subprocess.Popen(cmd(pid), env=env, cwd=here,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+                   for pid in range(1, n)]
+        try:
+            proc = subprocess.run(cmd(0), env=env, cwd=here,
+                                  capture_output=True, text=True,
+                                  timeout=900)
+        finally:
+            for w in workers:
+                try:
+                    w.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    w.kill()
+        if proc.returncode != 0:
+            print(f"# multihost h{n} fanout={fanout} failed "
+                  f"rc={proc.returncode}", file=sys.stderr)
+            sys.stderr.write(proc.stderr[-2000:])
+            return None
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        return json.loads(line) if line else None
+
+    out = {"multihost_rows": rows}
+    base = {}
+    for n in (1, 2, 4):
+        pod = _pod(n, fanout=2)
+        if pod is None:
+            continue
+        gwm = pod.get("metrics", {}).get("0", {})
+        merged = sum((m or {}).get("exec.multihost.merge.bytes", 0)
+                     for m in pod.get("metrics", {}).values())
+        out[f"multihost_h{n}_gateway_recv_bytes"] = \
+            gwm.get("shuffle.bytes.received", 0)
+        out[f"multihost_h{n}_merge_bytes"] = merged
+        for q, t in pod.get("timings", {}).items():
+            out[f"multihost_{q}_h{n}_rows_per_sec"] = \
+                round(t["rows_per_s"])
+            if n == 1:
+                base[q] = t["rows_per_s"]
+            elif base.get(q):
+                out[f"multihost_{q}_h{n}_vs_h1"] = \
+                    round(t["rows_per_s"] / base[q], 3)
+            print(f"# multihost h{n} fanout=2 {q} "
+                  f"rows_per_sec={t['rows_per_s']:.3e} "
+                  f"gw_recv={gwm.get('shuffle.bytes.received', 0)} "
+                  f"merged={merged}", file=sys.stderr)
+    flat = _pod(4, fanout=0)
+    if flat is not None:
+        gwm = flat.get("metrics", {}).get("0", {})
+        out["multihost_h4_flat_gateway_recv_bytes"] = \
+            gwm.get("shuffle.bytes.received", 0)
+        for q, t in flat.get("timings", {}).items():
+            out[f"multihost_{q}_h4_flat_rows_per_sec"] = \
+                round(t["rows_per_s"])
+        tree_b = out.get("multihost_h4_gateway_recv_bytes", 0)
+        flat_b = out["multihost_h4_flat_gateway_recv_bytes"]
+        if flat_b:
+            # < 1.0 = the tree shed gateway ingress onto interior hosts
+            out["multihost_h4_gateway_bytes_tree_vs_flat"] = \
+                round(tree_b / flat_b, 3)
+        print(f"# multihost h4 fanout=0 gw_recv={flat_b} "
+              f"(tree gw_recv={tree_b})", file=sys.stderr)
+    return out
+
+
 def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
               mode: str = "tpu_child", extra_env: dict | None = None):
     """One query/measurement in its own subprocess: a fresh backend
@@ -1391,6 +1494,16 @@ def main():
             "metric": "joinorder_sketch_rows_per_sec",
             "value": per.get("joinorder_sketch_rows_per_sec", 0),
             "unit": "rows/s", "rows": rows,
+            **per,
+        }))
+        return
+    if mode == "multihost_child":
+        per = run_multihost(rows,
+                            int(os.environ.get("BENCH_REPEATS", 3)))
+        print(json.dumps({
+            "metric": "multihost_groupby_h2_vs_h1",
+            "value": per.get("multihost_groupby_h2_vs_h1", 0),
+            "unit": "x", "rows": rows,
             **per,
         }))
         return
@@ -1602,6 +1715,17 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("movement_")})
             out.setdefault("movement_rows", r["rows"])
+    # round 15 tentpole: multi-host pod scale-out — 1/2/4 real host
+    # processes (jax.distributed rendezvous, host-owned shards) with
+    # the hierarchical partial-agg merge tree vs flat gateway fan-in
+    if os.environ.get("BENCH_MULTIHOST", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_MULTIHOST_ROWS",
+                                         1 << 17)),
+                      "multihost", max(child_timeout, 1200),
+                      mode="multihost_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("multihost_")})
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),
@@ -1667,7 +1791,8 @@ _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "cpu_rows", "ssb_rows", "tpcc_warehouses",
                   "spill_budget_bytes", "coldstart_rows",
                   "joinskip_budget_bytes", "joinskip_okey_cap",
-                  "movement_shard_bytes", "movement_build_bytes"}
+                  "movement_shard_bytes", "movement_build_bytes",
+                  "multihost_rows"}
 
 
 def regression_report(out: dict) -> None:
@@ -1695,6 +1820,9 @@ def regression_report(out: dict) -> None:
                 k.endswith("_cache_hits") or \
                 k.endswith("_node_budget_bytes") or \
                 k.endswith("_overlap_s") or k.endswith("_pages") or \
+                k.endswith("_recv_bytes") or \
+                k.endswith("_merge_bytes") or \
+                k.endswith("_bytes_tree_vs_flat") or \
                 isinstance(pv, bool) or isinstance(cv, bool) or \
                 not isinstance(pv, (int, float)) or \
                 not isinstance(cv, (int, float)) or not pv:

@@ -34,6 +34,21 @@ collective-discipline
     ``queued_collective_call`` within the same function — a mesh
     program that escapes the dispatcher is a rendezvous hazard on the
     first concurrent statement.
+
+    Round 15 (multi-host) extension, same rule: the CROSS-HOST
+    rendezvous entry points — ``jax.distributed.initialize`` /
+    ``jax.distributed.shutdown``, anything under
+    ``jax.experimental.multihost_utils``, and
+    ``mesh_utils.create_hybrid_device_mesh`` — are sanctioned only in
+    parallel/multihost.py. The coordinator client, its KV store, and
+    the hybrid ICI+DCN mesh are process-global singletons with strict
+    ordering constraints (initialize must precede ANY backend touch;
+    shutdown mid-flight aborts every peer via the coordination-service
+    heartbeat), so a second entry point anywhere else either
+    double-initializes the pod or tears live peers down. Everything
+    outside the home goes through the multihost wrappers
+    (``init_distributed`` / ``shutdown_distributed`` /
+    ``global_mesh``), which are idempotent and teardown-ordered.
 """
 
 from __future__ import annotations
@@ -50,6 +65,13 @@ DATA_PLANE_PREFIXES = (
 # the one module allowed to build collective programs: everything it
 # produces is executed on its own _MeshDispatcher FIFO thread
 COLLECTIVE_HOME = "cockroach_tpu/parallel/distagg.py"
+
+# the one module allowed to touch the cross-host rendezvous
+# (jax.distributed / multihost_utils / create_hybrid_device_mesh):
+# its init/shutdown wrappers are idempotent and run registered
+# teardowns in LIFO order, so the process-global coordinator client
+# has exactly one owner
+MULTIHOST_HOME = "cockroach_tpu/parallel/multihost.py"
 
 
 def _is_jnp_asarray(node: ast.Call, module) -> bool:
@@ -103,13 +125,61 @@ def _collective_ctor_name(node: ast.Call) -> str | None:
     return None
 
 
+def _dotted_name(f) -> list[str]:
+    """Attribute chain as parts (["jax", "distributed", "initialize"]);
+    empty when the chain does not bottom out at a plain Name."""
+    parts = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if not isinstance(f, ast.Name):
+        return []
+    parts.append(f.id)
+    parts.reverse()
+    return parts
+
+
+def _multihost_entry_name(node: ast.Call, module) -> str | None:
+    """A cross-host rendezvous entry point, or None.
+
+    Matches jax.distributed.{initialize,shutdown} (also via
+    ``from jax import distributed``), any call through a
+    ``multihost_utils`` segment, and ``create_hybrid_device_mesh``
+    under any spelling (the same pragmatic name-matching as the
+    shard_map/pmap check: aliasing these to evade the lint would
+    itself be a finding in review)."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "create_hybrid_device_mesh":
+            return f.id
+        if f.id in ("initialize", "shutdown"):
+            mod, orig = module.from_imports.get(f.id, ("", ""))
+            if mod == "jax.distributed":
+                return f"jax.distributed.{orig}"
+        return None
+    parts = _dotted_name(f)
+    if not parts:
+        return None
+    dotted = ".".join(parts)
+    if parts[-1] == "create_hybrid_device_mesh":
+        return dotted
+    if "multihost_utils" in parts[:-1]:
+        return dotted
+    if parts[-1] in ("initialize", "shutdown") and len(parts) >= 2 \
+            and parts[-2] == "distributed":
+        return dotted
+    return None
+
+
 def check_collective_discipline(index) -> list[Finding]:
     rule = "collective-discipline"
     out = []
     for rel, m in index.modules.items():
         if rel == COLLECTIVE_HOME or not rel.startswith("cockroach_tpu/"):
             continue
-        # (a) raw collective constructors outside the dispatcher's home
+        # (a) raw collective constructors outside the dispatcher's
+        # home; (c) cross-host rendezvous entry points outside the
+        # multihost home (same walk, same rule bit)
         for node in ast.walk(m.tree):
             if isinstance(node, ast.Call):
                 name = _collective_ctor_name(node)
@@ -123,6 +193,25 @@ def check_collective_discipline(index) -> list[Finding]:
                         "be built and executed via the queued "
                         "_MeshDispatcher or concurrent statements "
                         "deadlock the XLA rendezvous",
+                        waived=reason is not None,
+                        waiver_reason=reason or ""))
+                    continue
+                if rel == MULTIHOST_HOME:
+                    continue
+                name = _multihost_entry_name(node, m)
+                if name is not None:
+                    reason = m.waiver_for(rule, node.lineno,
+                                          node.end_lineno)
+                    out.append(Finding(
+                        rule, rel, node.lineno,
+                        f"{name} called outside {MULTIHOST_HOME}: the "
+                        "cross-host rendezvous (coordinator client, "
+                        "KV store, hybrid mesh) is a process-global "
+                        "singleton — a second entry point double-"
+                        "initializes the pod or tears live peers "
+                        "down; use the multihost wrappers "
+                        "(init_distributed / shutdown_distributed / "
+                        "global_mesh)",
                         waived=reason is not None,
                         waiver_reason=reason or ""))
         # (b) make_distributed_fn results must flow into

@@ -101,6 +101,21 @@ class FlowSpec:
     # around). Off = the historical compute-then-ship frame exchange
     # — the A/B lever for the parity fuzz and the movement bench.
     overlap: bool = True
+    # hierarchical partial-agg merge (round-15 multi-host tentpole):
+    # instead of every producer fanning flat into the gateway, the
+    # gateway arranges partial-agg streams into a k-ary host tree.
+    # merge_to overrides the stream's consumer (a mid-tree node
+    # instead of the gateway); merge_children lists the stream_ids
+    # whose partial chunks THIS node must absorb and tree-merge
+    # (physical.merge_partials) with its own before shipping one
+    # merged stream up. None/empty = the classic flat fan-in.
+    merge_to: Optional[int] = None
+    merge_children: Optional[list] = None
+    # idle bound for a mid-tree node's child-stream wait, set from the
+    # gateway's flow_timeout: the merge wait runs INSIDE deliver_all
+    # (it blocks the gateway's own pump when the merge node is the
+    # gateway's node), so it must give up no later than the flow would
+    merge_timeout: float = 300.0
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -111,7 +126,9 @@ class FlowSpec:
                 "graph": self.graph, "data_nodes": self.data_nodes,
                 "trace": self.trace, "joinfilter": self.joinfilter,
                 "adaptive": self.adaptive, "profile": self.profile,
-                "overlap": self.overlap}
+                "overlap": self.overlap, "merge_to": self.merge_to,
+                "merge_children": self.merge_children,
+                "merge_timeout": self.merge_timeout}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":
@@ -166,6 +183,14 @@ class FlowRegistry:
     def release(self, flow_id: str) -> None:
         for key in [k for k in self._inboxes if k[0] == flow_id]:
             del self._inboxes[key]
+
+    def release_stream(self, flow_id: str, stream_id) -> None:
+        """Release ONE stream's inbox — the merge-tree case, where a
+        mid-tree node drains its child streams from the same registry
+        the gateway's own inboxes for this flow may live in (when the
+        merge node IS the gateway's node): a flow-wide release there
+        would orphan the gateway's live inbox references."""
+        self._inboxes.pop((flow_id, stream_id), None)
 
 
 class Outbox:

@@ -29,7 +29,9 @@ from cockroach_tpu.distsql import serde
 from cockroach_tpu.distsql import shuffle as shfl
 from cockroach_tpu.distsql.flow import (FlowCancelled, FlowRegistry,
                                         FlowSpec, Outbox)
-from cockroach_tpu.distsql.physical import RAW, UNION, split
+from cockroach_tpu.distsql.physical import (RAW, UNION,
+                                            MergeUnsupported,
+                                            merge_partials, split)
 from cockroach_tpu.exec.compile import ExecParams, RunContext, compile_plan
 from cockroach_tpu.exec import profile as _prof
 from cockroach_tpu.ops.batch import ColumnBatch
@@ -45,6 +47,12 @@ class FlowError(Exception):
 
 # end-of-iteration sentinel for the overlapped-send double buffer
 _SHIP_DONE = object()
+
+# error-frame marker distinguishing "a participant is gone" from "the
+# statement errored" ACROSS the merge tree: a mid-tree node that times
+# out waiting for a child stream ships this marker up, and the gateway
+# raises FlowUnavailable (degradation ladder) instead of FlowError
+_UNAVAILABLE_MARK = "[flow-unavailable]"
 
 
 class FlowUnavailable(FlowError):
@@ -225,7 +233,11 @@ class DistSQLNode:
 
     # -- local stage execution -------------------------------------
     def _setup_flow(self, spec: FlowSpec) -> None:
-        outbox = Outbox(self.transport, self.node_id, spec.gateway,
+        # hierarchical merge: a stream's consumer is its merge-tree
+        # parent when the gateway planned one (flat fan-in otherwise)
+        consumer = (spec.merge_to if spec.merge_to is not None
+                    else spec.gateway)
+        outbox = Outbox(self.transport, self.node_id, consumer,
                         spec.flow_id, spec.stream_id,
                         node=self, window=spec.window)
         if spec.flow_id in self.cancelled_flows:
@@ -249,7 +261,10 @@ class DistSQLNode:
                 if spec.spans is not None:
                     self._materialize_spans(spec.spans)
                 batches, stage = self._run_local(spec, sink=sink)
-                self._ship_batches(spec, outbox, batches, stage)
+                if spec.merge_children:
+                    self._merge_and_ship(spec, outbox, batches, stage)
+                else:
+                    self._ship_batches(spec, outbox, batches, stage)
             if spec.trace:
                 # record this stage locally and ship the subtree back
                 # BEFORE EOF (the gateway's pump loop exits on EOF)
@@ -511,6 +526,93 @@ class DistSQLNode:
                 prev = nxt
             if overlapped > 0.0:
                 mv.note_overlap(overlapped)
+        finally:
+            mv.note_exchange(outbox.bytes_sent)
+
+    def _merge_and_ship(self, spec: FlowSpec, outbox: Outbox, batches,
+                        stage) -> None:
+        """Mid-tree node of a hierarchical partial-agg merge: absorb
+        the child streams the gateway assigned to us
+        (``spec.merge_children``), tree-merge their partial chunks with
+        our own shard's partials (physical.merge_partials — pure host
+        numpy, no XLA compile at intermediate hosts), and ship ONE
+        merged stream to our parent. Adaptive raw chunks pass through
+        unmerged (the gateway's raw fold handles them), as does
+        anything merge_partials cannot combine exactly.
+
+        The wait loop is the Outbox credit-wait discipline turned
+        around for the receive side: pump our own transport (acks and
+        child chunks arrive on it; deliver_all drains a snapshot so
+        the in-process re-entry terminates), reset the deadline on any
+        delivery, and fail only on true silence — with the
+        ``_UNAVAILABLE_MARK`` in the error so the gateway degrades
+        (replan/local fallback) instead of treating a dead child as a
+        statement error."""
+        mv = self.engine.movement
+        own = [self._host_output(b, stage.local, stage.string_cols)
+               for b in batches]
+        sids = list(spec.merge_children)
+        inboxes = {sid: self.registry.inbox(spec.flow_id, sid)
+                   for sid in sids}
+        idle = float(spec.merge_timeout or Outbox.CREDIT_TIMEOUT)
+        try:
+            deadline = _time.monotonic() + idle
+            while not all(ib.eof for ib in inboxes.values()):
+                if spec.flow_id in self.cancelled_flows:
+                    raise FlowCancelled(spec.flow_id)
+                moved = self.transport.deliver_all()
+                if moved:
+                    deadline = _time.monotonic() + idle
+                    continue
+                stalled = [s for s, ib in inboxes.items() if not ib.eof]
+                if self.transport.pending() == 0 and \
+                        not getattr(self.transport, "is_async", False):
+                    raise FlowError(
+                        f"{_UNAVAILABLE_MARK} merge streams {stalled} "
+                        "stalled on an idle synchronous transport")
+                if _time.monotonic() > deadline:
+                    raise FlowError(
+                        f"{_UNAVAILABLE_MARK} merge streams {stalled} "
+                        f"stalled ({idle}s silence)")
+                _time.sleep(0.001)
+            errs = [ib.error for ib in inboxes.values() if ib.error]
+            if errs:
+                # child errors propagate verbatim: an _UNAVAILABLE_MARK
+                # deeper in the tree keeps its marker all the way up
+                raise FlowError("; ".join(errs))
+            absorbed = sum(ib.bytes_received for ib in inboxes.values())
+            child = [c for ib in inboxes.values()
+                     for c in ib.drain_arrays()]
+        finally:
+            # per-stream release, NOT flow-wide: on the gateway's own
+            # node the gateway's direct inboxes for this flow share
+            # this registry
+            for sid in sids:
+                self.registry.release_stream(spec.flow_id, sid)
+        chunks = own + child
+        partial = [c for c in chunks if "__p0" in c[1]]
+        raw = [c for c in chunks if "__p0" not in c[1]]
+        shipped = list(partial)
+        if len(partial) > 1 and stage.merge_funcs:
+            try:
+                shipped = [merge_partials(partial, stage.merge_cols,
+                                          stage.merge_funcs)]
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "exec.multihost.flows.merged",
+                        "hierarchical merges performed at mid-tree "
+                        "nodes (partial streams combined before the "
+                        "gateway)").inc()
+                    self.metrics.counter(
+                        "exec.multihost.merge.bytes",
+                        "child partial-stream bytes absorbed by "
+                        "mid-tree merges instead of traversing the "
+                        "links above this node").inc(absorbed)
+            except MergeUnsupported:
+                shipped = list(partial)   # forward unmerged
+        try:
+            for n, cols, valid in shipped + raw:
+                outbox.send_arrays(n, cols, valid, spec.chunk_rows)
         finally:
             mv.note_exchange(outbox.bytes_sent)
 
@@ -990,7 +1092,8 @@ class Gateway:
                  monitor=None, window: int = 8, cluster=None,
                  prefer_shuffle: bool = False,
                  adaptive_agg: bool = True,
-                 overlap: bool = True):
+                 overlap: bool = True,
+                 merge_fanout: int = 0):
         # prefer_shuffle: route every shuffle-decomposable statement
         # through the multi-stage hash-exchange graph, even when a
         # single-stage plan would work (the sharded⋈sharded path is
@@ -1004,6 +1107,14 @@ class Gateway:
         # buffer compute against host transfer + send; off forces the
         # classic compute-then-ship frame exchange (A/B lever)
         self.overlap = overlap
+        # hierarchical partial-agg merge (round-15 multi-host
+        # tentpole): >0 arranges combine-exact partial-agg streams
+        # into a merge_fanout-ary tree (heap layout over the stream
+        # indices, stream 0 = the gateway's node) so cross-"host"
+        # bytes descend log-depth instead of all fanning flat into
+        # the gateway. 0 = the classic flat fan-in (A/B lever; also
+        # the only shape non-combine-exact statements ever use).
+        self.merge_fanout = int(merge_fanout)
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -1467,8 +1578,27 @@ class Gateway:
         registry = self.own.registry
         adaptive = (self.adaptive_agg and stage.stage == "partial_agg"
                     and stage.raw_local is not None)
+        # hierarchical merge: only combine-exact partial-agg flows may
+        # tree-merge (any fold order is bit-identical); everything
+        # else keeps the flat fan-in. Stream i rides node i; the tree
+        # is a heap over stream indices, so stream 0 — the gateway's
+        # own node — is the root and the gateway pumps ONE inbox.
+        fan = self.merge_fanout
+        tree = (fan > 0 and stage.stage == "partial_agg"
+                and stage.merge_exact and len(nodes) >= 2)
+        if tree:
+            self._count("distsql.flows.tree",
+                        "distributed flows whose partial-agg streams "
+                        "ran as a hierarchical merge tree")
         inboxes = []
         for i, nid in enumerate(nodes):
+            merge_to = merge_children = None
+            if tree:
+                if i > 0:
+                    merge_to = nodes[(i - 1) // fan]
+                kids = [k for k in range(fan * i + 1, fan * i + 1 + fan)
+                        if k < len(nodes)]
+                merge_children = kids or None
             spec = FlowSpec(flow_id, self.own.node_id, stage.stage, sql,
                             stream_id=i, chunk_rows=chunk_rows,
                             read_ts=read_ts, window=self.window,
@@ -1477,14 +1607,21 @@ class Gateway:
                                    else None),
                             trace=trace, joinfilter=jf_frames,
                             adaptive=adaptive, profile=profiled,
-                            overlap=self.overlap)
-            inboxes.append(registry.inbox(flow_id, i))
+                            overlap=self.overlap,
+                            merge_to=merge_to,
+                            merge_children=merge_children,
+                            merge_timeout=self.flow_timeout)
+            if not tree or i == 0:
+                # mid-tree streams terminate at their merge parent;
+                # only the root stream reaches the gateway
+                inboxes.append(registry.inbox(flow_id, i))
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
         union, merged_dicts = self._pump_and_union(
             flow_id, inboxes, stage.union_columns, stage.string_cols,
             nodes, stage=(stage if adaptive else None),
-            read_ts=read_ts)
+            read_ts=read_ts,
+            participants=(list(nodes) if tree else None))
 
         # output dictionaries come from the merged wire strings, not the
         # gateway's (possibly empty) local shard
@@ -1594,7 +1731,12 @@ class Gateway:
 
     def _pump_and_union(self, flow_id, inboxes, union_columns,
                         string_cols, nodes: list | None = None,
-                        stage=None, read_ts=None):
+                        stage=None, read_ts=None,
+                        participants: list | None = None):
+        # participants: the FULL node set feeding this flow when it is
+        # wider than the direct producers (hierarchical merge: the
+        # gateway pumps one root inbox but a death anywhere in the
+        # tree starves it) — the monitor fail-fast must watch them all
         nodes = nodes if nodes is not None else list(self.nodes)
         transport = self.own.transport
         registry = self.own.registry
@@ -1614,9 +1756,13 @@ class Gateway:
             if self.monitor is not None and spin % 256 == 255:
                 # a peer that trips mid-flow will never send EOF;
                 # stop waiting for it the moment the breaker says so
-                waiting = [nodes[i] for i, ib in enumerate(inboxes)
-                           if not ib.eof and
-                           nodes[i] != self.own.node_id]
+                if participants is not None:
+                    waiting = [n for n in participants
+                               if n != self.own.node_id]
+                else:
+                    waiting = [nodes[i] for i, ib in enumerate(inboxes)
+                               if not ib.eof and
+                               nodes[i] != self.own.node_id]
                 sick = [n for n in waiting
                         if not self.monitor.healthy(n)]
                 if sick:
@@ -1637,6 +1783,11 @@ class Gateway:
                 raise fail_fast
             errs = [ib.error for ib in inboxes if ib.error]
             if errs:
+                if any(_UNAVAILABLE_MARK in e for e in errs):
+                    # a mid-tree node timed out on a child stream: a
+                    # participant is gone, not a statement error —
+                    # keep the degradation ladder reachable
+                    raise FlowUnavailable("; ".join(errs))
                 raise FlowError("; ".join(errs))
             if not all(ib.eof for ib in inboxes):
                 raise FlowUnavailable("flow streams stalled")

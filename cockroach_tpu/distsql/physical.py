@@ -56,6 +56,16 @@ class StagePlan:
     raw_columns: list = field(default_factory=list)
     raw_strings: dict = field(default_factory=dict)
     raw_merge: P.PlanNode = None       # Aggregate over Scan(__rawunion)
+    # hierarchical partial-agg merge (round 15 multi-host tentpole):
+    # partial-form chunks may tree-merge at intermediate hosts before
+    # reaching the gateway (merge_partials below). merge_cols are the
+    # group-key names, merge_funcs maps each __pN partial column to its
+    # associative merge function, and merge_exact gates the whole path
+    # on combine_exact — a mid-tree merge reorders the fold, so only
+    # order-free/exactly-associative aggregates may ride it.
+    merge_cols: list = field(default_factory=list)
+    merge_funcs: dict = field(default_factory=dict)
+    merge_exact: bool = False
 
 
 def _peel(node: P.PlanNode):
@@ -360,6 +370,12 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
                     if isinstance(e, BCol) and e.name in strings}
     sp = StagePlan("partial_agg", local, _rewrap(wrappers, final),
                    union_cols, strings, dict_outputs)
+    # hierarchical-merge metadata: every final agg is a merge over one
+    # partial column (BCol __pN by construction above), so the partial
+    # schema merges to itself under these functions at any tree level
+    sp.merge_cols = list(gnames)
+    sp.merge_funcs = {fa.arg.name: fa.func for fa in final_aggs}
+    sp.merge_exact = combine_exact(core.aggs)
 
     # adaptive raw-ship alternative: only for combine-exact aggregates
     # (bit-identity across the per-shard choice) with at least one agg
@@ -396,3 +412,98 @@ def _split_aggregate(wrappers, core: P.Aggregate) -> StagePlan:
             [] if hashed else list(core.group_dims),
             group_lo=([] if hashed else list(core.group_lo)))
     return sp
+
+
+class MergeUnsupported(Exception):
+    """A partial chunk's dtype cannot tree-merge host-side; the caller
+    forwards the chunks unmerged (correctness first, byte savings
+    second)."""
+
+
+def merge_partials(chunks, group_cols, merge_funcs):
+    """Tree-merge partial-form wire chunks into one partial-form chunk.
+
+    The mid-tree rung of the hierarchical merge: psum folds partials
+    inside a host's mesh, this folds partial CHUNKS across rendezvous
+    domains on their way up the host tree, and the gateway's final
+    stage merges whatever reaches it. Chunks are the wire tuples
+    ``(n, cols, valid)`` (numpy host arrays, strings decoded) that
+    DistSQLNode._host_output produces, all sharing the partial schema
+    ``group_cols + merge_funcs.keys()``.
+
+    Pure numpy, no device work: intermediate hosts must merge without
+    compiling a plan (and without touching their mesh mid-flow). Only
+    combine-exact stages ride this path (StagePlan.merge_exact), so
+    the host-side int sums / min / max are bit-identical to any other
+    fold order. Raises MergeUnsupported for dtypes it cannot reduce
+    exactly (the caller forwards unmerged).
+    """
+    import numpy as np
+    live = [(n, c, v) for n, c, v in chunks if n > 0]
+    names = list(group_cols) + list(merge_funcs)
+    if not live:
+        _n, c0, v0 = chunks[0]
+        return (0, {k: c0[k][:0] for k in names},
+                {k: v0[k][:0] for k in names})
+    for p in merge_funcs:
+        for _n, c, _v in live:
+            if c[p].dtype.kind not in "biuf":
+                raise MergeUnsupported(
+                    f"partial column {p!r} has dtype {c[p].dtype}")
+    total = sum(n for n, _c, _v in live)
+    cols = {c: np.concatenate([ch[1][c] for ch in live]) for c in names}
+    valid = {c: np.concatenate([ch[2][c] for ch in live]).astype(bool)
+             for c in names}
+    # group identity = (valid bit, value) per key column; invalid
+    # slots normalize to the type's zero so NULL groups coalesce
+    fields, keydata = [], []
+    for idx, g in enumerate(group_cols):
+        gv = valid[g]
+        vals = cols[g].copy()
+        vals[~gv] = (b"" if vals.dtype.kind == "S"
+                     else "" if vals.dtype.kind == "U"
+                     else vals.dtype.type(0))
+        fields += [(f"v{idx}", np.uint8), (f"k{idx}", vals.dtype)]
+        keydata.append((gv.astype(np.uint8), vals))
+    if fields:
+        rec = np.empty(total, dtype=fields)
+        for idx, (gv8, vals) in enumerate(keydata):
+            rec[f"v{idx}"] = gv8
+            rec[f"k{idx}"] = vals
+        _uniq, first, inv = np.unique(rec, return_index=True,
+                                      return_inverse=True)
+        inv = inv.reshape(-1)
+        k = len(first)
+    else:      # ungrouped aggregate: one global group
+        first = np.zeros(1, dtype=np.int64)
+        inv = np.zeros(total, dtype=np.int64)
+        k = 1
+    out_cols = {g: cols[g][first] for g in group_cols}
+    out_valid = {g: valid[g][first] for g in group_cols}
+    for p, func in merge_funcs.items():
+        pv = valid[p]
+        vals = cols[p]
+        dt = vals.dtype
+        if func in ("sum", "sum_int"):
+            ident = dt.type(0)
+        elif func == "min":
+            ident = (dt.type(np.inf) if dt.kind == "f"
+                     else dt.type(np.iinfo(dt).max) if dt.kind in "iu"
+                     else dt.type(True))
+        else:                       # max / any
+            ident = (dt.type(-np.inf) if dt.kind == "f"
+                     else dt.type(np.iinfo(dt).min) if dt.kind in "iu"
+                     else dt.type(False))
+        contrib = np.where(pv, vals, ident)
+        acc = np.full(k, ident, dtype=dt)
+        if func in ("sum", "sum_int"):
+            np.add.at(acc, inv, contrib)
+        elif func == "min":
+            np.minimum.at(acc, inv, contrib)
+        else:
+            np.maximum.at(acc, inv, contrib)
+        anyv = np.zeros(k, dtype=bool)
+        np.logical_or.at(anyv, inv, pv)
+        out_cols[p] = acc
+        out_valid[p] = anyv
+    return k, out_cols, out_valid

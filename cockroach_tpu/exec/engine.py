@@ -444,6 +444,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             lambda: (0 if self._mesh_pool is None else
                      self._mesh_pool.occupancy()),
             "in-flight distributed dispatches across all sub-meshes")
+        # multi-host pod membership, read live off the rendezvous
+        # (parallel/multihost.py): 1 until init_distributed ran
+        from ..parallel import multihost as _mh
+        self.metrics.func_gauge(
+            "exec.multihost.hosts", _mh.num_hosts,
+            "host processes in this engine's rendezvous domain "
+            "(1 = single-host)")
         self._lane_init()
 
     def _admission_settings(self) -> None:
@@ -490,6 +497,13 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             for s in pool.sizes():
                 for m in pool.submeshes(s):
                     shutdown_dispatchers(m)
+        # tear down the cross-host rendezvous too: a closed engine
+        # must not leave a live distributed client behind, or the
+        # NEXT engine in this process (back-to-back tests, hostd
+        # restarts) inherits a stale coordinator and hangs its
+        # jax.distributed.initialize
+        from ..parallel import multihost as _mh
+        _mh.shutdown_distributed()
 
     # -- public API ----------------------------------------------------------
     def session(self) -> Session:

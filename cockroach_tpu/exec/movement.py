@@ -89,6 +89,10 @@ class TransferScheduler:
             "exec.movement.dist_spill_fallbacks",
             "DistSQL shards that spilled past their HBM slice instead "
             "of failing")
+        self.m_exch_overcommit = metrics.counter(
+            "exec.movement.exchange.overcommit.bytes",
+            "exchange bytes that proceeded unreserved after waiting "
+            "for the pool (admission degraded, not denied)")
 
     # -- resident forwarding ------------------------------------------
     def reserve_resident(self, account, nbytes: int) -> None:
@@ -191,6 +195,47 @@ class TransferScheduler:
             self.m_exchange.inc(nbytes)
         else:
             self.m_h2d.inc(nbytes)
+        try:
+            yield nbytes
+        finally:
+            self.monitor.release(account)
+            with self._cv:
+                self._transient -= nbytes
+                self._cv.notify_all()
+            self.m_inflight.set(self._transient)
+
+    @contextmanager
+    def exchange_lease(self, nbytes: int):
+        """Lease admission for DistSQL exchange buffers (round-13
+        residue closed in round 15: exchange traffic used to tally
+        trace-time bytes but bypass admission entirely). Semantics sit
+        between ``lease`` and ``soft_lease``: the buffer WAITS for
+        other transient traffic to drain like a real lease — so an
+        exchange storm serializes against stream/spill windows instead
+        of racing the allocator — but on a genuinely full pool it
+        degrades to observable overcommit rather than failing the
+        query (the collective's buffers are allocated inside XLA
+        regardless; denying a query over our own estimate would
+        regress round-12 behavior)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            yield 0
+            return
+        account = ("movement", KIND_EXCHANGE, next(self._ids))
+        admitted = True
+        try:
+            self._admit(account, nbytes)
+        except MemoryQuotaError:
+            admitted = False
+        self.m_exchange.inc(nbytes)
+        if not admitted:
+            self.m_exch_overcommit.inc(nbytes)
+            yield 0
+            return
+        with self._cv:
+            self._transient += nbytes
+        self.m_leases.inc()
+        self.m_inflight.set(self._transient)
         try:
             yield nbytes
         finally:

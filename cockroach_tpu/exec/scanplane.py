@@ -954,6 +954,22 @@ class ScanPlaneMixin:
         nbytes = self._table_device_bytes(td, cols, narrow=narrow_set)
         if placement == "replicated" and mesh is not None:
             nbytes *= mesh.size
+        if placement != "single" and mesh is not None:
+            from ..parallel import multihost
+            if multihost.num_hosts() > 1:
+                # resident uploads are strictly host-local on a pod:
+                # device_put of host arrays cannot address another
+                # process's devices, and silently trying yields an XLA
+                # crash deep in the upload. The cross-host dimension
+                # of a scan is the distsql merge tree's job (each host
+                # owns its shard), never a cross-DCN placement here.
+                local = set(jax.local_devices())
+                if any(d not in local for d in mesh.devices.flat):
+                    raise EngineError(
+                        f"table {name!r}: resident upload targets a "
+                        "mesh with non-addressable (remote-host) "
+                        "devices; use the host-local mesh "
+                        "(parallel.mesh.pod_mesh degrades to it)")
         self.movement.reserve_resident(key, nbytes)
         try:
             b = self._batch_from_chunks(td, td.chunks, cols,

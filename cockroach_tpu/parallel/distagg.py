@@ -390,12 +390,13 @@ def queued_collective_call(jfn, metrics=None, mesh=None,
     @functools.wraps(jfn)
     def call(*args, **kwargs):
         # unified transfer budget (exec/movement.py): a collective
-        # dispatch's shuffle/exchange working buffers ride a soft
-        # lease — admitted when the pool has room, observable
-        # overcommit when it doesn't (the buffers allocate inside XLA
-        # either way)
+        # dispatch's shuffle/exchange working buffers are LEASE-
+        # admitted — they wait for other transient traffic to drain
+        # like every other mover, degrading to observable overcommit
+        # only when the pool is genuinely full (the buffers allocate
+        # inside XLA either way)
         if movement is not None and lease_bytes > 0:
-            with movement.soft_lease("exchange", lease_bytes):
+            with movement.exchange_lease(lease_bytes):
                 return _call_inner(*args, **kwargs)
         return _call_inner(*args, **kwargs)
 

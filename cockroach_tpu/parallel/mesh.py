@@ -26,6 +26,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 SHARD_AXIS = "shards"
 
 
+def pod_mesh() -> Mesh:
+    """Mesh over THIS host's slice of the pod (round 15).
+
+    On a real multi-host pod the rendezvous (parallel/multihost.py)
+    is live and ``global_mesh()`` returns the hybrid ICI+DCN device
+    order from ``mesh_utils.create_hybrid_device_mesh`` — collectives
+    inside one slice ride ICI, the slice boundary rides DCN. On the
+    CPU backend (tier-1) cross-process XLA computations don't exist,
+    so this degrades to the host-local mesh: device collectives stay
+    inside the host and the host tree (distsql merge_to/merge_children
+    flows) carries the cross-host merge instead."""
+    from cockroach_tpu.parallel import multihost
+    return Mesh(np.asarray(multihost.global_mesh()), (SHARD_AXIS,))
+
+
 def make_mesh(devices=None, n: Optional[int] = None) -> Mesh:
     devs = list(devices if devices is not None else jax.devices())
     if n is not None:
@@ -102,7 +117,13 @@ _DOMAIN_GATES_LOCK = threading.Lock()
 
 
 def _devkey(mesh) -> tuple:
-    return tuple(int(d.id) for d in mesh.devices.flat)
+    # gate families are per rendezvous domain: two host processes of
+    # one pod each see local device ids 0..k-1, and a serialized gate
+    # registry must never conflate host A's devices with host B's
+    from cockroach_tpu.parallel import multihost
+    topo = multihost.topology()
+    dom = topo.process_id if topo is not None else -1
+    return (dom,) + tuple(int(d.id) for d in mesh.devices.flat)
 
 
 def execution_window(mesh):
@@ -137,7 +158,12 @@ class MeshPool:
     """
 
     def __init__(self, mesh: Mesh):
+        from cockroach_tpu.parallel import multihost
         self.mesh = mesh
+        # pod awareness: sub-mesh partitioning never crosses a DCN
+        # boundary — the pool splits THIS host's devices, and the
+        # cross-host dimension is the distsql merge tree's job
+        self.num_hosts = multihost.num_hosts()
         devs = list(mesh.devices.flat)
         self._subs: dict[int, list[Mesh]] = {}
         size = len(devs) // 2

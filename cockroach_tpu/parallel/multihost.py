@@ -1,0 +1,287 @@
+"""Multi-host pod runtime: rendezvous, host topology, merge tree.
+
+Round-15 tentpole. Everything through round 14 ran one process; this
+module is the sanctioned home for every cross-host rendezvous entry
+point (graftlint's collective-discipline rule flags the raw
+``jax.distributed`` / ``multihost_utils`` / ``create_hybrid_device_mesh``
+calls anywhere else, the same way it pins ``shard_map``/``pmap`` to
+parallel/distagg.py).
+
+Division of labor, forced by a backend reality: on the CPU backend
+``jax.distributed.initialize`` happily rendezvouses N localhost
+processes (shared KV store, barriers, global device view), but
+cross-process XLA *computations* raise ``Multiprocess computations
+aren't implemented on the CPU backend``. So:
+
+- **control plane** — rendezvous, host identity, address exchange and
+  barriers ride the jax.distributed coordinator KV store (works on
+  every backend, localhost included);
+- **data plane** — cross-host rows ride the repo's framed
+  SocketTransport / DistSQL flows (rpc/context.py), with the
+  hierarchical partial-agg merge (distsql/physical.py merge_plan)
+  reducing bytes up a host tree instead of fanning flat into the
+  gateway;
+- **device collectives** stay host-local (psum inside the host's own
+  mesh, distagg.make_distributed_fn unchanged); on real pods
+  ``global_mesh()`` upgrades to ``create_hybrid_device_mesh`` so the
+  within-slice axis rides ICI and the cross-slice axis rides DCN.
+
+The per-host dispatcher process entry point is server/hostd.py; the
+CPU-backed multi-process pytest harness (tests/test_multihost.py) and
+``bench.py multihost_child`` both spawn it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+KV_PREFIX = "cockroach_tpu"
+DEFAULT_FANOUT = 2
+_KV_TIMEOUT_S = 60.0
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """One host's view of the pod: who am I, how many of us, where is
+    the coordinator, and the merge-tree shape."""
+
+    process_id: int
+    num_processes: int
+    coordinator: str = ""
+    fanout: int = DEFAULT_FANOUT
+
+    @property
+    def is_gateway(self) -> bool:
+        return self.process_id == 0
+
+    def parent(self) -> Optional[int]:
+        return tree_parent(self.process_id, self.fanout)
+
+    def children(self) -> list:
+        return tree_children(self.process_id, self.num_processes,
+                             self.fanout)
+
+
+# module-global runtime state: one topology per process, guarded so
+# back-to-back engines (and back-to-back tests in one process) never
+# inherit a stale rendezvous — Engine.close tears this down.
+_LOCK = threading.RLock()
+_TOPOLOGY: Optional[HostTopology] = None
+_INITIALIZED_JAX = False      # we own a live jax.distributed client
+_LOCAL_KV: dict = {}          # single-process fallback KV store
+_TEARDOWNS: list = []         # cross-host dispatcher/pump teardown fns
+
+
+def topology() -> Optional[HostTopology]:
+    return _TOPOLOGY
+
+
+def is_active() -> bool:
+    return _TOPOLOGY is not None
+
+
+def num_hosts() -> int:
+    t = _TOPOLOGY
+    return t.num_processes if t is not None else 1
+
+
+def init_distributed(coordinator: str = "", num_processes: int = 1,
+                     process_id: int = 0,
+                     fanout: int = DEFAULT_FANOUT) -> HostTopology:
+    """Join (or create) the pod rendezvous. Idempotent: re-initializing
+    with the same shape returns the live topology; a different shape
+    while live is a programming error (stale rendezvous — call
+    shutdown_distributed first).
+
+    ``num_processes == 1`` is the degenerate pod: no coordinator is
+    contacted and the KV store is an in-process dict, so single-host
+    engines can use the same topology/merge-tree code paths with zero
+    network dependencies.
+    """
+    global _TOPOLOGY, _INITIALIZED_JAX
+    with _LOCK:
+        if _TOPOLOGY is not None:
+            if (_TOPOLOGY.num_processes == num_processes
+                    and _TOPOLOGY.process_id == process_id):
+                return _TOPOLOGY
+            raise RuntimeError(
+                "multihost already initialized as "
+                f"{_TOPOLOGY.process_id}/{_TOPOLOGY.num_processes}; "
+                "shutdown_distributed() before re-joining with "
+                f"{process_id}/{num_processes}")
+        topo = HostTopology(process_id=int(process_id),
+                            num_processes=int(num_processes),
+                            coordinator=coordinator,
+                            fanout=max(1, int(fanout)))
+        if topo.num_processes > 1:
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=topo.num_processes,
+                process_id=topo.process_id)
+            _INITIALIZED_JAX = True
+        _TOPOLOGY = topo
+        return topo
+
+
+def shutdown_distributed() -> None:
+    """Tear down the pod runtime: run registered cross-host teardowns
+    (dispatcher pumps, transports), release the jax.distributed client,
+    and clear the topology. Idempotent and safe when never initialized,
+    so Engine.close can always call it."""
+    global _TOPOLOGY, _INITIALIZED_JAX
+    with _LOCK:
+        teardowns, _TEARDOWNS[:] = list(_TEARDOWNS), []
+        for fn in reversed(teardowns):
+            try:
+                fn()
+            except Exception:
+                pass  # teardown is best-effort; state reset must win
+        if _INITIALIZED_JAX:
+            try:
+                import jax
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            _INITIALIZED_JAX = False
+        _TOPOLOGY = None
+        _LOCAL_KV.clear()
+
+
+def register_teardown(fn: Callable[[], None]) -> None:
+    """Register a cross-host resource (flow transport, pump thread)
+    for shutdown_distributed to reap — run LIFO, errors swallowed."""
+    with _LOCK:
+        _TEARDOWNS.append(fn)
+
+
+# ---------------------------------------------------------------------------
+# coordinator KV store: address exchange + barriers
+# ---------------------------------------------------------------------------
+
+def _client():
+    """The live jax.distributed coordinator client, or None in the
+    degenerate single-process pod."""
+    if not _INITIALIZED_JAX:
+        return None
+    from jax._src import distributed as _jdist
+    return _jdist.global_state.client
+
+
+def kv_set(key: str, value: str) -> None:
+    c = _client()
+    if c is None:
+        with _LOCK:
+            _LOCAL_KV[f"{KV_PREFIX}/{key}"] = str(value)
+        return
+    c.key_value_set(f"{KV_PREFIX}/{key}", str(value))
+
+
+def kv_get(key: str, timeout_s: float = _KV_TIMEOUT_S) -> str:
+    c = _client()
+    if c is None:
+        return _LOCAL_KV[f"{KV_PREFIX}/{key}"]
+    return c.blocking_key_value_get(f"{KV_PREFIX}/{key}",
+                                    int(timeout_s * 1000))
+
+
+def barrier(name: str, timeout_s: float = _KV_TIMEOUT_S) -> None:
+    c = _client()
+    if c is None:
+        return
+    c.wait_at_barrier(f"{KV_PREFIX}/{name}", int(timeout_s * 1000))
+
+
+def publish_flow_addr(host: str, port: int) -> None:
+    """Announce this host's DistSQL SocketTransport listener."""
+    t = _TOPOLOGY
+    if t is None:
+        raise RuntimeError("multihost not initialized")
+    kv_set(f"flowaddr/{t.process_id}", f"{host}:{port}")
+
+
+def peer_flow_addrs(timeout_s: float = _KV_TIMEOUT_S) -> dict:
+    """{process_id: (host, port)} for every host in the pod — blocks
+    until each peer has published."""
+    t = _TOPOLOGY
+    if t is None:
+        raise RuntimeError("multihost not initialized")
+    out = {}
+    for pid in range(t.num_processes):
+        raw = kv_get(f"flowaddr/{pid}", timeout_s)
+        host, _, port = raw.rpartition(":")
+        out[pid] = (host, int(port))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device mesh: hybrid on pods, host-local on the CPU harness
+# ---------------------------------------------------------------------------
+
+def global_mesh():
+    """Device array for the pod-wide mesh.
+
+    On accelerator backends this is ``create_hybrid_device_mesh`` —
+    within-slice axis over ICI, cross-slice axis over DCN (SNIPPETS.md
+    [1] pattern). On the CPU backend cross-process XLA computations are
+    unimplemented, so each host keeps its local device mesh and the
+    cross-host reduction rides the DistSQL merge tree instead; the
+    returned devices are the host-local ones.
+    """
+    import jax
+    if jax.default_backend() == "cpu" or num_hosts() <= 1:
+        return jax.local_devices()
+    import numpy as np
+    from jax.experimental import mesh_utils
+    local = len(jax.local_devices())
+    devs = mesh_utils.create_hybrid_device_mesh(
+        (local,), (num_hosts(),), devices=jax.devices())
+    return list(np.asarray(devs).ravel())
+
+
+# ---------------------------------------------------------------------------
+# merge tree: deterministic parent/children over host process ids
+# ---------------------------------------------------------------------------
+
+def tree_parent(pid: int, fanout: int = DEFAULT_FANOUT) -> Optional[int]:
+    """Parent host in the k-ary merge tree (None for the root/gateway).
+    Heap layout: parent(i) = (i-1)//fanout."""
+    if pid <= 0:
+        return None
+    return (pid - 1) // max(1, fanout)
+
+
+def tree_children(pid: int, n: int,
+                  fanout: int = DEFAULT_FANOUT) -> list:
+    """Child hosts of ``pid`` in an n-host pod (heap layout)."""
+    f = max(1, fanout)
+    kids = [f * pid + 1 + j for j in range(f)]
+    return [k for k in kids if k < n]
+
+
+def merge_depth(n: int, fanout: int = DEFAULT_FANOUT) -> int:
+    """Tree height: DCN hops a partial chunk takes worst-case to reach
+    the gateway (1 for flat fan-in of <= fanout hosts)."""
+    depth, pid = 0, n - 1
+    while pid > 0:
+        pid = tree_parent(pid, fanout)
+        depth += 1
+    return depth
+
+
+def env_topology() -> Optional[HostTopology]:
+    """Topology from COCKROACH_TPU_MULTIHOST_* env vars (hostd's
+    children and bench subprocesses pass identity this way), or None
+    when unset."""
+    n = os.environ.get("COCKROACH_TPU_MULTIHOST_PROCS")
+    if n is None:
+        return None
+    return HostTopology(
+        process_id=int(os.environ.get("COCKROACH_TPU_MULTIHOST_ID", "0")),
+        num_processes=int(n),
+        coordinator=os.environ.get("COCKROACH_TPU_MULTIHOST_COORD", ""),
+        fanout=int(os.environ.get("COCKROACH_TPU_MULTIHOST_FANOUT",
+                                  str(DEFAULT_FANOUT))))

@@ -1,6 +1,20 @@
 """Node server + pgwire SQL API (reference: pkg/server, pkg/sql/pgwire)."""
 
-from .node import Node, NodeConfig
-from .pgwire import PgServer
-
+# Lazy exports (PEP 562): `python -m cockroach_tpu.server.hostd` must
+# reach jax.distributed.initialize BEFORE anything touches a JAX
+# backend, and the eager `from .node import Node` chain imports the
+# whole engine (whose kernel modules trace jnp at import time).
 __all__ = ["Node", "NodeConfig", "PgServer"]
+
+_EXPORTS = {"Node": ("cockroach_tpu.server.node", "Node"),
+            "NodeConfig": ("cockroach_tpu.server.node", "NodeConfig"),
+            "PgServer": ("cockroach_tpu.server.pgwire", "PgServer")}
+
+
+def __getattr__(name):
+    try:
+        mod, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+    return getattr(importlib.import_module(mod), attr)

@@ -1,0 +1,399 @@
+"""Multi-host pod scale-out (round 15).
+
+Two layers:
+
+1. **In-process units** — merge-tree topology math, the degenerate
+   single-process rendezvous (in-process KV store, idempotent
+   init/shutdown, LIFO teardowns), ``physical.merge_partials`` (the
+   pure-numpy mid-tree rung), and a LocalTransport fakedist cluster
+   running the SAME partial-agg statement through the flat fan-in and
+   the hierarchical merge tree — both must be bit-identical to a
+   single-engine oracle.
+2. **Real multi-process pods** — ``server/hostd.py`` subprocesses
+   rendezvous via ``jax.distributed.initialize`` on localhost, each
+   owning its shard of lineitem, and ship partial-agg streams over the
+   socket fabric's host merge tree. Tier-1 runs the 2-process parity
+   check; the 4-process ladder and the fault modes (dispatcher death,
+   dropped merge link) ride the slow lane.
+
+The CPU backend cannot run cross-process XLA computations, so these
+pods exercise exactly what a TPU pod would use the DCN for: the
+rendezvous/KV control plane and the DistSQL data plane. Device
+collectives stay host-local either way (multihost.global_mesh).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.distsql.physical import MergeUnsupported, merge_partials
+from cockroach_tpu.parallel import multihost
+from cockroach_tpu.server.hostd import GROUPBY_SQL, _jsonable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROWS = 600
+
+
+# ---------------------------------------------------------------------------
+# merge-tree topology math
+# ---------------------------------------------------------------------------
+
+class TestTreeTopology:
+    def test_gateway_has_no_parent(self):
+        assert multihost.tree_parent(0) is None
+        assert multihost.tree_parent(0, fanout=7) is None
+
+    def test_heap_layout_small_pod(self):
+        # 7 hosts, fanout 2: the classic binary heap
+        assert multihost.tree_children(0, 7, 2) == [1, 2]
+        assert multihost.tree_children(1, 7, 2) == [3, 4]
+        assert multihost.tree_children(2, 7, 2) == [5, 6]
+        assert multihost.tree_children(3, 7, 2) == []
+
+    @pytest.mark.parametrize("n,f", [(2, 1), (4, 2), (7, 2), (9, 3),
+                                     (16, 4)])
+    def test_parent_child_consistency(self, n, f):
+        for pid in range(1, n):
+            parent = multihost.tree_parent(pid, f)
+            assert pid in multihost.tree_children(parent, n, f)
+        # every host appears as exactly one child
+        seen = [k for p in range(n)
+                for k in multihost.tree_children(p, n, f)]
+        assert sorted(seen) == list(range(1, n))
+
+    def test_merge_depth(self):
+        assert multihost.merge_depth(1, 2) == 0
+        assert multihost.merge_depth(2, 2) == 1
+        assert multihost.merge_depth(3, 2) == 1
+        assert multihost.merge_depth(7, 2) == 2
+        # flat fan-in of <= fanout hosts is one hop regardless
+        assert multihost.merge_depth(4, 8) == 1
+
+
+# ---------------------------------------------------------------------------
+# degenerate single-process rendezvous
+# ---------------------------------------------------------------------------
+
+class TestDegeneratePod:
+    def test_kv_roundtrip_and_idempotence(self):
+        assert not multihost.is_active()
+        topo = multihost.init_distributed(num_processes=1)
+        try:
+            assert topo.is_gateway
+            assert multihost.num_hosts() == 1
+            # same-shape re-init returns the live topology
+            assert multihost.init_distributed(num_processes=1) is topo
+            # in-process KV store + no-op barrier
+            multihost.kv_set("probe", "42")
+            assert multihost.kv_get("probe") == "42"
+            multihost.barrier("ready")
+            # a different shape while live is a stale rendezvous
+            with pytest.raises(RuntimeError, match="already initialized"):
+                multihost.init_distributed(num_processes=2, process_id=1)
+        finally:
+            multihost.shutdown_distributed()
+        assert not multihost.is_active()
+        assert multihost.num_hosts() == 1
+
+    def test_teardowns_run_lifo_once(self):
+        order = []
+        multihost.init_distributed(num_processes=1)
+        multihost.register_teardown(lambda: order.append("a"))
+        multihost.register_teardown(lambda: order.append("b"))
+        multihost.shutdown_distributed()
+        assert order == ["b", "a"]
+        multihost.shutdown_distributed()   # idempotent, no re-run
+        assert order == ["b", "a"]
+
+    def test_env_topology(self, monkeypatch):
+        assert multihost.env_topology() is None
+        monkeypatch.setenv("COCKROACH_TPU_MULTIHOST_PROCS", "4")
+        monkeypatch.setenv("COCKROACH_TPU_MULTIHOST_ID", "3")
+        monkeypatch.setenv("COCKROACH_TPU_MULTIHOST_COORD",
+                           "127.0.0.1:9999")
+        t = multihost.env_topology()
+        assert (t.num_processes, t.process_id) == (4, 3)
+        assert t.parent() == 1 and not t.is_gateway
+
+
+# ---------------------------------------------------------------------------
+# merge_partials: the pure-numpy mid-tree rung
+# ---------------------------------------------------------------------------
+
+def _pchunk(groups, partials, pvalid=None):
+    g = np.asarray(groups)
+    p = np.asarray(partials)
+    n = len(g)
+    pv = np.ones(n, bool) if pvalid is None else np.asarray(pvalid, bool)
+    return (n, {"g": g, "__p0": p},
+            {"g": np.ones(n, bool), "__p0": pv})
+
+
+def _as_dict(merged):
+    k, cols, valid = merged
+    return {cols["g"][i]: (cols["__p0"][i], bool(valid["__p0"][i]))
+            for i in range(k)}
+
+
+class TestMergePartials:
+    def test_sum_merges_overlapping_groups(self):
+        a = _pchunk(["x", "y"], [1, 2])
+        b = _pchunk(["y", "z"], [10, 20])
+        got = _as_dict(merge_partials([a, b], ["g"], {"__p0": "sum"}))
+        assert got == {"x": (1, True), "y": (12, True), "z": (20, True)}
+
+    def test_min_and_null_partials(self):
+        a = _pchunk(["x", "y"], [5, 7], pvalid=[True, False])
+        b = _pchunk(["x"], [3])
+        got = _as_dict(merge_partials([a, b], ["g"], {"__p0": "min"}))
+        assert got["x"] == (3, True)
+        # y only ever contributed a NULL partial: stays invalid
+        assert got["y"][1] is False
+
+    def test_empty_chunks_stay_empty(self):
+        a = _pchunk([], np.zeros(0, np.int64))
+        k, cols, valid = merge_partials([a, a], ["g"], {"__p0": "sum"})
+        assert k == 0 and len(cols["__p0"]) == 0
+
+    def test_unreducible_dtype_raises(self):
+        bad = (2, {"g": np.array(["x", "y"]),
+                   "__p0": np.array(["a", "b"])},
+               {"g": np.ones(2, bool), "__p0": np.ones(2, bool)})
+        with pytest.raises(MergeUnsupported):
+            merge_partials([bad, bad], ["g"], {"__p0": "max"})
+
+
+# ---------------------------------------------------------------------------
+# in-process fakedist: flat fan-in vs merge tree, bit-identical
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree_cluster():
+    from cockroach_tpu.distsql.node import DistSQLNode
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.kvserver.transport import LocalTransport
+    from cockroach_tpu.models import tpch
+    li = tpch.gen_lineitem(0.01, rows=ROWS)
+    part = tpch.gen_part(0.01)
+    transport = LocalTransport()
+    engines, nodes = [], []
+    n = 3
+    for i in range(n):
+        eng = Engine()
+        eng.execute(tpch.DDL["lineitem"])
+        eng.execute(tpch.DDL["part"])
+        lo, hi = i * ROWS // n, (i + 1) * ROWS // n
+        ts = eng.clock.now()
+        eng.store.insert_columns(
+            "lineitem", {k: v[lo:hi] for k, v in li.items()}, ts)
+        eng.store.insert_columns("part", part, ts)
+        engines.append(eng)
+        nodes.append(DistSQLNode(i, eng, transport))
+    oracle = Engine()
+    tpch.load(oracle, sf=0.01, rows=ROWS)
+    yield engines, nodes, oracle
+    for e in engines + [oracle]:
+        e.close()
+
+
+class TestInProcessMergeTree:
+    def _gateway(self, nodes, fanout):
+        from cockroach_tpu.distsql.node import Gateway
+        return Gateway(nodes[0], [0, 1, 2], replicated_tables={"part"},
+                       merge_fanout=fanout)
+
+    def test_tree_matches_flat_and_oracle(self, tree_cluster):
+        engines, nodes, oracle = tree_cluster
+        want = oracle.execute(GROUPBY_SQL).rows
+        flat = self._gateway(nodes, 0).run(GROUPBY_SQL).rows
+        tree = self._gateway(nodes, 2).run(GROUPBY_SQL).rows
+        assert flat == want          # exact sums: no tolerance needed
+        assert tree == want
+        snap = engines[0].metrics.snapshot()
+        # the tree actually engaged: node 0 merged its child stream(s)
+        assert snap.get("distsql.flows.tree", 0) >= 1
+        assert snap.get("exec.multihost.flows.merged", 0) >= 1
+        assert snap.get("exec.multihost.merge.bytes", 0) > 0
+
+    def test_float_fold_stays_flat(self, tree_cluster):
+        # AVG is a float fold (order-dependent) -> merge_exact is
+        # False and fanout must be ignored, not half-applied
+        engines, nodes, oracle = tree_cluster
+        sql = ("SELECT l_returnflag, avg(l_quantity) AS aq "
+               "FROM lineitem GROUP BY l_returnflag "
+               "ORDER BY l_returnflag")
+        before = engines[0].metrics.snapshot().get("distsql.flows.tree", 0)
+        got = self._gateway(nodes, 2).run(sql)
+        want = oracle.execute(sql)
+        after = engines[0].metrics.snapshot().get("distsql.flows.tree", 0)
+        assert after == before       # no tree for this statement
+        for gr, wr in zip(got.rows, want.rows):
+            assert gr[0] == wr[0]
+            assert gr[1] == pytest.approx(wr[1], rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# real multi-process pods over jax.distributed + the socket fabric
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_ENABLE_X64"] = "1"
+    env["COCKROACH_TPU_INVARIANTS"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_pod(nprocs: int, *, fanout: int = 2, rows: int = ROWS,
+            queries: str = "groupby,join", flow_timeout: float = 60.0,
+            fault: str = "none", timeout: float = 300.0) -> dict:
+    """Spawn an N-process hostd pod on localhost and return host 0's
+    JSON result line (results + per-host metric slices)."""
+    port = _free_port()
+
+    def cmd(pid):
+        return [sys.executable, "-m", "cockroach_tpu.server.hostd",
+                "--process-id", str(pid),
+                "--num-processes", str(nprocs),
+                "--coordinator", f"127.0.0.1:{port}",
+                "--fanout", str(fanout), "--rows", str(rows),
+                "--queries", queries,
+                "--flow-timeout", str(flow_timeout),
+                "--fault", fault]
+
+    env = _child_env()
+    workers = [subprocess.Popen(cmd(pid), env=env, cwd=REPO,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+               for pid in range(1, nprocs)]
+    try:
+        proc = subprocess.run(cmd(0), env=env, cwd=REPO,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    finally:
+        deadline = time.monotonic() + 30.0
+        for w in workers:
+            try:
+                w.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                w.kill()
+    assert proc.returncode == 0, \
+        f"gateway host failed:\n{proc.stdout}\n{proc.stderr}"
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    assert line, f"no result line on stdout:\n{proc.stdout}"
+    return json.loads(line)
+
+
+@pytest.fixture(scope="module")
+def pod_oracle():
+    """Single-process engine over the SAME generated data the pod
+    shards across hosts — the bit-identical reference."""
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    eng = Engine()
+    eng.execute(tpch.DDL["lineitem"])
+    eng.execute(tpch.DDL["part"])
+    ts = eng.clock.now()
+    eng.store.insert_columns(
+        "lineitem", tpch.gen_lineitem(0.01, rows=ROWS), ts)
+    eng.store.insert_columns("part", tpch.gen_part(0.01), ts)
+    yield eng
+    eng.close()
+
+
+def _oracle_rows(eng, sql):
+    res = eng.execute(sql)
+    return [[_jsonable(v) for v in r] for r in res.rows]
+
+
+class TestTwoHostPod:
+    """Tier-1: one 2-process pod, results bit-identical to the
+    single-process oracle, merge tree engaged."""
+
+    @pytest.fixture(scope="class")
+    def pod(self):
+        return run_pod(2, fanout=2, queries="groupby,join")
+
+    def test_groupby_bit_identical(self, pod, pod_oracle):
+        assert "error" not in pod["results"]["groupby"]
+        assert pod["results"]["groupby"]["rows"] == \
+            _oracle_rows(pod_oracle, GROUPBY_SQL)
+
+    def test_join_bit_identical(self, pod, pod_oracle):
+        from cockroach_tpu.models import tpch
+        assert "error" not in pod["results"]["join"]
+        assert pod["results"]["join"]["rows"] == \
+            _oracle_rows(pod_oracle, tpch.Q14)
+
+    def test_tree_and_rendezvous_metrics(self, pod):
+        m0 = pod["metrics"]["0"]
+        assert m0["exec.multihost.hosts"] == 2
+        assert m0["distsql.flows.tree"] >= 1
+        # 2 hosts, fanout 2: stream 1 merges on the gateway's own node
+        assert m0["exec.multihost.flows.merged"] >= 1
+        assert m0["exec.multihost.merge.bytes"] > 0
+        # host 1 actually ran its shard and shipped it
+        assert pod["metrics"]["1"]["shuffle.bytes.sent"] > 0
+
+
+@pytest.mark.slow
+class TestPodLadder:
+    def test_four_hosts_bit_identical_with_interior_merge(
+            self, pod_oracle):
+        pod = run_pod(4, fanout=2, queries="groupby")
+        assert pod["results"]["groupby"]["rows"] == \
+            _oracle_rows(pod_oracle, GROUPBY_SQL)
+        # heap layout: host 1 is interior (children 3,4 -> only 3
+        # exists in a 4-pod) and must have tree-merged, so its upward
+        # stream replaced its child's — the DCN-hop reduction
+        m = pod["metrics"]
+        assert m["1"]["exec.multihost.flows.merged"] >= 1
+        assert m["1"]["exec.multihost.merge.bytes"] > 0
+        assert m["0"]["exec.multihost.hosts"] == 4
+
+    def test_flat_fanin_matches_tree(self, pod_oracle):
+        pod = run_pod(2, fanout=0, queries="groupby")
+        assert pod["results"]["groupby"]["rows"] == \
+            _oracle_rows(pod_oracle, GROUPBY_SQL)
+        assert pod["metrics"]["0"].get("distsql.flows.tree", 0) == 0
+
+
+@pytest.mark.slow
+class TestPodFaults:
+    """A dead dispatcher / dropped merge link must surface as a clean
+    typed error on the gateway within the flow timeout — never a hang,
+    never a wrong answer."""
+
+    def _assert_clean_failure(self, pod, nprocs):
+        err = pod["results"]["groupby"].get("error", "")
+        assert "FlowUnavailable" in err, pod["results"]
+        assert "stalled" in err
+        assert pod["metrics"]["0"]["exec.multihost.hosts"] == nprocs
+
+    def test_dispatcher_death(self):
+        pod = run_pod(3, fanout=2, queries="groupby",
+                      flow_timeout=8.0, fault="dispatcher-death")
+        self._assert_clean_failure(pod, 3)
+
+    def test_dropped_merge_link(self):
+        pod = run_pod(3, fanout=2, queries="groupby",
+                      flow_timeout=8.0, fault="drop-link")
+        self._assert_clean_failure(pod, 3)

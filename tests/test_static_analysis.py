@@ -211,6 +211,40 @@ CLEAN_QUEUED_MESH_FN = """
         return queued_collective_call(mesh, dist, batch)
 """
 
+BAD_RENDEZVOUS = """
+    import jax
+
+    def join(coord, n, i):
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=i)
+"""
+
+BAD_MULTIHOST_UTILS = """
+    from jax.experimental import multihost_utils
+
+    def fence(name):
+        multihost_utils.sync_global_devices(name)
+"""
+
+WAIVED_RENDEZVOUS = """
+    import jax
+
+    def leave():
+        # graftlint: waive[collective-discipline] test-only teardown of
+        # a coordinator this process exclusively owns
+        jax.distributed.shutdown()
+"""
+
+CLEAN_RENDEZVOUS = """
+    from ..parallel import multihost
+
+    def join(coord, n, i):
+        return multihost.init_distributed(coord, n, i)
+
+    def leave():
+        multihost.shutdown_distributed()
+"""
+
 
 class TestCollectiveDiscipline:
     RULE = ["collective-discipline"]
@@ -239,6 +273,42 @@ class TestCollectiveDiscipline:
         r = _scan(tmp_path,
                   {"cockroach_tpu/parallel/distagg.py": BAD_COLLECTIVE},
                   self.RULE)
+        assert r["exit_code"] == 0 and not _unwaived(r)
+
+    # round-15 extension: cross-host rendezvous entry points are
+    # sanctioned only in parallel/multihost.py
+
+    def test_rendezvous_outside_multihost_home_is_caught(self,
+                                                         tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/server/bad.py": BAD_RENDEZVOUS},
+                  self.RULE)
+        hits = _unwaived(r, "collective-discipline")
+        assert len(hits) == 1 and r["exit_code"] == 2
+        assert "jax.distributed.initialize" in hits[0].message
+        assert "multihost" in hits[0].message
+
+    def test_multihost_utils_outside_home_is_caught(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/exec/bad.py": BAD_MULTIHOST_UTILS},
+                  self.RULE)
+        hits = _unwaived(r, "collective-discipline")
+        assert len(hits) == 1
+        assert "multihost_utils.sync_global_devices" in hits[0].message
+
+    def test_waived_rendezvous_passes(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/server/waived.py": WAIVED_RENDEZVOUS},
+                  self.RULE)
+        assert r["exit_code"] == 0 and not _unwaived(r)
+        assert r["counts"]["collective-discipline"]["waived"] == 1
+
+    def test_multihost_home_is_exempt(self, tmp_path):
+        r = _scan(tmp_path, {
+            "cockroach_tpu/parallel/multihost.py": BAD_RENDEZVOUS,
+            # wrapper calls from anywhere else are the sanctioned path
+            "cockroach_tpu/server/clean.py": CLEAN_RENDEZVOUS,
+        }, self.RULE)
         assert r["exit_code"] == 0 and not _unwaived(r)
 
 
