@@ -1295,6 +1295,159 @@ def run_multihost(rows: int, repeat: int = 3) -> dict:
     return out
 
 
+def run_elastic(rows: int, repeat: int = 8) -> dict:
+    """Round-16 elastic pod lanes (server/hostd.py --elastic): real
+    host processes over the socket KV coordinator + shard leases.
+
+    Lane A (failover): a 4-host pod runs a sustained groupby/join
+    statement loop; one worker is SIGKILLed mid-loop. The gateway must
+    convict it, move its shard leases to survivors, replan, and finish
+    with ZERO failed statements — every run bit-identical (the
+    ``consistent`` flag compares all runs of a query pairwise).
+
+    Lane B (scale-out): a 2-host pod runs the same loop while two more
+    hosts late-join the RUNNING pod; leases rebalance online (old
+    owners keep serving until the epoch flip) and the final assignment
+    must span all four hosts, again with every run identical.
+
+    Same caveat as the round-15 multihost lanes: all "hosts" share one
+    machine's cores, so rows/s prices the orchestration planes, not
+    pod compute scaling — the transferable signals are the zero failed
+    statements, the failover/lease-move counts, and the rebalance
+    bytes that moved through the movement scheduler's lease."""
+    import tempfile as _tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def _env():
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env.setdefault("JAX_ENABLE_X64", "1")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    def _pod(initial, late=0, kill_after=None, join_after=None):
+        tmp = _tempfile.mkdtemp(prefix="bench-elastic-")
+        addr_file = os.path.join(tmp, "kv_addr")
+        base = [sys.executable, "-m", "cockroach_tpu.server.hostd",
+                "--elastic", "--rows", str(rows), "--nshards", "8",
+                "--queries", "groupby,join", "--repeat", str(repeat),
+                "--statement-gap", "0.15", "--fanout", "2",
+                "--flow-timeout", "60",
+                "--heartbeat-interval", "0.1",
+                "--liveness-window", "1.0"]
+        env = _env()
+        founder = subprocess.Popen(
+            base + ["--process-id", "0", "--kv-addr-file", addr_file,
+                    "--initial-hosts", str(initial)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, cwd=here, text=True)
+        workers, joiners = [], []
+        try:
+            deadline = time.time() + 120
+            while not (os.path.exists(addr_file)
+                       and open(addr_file).read().strip()):
+                if founder.poll() is not None or time.time() > deadline:
+                    err = founder.communicate()[1]
+                    print(f"# elastic founder never published the KV "
+                          f"addr:\n{err[-2000:]}", file=sys.stderr)
+                    return None
+                time.sleep(0.05)
+            addr = open(addr_file).read().strip()
+            for pid in range(1, initial):
+                workers.append(subprocess.Popen(
+                    base + ["--process-id", str(pid),
+                            "--kv-addr", addr],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL, env=env, cwd=here))
+            if join_after is not None:
+                time.sleep(join_after)
+                for pid in range(initial, initial + late):
+                    joiners.append(subprocess.Popen(
+                        base + ["--process-id", str(pid),
+                                "--kv-addr", addr, "--late-join"],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL, env=env, cwd=here))
+            if kill_after is not None:
+                time.sleep(kill_after)
+                workers[-1].kill()      # the failover lane's victim
+            out, err = founder.communicate(timeout=600)
+        finally:
+            grace = time.monotonic() + 60.0
+            for w in workers + joiners:
+                try:
+                    w.wait(timeout=max(0.1,
+                                       grace - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+            if founder.poll() is None:
+                founder.kill()
+        if founder.returncode != 0:
+            print(f"# elastic pod rc={founder.returncode}\n"
+                  f"{err[-2000:]}", file=sys.stderr)
+            return None
+        line = next((ln for ln in out.splitlines()
+                     if ln.startswith("{")), None)
+        return json.loads(line) if line else None
+
+    def _metric_sum(pod, key):
+        return sum((m or {}).get(key, 0)
+                   for m in pod.get("metrics", {}).values())
+
+    out = {"elastic_rows": rows, "elastic_statements_per_query": repeat}
+
+    kill = _pod(initial=4, kill_after=4.0)
+    if kill is not None:
+        res = kill.get("results", {})
+        failed = sum(1 for r in res.values() if "error" in r)
+        out["elastic_kill_failed_statements"] = failed
+        out["elastic_kill_consistent"] = int(all(
+            r.get("consistent") for r in res.values()
+            if "error" not in r) and not failed)
+        gwm = kill.get("metrics", {}).get("0", {})
+        out["elastic_kill_failovers"] = \
+            gwm.get("distsql.degrade.failover", 0)
+        out["elastic_kill_lease_failovers"] = \
+            _metric_sum(kill, "exec.lease.failovers")
+        out["elastic_kill_live_hosts"] = \
+            len(kill.get("membership", {}).get("live", []))
+        for q, t in kill.get("timings", {}).items():
+            out[f"elastic_kill_{q}_rows_per_sec"] = \
+                round(t["rows_per_s"])
+        print(f"# elastic kill-mid-bench: failed={failed} "
+              f"consistent={out['elastic_kill_consistent']} "
+              f"failovers={out['elastic_kill_failovers']} "
+              f"live={kill.get('membership', {}).get('live')}",
+              file=sys.stderr)
+
+    scale = _pod(initial=2, late=2, join_after=3.0)
+    if scale is not None:
+        res = scale.get("results", {})
+        failed = sum(1 for r in res.values() if "error" in r)
+        out["elastic_scaleout_consistent"] = int(all(
+            r.get("consistent") for r in res.values()
+            if "error" not in r) and not failed)
+        mb = scale.get("membership", {})
+        out["elastic_scaleout_live_hosts"] = len(mb.get("live", []))
+        owners = set(mb.get("leases", {}).get("lineitem", {}).values())
+        out["elastic_scaleout_lease_owners"] = len(owners)
+        out["elastic_scaleout_lease_moves"] = \
+            _metric_sum(scale, "exec.lease.moves")
+        out["elastic_scaleout_rebalance_bytes"] = \
+            _metric_sum(scale, "exec.movement.rebalance.bytes")
+        for q, t in scale.get("timings", {}).items():
+            out[f"elastic_scaleout_{q}_rows_per_sec"] = \
+                round(t["rows_per_s"])
+        print(f"# elastic scale-out 2->4: "
+              f"consistent={out['elastic_scaleout_consistent']} "
+              f"live={mb.get('live')} owners={sorted(owners)} "
+              f"moves={out['elastic_scaleout_lease_moves']} "
+              f"rebal_bytes={out['elastic_scaleout_rebalance_bytes']}",
+              file=sys.stderr)
+    return out
+
+
 def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
               mode: str = "tpu_child", extra_env: dict | None = None):
     """One query/measurement in its own subprocess: a fresh backend
@@ -1330,6 +1483,12 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
         # transport; page assembly + frame exchange are host paths, so
         # measure on XLA-CPU (each Engine runs single-device — the
         # distribution axis is across Engines, not mesh devices)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    if mode == "elastic_child":
+        # elastic pod lanes spawn real hostd --elastic processes;
+        # like the multihost lanes they measure the control/data
+        # planes on XLA-CPU hosts
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
     if mode == "tpcc_child":
@@ -1504,6 +1663,16 @@ def main():
             "metric": "multihost_groupby_h2_vs_h1",
             "value": per.get("multihost_groupby_h2_vs_h1", 0),
             "unit": "x", "rows": rows,
+            **per,
+        }))
+        return
+    if mode == "elastic_child":
+        per = run_elastic(rows,
+                          int(os.environ.get("BENCH_REPEATS", 8)))
+        print(json.dumps({
+            "metric": "elastic_kill_failed_statements",
+            "value": per.get("elastic_kill_failed_statements", -1),
+            "unit": "statements", "rows": rows,
             **per,
         }))
         return
@@ -1726,6 +1895,16 @@ def main():
         if r is not None:
             out.update({k: v for k, v in r.items()
                         if k.startswith("multihost_")})
+    # round 16 robustness lanes: elastic pod — kill-one-host
+    # mid-bench (zero failed statements) + 2->4 online scale-out
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        r = run_child(int(os.environ.get("BENCH_ELASTIC_ROWS",
+                                         1 << 15)),
+                      "elastic", max(child_timeout, 1200),
+                      mode="elastic_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("elastic_")})
     if os.environ.get("BENCH_DISPATCHQ", "1") != "0":
         r = run_child(int(os.environ.get("BENCH_DISPATCHQ_ROWS",
                                          1 << 20)),
@@ -1792,7 +1971,17 @@ _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "spill_budget_bytes", "coldstart_rows",
                   "joinskip_budget_bytes", "joinskip_okey_cap",
                   "movement_shard_bytes", "movement_build_bytes",
-                  "multihost_rows"}
+                  "multihost_rows", "elastic_rows",
+                  "elastic_statements_per_query",
+                  "elastic_kill_failed_statements",
+                  "elastic_kill_consistent", "elastic_kill_failovers",
+                  "elastic_kill_lease_failovers",
+                  "elastic_kill_live_hosts",
+                  "elastic_scaleout_consistent",
+                  "elastic_scaleout_live_hosts",
+                  "elastic_scaleout_lease_owners",
+                  "elastic_scaleout_lease_moves",
+                  "elastic_scaleout_rebalance_bytes"}
 
 
 def regression_report(out: dict) -> None:

@@ -25,6 +25,7 @@ from .rules_concurrency import (check_blocking_under_lock,
                                 check_racy_global)
 from .rules_device import (check_collective_discipline,
                            check_no_aliasing_upload)
+from .rules_lease import check_lease_discipline
 from .rules_plan import check_plan_key_completeness
 from .rules_registration import check_registration_drift
 
@@ -36,8 +37,9 @@ RULES = (
     ("blocking-under-lock", 8, check_blocking_under_lock),
     ("plan-key-completeness", 16, check_plan_key_completeness),
     ("registration-drift", 32, check_registration_drift),
+    ("lease-discipline", 64, check_lease_discipline),
 )
-WAIVER_SYNTAX_BIT = 64
+WAIVER_SYNTAX_BIT = 128
 
 
 def changed_files(root) -> list[str] | None:

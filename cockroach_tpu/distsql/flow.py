@@ -116,6 +116,13 @@ class FlowSpec:
     # (it blocks the gateway's own pump when the merge node is the
     # gateway's node), so it must give up no later than the flow would
     merge_timeout: float = 300.0
+    # elastic pod (round 16): the membership epoch this flow was
+    # planned under. A host whose shard set was rebuilt at a NEWER
+    # epoch refuses the flow (its shards moved out from under the
+    # plan), shipping an unavailable-marked error so the gateway
+    # replans instead of double-counting or dropping moved rows.
+    # None = static pod, no epoch fencing.
+    epoch: Optional[int] = None
 
     def to_wire(self) -> dict:
         return {"flow_id": self.flow_id, "gateway": self.gateway,
@@ -128,7 +135,8 @@ class FlowSpec:
                 "adaptive": self.adaptive, "profile": self.profile,
                 "overlap": self.overlap, "merge_to": self.merge_to,
                 "merge_children": self.merge_children,
-                "merge_timeout": self.merge_timeout}
+                "merge_timeout": self.merge_timeout,
+                "epoch": self.epoch}
 
     @staticmethod
     def from_wire(d: dict) -> "FlowSpec":

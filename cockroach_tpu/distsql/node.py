@@ -145,6 +145,11 @@ class DistSQLNode:
         # kvserver.Cluster for leaseholder-partitioned scans: flows
         # carrying spans materialize them from the range plane
         self.cluster = cluster
+        # elastic pod handle (distsql/leases.ElasticPod) when this
+        # node participates in dynamic membership; None = static pod.
+        # Set by ElasticPod's constructor, read by the epoch fence in
+        # _setup_flow and the gateway's failover rung.
+        self.elastic = None
         self.registry = FlowRegistry()
         # the engine's registry: flow/shuffle metrics land next to the
         # SQL metrics so one /_status/vars scrape covers the node
@@ -219,6 +224,17 @@ class DistSQLNode:
             if key in self._producing:   # late acks for finished
                 # streams would otherwise re-create state forever
                 self.acks[key] = self.acks.get(key, 0) + n
+        elif kind == "shard_fetch":
+            # shard-lease rebalance: a gaining host asks for one of
+            # our held shards; page it out through the spill-tier
+            # page machinery (distsql/leases.serve_shard_fetch)
+            from cockroach_tpu.distsql import leases as _leases
+            _leases.serve_shard_fetch(self, frm, payload)
+        elif kind == "shard_page":
+            # one page of an inbound shard-lease rebalance stream
+            _, xid, chunk, eof, error = payload
+            self.registry.inbox(f"xfer:{xid}", 0).push(chunk, eof,
+                                                       error)
         elif kind == "cancel_flow":
             self._cancel(payload[1])
 
@@ -244,6 +260,21 @@ class DistSQLNode:
             # cancel raced ahead of the SetupFlow: drop it unexecuted
             self.flows_cancelled += 1
             return
+        if spec.epoch is not None and self.elastic is not None \
+                and not self.elastic.can_serve_epoch(spec.epoch):
+            # elastic epoch fence: this host's installed shard set does
+            # not match what the flow's epoch assigns it — the rows the
+            # plan expects here may have moved. Try a lazy reconcile
+            # first (a lease flip may simply not have landed locally
+            # yet); if still mismatched, refuse with the unavailable
+            # marker so the gateway replans instead of
+            # double-counting/dropping rows.
+            self.elastic.maybe_reconcile()
+            if not self.elastic.can_serve_epoch(spec.epoch):
+                outbox.close(error=(
+                    f"{_UNAVAILABLE_MARK} node {self.node_id} rebuilt "
+                    f"its shard set past epoch {spec.epoch}; replan"))
+                return
         key = (spec.flow_id, spec.stream_id)
         if key in self._flows_seen:
             return          # duplicate SetupFlow: already ran/running
@@ -296,13 +327,23 @@ class DistSQLNode:
             self._producing.discard((spec.flow_id, spec.stream_id))
             self.acks.pop((spec.flow_id, spec.stream_id), None)
 
+    def _diag_consumer(self, spec: FlowSpec) -> int:
+        """Diagnostic frames follow the DATA topology: a mid-tree
+        stream's flow_span/flow_profile frames land on its merge
+        parent — which relays them up re-tagged with its own stream —
+        so diagnostic ingress at the gateway is bounded by fanout
+        exactly like data ingress, instead of every producer fanning
+        spans straight at the gateway (round-15 carried follow-up)."""
+        return (spec.merge_to if spec.merge_to is not None
+                else spec.gateway)
+
     def _send_flow_span(self, spec: FlowSpec, wire: dict) -> None:
-        self.transport.send(self.node_id, spec.gateway,
+        self.transport.send(self.node_id, self._diag_consumer(spec),
                             ("flow_span", spec.flow_id,
                              spec.stream_id, wire))
 
     def _send_flow_profile(self, spec: FlowSpec, wire: dict) -> None:
-        self.transport.send(self.node_id, spec.gateway,
+        self.transport.send(self.node_id, self._diag_consumer(spec),
                             ("flow_profile", spec.flow_id,
                              spec.stream_id, wire))
 
@@ -555,6 +596,8 @@ class DistSQLNode:
         inboxes = {sid: self.registry.inbox(spec.flow_id, sid)
                    for sid in sids}
         idle = float(spec.merge_timeout or Outbox.CREDIT_TIMEOUT)
+        fwd_spans: list = []
+        fwd_profiles: list = []
         try:
             deadline = _time.monotonic() + idle
             while not all(ib.eof for ib in inboxes.values()):
@@ -583,12 +626,31 @@ class DistSQLNode:
             absorbed = sum(ib.bytes_received for ib in inboxes.values())
             child = [c for ib in inboxes.values()
                      for c in ib.drain_arrays()]
+            # child diagnostic frames rode their streams to US (the
+            # merge parent) — relay them upward re-tagged with our
+            # own stream so they hop the tree one level at a time
+            fwd_spans = [w for ib in inboxes.values()
+                         for w in ib.spans]
+            fwd_profiles = [w for ib in inboxes.values()
+                            for w in ib.profiles]
         finally:
             # per-stream release, NOT flow-wide: on the gateway's own
             # node the gateway's direct inboxes for this flow share
             # this registry
             for sid in sids:
                 self.registry.release_stream(spec.flow_id, sid)
+        if fwd_spans or fwd_profiles:
+            for w in fwd_spans:
+                self._send_flow_span(spec, w)
+            for w in fwd_profiles:
+                self._send_flow_profile(spec, w)
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "exec.multihost.diag.forwarded",
+                    "flow_span/flow_profile frames relayed up the "
+                    "merge tree by mid-tree nodes (diagnostic "
+                    "ingress bounded by fanout like data)").inc(
+                    len(fwd_spans) + len(fwd_profiles))
         chunks = own + child
         partial = [c for c in chunks if "__p0" in c[1]]
         raw = [c for c in chunks if "__p0" not in c[1]]
@@ -1093,7 +1155,8 @@ class Gateway:
                  prefer_shuffle: bool = False,
                  adaptive_agg: bool = True,
                  overlap: bool = True,
-                 merge_fanout: int = 0):
+                 merge_fanout: int = 0,
+                 elastic=None):
         # prefer_shuffle: route every shuffle-decomposable statement
         # through the multi-stage hash-exchange graph, even when a
         # single-stage plan would work (the sharded⋈sharded path is
@@ -1115,6 +1178,13 @@ class Gateway:
         # the gateway. 0 = the classic flat fan-in (A/B lever; also
         # the only shape non-combine-exact statements ever use).
         self.merge_fanout = int(merge_fanout)
+        # elastic pod (round 16, distsql/leases.ElasticPod): the node
+        # set comes from the epoch'd member view instead of the static
+        # list, flows carry the planning epoch, and mid-flow host loss
+        # takes the failover rung (expel -> lease reassignment ->
+        # replan on survivors with harvested partials) instead of
+        # raising FlowUnavailable at the caller.
+        self.elastic = elastic
         self.own = own
         self.nodes = data_nodes
         # tables fully present on every data node (dimension tables);
@@ -1318,6 +1388,11 @@ class Gateway:
         Only FlowUnavailable (node death) degrades; a remote execution
         error propagates unchanged."""
         def live() -> list:
+            if self.elastic is not None:
+                # the epoch'd member view IS the planner's node set:
+                # joiners appear as soon as their leases flip, drained
+                # hosts disappear with theirs
+                return self.elastic.data_nodes()
             if self.cluster is None or self.monitor is None:
                 return list(self.nodes)
             # plan on the currently-live set up front: a known-dead
@@ -1348,6 +1423,9 @@ class Gateway:
         try:
             return self._run_once(sql, chunk_rows, first)
         except FlowUnavailable as err:
+            if self.elastic is not None:
+                return self._elastic_failover(sql, chunk_rows, first,
+                                              err)
             if self.cluster is None:
                 raise
             if not self._replannable(sql):
@@ -1394,6 +1472,76 @@ class Gateway:
                         "degradation ladder: replans on a shrunken "
                         "node set")
             return self._run_once(sql, chunk_rows, healthy)
+
+    def _elastic_failover(self, sql: str, chunk_rows: int,
+                          first: list, err, depth: int = 0):
+        """The elastic rung of the degradation ladder: a participant
+        went silent mid-flow. Wait (bounded by flow_timeout) for the
+        heartbeat plane to convict the silent hosts, expel them and
+        reassign their shard leases to survivors (data via the
+        recover hook — the owners are gone), then re-enter the
+        round-8 replan ladder on the survivor set: the merge tree
+        re-heaps around the hole because _run_once rebuilds it over
+        the new node list, and partials are re-requested ONLY from
+        hosts whose shard set changed — flat-mode streams that
+        finished cleanly on the first attempt are harvested off the
+        failed flow and reused at the SAME read_ts."""
+        from ..utils import log
+        pod = self.elastic
+        mem = pod.membership
+        wait = min(self.flow_timeout, mem.window * 2.0 + 1.0)
+        deadline = _time.monotonic() + wait
+        others = [n for n in first if n != self.own.node_id]
+        while True:
+            dead = [n for n in others if not mem.alive(n)]
+            if dead or _time.monotonic() > deadline:
+                break
+            self.own.transport.deliver_all()
+            _time.sleep(0.01)
+        if not dead:
+            if "rebuilt its shard set past epoch" in str(err) \
+                    and depth < 2:
+                # not a host loss: a host refused the flow because a
+                # concurrent join/drain flipped the epoch under the
+                # plan. Everyone is alive — replan at the new epoch.
+                self._count("distsql.degrade.replan",
+                            "degradation ladder: replans on a "
+                            "shrunken node set")
+                return self._run_once(sql, chunk_rows,
+                                      pod.data_nodes())
+            # nobody convicted within the window: the stall was not a
+            # host loss this rung can repair — propagate
+            raise err
+        log.info(log.OPS,
+                 "elastic failover: host(s) %s convicted mid-flow; "
+                 "reassigning leases and replanning (%s)", dead, err)
+        self._count("distsql.degrade.failover",
+                    "degradation ladder: elastic failovers (host "
+                    "expelled, leases reassigned, statement replanned "
+                    "on survivors)")
+        _view, changed = pod.fail_over(dead)
+        survivors = pod.data_nodes()
+        if not survivors:
+            raise err
+        harvest = getattr(err, "harvest", None) or {}
+        reuse = {n: c for n, c in harvest.items()
+                 if n in survivors and n not in changed}
+        if reuse and self.metrics is not None:
+            self.metrics.counter(
+                "distsql.failover.partials_reused",
+                "first-attempt streams reused across an elastic "
+                "failover (hosts whose shard set did not change)"
+            ).inc(len(reuse))
+        try:
+            return self._run_once(sql, chunk_rows, survivors,
+                                  reuse=reuse,
+                                  read_ts=getattr(err, "read_ts",
+                                                  None))
+        except FlowUnavailable as err2:
+            if depth >= 2:
+                raise
+            return self._elastic_failover(sql, chunk_rows, survivors,
+                                          err2, depth + 1)
 
     def explain_analyze(self, sql: str, chunk_rows: int = 65536,
                         debug: bool = False):
@@ -1515,10 +1663,18 @@ class Gateway:
         return eng.execute(sql)
 
     def _run_once(self, sql: str, chunk_rows: int = 65536,
-                  nodes: list | None = None):
+                  nodes: list | None = None,
+                  reuse: dict | None = None,
+                  read_ts: int | None = None):
         # the node set is a PARAMETER (not mutated shared state): a
         # concurrent statement's replan must never tear another's view
         nodes = list(nodes) if nodes is not None else list(self.nodes)
+        # reuse: {node_id: drained chunks} harvested off a failed
+        # attempt's EOF-clean flat streams (elastic failover) — those
+        # nodes get no SetupFlow; their chunks inject at the union.
+        # read_ts pins the retry to the FIRST attempt's timestamp so
+        # reused and recomputed chunks read the same snapshot.
+        reuse = reuse or {}
         eng = self.own.engine
         transport = self.own.transport
         try:
@@ -1551,7 +1707,10 @@ class Gateway:
             self._check_join_placement(node)
         stage = split(node)
         flow_id = uuid.uuid4().hex[:12]
-        read_ts = int(eng.clock.now().to_int())
+        if read_ts is None:
+            read_ts = int(eng.clock.now().to_int())
+        epoch = (self.elastic.membership.epoch()
+                 if self.elastic is not None else None)
         jf_frames = self._derive_join_frames(node, read_ts)
 
         # fail fast on breaker-tripped peers: scheduling a flow onto a
@@ -1584,14 +1743,21 @@ class Gateway:
         # is a heap over stream indices, so stream 0 — the gateway's
         # own node — is the root and the gateway pumps ONE inbox.
         fan = self.merge_fanout
+        # reuse forces the flat fan-in: harvested chunks are per-NODE
+        # streams, and a tree root's merged stream would double-count
+        # them (the tree re-heaps on the NEXT full plan instead)
         tree = (fan > 0 and stage.stage == "partial_agg"
-                and stage.merge_exact and len(nodes) >= 2)
+                and stage.merge_exact and len(nodes) >= 2
+                and not reuse)
         if tree:
             self._count("distsql.flows.tree",
                         "distributed flows whose partial-agg streams "
                         "ran as a hierarchical merge tree")
         inboxes = []
+        inbox_nodes = []
         for i, nid in enumerate(nodes):
+            if nid in reuse:
+                continue   # harvested from the failed attempt
             merge_to = merge_children = None
             if tree:
                 if i > 0:
@@ -1610,18 +1776,23 @@ class Gateway:
                             overlap=self.overlap,
                             merge_to=merge_to,
                             merge_children=merge_children,
-                            merge_timeout=self.flow_timeout)
+                            merge_timeout=self.flow_timeout,
+                            epoch=epoch)
             if not tree or i == 0:
                 # mid-tree streams terminate at their merge parent;
                 # only the root stream reaches the gateway
                 inboxes.append(registry.inbox(flow_id, i))
+                inbox_nodes.append(nid)
             transport.send(self.own.node_id, nid,
                            ("setup_flow", spec.to_wire()))
+        extra = [c for nid in nodes if nid in reuse
+                 for c in reuse[nid]]
         union, merged_dicts = self._pump_and_union(
             flow_id, inboxes, stage.union_columns, stage.string_cols,
             nodes, stage=(stage if adaptive else None),
             read_ts=read_ts,
-            participants=(list(nodes) if tree else None))
+            participants=(list(nodes) if tree else None),
+            inbox_nodes=inbox_nodes, extra_chunks=extra)
 
         # output dictionaries come from the merged wire strings, not the
         # gateway's (possibly empty) local shard
@@ -1732,12 +1903,21 @@ class Gateway:
     def _pump_and_union(self, flow_id, inboxes, union_columns,
                         string_cols, nodes: list | None = None,
                         stage=None, read_ts=None,
-                        participants: list | None = None):
+                        participants: list | None = None,
+                        inbox_nodes: list | None = None,
+                        extra_chunks: list | None = None):
         # participants: the FULL node set feeding this flow when it is
         # wider than the direct producers (hierarchical merge: the
         # gateway pumps one root inbox but a death anywhere in the
         # tree starves it) — the monitor fail-fast must watch them all
+        # inbox_nodes: producer node per inbox (positional with
+        # ``inboxes``; defaults to ``nodes`` for the classic shape
+        # where stream i <- node i with no gaps)
+        # extra_chunks: pre-drained chunks injected at the union —
+        # harvested first-attempt streams across an elastic failover
         nodes = nodes if nodes is not None else list(self.nodes)
+        if inbox_nodes is None:
+            inbox_nodes = list(nodes[:len(inboxes)])
         transport = self.own.transport
         registry = self.own.registry
         # drive the network until all streams finish. In-process
@@ -1760,9 +1940,10 @@ class Gateway:
                     waiting = [n for n in participants
                                if n != self.own.node_id]
                 else:
-                    waiting = [nodes[i] for i, ib in enumerate(inboxes)
+                    waiting = [inbox_nodes[i]
+                               for i, ib in enumerate(inboxes)
                                if not ib.eof and
-                               nodes[i] != self.own.node_id]
+                               inbox_nodes[i] != self.own.node_id]
                 sick = [n for n in waiting
                         if not self.monitor.healthy(n)]
                 if sick:
@@ -1812,12 +1993,28 @@ class Gateway:
                         psink.remote_walls.append(
                             (w.get("node"),
                              float(w.get("device_time_s", 0.0))))
-            chunks = [c for ib in inboxes for c in ib.drain_arrays()]
+            chunks = list(extra_chunks or []) + \
+                [c for ib in inboxes for c in ib.drain_arrays()]
             if stage is not None:
                 chunks = self._fold_raw_chunks(chunks, stage, read_ts)
             union, merged_dicts = self._union_batch(
                 chunks, union_columns, string_cols)
-        except Exception:
+        except Exception as exc:
+            if isinstance(exc, FlowUnavailable) \
+                    and participants is None:
+                # harvest EOF-clean flat streams off the failed
+                # attempt: a survivor whose shard leases do not move
+                # in the failover need not recompute — its chunks
+                # (plus any already-reused ones) ride into the retry
+                # at the same read_ts. Flat mode only: a merge-tree
+                # root's stream aggregates the whole tree, including
+                # the hole.
+                h = {}
+                for hn, ib in zip(inbox_nodes, inboxes):
+                    if ib.eof and not ib.error:
+                        h[hn] = ib.drain_arrays()
+                exc.harvest = h
+                exc.read_ts = read_ts
             # tell every producer to stop: without this a stalled or
             # errored flow leaves remote stages running and pushing
             # chunks at a gateway that has already given up

@@ -497,7 +497,28 @@ def merge_partials(chunks, group_cols, merge_funcs):
         contrib = np.where(pv, vals, ident)
         acc = np.full(k, ident, dtype=dt)
         if func in ("sum", "sum_int"):
-            np.add.at(acc, inv, contrib)
+            if dt.kind in "iu":
+                # int SUM folds must not wrap silently (round-15
+                # carried follow-up): accumulate through Python ints
+                # (object dtype — arbitrary precision) and compare
+                # against the native dtype's range. In range → cast
+                # back, bit-identical to a non-overflowing native
+                # fold; out of range → MergeUnsupported, so the
+                # caller forwards unmerged and the overflow surfaces
+                # at the gateway's device fold (__sum_overflow guard)
+                # instead of as a silently wrapped number.
+                wide = np.zeros(k, dtype=object)
+                np.add.at(wide, inv, contrib.astype(object))
+                info = np.iinfo(dt)
+                lo = min((int(x) for x in wide), default=0)
+                hi = max((int(x) for x in wide), default=0)
+                if lo < int(info.min) or hi > int(info.max):
+                    raise MergeUnsupported(
+                        f"partial column {p!r}: {dt} SUM overflow "
+                        f"in tree merge (range [{lo}, {hi}])")
+                acc = wide.astype(dt)
+            else:
+                np.add.at(acc, inv, contrib)
         elif func == "min":
             np.minimum.at(acc, inv, contrib)
         else:

@@ -46,11 +46,12 @@ from contextlib import contextmanager
 from ..utils.mon import MemoryQuotaError
 
 # transient kinds — one vocabulary so metrics and accounts line up
-KIND_PAGE = "page"          # stream/spill page windows
-KIND_SPILL = "spill"        # spill partition working slices
-KIND_EXCHANGE = "exchange"  # shuffle frames / gateway union buffers
+KIND_PAGE = "page"           # stream/spill page windows
+KIND_SPILL = "spill"         # spill partition working slices
+KIND_EXCHANGE = "exchange"   # shuffle frames / gateway union buffers
+KIND_REBALANCE = "rebalance"  # shard-lease handoff pages (elastic pod)
 
-_KINDS = (KIND_PAGE, KIND_SPILL, KIND_EXCHANGE)
+_KINDS = (KIND_PAGE, KIND_SPILL, KIND_EXCHANGE, KIND_REBALANCE)
 
 # A lease that cannot be admitted waits at most this long for other
 # transient traffic to drain before giving up with the quota error —
@@ -93,6 +94,10 @@ class TransferScheduler:
             "exec.movement.exchange.overcommit.bytes",
             "exchange bytes that proceeded unreserved after waiting "
             "for the pool (admission degraded, not denied)")
+        self.m_rebalance = metrics.counter(
+            "exec.movement.rebalance.bytes",
+            "shard-lease rebalance bytes streamed between hosts "
+            "through the scheduler")
 
     # -- resident forwarding ------------------------------------------
     def reserve_resident(self, account, nbytes: int) -> None:
@@ -156,6 +161,8 @@ class TransferScheduler:
         self.m_inflight.set(self._transient)
         if kind == KIND_EXCHANGE:
             self.m_exchange.inc(nbytes)
+        elif kind == KIND_REBALANCE:
+            self.m_rebalance.inc(nbytes)
         else:
             self.m_h2d.inc(nbytes)
         try:
@@ -193,6 +200,8 @@ class TransferScheduler:
         self.m_inflight.set(self._transient)
         if kind == KIND_EXCHANGE:
             self.m_exchange.inc(nbytes)
+        elif kind == KIND_REBALANCE:
+            self.m_rebalance.inc(nbytes)
         else:
             self.m_h2d.inc(nbytes)
         try:

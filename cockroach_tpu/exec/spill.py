@@ -150,6 +150,23 @@ def _host_key_cols(src, names):
     return cols, valids
 
 
+def host_page_iter(n_rows: int, cols: dict, page_rows: int):
+    """Fixed-size host pages over a column dict — the spill tier's
+    page discipline exposed for host→host movers (shard-lease
+    rebalance streams ride this so a shard handoff's working set is
+    bounded per page exactly like a spill partition upload). Yields
+    ``(page_len, {col: slice})``; always yields at least one (possibly
+    empty) page so empty shards still produce a schema-carrying
+    frame."""
+    page_rows = max(1, int(page_rows))
+    if n_rows <= 0:
+        yield 0, {c: v[:0] for c, v in cols.items()}
+        return
+    for lo in range(0, n_rows, page_rows):
+        hi = min(n_rows, lo + page_rows)
+        yield hi - lo, {c: v[lo:hi] for c, v in cols.items()}
+
+
 def _partition_indices(pids: np.ndarray, nparts: int) -> list:
     """Global row indices per partition, ascending within each (stable
     argsort keeps row order), so chunk-run gather assembly applies."""

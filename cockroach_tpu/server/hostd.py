@@ -21,6 +21,18 @@ localhost; on a real pod the same command line runs once per host
 with the coordinator pointing at host 0. Fault modes (--fault) let
 the cross-host ladder tests kill a dispatcher or drop a merge link
 deterministically.
+
+``--elastic`` (round 16) switches to the DYNAMIC pod: no
+jax.distributed, no fixed --num-processes. Host 0 founds the pod
+(serves the socket KV coordinator, writes its address to
+--kv-addr-file), waits for --initial-hosts members, bootstraps the
+shard-lease table, and runs the statement loop; every other host
+points --kv-addr at the coordinator and either joins the founding
+set or — with --late-join — joins a RUNNING pod, streaming its new
+shards from their live owners before the lease flip. --drain-after
+makes a worker exit in an orderly drain mid-run, and --mem-fault
+injects membership-plane faults (delayed heartbeats, stale-epoch
+lease claims) for the churn ladder.
 """
 
 from __future__ import annotations
@@ -46,7 +58,10 @@ GROUPBY_SQL = (
     "ORDER BY l_returnflag, l_linestatus")
 
 _METRIC_KEYS = ("shuffle.bytes.", "exec.multihost.", "distsql.flows",
-                "exec.movement.exchange", "exec.agg.adaptive")
+                "exec.movement.exchange", "exec.agg.adaptive",
+                "cluster.membership.", "exec.lease.",
+                "exec.movement.rebalance", "distsql.degrade.",
+                "distsql.failover.")
 
 
 def _queries():
@@ -188,6 +203,198 @@ def _gather_peer_metrics(topo) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# elastic pod (round 16): dynamic membership + shard leases
+# ---------------------------------------------------------------------------
+
+def _elastic_recover(rows: int, nshards: int):
+    """Deterministic shard regeneration — the durable-storage stand-in
+    every elastic host agrees on: shard s of lineitem is rows
+    [s*R/NSH, (s+1)*R/NSH) of the seeded generator."""
+    from cockroach_tpu.models import tpch
+    li = tpch.gen_lineitem(0.01, rows=rows)
+
+    def recover(table: str, sid: int) -> dict:
+        assert table == "lineitem", table
+        lo = sid * rows // nshards
+        hi = (sid + 1) * rows // nshards
+        return {k: v[lo:hi] for k, v in li.items()}
+    return recover
+
+
+def _install_mem_faults(args) -> None:
+    if args.mem_fault == "none":
+        return
+    f = multihost.MembershipFaults(
+        heartbeat_delay_s=(args.liveness_window * 2.0
+                           if args.mem_fault == "delayed-heartbeat"
+                           else 0.0),
+        stale_epoch_claims=(args.mem_fault == "stale-epoch"),
+        hosts=(args.process_id,))
+    multihost.install_membership_faults(f)
+
+
+def _elastic_serve(transport, pod, refresh_peers, drain_after: float):
+    """Elastic worker pump: flow traffic + idle-time lease reconcile,
+    until the gateway posts ``done`` (or our drain deadline lands)."""
+    drain_at = (time.monotonic() + drain_after
+                if drain_after > 0 else None)
+    while True:
+        refresh_peers()
+        moved = transport.deliver_all()
+        if pod.node is None or not pod.node._producing:
+            try:
+                pod.reconcile()
+            except Exception:   # noqa: BLE001 — coordinator may be
+                return          # gone: the pod is tearing down
+        if drain_at is not None and time.monotonic() > drain_at:
+            pod.drain_pod()
+            return
+        if moved or transport.pending():
+            continue
+        if multihost.kv_try_get("done"):
+            return
+        time.sleep(0.005)
+
+
+def _elastic_main(args) -> int:
+    from cockroach_tpu.distsql import leases as L
+    from cockroach_tpu.distsql.node import DistSQLNode, Gateway
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.rpc.context import SocketTransport
+    from cockroach_tpu.storage.hlc import Timestamp
+
+    hid = args.process_id
+    founder = not args.kv_addr
+    eng = Engine()
+    eng.execute(tpch.DDL["lineitem"])
+    eng.execute(tpch.DDL["part"])
+    eng.store.insert_columns("part", tpch.gen_part(0.01),
+                             Timestamp(1, 0))
+    mem = multihost.init_elastic(
+        hid, kv_addr=args.kv_addr, serve_kv=founder,
+        fanout=max(1, args.fanout), metrics=eng.metrics,
+        heartbeat_interval=args.heartbeat_interval,
+        liveness_window=args.liveness_window)
+    if founder and args.kv_addr_file:
+        with open(args.kv_addr_file, "w") as f:
+            f.write(multihost.elastic_kv_addr())
+    _install_mem_faults(args)
+
+    transport = SocketTransport(hid)
+    try:
+        transport.attach_metrics(eng.metrics)
+    except Exception:
+        pass
+    host, port = transport.addr
+    multihost.kv_set(f"flowaddr/{hid}", f"{host}:{port}")
+    multihost.register_teardown(transport.close)
+    node = DistSQLNode(hid, eng, transport)
+    keeper = L.ShardKeeper(eng)
+    keeper.register_table("lineitem", tpch.DDL["lineitem"])
+    leases = L.ShardLeases(mem, metrics=eng.metrics)
+    pod = L.ElasticPod(hid, mem, leases, keeper, node=node,
+                       recover=_elastic_recover(args.rows,
+                                                args.nshards))
+
+    known = {hid}
+
+    def refresh_peers() -> None:
+        for sid, raw in multihost.kv_list("flowaddr/").items():
+            pid = int(sid)
+            if pid not in known and raw:
+                h, _, p = raw.rpartition(":")
+                transport.connect(pid, (h, int(p)))
+                known.add(pid)
+
+    if not founder:
+        mem.start_heartbeat()
+        if args.late_join:
+            refresh_peers()
+            pod.join_pod(timeout_s=args.flow_timeout)
+        else:
+            mem.join()
+        _elastic_serve(transport, pod, refresh_peers,
+                       args.drain_after)
+        try:
+            multihost.kv_set(f"hostmetrics/{hid}",
+                             json.dumps(_metric_slice(eng)))
+        except Exception:
+            pass
+        time.sleep(0.2)
+        eng.close()
+        return 0
+
+    # founder = gateway: wait for the founding member set, bootstrap
+    # the lease table, then run the statement loop under churn
+    mem.join()
+    mem.start_heartbeat()
+    deadline = time.monotonic() + args.flow_timeout
+    while len(mem.view().live) < args.initial_hosts:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"elastic pod: {len(mem.view().live)} of "
+                f"{args.initial_hosts} founding hosts joined")
+        time.sleep(0.01)
+    owners = sorted(mem.view().live)[:args.initial_hosts]
+    pod.bootstrap("lineitem", tpch.DDL["lineitem"], args.nshards,
+                  owners)
+    refresh_peers()
+    gw = Gateway(node, pod.data_nodes(),
+                 replicated_tables={"part"},
+                 flow_timeout=args.flow_timeout,
+                 merge_fanout=args.fanout, elastic=pod)
+    out = {"hosts": args.initial_hosts, "rows": args.rows,
+           "fanout": args.fanout, "elastic": True,
+           "results": {}, "timings": {}}
+    qs = _queries()
+    names = [q for q in args.queries.split(",") if q]
+    for name in names:
+        best, rows_out, consistent = None, None, True
+        try:
+            for _ in range(max(1, args.repeat)):
+                refresh_peers()
+                pod.maybe_reconcile()
+                t0 = time.monotonic()
+                res = gw.run(qs[name])
+                dt = time.monotonic() - t0
+                best = dt if best is None else min(best, dt)
+                got = [[_jsonable(v) for v in r] for r in res.rows]
+                if rows_out is None:
+                    rows_out = got
+                elif got != rows_out:
+                    consistent = False
+                if args.statement_gap > 0:
+                    time.sleep(args.statement_gap)
+        except Exception as e:  # noqa: BLE001 — harness asserts shape
+            out["results"][name] = {
+                "error": f"{type(e).__name__}: {e}"}
+            continue
+        out["results"][name] = {"names": list(res.names),
+                                "rows": rows_out,
+                                "runs": max(1, args.repeat),
+                                "consistent": consistent}
+        out["timings"][name] = {"elapsed_s": best,
+                                "rows_per_s": args.rows / best}
+    from cockroach_tpu.server.node import membership_status
+    out["membership"] = membership_status()
+    out["metrics"] = {"0": _metric_slice(eng)}
+    multihost.kv_set("done", "1")
+    for pid in sorted(int(s) for s in
+                      multihost.kv_list("flowaddr/").keys()):
+        if pid == hid:
+            continue
+        try:
+            out["metrics"][str(pid)] = json.loads(
+                multihost.kv_get(f"hostmetrics/{pid}", timeout_s=5.0))
+        except Exception:
+            out["metrics"][str(pid)] = None   # died / drained early
+    print(json.dumps(out), flush=True)
+    eng.close()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="cockroach_tpu.server.hostd")
     ap.add_argument("--process-id", type=int, default=0)
@@ -204,7 +411,38 @@ def main(argv=None) -> int:
     ap.add_argument("--flow-timeout", type=float, default=60.0)
     ap.add_argument("--fault", default="none",
                     choices=["none", "dispatcher-death", "drop-link"])
+    # -- elastic pod (round 16) ------------------------------------
+    ap.add_argument("--elastic", action="store_true",
+                    help="dynamic-membership pod: shard leases, "
+                    "online join/drain, statement failover")
+    ap.add_argument("--kv-addr", default="",
+                    help="elastic coordinator host:port (empty = "
+                    "found the pod and serve the KV)")
+    ap.add_argument("--kv-addr-file", default="",
+                    help="founder writes its coordinator address "
+                    "here for late joiners")
+    ap.add_argument("--nshards", type=int, default=8)
+    ap.add_argument("--initial-hosts", type=int, default=2,
+                    help="founder bootstraps leases once this many "
+                    "members joined")
+    ap.add_argument("--late-join", action="store_true",
+                    help="join a RUNNING pod: stream shards from "
+                    "live owners, then flip")
+    ap.add_argument("--drain-after", type=float, default=0.0,
+                    help="worker drains out of the pod after this "
+                    "many seconds (0 = never)")
+    ap.add_argument("--statement-gap", type=float, default=0.0,
+                    help="sleep between gateway statements (gives "
+                    "churn a window to land mid-run)")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.1)
+    ap.add_argument("--liveness-window", type=float, default=1.0)
+    ap.add_argument("--mem-fault", default="none",
+                    choices=["none", "delayed-heartbeat",
+                             "stale-epoch"])
     args = ap.parse_args(argv)
+
+    if args.elastic:
+        return _elastic_main(args)
 
     topo = multihost.init_distributed(
         coordinator=args.coordinator,

@@ -103,6 +103,40 @@ def _tenants_payload(engine) -> dict:
                         for t in engine.sqlstats.tenants()]}
 
 
+def membership_status() -> dict:
+    """The /_status/membership body: this host's view of the elastic
+    pod — epoch'd live set, per-member state/incarnation, heartbeat
+    suspects, and the shard-lease assignment at the current epoch
+    (read through the epoch-guarded LeaseView, never the raw lease
+    records). ``{"elastic": false}`` when the pod is static or
+    single-process (pkg/server/status.go NodesLiveness analogue)."""
+    from cockroach_tpu.parallel import multihost
+    mem = multihost.membership()
+    if mem is None:
+        return {"elastic": False}
+    view = mem.view()
+    out = {
+        "elastic": True,
+        "host_id": mem.host_id,
+        "incarnation": mem.incarnation,
+        "epoch": view.epoch,
+        "live": sorted(view.live),
+        "members": {str(h): dict(view.members.get(str(h), {}))
+                    for h in view.live},
+        "suspects": sorted(mem.suspects(view.live)),
+        "expelled": bool(mem.expelled()),
+    }
+    try:
+        from cockroach_tpu.distsql.leases import ShardLeases
+        lv = ShardLeases(mem).view_at(view.epoch)
+        out["leases"] = {
+            t: {str(s): o for s, o in sorted(lv.assignment(t).items())}
+            for t in sorted(lv.assignments)}
+    except Exception:   # noqa: BLE001 — lease table may not exist yet
+        out["leases"] = {}
+    return out
+
+
 def register_status_sources(cluster, engine) -> None:
     """Expose this engine's tracez/statements/tenants payloads to
     peers over the NetCluster "status" RPC (the server side of
@@ -335,6 +369,12 @@ class Node:
                         "tables": sorted(node.store.tables),
                         "peers": peers,
                     }).encode()
+                    ctype = "application/json"
+                elif path == "/_status/membership":
+                    # elastic-pod membership + shard leases as this
+                    # host sees them (epoch'd view, suspects,
+                    # epoch-guarded lease assignment)
+                    body = json.dumps(membership_status()).encode()
                     ctype = "application/json"
                 elif path == "/_status/statements":
                     # per-fingerprint statement stats (pkg/server

@@ -1,0 +1,760 @@
+"""Elastic pod (round 16): dynamic membership, shard leases that move,
+and mid-statement failover.
+
+Layers:
+
+1. **Membership units** — join/leave epochs over the degenerate
+   in-process KV, heartbeat liveness windows, incarnation fencing on
+   same-id rejoin, expel/expelled.
+2. **Lease-plane units** — ``plan_rebalance`` determinism and minimal
+   movement, the epoch-guarded ``LeaseView``, stale-epoch transition
+   fencing (a stale claim loses a CAS instead of double-owning).
+3. **Churn matrix (fast lane)** — LocalTransport pods in one process:
+   (join | drain | kill) x (idle | mid-scan | mid-merge). Mid-statement
+   churn is injected deterministically between transport pumps, so the
+   lease flip lands while the flow's streams are in flight and the
+   epoch fence / replan ladder must absorb it. Every statement must be
+   bit-identical to the single-engine oracle, every epoch must leave
+   each shard owned (and INSTALLED) exactly once, and no pod may wedge.
+4. **Membership faults** — delayed heartbeats (suspect, never expelled,
+   statement still clean), stale-epoch lease claims (cleanly fenced),
+   kill + same-id rejoin (incarnation bump, shards rebalance back).
+5. **Satellites** — ``merge_partials`` int64 SUM overflow raises
+   instead of wrapping; flow_span diagnostics route up the merge tree
+   (interior hosts forward, gateway still sees every node's span).
+6. **Slow lane** — a real 2->3-process socket pod via
+   ``hostd --elastic``: host 2 late-joins a RUNNING pod mid statement
+   loop; every run bit-identical to the oracle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.distsql import leases as leases_mod
+from cockroach_tpu.distsql.leases import (LeaseView, plan_rebalance,
+                                          ShardLeases)
+from cockroach_tpu.distsql.physical import (MergeUnsupported,
+                                            merge_partials)
+from cockroach_tpu.parallel import multihost
+from cockroach_tpu.server.hostd import GROUPBY_SQL, _jsonable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROWS = 600
+NSH = 6
+
+
+# ---------------------------------------------------------------------------
+# 1. membership units (degenerate in-process KV)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def local_kv():
+    multihost.init_distributed(num_processes=1)
+    yield
+    multihost.install_membership_faults(None)
+    multihost.shutdown_distributed()
+
+
+def _mem(hid, window=0.4):
+    return multihost.Membership(hid, f"h{hid}",
+                                heartbeat_interval=0.05,
+                                liveness_window=window)
+
+
+class TestMembership:
+    def test_join_leave_epochs_converge(self, local_kv):
+        m0, m1 = _mem(0), _mem(1)
+        e0 = m0.join()
+        assert e0 == 1 and m0.view().live == (0,)
+        e1 = m1.join()
+        assert e1 == 2
+        # both hosts resolve the SAME view at the same epoch
+        assert m0.view().live == m1.view().live == (0, 1)
+        assert m0.view(epoch=1).live == (0,)
+        e2 = m1.leave()
+        assert e2 == 3 and m0.view().live == (0,)
+
+    def test_heartbeat_liveness_window(self, local_kv):
+        m0, m1 = _mem(0), _mem(1)
+        m0.join()
+        m1.join()
+        m1.beat()
+        assert m0.alive(1)
+        assert m0.suspects([0, 1]) == []
+        # silence past the window: suspect, but the VIEW still has it
+        # (conviction is the failover path's explicit decision)
+        assert not m0.alive(1, now=time.time() + 1.0)
+        assert 1 in m0.view().live
+
+    def test_expel_and_rejoin_bumps_incarnation(self, local_kv):
+        m0, m1 = _mem(0), _mem(1)
+        m0.join()
+        inc1 = (m1.join(), m1.incarnation)[1]
+        m0.expel(1)
+        assert m1.expelled()
+        assert 1 not in m0.view().live
+        # same id comes back: new incarnation fences the old life
+        m1.join()
+        assert m1.incarnation == inc1 + 1
+        assert not m1.expelled()
+        assert m0.view().live == (0, 1)
+
+    def test_stale_incarnation_heartbeat_is_dead(self, local_kv):
+        m0 = _mem(0)
+        m0.join()
+        zombie = _mem(1)
+        zombie.join()
+        zombie.beat()
+        # a second life under id 1 outruns the zombie
+        m1b = _mem(1)
+        m1b.join()
+        m1b.beat()
+        assert zombie.expelled()        # old incarnation is fenced
+        zombie.beat()                   # the zombie's beat lands last...
+        assert not m0.alive(1)          # ...but cannot keep 1 alive
+        m1b.beat()
+        assert m0.alive(1)              # only the new life counts
+
+
+# ---------------------------------------------------------------------------
+# 2. lease-plane units
+# ---------------------------------------------------------------------------
+
+class TestPlanRebalance:
+    def test_deterministic_and_balanced(self):
+        cur = {s: -1 for s in range(NSH)}
+        a = plan_rebalance(cur, [0, 1])
+        assert a == plan_rebalance(cur, [1, 0])     # order-independent
+        loads = {h: sum(1 for o in a.values() if o == h) for h in (0, 1)}
+        assert loads == {0: 3, 1: 3}
+
+    def test_minimal_moves_on_join(self):
+        cur = plan_rebalance({s: -1 for s in range(NSH)}, [0, 1])
+        target = plan_rebalance(cur, [0, 1, 2])
+        moved = [s for s in cur if target[s] != cur[s]]
+        # 6 shards over 3 hosts: exactly 2 move, both to the joiner
+        assert len(moved) == 2
+        assert all(target[s] == 2 for s in moved)
+        # survivors keep what they had
+        assert all(target[s] == cur[s] for s in cur if s not in moved)
+
+    def test_dead_owner_shards_land_on_survivors(self):
+        cur = plan_rebalance({s: -1 for s in range(NSH)}, [0, 1, 2])
+        target = plan_rebalance(cur, [0, 2])
+        assert set(target.values()) == {0, 2}
+        loads = {h: sum(1 for o in target.values() if o == h)
+                 for h in (0, 2)}
+        assert loads == {0: 3, 2: 3}
+
+    def test_no_live_hosts_raises(self):
+        with pytest.raises(leases_mod.LeaseError):
+            plan_rebalance({0: 0}, [])
+
+    def test_view_accessors(self):
+        v = LeaseView(epoch=3, assignments={"t": {0: 0, 1: 1, 2: 0}})
+        assert v.owner("t", 2) == 0 and v.owner("t", 9) is None
+        assert v.shards_of("t", 0) == [0, 2]
+        assert v.owners("t") == {0, 1}
+        v.validate()
+
+
+class TestLeaseTransitions:
+    def test_stale_epoch_claim_is_fenced(self, local_kv):
+        m0 = _mem(0)
+        m0.join()                      # epoch 1
+        ls = ShardLeases(m0)
+        ls.register_table("t", 2)
+        assert ls.transition("t", {0: 0, 1: 0}) == 2
+        e = m0.epoch()
+        # a claim bid at a PAST epoch must lose, not double-own
+        assert ls.transition("t", {0: 0, 1: 1},
+                             claim_epoch=e - 1) is None
+        assert ls.current_view().assignment("t") == {0: 0, 1: 0}
+        # the legitimate claim at the current epoch still lands
+        assert ls.transition("t", {0: 0, 1: 1}) == e + 1
+        assert ls.current_view().assignment("t") == {0: 0, 1: 1}
+
+    def test_injected_stale_claims_are_fenced(self, local_kv):
+        m0 = _mem(0)
+        m0.join()
+        ls = ShardLeases(m0)
+        ls.register_table("t", 2)
+        ls.transition("t", {0: 0, 1: 0})
+        multihost.install_membership_faults(
+            multihost.MembershipFaults(stale_epoch_claims=True,
+                                       hosts=(0,)))
+        assert ls.transition("t", {0: 1, 1: 1}) is None
+        assert ls.current_view().assignment("t") == {0: 0, 1: 0}
+        multihost.install_membership_faults(None)
+        assert ls.transition("t", {0: 1, 1: 1}) is not None
+
+    def test_view_at_walks_to_newest_at_or_below(self, local_kv):
+        m0 = _mem(0)
+        m0.join()
+        ls = ShardLeases(m0)
+        ls.register_table("t", 1)
+        ls.transition("t", {0: 0})     # published at epoch 2
+        m0.expel(99)                   # unrelated epoch bump -> 3
+        assert ls.view_at(m0.epoch()).assignment("t") == {0: 0}
+        assert ls.view_at(1).assignment("t") == {}
+
+
+# ---------------------------------------------------------------------------
+# 3. churn matrix: LocalTransport fast lane
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def oracle():
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.storage.hlc import Timestamp
+    eng = Engine()
+    eng.execute(tpch.DDL["lineitem"])
+    eng.execute(tpch.DDL["part"])
+    eng.store.insert_columns(
+        "lineitem", tpch.gen_lineitem(0.01, rows=ROWS), Timestamp(1, 0))
+    eng.store.insert_columns("part", tpch.gen_part(0.01),
+                             Timestamp(1, 0))
+    yield eng
+    eng.close()
+
+
+def _want(oracle, sql=GROUPBY_SQL):
+    return oracle.execute(sql).rows
+
+
+@pytest.fixture
+def pod_factory():
+    """Build degenerate-KV elastic pods; tear every engine down after
+    the test regardless of how much churn it inflicted."""
+    from cockroach_tpu.distsql.node import DistSQLNode, Gateway
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.kvserver.transport import LocalTransport
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.storage.hlc import Timestamp
+
+    engines = []
+    mems = []
+    li = tpch.gen_lineitem(0.01, rows=ROWS)
+    part = tpch.gen_part(0.01)
+
+    def recover(table, sid):
+        assert table == "lineitem"
+        lo, hi = sid * ROWS // NSH, (sid + 1) * ROWS // NSH
+        return {k: v[lo:hi] for k, v in li.items()}
+
+    def make(n, fanout=0, flow_timeout=5.0, window=0.4):
+        multihost.init_distributed(num_processes=1)
+        transport = LocalTransport()
+        hosts = {}
+
+        def add_host(hid):
+            eng = Engine()
+            eng.execute(tpch.DDL["lineitem"])
+            eng.execute(tpch.DDL["part"])
+            eng.store.insert_columns("part", part, Timestamp(1, 0))
+            engines.append(eng)
+            node = DistSQLNode(hid, eng, transport)
+            mem = multihost.Membership(hid, f"h{hid}",
+                                       metrics=eng.metrics,
+                                       heartbeat_interval=0.05,
+                                       liveness_window=window)
+            mems.append(mem)
+            keeper = leases_mod.ShardKeeper(eng)
+            keeper.register_table("lineitem", tpch.DDL["lineitem"])
+            pod = leases_mod.ElasticPod(
+                hid, mem, leases_mod.ShardLeases(mem,
+                                                 metrics=eng.metrics),
+                keeper, node=node, recover=recover)
+            hosts[hid] = SimpleNamespace(eng=eng, node=node, mem=mem,
+                                         pod=pod)
+            return hosts[hid]
+
+        for i in range(n):
+            add_host(i)
+            hosts[i].mem.join()
+            hosts[i].mem.start_heartbeat()
+        for i in range(n):
+            hosts[i].pod.bootstrap("lineitem", tpch.DDL["lineitem"],
+                                   NSH, list(range(n)))
+        gw = Gateway(hosts[0].node, list(range(n)),
+                     replicated_tables={"part"}, merge_fanout=fanout,
+                     flow_timeout=flow_timeout, elastic=hosts[0].pod)
+        return SimpleNamespace(transport=transport, hosts=hosts,
+                               gw=gw, add_host=add_host)
+
+    yield make
+    multihost.install_membership_faults(None)
+    # heartbeat threads write into the CURRENT KV: left running they
+    # would keep this test's host ids fresh in the NEXT test's pod
+    for mem in mems:
+        mem.stop_heartbeat()
+    for eng in engines:
+        eng.close()
+    multihost.shutdown_distributed()
+
+
+def _kill(ctx, hid):
+    """A crashed host: heartbeats stop, every frame to/from it drops."""
+    ctx.hosts[hid].mem.stop_heartbeat()
+    ctx.transport.stop_node(hid)
+
+
+def _assert_single_owned(ctx, nshards=NSH, table="lineitem"):
+    """The PR's core invariant after any churn: every shard leased
+    exactly once to a live host, and the hosts' ENGINES serve exactly
+    (and disjointly) what the leases say."""
+    pod0 = ctx.hosts[0].pod
+    live = set(pod0.membership.view().live)
+    for h in ctx.hosts.values():        # let stragglers catch up
+        if h.pod.host_id in live and not h.mem.expelled():
+            h.pod.maybe_reconcile()
+    v = pod0.view()
+    v.validate()
+    asg = v.assignment(table)
+    assert sorted(asg) == list(range(nshards))
+    assert set(asg.values()) <= live
+    installed = {}
+    for hid, h in ctx.hosts.items():
+        if hid not in live or h.mem.expelled():
+            continue
+        for s in h.pod.keeper.installed(table):
+            assert s not in installed, \
+                f"shard {s} served by both {installed[s]} and {hid}"
+            installed[s] = hid
+    assert installed == asg, "engines drifted from the lease table"
+
+
+class _ChurnDuringPump:
+    """Deterministic mid-statement churn: fire ``op`` once, just
+    before the Nth transport pump of the flow — after SetupFlows are
+    queued (at_pump=1 lands before any host produced; later pumps land
+    with streams already in flight)."""
+
+    def __init__(self, transport, op, at_pump=1):
+        self._orig = transport.deliver_all
+        self._transport = transport
+        self._op = op
+        self._at = at_pump
+        self._n = 0
+        self._depth = 0
+        self.fired = False
+        transport.deliver_all = self
+
+    def __call__(self):
+        # LocalTransport is synchronous: interior merge nodes pump
+        # deliver_all REENTRANTLY while producing. Firing churn from
+        # inside such a pump would block the producer under our own
+        # stack frame — an interleaving impossible with real per-host
+        # processes — so a trigger reached at depth defers to the
+        # moment the outermost pump unwinds (still mid-statement:
+        # the gateway is between pump iterations, streams in flight).
+        self._n += 1
+        if not self.fired and self._n >= self._at and self._depth == 0:
+            self.fired = True
+            self._op()
+        self._depth += 1
+        try:
+            ret = self._orig()
+        finally:
+            self._depth -= 1
+        if not self.fired and self._n >= self._at and self._depth == 0:
+            self.fired = True
+            self._op()
+        return ret
+
+    def uninstall(self):
+        self._transport.deliver_all = self._orig
+
+
+class TestChurnMatrix:
+    # -- idle churn: between statements -----------------------------
+    def test_join_idle(self, pod_factory, oracle):
+        ctx = pod_factory(2)
+        want = _want(oracle)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        h2 = ctx.add_host(2)
+        h2.mem.start_heartbeat()
+        h2.pod.join_pod()
+        assert ctx.hosts[0].pod.data_nodes() == [0, 1, 2]
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        # the joiner STREAMED its shards from live owners (recover is
+        # the dead-owner path, not the scale-out path); the movement
+        # lease — and its byte count — is taken on the SERVING side
+        streamed = sum(
+            ctx.hosts[h].eng.metrics.snapshot()
+            .get("exec.movement.rebalance.bytes", 0) for h in (0, 1))
+        assert streamed > 0
+
+    def test_drain_idle(self, pod_factory, oracle):
+        ctx = pod_factory(3)
+        want = _want(oracle)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        ctx.hosts[2].pod.drain_pod()
+        assert ctx.hosts[0].pod.data_nodes() == [0, 1]
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_kill_idle(self, pod_factory, oracle):
+        ctx = pod_factory(3)
+        want = _want(oracle)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        _kill(ctx, 2)
+        time.sleep(0.5)                # past the liveness window
+        view, changed = ctx.hosts[0].pod.fail_over([2])
+        assert 2 in changed and 2 not in view.owners("lineitem")
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        snap = ctx.hosts[0].eng.metrics.snapshot()
+        assert snap.get("exec.lease.failovers", 0) >= 1
+
+    # -- mid-statement churn ---------------------------------------
+    @pytest.mark.parametrize("at_pump", [1, 2],
+                             ids=["pre-scan", "streams-in-flight"])
+    def test_join_mid_scan(self, pod_factory, oracle, at_pump):
+        ctx = pod_factory(2)
+        want = _want(oracle)
+        h2 = ctx.add_host(2)
+        h2.mem.start_heartbeat()
+        hook = _ChurnDuringPump(ctx.transport, h2.pod.join_pod,
+                                at_pump=at_pump)
+        try:
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+        finally:
+            hook.uninstall()
+        assert hook.fired
+        _assert_single_owned(ctx)
+        assert ctx.hosts[0].pod.data_nodes() == [0, 1, 2]
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_drain_mid_scan(self, pod_factory, oracle):
+        ctx = pod_factory(3)
+        want = _want(oracle)
+        hook = _ChurnDuringPump(ctx.transport,
+                                ctx.hosts[2].pod.drain_pod, at_pump=1)
+        try:
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+        finally:
+            hook.uninstall()
+        assert hook.fired
+        _assert_single_owned(ctx)
+        assert ctx.hosts[0].pod.data_nodes() == [0, 1]
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_kill_mid_scan(self, pod_factory, oracle):
+        ctx = pod_factory(3, flow_timeout=2.0)
+        want = _want(oracle)
+        hook = _ChurnDuringPump(ctx.transport,
+                                lambda: _kill(ctx, 1), at_pump=1)
+        try:
+            got = ctx.gw.run(GROUPBY_SQL).rows
+        finally:
+            hook.uninstall()
+        assert got == want, "mid-scan host loss changed the answer"
+        snap = ctx.hosts[0].eng.metrics.snapshot()
+        assert snap.get("distsql.degrade.failover", 0) >= 1
+        _assert_single_owned(ctx)
+        assert 1 not in ctx.hosts[0].pod.data_nodes()
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_join_mid_merge(self, pod_factory, oracle):
+        ctx = pod_factory(3, fanout=2)
+        want = _want(oracle)
+        h3 = ctx.add_host(3)
+        h3.mem.start_heartbeat()
+        hook = _ChurnDuringPump(ctx.transport, h3.pod.join_pod,
+                                at_pump=2)
+        try:
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+        finally:
+            hook.uninstall()
+        assert hook.fired
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_drain_mid_merge(self, pod_factory, oracle):
+        ctx = pod_factory(3, fanout=2)
+        want = _want(oracle)
+        hook = _ChurnDuringPump(ctx.transport,
+                                ctx.hosts[2].pod.drain_pod, at_pump=2)
+        try:
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+        finally:
+            hook.uninstall()
+        assert hook.fired
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_kill_mid_merge(self, pod_factory, oracle):
+        # 4 hosts, fanout 2: host 1 is an INTERIOR merge node (child
+        # 3 streams through it) — killing it takes out a subtree, not
+        # just a leaf shard
+        ctx = pod_factory(4, fanout=2, flow_timeout=2.0)
+        want = _want(oracle)
+        hook = _ChurnDuringPump(ctx.transport,
+                                lambda: _kill(ctx, 1), at_pump=1)
+        try:
+            got = ctx.gw.run(GROUPBY_SQL).rows
+        finally:
+            hook.uninstall()
+        assert got == want, "mid-merge host loss changed the answer"
+        snap = ctx.hosts[0].eng.metrics.snapshot()
+        assert snap.get("distsql.degrade.failover", 0) >= 1
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_scale_out_2_to_4_under_load(self, pod_factory, oracle):
+        """The acceptance lane: 2->4 hosts while statements run, every
+        answer bit-identical, leases spread over all four."""
+        ctx = pod_factory(2)
+        want = _want(oracle)
+        for hid in (2, 3):
+            h = ctx.add_host(hid)
+            h.mem.start_heartbeat()
+            hook = _ChurnDuringPump(ctx.transport, h.pod.join_pod,
+                                    at_pump=1)
+            try:
+                assert ctx.gw.run(GROUPBY_SQL).rows == want
+            finally:
+                hook.uninstall()
+            assert hook.fired
+        _assert_single_owned(ctx)
+        v = ctx.hosts[0].pod.view()
+        assert v.owners("lineitem") == {0, 1, 2, 3}
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+
+# ---------------------------------------------------------------------------
+# 4. membership faults
+# ---------------------------------------------------------------------------
+
+class TestMembershipFaults:
+    def test_delayed_heartbeat_is_suspect_not_expelled(
+            self, pod_factory, oracle):
+        ctx = pod_factory(2)
+        want = _want(oracle)
+        multihost.install_membership_faults(
+            multihost.MembershipFaults(heartbeat_drop=10 ** 6,
+                                       hosts=(1,)))
+        try:
+            time.sleep(0.5)            # past the window: 1 goes stale
+            m0 = ctx.hosts[0].mem
+            assert m0.suspects([0, 1]) == [1]
+            # the host is SLOW, not dead: it still serves, the
+            # statement is clean, and nothing convicts it
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+            snap = ctx.hosts[0].eng.metrics.snapshot()
+            assert snap.get("distsql.degrade.failover", 0) == 0
+            assert 1 in ctx.hosts[0].pod.data_nodes()
+        finally:
+            multihost.install_membership_faults(None)
+        # heartbeats resume: suspicion clears without any transition
+        deadline = time.monotonic() + 3.0
+        while ctx.hosts[0].mem.suspects([0, 1]):
+            assert time.monotonic() < deadline, "suspicion wedged"
+            time.sleep(0.05)
+        _assert_single_owned(ctx)
+
+    def test_kill_then_same_id_rejoin(self, pod_factory, oracle):
+        ctx = pod_factory(3)
+        want = _want(oracle)
+        _kill(ctx, 2)
+        time.sleep(0.5)
+        ctx.hosts[0].pod.fail_over([2])
+        assert ctx.hosts[2].mem.expelled()
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+        # the host comes back under the SAME id: new incarnation,
+        # fenced past life, shards rebalance back onto it
+        ctx.transport.restart_node(2)
+        old_inc = ctx.hosts[2].mem.incarnation
+        ctx.hosts[2].mem.start_heartbeat()
+        ctx.hosts[2].pod.join_pod()
+        assert ctx.hosts[2].mem.incarnation == old_inc + 1
+        assert not ctx.hosts[2].mem.expelled()
+        snap = ctx.hosts[2].eng.metrics.snapshot()
+        assert snap.get("cluster.membership.rejoins", 0) >= 1
+        _assert_single_owned(ctx)
+        assert 2 in ctx.hosts[0].pod.view().owners("lineitem")
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+    def test_stale_epoch_join_claim_cannot_double_own(
+            self, pod_factory, oracle):
+        ctx = pod_factory(2)
+        want = _want(oracle)
+        h2 = ctx.add_host(2)
+        h2.mem.start_heartbeat()
+        multihost.install_membership_faults(
+            multihost.MembershipFaults(stale_epoch_claims=True,
+                                       hosts=(2,)))
+        try:
+            # the joiner's lease flip bids a past epoch: the CAS
+            # fences it and the pending record is dropped — the host
+            # joins the member view but owns NOTHING (never a shard
+            # owned twice, never a wedged pod)
+            h2.pod.join_pod(timeout_s=5.0)
+            assert 2 in ctx.hosts[0].pod.data_nodes()
+            v = ctx.hosts[0].pod.view()
+            assert 2 not in v.owners("lineitem")
+            _assert_single_owned(ctx)
+            assert ctx.gw.run(GROUPBY_SQL).rows == want
+        finally:
+            multihost.install_membership_faults(None)
+        # with the fault gone the same join completes for real
+        h2.pod.join_pod()
+        assert 2 in ctx.hosts[0].pod.view().owners("lineitem")
+        _assert_single_owned(ctx)
+        assert ctx.gw.run(GROUPBY_SQL).rows == want
+
+
+# ---------------------------------------------------------------------------
+# 5. satellites: merge overflow + tree-routed diagnostics
+# ---------------------------------------------------------------------------
+
+def _pchunk(groups, partials):
+    g = np.asarray(groups)
+    p = np.asarray(partials)
+    n = len(g)
+    return (n, {"g": g, "__p0": p},
+            {"g": np.ones(n, bool), "__p0": np.ones(n, bool)})
+
+
+class TestMergeOverflow:
+    def test_int64_sum_overflow_raises(self):
+        big = np.iinfo(np.int64).max - 10
+        a = _pchunk(["x"], np.array([big], np.int64))
+        b = _pchunk(["x"], np.array([100], np.int64))
+        with pytest.raises(MergeUnsupported, match="overflow"):
+            merge_partials([a, b], ["g"], {"__p0": "sum"})
+
+    def test_int64_negative_overflow_raises(self):
+        small = np.iinfo(np.int64).min + 10
+        a = _pchunk(["x"], np.array([small], np.int64))
+        b = _pchunk(["x"], np.array([-100], np.int64))
+        with pytest.raises(MergeUnsupported, match="overflow"):
+            merge_partials([a, b], ["g"], {"__p0": "sum"})
+
+    def test_near_max_sum_stays_exact(self):
+        # sums that FIT must come back exact in the original dtype —
+        # the overflow guard must not widen the result
+        near = np.iinfo(np.int64).max // 2
+        a = _pchunk(["x"], np.array([near], np.int64))
+        b = _pchunk(["x"], np.array([near], np.int64))
+        k, cols, valid = merge_partials([a, b], ["g"], {"__p0": "sum"})
+        assert k == 1
+        assert cols["__p0"].dtype == np.int64
+        assert cols["__p0"][0] == 2 * near
+
+    def test_uint64_overflow_raises(self):
+        big = np.iinfo(np.uint64).max - 1
+        a = _pchunk(["x"], np.array([big], np.uint64))
+        b = _pchunk(["x"], np.array([5], np.uint64))
+        with pytest.raises(MergeUnsupported, match="overflow"):
+            merge_partials([a, b], ["g"], {"__p0": "sum"})
+
+
+class TestTreeRoutedDiagnostics:
+    def test_flow_spans_relay_up_the_merge_tree(self, pod_factory,
+                                                oracle):
+        from cockroach_tpu.utils import tracing
+        ctx = pod_factory(4, fanout=2)
+        with tracing.capture("stmt") as rec:
+            got = ctx.gw.run(GROUPBY_SQL)
+        assert got.rows == _want(oracle)
+        flows = rec.find_all("flow")
+        # the gateway still sees EVERY node's span...
+        assert {s.tags["node"] for s in flows} >= {1, 2, 3}
+        # ...but host 3's went through its merge parent (host 1), not
+        # straight to the gateway
+        snap = ctx.hosts[1].eng.metrics.snapshot()
+        assert snap.get("exec.multihost.diag.forwarded", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# 6. slow lane: real 2->3-process socket pod, late join mid-run
+# ---------------------------------------------------------------------------
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_ENABLE_X64"] = "1"
+    env["COCKROACH_TPU_INVARIANTS"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+class TestElasticPodProcesses:
+    def test_late_join_mid_statement_loop(self, oracle):
+        """Founder + 1 worker bootstrap a 2-host pod and run a
+        statement loop; a THIRD process joins the running pod over
+        real sockets. Every run must be bit-identical to the oracle
+        and the final membership must include the joiner."""
+        tmp = tempfile.mkdtemp()
+        addr_file = os.path.join(tmp, "kv_addr")
+        base = [sys.executable, "-m", "cockroach_tpu.server.hostd",
+                "--elastic", "--rows", str(ROWS),
+                "--nshards", str(NSH), "--queries", "groupby",
+                "--flow-timeout", "30",
+                "--heartbeat-interval", "0.05",
+                "--liveness-window", "0.5"]
+        env = _child_env()
+        founder = subprocess.Popen(
+            base + ["--process-id", "0", "--kv-addr-file", addr_file,
+                    "--initial-hosts", "2", "--repeat", "8",
+                    "--statement-gap", "0.25"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, cwd=REPO, text=True)
+        workers = []
+        try:
+            deadline = time.time() + 60
+            while not (os.path.exists(addr_file)
+                       and open(addr_file).read().strip()):
+                assert founder.poll() is None, founder.stderr.read()
+                assert time.time() < deadline, "no KV addr published"
+                time.sleep(0.05)
+            addr = open(addr_file).read().strip()
+            workers.append(subprocess.Popen(
+                base + ["--process-id", "1", "--kv-addr", addr],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env, cwd=REPO))
+            time.sleep(2.5)            # founder is mid statement-loop
+            workers.append(subprocess.Popen(
+                base + ["--process-id", "2", "--kv-addr", addr,
+                        "--late-join"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env, cwd=REPO))
+            out, err = founder.communicate(timeout=240)
+        finally:
+            wait_until = time.monotonic() + 30.0
+            for w in workers:
+                try:
+                    w.wait(timeout=max(
+                        0.1, wait_until - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+            if founder.poll() is None:
+                founder.kill()
+        assert founder.returncode == 0, f"founder died:\n{err}"
+        doc = json.loads(out.strip().splitlines()[-1])
+        res = doc["results"]["groupby"]
+        assert "error" not in res, res
+        assert res["consistent"], "answers varied across the join"
+        want = [[_jsonable(v) for v in r]
+                for r in oracle.execute(GROUPBY_SQL).rows]
+        assert res["rows"] == want
+        mb = doc["membership"]
+        assert mb["elastic"] and 2 in mb["live"]
+        assert set(map(int, mb["leases"]["lineitem"].values())) \
+            == {0, 1, 2}
