@@ -1138,6 +1138,108 @@ def run_concurrency(rows, sessions=(1, 8, 32, 100)):
     return results
 
 
+def run_oltp_batch(records: int = 20000, steps: int = 6000,
+                   sessions=(32, 1000)):
+    """Fused OLTP lane A/B (round 18 tentpole): YCSB-A (50% point
+    read / 50% point update, zipfian) at 32 and 1000 concurrent
+    sessions, oltp_batch=off (per-statement lane, one mirror read /
+    one txn commit per statement) vs auto (cross-session batch
+    fusion + group commit: one multi-key mirror probe and one commit
+    per window). An analytic tenant runs a q6-style aggregate on a
+    duty cycle throughout, so the OLTP rates are measured with the
+    device path live — the interleaving the fused lane exists to
+    survive — without a busy loop saturating the interpreter.
+    Metric deltas around the auto arm verify the group-commit
+    shape: one proposal per fused write window, commands/proposal =
+    average window size. Retries are client-side txn restarts: the
+    off arm burns them on zipfian write-write races, the single
+    write collector serializes them away in auto."""
+    import threading as _th
+
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.models import tpch
+    from cockroach_tpu.workload.ycsb import YCSB
+
+    eng = Engine()
+    t0 = time.time()
+    wl = YCSB(eng, workload="A", records=records, seed=1)
+    wl.setup()
+    arows = 1 << 14
+    tpch.load(eng, sf=arows / tpch.LINEITEM_PER_SF, rows=arows,
+              tables=("lineitem",), encoded=True)
+    print(f"# oltpbatch datagen_s={time.time() - t0:.1f} "
+          f"records={records}", file=sys.stderr)
+    # warm both lanes + the analytic plan outside the timed arms
+    wl.run_concurrent(steps=256, workers=8,
+                      session_vars={"oltp_batch": "off"})
+    wl.run_concurrent(steps=256, workers=8,
+                      session_vars={"oltp_batch": "auto"})
+    q6 = ("SELECT sum(l_extendedprice * l_discount) FROM lineitem "
+          "WHERE l_quantity < 24")
+    eng.execute(q6)
+
+    results = {"oltp_records": records, "oltp_steps": steps}
+    for n in sessions:
+        per_arm = {}
+        for arm in ("off", "auto"):
+            stop = _th.Event()
+            ana_ops = [0]
+
+            def analytic(stop=stop, ana_ops=ana_ops):
+                # duty-cycled, not a busy loop: a spinning analytic
+                # thread just measures GIL contention, not the lane
+                s = eng.session()
+                while not stop.is_set():
+                    eng.execute(q6, s)
+                    ana_ops[0] += 1
+                    stop.wait(0.15)
+
+            snap0 = eng.metrics.snapshot()
+            ath = _th.Thread(target=analytic)
+            ath.start()
+            try:
+                r = wl.run_concurrent(
+                    steps=steps, workers=n,
+                    session_vars={"oltp_batch": arm},
+                    record_latency=True)
+            finally:
+                stop.set()
+                ath.join()
+            snap1 = eng.metrics.snapshot()
+            per_arm[arm] = r
+            key = f"oltp_{arm}_{n}"
+            results[f"{key}_ops_per_sec"] = round(r["ops_per_sec"], 1)
+            results[f"{key}_p50_ms"] = round(r.get("p50_ms", 0.0), 3)
+            results[f"{key}_p99_ms"] = round(r.get("p99_ms", 0.0), 3)
+            results[f"{key}_retries"] = r["retries"]
+            if arm == "auto":
+                windows = (snap1.get("exec.oltp.batch.windows", 0)
+                           - snap0.get("exec.oltp.batch.windows", 0))
+                fused = (snap1.get("exec.oltp.batch.fused", 0)
+                         - snap0.get("exec.oltp.batch.fused", 0))
+                props = (
+                    snap1.get("kv.raft.groupcommit.proposals", 0)
+                    - snap0.get("kv.raft.groupcommit.proposals", 0))
+                cmds = (
+                    snap1.get("kv.raft.groupcommit.commands", 0)
+                    - snap0.get("kv.raft.groupcommit.commands", 0))
+                results[f"oltp_auto_{n}_windows"] = windows
+                results[f"oltp_auto_{n}_fused_stmts"] = fused
+                results[f"oltp_auto_{n}_gc_proposals"] = props
+                results[f"oltp_auto_{n}_gc_commands"] = cmds
+                results[f"oltp_auto_{n}_cmds_per_proposal"] = \
+                    round(cmds / props, 2) if props else 0.0
+            print(f"# oltpbatch arm={arm} n={n} "
+                  f"ops_per_sec={r['ops_per_sec']:.1f} "
+                  f"p99_ms={r.get('p99_ms', 0.0):.3f} "
+                  f"analytic_ops={ana_ops[0]}", file=sys.stderr)
+        off = per_arm["off"]["ops_per_sec"]
+        results[f"oltp_batch_speedup_{n}"] = \
+            round(per_arm["auto"]["ops_per_sec"] / off, 3) if off \
+            else 0.0
+    return results
+
+
 def run_coldstart(query: str, rows: int):
     """Leaf: time-to-first-result for one headline query in THIS
     fresh process (round 9 tentpole). Data generation is excluded;
@@ -1502,6 +1604,12 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
         # device, and measured faster there.
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    if mode == "oltpbatch_child":
+        # the fused OLTP lane is a host path (mirror probes, group
+        # commit); its analytic tenant compiles one small aggregate —
+        # both belong on XLA-CPU, not behind the tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     if extra_env:
         env.update(extra_env)
     for attempt in range(attempts):
@@ -1693,6 +1801,19 @@ def main():
             "metric": "conc_dist8_speedup",
             "value": per.get("conc_dist8_speedup", 0),
             "unit": "x", "rows": rows,
+            **per,
+        }))
+        return
+    if mode == "oltpbatch_child":
+        per = run_oltp_batch(
+            int(os.environ.get("BENCH_OLTP_RECORDS", 20000)),
+            int(os.environ.get("BENCH_OLTP_STEPS", 6000)),
+            sessions=tuple(int(x) for x in os.environ.get(
+                "BENCH_OLTP_SESSIONS", "32,1000").split(",")))
+        print(json.dumps({
+            "metric": "oltp_batch_speedup_32",
+            "value": per.get("oltp_batch_speedup_32", 0),
+            "unit": "x",
             **per,
         }))
         return
@@ -1923,6 +2044,15 @@ def main():
             out.update({k: v for k, v in r.items()
                         if k.startswith("conc_")})
             out.setdefault("concurrency_rows", r["rows"])
+    # round 18 tentpole A/B: cross-session batch fusion + group
+    # commit (oltp_batch=auto) vs the per-statement lane (off) on a
+    # YCSB-B mix at 32/1000 sessions with an analytic tenant running
+    if os.environ.get("BENCH_OLTPBATCH", "1") != "0":
+        r = run_child(0, "oltpbatch", max(child_timeout, 1200),
+                      mode="oltpbatch_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("oltp_")})
     if os.environ.get("BENCH_TPCC", "1") != "0":
         r = run_child(0, "tpcc", 900, mode="tpcc_child")
         if r is not None:
@@ -1981,7 +2111,21 @@ _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "elastic_scaleout_live_hosts",
                   "elastic_scaleout_lease_owners",
                   "elastic_scaleout_lease_moves",
-                  "elastic_scaleout_rebalance_bytes"}
+                  "elastic_scaleout_rebalance_bytes",
+                  # window/proposal counts are shape verification —
+                  # they track load timing, not performance
+                  "oltp_records", "oltp_steps",
+                  "oltp_auto_32_windows", "oltp_auto_32_fused_stmts",
+                  "oltp_auto_32_gc_proposals",
+                  "oltp_auto_32_gc_commands",
+                  "oltp_auto_32_cmds_per_proposal",
+                  "oltp_auto_1000_windows",
+                  "oltp_auto_1000_fused_stmts",
+                  "oltp_auto_1000_gc_proposals",
+                  "oltp_auto_1000_gc_commands",
+                  "oltp_auto_1000_cmds_per_proposal",
+                  "oltp_off_32_retries", "oltp_auto_32_retries",
+                  "oltp_off_1000_retries", "oltp_auto_1000_retries"}
 
 
 def regression_report(out: dict) -> None:

@@ -32,6 +32,18 @@ from .session import EngineError, Result, Session
 from .stmtutil import _contains_func, _stmt_table_refs
 
 
+def retry_exhausted(last: Exception | None) -> EngineError:
+    """The serialization-failure error after the DML retry budget.
+    Still the retryable class — pgwire maps the "restart transaction"
+    phrasing to SQLSTATE 40001. Single source for every autocommit
+    retry loop (the full DML path here, the OLTP lane's per-statement
+    writes, and its fused batch-window rounds), so a client's retry
+    matcher sees one phrasing regardless of which path a statement
+    took."""
+    return EngineError(
+        f"restart transaction: DML exhausted retries: {last}")
+
+
 class DMLMixin:
     """Engine methods for this concern; mixed into exec.engine.Engine
     (all state lives on the Engine instance)."""
@@ -82,10 +94,7 @@ class DMLMixin:
             except BaseException:
                 t.rollback()
                 raise
-        # still the retryable serialization class (pgwire maps the
-        # "restart transaction" phrasing to SQLSTATE 40001)
-        raise EngineError(f"restart transaction: DML exhausted "
-                          f"retries: {last}")
+        raise retry_exhausted(last)
 
     # -- range-plane scan-plane sync ----------------------------------------
     # With a Cluster attached, the columnstore is a materialization of

@@ -19,6 +19,7 @@ serves its first query warm.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime
 import threading
 from dataclasses import dataclass, field
@@ -452,6 +453,35 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             "host processes in this engine's rendezvous domain "
             "(1 = single-host)")
         self._lane_init()
+        # OLTP batch-window plane (exec/oltpbatch.py): window counts,
+        # statements that actually rode a multi-statement window, the
+        # rolling median window size, and per-request wait-in-window
+        # time. Group-commit counters read the process-wide raft tally
+        # (single-node lane commits bump it too — the fused kv commit
+        # is the WAL-append analogue there).
+        _lb = self._lane_batcher
+        self.metrics.func_counter(
+            "exec.oltp.batch.windows", lambda: _lb.windows,
+            "OLTP batch windows executed (a solo statement is a "
+            "window of one)")
+        self.metrics.func_counter(
+            "exec.oltp.batch.fused", lambda: _lb.fused,
+            "statements that shared a multi-statement batch window")
+        self.metrics.func_gauge(
+            "exec.oltp.batch.size_p50", _lb.size_p50,
+            "median batch-window size over the last 512 windows")
+        _lb.wait_observer = self.metrics.histogram(
+            "exec.oltp.batch.flush_wait_seconds",
+            "per-request wall time inside the batch window, queue to "
+            "outcome (s)").observe
+        from ..kvserver.raft import GROUPCOMMIT as _gc
+        self.metrics.func_counter(
+            "kv.raft.groupcommit.proposals", _gc.proposals,
+            "group-commit proposals (one fused log append / kv commit "
+            "per batch-window write round)")
+        self.metrics.func_counter(
+            "kv.raft.groupcommit.commands", _gc.commands,
+            "individual commands that rode group-commit proposals")
 
     def _admission_settings(self) -> None:
         """Refresh the controller's shed thresholds from cluster
@@ -672,21 +702,89 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # full-path statements see the columnstore: publish any lane
         # writes still queued in the mirror first, and suspend lane
         # writes while this statement runs (its snapshot must not have
-        # unflushed lane commits beneath it — exec/oltplane.py)
+        # unflushed lane commits beneath it — exec/oltplane.py).
+        # Suspension and flush are SCOPED to the statement's base
+        # tables when they can be enumerated: a multi-tenant analytic
+        # statement over other tables neither stalls the OLTP lane nor
+        # forces its deferred publish (round-18 group-commit lane).
+        tables = self._stmt_tables(stmt)
         with self._lane_sync:
             # atomic with lane commits: after this block, any lane
-            # write either already sits in _lane_pending (flushed
-            # below) or will observe _nonlane_active and take the
-            # full path (exec/oltplane.py)
-            self._nonlane_active += 1
-            pending = bool(self._lane_pending)
+            # write to a suspended table either already sits in
+            # _lane_pending (flushed below) or will observe the
+            # suspension and take the full path (exec/oltplane.py)
+            if tables is None:
+                self._nonlane_active += 1
+                pending = bool(self._lane_pending)
+            else:
+                nt = self._nonlane_tables
+                for t in tables:
+                    nt[t] = nt.get(t, 0) + 1
+                pending = any(t in self._lane_pending for t in tables)
         try:
-            if pending or self._lane_pending:
+            if pending:
                 with self._stmt_lock:
-                    self.lane_flush()
+                    self.lane_flush(tables)
             return self._execute_stmt_inner(stmt, session, sql_text)
         finally:
-            self._nonlane_active -= 1
+            with self._lane_sync:
+                if tables is None:
+                    self._nonlane_active -= 1
+                else:
+                    nt = self._nonlane_tables
+                    for t in tables:
+                        n = nt.get(t, 0) - 1
+                        if n > 0:
+                            nt[t] = n
+                        else:
+                            nt.pop(t, None)
+
+    def _stmt_tables(self, stmt: ast.Statement):
+        """Base tables `stmt` can read or write, or None when they
+        cannot be enumerated (DDL, EXPLAIN, txn control, views, ...).
+        Conservative by construction: only statement shapes listed
+        here return a set; a view reference returns None because the
+        expansion's base tables are not visible in the AST. Callers
+        treat None as 'touches everything' (the pre-round-18 global
+        lane suspension)."""
+        if not isinstance(stmt, (ast.Select, ast.SetOp, ast.Insert,
+                                 ast.Update, ast.Delete)):
+            return None
+        names: set = set()
+        try:
+            tbl = getattr(stmt, "table", None)
+            if isinstance(tbl, str):
+                names.add(tbl)
+            self._collect_tables(stmt, names)
+        except RecursionError:  # pragma: no cover - absurd nesting
+            return None
+        if names & self._view_map().keys():
+            return None
+        return names
+
+    @classmethod
+    def _collect_tables(cls, node, out: set) -> None:
+        """Recursive TableRef harvest over parsed statement trees.
+        Every AST node is a dataclass, so a generic field walk reaches
+        subqueries/CTEs/derived tables wherever they nest; table names
+        carried as plain `str` fields (Insert/Update/Delete.table) are
+        added by _stmt_tables before the walk."""
+        if node is None or isinstance(node, (str, int, float, bool,
+                                             bytes)):
+            return
+        if isinstance(node, (list, tuple)):
+            for x in node:
+                cls._collect_tables(x, out)
+            return
+        if isinstance(node, ast.TableRef):
+            if node.subquery is not None:
+                cls._collect_tables(node.subquery, out)
+            else:
+                out.add(node.name)
+            return
+        if dataclasses.is_dataclass(node):
+            for f in dataclasses.fields(node):
+                cls._collect_tables(getattr(node, f.name), out)
 
     def _execute_stmt_inner(self, stmt: ast.Statement, session: Session,
                             sql_text: str = "") -> Result:

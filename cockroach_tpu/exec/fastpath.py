@@ -37,6 +37,12 @@ class FastpathMixin:
         provably contain no match at any timestamp."""
         if where is None:
             return None
+        if not self._table_indexes(table):
+            # no secondary index, no candidates: skip building and
+            # matching the probe SELECT entirely — this runs on every
+            # full-path point DML, and index-less OLTP tables (the
+            # lane's whole population) paid it for nothing
+            return None
         probe = ast.Select(
             items=[ast.SelectItem(None, star=True)],
             table=ast.TableRef(table), where=where)

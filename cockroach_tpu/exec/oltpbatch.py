@@ -1,0 +1,160 @@
+"""Cross-session batch windows for the OLTP fast lane.
+
+The lane (exec/oltplane.py) already compiles a point statement down to
+one native call — what remains at high concurrency is per-statement
+dispatch: every session takes the statement gate, reads the clock,
+bumps the timestamp cache, and (for writes) runs its own kv commit.
+This module amortizes that across sessions the way the reference
+amortizes WAL appends in its pipelined raft proposals: concurrent
+eligible statements queue into a *window*, one thread (the leader)
+drains the queue and executes the whole window fused — one multi-key
+mirror probe for the reads, one group-committed kv transaction per
+write round — and every waiter gets its own Result or statement error.
+
+Batching is opportunistic, not timed: an uncontended request becomes
+leader immediately and runs solo (zero added latency at low
+concurrency); windows only grow when sessions actually pile up behind
+a running window. Reads and writes collect into SEPARATE windows —
+a group commit (kv transaction + intent resolution) is an order of
+magnitude slower than a multi-key probe, and a shared queue would
+head-of-line block every reader behind it. The session var
+`oltp_batch=off` bypasses this module entirely and restores the
+per-statement path bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class BatchReq:
+    """One session's statement riding in a batch window."""
+
+    __slots__ = ("plan", "lits", "session", "result", "error")
+
+    def __init__(self, plan, lits, session):
+        self.plan = plan
+        self.lits = lits
+        self.session = session
+        self.result = None
+        self.error = None
+
+    @property
+    def done(self) -> bool:
+        return self.result is not None or self.error is not None
+
+
+class _Collector:
+    """One batch-window queue (reads or writes): its own
+    condition-variable, queue, and leader slot, so the two statement
+    kinds never wait on each other's windows."""
+
+    def __init__(self, batcher, run_fn):
+        self.batcher = batcher
+        self.run_fn = run_fn
+        # condition-variable idiom: the with-block IS the wait/notify
+        # pattern (queue append, leader election, and waiter wakeup
+        # all happen under this one cv)
+        self.window_cv = threading.Condition()
+        self.queue: list = []
+        self.busy = False
+
+    def submit(self, req) -> None:
+        leader = False
+        batch = None
+        with self.window_cv:
+            self.queue.append(req)
+            while True:
+                if req.done:
+                    break
+                if not self.busy:
+                    # become the window leader: claim everything
+                    # queued so far (including our own request)
+                    self.busy = True
+                    batch, self.queue = self.queue, []
+                    leader = True
+                    break
+                self.window_cv.wait(timeout=1.0)
+        if leader:
+            try:
+                self.batcher._run_window(batch, self.run_fn)
+            finally:
+                with self.window_cv:
+                    self.busy = False
+                    self.window_cv.notify_all()
+
+
+class LaneBatcher:
+    """Batch-window collector in front of the lane executors."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._reads = _Collector(self, engine._lane_read_batch)
+        self._writes = _Collector(self, engine._lane_write_batch)
+        # window stats (read by exec.oltp.batch.* metric families);
+        # shared by both collectors, mutated only under this cv
+        self.stats_cv = threading.Condition()
+        self.windows = 0
+        self.fused = 0
+        self.statements = 0
+        self._sizes: deque = deque(maxlen=512)
+        # histogram .observe for flush-wait, assigned at engine metric
+        # registration (None in engines built without a registry)
+        self.wait_observer = None
+
+    def size_p50(self) -> float:
+        with self.stats_cv:
+            sizes = sorted(self._sizes)
+        if not sizes:
+            return 0.0
+        return float(sizes[len(sizes) // 2])
+
+    def submit(self, plan, lits, session):
+        """Execute one eligible statement through a batch window.
+        Blocks until this request has an outcome; returns its Result
+        or raises its per-statement error."""
+        req = BatchReq(plan, lits, session)
+        t0 = time.perf_counter()
+        if plan.kind == "point":
+            self._reads.submit(req)
+        else:
+            self._writes.submit(req)
+        obs = self.wait_observer
+        if obs is not None:
+            obs(time.perf_counter() - t0)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- leader side ------------------------------------------------
+
+    def _run_window(self, batch, fn) -> None:
+        self._run_phase(batch, fn)
+        with self.stats_cv:
+            self.windows += 1
+            self.statements += len(batch)
+            if len(batch) > 1:
+                self.fused += len(batch)
+            self._sizes.append(len(batch))
+
+    @staticmethod
+    def _run_phase(reqs, fn) -> None:
+        """Run one phase; guarantee every request leaves with exactly
+        one outcome even if the executor dies mid-window (the fault
+        bar: a waiter must never hang or see two outcomes)."""
+        if not reqs:
+            return
+        try:
+            fn(reqs)
+        except BaseException as e:
+            for r in reqs:
+                if not r.done:
+                    r.error = e
+            if not isinstance(e, Exception):
+                raise
+        for r in reqs:
+            if not r.done:  # pragma: no cover - executor contract
+                r.error = RuntimeError(
+                    "batch window dropped a request")

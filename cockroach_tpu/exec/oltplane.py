@@ -36,6 +36,7 @@ pushes and retry through the same loop as `_dml`.
 from __future__ import annotations
 
 import ctypes
+import functools
 import re
 import threading
 import time
@@ -56,8 +57,12 @@ MAX_I64 = np.iinfo(np.int64).max
 _LIT_RE = re.compile(r"'(?:[^']|'')*'|(?<![\w.])\d+(?![\w.\d])")
 
 
-def normalize(sql: str):
-    """(shape, literals): literals replaced by ? placeholders."""
+@functools.lru_cache(maxsize=8192)
+def _normalize_text(sql: str):
+    """Memoized (shape, literals-tuple) for one statement text: the
+    regex pass runs once per DISTINCT text, not once per execution —
+    YCSB-style drivers repeat a small set of literal combinations
+    millions of times and this sat at the top of the lane profile."""
     lits: list = []
 
     def sub(m):
@@ -68,7 +73,13 @@ def normalize(sql: str):
             lits.append(int(tok))
         return "?"
 
-    return _LIT_RE.sub(sub, sql), lits
+    return _LIT_RE.sub(sub, sql), tuple(lits)
+
+
+def normalize(sql: str):
+    """(shape, literals): literals replaced by ? placeholders."""
+    shape, lits = _normalize_text(sql)
+    return shape, list(lits)
 
 
 # ---------------------------------------------------------------------------
@@ -200,6 +211,32 @@ class TableMirror:
             return None
         return vals[:self.ncols].tolist(), vld[:self.ncols].tolist()
 
+    def multiread(self, keys, read_ts: int):
+        """Fused gather for one batch window: (vals row-major list,
+        valid list, found list) across the whole key vector — a single
+        native call (one shared-lock acquisition, one GIL release)
+        instead of len(keys) point reads."""
+        n = len(keys)
+        karr = np.ascontiguousarray(keys, dtype=np.int64)
+        vals = np.empty(max(n, 1) * self.ncols, dtype=np.int64)
+        vld = np.empty(max(n, 1) * self.ncols, dtype=np.uint8)
+        fnd = np.zeros(max(n, 1), dtype=np.uint8)
+        if hasattr(self.lib, "oltp_multiread"):
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            self.lib.oltp_multiread(
+                self.h, n, karr.ctypes.data_as(i64p), int(read_ts),
+                vals.ctypes.data_as(i64p), vld.ctypes.data_as(u8p),
+                fnd.ctypes.data_as(u8p))
+        else:  # pragma: no cover - stale cached .so without the symbol
+            for i in range(n):
+                got = self.read(int(karr[i]), read_ts)
+                if got is not None:
+                    fnd[i] = 1
+                    vals[i * self.ncols:(i + 1) * self.ncols] = got[0]
+                    vld[i * self.ncols:(i + 1) * self.ncols] = got[1]
+        return vals.tolist(), vld.tolist(), fnd.tolist()
+
     def scan(self, lo, lo_strict, hi, hi_strict, read_ts: int,
              cap: int):
         """(nrows, keys[], vals row-major, valid row-major)."""
@@ -317,8 +354,20 @@ class OltpLaneMixin:
         # (review round-5 finding #3)
         self._lane_sync = threading.Lock()
         self._nonlane_active = 0
+        # statement-scoped suspension: full-path statements whose base
+        # table set is known suspend lane writes ONLY for those tables
+        # (table -> active statement count, under _lane_sync). An
+        # analytic tenant scanning other tables no longer stalls the
+        # OLTP lane or forces its flush (engine.execute_stmt).
+        self._nonlane_tables: dict = {}
         self.lane_hits = 0
         self.lane_misses = 0
+        # cross-session batch windows (exec/oltpbatch.py): concurrent
+        # point statements fuse into one multi-key probe / one group
+        # commit. Session var oltp_batch=off restores the
+        # per-statement path bit-for-bit.
+        from .oltpbatch import LaneBatcher
+        self._lane_batcher = LaneBatcher(self)
 
     # -- entry ------------------------------------------------------
 
@@ -355,10 +404,19 @@ class OltpLaneMixin:
             return None
         t0 = time.perf_counter()
         try:
-            if plan.kind in ("point", "scan"):
+            if plan.kind == "scan":
+                # range scans stay per-statement: their native scan is
+                # already one fused pass and their result sizes would
+                # make window buffers unbounded
                 res = self._lane_read(plan, lits, session)
+            elif session is not None and \
+                    session.vars.get("oltp_batch", "auto") == "off":
+                # the A/B lever: off is exactly the per-statement path
+                res = (self._lane_read(plan, lits, session)
+                       if plan.kind == "point"
+                       else self._lane_write(plan, lits, session))
             else:
-                res = self._lane_write(plan, lits, session)
+                res = self._lane_batcher.submit(plan, lits, session)
         except ShapeIneligible:
             return None
         if res is not None:
@@ -707,16 +765,25 @@ class OltpLaneMixin:
 
     # -- write handlers ---------------------------------------------
 
+    def _nonlane_busy(self, table: str) -> bool:
+        """A full-path statement that can read `table` is in flight
+        (statement-scoped when its table set is known, global
+        otherwise)."""
+        return bool(self._nonlane_active
+                    or self._nonlane_tables.get(table))
+
     def _lane_write(self, plan: LanePlan, lits, session):
         from ..kv.concurrency import TxnAbortedError, TxnRetryError
+        from ..kv.txn import DB as KVDB
         from ..kv.txn import Txn
+        from .dml import retry_exhausted
         self._stmt_lock.acquire_read()
         try:
-            if self._nonlane_active:
-                # a full-path statement is in flight: its snapshot was
-                # taken after a flush, so new lane writes must queue
-                # BEHIND it — take the full path instead (re-checked
-                # under _lane_sync at commit time)
+            if self._nonlane_busy(plan.table):
+                # a full-path statement over this table is in flight:
+                # its snapshot was taken after a flush, so new lane
+                # writes must queue BEHIND it — take the full path
+                # instead (re-checked under _lane_sync at commit time)
                 raise ShapeIneligible("nonlane active")
             if any(f.table == plan.table for f in self.cdc_feeds) \
                     or any(th.is_alive() and tb == plan.table
@@ -733,11 +800,11 @@ class OltpLaneMixin:
             schema = td.schema
             codec = td.codec
             last = None
-            for _ in range(20):
+            for _ in range(KVDB.MAX_ATTEMPTS):
                 t = Txn(self.kv.store)
                 try:
                     with self._lane_sync:
-                        if self._nonlane_active:
+                        if self._nonlane_busy(plan.table):
                             raise ShapeIneligible("nonlane active")
                         res = self._lane_write_once(plan, lits, t, m,
                                                     td, schema, codec)
@@ -759,8 +826,7 @@ class OltpLaneMixin:
                 except BaseException:
                     t.rollback()
                     raise
-            raise EngineError(
-                f"restart transaction: DML exhausted retries: {last}")
+            raise retry_exhausted(last)
         finally:
             self._stmt_lock.release_read()
 
@@ -833,6 +899,185 @@ class OltpLaneMixin:
         t.put(key, codec.encode_value(row))
         return (Result(row_count=1, tag="UPDATE"), ("put", key, row))
 
+    # -- batch windows (exec/oltpbatch.py drives these) -------------
+
+    def _lane_read_batch(self, reqs) -> None:
+        """One fused multi-key probe for a window of point reads:
+        a single statement-gate acquisition, one read timestamp, and
+        one native `multiread` per table instead of len(reqs) point
+        reads. Each request's tscache span is still registered
+        individually, so writers see exactly the spans the
+        per-statement path would have left behind."""
+        self._stmt_lock.acquire_read()
+        try:
+            read_ts = self.clock.now()
+            rtsi = read_ts.to_int()
+            tsc = self.kv.store.tscache
+            groups: dict = {}
+            for req in reqs:
+                groups.setdefault(req.plan.table, []).append(req)
+            for tname, group in groups.items():
+                try:
+                    m = self._lane_mirror(tname)
+                except ShapeIneligible as e:
+                    for req in group:
+                        req.error = e
+                    continue
+                keys = []
+                for req in group:
+                    plan = req.plan
+                    if plan.td is None:
+                        plan.td = self.store.table(tname)
+                        plan.codec = plan.td.codec
+                    key = int(plan.pk_lit.get(req.lits))
+                    tsc.add(Span(plan.codec.key_from_pk((key,))),
+                            read_ts, None)
+                    keys.append(key)
+                vals, vld, fnd = m.multiread(keys, rtsi)
+                ncols = m.ncols
+                for i, req in enumerate(group):
+                    plan = req.plan
+                    rows = []
+                    if fnd[i]:
+                        base = i * ncols
+                        rows.append(tuple(
+                            dec(vals[base + p])
+                            if vld[base + p] else None
+                            for p, dec in plan.out_pairs))
+                    if plan.limit_lit is not None:
+                        rows = rows[:max(
+                            int(plan.limit_lit.get(req.lits)), 0)]
+                    req.result = Result(names=plan.out_names,
+                                        rows=rows,
+                                        types=plan.out_types)
+        finally:
+            self._stmt_lock.release_read()
+
+    def _lane_write_batch(self, reqs) -> None:
+        """Group commit for a window of single-row writes: the window
+        splits into rounds with at most one write per (table, pk) —
+        a second write to the same key must observe the first's
+        committed value, which a shared transaction cannot give it —
+        and each round commits as ONE kv transaction (one WAL-append
+        analogue) while every waiter still gets its own Result or
+        statement error."""
+        self._stmt_lock.acquire_read()
+        try:
+            live = []
+            for req in reqs:
+                tname = req.plan.table
+                if self._nonlane_busy(tname):
+                    # a full-path statement over this table is in
+                    # flight: its waiters fall back to the full path,
+                    # same as the per-statement lane
+                    req.error = ShapeIneligible("nonlane active")
+                elif any(f.table == tname for f in self.cdc_feeds) \
+                        or any(th.is_alive() and tb == tname
+                               for th, tb in
+                               self._cdc_threads.values()):
+                    req.error = ShapeIneligible("changefeed active")
+                else:
+                    live.append(req)
+            while live:
+                seen: set = set()
+                this_round, defer = [], []
+                for req in live:
+                    k = (req.plan.table, self._lane_req_pk(req))
+                    if k in seen:
+                        defer.append(req)
+                    else:
+                        seen.add(k)
+                        this_round.append(req)
+                self._lane_write_round(this_round)
+                live = defer
+        finally:
+            self._stmt_lock.release_read()
+
+    def _lane_req_pk(self, req):
+        """Primary-key value a write request targets (dedup key for
+        round-splitting). Uncoercible values pass through raw — the
+        round surfaces the real statement error."""
+        plan, lits = req.plan, req.lits
+        if plan.kind == "insert":
+            pk = self.store.table(plan.table).schema.primary_key[0]
+            for cn, ref in zip(plan.ins_cols, plan.ins_lits):
+                if cn == pk:
+                    v = ref.get(lits)
+                    try:
+                        return int(v)
+                    except (TypeError, ValueError):
+                        return v
+            return None
+        return int(plan.pk_lit.get(lits))
+
+    def _lane_write_round(self, reqs) -> None:
+        from ..kv.concurrency import TxnAbortedError, TxnRetryError
+        from ..kv.txn import DB as KVDB
+        from ..kv.txn import Txn
+        from ..kvserver.raft import GROUPCOMMIT
+        from .dml import retry_exhausted
+        ctx: dict = {}
+        for req in reqs:
+            tname = req.plan.table
+            if tname not in ctx:
+                m = self._lane_mirror(tname)
+                td = self.store.table(tname)
+                ctx[tname] = (m, td, td.schema, td.codec)
+        last = None
+        for _ in range(KVDB.MAX_ATTEMPTS):
+            t = Txn(self.kv.store)
+            try:
+                with self._lane_sync:
+                    if self._nonlane_active or any(
+                            self._nonlane_tables.get(tn)
+                            for tn in ctx):
+                        raise ShapeIneligible("nonlane active")
+                    outcomes = []
+                    for req in reqs:
+                        m, td, schema, codec = ctx[req.plan.table]
+                        try:
+                            res = self._lane_write_once(
+                                req.plan, req.lits, t, m, td,
+                                schema, codec)
+                        except (EngineError, ShapeIneligible) as e:
+                            # per-statement errors all raise BEFORE
+                            # t.put, so the shared txn carries no
+                            # trace of the failed request
+                            outcomes.append((req, None, e))
+                        else:
+                            outcomes.append((req, res, None))
+                    cts = t.commit()   # ONE commit for the round
+                    tsi = cts.to_int()
+                    nops = 0
+                    with self._lane_lock:
+                        for req, res, err in outcomes:
+                            if res is None or res[1] is None:
+                                continue
+                            op = res[1]
+                            self._lane_apply_mirror(
+                                ctx[req.plan.table][0], op, tsi)
+                            self._lane_pending.setdefault(
+                                req.plan.table, []).append((op, tsi))
+                            nops += 1
+                if nops:
+                    GROUPCOMMIT.bump(nops)
+                for req, res, err in outcomes:
+                    if err is not None:
+                        req.error = err
+                    else:
+                        req.result = res[0]
+                return
+            except (TxnRetryError, TxnAbortedError) as e:
+                t.rollback()
+                last = e
+            except ShapeIneligible:
+                t.rollback()
+                raise
+            except BaseException:
+                t.rollback()
+                raise
+        raise retry_exhausted(last)
+
     @staticmethod
     def _lane_coerce(col, v):
         f = col.type.family
@@ -846,12 +1091,22 @@ class OltpLaneMixin:
 
     # -- deferred publish -------------------------------------------
 
-    def lane_flush(self) -> None:
+    def lane_flush(self, tables=None) -> None:
         """Publish queued lane writes to the columnstore. Caller holds
-        the write side of the statement gate."""
+        the write side of the statement gate. ``tables`` limits the
+        publish to those tables' queues (statement-scoped flush:
+        engine.execute_stmt flushes only what the statement can read,
+        so an analytic query never pays another table's upload)."""
         with self._lane_lock:
-            pending = self._lane_pending
-            self._lane_pending = {}
+            if tables is None:
+                pending = self._lane_pending
+                self._lane_pending = {}
+            else:
+                pending = {}
+                for t in tables:
+                    e = self._lane_pending.pop(t, None)
+                    if e:
+                        pending[t] = e
         for table, entries in pending.items():
             entries.sort(key=lambda e: e[1])
             batches = []

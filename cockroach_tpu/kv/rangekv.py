@@ -39,8 +39,8 @@ from ..kvserver.store import _dec_ts, _enc_ts, raise_op_error
 from ..storage.hlc import Timestamp
 from ..storage.mvcc import MVCCValue, TxnMeta, TxnStatus
 from ..utils import tracing
-from .concurrency import (SpanLatchManager, TimestampCache, TxnRecord,
-                          TxnRegistry)
+from .concurrency import (Span, SpanLatchManager, TimestampCache,
+                          TxnRecord, TxnRegistry)
 from .txn import KVStore
 
 
@@ -97,7 +97,18 @@ class RangeMVCC:
     def get(self, key: bytes, read_ts: Timestamp,
             txn: Optional[TxnMeta] = None,
             inconsistent: bool = False) -> Optional[MVCCValue]:
-        return self._leaseholder(key).mvcc.get(
+        rep = self._leaseholder(key)
+        # leaseholder-side tscache (tscache/cache.go): the floor a
+        # served read leaves behind lives WITH the lease, so a write
+        # arriving via any other gateway still pushes above it (closes
+        # the gateway-local limitation noted in ClusterKVStore).  A
+        # RemoteReplica proxy has no cache; those reads fall back to
+        # the gateway-local discipline in Txn._write.
+        tscache = getattr(rep, "tscache", None)
+        if not inconsistent and tscache is not None:
+            tscache.add(Span(key), read_ts,
+                        txn.id if txn is not None else None)
+        return rep.mvcc.get(
             key, read_ts, txn=txn, inconsistent=inconsistent)
 
     def scan(self, start: bytes, end: bytes, read_ts: Timestamp,
@@ -108,6 +119,10 @@ class RangeMVCC:
         for desc, rep in self._ranges_overlapping(start, end):
             lo = max(start, desc.start_key)
             hi = min(end, desc.end_key)
+            tscache = getattr(rep, "tscache", None)
+            if not inconsistent and tscache is not None:
+                tscache.add(Span(lo, hi), read_ts,
+                            txn.id if txn is not None else None)
             out.extend(rep.mvcc.scan(
                 lo, hi, read_ts, txn=txn,
                 max_keys=(max_keys - len(out)) if max_keys else 0,
@@ -131,6 +146,16 @@ class RangeMVCC:
     def put(self, key: bytes, write_ts: Timestamp,
             value: Optional[bytes],
             txn: Optional[TxnMeta] = None) -> None:
+        if txn is not None:
+            # consult the LEASEHOLDER's tscache before proposing: a
+            # read served there (possibly via another gateway) sets a
+            # floor this write must exceed — same discipline Txn._write
+            # applies against the gateway-local cache
+            tscache = getattr(self._leaseholder(key), "tscache", None)
+            if tscache is not None:
+                floor = tscache.get_max(Span(key), exclude=txn.id)
+                if not txn.write_ts > floor:
+                    txn.write_ts = floor.next()
         op = {"op": "put" if value is not None else "delete",
               "key": key.decode("latin1"),
               "ts": _enc_ts(txn.write_ts if txn is not None
@@ -279,13 +304,13 @@ class ClusterKVStore(KVStore):
     per-SQL-gateway, like the reference's per-node concurrency
     manager; cross-gateway WRITE-write conflicts serialize on the
     replicated intents, and pushes of foreign txns consult the
-    replicated anchor-range record (``ClusterTxnRegistry``). Remaining
-    honest limitation: the timestamp cache is gateway-local, so a
-    read served by gateway A does not push gateway B's writes the way
-    a leaseholder-side tscache would — multi-gateway workloads should
-    route DML through one gateway until the tscache moves
-    leaseholder-side (tscache/cache.go is per-leaseholder in the
-    reference, which is what makes its reads safe)."""
+    replicated anchor-range record (``ClusterTxnRegistry``). Reads
+    additionally leave their floor in the LEASEHOLDER's timestamp
+    cache (``Replica.tscache`` — tscache/cache.go is per-leaseholder
+    in the reference), and ``RangeMVCC.put`` consults that floor
+    before proposing, so a read served via gateway A pushes a write
+    arriving via gateway B: multi-gateway DML no longer needs to
+    route through a single gateway."""
 
     def __init__(self, cluster):
         self.cluster = cluster

@@ -28,7 +28,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from cockroach_tpu.kvserver.raft import RaftNode, Snapshot
+from cockroach_tpu.kvserver.raft import (RaftNode, Snapshot,
+                                         unpack_group)
 from cockroach_tpu.storage.hlc import MAX_TIMESTAMP, Clock, Timestamp
 from cockroach_tpu.storage.keys import EngineKey
 from cockroach_tpu.storage.mvcc import MVCC, TxnMeta, _dec_value
@@ -124,6 +125,13 @@ class Replica:
         # must stay below every in-flight write (the reference's
         # propBuf closed-timestamp tracker, replica_proposal_buf.go)
         self._inflight_wts: dict[str, Timestamp] = {}
+        # leaseholder-side timestamp cache (tscache/cache.go is
+        # per-leaseholder in the reference): reads served HERE leave
+        # their floor HERE, so a write arriving via a different
+        # gateway still pushes above every served read. Travels with
+        # the lease, not the gateway.
+        from ..kv.concurrency import TimestampCache
+        self.tscache = TimestampCache()
         from .rangefeed import Processor as RangefeedProcessor
         self.rangefeed = RangefeedProcessor(self)
 
@@ -219,31 +227,7 @@ class Replica:
             # dedup window on surviving replicas
             cmd["_id"] = f"{self.store.node_id}.{uuid.uuid4().hex[:16]}"
         if cmd.get("kind") == "batch" and self.holds_lease():
-            # closed-timestamp discipline at the leaseholder: forward
-            # any write below the closed ts (the promise to followers
-            # is that history at or below it is immutable), and carry a
-            # new closed ts on the command so followers advance at
-            # apply time (closedts "raft transport")
-            closed = self.closed_ts
-            min_wts = None
-            for op in cmd["ops"]:
-                if "ts" not in op:
-                    continue
-                wts = _dec_ts(op["ts"])
-                if not closed < wts:
-                    wts = Timestamp(closed.wall, closed.logical + 1)
-                    op["ts"] = _enc_ts(wts)
-                if min_wts is None or wts < min_wts:
-                    min_wts = wts
-            if min_wts is not None:
-                self._inflight_wts[cmd["_id"]] = min_wts
-            target = self._closed_target()
-            if min_wts is not None and not target < min_wts:
-                target = Timestamp(min_wts.wall, min_wts.logical - 1) \
-                    if min_wts.logical > 0 else Timestamp(
-                        min_wts.wall - 1, 0)
-            if self.closed_ts < target:
-                cmd["closed"] = _enc_ts(target)
+            self._prep_closed(cmd)
         if done is not None:
             self._waiters[cmd["_id"]] = done
         # span events fire on the PROPOSER's thread (the one holding
@@ -265,6 +249,61 @@ class Replica:
             return True
         self._waiters.pop(cmd["_id"], None)
         return False
+
+    def _prep_closed(self, cmd: dict) -> None:
+        """Closed-timestamp discipline at the leaseholder: forward any
+        write below the closed ts (the promise to followers is that
+        history at or below it is immutable), and carry a new closed
+        ts on the command so followers advance at apply time (closedts
+        "raft transport")."""
+        closed = self.closed_ts
+        min_wts = None
+        for op in cmd["ops"]:
+            if "ts" not in op:
+                continue
+            wts = _dec_ts(op["ts"])
+            if not closed < wts:
+                wts = Timestamp(closed.wall, closed.logical + 1)
+                op["ts"] = _enc_ts(wts)
+            if min_wts is None or wts < min_wts:
+                min_wts = wts
+        if min_wts is not None:
+            self._inflight_wts[cmd["_id"]] = min_wts
+        target = self._closed_target()
+        if min_wts is not None and not target < min_wts:
+            target = Timestamp(min_wts.wall, min_wts.logical - 1) \
+                if min_wts.logical > 0 else Timestamp(
+                    min_wts.wall - 1, 0)
+        if self.closed_ts < target:
+            cmd["closed"] = _enc_ts(target)
+
+    def propose_batch(self, cmds: list[dict],
+                      dones: list[Optional[Callable]]) -> bool:
+        """Group commit: propose a whole batch window of commands as
+        ONE raft log entry (raft.propose_group). Each waiter is still
+        registered and acked individually at apply time — per-command
+        results and errors are preserved. Falls back to per-command
+        propose when this replica is not the leader (forwarded
+        proposals stay single-command: the leader owns windowing)."""
+        if not (self.raft.is_leader() and self.holds_lease()):
+            ok = True
+            for cmd, done in zip(cmds, dones):
+                ok = self.propose(cmd, done) and ok
+            return ok
+        datas = []
+        for cmd, done in zip(cmds, dones):
+            if "_id" not in cmd:
+                cmd["_id"] = \
+                    f"{self.store.node_id}.{uuid.uuid4().hex[:16]}"
+            if cmd.get("kind") == "batch":
+                self._prep_closed(cmd)
+            if done is not None:
+                self._waiters[cmd["_id"]] = done
+            datas.append(json.dumps(cmd).encode())
+        tracing.event("raft-group-append",
+                      range_id=self.desc.range_id,
+                      commands=len(datas))
+        return self.raft.propose_group(datas) is not None
 
     # ------------------------------------------------------------------
     # raft plumbing
@@ -301,7 +340,17 @@ class Replica:
         self.applied_index = index
         if not data:
             return
-        cmd = json.loads(data.decode())
+        group = unpack_group(data)
+        if group is not None:
+            # group-commit entry: unpack and apply each command in
+            # proposal order, acking every waiter individually (the
+            # apply-side half of the group-commit contract)
+            for sub in group:
+                self._apply_cmd(json.loads(sub.decode()))
+            return
+        self._apply_cmd(json.loads(data.decode()))
+
+    def _apply_cmd(self, cmd: dict) -> None:
         cmd_id = cmd.get("_id", "")
         self._inflight_wts.pop(cmd_id, None)
         if cmd_id and cmd_id in self._applied_ids:

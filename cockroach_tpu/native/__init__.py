@@ -114,6 +114,15 @@ def get_oltp():
         lib.oltp_live.restype = ctypes.c_int
         lib.oltp_read.argtypes = [vp, i64, i64, i64p, u8p]
         lib.oltp_read.restype = ctypes.c_int
+        try:
+            # batch-window gather (may be absent from a stale cached
+            # .so built before the symbol existed; callers hasattr-gate
+            # and fall back to per-key oltp_read)
+            lib.oltp_multiread.argtypes = [vp, i64, i64p, i64, i64p,
+                                           u8p, u8p]
+            lib.oltp_multiread.restype = i64
+        except AttributeError:
+            pass
         lib.oltp_scan.argtypes = [vp, i64, ctypes.c_int, ctypes.c_int,
                                   i64, ctypes.c_int, ctypes.c_int,
                                   i64, i64, i64p, i64p, u8p]

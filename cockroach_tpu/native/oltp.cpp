@@ -192,6 +192,32 @@ int oltp_read(void* h, int64_t key, int64_t read_ts, int64_t* out_vals,
   return 1;
 }
 
+// Fused multi-key probe (the batch window's gather): one shared-lock
+// acquisition and one pass over a key vector instead of n oltp_read
+// calls. out_vals/out_valid are row-major ncols per key slot;
+// out_found[i] is 1 when key i has a visible version. Returns hits.
+int64_t oltp_multiread(void* h, int64_t n, const int64_t* keys,
+                       int64_t read_ts, int64_t* out_vals,
+                       uint8_t* out_valid, uint8_t* out_found) {
+  auto* t = static_cast<Table*>(h);
+  std::shared_lock lk(t->mu);
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    out_found[i] = 0;
+    auto it = t->index.find(keys[i]);
+    if (it == t->index.end()) continue;
+    int64_t r = t->visible(it->second, read_ts);
+    if (r < 0) continue;
+    std::memcpy(out_vals + i * t->ncols, &t->vals[r * t->ncols],
+                sizeof(int64_t) * t->ncols);
+    std::memcpy(out_valid + i * t->ncols, &t->valid[r * t->ncols],
+                t->ncols);
+    out_found[i] = 1;
+    hits++;
+  }
+  return hits;
+}
+
 // Ordered range scan over live keys in [lo, hi] (bounds optional via
 // has_*/strict flags), emitting up to `cap` visible rows in key
 // order. Returns rows written; out_vals is row-major ncols per row.

@@ -1097,6 +1097,17 @@ class PgServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # OLTP responses are one small packet per statement;
+                # with cross-session batch windows a session's reply
+                # can gate another session's window, so Nagle's 40ms
+                # delayed-ACK interaction would land straight on the
+                # fused lane's p99 (the reference sets TCP_NODELAY on
+                # every pgwire conn for the same reason)
+                try:
+                    self.request.setsockopt(socket.IPPROTO_TCP,
+                                            socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
                 outer._next_id[0] += 1
                 conn = _Conn(self.request, outer.engine,
                              outer._next_id[0], outer.version,

@@ -98,6 +98,27 @@ class LSM:
                     return v
             return None
 
+    def get_newest(self, start: EngineKey, end: EngineKey, want=None
+                   ) -> Optional[tuple[EngineKey, Optional[bytes]]]:
+        """First live merged entry in [start, end): the MVCC
+        newest-version point probe. scan() materializes every version
+        in the range under the lock before the caller sees one — for
+        a hot key carrying V versions that is O(V) per point lookup
+        (zipfian OLTP keys reach thousands). This stops at the first
+        entry that is not an engine tombstone and passes ``want``."""
+        with self._lock:
+            sources = [self.mem.iter_range(start, end)]
+            sources += [s.iter_range(start, end) for s in self.l0]
+            if self.l1 is not None:
+                sources.append(self.l1.iter_range(start, end))
+            for k, v in _merge(sources):
+                if v is None:
+                    continue
+                if want is not None and not want(k):
+                    continue
+                return k, v
+        return None
+
     def scan(self, start: EngineKey, end: Optional[EngineKey] = None,
              include_tombstones: bool = False
              ) -> Iterator[tuple[EngineKey, Optional[bytes]]]:

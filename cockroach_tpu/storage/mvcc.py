@@ -148,14 +148,13 @@ class MVCC:
         """Newest version with ts <= max_ts (or any, if None)."""
         start = (EngineKey.versioned(key, max_ts) if max_ts is not None
                  else EngineKey(key, 0))
-        for ek, v in self.engine.scan(start, EngineKey(next_key(key), -1),
-                                      include_tombstones=True):
-            if ek.key != key or ek.is_meta:
-                continue
-            if v is None:
-                continue  # engine tombstone (GC'd version)
-            return MVCCValue(key, ek.ts, _dec_value(v))
-        return None
+        hit = self.engine.get_newest(
+            start, EngineKey(next_key(key), -1),
+            lambda ek: ek.key == key and not ek.is_meta)
+        if hit is None:
+            return None
+        ek, v = hit
+        return MVCCValue(key, ek.ts, _dec_value(v))
 
     @staticmethod
     def _own(meta: Optional[TxnMeta], txn: Optional[TxnMeta]) -> bool:

@@ -266,6 +266,11 @@ class SessionVars:
         # past which a warm locator declines in favor of the scan
         "index_scan": "on",          # on | off
         "index_lookup_limit": 4096,
+        # cross-session batch fusion on the OLTP lane
+        # (exec/oltpbatch.py): auto fuses concurrent point statements
+        # into batch windows (one multi-key probe / one group commit);
+        # off restores the per-statement lane path (bench A/B lever)
+        "oltp_batch": "auto",        # auto | off
         # admission tier for this session's statements (the reference's
         # admission.WorkPriority): high | normal | low
         "admission_priority": "normal",

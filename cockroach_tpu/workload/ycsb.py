@@ -58,7 +58,8 @@ class YCSB:
 
     def __init__(self, engine, workload: str = "A", records: int = 1000,
                  seed: int = 0, distribution: str = "zipfian",
-                 scan_limit: int = 10):
+                 scan_limit: int = 10, session=None,
+                 record_latency: bool = False):
         if workload not in MIXES:
             raise ValueError(f"unknown YCSB workload {workload!r}")
         self.engine = engine
@@ -73,6 +74,12 @@ class YCSB:
         self.next_key = records
         self.ops = {op: 0 for op in
                     ("read", "update", "insert", "scan", "rmw")}
+        self.retries = 0               # client-side txn restarts
+        # each driver is one client session: session vars (e.g. the
+        # oltp_batch A/B lever) ride with it into every statement
+        self.session = session
+        self.record_latency = record_latency
+        self.latencies: list = []      # per-step seconds when enabled
         # hoisted: the mix is fixed, don't rebuild per step
         self._op_names, op_probs = zip(*self.mix.items())
         self._op_sampler = _CdfSampler(op_probs, self.rng, batch=1024)
@@ -97,31 +104,52 @@ class YCSB:
             return self.zipf.sample()
         return int(self.rng.integers(0, self.records))
 
+    def _write_retry(self, sql: str):
+        """Execute a write, retrying client-side on txn restarts —
+        what every YCSB client does against the reference (lib/pq
+        surfaces SQLSTATE 40001, the workload retries the op). Contended
+        per-statement writes restart under write-write races; retry
+        time counts toward the op's recorded latency, which is the
+        client-observed truth."""
+        from ..exec.session import EngineError
+        while True:
+            try:
+                return self.engine.execute(sql, self.session)
+            except EngineError as exc:
+                if "restart transaction" not in str(exc):
+                    raise
+                self.retries += 1
+
     def step(self) -> str:
+        import time
         op = self._op_names[self._op_sampler.sample()]
         e = self.engine
+        s = self.session
         k = self._key()
+        t0 = time.perf_counter() if self.record_latency else 0.0
         if op == "read":
             e.execute(f"SELECT field0, field1 FROM usertable "
-                      f"WHERE ycsb_key = {k}")
+                      f"WHERE ycsb_key = {k}", s)
         elif op == "update":
-            e.execute(f"UPDATE usertable SET field0 = "
-                      f"{int(self.rng.integers(0, 1000))} "
-                      f"WHERE ycsb_key = {k}")
+            self._write_retry(f"UPDATE usertable SET field0 = "
+                              f"{int(self.rng.integers(0, 1000))} "
+                              f"WHERE ycsb_key = {k}")
         elif op == "insert":
-            e.execute(f"INSERT INTO usertable VALUES ({self.next_key}, "
-                      f"0, 0)")
+            self._write_retry(f"INSERT INTO usertable VALUES "
+                              f"({self.next_key}, 0, 0)")
             self.next_key += 1
         elif op == "scan":
             e.execute(f"SELECT ycsb_key, field0 FROM usertable "
                       f"WHERE ycsb_key >= {k} ORDER BY ycsb_key "
-                      f"LIMIT {self.scan_limit}")
+                      f"LIMIT {self.scan_limit}", s)
         elif op == "rmw":
             r = e.execute(f"SELECT field0 FROM usertable "
-                          f"WHERE ycsb_key = {k}")
+                          f"WHERE ycsb_key = {k}", s)
             v = (r.rows[0][0] or 0) + 1 if r.rows else 0
-            e.execute(f"UPDATE usertable SET field0 = {v} "
-                      f"WHERE ycsb_key = {k}")
+            self._write_retry(f"UPDATE usertable SET field0 = {v} "
+                              f"WHERE ycsb_key = {k}")
+        if self.record_latency:
+            self.latencies.append(time.perf_counter() - t0)
         self.ops[op] += 1
         return op
 
@@ -134,25 +162,36 @@ class YCSB:
         return {"ops": dict(self.ops), "seconds": dt,
                 "ops_per_sec": steps / dt if dt > 0 else 0.0}
 
-    def run_concurrent(self, steps: int = 100,
-                       workers: int = 16) -> dict:
+    def run_concurrent(self, steps: int = 100, workers: int = 16,
+                       session_vars: dict | None = None,
+                       record_latency: bool = False) -> dict:
         """N concurrent drivers over ONE engine, each with its own
         worker object (private RNG/zipf/counters — no shared mutable
         state except the engine, whose statement gate is the thing
         under test). Insert keyspaces are disjoint per worker so
         concurrent inserts never collide on the primary key. The
         16-connection shape of the reference's `workload run ycsb
-        --concurrency`."""
+        --concurrency`. ``session_vars`` gives every driver its own
+        Session with those vars set (the fused-vs-per-statement
+        ``oltp_batch`` A/B rides this); ``record_latency`` adds
+        p50/p99 per-op milliseconds to the result."""
         import threading
         import time
 
         per = max(steps // workers, 1)
         drivers = []
         for w in range(workers):
+            session = None
+            if session_vars is not None:
+                from ..exec.session import Session
+                session = Session()
+                for k, v in session_vars.items():
+                    session.vars.set(k, v)
             d = YCSB(self.engine, workload=self.workload,
                      records=self.records, seed=1000 + w,
                      distribution=self.distribution,
-                     scan_limit=self.scan_limit)
+                     scan_limit=self.scan_limit, session=session,
+                     record_latency=record_latency)
             # disjoint from BOTH each other and any keys a prior
             # sequential run inserted from self.next_key upward
             d.next_key = self.records + (w + 1) * 10_000_000
@@ -179,5 +218,13 @@ class YCSB:
         total = per * workers
         ops = {op: sum(d.ops[op] for d in drivers)
                for op in self.ops}
-        return {"ops": ops, "seconds": dt, "workers": workers,
-                "ops_per_sec": total / dt if dt > 0 else 0.0}
+        out = {"ops": ops, "seconds": dt, "workers": workers,
+               "ops_per_sec": total / dt if dt > 0 else 0.0,
+               "retries": sum(d.retries for d in drivers)}
+        if record_latency:
+            lats = sorted(x for d in drivers for x in d.latencies)
+            if lats:
+                out["p50_ms"] = lats[len(lats) // 2] * 1e3
+                out["p99_ms"] = lats[
+                    min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3
+        return out

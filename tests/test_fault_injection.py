@@ -609,3 +609,138 @@ class TestDispatcherDeath:
         assert e.execute(q).rows == want  # distributed path is back
         assert d.respawns >= r0 + 1
         e.close()
+
+
+class TestBatchWindowDeath:
+    """The OLTP batch window's fault bar (round 18): the leader thread
+    executing a fused window dies mid-window — every waiting session
+    must get exactly ONE outcome (its result or an error, never a
+    hang, never two), and the batcher must keep serving afterwards."""
+
+    def _mk(self):
+        from cockroach_tpu.exec.engine import Engine
+
+        e = Engine()
+        e.execute("CREATE TABLE bt (k INT8 NOT NULL PRIMARY KEY, "
+                  "v INT8)")
+        e.execute("INSERT INTO bt VALUES " + ", ".join(
+            f"({i}, {i})" for i in range(32)))
+        return e
+
+    def _session(self):
+        from cockroach_tpu.exec.session import Session
+
+        s = Session()
+        s.vars.set("oltp_batch", "auto")
+        return s
+
+    def test_executor_death_mid_window_exactly_one_outcome(self):
+        import threading
+
+        from cockroach_tpu.native import get_oltp
+
+        if get_oltp() is None:
+            pytest.skip("native toolchain unavailable")
+        e = self._mk()
+        lb = e._lane_batcher
+        s = self._session()
+        e.execute("UPDATE bt SET v = 0 WHERE k = 0", s)  # shape built
+        gate = threading.Event()
+        entered = threading.Event()
+        real = lb._writes.run_fn
+        boom = RuntimeError("executor died mid-window")
+
+        def dying(reqs):
+            # half the window already has results when the leader
+            # dies: the survivors keep theirs, the rest get the error
+            entered.set()
+            gate.wait(5)
+            for r in reqs[: len(reqs) // 2]:
+                real([r])
+            raise boom
+
+        lb._writes.run_fn = dying
+        outcomes = {}
+
+        def drive(k):
+            try:
+                r = e.execute(
+                    f"UPDATE bt SET v = {k + 100} WHERE k = {k}", s)
+                outcomes[k] = ("ok", r.row_count)
+            except Exception as exc:
+                outcomes[k] = ("err", str(exc))
+
+        ts = [threading.Thread(target=drive, args=(k,))
+              for k in range(1, 7)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        for _ in range(200):
+            with lb._writes.window_cv:
+                if len(lb._writes.queue) == 5:
+                    break
+            time.sleep(0.01)
+        lb._writes.run_fn = real
+        gate.set()
+        for t in ts:
+            t.join(10)
+        assert not any(t.is_alive() for t in ts)   # nobody hangs
+        assert len(outcomes) == 6                  # exactly one each
+        errs = [k for k, (kind, _) in outcomes.items()
+                if kind == "err"]
+        assert errs                                # the death surfaced
+        for k, (kind, info) in outcomes.items():
+            if kind == "err":
+                assert "executor died" in info
+        # the batcher recovered: next statement rides a fresh window
+        r = e.execute("UPDATE bt SET v = 999 WHERE k = 31", s)
+        assert r.row_count == 1
+        assert e.execute("SELECT v FROM bt WHERE k = 31"
+                         ).rows == [(999,)]
+        # committed writes from the half-applied window are visible,
+        # failed ones untouched: each key is either old or new value
+        for k in range(1, 7):
+            v = e.execute(f"SELECT v FROM bt WHERE k = {k}"
+                          ).rows[0][0]
+            assert v in (k, k + 100)
+
+    def test_keyboard_interrupt_propagates_and_fails_waiters(self):
+        """A non-Exception BaseException (Ctrl-C on the leader) still
+        gives every waiter an outcome AND re-raises on the leader."""
+        from cockroach_tpu.exec.oltpbatch import BatchReq, LaneBatcher
+
+        class _Eng:
+            def _lane_read_batch(self, reqs):
+                raise KeyboardInterrupt
+
+            def _lane_write_batch(self, reqs):
+                raise KeyboardInterrupt
+
+        lb = LaneBatcher(_Eng())
+        reqs = [BatchReq(None, [], None) for _ in range(3)]
+        with pytest.raises(KeyboardInterrupt):
+            lb._run_phase(reqs, _Eng()._lane_write_batch)
+        for r in reqs:
+            assert isinstance(r.error, KeyboardInterrupt)
+
+    def test_executor_dropping_a_request_is_an_error_not_a_hang(self):
+        """An executor that returns without assigning an outcome to
+        some request violates its contract: the batcher must surface
+        that as an error on the dropped request."""
+        from cockroach_tpu.exec.oltpbatch import BatchReq, LaneBatcher
+
+        class _Eng:
+            def _lane_read_batch(self, reqs):
+                reqs[0].result = "served"
+
+            def _lane_write_batch(self, reqs):
+                pass
+
+        eng = _Eng()
+        lb = LaneBatcher(eng)
+        reqs = [BatchReq(None, [], None) for _ in range(2)]
+        lb._run_phase(reqs, eng._lane_read_batch)
+        assert reqs[0].result == "served" and reqs[0].error is None
+        assert isinstance(reqs[1].error, RuntimeError)
+        assert "dropped" in str(reqs[1].error)

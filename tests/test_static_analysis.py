@@ -427,6 +427,157 @@ class TestBlockingUnderLock:
         assert r["exit_code"] == 0 and not _unwaived(r)
 
 
+# --- round 18: the batch-window wait/notify + group-commit tally
+# idioms, seeded BAD/CLEAN so the analyzer keeps guarding the shapes
+# oltpbatch.py and kvserver/raft.py actually use -------------------
+
+BAD_WINDOW_STATS = """
+    import threading
+
+    WINDOW_SIZES = []
+    _LOCK = threading.Lock()
+
+    def note_window(reqs):
+        # stats bump escaped the lock: two leaders draining their
+        # windows concurrently lose appends
+        WINDOW_SIZES.append(len(reqs))
+"""
+
+BAD_GLOBAL_PROPOSALS = """
+    PROPOSALS = 0
+
+    def bump():
+        global PROPOSALS
+        PROPOSALS += 1
+"""
+
+CLEAN_GROUPCOMMIT_TALLY = """
+    import threading
+
+    class _GroupCommitTally:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._proposals = 0
+
+        def bump(self, commands):
+            with self._mu:
+                self._proposals += 1
+
+    GROUPCOMMIT = _GroupCommitTally()
+
+    def commit_round(nops):
+        GROUPCOMMIT.bump(nops)
+"""
+
+BAD_WINDOW_RUN_UNDER_LOCK = """
+    import threading
+
+    _LOCK = threading.Lock()
+
+    def submit(req, done):
+        with _LOCK:
+            # leader runs the window while every follower's submit
+            # blocks on the same lock: the convoy the split
+            # collectors exist to avoid
+            done.wait(timeout=5.0)
+"""
+
+CLEAN_COLLECTOR_WINDOW = """
+    import threading
+
+    class Collector:
+        def __init__(self, run_fn):
+            self.window_cv = threading.Condition()
+            self.queue = []
+            self.busy = False
+            self.run_fn = run_fn
+
+        def submit(self, req):
+            batch = None
+            with self.window_cv:
+                self.queue.append(req)
+                while not req.done:
+                    if not self.busy:
+                        self.busy = True
+                        batch, self.queue = self.queue, []
+                        break
+                    self.window_cv.wait(timeout=1.0)
+            if batch is not None:
+                try:
+                    self.run_fn(batch)
+                finally:
+                    with self.window_cv:
+                        self.busy = False
+                        self.window_cv.notify_all()
+"""
+
+WAIVED_WINDOW_STATS = """
+    import threading
+
+    WINDOW_SIZES = []
+    _LOCK = threading.Lock()
+
+    def note_window(reqs):
+        # graftlint: waive[racy-global] single-threaded bench
+        # bookkeeping, never reached from session threads
+        WINDOW_SIZES.append(len(reqs))
+"""
+
+
+class TestBatchWindowIdioms:
+    """The round-18 concurrency shapes stay analyzable: unlocked
+    window stats and bare global proposal counters are caught, the
+    lock-inside-Tally wrapper and the condition-variable collector
+    are sanctioned, waivers still work."""
+
+    def test_unlocked_window_stats_caught(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/exec/bad.py": BAD_WINDOW_STATS},
+                  ["racy-global"])
+        hits = _unwaived(r, "racy-global")
+        assert len(hits) == 1
+        assert "WINDOW_SIZES" in hits[0].message
+
+    def test_bare_global_proposal_counter_caught(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/kvserver/bad.py":
+                   BAD_GLOBAL_PROPOSALS},
+                  ["racy-global"])
+        hits = _unwaived(r, "racy-global")
+        assert len(hits) == 1
+        assert "PROPOSALS" in hits[0].message
+
+    def test_groupcommit_tally_wrapper_sanctioned(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/kvserver/ok.py":
+                   CLEAN_GROUPCOMMIT_TALLY},
+                  ["racy-global"])
+        assert r["exit_code"] == 0 and not _unwaived(r)
+
+    def test_window_run_under_plain_lock_caught(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/exec/bad.py":
+                   BAD_WINDOW_RUN_UNDER_LOCK},
+                  ["blocking-under-lock"])
+        hits = _unwaived(r, "blocking-under-lock")
+        assert len(hits) == 1
+        assert "wait" in hits[0].message
+
+    def test_collector_cv_idiom_sanctioned(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/exec/ok.py": CLEAN_COLLECTOR_WINDOW},
+                  ["blocking-under-lock", "racy-global"])
+        assert r["exit_code"] == 0 and not _unwaived(r)
+
+    def test_waived_window_stats_pass(self, tmp_path):
+        r = _scan(tmp_path,
+                  {"cockroach_tpu/exec/ok.py": WAIVED_WINDOW_STATS},
+                  ["racy-global"])
+        assert not _unwaived(r)
+        waived = [f for f in r["findings"] if f.waived]
+        assert len(waived) == 1
+
+
 class TestPlanKeyCompleteness:
     def test_real_prepare_closure_is_complete(self, report):
         assert not _unwaived(report, "plan-key-completeness")
