@@ -1158,9 +1158,19 @@ def run_oltp_batch(records: int = 20000, steps: int = 6000,
 
     from cockroach_tpu.exec.engine import Engine
     from cockroach_tpu.models import tpch
+    from cockroach_tpu.server import pgfront
     from cockroach_tpu.workload.ycsb import YCSB
 
     eng = Engine()
+    # r19 satellite: sub-default GIL switch quantum. The r14 bars
+    # carried a caveat — an analytic statement holding the GIL for the
+    # full 5ms default quantum stretches batch-window close latency.
+    # sql.exec.switch_interval is the serving-path lever (armed by
+    # PgServer.start); the bench arms it identically so the oltp bars
+    # now price the lane with the quantum the front door serves under.
+    switch = float(os.environ.get("BENCH_SWITCH_INTERVAL", "0.001"))
+    eng.settings.set("sql.exec.switch_interval", switch)
+    pgfront.apply_switch_interval(eng.settings)
     t0 = time.time()
     wl = YCSB(eng, workload="A", records=records, seed=1)
     wl.setup()
@@ -1178,7 +1188,8 @@ def run_oltp_batch(records: int = 20000, steps: int = 6000,
           "WHERE l_quantity < 24")
     eng.execute(q6)
 
-    results = {"oltp_records": records, "oltp_steps": steps}
+    results = {"oltp_records": records, "oltp_steps": steps,
+               "oltp_switch_interval": switch}
     for n in sessions:
         per_arm = {}
         for arm in ("off", "auto"):
@@ -1237,6 +1248,277 @@ def run_oltp_batch(records: int = 20000, steps: int = 6000,
         results[f"oltp_batch_speedup_{n}"] = \
             round(per_arm["auto"]["ops_per_sec"] / off, 3) if off \
             else 0.0
+    return results
+
+
+def run_frontdoor(sessions=(1000, 10000)):
+    """Round-19 tentpole A/B: the selector reactor front door
+    (pgwire_frontend=reactor) vs thread-per-connection (threads) at
+    1K/10K CONNECTED sessions, almost all parked. Per rung: wall time
+    to connect+authenticate N sessions, RSS per parked session,
+    process thread count with everything idle, and point-read /
+    small-analytic latency from live tenants measured WHILE the idle
+    fleet is parked (the front door's job is that parked sessions
+    cost nothing — the live tenants shouldn't feel them). The
+    threads arm stops at 1K: a thread per idle session at 10K is the
+    pathology the reactor exists to remove, not a bar worth burning
+    ~80GB of stacks to print. A quota rung on the reactor arms
+    sql.admission.tenant.slots and sends a noisy analytic tenant
+    against quiet tenants — quiet p99 must hold while the noisy
+    tenant's excess statements queue (admission.tenant.slot_waits)."""
+    import socket as _socket
+    import struct as _struct
+    import threading as _th
+
+    from cockroach_tpu.cli import PgClient
+    from cockroach_tpu.exec.engine import Engine
+    from cockroach_tpu.server.pgwire import PgServer
+
+    # fd headroom: both ends of every connection live in this process
+    want = max(sessions) * 2 + 1024
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < want:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE,
+                (min(hard, want) if hard > 0 else want, hard))
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except Exception:
+        soft = 1024
+    cap = max(64, (soft - 1024) // 2)
+    sessions = tuple(min(n, cap) for n in sessions)
+
+    eng = Engine()
+    s0 = eng.session()
+    eng.execute("CREATE TABLE fd (k INT PRIMARY KEY, v FLOAT)", s0)
+    eng.execute("INSERT INTO fd VALUES "
+                + ", ".join(f"({i}, {i}.5)" for i in range(512)), s0)
+    ana_sql = "SELECT sum(k + v) FROM fd WHERE k < 400"
+    eng.execute(ana_sql, s0)                    # warm the plan
+    eng.execute("SELECT v FROM fd WHERE k = 3", s0)
+
+    def rss_kb():
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return int(ln.split()[1])
+        return 0
+
+    sp = (b"user\x00root\x00database\x00defaultdb\x00\x00")
+    startup = _struct.pack("!I", len(sp) + 8) \
+        + _struct.pack("!I", 196608) + sp
+
+    def connect_idle(addr):
+        sock = _socket.create_connection(addr, timeout=120.0)
+        sock.sendall(startup)
+        sock.settimeout(120.0)
+        buf = b""
+        while True:
+            off = 0
+            while len(buf) - off >= 5:
+                (ln,) = _struct.unpack_from("!I", buf, off + 1)
+                if len(buf) - off < 1 + ln:
+                    break
+                if buf[off:off + 1] == b"Z":
+                    return sock
+                off += 1 + ln
+            buf = buf[off:]
+            b = sock.recv(4096)
+            if not b:
+                raise ConnectionError("closed during startup")
+            buf += b
+
+    def p_ms(lat, q):
+        if not lat:
+            return 0.0
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(len(lat) * q))] * 1000
+
+    results = {}
+    for arm in ("reactor", "threads"):
+        srv = PgServer(eng, "127.0.0.1", 0, frontend=arm).start()
+        addr = srv.addr
+        try:
+            for n in sessions:
+                if arm == "threads" and n > 1000:
+                    print(f"# frontdoor arm=threads n={n} skipped "
+                          "(thread-per-idle-session at 10K is the "
+                          "pathology under test, not a bar)",
+                          file=sys.stderr)
+                    continue
+                idle: list = []
+                errors: list = []
+                ilock = _th.Lock()
+                rss0, th0 = rss_kb(), _th.active_count()
+                t0 = time.time()
+
+                def connector(k, per=(n + 15) // 16):
+                    got = []
+                    try:
+                        for _ in range(min(per, n - k * per)):
+                            got.append(connect_idle(addr))
+                    except BaseException as e:
+                        errors.append(e)
+                    with ilock:
+                        idle.extend(got)
+
+                cth = [_th.Thread(target=connector, args=(k,))
+                       for k in range(16)]
+                for t in cth:
+                    t.start()
+                for t in cth:
+                    t.join()
+                connect_s = time.time() - t0
+                if errors:
+                    raise errors[0]
+                time.sleep(1.0)          # let startup workers park
+                rss1, th1 = rss_kb(), _th.active_count()
+                # live tenants against the parked fleet: 4 point-read
+                # sessions + 1 analytic session
+                lat_pt: list = []
+                lat_ana: list = []
+                llock = _th.Lock()
+
+                def oltp(idx):
+                    try:
+                        c = PgClient(*addr)
+                        got = []
+                        for i in range(64):
+                            t1 = time.monotonic()
+                            c.query("SELECT v FROM fd WHERE k = "
+                                    f"{(idx * 64 + i) % 512}")
+                            got.append(time.monotonic() - t1)
+                        c.close()
+                        with llock:
+                            lat_pt.extend(got)
+                    except BaseException as e:
+                        errors.append(e)
+
+                def analytic():
+                    try:
+                        c = PgClient(*addr)
+                        got = []
+                        for _ in range(8):
+                            t1 = time.monotonic()
+                            c.query(ana_sql)
+                            got.append(time.monotonic() - t1)
+                        c.close()
+                        with llock:
+                            lat_ana.extend(got)
+                    except BaseException as e:
+                        errors.append(e)
+
+                live = [_th.Thread(target=oltp, args=(i,))
+                        for i in range(4)]
+                live.append(_th.Thread(target=analytic))
+                for t in live:
+                    t.start()
+                for t in live:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                key = f"fd_{arm}_{n}"
+                results[f"{key}_connect_s"] = round(connect_s, 2)
+                results[f"{key}_rss_kb_per_idle"] = \
+                    round(max(0, rss1 - rss0) / n, 1)
+                results[f"{key}_threads"] = th1 - th0
+                results[f"{key}_oltp_p50_ms"] = \
+                    round(p_ms(lat_pt, 0.50), 2)
+                results[f"{key}_oltp_p99_ms"] = \
+                    round(p_ms(lat_pt, 0.99), 2)
+                results[f"{key}_ana_p99_ms"] = \
+                    round(p_ms(lat_ana, 0.99), 2)
+                print(f"# frontdoor arm={arm} n={n} "
+                      f"connect_s={connect_s:.2f} "
+                      f"rss_kb_per_idle={results[f'{key}_rss_kb_per_idle']} "
+                      f"threads=+{th1 - th0} "
+                      f"oltp_p99_ms={results[f'{key}_oltp_p99_ms']} "
+                      f"ana_p99_ms={results[f'{key}_ana_p99_ms']}",
+                      file=sys.stderr)
+                for s in idle:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                # drain teardowns before the next rung measures RSS
+                deadline = time.time() + 60
+                while (getattr(srv._impl, "_sessions", None)
+                       and len(srv._impl._sessions) > 0
+                       and time.time() < deadline):
+                    time.sleep(0.1)
+        finally:
+            srv.stop()
+
+    # quota rung (reactor): noisy analytic tenant vs quiet tenants at
+    # the 1K-mixed shape — tenant slot quota parks the noisy excess
+    srv = PgServer(eng, "127.0.0.1", 0, frontend="reactor").start()
+    addr = srv.addr
+    try:
+        def quiet_run(lat_out):
+            errors2: list = []
+
+            def quiet(idx):
+                try:
+                    c = PgClient(*addr)
+                    c.query("SET application_name = 'fd_quiet'")
+                    got = []
+                    for _ in range(16):
+                        t1 = time.monotonic()
+                        c.query(ana_sql)
+                        got.append(time.monotonic() - t1)
+                    c.close()
+                    lat_out.extend(got)
+                except BaseException as e:
+                    errors2.append(e)
+
+            ths = [_th.Thread(target=quiet, args=(i,))
+                   for i in range(2)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            if errors2:
+                raise errors2[0]
+
+        base_lat: list = []
+        quiet_run(base_lat)
+        eng.settings.set("sql.admission.tenant.slots", 2)
+        waits0 = eng.admission.tenant_slot_waits
+        stop = _th.Event()
+
+        def noisy():
+            try:
+                c = PgClient(*addr)
+                c.query("SET application_name = 'fd_noisy'")
+                while not stop.is_set():
+                    c.query(ana_sql)
+                c.close()
+            except BaseException:
+                pass
+
+        storm = [_th.Thread(target=noisy) for _ in range(8)]
+        for t in storm:
+            t.start()
+        time.sleep(0.5)
+        noisy_lat: list = []
+        quiet_run(noisy_lat)
+        stop.set()
+        for t in storm:
+            t.join(timeout=30)
+        waits = eng.admission.tenant_slot_waits - waits0
+        eng.settings.set("sql.admission.tenant.slots", 0)
+        results["fd_quota_quiet_p99_ms"] = round(p_ms(base_lat, 0.99), 2)
+        results["fd_quota_quiet_p99_noisy_ms"] = \
+            round(p_ms(noisy_lat, 0.99), 2)
+        results["fd_quota_slot_waits"] = waits
+        print(f"# frontdoor quota quiet_p99_ms="
+              f"{results['fd_quota_quiet_p99_ms']} "
+              f"noisy-storm quiet_p99_ms="
+              f"{results['fd_quota_quiet_p99_noisy_ms']} "
+              f"slot_waits={waits}", file=sys.stderr)
+    finally:
+        srv.stop()
     return results
 
 
@@ -1610,6 +1892,12 @@ def run_child(rows: int, query: str, timeout: int, attempts: int = 2,
         # both belong on XLA-CPU, not behind the tunnel
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    if mode == "frontdoor_child":
+        # the 1K/10K-session front-door rungs price socket plumbing,
+        # frame parsing, and thread scheduling — pure host paths; the
+        # one analytic plan belongs on XLA-CPU
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     if extra_env:
         env.update(extra_env)
     for attempt in range(attempts):
@@ -1814,6 +2102,17 @@ def main():
             "metric": "oltp_batch_speedup_32",
             "value": per.get("oltp_batch_speedup_32", 0),
             "unit": "x",
+            **per,
+        }))
+        return
+    if mode == "frontdoor_child":
+        per = run_frontdoor(
+            sessions=tuple(int(x) for x in os.environ.get(
+                "BENCH_FRONTDOOR_SESSIONS", "1000,10000").split(",")))
+        print(json.dumps({
+            "metric": "fd_reactor_1000_rss_kb_per_idle",
+            "value": per.get("fd_reactor_1000_rss_kb_per_idle", 0),
+            "unit": "KB/session",
             **per,
         }))
         return
@@ -2053,6 +2352,14 @@ def main():
         if r is not None:
             out.update({k: v for k, v in r.items()
                         if k.startswith("oltp_")})
+    # round 19 tentpole: selector-reactor front door vs thread-per-
+    # conn at 1K/10K parked sessions, plus the tenant-quota rung
+    if os.environ.get("BENCH_FRONTDOOR", "1") != "0":
+        r = run_child(0, "frontdoor", max(child_timeout, 1200),
+                      mode="frontdoor_child")
+        if r is not None:
+            out.update({k: v for k, v in r.items()
+                        if k.startswith("fd_")})
     if os.environ.get("BENCH_TPCC", "1") != "0":
         r = run_child(0, "tpcc", 900, mode="tpcc_child")
         if r is not None:
@@ -2125,7 +2432,16 @@ _NON_PERF_KEYS = {"vs_baseline", "vs_cpu", "n", "rc", "rows",
                   "oltp_auto_1000_gc_commands",
                   "oltp_auto_1000_cmds_per_proposal",
                   "oltp_off_32_retries", "oltp_auto_32_retries",
-                  "oltp_off_1000_retries", "oltp_auto_1000_retries"}
+                  "oltp_off_1000_retries", "oltp_auto_1000_retries",
+                  # front-door shape numbers: thread/RSS/quota counts
+                  # verify the reactor's resource model, not speed
+                  "oltp_switch_interval",
+                  "fd_reactor_1000_threads", "fd_reactor_10000_threads",
+                  "fd_threads_1000_threads",
+                  "fd_reactor_1000_rss_kb_per_idle",
+                  "fd_reactor_10000_rss_kb_per_idle",
+                  "fd_threads_1000_rss_kb_per_idle",
+                  "fd_quota_slot_waits"}
 
 
 def regression_report(out: dict) -> None:

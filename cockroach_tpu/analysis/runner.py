@@ -27,6 +27,7 @@ from .rules_device import (check_collective_discipline,
                            check_no_aliasing_upload)
 from .rules_lease import check_lease_discipline
 from .rules_plan import check_plan_key_completeness
+from .rules_reactor import check_reactor_discipline
 from .rules_registration import check_registration_drift
 
 # (rule name, exit bit, checker). Order is the documented bit layout.
@@ -38,8 +39,9 @@ RULES = (
     ("plan-key-completeness", 16, check_plan_key_completeness),
     ("registration-drift", 32, check_registration_drift),
     ("lease-discipline", 64, check_lease_discipline),
+    ("reactor-discipline", 128, check_reactor_discipline),
 )
-WAIVER_SYNTAX_BIT = 128
+WAIVER_SYNTAX_BIT = 256
 
 
 def changed_files(root) -> list[str] | None:

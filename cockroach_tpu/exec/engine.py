@@ -262,8 +262,20 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # in flight: non-owners wait on the event OUTSIDE _device_lock
         # so the host->device transfer never runs under the cache lock
         self._device_inflight: dict[tuple, threading.Event] = {}
-        self._exec_cache: dict[tuple, tuple] = {}
-        self._parse_cache: dict[str, object] = {}
+        # tenant-partitioned compiled-plan / parse caches (exec/
+        # tenantcache.py): dict-compatible on the read path; the put
+        # path tags entries with the executing statement's tenant so
+        # sql.exec.plan_cache.tenant_budget bounds each tenant to
+        # evicting its own shapes
+        from .tenantcache import TenantLRU
+        self._exec_cache: TenantLRU = TenantLRU(self._EXEC_CACHE_MAX)
+        self._parse_cache: TenantLRU = TenantLRU(
+            self._PARSE_CACHE_MAX,
+            on_evict=lambda k: self._plain_memo.discard(k))
+        # the executing statement's tenant, published per-thread
+        # between admission acquire/release so cache puts deep in the
+        # dispatch stack can attribute entries without plumbing
+        self._tenant_tl = threading.local()
         # SELECT texts proven view-free/subquery-free: the "_plain"
         # memo keyed by TEXT instead of mutating the shared cached AST
         # (round-4 advisor, low: an in-place annotation on a shared
@@ -423,10 +435,41 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # recorded but nothing shed on it)
         self.admission.movement_wait_p99 = (
             lambda: self.movement.m_wait.quantile(0.99))
+        # device-backlog back-pressure: the live dispatcher queue depth
+        # (exec.device.queue.depth) feeds the exec-queue shed rung —
+        # when the mesh itself is backlogged, admitting more work only
+        # grows execution-stall p99
+        self.admission.exec_queue_depth = (
+            lambda: self.devstats.queue_depth())
+        # per-tenant quota plane: hard slot/HBM budgets at dispatch
+        # (sql.admission.tenant.*) and plan-cache partitioning
+        # (sql.exec.plan_cache.tenant_budget)
+        self.metrics.func_counter(
+            "admission.tenant.slot_waits",
+            lambda: self.admission.tenant_slot_waits,
+            "statements queued because their tenant was at its "
+            "concurrent-slot cap while global slots were free")
+        self.metrics.func_counter(
+            "admission.tenant.hbm_waits",
+            lambda: self.admission.tenant_hbm_waits,
+            "statements queued because their tenant's in-flight HBM "
+            "ledger could not fit the statement's estimate")
+        self.metrics.func_gauge(
+            "admission.tenant.active",
+            lambda: len(self.admission.tenant_usage()),
+            "tenants currently holding at least one execution slot")
+        self.metrics.func_counter(
+            "admission.tenant.plan_evictions",
+            lambda: (sum(self._exec_cache.tenant_evictions.values())
+                     + sum(self._parse_cache.tenant_evictions.values())),
+            "plan/parse cache entries a tenant evicted from its OWN "
+            "partition on hitting sql.exec.plan_cache.tenant_budget")
         self._admission_settings()
         self.settings.on_change(
             lambda n, v: self._admission_settings()
-            if n.startswith("sql.admission.") else None)
+            if n.startswith(("sql.admission.",
+                             "sql.exec.plan_cache.",
+                             "sql.exec.hbm_budget_bytes")) else None)
         # sub-mesh dispatch plane (exec.submesh.dispatches counts in
         # _submesh_pool's router; count/occupancy read the pool live)
         self.metrics.func_gauge(
@@ -484,13 +527,27 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             "individual commands that rode group-commit proposals")
 
     def _admission_settings(self) -> None:
-        """Refresh the controller's shed thresholds from cluster
-        settings (sql.admission.shed.*; 0 disables)."""
+        """Refresh the controller's shed thresholds and tenant quotas
+        from cluster settings (sql.admission.*,
+        sql.exec.plan_cache.tenant_budget; 0 disables each)."""
         try:
             self.admission.shed_queue_depth = int(self.settings.get(
                 "sql.admission.shed.queue_depth"))
             self.admission.shed_wait_seconds = float(self.settings.get(
                 "sql.admission.shed.wait_seconds"))
+            self.admission.shed_exec_queue_depth = int(self.settings.get(
+                "sql.admission.shed.exec_queue_depth"))
+            self.admission.tenant_slots = int(self.settings.get(
+                "sql.admission.tenant.slots"))
+            frac = float(self.settings.get(
+                "sql.admission.tenant.hbm_fraction"))
+            self.admission.tenant_hbm_bytes = int(
+                frac * int(self.settings.get("sql.exec.hbm_budget_bytes"))
+            ) if frac > 0 else 0
+            budget = int(self.settings.get(
+                "sql.exec.plan_cache.tenant_budget"))
+            self._exec_cache.tenant_budget = budget
+            self._parse_cache.tenant_budget = budget
         except Exception:
             pass
 
@@ -565,16 +622,14 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 return hit
             return copy.deepcopy(hit)
         stmt = parser.parse(sql)
-        if len(self._parse_cache) >= self._PARSE_CACHE_MAX:
-            # evict the oldest half (dict preserves insertion order)
-            # instead of clearing: a full clear made every hot
-            # statement reparse at once — a stampede exactly when the
-            # cache was earning its keep
-            for k in list(self._parse_cache)[
-                    :self._PARSE_CACHE_MAX // 2]:
-                del self._parse_cache[k]
-                self._plain_memo.discard(k)
-        self._parse_cache[sql] = stmt
+        # insertion delegates eviction to the TenantLRU: a tenant past
+        # its sql.exec.plan_cache.tenant_budget evicts its own oldest
+        # entries; at the global cap the oldest half goes (a full
+        # clear made every hot statement reparse at once — a stampede
+        # exactly when the cache was earning its keep). The on_evict
+        # hook keeps _plain_memo in sync.
+        self._parse_cache.max_entries = self._PARSE_CACHE_MAX
+        self._parse_cache.put(sql, stmt, self._current_tenant())
         return copy.deepcopy(stmt) if not (
             isinstance(stmt, ast.Select) and not stmt.ctes
             and not self._has_derived(stmt)) else stmt
@@ -585,10 +640,37 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
     _EXEC_CACHE_MAX = 512
 
     def _exec_cache_put(self, key, val) -> None:
-        if len(self._exec_cache) >= self._EXEC_CACHE_MAX:
-            for k in list(self._exec_cache)[:self._EXEC_CACHE_MAX // 2]:
-                del self._exec_cache[k]
-        self._exec_cache[key] = val
+        self._exec_cache.max_entries = self._EXEC_CACHE_MAX
+        self._exec_cache.put(key, val, self._current_tenant())
+
+    def _current_tenant(self) -> str:
+        """Tenant of the statement executing on this thread ('' when
+        none): published across acquire/release in
+        _execute_stmt_inner so cache puts anywhere in the dispatch
+        stack (scanplane mixin, spill keys, parse inserts) attribute
+        entries without plumbing a tenant argument through."""
+        return getattr(self._tenant_tl, "value", "") or ""
+
+    def _stmt_hbm_estimate(self, stmt: ast.Statement) -> int:
+        """Coarse working-set estimate for the tenant HBM ledger:
+        8 bytes per (row, column) over the statement's enumerable base
+        tables. Deliberately cheap and over-inclusive (projection and
+        filters ignored) — the ledger gates *concurrency* per tenant,
+        it is not an allocator; the BytesMonitor still owns real
+        reservations at upload time. Computed only when
+        sql.admission.tenant.hbm_fraction arms the quota."""
+        tables = self._stmt_tables(stmt)
+        if not tables:
+            return 0
+        total = 0
+        for t in tables:
+            td = self.store.tables.get(t)
+            if td is not None:
+                try:
+                    total += td.row_count * len(td.schema.columns) * 8
+                except Exception:
+                    pass
+        return total
 
     def shape_ladder(self) -> coldstart.ShapeLadder:
         """The shape-bucket ladder every padded row count comes from:
@@ -682,6 +764,12 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         if res is not None:
             return res
         session = session or self.session()
+        # publish the tenant for the parse-cache put: admission (which
+        # publishes it for exec-cache puts) only runs later, inside
+        # _execute_stmt_inner — too late for the parse insert
+        app = str(session.vars.get("application_name") or "")
+        prev_tenant = getattr(self._tenant_tl, "value", "")
+        self._tenant_tl.value = app or f"s{id(session)}"
         try:
             stmt = self._parse_cached(sql)
         except Exception:
@@ -690,6 +778,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
             if session.txn is not None:
                 session.txn_aborted = True
             raise
+        finally:
+            self._tenant_tl.value = prev_tenant
         return self.execute_stmt(stmt, session, sql_text=sql)
 
     def execute_stmt(self, stmt: ast.Statement, session: Session,
@@ -816,7 +906,17 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
         # own tenant rather than one shared bucket
         app_name = str(session.vars.get("application_name") or "")
         tenant = app_name or f"s{id(session)}"
-        self.admission.acquire(priority=prio, tenant=tenant)
+        # per-tenant HBM ledger (sql.admission.tenant.hbm_fraction):
+        # estimate the working set only when the quota is armed — the
+        # estimate walks the statement's base tables
+        hbm_est = (self._stmt_hbm_estimate(stmt)
+                   if self.admission.tenant_hbm_bytes else 0)
+        self.admission.acquire(priority=prio, tenant=tenant,
+                               hbm=hbm_est)
+        # publish the tenant for cache-put attribution (restored in
+        # the finally below; nested statements keep their outer value)
+        prev_tenant = getattr(self._tenant_tl, "value", "")
+        self._tenant_tl.value = tenant
         # SET tracing = on|cluster (pgwire trace control): "on"
         # records gateway-local; "cluster" additionally sets the
         # recording-request bit so every RPC / DistSQL flow the
@@ -984,7 +1084,8 @@ class Engine(OltpLaneMixin, FastpathMixin, ScanPlaneMixin, DDLMixin,
                 session.txn_aborted = True
             raise
         finally:
-            self.admission.release()
+            self._tenant_tl.value = prev_tenant
+            self.admission.release(tenant=tenant, hbm=hbm_est)
 
     def _dispatch_locked(self, stmt, session, sql_text: str,
                          shared: bool) -> Result:

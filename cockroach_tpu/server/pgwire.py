@@ -54,6 +54,10 @@ class ProtocolError(Exception):
     pass
 
 
+class PreparedBudgetError(Exception):
+    """Session exceeded server.prepared_statement_budget (53400)."""
+
+
 # -- SCRAM-SHA-256 (RFC 5802/7677; the reference's default auth
 # method, pkg/sql/pgwire/auth_methods.go:69) --------------------------
 
@@ -83,6 +87,8 @@ def _sqlstate(exc: Exception) -> str:
     msg = str(exc)
     if isinstance(exc, CopyDataError):
         return "22P02"  # invalid_text_representation
+    if isinstance(exc, PreparedBudgetError):
+        return "53400"  # configuration_limit_exceeded
     if isinstance(exc, AdmissionRejected):
         # admission queue full / load shed: the clean front-door
         # rejection clients should retry with backoff
@@ -316,10 +322,17 @@ def split_statements(buf: str) -> list[str]:
 
 
 class _Writer:
-    """Typed pgwire backend-message writer over a socket."""
+    """Typed pgwire backend-message writer over a socket.
 
-    def __init__(self, sock: socket.socket):
+    ``sendall`` injects the flush primitive: the reactor front end
+    hands workers a select-backed sendall that is safe on its
+    non-blocking sockets; the thread front end keeps the plain
+    blocking ``socket.sendall``.
+    """
+
+    def __init__(self, sock: socket.socket, sendall=None):
         self._sock = sock
+        self._sendall = sendall or sock.sendall
         self._buf = bytearray()
 
     def msg(self, typ: bytes, payload: bytes = b""):
@@ -327,7 +340,7 @@ class _Writer:
 
     def flush(self):
         if self._buf:
-            self._sock.sendall(bytes(self._buf))
+            self._sendall(bytes(self._buf))
             self._buf.clear()
 
     # -- concrete messages ---------------------------------------------------
@@ -601,7 +614,8 @@ class _Conn:
     def __init__(self, sock: socket.socket, engine: Engine, conn_id: int,
                  version: str, auth: dict | None = None,
                  tls=None, auth_method: str = "cleartext",
-                 scram_users: dict | None = None):
+                 scram_users: dict | None = None,
+                 reader=None, sendall=None):
         self.sock = sock
         self.engine = engine
         self.conn_id = conn_id
@@ -610,8 +624,11 @@ class _Conn:
         self.auth_method = auth_method
         self.scram_users = scram_users or {}
         self.tls = tls  # ssl.SSLContext or None
-        self.r = _Reader(sock)
-        self.w = _Writer(sock)
+        # the reactor front end injects a frame-queue reader and a
+        # non-blocking-safe sendall; every protocol handler below is
+        # shared verbatim between front ends (the bit-for-bit A/B)
+        self.r = reader if reader is not None else _Reader(sock)
+        self.w = _Writer(sock, sendall=sendall)
         self.session: Session = engine.session()
         # extended-protocol state: prepared statements (sql, declared
         # param oids) + bound portals (sql with params substituted,
@@ -785,6 +802,13 @@ class _Conn:
                 self.w.flush()
                 return False
             break
+        return self.finish_startup(params)
+
+    def finish_startup(self, params: dict) -> bool:
+        """Authentication + session announcements for an accepted
+        PROTO_V3 startup. Split from handshake() so the reactor front
+        end — which parses startup packets on the event loop — can run
+        just this phase on a worker thread."""
         self.user = params.get("user", "root")
         if self.auth is not None:
             if self.auth_method == "scram-sha-256":
@@ -827,21 +851,57 @@ class _Conn:
         log.info(log.SESSIONS, "client session opened user=%s",
                  getattr(self, "user", "?"))
         while True:
-            typ, body = self.r.message()
-            if typ == b"X":          # Terminate
+            typ, body = self._next_message()
+            if typ is None:          # idle-session timeout
                 return
-            if typ == b"Q":
-                self._simple_query(body)
-            elif typ in (b"P", b"B", b"D", b"E", b"C", b"H", b"S"):
-                self._extended(typ, body)
-            elif typ == b"F":        # function call: unsupported
-                self.w.error("function call protocol unsupported",
-                             code="0A000")
-                self.w.ready_for_query(self._txn_status())
-            else:
-                self.w.error(f"unknown frontend message {typ!r}",
-                             code="08P01")
-                self.w.ready_for_query(self._txn_status())
+            if not self.process(typ, body):
+                return
+
+    def _next_message(self):
+        """Blocking read of the next frame, honoring
+        server.idle_session_timeout while the session sits idle
+        OUTSIDE a transaction (a session holding a txn open keeps its
+        locks on purpose; pg's idle_session_timeout has the same
+        carve-out via idle_in_transaction_session_timeout). Returns
+        (None, None) when the idle deadline fires."""
+        try:
+            idle = float(self.engine.settings.get(
+                "server.idle_session_timeout"))
+        except Exception:
+            idle = 0.0
+        if idle <= 0 or self.session.in_txn:
+            return self.r.message()
+        try:
+            self.sock.settimeout(idle)
+            return self.r.message()
+        except (socket.timeout, TimeoutError):
+            return None, None
+        finally:
+            try:
+                self.sock.settimeout(None)
+            except OSError:
+                pass
+
+    def process(self, typ: bytes, body: bytes) -> bool:
+        """Dispatch one frontend message; False = Terminate. Both
+        front ends funnel through here — the thread loop above and
+        the reactor's worker drain (server/pgfront.py) — so replies
+        are byte-identical by construction."""
+        if typ == b"X":          # Terminate
+            return False
+        if typ == b"Q":
+            self._simple_query(body)
+        elif typ in (b"P", b"B", b"D", b"E", b"C", b"H", b"S"):
+            self._extended(typ, body)
+        elif typ == b"F":        # function call: unsupported
+            self.w.error("function call protocol unsupported",
+                         code="0A000")
+            self.w.ready_for_query(self._txn_status())
+        else:
+            self.w.error(f"unknown frontend message {typ!r}",
+                         code="08P01")
+            self.w.ready_for_query(self._txn_status())
+        return True
 
     def _simple_query(self, body: bytes):
         sql, _ = _cstr(body, 0)
@@ -1004,6 +1064,21 @@ class _Conn:
                 n_ph = _count_placeholders(sql)
                 while len(oids) < n_ph:
                     oids.append(0)
+                if name and name not in self.stmts:
+                    # named statements are session-lifetime server
+                    # memory; cap them so one session cannot grow the
+                    # server unboundedly (the unnamed statement
+                    # replaces itself and stays exempt)
+                    try:
+                        budget = int(self.engine.settings.get(
+                            "server.prepared_statement_budget"))
+                    except Exception:
+                        budget = 0
+                    if budget and len(self.stmts) >= budget:
+                        raise PreparedBudgetError(
+                            f"prepared statement budget ({budget}) "
+                            f"exhausted; DEALLOCATE or Close unused "
+                            f"statements")
                 self.stmts[name] = (sql, oids)
                 self.w.parse_complete()
             elif typ == b"B":         # Bind
@@ -1062,18 +1137,32 @@ class _Conn:
 
 
 class PgServer:
-    """TCP listener dispatching pgwire connections onto threads.
+    """The pgwire front door: listener + connection dispatch.
 
-    The reference accepts on a listener in (*Server).AcceptClients
-    (pkg/server/server.go:1915) and serves each conn on a goroutine via
-    pgwire.Server.ServeConn; threads are the Python analogue.
+    Two interchangeable front ends behind one facade, selected by the
+    ``server.pgwire_frontend`` cluster setting (or the ``frontend=``
+    override):
+
+    - ``reactor`` (default): one selector event loop owns every
+      socket; idle sessions hold no thread and O(1) buffer memory; a
+      bounded worker pool sized by *active statements* runs the
+      protocol handlers (server/pgfront.py).
+    - ``threads``: the legacy thread-per-connection
+      socketserver.ThreadingTCPServer — the reference accepts in
+      (*Server).AcceptClients (pkg/server/server.go:1915) and serves
+      each conn on a goroutine; a thread per conn is that analogue.
+
+    Both front ends drive the same ``_Conn`` protocol handlers, so
+    replies are bit-identical — the A/B lever for the 1K/10K-session
+    bench rungs.
     """
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
                  port: int = 0, version: str = "0.2.0",
                  auth: dict | None = None,
                  certs_dir: str | None = None,
-                 auth_method: str = "cleartext"):
+                 auth_method: str = "cleartext",
+                 frontend: str | None = None):
         self.engine = engine
         self.version = version
         self.auth = auth  # user -> cleartext password; None = insecure
@@ -1093,7 +1182,56 @@ class PgServer:
                 os.path.join(certs_dir, "node.key"))
             self.tls = ctx
         self._next_id = [0]
-        outer = self
+        if frontend is None:
+            try:
+                frontend = str(engine.settings.get(
+                    "server.pgwire_frontend"))
+            except Exception:
+                frontend = "threads"
+        self.frontend = frontend
+        if frontend == "reactor":
+            from .pgfront import ReactorServer
+            self._impl = ReactorServer(self, host, port)
+        else:
+            self._impl = _ThreadServer(self, host, port)
+
+    def new_conn(self, sock: socket.socket, reader=None,
+                 sendall=None) -> _Conn:
+        """One _Conn with the next conn id; both front ends funnel
+        connection construction through here."""
+        self._next_id[0] += 1
+        return _Conn(sock, self.engine, self._next_id[0], self.version,
+                     auth=self.auth, tls=self.tls,
+                     auth_method=self.auth_method,
+                     scram_users=self.scram_users,
+                     reader=reader, sendall=sendall)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._impl.addr
+
+    def start(self):
+        from . import pgfront
+        # the r18 residue lever: a sub-default GIL switch quantum lets
+        # OLTP batch windows close under analytic load (process-global;
+        # see sql.exec.switch_interval). Armed here + on change.
+        pgfront.apply_switch_interval(self.engine.settings)
+        self.engine.settings.on_change(
+            lambda n, v: pgfront.apply_switch_interval(
+                self.engine.settings)
+            if n == "sql.exec.switch_interval" else None)
+        self._impl.start()
+        return self
+
+    def stop(self):
+        self._impl.stop()
+
+
+class _ThreadServer:
+    """Thread-per-connection front end (the pre-reactor default)."""
+
+    def __init__(self, parent: PgServer, host: str, port: int):
+        outer = parent
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -1108,12 +1246,7 @@ class PgServer:
                                             socket.TCP_NODELAY, 1)
                 except OSError:
                     pass
-                outer._next_id[0] += 1
-                conn = _Conn(self.request, outer.engine,
-                             outer._next_id[0], outer.version,
-                             auth=outer.auth, tls=outer.tls,
-                             auth_method=outer.auth_method,
-                             scram_users=outer.scram_users)
+                conn = outer.new_conn(self.request)
                 try:
                     conn.serve()
                 except (ConnectionError, ProtocolError, OSError):

@@ -171,6 +171,53 @@ def _register_builtins(s: Settings):
     s.register("sql.admission.shed.wait_seconds", 0.0, float,
                "recent admission grant-wait (EWMA, seconds) above which "
                "low-priority statements are shed (0 disables)")
+    s.register("sql.admission.shed.exec_queue_depth", 0, int,
+               "live device-dispatcher queue depth (exec.device.queue."
+               "depth) above which low-priority statements are shed: "
+               "when the mesh itself is backlogged, queueing more work "
+               "only grows execution-stall p99 (0 disables)")
+    s.register("sql.admission.tenant.slots", 0, int,
+               "per-tenant cap on concurrently held admission slots; a "
+               "tenant at its cap queues behind other tenants even when "
+               "global slots are free (0 disables; the quota analogue "
+               "of tenant-weighted WorkQueue ordering)")
+    s.register("sql.admission.tenant.hbm_fraction", 0.0, float,
+               "fraction of sql.exec.hbm_budget_bytes one tenant's "
+               "in-flight statements may pin at once; statements whose "
+               "estimated working set would push the tenant over wait "
+               "for an eligible slot instead of dispatching (0 disables)")
+    s.register("sql.exec.plan_cache.tenant_budget", 0, int,
+               "per-tenant entry budget in the compiled-plan and parse "
+               "caches: a tenant past its budget evicts its OWN oldest "
+               "entries, never another tenant's compiled shapes "
+               "(0 = shared LRU, no partitioning)")
+    s.register("server.prepared_statement_budget", 256, int,
+               "named prepared statements one pgwire session may hold; "
+               "Parse past the budget fails with 53400 instead of "
+               "growing server memory unboundedly (0 disables)")
+    # pgwire front door (server/pgfront.py reactor)
+    s.register("server.pgwire_frontend", "reactor", str,
+               "pgwire connection front end: reactor = one selector "
+               "event loop owns all sockets, idle sessions hold no "
+               "thread, a bounded worker pool sized by active "
+               "statements runs the protocol; threads = legacy "
+               "thread-per-connection socketserver (bit-for-bit A/B "
+               "lever)")
+    s.register("server.idle_session_timeout", 0.0, float,
+               "seconds a pgwire session may sit idle outside a "
+               "transaction before the server closes it (0 disables; "
+               "idle_session_timeout analogue)")
+    s.register("server.startup_deadline_seconds", 10.0, float,
+               "deadline for a new connection to complete its startup "
+               "packet and authentication; a slow-loris connect is "
+               "closed at the deadline instead of pinning the front "
+               "door (0 disables)")
+    s.register("sql.exec.switch_interval", 0.0, float,
+               "sys.setswitchinterval applied while executor workers "
+               "run (0 = leave the interpreter default of 5ms). "
+               "Process-global: a smaller quantum lets OLTP batch "
+               "windows close while an analytic statement holds the "
+               "GIL (measured ~2x oltpbatch flip at 0.0005)")
     # observability: operator profiles + statement diagnostics
     s.register("sql.stmt_profile.enabled", True, bool,
                "per-statement coarse operator profile (exec/profile"
